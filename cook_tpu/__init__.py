@@ -1,0 +1,25 @@
+"""cook_tpu — a TPU-native multitenant fair-share batch scheduler.
+
+A ground-up rebuild of the capabilities of Cook (Two Sigma's fair-share batch
+scheduler, reference at /root/reference): DRU-based fair-share ranking,
+offer/bin-packing job->host matching, preemptive rebalancing, quotas/shares/
+rate limits, pluggable compute-cluster backends, REST API + clients, and a
+faster-than-real-time trace-replay simulator.
+
+Unlike the reference (Clojure + Java Fenzo), the per-cycle scheduling hot path
+is implemented as jitted, batched JAX/XLA computations:
+
+- ``cook_tpu.ops.dru``      — fair-share (DRU) ranking as segmented prefix sums
+                              (reference: scheduler/src/cook/scheduler/dru.clj)
+- ``cook_tpu.ops.match``    — jobs x offers bin-packing assignment kernels
+                              (reference: Fenzo scheduleOnce, scheduler.clj:617-687)
+- ``cook_tpu.ops.rebalance``— preemption victim search
+                              (reference: scheduler/src/cook/rebalancer.clj:320-407)
+- ``cook_tpu.parallel``     — per-pool sharding over a TPU mesh (shard_map) with
+                              ICI collectives for cross-pool reconciliation
+
+The control plane (transactional store, state machines, cluster backends, REST,
+policy) stays host-side, mirroring the reference's layer map (SURVEY.md section 1).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+from .dru import (  # noqa: F401
+    RankInputs,
+    RankResult,
+    pool_quota_mask,
+    rank_kernel,
+    segment_cumsum,
+    user_quota_mask,
+)
+from .match import (  # noqa: F401
+    MatchInputs,
+    auction_match_kernel,
+    greedy_match_kernel,
+    multipass_match_kernel,
+)
+from .padding import bucket, pad_to  # noqa: F401
+from . import host_prep, reference_impl  # noqa: F401

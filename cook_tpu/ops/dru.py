@@ -1,0 +1,143 @@
+"""DRU (Dominant Resource Usage) fair-share ranking as jitted tensor kernels.
+
+Re-expresses the reference's rank hot loop (SURVEY.md HOT LOOP #1;
+reference: scheduler/src/cook/scheduler/dru.clj:43-126 and
+scheduler.clj sort-jobs-by-dru-helper/limit-over-quota-jobs :2057-2099) as
+segmented prefix sums + one global sort, instead of per-user lazy lists merged
+through a priority queue:
+
+  per user u, tasks sorted by the user's task order (running first, then
+  priority/submit order):
+      cum[u,i]   = sum of resources of tasks 0..i of u          (prefix sum)
+      dru[u,i]   = max(cum_mem/share_mem, cum_cpus/share_cpus)  (default mode)
+                 |  cum_gpus/share_gpus                         (gpu mode)
+  global rank = all tasks sorted ascending by dru.
+
+Tasks from all users are laid out contiguously per user in one padded array;
+segment starts are carried as `first_idx` (index of the first task of this
+task's user), which turns per-user prefix sums into
+``cumsum(x) - cumsum(x)[first_idx-1]`` — an O(T) computation with no
+data-dependent control flow, so XLA maps it to a handful of fused loops.
+
+Quota enforcement at rank time is folded in as masks:
+  * per-user over-quota limiting (reference: limit-over-quota-jobs
+    scheduler.clj:2057): tasks after the Nth over-quota task are dropped;
+  * pool-level quota (reference: filter-based-on-pool-quota tools.clj:917) is
+    a cumsum + compare over the ranked pending jobs.
+
+Ties: the reference explicitly allows any order for equal DRUs
+(dru.clj:114-116 docstring); we fix (dru, user_rank, position) ordering so the
+kernel and the CPU fallback agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import scan as scanlib
+
+# Column layout of the per-task usage matrix fed to the quota mask.
+USAGE_DIMS = ("cpus", "mem", "gpus", "count")
+
+
+class RankInputs(NamedTuple):
+    """Padded device inputs for one pool's rank cycle.
+
+    All tasks (running tasks first within each user, then that user's pending
+    jobs in priority order) are grouped contiguously by user.
+    """
+
+    usage: jax.Array       # f32[T, 4] per-task (cpus, mem, gpus, count=1)
+    quota: jax.Array       # f32[T, 4] the task's user's quota, inf = unlimited
+    shares: jax.Array      # f32[T, 3] the user's DRU divisors (cpus, mem, gpus)
+    first_idx: jax.Array   # i32[T] index of first task of this task's user
+    user_rank: jax.Array   # i32[T] dense rank of the user (sorted by name)
+    pending: jax.Array     # bool[T] True for pending (virtual) tasks
+    valid: jax.Array       # bool[T] False for padding
+
+
+class RankResult(NamedTuple):
+    order: jax.Array       # i32[T] task indices; ranked pending jobs first
+    dru: jax.Array         # f32[T] per-task DRU score (+inf for dropped/padding)
+    keep: jax.Array        # bool[T] survived over-quota limiting
+    num_ranked: jax.Array  # i32[] number of ranked pending tasks
+
+
+def segment_cumsum(x: jax.Array, first_idx: jax.Array) -> jax.Array:
+    """Per-segment inclusive prefix sum for contiguous segments.
+
+    ``first_idx[t]`` is the index of the first element of t's segment.
+    Uses a restart-flag associative scan, not cumsum-minus-base, so float32
+    precision is bounded by per-segment magnitudes (no cross-user
+    cancellation at production scale).
+    """
+    return scanlib.segmented_cumsum_by_first_idx(x, first_idx)
+
+
+@functools.partial(jax.jit, static_argnames=("gpu_mode", "max_over_quota_jobs"))
+def rank_kernel(inp: RankInputs, *, gpu_mode: bool = False,
+                max_over_quota_jobs: int = 100) -> RankResult:
+    """Rank one pool's tasks by DRU. Returns ranked order over pending tasks.
+
+    Matches the semantics of sort-jobs-by-dru-helper (scheduler.clj:2073-2099)
+    with dru-mode default|gpu (dru.clj:50-80,106-126).
+    """
+    usage = inp.usage * inp.valid[:, None]
+
+    # --- per-user over-quota limiting (limit-over-quota-jobs) --------------
+    cum_all = segment_cumsum(usage, inp.first_idx)
+    over = jnp.any(cum_all > inp.quota, axis=-1) & inp.valid
+    over_cnt = segment_cumsum(over.astype(jnp.int32), inp.first_idx)
+    keep = inp.valid & (over_cnt <= max_over_quota_jobs)
+
+    # --- segmented prefix sums over surviving tasks ------------------------
+    cum = segment_cumsum(usage * keep[:, None], inp.first_idx)
+    if gpu_mode:
+        dru = cum[:, 2] / inp.shares[:, 2]
+    else:
+        dru = jnp.maximum(cum[:, 1] / inp.shares[:, 1],
+                          cum[:, 0] / inp.shares[:, 0])
+
+    # --- global ascending sort over pending survivors ----------------------
+    rankable = keep & inp.pending
+    sort_dru = jnp.where(rankable, dru, jnp.inf)
+    position = jnp.arange(dru.shape[0], dtype=jnp.int32)
+    order = jnp.lexsort((position, inp.user_rank, sort_dru))
+    num_ranked = jnp.sum(rankable.astype(jnp.int32))
+    return RankResult(order=order.astype(jnp.int32),
+                      dru=jnp.where(keep, dru, jnp.inf),
+                      keep=keep, num_ranked=num_ranked)
+
+
+@jax.jit
+def pool_quota_mask(job_usage: jax.Array, base_usage: jax.Array,
+                    quota: jax.Array, valid: jax.Array) -> jax.Array:
+    """Pool-level quota filter over the ranked pending queue.
+
+    ``job_usage`` f32[J, 4] in ranked order; ``base_usage``/``quota`` f32[4]
+    are the pool's current running usage and cap.  A job is kept when the
+    cumulative usage of *all* jobs ahead of it (kept or not) plus base stays
+    below quota — matching filter-based-on-pool-quota (tools.clj:917-933),
+    whose accumulator includes filtered jobs.
+    """
+    cum = jnp.cumsum(job_usage * valid[:, None], axis=0) + base_usage[None, :]
+    return valid & jnp.all(cum <= quota[None, :], axis=-1)
+
+
+@jax.jit
+def user_quota_mask(job_usage: jax.Array, user_rank: jax.Array,
+                    first_idx: jax.Array, base_usage: jax.Array,
+                    quota: jax.Array, valid: jax.Array) -> jax.Array:
+    """Per-user quota filter over a user-contiguous job list.
+
+    ``base_usage`` f32[U, 4] running usage per user id; ``quota`` f32[J, 4]
+    per job.  Used by the considerable-jobs filter at match time
+    (reference: filter-pending-jobs-for-quota tools.clj:899-915).
+    """
+    cum = segment_cumsum(job_usage * valid[:, None], first_idx)
+    total = cum + base_usage[user_rank]
+    return valid & jnp.all(total <= quota, axis=-1)

@@ -1,0 +1,106 @@
+"""Host-side packing: entity lists -> padded device tensors.
+
+The control plane deals in Job/Instance entities; the kernels in padded
+arrays.  This module is the boundary: pure numpy, no JAX, so it can feed
+either the TPU kernels or the CPU fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .padding import bucket, pad_to
+from .reference_impl import UserTasks
+
+F32 = np.float32
+
+
+def pack_rank_inputs(users: List[UserTasks],
+                     shares: Dict[str, Tuple[float, float, float]],
+                     quotas: Dict[str, np.ndarray],
+                     pad: bool = True):
+    """Build the arrays of ops.dru.RankInputs (as numpy) plus the flat
+    task-id table mapping kernel positions back to tasks.
+
+    Users are laid out contiguously, sorted by user name (matching the
+    reference's deterministic ``(sort-by first)``, dru.clj:123).
+    Returns (arrays dict, task_ids list).
+    """
+    users = sorted(users, key=lambda u: u.user)
+    usage_rows, quota_rows, share_rows = [], [], []
+    first_idx, user_rank, pending, task_ids = [], [], [], []
+    offset = 0
+    for rank, ut in enumerate(users):
+        n = len(ut.task_ids)
+        share = np.asarray(shares[ut.user], dtype=F32)
+        quota = np.asarray(quotas[ut.user], dtype=F32)
+        for i in range(n):
+            usage_rows.append(ut.usage[i])
+            quota_rows.append(quota)
+            share_rows.append(share)
+            first_idx.append(offset)
+            user_rank.append(rank)
+            pending.append(ut.pending[i])
+            task_ids.append(ut.task_ids[i])
+        offset += n
+
+    if not task_ids:  # canonical 1-row all-padding layout
+        usage_rows = [np.zeros(4, dtype=F32)]
+        quota_rows = [np.full(4, np.inf, dtype=F32)]
+        share_rows = [np.full(3, np.inf, dtype=F32)]
+        first_idx, user_rank, pending = [0], [0], [False]
+    arrays = {
+        "usage": np.array(usage_rows, dtype=F32),
+        "quota": np.array(quota_rows, dtype=F32),
+        "shares": np.array(share_rows, dtype=F32),
+        "first_idx": np.array(first_idx, dtype=np.int32),
+        "user_rank": np.array(user_rank, dtype=np.int32),
+        "pending": np.array(pending, dtype=bool),
+        "valid": np.full(len(first_idx), bool(task_ids)),
+    }
+    if pad:
+        size = bucket(arrays["usage"].shape[0])
+        arrays["usage"] = pad_to(arrays["usage"], size)
+        arrays["quota"] = pad_to(arrays["quota"], size, fill=np.inf)
+        arrays["shares"] = pad_to(arrays["shares"], size, fill=np.inf)
+        arrays["first_idx"] = pad_to(arrays["first_idx"], size)
+        arrays["user_rank"] = pad_to(arrays["user_rank"], size,
+                                     fill=np.int32(2**31 - 1))
+        arrays["pending"] = pad_to(arrays["pending"], size, fill=False)
+        arrays["valid"] = pad_to(arrays["valid"], size, fill=False)
+    return arrays, task_ids
+
+
+def pack_match_inputs(job_res: Sequence[Sequence[float]],
+                      constraint_mask: np.ndarray,
+                      host_avail: Sequence[Sequence[float]],
+                      host_capacity: Sequence[Sequence[float]],
+                      pad: bool = True):
+    """Pad jobs x hosts match inputs to buckets. Padding jobs get valid=False;
+    padding hosts get zero capacity (never feasible)."""
+    job_res = np.asarray(job_res, dtype=F32).reshape(-1, 4)
+    avail = np.asarray(host_avail, dtype=F32).reshape(-1, 4)
+    capacity = np.asarray(host_capacity, dtype=F32).reshape(-1, 4)
+    J, H = job_res.shape[0], avail.shape[0]
+    cmask = np.asarray(constraint_mask, dtype=bool).reshape(J, H)
+    valid = np.ones(J, dtype=bool)
+    if pad:
+        JB, HB = bucket(J), bucket(H)
+        job_res = pad_to(job_res, JB)
+        valid = pad_to(valid, JB, fill=False)
+        avail = pad_to(avail, HB)
+        capacity = pad_to(capacity, HB)
+        grown = np.zeros((JB, HB), dtype=bool)
+        grown[:J, :H] = cmask
+        cmask = grown
+    return {
+        "job_res": job_res,
+        "constraint_mask": cmask,
+        "avail": avail,
+        "capacity": capacity,
+        "valid": valid,
+        "num_jobs": J,
+        "num_hosts": H,
+    }

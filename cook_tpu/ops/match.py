@@ -1,0 +1,152 @@
+"""Batched jobs x offers bin-packing assignment kernels.
+
+Replaces the reference's Fenzo hot loop (SURVEY.md HOT LOOP #2; reference:
+fenzo.scheduleOnce called from scheduler.clj:617-687, default fitness
+cpuMemBinPacker per config.clj:108) with two TPU formulations:
+
+* :func:`greedy_match_kernel` — ``lax.scan`` over jobs in rank order; each
+  step evaluates the full host axis (feasibility + fitness) as wide vector
+  ops and commits one assignment.  Bit-exact parity with the sequential CPU
+  fallback (``reference_impl.greedy_match``); the sequential carry is only
+  the H x R availability matrix.
+
+* :func:`multipass_match_kernel` — K rounds of "every unassigned job picks
+  its best host in parallel, then per-host prefix-sum conflict resolution in
+  rank order".  One round is O(J*H) fully-parallel work, so XLA tiles it onto
+  the MXU/VPU without a J-length dependency chain; a handful of rounds
+  converges to the greedy answer for real offer distributions (parity is
+  asserted statistically in tests, >=99.9% per BASELINE.md).
+
+Both kernels take a precompiled constraint mask (bool[J, H]) — the host-side
+constraint compiler (cook_tpu.sched.constraints) lowers the reference's
+constraint zoo (constraints.clj) into it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import scan as scanlib
+
+NEG_INF = -jnp.inf
+
+
+class MatchInputs(NamedTuple):
+    job_res: jax.Array          # f32[J, R] demands in rank order
+    constraint_mask: jax.Array  # bool[J, H]
+    avail: jax.Array            # f32[H, R] offered (spare) resources
+    capacity: jax.Array         # f32[H, R] total capacity (for fitness)
+    valid: jax.Array            # bool[J] False for padding
+
+
+def _fitness(need: jax.Array, avail: jax.Array, capacity: jax.Array) -> jax.Array:
+    """cpuMemBinPacker: mean post-assignment utilization of cpus+mem.
+    Higher is better (pack tight, leave big holes elsewhere)."""
+    used = capacity - avail
+    cap = jnp.maximum(capacity, 1e-9)
+    f_cpu = (used[:, 0] + need[0]) / cap[:, 0]
+    f_mem = (used[:, 1] + need[1]) / cap[:, 1]
+    return (f_cpu + f_mem) * 0.5
+
+
+@jax.jit
+def greedy_match_kernel(inp: MatchInputs) -> Tuple[jax.Array, jax.Array]:
+    """Sequential-greedy assignment, one job per scan step.
+
+    Returns (assign i32[J] host index or -1, remaining avail f32[H, R]).
+    """
+
+    def step(avail, xs):
+        need, cmask, valid = xs
+        feasible = jnp.all(avail >= need[None, :], axis=1) & cmask & valid
+        fitness = jnp.where(feasible, _fitness(need, avail, inp.capacity), NEG_INF)
+        host = jnp.argmax(fitness)  # ties -> lowest index, as in the fallback
+        found = feasible[host]
+        avail = avail - jnp.where(found, need[None, :] * (jnp.arange(avail.shape[0]) == host)[:, None], 0.0)
+        return avail, jnp.where(found, host, -1).astype(jnp.int32)
+
+    avail, assign = jax.lax.scan(step, inp.avail,
+                                 (inp.job_res, inp.constraint_mask, inp.valid))
+    return assign, avail
+
+
+@functools.partial(jax.jit, static_argnames=("num_prefs", "num_rounds"))
+def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
+                         num_rounds: int = 24) -> Tuple[jax.Array, jax.Array]:
+    """Parallel top-K auction assignment for large J.
+
+    Every job precomputes its ``num_prefs`` best hosts by bin-packing fitness
+    (one J x H pass, MXU/VPU-friendly), then ``num_rounds`` rounds of:
+
+      1. every unassigned job proposes to its current preference;
+      2. proposals are grouped per host (one lexsort) and admitted in rank
+         order while the cumulative demand prefix fits the host's
+         availability;
+      3. jobs whose preferred host can no longer fit them *individually*
+         advance their preference pointer (availability only shrinks within a
+         cycle, so advancing is safe); contended-but-feasible jobs retry.
+
+    The first-ranked feasible proposer on a host always fits its own prefix,
+    so every contended host admits at least one job per round.  This trades
+    the greedy kernel's J-step dependency chain for ~num_rounds data-parallel
+    steps; placement decisions can deviate from greedy (fitness is computed
+    against the cycle-start availability), which the tests bound
+    statistically — the greedy kernel remains the bit-exact parity mode.
+    """
+    J, H = inp.constraint_mask.shape
+    job_idx = jnp.arange(J, dtype=jnp.int32)
+
+    feasible0 = (jnp.all(inp.avail[None, :, :] >= inp.job_res[:, None, :], axis=2)
+                 & inp.constraint_mask & inp.valid[:, None])
+    used = inp.capacity - inp.avail
+    cap = jnp.maximum(inp.capacity, 1e-9)
+    fit = (used[None, :, 0] + inp.job_res[:, 0:1]) / cap[None, :, 0] \
+        + (used[None, :, 1] + inp.job_res[:, 1:2]) / cap[None, :, 1]
+    fit = jnp.where(feasible0, fit * 0.5, NEG_INF)
+    K = min(num_prefs, H)
+    pref_fit, pref_host = jax.lax.top_k(fit, K)        # [J, K]
+    pref_ok = pref_fit > NEG_INF
+
+    def one_round(state, _):
+        assign, avail, ptr = state
+        active = (assign < 0) & inp.valid & (ptr < K)
+        safe_ptr = jnp.minimum(ptr, K - 1)
+        cand = jnp.take_along_axis(pref_host, safe_ptr[:, None], axis=1)[:, 0]
+        cand_ok = jnp.take_along_axis(pref_ok, safe_ptr[:, None], axis=1)[:, 0]
+        fits_alone = jnp.all(avail[cand] >= inp.job_res, axis=1) & cand_ok
+        proposes = active & fits_alone
+        # a host that can't fit the job individually never will again
+        ptr = jnp.where(active & ~fits_alone, ptr + 1, ptr)
+
+        choice = jnp.where(proposes, cand, H)
+        order = jnp.lexsort((job_idx, choice))
+        sorted_choice = choice[order]
+        sorted_res = inp.job_res[order] * (sorted_choice < H)[:, None]
+        first_of_seg = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), sorted_choice[1:] != sorted_choice[:-1]])
+        seg_cum = scanlib.segmented_cumsum(sorted_res, first_of_seg)
+        host_avail = avail[jnp.minimum(sorted_choice, H - 1)]
+        fits_prefix = (jnp.all(seg_cum <= host_avail, axis=1)
+                       & (sorted_choice < H))
+        admitted = jnp.zeros((J,), dtype=bool).at[order].set(fits_prefix)
+        assign = jnp.where(admitted, choice, assign)
+        consumed = jax.ops.segment_sum(
+            inp.job_res * admitted[:, None], jnp.minimum(choice, H - 1),
+            num_segments=H)
+        avail = avail - consumed
+        return (assign, avail, ptr), None
+
+    init = (jnp.full((J,), -1, dtype=jnp.int32), inp.avail,
+            jnp.zeros((J,), dtype=jnp.int32))
+    (assign, avail, _), _ = jax.lax.scan(one_round, init, None,
+                                         length=num_rounds)
+    return assign, avail
+
+
+# Backwards-compatible alias; the auction formulation superseded the naive
+# every-job-argmax multipass, which converged one host per pass.
+multipass_match_kernel = auction_match_kernel

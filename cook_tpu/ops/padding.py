@@ -1,0 +1,29 @@
+"""Bucketed padding so per-cycle dynamic sizes hit a small set of compiled shapes.
+
+Pending-job and offer counts vary every cycle; XLA requires static shapes, so
+we round sizes up to geometric buckets (x2 steps) to bound recompiles
+(SURVEY.md section 7 "dynamic shapes" hard part).
+"""
+
+from __future__ import annotations
+
+MIN_BUCKET = 64
+
+
+def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Smallest power-of-two bucket >= max(n, 1)."""
+    size = minimum
+    n = max(n, 1)
+    while size < n:
+        size *= 2
+    return size
+
+
+def pad_to(arr, size: int, fill=0):
+    """Pad a numpy array's leading axis up to ``size`` with ``fill``."""
+    import numpy as np
+
+    if arr.shape[0] == size:
+        return arr
+    pad_shape = (size - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, dtype=arr.dtype)], axis=0)

@@ -1,0 +1,191 @@
+"""CPU fallback implementations with the reference's sequential semantics.
+
+These are deliberately *structured like the reference* (per-user lazy prefix
+sums merged through a heap — dru.clj:43-126; one-task-at-a-time greedy fit —
+Fenzo scheduleOnce; per-host prefix aggregation — rebalancer.clj:320-407)
+rather than like the TPU kernels, so they serve two roles:
+
+1. the in-process matcher when no accelerator is present (the reference keeps
+   a Fenzo path for exactly this, BASELINE.json north star), and
+2. the independent golden for kernel parity tests (SURVEY.md section 7 step 2/3).
+
+All arithmetic is float32 to match on-device precision, keeping decision
+parity bit-exact.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+F32 = np.float32
+
+
+# --------------------------------------------------------------------------
+# DRU ranking (reference: dru.clj + scheduler.clj:2057-2099)
+# --------------------------------------------------------------------------
+
+class UserTasks:
+    """One user's tasks in that user's sort order (running first, then
+    pending by priority/submit-time — tools.clj same-user-task-comparator)."""
+
+    def __init__(self, user: str, task_ids: Sequence[int],
+                 usage: np.ndarray, pending: Sequence[bool]):
+        self.user = user
+        self.task_ids = list(task_ids)     # global task identifiers
+        self.usage = np.asarray(usage, dtype=F32)  # [n, 4] cpus, mem, gpus, count
+        self.pending = list(pending)
+
+
+def limit_over_quota(tasks: UserTasks, quota: np.ndarray,
+                     max_over_quota_jobs: int) -> UserTasks:
+    """Drop tasks after the Nth whose cumulative usage exceeds quota
+    (reference: limit-over-quota-jobs scheduler.clj:2057-2071)."""
+    quota = np.asarray(quota, dtype=F32)
+    total = np.zeros(4, dtype=F32)
+    kept_ids, kept_usage, kept_pending = [], [], []
+    over_count = 0
+    for i in range(len(tasks.task_ids)):
+        total = total + tasks.usage[i]
+        if np.any(total > quota):
+            over_count += 1
+        if over_count > max_over_quota_jobs:
+            break
+        kept_ids.append(tasks.task_ids[i])
+        kept_usage.append(tasks.usage[i])
+        kept_pending.append(tasks.pending[i])
+    usage = np.array(kept_usage, dtype=F32).reshape(len(kept_ids), 4)
+    return UserTasks(tasks.user, kept_ids, usage, kept_pending)
+
+
+def rank_by_dru(users: List[UserTasks],
+                shares: Dict[str, Tuple[float, float, float]],
+                quotas: Dict[str, np.ndarray],
+                gpu_mode: bool = False,
+                max_over_quota_jobs: int = 100) -> List[Tuple[int, float]]:
+    """Rank pending tasks ascending by DRU.
+
+    Returns [(task_id, dru)] for pending tasks only, in rank order.  Per-user
+    streams of (dru, user_rank, position) are merged through a heap, mirroring
+    sorted-merge (dru.clj:82-104); users are processed in name order like the
+    reference's ``(sort-by first)`` (dru.clj:123).
+    """
+    streams = []
+    for user_rank, ut in enumerate(sorted(users, key=lambda u: u.user)):
+        ut = limit_over_quota(ut, quotas[ut.user], max_over_quota_jobs)
+        share = np.asarray(shares[ut.user], dtype=F32)
+        cum = np.zeros(3, dtype=F32)
+        stream = []
+        for pos in range(len(ut.task_ids)):
+            cum = cum + ut.usage[pos, :3]
+            if gpu_mode:
+                dru = F32(cum[2] / share[2])
+            else:
+                dru = F32(max(cum[1] / share[1], cum[0] / share[0]))
+            if ut.pending[pos]:
+                stream.append((dru, user_rank, pos, ut.task_ids[pos]))
+        streams.append(stream)
+    merged = heapq.merge(*streams)
+    return [(task_id, dru) for dru, _ur, _pos, task_id in merged]
+
+
+def filter_pool_quota(job_usage: np.ndarray, base_usage: np.ndarray,
+                      quota: Optional[np.ndarray]) -> np.ndarray:
+    """Pool-quota keep mask over a ranked queue (tools.clj:917-933): the
+    accumulator includes filtered jobs."""
+    n = job_usage.shape[0]
+    keep = np.ones(n, dtype=bool)
+    if quota is None:
+        return keep
+    total = np.asarray(base_usage, dtype=F32).copy()
+    for i in range(n):
+        total = total + job_usage[i]
+        keep[i] = bool(np.all(total <= quota))
+    return keep
+
+
+# --------------------------------------------------------------------------
+# Greedy bin-packing match (reference: Fenzo scheduleOnce via
+# scheduler.clj:617-687; fitness = cpuMemBinPacker, config.clj:108)
+# --------------------------------------------------------------------------
+
+def binpack_fitness(need: np.ndarray, avail: np.ndarray,
+                    capacity: np.ndarray) -> np.ndarray:
+    """cpuMemBinPacker: mean of post-assignment cpu and mem utilization."""
+    used = capacity - avail
+    cap = np.maximum(capacity, F32(1e-9))
+    f_cpu = (used[:, 0] + need[0]) / cap[:, 0]
+    f_mem = (used[:, 1] + need[1]) / cap[:, 1]
+    return ((f_cpu + f_mem) / F32(2.0)).astype(F32)
+
+
+def greedy_match(job_res: np.ndarray, constraint_mask: np.ndarray,
+                 avail: np.ndarray, capacity: np.ndarray) -> np.ndarray:
+    """Assign jobs (in rank order) one at a time to the feasible host with the
+    highest bin-packing fitness; ties -> lowest host index. Returns i32[J]
+    host index or -1.  Mutates nothing; works on copies."""
+    job_res = np.asarray(job_res, dtype=F32)
+    avail = np.asarray(avail, dtype=F32).copy()
+    capacity = np.asarray(capacity, dtype=F32)
+    J = job_res.shape[0]
+    assign = np.full(J, -1, dtype=np.int32)
+    for j in range(J):
+        need = job_res[j]
+        feasible = np.all(avail >= need[None, :], axis=1) & constraint_mask[j]
+        if not feasible.any():
+            continue
+        fitness = binpack_fitness(need, avail, capacity)
+        fitness = np.where(feasible, fitness, -np.inf)
+        h = int(np.argmax(fitness))
+        assign[j] = h
+        avail[h] = avail[h] - need
+    return assign
+
+
+# --------------------------------------------------------------------------
+# Preemption decision (reference: rebalancer.clj compute-preemption-decision
+# :320-407)
+# --------------------------------------------------------------------------
+
+def preemption_decision(task_dru: np.ndarray, task_res: np.ndarray,
+                        task_host: np.ndarray, eligible: np.ndarray,
+                        spare: np.ndarray, host_ok: np.ndarray,
+                        demand: np.ndarray) -> Optional[Tuple[int, List[int], float]]:
+    """Pick (host, victim task indices, decision dru) maximizing the minimum
+    DRU among preempted tasks; spare-only solutions score +inf ("MAX_VALUE"
+    rows in the reference).  Tasks must be pre-filtered by the caller's
+    eligibility rules (safe-dru-threshold, min-dru-diff, quota/self) and are
+    scanned per host in descending-DRU order; ties -> lowest host index.
+    """
+    task_dru = np.asarray(task_dru, dtype=F32)
+    task_res = np.asarray(task_res, dtype=F32)
+    spare = np.asarray(spare, dtype=F32)
+    demand = np.asarray(demand, dtype=F32)
+    H = spare.shape[0]
+    best: Optional[Tuple[float, int, List[int]]] = None  # (score, host, victims)
+
+    def consider(score: float, host: int, victims: List[int]):
+        nonlocal best
+        if best is None or score > best[0] or (score == best[0] and host < best[1]):
+            best = (score, host, victims)
+
+    for h in range(H):
+        if not host_ok[h]:
+            continue
+        if np.all(spare[h] >= demand):
+            consider(np.inf, h, [])
+            continue
+        idx = [t for t in np.nonzero((task_host == h) & eligible)[0]]
+        idx.sort(key=lambda t: (-task_dru[t], t))
+        freed = spare[h].copy()
+        for k, t in enumerate(idx):
+            freed = freed + task_res[t]
+            if np.all(freed >= demand):
+                consider(float(task_dru[t]), h, [int(x) for x in idx[:k + 1]])
+                break
+    if best is None:
+        return None
+    score, host, victims = best
+    return host, victims, score

@@ -1,0 +1,38 @@
+"""Segmented scan primitives shared by the rank/match/rebalance kernels.
+
+A segmented prefix sum with restart flags is the workhorse that replaces the
+reference's per-user / per-host ``reductions`` loops (dru.clj:43-48,
+rebalancer.clj:380-404).  Implemented as an associative scan over
+(value, segment-start-flag) pairs — unlike ``cumsum(x) - cumsum(x)[base]``,
+no cross-segment cancellation occurs, so precision stays at the scale of a
+single segment's values even with millions of rows in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _combine(a, b):
+    av, af = a
+    bv, bf = b
+    return jnp.where(bf, bv, av + bv), af | bf
+
+
+def segmented_cumsum(x: jax.Array, start_flags: jax.Array) -> jax.Array:
+    """Per-segment inclusive prefix sum.
+
+    ``start_flags`` bool[T] marks the first element of each segment (element 0
+    should be marked).  Works for any trailing shape of ``x``.
+    """
+    flags = start_flags.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    out, _ = jax.lax.associative_scan(_combine, (x, flags), axis=0)
+    return out
+
+
+def segmented_cumsum_by_first_idx(x: jax.Array, first_idx: jax.Array) -> jax.Array:
+    """Segmented prefix sum where ``first_idx[t]`` is the index of t's segment
+    start (the contiguous-segment encoding used by the rank kernel)."""
+    t = jnp.arange(x.shape[0], dtype=first_idx.dtype)
+    return segmented_cumsum(x, t == first_idx)

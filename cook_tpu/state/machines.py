@@ -1,0 +1,92 @@
+"""Pure state-machine transition functions for jobs and instances.
+
+These mirror the reference's transactional Datomic db-fns
+(reference: schema.clj :instance/update-state :1242-1308 and
+:job/update-state :1202-1239) as pure functions over entity values.  The
+store applies them inside a transaction so the "txn aborts if state moved"
+discipline is preserved (SURVEY.md section 5, race handling #4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .schema import (
+    Instance,
+    InstanceStatus,
+    Job,
+    JobState,
+    Reasons,
+)
+
+# Legal instance transitions (reference: schema.clj:1242-1308). A transition
+# request to the current state is a no-op; anything not listed is rejected.
+_INSTANCE_TRANSITIONS = {
+    InstanceStatus.UNKNOWN: {InstanceStatus.RUNNING, InstanceStatus.SUCCESS, InstanceStatus.FAILED},
+    InstanceStatus.RUNNING: {InstanceStatus.SUCCESS, InstanceStatus.FAILED},
+    InstanceStatus.SUCCESS: set(),
+    InstanceStatus.FAILED: set(),
+}
+
+
+def instance_transition_allowed(cur: InstanceStatus, new: InstanceStatus) -> bool:
+    return new is cur or new in _INSTANCE_TRANSITIONS[cur]
+
+
+def next_job_state(
+    job: Job,
+    instances: Dict[str, Instance],
+) -> Tuple[JobState, Optional[str]]:
+    """Recompute job state from its instances.
+
+    Returns (state, reason) where reason explains a COMPLETED verdict.
+    Mirrors :job/update-state (schema.clj:1202-1239):
+      - any live (unknown/running) instance  -> RUNNING
+      - a successful instance                -> COMPLETED
+      - all attempts consumed                -> COMPLETED
+      - user killed the job                  -> COMPLETED
+      - otherwise                            -> WAITING (retry)
+    """
+    if job.user_killed:
+        return JobState.COMPLETED, "user-killed"
+    success = False
+    live = False
+    for tid in job.instances:
+        inst = instances.get(tid)
+        if inst is None:
+            continue
+        if inst.status is InstanceStatus.SUCCESS:
+            success = True
+        elif inst.status in (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+            live = True
+    if success:
+        return JobState.COMPLETED, "success"
+    if live:
+        return JobState.RUNNING, None
+    if job.attempts_used(instances) >= job.max_retries:
+        return JobState.COMPLETED, "attempts-consumed"
+    return JobState.WAITING, None
+
+
+def allowed_to_start(job: Job, instances: Dict[str, Instance]) -> Optional[str]:
+    """Launch guard (reference: :job/allowed-to-start? schema.clj:1311-1325).
+
+    Returns None when the job may start a new instance, else a rejection
+    reason string.  Applied inside the launch transaction so a concurrent
+    kill/complete aborts the launch (scheduler.clj:987-1009 invariant).
+    """
+    if job.state is not JobState.WAITING:
+        return f"job-state-{job.state.value}"
+    if not job.committed:
+        return "uncommitted"
+    for tid in job.instances:
+        inst = instances.get(tid)
+        if inst is not None and inst.status in (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+            return "has-live-instance"
+    return None
+
+
+def classify_failure(reason_code: Optional[int]) -> Tuple[bool, Optional[int]]:
+    """Return (mea_culpa?, failure_limit) for a failure reason code."""
+    reason = Reasons.by_code(reason_code if reason_code is not None else Reasons.UNKNOWN.code)
+    return reason.mea_culpa, reason.failure_limit

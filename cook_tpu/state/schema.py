@@ -1,0 +1,365 @@
+"""Entity schema: jobs, instances, groups, pools, shares, quotas.
+
+Mirrors the reference Datomic schema (reference: scheduler/src/cook/schema.clj:20-1100)
+as plain Python dataclasses.  The reference keeps ~200 attributes; we keep the
+behavior-bearing subset and a ``labels``/``env`` escape hatch for the rest.
+
+Resource vectors are ordered (cpus, mem, gpus, disk) so host-side entities
+convert losslessly into the (N x R) tensors consumed by the kernels in
+``cook_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# Resource dimension order used by every kernel in cook_tpu.ops.
+RESOURCE_DIMS: Tuple[str, ...] = ("cpus", "mem", "gpus", "disk")
+NUM_RESOURCE_DIMS = len(RESOURCE_DIMS)
+
+DEFAULT_JOB_PRIORITY = 50  # reference: util/default-job-priority (tools.clj)
+MAX_JOB_PRIORITY = 100
+
+
+class JobState(enum.Enum):
+    """Job lifecycle (reference: schema.clj job state machine, :job/update-state
+    schema.clj:1202-1239): waiting <-> running -> completed."""
+
+    WAITING = "waiting"
+    RUNNING = "running"
+    COMPLETED = "completed"
+
+
+class InstanceStatus(enum.Enum):
+    """Instance lifecycle (reference: :instance/update-state schema.clj:1242-1308):
+    unknown -> running -> {success, failed}."""
+
+    UNKNOWN = "unknown"
+    RUNNING = "running"
+    SUCCESS = "success"
+    FAILED = "failed"
+
+
+TERMINAL_INSTANCE_STATUSES = (InstanceStatus.SUCCESS, InstanceStatus.FAILED)
+
+
+@dataclass(frozen=True)
+class Reason:
+    """Failure reason (reference: scheduler/src/cook/mesos/reason.clj).
+
+    ``mea_culpa`` failures are the cluster's fault and do not consume user
+    retries (up to ``failure_limit`` occurrences, None = unlimited).
+    """
+
+    code: int
+    name: str
+    mea_culpa: bool = False
+    failure_limit: Optional[int] = None
+
+
+class Reasons:
+    """Registry of failure reasons, mirroring reason.clj's reason table."""
+
+    NORMAL_EXIT = Reason(0, "normal-exit")
+    UNKNOWN = Reason(1, "unknown")
+    KILLED_BY_USER = Reason(2, "killed-by-user")
+    PREEMPTED_BY_REBALANCER = Reason(3, "preempted-by-rebalancer", mea_culpa=True)
+    PREEMPTED_BY_POOL = Reason(4, "preempted-by-pool", mea_culpa=True)
+    MAX_RUNTIME_EXCEEDED = Reason(5, "max-runtime-exceeded")
+    NON_ZERO_EXIT = Reason(6, "non-zero-exit")
+    NODE_LOST = Reason(7, "node-lost", mea_culpa=True)
+    CONTAINER_LAUNCH_FAILED = Reason(8, "container-launch-failed", mea_culpa=True, failure_limit=3)
+    HEARTBEAT_LOST = Reason(9, "heartbeat-lost", mea_culpa=True)
+    CHECKPOINT_FAILURE = Reason(10, "checkpoint-failure", mea_culpa=True, failure_limit=3)
+    STRAGGLER = Reason(11, "straggler", mea_culpa=True)
+    CANCELLED_DURING_LAUNCH = Reason(12, "cancelled-during-launch", mea_culpa=True)
+    REASON_POD_SUBMISSION_FAILED = Reason(13, "pod-submission-failed", mea_culpa=True, failure_limit=10)
+
+    _by_code: Dict[int, Reason] = {}
+    _by_name: Dict[str, Reason] = {}
+
+    @classmethod
+    def all(cls) -> List[Reason]:
+        return [v for v in vars(cls).values() if isinstance(v, Reason)]
+
+    @classmethod
+    def by_code(cls, code: int) -> Reason:
+        if not cls._by_code:
+            cls._by_code = {r.code: r for r in cls.all()}
+        return cls._by_code.get(code, cls.UNKNOWN)
+
+    @classmethod
+    def by_name(cls, name: str) -> Reason:
+        if not cls._by_name:
+            cls._by_name = {r.name: r for r in cls.all()}
+        return cls._by_name.get(name, cls.UNKNOWN)
+
+
+@dataclass(frozen=True)
+class Resources:
+    """A point in resource space. Arithmetic is element-wise.
+
+    Reference jobs carry cpus/mem(/gpus); hosts additionally advertise disk and
+    port ranges (ports are handled host-side at launch, mesos/task.clj:209-237).
+    """
+
+    cpus: float = 0.0
+    mem: float = 0.0
+    gpus: float = 0.0
+    disk: float = 0.0
+
+    def as_tuple(self) -> Tuple[float, float, float, float]:
+        return (self.cpus, self.mem, self.gpus, self.disk)
+
+    def __add__(self, other: "Resources") -> "Resources":
+        return Resources(*(a + b for a, b in zip(self.as_tuple(), other.as_tuple())))
+
+    def __sub__(self, other: "Resources") -> "Resources":
+        return Resources(*(a - b for a, b in zip(self.as_tuple(), other.as_tuple())))
+
+    def fits_in(self, other: "Resources") -> bool:
+        return all(a <= b for a, b in zip(self.as_tuple(), other.as_tuple()))
+
+    def non_negative(self) -> bool:
+        return all(a >= 0 for a in self.as_tuple())
+
+
+@dataclass
+class Constraint:
+    """User-specified placement constraint (reference: schema.clj
+    :constraint/{attribute,operator,pattern}; constraints.clj:356-430).
+
+    operator is one of EQUALS ("EQUALS") today; the mask compiler in
+    cook_tpu.sched.constraints interprets it against host attributes.
+    """
+
+    attribute: str
+    operator: str
+    pattern: str
+
+
+class CheckpointMode(enum.Enum):
+    # reference: schema.clj :job/checkpoint modes
+    AUTO = "auto"
+    PERIODIC = "periodic"
+    PREEMPTION = "preemption"
+
+
+@dataclass
+class Checkpoint:
+    """Job checkpointing declaration (reference: schema.clj:84, kubernetes/api.clj:1173-1267)."""
+
+    mode: CheckpointMode = CheckpointMode.AUTO
+    volume_mounts: List[str] = field(default_factory=list)
+    period_sec: Optional[int] = None
+    options: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Application:
+    name: str = ""
+    version: str = ""
+    workload_class: str = ""
+
+
+@dataclass
+class Job:
+    """A user's unit of work (reference: schema.clj:20-682 job attributes)."""
+
+    uuid: str
+    user: str
+    command: str = ""
+    name: str = "cookjob"
+    resources: Resources = field(default_factory=lambda: Resources(cpus=1.0, mem=128.0))
+    priority: int = DEFAULT_JOB_PRIORITY  # 0-100
+    max_retries: int = 1
+    max_runtime_ms: int = 2**53
+    expected_runtime_ms: Optional[int] = None
+    pool: str = "default"
+    state: JobState = JobState.WAITING
+    submit_time_ms: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    container: Optional[Dict[str, Any]] = None
+    constraints: List[Constraint] = field(default_factory=list)
+    group: Optional[str] = None  # group uuid
+    application: Optional[Application] = None
+    checkpoint: Optional[Checkpoint] = None
+    disable_mea_culpa_retries: bool = False
+    # commit-latch: submitted-but-uncommitted jobs are invisible to queries
+    # (reference: metatransaction/core.clj filter-committed; schema.clj:28).
+    committed: bool = True
+    # instances by task_id, newest last
+    instances: List[str] = field(default_factory=list)
+    # count of mea-culpa failures per reason code (for failure_limit accounting,
+    # reference: :job/all-attempts-consumed? logic)
+    mea_culpa_failures: Dict[int, int] = field(default_factory=dict)
+    # set when the job reached completed because the user killed it
+    user_killed: bool = False
+    # rebalancer host reservation consumed by the matcher (rebalancer.clj:419-432)
+    reserved_host: Optional[str] = None
+    # "under investigation" flag driving the unscheduled-jobs explainer
+    under_investigation: bool = False
+    last_waiting_start_ms: int = 0
+
+    def attempts_used(self, instances: Dict[str, "Instance"]) -> int:
+        """Number of retries consumed: failed, non-mea-culpa instances
+        (mea-culpa failures under their limit don't count;
+        reference: :job/all-attempts-consumed? + reason failure limits)."""
+        used = 0
+        mea_culpa_counts: Dict[int, int] = {}
+        for tid in self.instances:
+            inst = instances.get(tid)
+            if inst is None or inst.status is not InstanceStatus.FAILED:
+                continue
+            reason = Reasons.by_code(inst.reason_code if inst.reason_code is not None else 1)
+            if reason.mea_culpa and not self.disable_mea_culpa_retries:
+                n = mea_culpa_counts.get(reason.code, 0) + 1
+                mea_culpa_counts[reason.code] = n
+                if reason.failure_limit is None or n <= reason.failure_limit:
+                    continue  # free retry
+            used += 1
+        return used
+
+
+@dataclass
+class Instance:
+    """One attempt at running a job (reference: schema.clj:683-1100)."""
+
+    task_id: str
+    job_uuid: str
+    status: InstanceStatus = InstanceStatus.UNKNOWN
+    hostname: str = ""
+    slave_id: str = ""
+    compute_cluster: str = ""
+    start_time_ms: int = 0
+    end_time_ms: Optional[int] = None
+    mesos_start_time_ms: Optional[int] = None
+    reason_code: Optional[int] = None
+    preempted: bool = False
+    progress: int = 0
+    progress_message: str = ""
+    progress_sequence: int = 0
+    exit_code: Optional[int] = None
+    sandbox_directory: str = ""
+    ports: List[int] = field(default_factory=list)
+    queue_time_ms: int = 0
+    cancelled: bool = False
+
+
+class GroupPlacementType(enum.Enum):
+    # reference: schema.clj host-placement types; constraints.clj:586-676
+    ALL = "all"
+    UNIQUE = "unique"
+    BALANCED = "balanced"
+    ATTRIBUTE_EQUALS = "attribute-equals"
+
+
+@dataclass
+class Group:
+    """Job group with placement constraints + straggler handling
+    (reference: schema.clj group attributes; group.clj)."""
+
+    uuid: str
+    name: str = "defaultgroup"
+    placement_type: GroupPlacementType = GroupPlacementType.ALL
+    placement_attribute: Optional[str] = None
+    placement_minimum: int = 2  # for BALANCED
+    straggler_quantile: Optional[float] = None   # e.g. 0.5
+    straggler_multiplier: Optional[float] = None  # e.g. 2.0
+    jobs: List[str] = field(default_factory=list)
+
+
+class DruMode(enum.Enum):
+    # reference: schema.clj :pool/dru-mode default|gpu
+    DEFAULT = "default"
+    GPU = "gpu"
+
+
+class SchedulerKind(enum.Enum):
+    """Which matcher drives a pool (reference: config.clj pool-schedulers;
+    'fenzo' -> our batched greedy kernel, 'kubernetes' -> direct backpressure mode)."""
+
+    BATCH = "batch"       # rank + bin-pack match (Fenzo-style)
+    DIRECT = "direct"     # direct submission under backpressure (Kenzo-style)
+
+
+@dataclass
+class Pool:
+    """Scheduling pool (reference: schema.clj pool attributes; pool.clj)."""
+
+    name: str
+    purpose: str = ""
+    state: str = "active"  # active | inactive
+    dru_mode: DruMode = DruMode.DEFAULT
+    scheduler: SchedulerKind = SchedulerKind.BATCH
+
+
+@dataclass
+class ShareEntry:
+    """Per-user per-pool fair-share weights = DRU divisors
+    (reference: share.clj; 'default' user is the fallback)."""
+
+    user: str
+    pool: str
+    resources: Dict[str, float] = field(default_factory=dict)
+    reason: str = ""
+
+
+@dataclass
+class QuotaEntry:
+    """Per-user per-pool hard caps, including job count
+    (reference: quota.clj; :count is a quota dimension)."""
+
+    user: str
+    pool: str
+    resources: Dict[str, float] = field(default_factory=dict)  # cpus/mem/gpus
+    count: float = float("inf")
+    reason: str = ""
+
+
+def new_uuid() -> str:
+    return str(uuidlib.uuid4())
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def job_usage(job: Job) -> Dict[str, float]:
+    """Usage map of one job, including count=1 (reference: tools.clj job->usage)."""
+    u = {"count": 1.0, "cpus": job.resources.cpus, "mem": job.resources.mem}
+    if job.resources.gpus:
+        u["gpus"] = job.resources.gpus
+    return u
+
+
+def add_usage(a: Dict[str, float], b: Dict[str, float]) -> Dict[str, float]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def below_quota(quota: Dict[str, float], usage: Dict[str, float]) -> bool:
+    """True iff usage <= quota on every dimension present in usage
+    (reference: tools.clj below-quota?, missing quota key treated as 0)."""
+    return all(v <= quota.get(k, 0.0) for k, v in usage.items())
+
+
+def to_json(obj: Any) -> Any:
+    """Recursively convert entities to JSON-serializable structures."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: to_json(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: to_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_json(v) for v in obj]
+    return obj

@@ -1,0 +1,588 @@
+"""Transactional in-memory state store with guard transactions and a tx feed.
+
+Plays the role of the reference's Datomic peer + transactor
+(reference: scheduler/src/cook/datomic.clj, schema.clj db-fns,
+metatransaction/core.clj):
+
+- **All-or-nothing transactions** with an undo log; a guard raising
+  :class:`AbortTransaction` rolls everything back (the reference's
+  ":job/allowed-to-start? aborts the txn" discipline, schema.clj:1311-1325).
+- **Tx-report feed**: subscribers receive the event list of every committed
+  transaction (reference: create-tx-report-mult datomic.clj:49, consumed by
+  monitor-tx-report-queue scheduler.clj:378-448 to kill orphaned instances).
+- **Commit latch**: batch-submitted jobs stay invisible to queries until the
+  latch commits (reference: metatransactions + :job/commit-latch schema.clj:28).
+- **Snapshot/restore**: full-state JSON round-trip; a new leader resumes by
+  re-reading state (SURVEY.md section 5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from . import machines
+from .schema import (
+    Application,
+    Checkpoint,
+    CheckpointMode,
+    Constraint,
+    DruMode,
+    Group,
+    GroupPlacementType,
+    Instance,
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+    QuotaEntry,
+    Resources,
+    SchedulerKind,
+    ShareEntry,
+    now_ms,
+    to_json,
+)
+
+
+class AbortTransaction(Exception):
+    """Raised inside a transaction to roll back all of its writes."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class TxEvent:
+    __slots__ = ("kind", "data")
+
+    def __init__(self, kind: str, **data: Any):
+        self.kind = kind
+        self.data = data
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"TxEvent({self.kind}, {self.data})"
+
+
+class _Txn:
+    """One open transaction: copy-on-write views over the store's entity maps."""
+
+    def __init__(self, store: "Store"):
+        self._store = store
+        self._writes: Dict[Tuple[str, str], Any] = {}
+        self._deletes: set = set()
+        self.events: List[TxEvent] = []
+
+    def _get(self, table: str, key: str, for_write: bool) -> Any:
+        wk = (table, key)
+        if wk in self._deletes:
+            return None
+        if wk in self._writes:
+            return self._writes[wk]
+        ent = getattr(self._store, "_" + table).get(key)
+        if ent is None:
+            return None
+        # Reads are deep-copied too: a transaction fn mutating a read-returned
+        # entity must not leak into the store outside the write log (the
+        # all-or-nothing guarantee would silently break on abort otherwise).
+        ent = copy.deepcopy(ent)
+        if for_write:
+            self._writes[wk] = ent
+        return ent
+
+    # -- reads (txn-local view) ---------------------------------------------
+    def job(self, uuid: str) -> Optional[Job]:
+        return self._get("jobs", uuid, for_write=False)
+
+    def instance(self, task_id: str) -> Optional[Instance]:
+        return self._get("instances", task_id, for_write=False)
+
+    def group(self, uuid: str) -> Optional[Group]:
+        return self._get("groups", uuid, for_write=False)
+
+    def instances_of(self, job: Job) -> Dict[str, Instance]:
+        return {tid: inst for tid in job.instances
+                if (inst := self._get("instances", tid, for_write=False)) is not None}
+
+    # -- writes --------------------------------------------------------------
+    def job_w(self, uuid: str) -> Optional[Job]:
+        return self._get("jobs", uuid, for_write=True)
+
+    def instance_w(self, task_id: str) -> Optional[Instance]:
+        return self._get("instances", task_id, for_write=True)
+
+    def group_w(self, uuid: str) -> Optional[Group]:
+        return self._get("groups", uuid, for_write=True)
+
+    def put(self, table: str, key: str, entity: Any) -> None:
+        self._deletes.discard((table, key))
+        self._writes[(table, key)] = entity
+
+    def delete(self, table: str, key: str) -> None:
+        self._writes.pop((table, key), None)
+        self._deletes.add((table, key))
+
+    def abort(self, reason: str) -> None:
+        raise AbortTransaction(reason)
+
+    def event(self, kind: str, **data: Any) -> None:
+        self.events.append(TxEvent(kind, **data))
+
+    # -- composite ops shared by several public store methods ---------------
+    def recompute_job_state(self, job: Job) -> None:
+        """Re-derive job state from instances; emits job-state event on change
+        (reference: :job/update-state side of :instance/update-state)."""
+        new_state, reason = machines.next_job_state(job, self.instances_of(job))
+        if new_state is not job.state:
+            old = job.state
+            job.state = new_state
+            if new_state is JobState.WAITING:
+                job.last_waiting_start_ms = now_ms()
+            self.event("job-state", uuid=job.uuid, old=old.value,
+                       new=new_state.value, reason=reason)
+
+
+class Store:
+    """Thread-safe entity store. All mutation goes through :meth:`transact`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, Job] = {}
+        self._instances: Dict[str, Instance] = {}
+        self._groups: Dict[str, Group] = {}
+        self._pools: Dict[str, Pool] = {}
+        self._shares: Dict[str, ShareEntry] = {}   # key: f"{user}/{pool}"
+        self._quotas: Dict[str, QuotaEntry] = {}   # key: f"{user}/{pool}"
+        self._latches: Dict[str, List[str]] = {}   # latch uuid -> job uuids
+        self._tx_id = 0
+        self._subscribers: List[Callable[[int, List[TxEvent]], None]] = []
+        # Commit-ordered event delivery (the reference's tx-report *queue*):
+        # events enqueue under the main lock and drain under _notify_lock, so
+        # subscribers always observe transactions in tx_id order.
+        self._event_queue: List[Tuple[int, List[TxEvent]]] = []
+        self._notify_lock = threading.Lock()
+        self._draining = threading.local()
+
+    # ------------------------------------------------------------------ txns
+    def transact(self, fn: Callable[[_Txn], Any]) -> Any:
+        """Run ``fn`` transactionally. Its writes are installed atomically on
+        normal return; AbortTransaction rolls back and re-raises."""
+        with self._lock:
+            txn = _Txn(self)
+            result = fn(txn)  # AbortTransaction propagates; nothing installed
+            for (table, key), ent in txn._writes.items():
+                getattr(self, "_" + table)[key] = ent
+            for table, key in txn._deletes:
+                getattr(self, "_" + table).pop(key, None)
+            self._tx_id += 1
+            if txn.events:
+                self._event_queue.append((self._tx_id, txn.events))
+        self._drain_events()
+        return result
+
+    def _drain_events(self) -> None:
+        """Deliver queued events in commit order. Whoever holds _notify_lock
+        drains everything; other committers' events ride along in order.
+        A subscriber that itself transacts enqueues new events and returns —
+        the outer drain loop delivers them after the current round, keeping
+        every subscriber's view in tx_id order (and avoiding re-entry)."""
+        if getattr(self._draining, "active", False):
+            return
+        while not self._notify_lock.acquire(blocking=False):
+            # Another thread is draining and will deliver our events — unless
+            # it is just exiting; spin until the queue empties or we win the
+            # lock (waiting blocking would serialize commits behind callbacks).
+            with self._lock:
+                if not self._event_queue:
+                    return
+            time.sleep(0)
+        self._draining.active = True
+        try:
+            while True:
+                with self._lock:
+                    if not self._event_queue:
+                        return
+                    tx_id, events = self._event_queue.pop(0)
+                    subscribers = list(self._subscribers)
+                for sub in subscribers:
+                    sub(tx_id, events)
+        finally:
+            self._draining.active = False
+            self._notify_lock.release()
+
+    def subscribe(self, fn: Callable[[int, List[TxEvent]], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+    # ----------------------------------------------------------- submission
+    def create_jobs(self, jobs: Iterable[Job], groups: Iterable[Group] = (),
+                    latch: Optional[str] = None) -> List[str]:
+        """Batch-submit jobs. With ``latch``, jobs are invisible until
+        :meth:`commit_latch` (metatransaction semantics)."""
+        jobs = list(jobs)
+
+        def _create(txn: _Txn) -> List[str]:
+            for group in groups:
+                existing = txn.group(group.uuid)
+                if existing is not None:
+                    merged = txn.group_w(group.uuid)
+                    merged.jobs.extend(j for j in group.jobs if j not in merged.jobs)
+                else:
+                    txn.put("groups", group.uuid, copy.deepcopy(group))
+            for job in jobs:
+                if txn.job(job.uuid) is not None:
+                    txn.abort(f"duplicate job uuid {job.uuid}")
+                job = copy.deepcopy(job)
+                if not job.submit_time_ms:
+                    job.submit_time_ms = now_ms()
+                job.last_waiting_start_ms = job.submit_time_ms
+                job.committed = latch is None
+                txn.put("jobs", job.uuid, job)
+                txn.event("job-created", uuid=job.uuid, user=job.user, pool=job.pool)
+            return [j.uuid for j in jobs]
+
+        # Register the latch under the same lock as the create transaction so
+        # a snapshot or concurrent commit_latch can never observe the jobs
+        # without their latch entry (which would strand them uncommitted).
+        with self._lock:
+            uuids = self.transact(_create)
+            if latch is not None:
+                self._latches.setdefault(latch, []).extend(uuids)
+        return uuids
+
+    def commit_latch(self, latch: str) -> None:
+        with self._lock:
+            uuids = self._latches.pop(latch, [])
+
+        def _commit(txn: _Txn) -> None:
+            for uuid in uuids:
+                job = txn.job_w(uuid)
+                if job is not None:
+                    job.committed = True
+                    txn.event("job-committed", uuid=uuid)
+
+        self.transact(_commit)
+
+    # -------------------------------------------------------------- launches
+    def launch_instance(self, job_uuid: str, task_id: str, hostname: str,
+                        slave_id: str = "", compute_cluster: str = "",
+                        ports: Optional[List[int]] = None) -> Instance:
+        """Create an instance under the allowed-to-start guard; aborts (and
+        therefore blocks the backend launch) if the job state moved
+        (reference: scheduler.clj:987-1009 + schema.clj:1311-1325)."""
+
+        def _launch(txn: _Txn) -> Instance:
+            job = txn.job_w(job_uuid)
+            if job is None:
+                txn.abort("no-such-job")
+            deny = machines.allowed_to_start(job, txn.instances_of(job))
+            if deny is not None:
+                txn.abort(deny)
+            t = now_ms()
+            inst = Instance(task_id=task_id, job_uuid=job_uuid, hostname=hostname,
+                            slave_id=slave_id or hostname, compute_cluster=compute_cluster,
+                            status=InstanceStatus.UNKNOWN, start_time_ms=t,
+                            ports=ports or [],
+                            queue_time_ms=max(0, t - job.last_waiting_start_ms))
+            txn.put("instances", task_id, inst)
+            job.instances.append(task_id)
+            job.state = JobState.RUNNING
+            txn.event("instance-created", task_id=task_id, job=job_uuid, hostname=hostname)
+            txn.event("job-state", uuid=job_uuid, old="waiting", new="running", reason=None)
+            return inst
+
+        return self.transact(_launch)
+
+    def update_instance_status(self, task_id: str, new_status: InstanceStatus,
+                               reason_code: Optional[int] = None,
+                               exit_code: Optional[int] = None,
+                               preempted: bool = False) -> bool:
+        """Instance state machine + job writeback (reference:
+        :instance/update-state schema.clj:1242-1308). Returns False when the
+        transition is illegal (stale status updates are dropped, not errors)."""
+
+        def _update(txn: _Txn) -> bool:
+            inst = txn.instance_w(task_id)
+            if inst is None:
+                return False
+            if inst.status is new_status:
+                # Redelivered status (k8s watch replays, mesos re-sends): a
+                # pure no-op — must not overwrite end_time/reason/exit_code.
+                return True
+            if not machines.instance_transition_allowed(inst.status, new_status):
+                return False
+            old = inst.status
+            inst.status = new_status
+            if reason_code is not None:
+                inst.reason_code = reason_code
+            if exit_code is not None:
+                inst.exit_code = exit_code
+            if preempted:
+                inst.preempted = True
+            if new_status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
+                inst.end_time_ms = now_ms()
+            if new_status is InstanceStatus.RUNNING and inst.mesos_start_time_ms is None:
+                inst.mesos_start_time_ms = now_ms()
+            if old is not new_status:
+                txn.event("instance-status", task_id=task_id, job=inst.job_uuid,
+                          old=old.value, new=new_status.value, reason=reason_code)
+            job = txn.job_w(inst.job_uuid)
+            if job is not None:
+                txn.recompute_job_state(job)
+            return True
+
+        return self.transact(_update)
+
+    def update_instance_progress(self, task_id: str, progress: int,
+                                 message: str = "", sequence: int = 0) -> bool:
+        """Progress writeback, monotone by sequence: reordered updates are
+        dropped rather than regressing progress (reference: progress
+        aggregator keeps latest-by-sequence, progress.clj:34-99)."""
+
+        def _update(txn: _Txn) -> bool:
+            inst = txn.instance_w(task_id)
+            if inst is None:
+                return False
+            if sequence < inst.progress_sequence:
+                return False
+            inst.progress_sequence = sequence
+            inst.progress = progress
+            if message:
+                inst.progress_message = message
+            return True
+
+        return self.transact(_update)
+
+    def kill_job(self, job_uuid: str) -> bool:
+        """User kill: mark killed + recompute state; the tx feed's
+        job-state->completed event triggers instance kills in the scheduler
+        (reference: monitor-tx-report-queue scheduler.clj:405-447)."""
+
+        def _kill(txn: _Txn) -> bool:
+            job = txn.job_w(job_uuid)
+            if job is None:
+                return False
+            if job.state is JobState.COMPLETED:
+                return True
+            job.user_killed = True
+            txn.recompute_job_state(job)
+            return True
+
+        return self.transact(_kill)
+
+    def retry_job(self, job_uuid: str, retries: int) -> bool:
+        """Set max-retries; resurrect a completed job back to waiting if it
+        now has attempts left (reference: tools.clj retry-job!)."""
+
+        def _retry(txn: _Txn) -> bool:
+            job = txn.job_w(job_uuid)
+            if job is None:
+                return False
+            job.max_retries = retries
+            if job.state is JobState.COMPLETED and not job.user_killed:
+                insts = txn.instances_of(job)
+                has_success = any(i.status is InstanceStatus.SUCCESS for i in insts.values())
+                if not has_success and job.attempts_used(insts) < retries:
+                    job.state = JobState.WAITING
+                    job.last_waiting_start_ms = now_ms()
+                    txn.event("job-state", uuid=job_uuid, old="completed",
+                              new="waiting", reason="retry")
+            return True
+
+        return self.transact(_retry)
+
+    # --------------------------------------------------------------- queries
+    def job(self, uuid: str) -> Optional[Job]:
+        with self._lock:
+            job = self._jobs.get(uuid)
+            return copy.deepcopy(job) if job is not None else None
+
+    def instance(self, task_id: str) -> Optional[Instance]:
+        with self._lock:
+            inst = self._instances.get(task_id)
+            return copy.deepcopy(inst) if inst is not None else None
+
+    def group(self, uuid: str) -> Optional[Group]:
+        with self._lock:
+            g = self._groups.get(uuid)
+            return copy.deepcopy(g) if g is not None else None
+
+    def jobs_where(self, pred: Callable[[Job], bool]) -> List[Job]:
+        with self._lock:
+            return [copy.deepcopy(j) for j in self._jobs.values()
+                    if j.committed and pred(j)]
+
+    def pending_jobs(self, pool: Optional[str] = None) -> List[Job]:
+        """Committed waiting jobs (reference: queries.clj get-pending-job-ents)."""
+        return self.jobs_where(
+            lambda j: j.state is JobState.WAITING and (pool is None or j.pool == pool))
+
+    def running_jobs(self, pool: Optional[str] = None) -> List[Job]:
+        return self.jobs_where(
+            lambda j: j.state is JobState.RUNNING and (pool is None or j.pool == pool))
+
+    def running_instances(self, pool: Optional[str] = None) -> List[Tuple[Job, Instance]]:
+        """(job, instance) for live instances (reference: tools.clj
+        get-running-task-ents — includes unknown + running)."""
+        with self._lock:
+            out = []
+            for inst in self._instances.values():
+                if inst.status not in (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                    continue
+                job = self._jobs.get(inst.job_uuid)
+                if job is None or (pool is not None and job.pool != pool):
+                    continue
+                out.append((copy.deepcopy(job), copy.deepcopy(inst)))
+            return out
+
+    def user_usage(self, pool: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+        """Per-user aggregate usage of running jobs (reference: scheduler.clj
+        user->usage)."""
+        usage: Dict[str, Dict[str, float]] = {}
+        for job, _inst in self.running_instances(pool):
+            u = usage.setdefault(job.user, {"count": 0.0, "cpus": 0.0, "mem": 0.0, "gpus": 0.0})
+            u["count"] += 1
+            u["cpus"] += job.resources.cpus
+            u["mem"] += job.resources.mem
+            u["gpus"] += job.resources.gpus
+        return usage
+
+    # ----------------------------------------------------- pools/shares/quota
+    def put_pool(self, pool: Pool) -> None:
+        with self._lock:
+            self._pools[pool.name] = pool
+
+    def pools(self) -> List[Pool]:
+        with self._lock:
+            return [copy.deepcopy(p) for p in self._pools.values()]
+
+    def pool(self, name: str) -> Optional[Pool]:
+        with self._lock:
+            p = self._pools.get(name)
+            return copy.deepcopy(p) if p is not None else None
+
+    def set_share(self, user: str, pool: str, resources: Dict[str, float],
+                  reason: str = "") -> None:
+        with self._lock:
+            self._shares[f"{user}/{pool}"] = ShareEntry(user, pool, dict(resources), reason)
+
+    def get_share(self, user: str, pool: str) -> Dict[str, float]:
+        """Share with 'default'-user then MAX_VALUE fallback per resource
+        (reference: share.clj get-share :105)."""
+        with self._lock:
+            entry = self._shares.get(f"{user}/{pool}")
+            default = self._shares.get(f"default/{pool}")
+        out: Dict[str, float] = {}
+        for dim in ("cpus", "mem", "gpus"):
+            if entry and dim in entry.resources:
+                out[dim] = entry.resources[dim]
+            elif default and dim in default.resources:
+                out[dim] = default.resources[dim]
+            else:
+                out[dim] = float(2**1023)  # stands in for Double/MAX_VALUE
+        return out
+
+    def retract_share(self, user: str, pool: str) -> None:
+        with self._lock:
+            self._shares.pop(f"{user}/{pool}", None)
+
+    def set_quota(self, user: str, pool: str, resources: Dict[str, float],
+                  count: float = float("inf"), reason: str = "") -> None:
+        with self._lock:
+            self._quotas[f"{user}/{pool}"] = QuotaEntry(user, pool, dict(resources), count, reason)
+
+    def get_quota(self, user: str, pool: str) -> Dict[str, float]:
+        """Quota map incl. :count, default-user fallback, infinite default
+        (reference: quota.clj get-quota :82)."""
+        with self._lock:
+            entry = self._quotas.get(f"{user}/{pool}")
+            default = self._quotas.get(f"default/{pool}")
+        out: Dict[str, float] = {}
+        for dim in ("cpus", "mem", "gpus"):
+            if entry and dim in entry.resources:
+                out[dim] = entry.resources[dim]
+            elif default and dim in default.resources:
+                out[dim] = default.resources[dim]
+            else:
+                out[dim] = float("inf")
+        if entry is not None:
+            out["count"] = entry.count
+        elif default is not None:
+            out["count"] = default.count
+        else:
+            out["count"] = float("inf")
+        return out
+
+    def retract_quota(self, user: str, pool: str) -> None:
+        with self._lock:
+            self._quotas.pop(f"{user}/{pool}", None)
+
+    def shares(self) -> List[ShareEntry]:
+        with self._lock:
+            return list(self._shares.values())
+
+    def quotas(self) -> List[QuotaEntry]:
+        with self._lock:
+            return list(self._quotas.values())
+
+    # ------------------------------------------------------ snapshot/restore
+    def snapshot(self) -> str:
+        """Serialize full state to JSON (leader handoff / checkpoint)."""
+        with self._lock:
+            state = {
+                "tx_id": self._tx_id,
+                "jobs": {k: to_json(v) for k, v in self._jobs.items()},
+                "instances": {k: to_json(v) for k, v in self._instances.items()},
+                "groups": {k: to_json(v) for k, v in self._groups.items()},
+                "pools": {k: to_json(v) for k, v in self._pools.items()},
+                "shares": {k: to_json(v) for k, v in self._shares.items()},
+                "quotas": {k: to_json(v) for k, v in self._quotas.items()},
+                "latches": dict(self._latches),
+            }
+        return json.dumps(state)
+
+    @classmethod
+    def restore(cls, blob: str) -> "Store":
+        state = json.loads(blob)
+        store = cls()
+        store._tx_id = state["tx_id"]
+        for k, v in state["jobs"].items():
+            store._jobs[k] = _job_from_json(v)
+        for k, v in state["instances"].items():
+            v = dict(v)
+            v["status"] = InstanceStatus(v["status"])
+            store._instances[k] = Instance(**v)
+        for k, v in state["groups"].items():
+            v = dict(v)
+            v["placement_type"] = GroupPlacementType(v["placement_type"])
+            store._groups[k] = Group(**v)
+        for k, v in state["pools"].items():
+            v = dict(v)
+            v["dru_mode"] = DruMode(v["dru_mode"])
+            v["scheduler"] = SchedulerKind(v["scheduler"])
+            store._pools[k] = Pool(**v)
+        for k, v in state["shares"].items():
+            store._shares[k] = ShareEntry(**v)
+        for k, v in state["quotas"].items():
+            v = dict(v)
+            v["count"] = float(v["count"]) if v["count"] is not None else float("inf")
+            store._quotas[k] = QuotaEntry(**v)
+        store._latches = {k: list(v) for k, v in state.get("latches", {}).items()}
+        return store
+
+
+def _job_from_json(v: Dict[str, Any]) -> Job:
+    v = dict(v)
+    v["state"] = JobState(v["state"])
+    v["resources"] = Resources(**v["resources"])
+    v["constraints"] = [Constraint(**c) for c in v.get("constraints") or []]
+    if v.get("application"):
+        v["application"] = Application(**v["application"])
+    if v.get("checkpoint"):
+        c = dict(v["checkpoint"])
+        c["mode"] = CheckpointMode(c["mode"])
+        v["checkpoint"] = Checkpoint(**c)
+    v["mea_culpa_failures"] = {int(k): int(n) for k, n in (v.get("mea_culpa_failures") or {}).items()}
+    return Job(**v)

@@ -1,0 +1,14 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run on
+XLA's host-platform device virtualization (the driver separately dry-runs
+the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,195 @@
+"""DRU rank kernel parity tests vs the CPU fallback golden.
+
+Mirrors the reference's dru unit tests + rank benchmark shape
+(scheduler/test/cook/test/scheduler/dru.clj, benchmark.clj:37-77).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cook_tpu.ops import host_prep, rank_kernel, reference_impl
+from cook_tpu.ops.dru import RankInputs, pool_quota_mask
+from cook_tpu.ops.reference_impl import UserTasks
+
+INF = float("inf")
+
+
+def make_inputs(users, shares, quotas):
+    arrays, task_ids = host_prep.pack_rank_inputs(users, shares, quotas)
+    inp = RankInputs(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    return inp, task_ids
+
+
+def run_both(users, shares, quotas, gpu_mode=False, max_over_quota_jobs=100):
+    golden = reference_impl.rank_by_dru(
+        users, shares, quotas, gpu_mode=gpu_mode,
+        max_over_quota_jobs=max_over_quota_jobs)
+    inp, task_ids = make_inputs(users, shares, quotas)
+    res = rank_kernel(inp, gpu_mode=gpu_mode,
+                      max_over_quota_jobs=max_over_quota_jobs)
+    n = int(res.num_ranked)
+    order = np.asarray(res.order)[:n]
+    kernel_ids = [task_ids[i] for i in order]
+    return [t for t, _ in golden], kernel_ids, res
+
+
+def usage_rows(*rows):
+    # rows of (cpus, mem, gpus); count column appended
+    return np.array([[c, m, g, 1.0] for c, m, g in rows], dtype=np.float32)
+
+
+class TestDruRanking:
+    def test_single_user_order_is_input_order(self):
+        users = [UserTasks("alice", [0, 1, 2],
+                           usage_rows((1, 10, 0), (1, 10, 0), (1, 10, 0)),
+                           [True, True, True])]
+        shares = {"alice": (10.0, 100.0, 1.0)}
+        quotas = {"alice": np.full(4, INF, dtype=np.float32)}
+        golden, kernel, _ = run_both(users, shares, quotas)
+        assert golden == [0, 1, 2]
+        assert kernel == golden
+
+    def test_two_users_interleave_by_dru(self):
+        # equal shares, equal tasks -> users alternate
+        u = lambda name, ids: UserTasks(
+            name, ids, usage_rows(*[(1, 10, 0)] * len(ids)), [True] * len(ids))
+        users = [u("alice", [0, 1, 2]), u("bob", [3, 4, 5])]
+        shares = {"alice": (10.0, 100.0, 1.0), "bob": (10.0, 100.0, 1.0)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, _ = run_both(users, shares, quotas)
+        assert golden == [0, 3, 1, 4, 2, 5]
+        assert kernel == golden
+
+    def test_share_weights_shift_order(self):
+        # bob has 2x the share -> his tasks score half as high and go first
+        users = [
+            UserTasks("alice", [0, 1], usage_rows((2, 20, 0), (2, 20, 0)), [True, True]),
+            UserTasks("bob", [2, 3], usage_rows((2, 20, 0), (2, 20, 0)), [True, True]),
+        ]
+        shares = {"alice": (10.0, 100.0, 1.0), "bob": (20.0, 200.0, 1.0)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, _ = run_both(users, shares, quotas)
+        assert golden[0] == 2  # bob first
+        assert kernel == golden
+
+    def test_running_tasks_push_pending_back(self):
+        # alice has two running tasks; her pending task ranks after bob's
+        users = [
+            UserTasks("alice", [0, 1, 2],
+                      usage_rows((4, 40, 0), (4, 40, 0), (1, 10, 0)),
+                      [False, False, True]),
+            UserTasks("bob", [3], usage_rows((1, 10, 0)), [True]),
+        ]
+        shares = {"alice": (10.0, 100.0, 1.0), "bob": (10.0, 100.0, 1.0)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, _ = run_both(users, shares, quotas)
+        assert golden == [3, 2]
+        assert kernel == golden
+
+    def test_dominant_resource_is_max_dim(self):
+        # alice's tasks are memory-heavy, bob's cpu-heavy; DRU takes the max
+        users = [
+            UserTasks("alice", [0], usage_rows((1, 90, 0)), [True]),
+            UserTasks("bob", [1], usage_rows((8, 10, 0)), [True]),
+        ]
+        shares = {"alice": (10.0, 100.0, 1.0), "bob": (10.0, 100.0, 1.0)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, res = run_both(users, shares, quotas)
+        # alice dru = max(90/100, 1/10) = 0.9; bob = max(10/100, 8/10) = 0.8
+        assert golden == [1, 0]
+        assert kernel == golden
+
+    def test_gpu_mode(self):
+        users = [
+            UserTasks("alice", [0, 1], usage_rows((1, 1, 4), (1, 1, 4)), [True, True]),
+            UserTasks("bob", [2], usage_rows((1, 1, 2)), [True]),
+        ]
+        shares = {"alice": (INF, INF, 4.0), "bob": (INF, INF, 4.0)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, _ = run_both(users, shares, quotas, gpu_mode=True)
+        # alice cum gpu dru: 1.0, 2.0 ; bob: 0.5
+        assert golden == [2, 0, 1]
+        assert kernel == golden
+
+    def test_unset_share_gives_zero_dru(self):
+        # share falls back to a MAX_VALUE stand-in -> dru 0, ranked first
+        users = [
+            UserTasks("alice", [0], usage_rows((1, 10, 0)), [True]),
+            UserTasks("bob", [1], usage_rows((1, 10, 0)), [True]),
+        ]
+        shares = {"alice": (10.0, 100.0, 1.0), "bob": (INF, INF, INF)}
+        quotas = {n: np.full(4, INF, dtype=np.float32) for n in ("alice", "bob")}
+        golden, kernel, _ = run_both(users, shares, quotas)
+        assert golden == [1, 0]
+        assert kernel == golden
+
+    def test_over_quota_limiting(self):
+        # quota of 2 cpus; tasks of 1 cpu each; max_over_quota_jobs=1 keeps
+        # the first over-quota task and drops the rest
+        users = [UserTasks("alice", [0, 1, 2, 3],
+                           usage_rows(*[(1, 10, 0)] * 4), [True] * 4)]
+        shares = {"alice": (10.0, 100.0, 1.0)}
+        quotas = {"alice": np.array([2.0, INF, INF, INF], dtype=np.float32)}
+        golden, kernel, _ = run_both(users, shares, quotas, max_over_quota_jobs=1)
+        assert golden == [0, 1, 2]
+        assert kernel == golden
+
+    def test_quota_count_dimension(self):
+        # count quota of 2 -> third task is over quota
+        users = [UserTasks("alice", [0, 1, 2, 3],
+                           usage_rows(*[(1, 10, 0)] * 4), [True] * 4)]
+        shares = {"alice": (10.0, 100.0, 1.0)}
+        quotas = {"alice": np.array([INF, INF, INF, 2.0], dtype=np.float32)}
+        golden, kernel, _ = run_both(users, shares, quotas, max_over_quota_jobs=0)
+        assert golden == [0, 1]
+        assert kernel == golden
+
+    @pytest.mark.parametrize("seed", range(5))
+    @pytest.mark.parametrize("gpu_mode", [False, True])
+    def test_randomized_parity(self, seed, gpu_mode):
+        rng = np.random.default_rng(seed)
+        n_users = int(rng.integers(1, 12))
+        users, shares, quotas = [], {}, {}
+        tid = 0
+        for u in range(n_users):
+            name = f"user{u:02d}"
+            n = int(rng.integers(1, 30))
+            rows = []
+            pend = []
+            for _ in range(n):
+                rows.append((float(rng.integers(1, 16)),
+                             float(rng.integers(16, 4096)),
+                             float(rng.integers(0, 4))))
+                pend.append(bool(rng.random() < 0.6))
+            users.append(UserTasks(name, list(range(tid, tid + n)),
+                                   usage_rows(*rows), pend))
+            tid += n
+            shares[name] = (float(rng.integers(8, 64)),
+                            float(rng.integers(1024, 8192)),
+                            float(rng.integers(1, 8)))
+            quotas[name] = np.array(
+                [float(rng.integers(20, 200)), INF, INF,
+                 float(rng.integers(5, 50))], dtype=np.float32)
+        golden, kernel, _ = run_both(users, shares, quotas, gpu_mode=gpu_mode,
+                                     max_over_quota_jobs=3)
+        assert kernel == golden
+
+
+class TestPoolQuotaMask:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(7)
+        J = 40
+        job_usage = np.stack([
+            rng.integers(1, 8, J).astype(np.float32),
+            rng.integers(10, 100, J).astype(np.float32),
+            np.zeros(J, dtype=np.float32),
+            np.ones(J, dtype=np.float32)], axis=1)
+        base = np.array([10.0, 100.0, 0.0, 5.0], dtype=np.float32)
+        quota = np.array([80.0, 2000.0, INF, 30.0], dtype=np.float32)
+        golden = reference_impl.filter_pool_quota(job_usage, base, quota)
+        got = np.asarray(pool_quota_mask(
+            jnp.asarray(job_usage), jnp.asarray(base), jnp.asarray(quota),
+            jnp.ones(J, dtype=bool)))
+        np.testing.assert_array_equal(got, golden)

@@ -1,0 +1,146 @@
+"""Match kernel tests: greedy scan parity (bit-exact) and multipass
+convergence (statistical parity per BASELINE.md >=99.9%)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cook_tpu.ops import (
+    MatchInputs,
+    greedy_match_kernel,
+    host_prep,
+    multipass_match_kernel,
+    reference_impl,
+)
+
+
+def to_inputs(arrays):
+    return MatchInputs(
+        job_res=jnp.asarray(arrays["job_res"]),
+        constraint_mask=jnp.asarray(arrays["constraint_mask"]),
+        avail=jnp.asarray(arrays["avail"]),
+        capacity=jnp.asarray(arrays["capacity"]),
+        valid=jnp.asarray(arrays["valid"]),
+    )
+
+
+def random_case(rng, J, H, tight=False):
+    job_res = np.stack([
+        rng.integers(1, 8, J).astype(np.float32),
+        rng.integers(64, 1024, J).astype(np.float32),
+        (rng.random(J) < 0.2) * rng.integers(0, 4, J).astype(np.float32),
+        np.zeros(J, dtype=np.float32),
+    ], axis=1)
+    scale = 4 if not tight else 1
+    capacity = np.stack([
+        rng.integers(8, 32 * scale, H).astype(np.float32),
+        rng.integers(1024, 8192 * scale, H).astype(np.float32),
+        rng.integers(0, 8, H).astype(np.float32),
+        np.full(H, 1e6, dtype=np.float32),
+    ], axis=1)
+    used_frac = rng.random((H, 1)).astype(np.float32) * 0.5
+    avail = (capacity * (1 - used_frac)).astype(np.float32)
+    cmask = rng.random((J, H)) < (0.9 if not tight else 0.7)
+    return job_res, cmask, avail, capacity
+
+
+class TestGreedyParity:
+    def test_simple_binpack_prefers_fuller_host(self):
+        job_res = np.array([[1, 100, 0, 0]], dtype=np.float32)
+        capacity = np.array([[10, 1000, 0, 0], [10, 1000, 0, 0]], dtype=np.float32)
+        avail = np.array([[10, 1000, 0, 0], [5, 500, 0, 0]], dtype=np.float32)
+        cmask = np.ones((1, 2), dtype=bool)
+        golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+        assert golden[0] == 1  # host 1 is half-used -> higher fitness
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, _ = greedy_match_kernel(to_inputs(arrays))
+        assert np.asarray(assign)[0] == 1
+
+    def test_infeasible_job_unassigned(self):
+        job_res = np.array([[100, 100, 0, 0]], dtype=np.float32)
+        capacity = avail = np.array([[10, 1000, 0, 0]], dtype=np.float32)
+        cmask = np.ones((1, 1), dtype=bool)
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, _ = greedy_match_kernel(to_inputs(arrays))
+        assert np.asarray(assign)[0] == -1
+
+    def test_constraint_mask_respected(self):
+        job_res = np.array([[1, 100, 0, 0]], dtype=np.float32)
+        capacity = avail = np.array([[10, 1000, 0, 0], [10, 1000, 0, 0]],
+                                    dtype=np.float32)
+        cmask = np.array([[False, True]])
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, _ = greedy_match_kernel(to_inputs(arrays))
+        assert np.asarray(assign)[0] == 1
+
+    def test_sequential_depletion(self):
+        # two jobs, one host that fits exactly one of them
+        job_res = np.array([[4, 400, 0, 0], [4, 400, 0, 0]], dtype=np.float32)
+        capacity = np.array([[8, 800, 0, 0]], dtype=np.float32)
+        avail = np.array([[5, 500, 0, 0]], dtype=np.float32)
+        cmask = np.ones((2, 1), dtype=bool)
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, left = greedy_match_kernel(to_inputs(arrays))
+        assert list(np.asarray(assign)[:2]) == [0, -1]
+        np.testing.assert_allclose(np.asarray(left)[0],
+                                   [1, 100, 0, 0], rtol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_exact_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        J, H = int(rng.integers(5, 120)), int(rng.integers(3, 60))
+        job_res, cmask, avail, capacity = random_case(rng, J, H, tight=bool(seed % 2))
+        golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, _ = greedy_match_kernel(to_inputs(arrays))
+        np.testing.assert_array_equal(np.asarray(assign)[:J], golden)
+
+    def test_gpu_dimension_feasibility(self):
+        job_res = np.array([[1, 100, 2, 0]], dtype=np.float32)
+        capacity = np.array([[10, 1000, 0, 0], [10, 1000, 4, 0]], dtype=np.float32)
+        avail = capacity.copy()
+        cmask = np.ones((1, 2), dtype=bool)
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+        assign, _ = greedy_match_kernel(to_inputs(arrays))
+        assert np.asarray(assign)[0] == 1
+
+
+class TestMultipass:
+    def test_never_oversubscribes(self):
+        for seed in range(4):
+            rng = np.random.default_rng(100 + seed)
+            J, H = 80, 20
+            job_res, cmask, avail, capacity = random_case(rng, J, H, tight=True)
+            arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+            assign, left = multipass_match_kernel(to_inputs(arrays))
+            assign = np.asarray(assign)[:J]
+            left = np.asarray(left)
+            # availability never goes negative
+            assert (left[:H] >= -1e-3).all()
+            # assigned jobs respect their constraint mask
+            for j, h in enumerate(assign):
+                if h >= 0:
+                    assert cmask[j, h]
+
+    def test_statistical_parity_with_greedy(self):
+        total = agree = 0
+        placed_golden = placed_multi = 0
+        for seed in range(8):
+            rng = np.random.default_rng(200 + seed)
+            J, H = 100, 30
+            job_res, cmask, avail, capacity = random_case(rng, J, H)
+            golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+            arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+            assign, _ = multipass_match_kernel(to_inputs(arrays))
+            assign = np.asarray(assign)[:J]
+            total += J
+            agree += int((assign == golden).sum())
+            placed_golden += int((golden >= 0).sum())
+            placed_multi += int((assign >= 0).sum())
+        # The auction mode guarantees placement-*count* parity (BASELINE.md's
+        # utilization-bearing metric); individual host choices may differ from
+        # the sequential greedy order because fitness is computed against
+        # cycle-start availability.  The greedy kernel is the bit-exact mode.
+        assert placed_multi >= 0.999 * placed_golden
+        assert agree / total > 0.15  # sanity: choices correlate with greedy

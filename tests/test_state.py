@@ -1,0 +1,302 @@
+"""State-core tests: schema, state machines, transactional store.
+
+Models the reference's unit-test tier (SURVEY.md section 4: in-memory Datomic +
+entity factories testutil.clj:217-478) with plain Store fixtures.
+"""
+
+import pytest
+
+from cook_tpu.state import (
+    AbortTransaction,
+    Group,
+    Instance,
+    InstanceStatus,
+    Job,
+    JobState,
+    Reasons,
+    Resources,
+    Store,
+    machines,
+    new_uuid,
+)
+
+
+def make_job(user="alice", pool="default", cpus=1.0, mem=100.0, gpus=0.0,
+             priority=50, max_retries=1, **kw) -> Job:
+    return Job(uuid=new_uuid(), user=user, command="echo hi", pool=pool,
+               resources=Resources(cpus=cpus, mem=mem, gpus=gpus),
+               priority=priority, max_retries=max_retries, **kw)
+
+
+class TestInstanceStateMachine:
+    def test_legal_transitions(self):
+        ok = machines.instance_transition_allowed
+        assert ok(InstanceStatus.UNKNOWN, InstanceStatus.RUNNING)
+        assert ok(InstanceStatus.UNKNOWN, InstanceStatus.FAILED)
+        assert ok(InstanceStatus.RUNNING, InstanceStatus.SUCCESS)
+        assert ok(InstanceStatus.RUNNING, InstanceStatus.FAILED)
+        assert not ok(InstanceStatus.SUCCESS, InstanceStatus.RUNNING)
+        assert not ok(InstanceStatus.FAILED, InstanceStatus.RUNNING)
+        assert not ok(InstanceStatus.SUCCESS, InstanceStatus.FAILED)
+        # self-transition is a tolerated no-op
+        assert ok(InstanceStatus.RUNNING, InstanceStatus.RUNNING)
+
+
+class TestLaunchAndComplete:
+    def test_launch_then_success(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        inst = store.launch_instance(uuid, "task-1", "host-a")
+        assert inst.status is InstanceStatus.UNKNOWN
+        assert store.job(uuid).state is JobState.RUNNING
+
+        assert store.update_instance_status("task-1", InstanceStatus.RUNNING)
+        assert store.update_instance_status("task-1", InstanceStatus.SUCCESS)
+        job = store.job(uuid)
+        assert job.state is JobState.COMPLETED
+
+    def test_failed_instance_requeues_until_retries_exhausted(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job(max_retries=2)])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.FAILED,
+                                     reason_code=Reasons.NON_ZERO_EXIT.code)
+        assert store.job(uuid).state is JobState.WAITING  # retry available
+        store.launch_instance(uuid, "t2", "h2")
+        store.update_instance_status("t2", InstanceStatus.FAILED,
+                                     reason_code=Reasons.NON_ZERO_EXIT.code)
+        assert store.job(uuid).state is JobState.COMPLETED  # attempts consumed
+
+    def test_mea_culpa_failure_does_not_consume_retry(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job(max_retries=1)])
+        for i in range(3):
+            store.launch_instance(uuid, f"t{i}", f"h{i}")
+            store.update_instance_status(
+                f"t{i}", InstanceStatus.FAILED,
+                reason_code=Reasons.PREEMPTED_BY_REBALANCER.code, preempted=True)
+            assert store.job(uuid).state is JobState.WAITING
+        # a real failure then consumes the single retry
+        store.launch_instance(uuid, "t-final", "hx")
+        store.update_instance_status("t-final", InstanceStatus.FAILED,
+                                     reason_code=Reasons.NON_ZERO_EXIT.code)
+        assert store.job(uuid).state is JobState.COMPLETED
+
+    def test_mea_culpa_failure_limit(self):
+        # CONTAINER_LAUNCH_FAILED has failure_limit=3: the 4th occurrence
+        # consumes a real retry (reference: reason failure limits +
+        # persist-mea-culpa-failure-limit! scheduler.clj:2326-2342).
+        store = Store()
+        [uuid] = store.create_jobs([make_job(max_retries=1)])
+        for i in range(3):
+            store.launch_instance(uuid, f"t{i}", "h")
+            store.update_instance_status(f"t{i}", InstanceStatus.FAILED,
+                                         reason_code=Reasons.CONTAINER_LAUNCH_FAILED.code)
+            assert store.job(uuid).state is JobState.WAITING
+        store.launch_instance(uuid, "t3", "h")
+        store.update_instance_status("t3", InstanceStatus.FAILED,
+                                     reason_code=Reasons.CONTAINER_LAUNCH_FAILED.code)
+        assert store.job(uuid).state is JobState.COMPLETED
+
+    def test_disable_mea_culpa(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job(max_retries=1, disable_mea_culpa_retries=True)])
+        store.launch_instance(uuid, "t0", "h")
+        store.update_instance_status("t0", InstanceStatus.FAILED,
+                                     reason_code=Reasons.PREEMPTED_BY_REBALANCER.code)
+        assert store.job(uuid).state is JobState.COMPLETED
+
+
+class TestLaunchGuard:
+    def test_allowed_to_start_blocks_double_launch(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        with pytest.raises(AbortTransaction) as exc:
+            store.launch_instance(uuid, "t2", "h2")
+        assert "job-state-running" in str(exc.value)
+
+    def test_allowed_to_start_blocks_completed_job(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.kill_job(uuid)
+        with pytest.raises(AbortTransaction):
+            store.launch_instance(uuid, "t1", "h1")
+
+    def test_abort_rolls_back_everything(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        try:
+            store.launch_instance(uuid, "t2", "h2")
+        except AbortTransaction:
+            pass
+        assert store.instance("t2") is None
+        assert len(store.job(uuid).instances) == 1
+
+
+class TestKillAndTxFeed:
+    def test_kill_emits_completed_event(self):
+        store = Store()
+        events = []
+        store.subscribe(lambda tx_id, evs: events.extend(evs))
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        store.kill_job(uuid)
+        job = store.job(uuid)
+        assert job.state is JobState.COMPLETED
+        kinds = [e.kind for e in events]
+        assert "job-created" in kinds and "instance-created" in kinds
+        completed = [e for e in events if e.kind == "job-state" and e.data["new"] == "completed"]
+        assert completed and completed[0].data["reason"] == "user-killed"
+
+    def test_redelivered_terminal_status_is_pure_noop(self):
+        # k8s watch replays / mesos re-sends must not touch terminal fields
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.RUNNING)
+        store.update_instance_status("t1", InstanceStatus.FAILED,
+                                     reason_code=Reasons.NON_ZERO_EXIT.code,
+                                     exit_code=3)
+        first = store.instance("t1")
+        assert store.update_instance_status(
+            "t1", InstanceStatus.FAILED,
+            reason_code=Reasons.PREEMPTED_BY_REBALANCER.code, exit_code=9,
+            preempted=True)
+        again = store.instance("t1")
+        assert again.end_time_ms == first.end_time_ms
+        assert again.reason_code == Reasons.NON_ZERO_EXIT.code
+        assert again.exit_code == 3
+        assert not again.preempted
+
+    def test_progress_sequence_monotone(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        assert store.update_instance_progress("t1", 50, sequence=5)
+        assert not store.update_instance_progress("t1", 30, sequence=3)
+        assert store.instance("t1").progress == 50
+
+    def test_txn_read_mutation_does_not_leak(self):
+        # mutating an entity obtained via a txn *read* then aborting must
+        # leave the store untouched (all-or-nothing guarantee)
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+
+        def evil(txn):
+            job = txn.job(uuid)  # read, not job_w
+            job.priority = 99
+            txn.abort("nope")
+
+        with pytest.raises(AbortTransaction):
+            store.transact(evil)
+        assert store.job(uuid).priority == 50
+
+    def test_subscriber_transacting_from_callback(self):
+        # a subscriber reacting to job completion by transacting (the
+        # monitor-tx-report-queue pattern) must not deadlock and must see
+        # events in commit order
+        store = Store()
+        seen = []
+
+        def on_events(tx_id, events):
+            seen.append(tx_id)
+            for e in events:
+                if e.kind == "job-state" and e.data["new"] == "completed":
+                    store.kill_job(e.data["uuid"])  # idempotent re-kill
+
+        store.subscribe(on_events)
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.SUCCESS)
+        assert seen == sorted(seen)
+
+    def test_stale_status_update_dropped(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.SUCCESS)
+        # late RUNNING update must not resurrect the instance
+        assert not store.update_instance_status("t1", InstanceStatus.RUNNING)
+        assert store.instance("t1").status is InstanceStatus.SUCCESS
+        assert store.job(uuid).state is JobState.COMPLETED
+
+
+class TestCommitLatch:
+    def test_uncommitted_jobs_invisible_until_latch_commits(self):
+        store = Store()
+        jobs = [make_job(), make_job()]
+        store.create_jobs(jobs, latch="latch-1")
+        assert store.pending_jobs() == []
+        store.commit_latch("latch-1")
+        assert {j.uuid for j in store.pending_jobs()} == {j.uuid for j in jobs}
+
+    def test_uncommitted_job_cannot_start(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()], latch="latch-2")
+        with pytest.raises(AbortTransaction) as exc:
+            store.launch_instance(uuid, "t1", "h1")
+        assert "uncommitted" in str(exc.value)
+
+
+class TestRetry:
+    def test_retry_resurrects_completed_job(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job(max_retries=1)])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.FAILED,
+                                     reason_code=Reasons.NON_ZERO_EXIT.code)
+        assert store.job(uuid).state is JobState.COMPLETED
+        store.retry_job(uuid, 3)
+        assert store.job(uuid).state is JobState.WAITING
+
+    def test_retry_does_not_resurrect_successful_job(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.SUCCESS)
+        store.retry_job(uuid, 5)
+        assert store.job(uuid).state is JobState.COMPLETED
+
+
+class TestSharesQuotas:
+    def test_share_default_user_fallback(self):
+        store = Store()
+        store.set_share("default", "default", {"cpus": 10.0, "mem": 1000.0})
+        store.set_share("alice", "default", {"cpus": 20.0})
+        s = store.get_share("alice", "default")
+        assert s["cpus"] == 20.0
+        assert s["mem"] == 1000.0  # falls back to default user
+        s = store.get_share("bob", "default")
+        assert s["cpus"] == 10.0
+        # unset dims fall back to a MAX_VALUE stand-in
+        assert store.get_share("bob", "default")["gpus"] > 1e300
+
+    def test_quota_count_dimension(self):
+        store = Store()
+        store.set_quota("alice", "default", {"cpus": 4.0}, count=2)
+        q = store.get_quota("alice", "default")
+        assert q["count"] == 2
+        assert q["mem"] == float("inf")
+
+
+class TestSnapshotRestore:
+    def test_round_trip(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job(gpus=2.0)])
+        store.launch_instance(uuid, "t1", "h1")
+        store.update_instance_status("t1", InstanceStatus.RUNNING)
+        store.set_share("alice", "default", {"cpus": 5.0})
+        store.set_quota("alice", "default", {"mem": 100.0}, count=7)
+        blob = store.snapshot()
+        restored = Store.restore(blob)
+        job = restored.job(uuid)
+        assert job.state is JobState.RUNNING
+        assert job.resources.gpus == 2.0
+        assert restored.instance("t1").status is InstanceStatus.RUNNING
+        assert restored.get_share("alice", "default")["cpus"] == 5.0
+        assert restored.get_quota("alice", "default")["count"] == 7
+        # restored store is live: finish the instance
+        restored.update_instance_status("t1", InstanceStatus.SUCCESS)
+        assert restored.job(uuid).state is JobState.COMPLETED
