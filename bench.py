@@ -1,0 +1,144 @@
+"""Benchmark: per-cycle scheduling hot path on the available accelerator.
+
+Measures the two kernels that replace the reference's hot loops at the
+BASELINE.md scales:
+  - DRU rank of 100k tasks across 500 users (BASELINE config 2)
+  - greedy bin-pack match of 1k considerable jobs x 5k host offers
+    (config 3's kernel at the reference's fenzo-max-jobs-considered cap)
+
+The headline value is the combined match-cycle latency (p50); vs_baseline is
+the speedup over the CPU fallback (reference-semantics numpy/python path)
+on the same inputs.  Prints exactly one JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def p50(xs):
+    return float(np.percentile(np.asarray(xs), 50))
+
+
+def bench_rank(reps=10):
+    import jax
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import host_prep, rank_kernel, reference_impl
+    from cook_tpu.ops.dru import RankInputs
+    from cook_tpu.ops.reference_impl import UserTasks
+
+    rng = np.random.default_rng(0)
+    n_users, total = 500, 100_000
+    per_user = total // n_users
+    users, shares, quotas = [], {}, {}
+    tid = 0
+    for u in range(n_users):
+        name = f"user{u:04d}"
+        rows = np.stack([
+            rng.integers(1, 16, per_user).astype(np.float32),
+            rng.integers(64, 4096, per_user).astype(np.float32),
+            np.zeros(per_user, dtype=np.float32),
+            np.ones(per_user, dtype=np.float32)], axis=1)
+        pend = (rng.random(per_user) < 0.8).tolist()
+        users.append(UserTasks(name, list(range(tid, tid + per_user)),
+                               rows, pend))
+        tid += per_user
+        shares[name] = (64.0, 65536.0, 8.0)
+        quotas[name] = np.full(4, np.inf, dtype=np.float32)
+
+    t0 = time.perf_counter()
+    arrays, _ = host_prep.pack_rank_inputs(users, shares, quotas)
+    pack_s = time.perf_counter() - t0
+    inp = RankInputs(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    out = rank_kernel(inp)
+    out.order.block_until_ready()  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = rank_kernel(inp)
+        out.order.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+
+    t0 = time.perf_counter()
+    reference_impl.rank_by_dru(users, shares, quotas)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    print(f"rank pack={pack_s*1e3:.0f}ms tpu_p50={p50(times):.2f}ms "
+          f"cpu={cpu_ms:.0f}ms", file=sys.stderr)
+    return p50(times), cpu_ms
+
+
+def bench_match(reps=10):
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import (MatchInputs, greedy_match_kernel, host_prep,
+                              reference_impl)
+
+    rng = np.random.default_rng(1)
+    J, H = 1000, 5000
+    job_res = np.stack([
+        rng.integers(1, 16, J).astype(np.float32),
+        rng.integers(64, 4096, J).astype(np.float32),
+        np.zeros(J, dtype=np.float32),
+        np.zeros(J, dtype=np.float32)], axis=1)
+    capacity = np.stack([
+        rng.integers(16, 128, H).astype(np.float32),
+        rng.integers(4096, 65536, H).astype(np.float32),
+        np.zeros(H, dtype=np.float32),
+        np.full(H, 1e6, dtype=np.float32)], axis=1)
+    avail = (capacity * rng.uniform(0.3, 1.0, (H, 1))).astype(np.float32)
+    cmask = np.ones((J, H), dtype=bool)
+
+    arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+    inp = MatchInputs(
+        job_res=jnp.asarray(arrays["job_res"]),
+        constraint_mask=jnp.asarray(arrays["constraint_mask"]),
+        avail=jnp.asarray(arrays["avail"]),
+        capacity=jnp.asarray(arrays["capacity"]),
+        valid=jnp.asarray(arrays["valid"]))
+    assign, _ = greedy_match_kernel(inp)
+    assign.block_until_ready()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        assign, _ = greedy_match_kernel(inp)
+        assign.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+
+    t0 = time.perf_counter()
+    golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    parity = float((np.asarray(assign)[:J] == golden).mean())
+    print(f"match tpu_p50={p50(times):.2f}ms cpu={cpu_ms:.0f}ms "
+          f"parity={parity:.4f}", file=sys.stderr)
+    return p50(times), cpu_ms, parity
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    rank_tpu, rank_cpu = bench_rank()
+    match_tpu, match_cpu, parity = bench_match()
+    tpu_total = rank_tpu + match_tpu
+    cpu_total = rank_cpu + match_cpu
+    print(json.dumps({
+        "metric": "match_cycle_p50_ms_rank100k_match1kx5k",
+        "value": round(tpu_total, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_total / tpu_total, 2),
+        "detail": {
+            "platform": platform,
+            "rank_ms_100k_tasks_500_users": round(rank_tpu, 3),
+            "match_ms_1k_jobs_5k_hosts": round(match_tpu, 3),
+            "cpu_fallback_rank_ms": round(rank_cpu, 1),
+            "cpu_fallback_match_ms": round(match_cpu, 1),
+            "greedy_placement_parity": parity,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

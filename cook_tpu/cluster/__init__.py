@@ -1,0 +1,2 @@
+from .base import ComputeCluster, LaunchSpec, Offer, ReadWriteLock  # noqa: F401
+from .fake import FakeCluster, FakeHost  # noqa: F401

@@ -1,0 +1,190 @@
+"""In-process fake compute cluster with a virtual clock.
+
+The port of the reference's test/simulation backends: the fake compute
+cluster registered by unit tests (reference: testutil.clj:76-122) fused with
+the offer-fabricating in-JVM Mesos master used by the faster-than-real-time
+simulator (reference: scheduler/src/cook/mesos/mesos_mock.clj:88-184).
+
+Hosts are declared with capacities/attributes; offers are synthesized as
+capacity minus consumption (the k8s-style offer model); launched tasks
+complete after a configurable virtual duration when :meth:`advance_to` moves
+the clock, delivering status updates through the scheduler's callback.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..state.schema import InstanceStatus, Reasons, Resources
+from .base import ComputeCluster, LaunchSpec, Offer
+
+
+@dataclass
+class FakeHost:
+    hostname: str
+    capacity: Resources
+    pool: str = "default"
+    attributes: Dict[str, str] = field(default_factory=dict)
+    gpu_model: str = ""
+    disk_type: str = ""
+
+
+@dataclass
+class _RunningTask:
+    spec: LaunchSpec
+    started_at_ms: int
+    duration_ms: Optional[int]   # None = runs until killed
+    exit_code: int = 0
+
+
+class FakeCluster(ComputeCluster):
+    """Deterministic fake backend for tests, the simulator, and benchmarks."""
+
+    def __init__(self, name: str, hosts: List[FakeHost],
+                 default_task_duration_ms: Optional[int] = None):
+        super().__init__(name)
+        self._hosts: Dict[str, FakeHost] = {h.hostname: h for h in hosts}
+        self._tasks: Dict[str, _RunningTask] = {}
+        self._lock = threading.RLock()
+        self._now_ms = 0
+        self._default_duration_ms = default_task_duration_ms
+        # task_id -> duration override, set by tests/simulator before launch
+        self.task_durations_ms: Dict[str, int] = {}
+        self.task_exit_codes: Dict[str, int] = {}
+        self.launched_order: List[str] = []
+
+    # ------------------------------------------------------------- protocol
+    def pending_offers(self, pool: str) -> List[Offer]:
+        with self._lock:
+            consumption: Dict[str, Resources] = {}
+            counts: Dict[str, int] = {}
+            for t in self._tasks.values():
+                h = t.spec.hostname
+                consumption[h] = consumption.get(h, Resources()) + t.spec.resources
+                counts[h] = counts.get(h, 0) + 1
+            offers = []
+            for h in self._hosts.values():
+                if h.pool != pool:
+                    continue
+                used = consumption.get(h.hostname, Resources())
+                avail = h.capacity - used
+                if not avail.non_negative():
+                    avail = Resources()
+                offers.append(Offer(
+                    id=f"{self.name}/{h.hostname}/{self._now_ms}",
+                    hostname=h.hostname, slave_id=h.hostname, pool=pool,
+                    cluster=self.name,
+                    available=avail, capacity=h.capacity,
+                    attributes=dict(h.attributes),
+                    task_count=counts.get(h.hostname, 0),
+                    gpu_model=h.gpu_model, disk_type=h.disk_type))
+            return offers
+
+    def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
+        rejected: List[str] = []
+        with self._lock:
+            for spec in specs:
+                if not spec.hostname:
+                    # direct (Kenzo) mode: the backend's own scheduler places
+                    # the task — first-fit stand-in for kube-scheduler
+                    chosen = self._first_fit(pool, spec.resources)
+                    if chosen is None:
+                        rejected.append(spec.task_id)
+                        continue
+                    spec.hostname = chosen
+                    spec.slave_id = chosen
+                duration = self.task_durations_ms.get(
+                    spec.task_id, self._default_duration_ms)
+                self._tasks[spec.task_id] = _RunningTask(
+                    spec=spec, started_at_ms=self._now_ms, duration_ms=duration,
+                    exit_code=self.task_exit_codes.get(spec.task_id, 0))
+                self.launched_order.append(spec.task_id)
+        for spec in specs:
+            if spec.task_id not in rejected:
+                self._emit(spec.task_id, InstanceStatus.RUNNING, None,
+                           hostname=spec.hostname)
+        for tid in rejected:
+            self._emit(tid, InstanceStatus.FAILED,
+                       Reasons.REASON_POD_SUBMISSION_FAILED.code)
+
+    def _first_fit(self, pool: str, need: Resources) -> Optional[str]:
+        consumption: Dict[str, Resources] = {}
+        for t in self._tasks.values():
+            h = t.spec.hostname
+            consumption[h] = consumption.get(h, Resources()) + t.spec.resources
+        for h in self._hosts.values():
+            if h.pool != pool:
+                continue
+            avail = h.capacity - consumption.get(h.hostname, Resources())
+            if need.fits_in(avail):
+                return h.hostname
+        return None
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            self._emit(task_id, InstanceStatus.FAILED, Reasons.KILLED_BY_USER.code)
+
+    # ---------------------------------------------------------- virtual time
+    def advance_to(self, now_ms: int) -> List[str]:
+        """Move the virtual clock; complete tasks whose duration elapsed.
+        Returns completed task ids (in completion-time order)."""
+        finished: List[tuple] = []
+        with self._lock:
+            self._now_ms = max(self._now_ms, now_ms)
+            for tid, t in list(self._tasks.items()):
+                if t.duration_ms is None:
+                    continue
+                done_at = t.started_at_ms + t.duration_ms
+                if done_at <= self._now_ms:
+                    finished.append((done_at, tid, t.exit_code))
+                    del self._tasks[tid]
+        finished.sort()
+        out = []
+        for _done_at, tid, exit_code in finished:
+            ok = exit_code == 0
+            self._emit(tid,
+                       InstanceStatus.SUCCESS if ok else InstanceStatus.FAILED,
+                       None if ok else Reasons.NON_ZERO_EXIT.code,
+                       exit_code=exit_code)
+            out.append(tid)
+        return out
+
+    @property
+    def now_ms(self) -> int:
+        return self._now_ms
+
+    def running_task_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._tasks.keys())
+
+    def complete_task(self, task_id: str, exit_code: int = 0) -> None:
+        """Test/simulator hook: finish a running task immediately."""
+        with self._lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            ok = exit_code == 0
+            self._emit(task_id,
+                       InstanceStatus.SUCCESS if ok else InstanceStatus.FAILED,
+                       None if ok else Reasons.NON_ZERO_EXIT.code,
+                       exit_code=exit_code)
+
+    def fail_task(self, task_id: str, reason_code: int,
+                  preempted: bool = False) -> None:
+        """Test/chaos hook: fail a running task with a given reason."""
+        with self._lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            self._emit(task_id, InstanceStatus.FAILED, reason_code,
+                       preempted=preempted)
+
+    def _emit(self, task_id: str, status: InstanceStatus,
+              reason_code: Optional[int], exit_code: Optional[int] = None,
+              preempted: bool = False, hostname: Optional[str] = None) -> None:
+        if self._status_callback is not None:
+            self._status_callback(task_id, status, reason_code,
+                                  exit_code=exit_code, preempted=preempted,
+                                  hostname=hostname)

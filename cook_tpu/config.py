@@ -1,0 +1,87 @@
+"""Framework configuration.
+
+Mirrors the behavior-bearing knobs of the reference's EDN config system
+(reference: scheduler/src/cook/config.clj:231-798), as nested dataclasses.
+Per-pool scheduler selection follows the reference's pool-regex scheme
+(config.clj:121,798): the matcher backend is chosen per pool, with ``cpu``
+as the no-accelerator fallback (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Pattern
+
+
+@dataclass
+class MatcherConfig:
+    """Per-pool matcher knobs (reference: default-fenzo-scheduler-config
+    config.clj:110-117)."""
+
+    # "tpu-greedy" = bit-exact greedy scan kernel; "tpu-auction" = top-K
+    # auction kernel for large queues; "cpu" = numpy fallback.
+    backend: str = "tpu-greedy"
+    max_jobs_considered: int = 1000
+    # head-of-queue fairness backoff (scheduler.clj:1613-1651)
+    scaleback: float = 0.95
+    floor_iterations_before_warn: int = 10
+    floor_iterations_before_reset: int = 1000
+    # auction-kernel shape knobs
+    auction_num_prefs: int = 16
+    auction_num_rounds: int = 24
+
+
+@dataclass
+class RebalancerConfig:
+    """Preemption-cycle parameters (reference: rebalancer.clj:535-557
+    dynamic Datomic params)."""
+
+    enabled: bool = True
+    interval_seconds: float = 120.0
+    safe_dru_threshold: float = 1.0
+    min_dru_diff: float = 0.5
+    max_preemption: int = 64
+
+
+@dataclass
+class PoolQuota:
+    """Pool-level global caps (reference: tools.clj global-pool-quota)."""
+
+    cpus: float = float("inf")
+    mem: float = float("inf")
+    gpus: float = float("inf")
+    count: float = float("inf")
+
+
+@dataclass
+class Config:
+    rank_interval_seconds: float = 5.0         # mesos.clj:108
+    match_interval_seconds: float = 1.0        # target-per-pool-match-interval
+    max_over_quota_jobs: int = 100             # config.clj:413-416
+    default_pool: str = "default"
+    # pool-regex -> matcher config, first match wins (config.clj:798)
+    pool_matchers: List[tuple] = field(default_factory=list)
+    default_matcher: MatcherConfig = field(default_factory=MatcherConfig)
+    rebalancer: RebalancerConfig = field(default_factory=RebalancerConfig)
+    # pool name -> global quota; pool -> quota-group name for cross-pool caps
+    pool_quotas: Dict[str, PoolQuota] = field(default_factory=dict)
+    quota_groups: Dict[str, str] = field(default_factory=dict)
+    quota_group_quotas: Dict[str, PoolQuota] = field(default_factory=dict)
+    max_tasks_per_host: Optional[int] = None
+    # reapers (scheduler.clj:1888-2016)
+    lingering_task_interval_seconds: float = 30.0
+    straggler_interval_seconds: float = 30.0
+
+    _compiled: List[tuple] = field(default_factory=list, repr=False)
+
+    def matcher_for_pool(self, pool_name: str) -> MatcherConfig:
+        if not self._compiled and self.pool_matchers:
+            self._compiled = [(re.compile(rx), mc) for rx, mc in self.pool_matchers]
+        for rx, mc in self._compiled:
+            if rx.search(pool_name):
+                return mc
+        return self.default_matcher
+
+    def pool_quota(self, pool_name: str) -> Optional[PoolQuota]:
+        return self.pool_quotas.get(pool_name)

@@ -13,4 +13,10 @@ from .match import (  # noqa: F401
     multipass_match_kernel,
 )
 from .padding import bucket, pad_to  # noqa: F401
+from .rebalance import (  # noqa: F401
+    RebalanceDecision,
+    RebalanceInputs,
+    preemption_kernel,
+)
+from .scan import segmented_cumsum  # noqa: F401
 from . import host_prep, reference_impl  # noqa: F401

@@ -78,6 +78,36 @@ def segment_cumsum(x: jax.Array, first_idx: jax.Array) -> jax.Array:
     return scanlib.segmented_cumsum_by_first_idx(x, first_idx)
 
 
+def rank_body(usage, quota, shares, first_idx, user_rank, pending, valid,
+              gpu_mode: bool, max_over_quota_jobs: int):
+    """Pure rank math (jit/vmap-composable): returns
+    (order, num_ranked, dru, keep, rankable).  Single source of truth shared
+    by :func:`rank_kernel` and the pool-sharded cycle."""
+    usage = usage * valid[:, None]
+
+    # --- per-user over-quota limiting (limit-over-quota-jobs) --------------
+    cum_all = segment_cumsum(usage, first_idx)
+    over = jnp.any(cum_all > quota, axis=-1) & valid
+    over_cnt = segment_cumsum(over.astype(jnp.int32), first_idx)
+    keep = valid & (over_cnt <= max_over_quota_jobs)
+
+    # --- segmented prefix sums over surviving tasks ------------------------
+    cum = segment_cumsum(usage * keep[:, None], first_idx)
+    if gpu_mode:
+        dru = cum[:, 2] / shares[:, 2]
+    else:
+        dru = jnp.maximum(cum[:, 1] / shares[:, 1],
+                          cum[:, 0] / shares[:, 0])
+
+    # --- global ascending sort over pending survivors ----------------------
+    rankable = keep & pending
+    sort_dru = jnp.where(rankable, dru, jnp.inf)
+    position = jnp.arange(dru.shape[0], dtype=jnp.int32)
+    order = jnp.lexsort((position, user_rank, sort_dru)).astype(jnp.int32)
+    num_ranked = jnp.sum(rankable.astype(jnp.int32))
+    return order, num_ranked, dru, keep, rankable
+
+
 @functools.partial(jax.jit, static_argnames=("gpu_mode", "max_over_quota_jobs"))
 def rank_kernel(inp: RankInputs, *, gpu_mode: bool = False,
                 max_over_quota_jobs: int = 100) -> RankResult:
@@ -86,30 +116,10 @@ def rank_kernel(inp: RankInputs, *, gpu_mode: bool = False,
     Matches the semantics of sort-jobs-by-dru-helper (scheduler.clj:2073-2099)
     with dru-mode default|gpu (dru.clj:50-80,106-126).
     """
-    usage = inp.usage * inp.valid[:, None]
-
-    # --- per-user over-quota limiting (limit-over-quota-jobs) --------------
-    cum_all = segment_cumsum(usage, inp.first_idx)
-    over = jnp.any(cum_all > inp.quota, axis=-1) & inp.valid
-    over_cnt = segment_cumsum(over.astype(jnp.int32), inp.first_idx)
-    keep = inp.valid & (over_cnt <= max_over_quota_jobs)
-
-    # --- segmented prefix sums over surviving tasks ------------------------
-    cum = segment_cumsum(usage * keep[:, None], inp.first_idx)
-    if gpu_mode:
-        dru = cum[:, 2] / inp.shares[:, 2]
-    else:
-        dru = jnp.maximum(cum[:, 1] / inp.shares[:, 1],
-                          cum[:, 0] / inp.shares[:, 0])
-
-    # --- global ascending sort over pending survivors ----------------------
-    rankable = keep & inp.pending
-    sort_dru = jnp.where(rankable, dru, jnp.inf)
-    position = jnp.arange(dru.shape[0], dtype=jnp.int32)
-    order = jnp.lexsort((position, inp.user_rank, sort_dru))
-    num_ranked = jnp.sum(rankable.astype(jnp.int32))
-    return RankResult(order=order.astype(jnp.int32),
-                      dru=jnp.where(keep, dru, jnp.inf),
+    order, num_ranked, dru, keep, _rankable = rank_body(
+        inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
+        inp.pending, inp.valid, gpu_mode, max_over_quota_jobs)
+    return RankResult(order=order, dru=jnp.where(keep, dru, jnp.inf),
                       keep=keep, num_ranked=num_ranked)
 
 

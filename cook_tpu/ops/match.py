@@ -53,25 +53,33 @@ def _fitness(need: jax.Array, avail: jax.Array, capacity: jax.Array) -> jax.Arra
     return (f_cpu + f_mem) * 0.5
 
 
+def greedy_assign(job_res, constraint_mask, valid, avail, capacity):
+    """Pure greedy-scan math (jit/vmap-composable); single source of truth
+    shared by :func:`greedy_match_kernel` and the pool-sharded cycle.
+    Returns (assign i32[J], remaining avail f32[H, R])."""
+
+    def step(avail, xs):
+        need, cmask, ok = xs
+        feasible = jnp.all(avail >= need[None, :], axis=1) & cmask & ok
+        fitness = jnp.where(feasible, _fitness(need, avail, capacity), NEG_INF)
+        host = jnp.argmax(fitness)  # ties -> lowest index, as in the fallback
+        found = feasible[host]
+        onehot = (jnp.arange(avail.shape[0]) == host)[:, None]
+        avail = avail - jnp.where(found, need[None, :] * onehot, 0.0)
+        return avail, jnp.where(found, host, -1).astype(jnp.int32)
+
+    avail, assign = jax.lax.scan(step, avail, (job_res, constraint_mask, valid))
+    return assign, avail
+
+
 @jax.jit
 def greedy_match_kernel(inp: MatchInputs) -> Tuple[jax.Array, jax.Array]:
     """Sequential-greedy assignment, one job per scan step.
 
     Returns (assign i32[J] host index or -1, remaining avail f32[H, R]).
     """
-
-    def step(avail, xs):
-        need, cmask, valid = xs
-        feasible = jnp.all(avail >= need[None, :], axis=1) & cmask & valid
-        fitness = jnp.where(feasible, _fitness(need, avail, inp.capacity), NEG_INF)
-        host = jnp.argmax(fitness)  # ties -> lowest index, as in the fallback
-        found = feasible[host]
-        avail = avail - jnp.where(found, need[None, :] * (jnp.arange(avail.shape[0]) == host)[:, None], 0.0)
-        return avail, jnp.where(found, host, -1).astype(jnp.int32)
-
-    avail, assign = jax.lax.scan(step, inp.avail,
-                                 (inp.job_res, inp.constraint_mask, inp.valid))
-    return assign, avail
+    return greedy_assign(inp.job_res, inp.constraint_mask, inp.valid,
+                         inp.avail, inp.capacity)
 
 
 @functools.partial(jax.jit, static_argnames=("num_prefs", "num_rounds"))

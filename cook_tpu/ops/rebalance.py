@@ -1,0 +1,79 @@
+"""Preemption victim-selection kernel.
+
+Re-expresses the reference's rebalancer inner loop (SURVEY.md HOT LOOP #3b;
+reference: compute-preemption-decision rebalancer.clj:320-407) as one batched
+computation: tasks pre-sorted by (host, dru descending) with per-host spare
+resources; the kernel evaluates every "preempt the k highest-DRU eligible
+tasks on host h" prefix simultaneously via a segmented prefix sum and takes
+the global argmax of decision quality (= minimum victim DRU; spare-only
+placements score +inf, the reference's Double/MAX_VALUE rows).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import scan as scanlib
+
+
+class RebalanceInputs(NamedTuple):
+    """Padded inputs. Tasks sorted by (host_idx, -dru); padding rows have
+    eligible=False and host_idx pointing at a real host (clamped)."""
+
+    task_dru: jax.Array      # f32[T]
+    task_res: jax.Array      # f32[T, R]
+    task_host: jax.Array     # i32[T]
+    host_start: jax.Array    # bool[T] first row of its host segment
+    eligible: jax.Array      # bool[T] passes dru/quota/self filters
+    spare: jax.Array         # f32[H, R] spare resources per host
+    host_ok: jax.Array       # bool[H] passes the pending job's constraints
+    demand: jax.Array        # f32[R] pending job resources
+
+
+class RebalanceDecision(NamedTuple):
+    found: jax.Array         # bool[]
+    spare_only: jax.Array    # bool[] no preemption needed, spare suffices
+    host: jax.Array          # i32[] winning host index
+    victim_mask: jax.Array   # bool[T] tasks to preempt
+    decision_dru: jax.Array  # f32[] min victim dru (inf when spare_only)
+
+
+@jax.jit
+def preemption_kernel(inp: RebalanceInputs) -> RebalanceDecision:
+    T = inp.task_dru.shape[0]
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+
+    res_eligible = inp.task_res * inp.eligible[:, None]
+    seg_cum = scanlib.segmented_cumsum(res_eligible, inp.host_start)
+    total = inp.spare[inp.task_host] + seg_cum
+    task_host_ok = inp.host_ok[inp.task_host]
+    feasible = (jnp.all(total >= inp.demand[None, :], axis=1)
+                & inp.eligible & task_host_ok)
+    # decision quality = dru of the last (lowest-dru) victim in the prefix;
+    # within a host the first feasible row IS the best prefix (dru sorted
+    # descending), and argmax over dru picks exactly that row.
+    score = jnp.where(feasible, inp.task_dru, -jnp.inf)
+
+    # spare-only solutions (reference: MAX_VALUE rows) dominate everything
+    spare_feasible = (jnp.all(inp.spare >= inp.demand[None, :], axis=1)
+                      & inp.host_ok)
+    any_spare = jnp.any(spare_feasible)
+    spare_host = jnp.argmax(spare_feasible)  # lowest index among feasible
+
+    best_t = jnp.argmax(score)
+    best_score = score[best_t]
+    any_task = best_score > -jnp.inf  # note: an unset-share user's dru is +inf
+    best_host = inp.task_host[best_t]
+
+    found = any_spare | any_task
+    spare_only = any_spare
+    host = jnp.where(any_spare, spare_host, best_host).astype(jnp.int32)
+    victim_mask = (~spare_only & inp.eligible
+                   & (inp.task_host == host) & (t_idx <= best_t))
+    decision_dru = jnp.where(spare_only, jnp.inf, best_score)
+    return RebalanceDecision(found=found, spare_only=spare_only, host=host,
+                             victim_mask=victim_mask,
+                             decision_dru=decision_dru)

@@ -1,0 +1,6 @@
+from .mesh import POOL_AXIS, pool_mesh  # noqa: F401
+from .sharded import (  # noqa: F401
+    PoolCycleInputs,
+    PoolCycleResult,
+    make_pool_cycle,
+)

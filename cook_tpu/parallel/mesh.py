@@ -1,0 +1,27 @@
+"""Device mesh helpers for pool-sharded scheduling.
+
+The TPU-build equivalent of the reference's per-pool concurrency (reference:
+per-pool handlers round-robin triggered, scheduler.clj:2491-2517): pools
+shard across a 1-D "pool" mesh axis; cross-pool reconciliation (quota groups,
+global DRU telemetry) rides ICI collectives (SURVEY.md section 2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+POOL_AXIS = "pool"
+
+
+def pool_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the pool axis. With multi-slice topologies a 2-D
+    ("slice", "pool") mesh would put independent pools on DCN and keep
+    reconciliation collectives on ICI; single-slice uses all devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (POOL_AXIS,))

@@ -1,0 +1,143 @@
+"""Pool-sharded fused scheduling cycle: rank + match on a device mesh.
+
+One jitted step runs EVERY pool's rank (DRU segmented prefix sums + sort) and
+match (greedy bin-pack scan) with pools sharded over the mesh's "pool" axis
+via ``shard_map``; cross-pool facts are reconciled with XLA collectives:
+
+ - per-pool matched-resource totals are ``all_gather``'d so quota-group caps
+   spanning pools (reference: scheduler.clj:2125-2157 quota-group
+   aggregation) can be enforced against a globally consistent view;
+ - a ``psum`` of per-pool placement counts gives the global cycle telemetry
+   the reference logs per match cycle (scheduler.clj:1210-1280).
+
+The match job axis is aligned with the rank task axis (running-task rows are
+never valid match rows), so the ranked order permutes match inputs entirely
+on device — no host round-trip between rank and match.
+
+This module is the scale axis of the framework (SURVEY.md section 5
+"long-context" slot): pools across devices, and within a pool the job/offer
+tensors are bucketed so XLA tiles them onto the VPU/MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import dru as dru_ops
+from ..ops import match as match_ops
+from .mesh import POOL_AXIS
+
+
+class PoolCycleInputs(NamedTuple):
+    """Per-pool padded arrays, stacked on a leading pool axis [P, ...].
+
+    Task/job axes are shared: row t is one task; pending rows double as
+    match candidates (job_res/cmask); running rows have pending=False.
+    """
+
+    # rank side [P, T, ...]
+    usage: jax.Array       # f32[P, T, 4]
+    quota: jax.Array       # f32[P, T, 4]
+    shares: jax.Array      # f32[P, T, 3]
+    first_idx: jax.Array   # i32[P, T]
+    user_rank: jax.Array   # i32[P, T]
+    pending: jax.Array     # bool[P, T]
+    valid: jax.Array       # bool[P, T]
+    # match side
+    job_res: jax.Array     # f32[P, T, R]
+    cmask: jax.Array       # bool[P, T, H]
+    avail: jax.Array       # f32[P, H, R]
+    capacity: jax.Array    # f32[P, H, R]
+
+
+class PoolCycleResult(NamedTuple):
+    order: jax.Array          # i32[P, T] rank order (pending first)
+    num_ranked: jax.Array     # i32[P]
+    dru: jax.Array            # f32[P, T]
+    assign: jax.Array         # i32[P, T] host or -1, in RANK order
+    matched_usage: jax.Array  # f32[P, 4] resources matched per pool (global view)
+    total_matched: jax.Array  # i32[] global placement count
+
+
+def _rank_one_pool(usage, quota, shares, first_idx, user_rank, pending, valid,
+                   gpu_mode: bool, max_over_quota_jobs: int):
+    order, num_ranked, dru, _keep, rankable = dru_ops.rank_body(
+        usage, quota, shares, first_idx, user_rank, pending, valid,
+        gpu_mode, max_over_quota_jobs)
+    return order, num_ranked, dru, rankable
+
+
+def _match_one_pool(job_res, cmask, avail, capacity, valid):
+    assign, _avail = match_ops.greedy_assign(job_res, cmask, valid, avail,
+                                             capacity)
+    return assign
+
+
+def single_pool_cycle(usage, quota, shares, first_idx, user_rank, pending,
+                      valid, job_res, cmask, avail, capacity,
+                      gpu_mode: bool = False, max_over_quota_jobs: int = 100):
+    """Single-chip fused rank+match step (the framework's 'forward pass'):
+    DRU-rank all tasks, permute pending jobs into rank order, greedy
+    bin-pack them against the offers. Jittable as-is."""
+    order, num_ranked, dru, rankable = _rank_one_pool(
+        usage, quota, shares, first_idx, user_rank, pending, valid,
+        gpu_mode, max_over_quota_jobs)
+    sorted_res = jnp.take(job_res, order, axis=0)
+    sorted_mask = jnp.take(cmask, order, axis=0)
+    sorted_ok = jnp.take(rankable, order, axis=0)
+    assign = _match_one_pool(sorted_res, sorted_mask, avail, capacity,
+                             sorted_ok)
+    return order, num_ranked, dru, assign
+
+
+def make_pool_cycle(mesh: Mesh, *, gpu_mode: bool = False,
+                    max_over_quota_jobs: int = 100):
+    """Build the jitted pool-sharded cycle for a mesh."""
+
+    def cycle_body(inp: PoolCycleInputs) -> PoolCycleResult:
+        # local block: leading dim = pools on this device
+        def per_pool(usage, quota, shares, first_idx, user_rank, pending,
+                     valid, job_res, cmask, avail, capacity):
+            order, num_ranked, dru, rankable = _rank_one_pool(
+                usage, quota, shares, first_idx, user_rank, pending, valid,
+                gpu_mode, max_over_quota_jobs)
+            sorted_res = jnp.take(job_res, order, axis=0)
+            sorted_mask = jnp.take(cmask, order, axis=0)
+            sorted_ok = jnp.take(rankable, order, axis=0)
+            assign = _match_one_pool(sorted_res, sorted_mask, avail,
+                                     capacity, sorted_ok)
+            matched = (assign >= 0)
+            matched_usage = jnp.sum(
+                sorted_res * matched[:, None], axis=0)[:4]
+            return order, num_ranked, dru, assign, matched_usage
+
+        order, num_ranked, dru, assign, matched_usage = jax.vmap(per_pool)(
+            inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
+            inp.pending, inp.valid, inp.job_res, inp.cmask, inp.avail,
+            inp.capacity)
+        # ICI reconciliation: every device sees every pool's matched usage
+        # (quota groups span pools) and the global placement count.
+        matched_usage_global = jax.lax.all_gather(
+            matched_usage, POOL_AXIS, axis=0, tiled=True)
+        total = jax.lax.psum(jnp.sum((assign >= 0).astype(jnp.int32)),
+                             POOL_AXIS)
+        return PoolCycleResult(order=order, num_ranked=num_ranked, dru=dru,
+                               assign=assign,
+                               matched_usage=matched_usage_global,
+                               total_matched=total)
+
+    spec = P(POOL_AXIS)
+    sharded = shard_map(
+        cycle_body, mesh=mesh,
+        in_specs=(PoolCycleInputs(*(spec,) * len(PoolCycleInputs._fields)),),
+        out_specs=PoolCycleResult(
+            order=spec, num_ranked=spec, dru=spec, assign=spec,
+            matched_usage=P(), total_matched=P()),
+        check_vma=False)
+    return jax.jit(sharded)

@@ -1,0 +1,9 @@
+from .constraints import (  # noqa: F401
+    ConstraintContext,
+    build_constraint_mask,
+    validate_group_placement,
+)
+from .matcher import MatchCycleResult, Matcher  # noqa: F401
+from .ranker import Ranker, build_user_tasks  # noqa: F401
+from .rebalancer import PreemptionDecision, Rebalancer  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401

@@ -1,0 +1,179 @@
+"""Constraint compiler: the reference's constraint zoo lowered to a bool[J, H]
+mask consumed by the match kernels.
+
+The reference evaluates constraints as host predicates one task at a time
+inside Fenzo (reference: scheduler/src/cook/scheduler/constraints.clj —
+JobConstraint protocol :51, registry :459, fenzoized :466).  Here the common
+constraints are *vectorized* over the jobs x hosts plane up front, which is
+what lets the matcher stay a single jitted kernel (SURVEY.md section 7
+"constraint extensibility on device"); anything truly dynamic (within-batch
+group placement) is validated host-side post-match.
+
+Implemented (reference locations):
+  novel-host            constraints.clj:68   — never retry on a host that failed this job
+  gpu-host              constraints.clj:122  — gpu jobs only on matching-gpu hosts, and
+                                               non-gpu jobs never on gpu hosts
+  disk-host             constraints.clj:164  — disk-type affinity
+  user attribute EQUALS constraints.clj:356
+  max-tasks-per-host    constraints.clj:433
+  rebalancer-reservation constraints.clj:242 — reserved hosts only for their job
+  checkpoint-locality   constraints.clj:218  — restarted checkpointed jobs pinned
+                                               to their previous location attribute
+  group unique-host / attribute-equals (running cotasks)
+                        constraints.clj:586-676
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..cluster.base import Offer
+from ..state.schema import GroupPlacementType, Job
+
+GPU_MODEL_LABEL = "gpu-model"
+DISK_TYPE_LABEL = "disk-type"
+LOCATION_ATTRIBUTE = "location"
+
+
+@dataclass
+class ConstraintContext:
+    """Host-side facts the compiler needs beyond the job/offer lists."""
+
+    # job uuid -> hostnames where a previous instance of this job failed
+    failed_hosts: Dict[str, Set[str]] = field(default_factory=dict)
+    # job uuid -> reserved hostname (rebalancer reservations,
+    # rebalancer.clj:419-432, consumed at scheduler.clj:645-653)
+    reserved_hosts: Dict[str, str] = field(default_factory=dict)
+    # group uuid -> hostnames of *running* cotasks
+    group_running_hosts: Dict[str, Set[str]] = field(default_factory=dict)
+    # group uuid -> attribute value of running cotasks (attribute-equals)
+    group_attr_values: Dict[str, str] = field(default_factory=dict)
+    # group uuid -> Group entity (for placement type/attribute)
+    groups: Dict[str, object] = field(default_factory=dict)
+    # job uuid -> checkpoint location attribute value to pin to
+    checkpoint_locations: Dict[str, str] = field(default_factory=dict)
+    max_tasks_per_host: Optional[int] = None
+
+
+def build_constraint_mask(jobs: List[Job], offers: List[Offer],
+                          ctx: ConstraintContext) -> np.ndarray:
+    """Compile all active constraints into one bool[J, H] feasibility mask."""
+    J, H = len(jobs), len(offers)
+    mask = np.ones((J, H), dtype=bool)
+    if J == 0 or H == 0:
+        return mask
+
+    host_gpu = np.array([o.capacity.gpus > 0 for o in offers], dtype=bool)
+    host_gpu_model = [o.gpu_model for o in offers]
+    host_disk_type = [o.disk_type for o in offers]
+    host_names = [o.hostname for o in offers]
+    host_tasks = np.array([o.task_count for o in offers], dtype=np.int32)
+
+    # hosts reserved for some job are off-limits to every other job
+    reserved_by = {h: u for u, h in ctx.reserved_hosts.items()}
+
+    if ctx.max_tasks_per_host is not None:
+        mask &= (host_tasks < ctx.max_tasks_per_host)[None, :]
+
+    for j, job in enumerate(jobs):
+        row = mask[j]
+
+        # novel-host
+        failed = ctx.failed_hosts.get(job.uuid)
+        if failed:
+            for h, name in enumerate(host_names):
+                if name in failed:
+                    row[h] = False
+
+        # gpu-host: bidirectional isolation
+        if job.resources.gpus > 0:
+            row &= host_gpu
+            wanted_model = job.labels.get(GPU_MODEL_LABEL)
+            if wanted_model:
+                row &= np.array([m == wanted_model for m in host_gpu_model])
+        else:
+            row &= ~host_gpu
+
+        # disk-type affinity
+        wanted_disk = job.labels.get(DISK_TYPE_LABEL)
+        if wanted_disk:
+            row &= np.array([d == wanted_disk for d in host_disk_type])
+
+        # user-specified attribute constraints (EQUALS)
+        for c in job.constraints:
+            if c.operator.upper() == "EQUALS":
+                row &= np.array([o.attributes.get(c.attribute) == c.pattern
+                                 for o in offers])
+
+        # checkpoint locality: pin to prior location
+        loc = ctx.checkpoint_locations.get(job.uuid)
+        if loc:
+            row &= np.array([o.attributes.get(LOCATION_ATTRIBUTE) == loc
+                             for o in offers])
+
+        # rebalancer reservations
+        for h, name in enumerate(host_names):
+            owner = reserved_by.get(name)
+            if owner is not None and owner != job.uuid:
+                row[h] = False
+
+        # group placement vs RUNNING cotasks (within-batch handled post-match)
+        if job.group is not None:
+            group = ctx.groups.get(job.group)
+            ptype = getattr(group, "placement_type", None)
+            if ptype is GroupPlacementType.UNIQUE:
+                running = ctx.group_running_hosts.get(job.group, set())
+                for h, name in enumerate(host_names):
+                    if name in running:
+                        row[h] = False
+            elif ptype is GroupPlacementType.ATTRIBUTE_EQUALS:
+                attr = getattr(group, "placement_attribute", None)
+                want = ctx.group_attr_values.get(job.group)
+                if attr and want is not None:
+                    row &= np.array([o.attributes.get(attr) == want
+                                     for o in offers])
+    return mask
+
+
+def validate_group_placement(jobs: List[Job], assignments: np.ndarray,
+                             offers: List[Offer],
+                             ctx: ConstraintContext) -> np.ndarray:
+    """Post-match within-batch group check: for UNIQUE groups, only the first
+    (highest-ranked) cotask per host keeps its slot this cycle; for
+    ATTRIBUTE_EQUALS with no running cotask yet, the first placed cotask
+    fixes the attribute value for the rest of the batch.
+
+    Returns the assignment vector with violators reset to -1 (they retry next
+    cycle, like a Fenzo failure would).
+    """
+    out = assignments.copy()
+    group_hosts: Dict[str, Set[str]] = {
+        g: set(hs) for g, hs in ctx.group_running_hosts.items()}
+    group_attr: Dict[str, str] = dict(ctx.group_attr_values)
+    for j, job in enumerate(jobs):
+        h = int(out[j])
+        if h < 0 or job.group is None:
+            continue
+        group = ctx.groups.get(job.group)
+        ptype = getattr(group, "placement_type", None)
+        hostname = offers[h].hostname
+        if ptype is GroupPlacementType.UNIQUE:
+            used = group_hosts.setdefault(job.group, set())
+            if hostname in used:
+                out[j] = -1
+            else:
+                used.add(hostname)
+        elif ptype is GroupPlacementType.ATTRIBUTE_EQUALS:
+            attr = getattr(group, "placement_attribute", None)
+            if attr:
+                val = offers[h].attributes.get(attr)
+                fixed = group_attr.get(job.group)
+                if fixed is None:
+                    if val is not None:
+                        group_attr[job.group] = val
+                elif val != fixed:
+                    out[j] = -1
+    return out

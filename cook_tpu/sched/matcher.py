@@ -1,0 +1,233 @@
+"""Match cycle: ranked queue x cluster offers -> launched tasks.
+
+Host half of the reference's match path (reference: handle-fenzo-pool
+scheduler.clj:1554, handle-resource-offers! :1339, launch-matched-tasks!
+:1028) around the batched match kernels:
+
+  considerable selection (quota filter + cap)  -> constraint mask compile
+  -> kernel dispatch (greedy / auction / cpu)  -> within-batch group check
+  -> transactional launch guard                -> cluster launch under
+                                                  kill-lock read side
+
+Head-of-queue fairness backoff is preserved host-side
+(scheduler.clj:1613-1651): while the head of the queue can't match, the
+number of considerable jobs shrinks so the cheap tail can't starve it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..cluster.base import ComputeCluster, LaunchSpec, Offer
+from ..config import Config, MatcherConfig
+from ..ops import host_prep, reference_impl
+from ..state.schema import InstanceStatus, Job, new_uuid
+from ..state.store import AbortTransaction, Store
+from .constraints import (
+    ConstraintContext,
+    build_constraint_mask,
+    validate_group_placement,
+)
+
+F32 = np.float32
+
+
+@dataclass
+class MatchCycleResult:
+    considered: int = 0
+    matched: List[Tuple[Job, Offer]] = field(default_factory=list)
+    launched_task_ids: List[str] = field(default_factory=list)
+    unmatched: List[Job] = field(default_factory=list)
+    head_matched: bool = True
+    launch_failures: List[Tuple[str, str]] = field(default_factory=list)
+
+
+class _BackoffState:
+    """Per-pool num-considerable backoff (scheduler.clj:1613-1651)."""
+
+    def __init__(self, cap: int):
+        self.num_considerable = cap
+        self.floor_iterations = 0
+
+    def update(self, mc: MatcherConfig, head_matched: bool) -> None:
+        if head_matched:
+            self.num_considerable = mc.max_jobs_considered
+            self.floor_iterations = 0
+        else:
+            shrunk = int(self.num_considerable * mc.scaleback)
+            self.num_considerable = max(1, shrunk)
+            if self.num_considerable == 1:
+                self.floor_iterations += 1
+                if self.floor_iterations >= mc.floor_iterations_before_reset:
+                    self.num_considerable = mc.max_jobs_considered
+                    self.floor_iterations = 0
+
+
+class Matcher:
+    def __init__(self, store: Store, config: Config):
+        self.store = store
+        self.config = config
+        self._backoff: Dict[str, _BackoffState] = {}
+
+    # ------------------------------------------------------------ selection
+    def considerable_jobs(self, pool_name: str, ranked: List[Job],
+                          limit: int) -> List[Job]:
+        """Quota-filtered prefix of the ranked queue (reference:
+        pending-jobs->considerable-jobs scheduler.clj:729: usage of running
+        jobs + jobs earlier in the queue must stay below the user's quota;
+        the accumulator includes skipped jobs, tools.clj:899-915)."""
+        if limit <= 0:
+            return []
+        usage: Dict[str, np.ndarray] = {}
+        for job, _inst in self.store.running_instances(pool_name):
+            u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
+            u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
+        out: List[Job] = []
+        for job in ranked:
+            quota = self.store.get_quota(job.user, pool_name)
+            qvec = np.array([quota.get("cpus", np.inf), quota.get("mem", np.inf),
+                             quota.get("gpus", np.inf), quota.get("count", np.inf)],
+                            dtype=F32)
+            u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
+            u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
+            if np.all(u <= qvec):
+                out.append(job)
+                if len(out) >= limit:
+                    break
+        return out
+
+    # -------------------------------------------------------------- context
+    def _constraint_context(self, jobs: List[Job],
+                            reserved_hosts: Optional[Dict[str, str]] = None
+                            ) -> ConstraintContext:
+        ctx = ConstraintContext(
+            reserved_hosts=dict(reserved_hosts or {}),
+            max_tasks_per_host=self.config.max_tasks_per_host)
+        for job in jobs:
+            full = self.store.job(job.uuid)
+            if full is None:
+                continue
+            failed = set()
+            for tid in full.instances:
+                inst = self.store.instance(tid)
+                if inst is not None and inst.status is InstanceStatus.FAILED:
+                    failed.add(inst.hostname)
+            if failed:
+                ctx.failed_hosts[job.uuid] = failed
+            if job.group:
+                group = self.store.group(job.group)
+                if group is not None and job.group not in ctx.groups:
+                    ctx.groups[job.group] = group
+                    hosts = set()
+                    for member_uuid in group.jobs:
+                        member = self.store.job(member_uuid)
+                        if member is None:
+                            continue
+                        for tid in member.instances:
+                            inst = self.store.instance(tid)
+                            if inst is not None and inst.status in (
+                                    InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                                hosts.add(inst.hostname)
+                    if hosts:
+                        ctx.group_running_hosts[job.group] = hosts
+        return ctx
+
+    # ----------------------------------------------------------------- match
+    def match_pool(self, pool_name: str, ranked: List[Job],
+                   offers: List[Offer],
+                   clusters: Dict[str, ComputeCluster],
+                   reserved_hosts: Optional[Dict[str, str]] = None
+                   ) -> MatchCycleResult:
+        mc = self.config.matcher_for_pool(pool_name)
+        backoff = self._backoff.setdefault(
+            pool_name, _BackoffState(mc.max_jobs_considered))
+        result = MatchCycleResult()
+        considerable = self.considerable_jobs(
+            pool_name, ranked, min(backoff.num_considerable,
+                                   mc.max_jobs_considered))
+        result.considered = len(considerable)
+        if not considerable or not offers:
+            result.unmatched = considerable
+            # an empty cycle leaves the backoff state untouched
+            return result
+
+        ctx = self._constraint_context(considerable, reserved_hosts)
+        cmask = build_constraint_mask(considerable, offers, ctx)
+        job_res = [[j.resources.cpus, j.resources.mem, j.resources.gpus,
+                    j.resources.disk] for j in considerable]
+        avail = [[o.available.cpus, o.available.mem, o.available.gpus,
+                  o.available.disk] for o in offers]
+        cap = [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
+                o.capacity.disk] for o in offers]
+
+        assign = self._dispatch(mc, job_res, cmask, avail, cap)
+        assign = validate_group_placement(considerable, assign, offers, ctx)
+
+        # head-of-queue backoff bookkeeping
+        result.head_matched = bool(assign[0] >= 0)
+        backoff.update(mc, result.head_matched)
+
+        for j, job in enumerate(considerable):
+            h = int(assign[j])
+            if h < 0:
+                result.unmatched.append(job)
+            else:
+                result.matched.append((job, offers[h]))
+        self._launch(pool_name, result, clusters)
+        return result
+
+    def _dispatch(self, mc: MatcherConfig, job_res, cmask, avail, cap
+                  ) -> np.ndarray:
+        if mc.backend == "cpu":
+            return reference_impl.greedy_match(
+                np.asarray(job_res, dtype=F32), cmask,
+                np.asarray(avail, dtype=F32), np.asarray(cap, dtype=F32))
+        import jax.numpy as jnp
+        from ..ops import MatchInputs, auction_match_kernel, greedy_match_kernel
+        arrays = host_prep.pack_match_inputs(job_res, cmask, avail, cap)
+        inp = MatchInputs(
+            job_res=jnp.asarray(arrays["job_res"]),
+            constraint_mask=jnp.asarray(arrays["constraint_mask"]),
+            avail=jnp.asarray(arrays["avail"]),
+            capacity=jnp.asarray(arrays["capacity"]),
+            valid=jnp.asarray(arrays["valid"]))
+        if mc.backend == "tpu-auction":
+            assign, _ = auction_match_kernel(
+                inp, num_prefs=mc.auction_num_prefs,
+                num_rounds=mc.auction_num_rounds)
+        else:
+            assign, _ = greedy_match_kernel(inp)
+        return np.asarray(assign)[:arrays["num_jobs"]]
+
+    # ---------------------------------------------------------------- launch
+    def _launch(self, pool_name: str, result: MatchCycleResult,
+                clusters: Dict[str, ComputeCluster]) -> None:
+        """Transactional guard then cluster launch (reference:
+        launch-matched-tasks! scheduler.clj:1028: the store transaction
+        failing MUST block the backend launch)."""
+        by_cluster: Dict[str, List[LaunchSpec]] = {}
+        for job, offer in result.matched:
+            task_id = new_uuid()
+            try:
+                self.store.launch_instance(
+                    job.uuid, task_id, offer.hostname,
+                    slave_id=offer.slave_id, compute_cluster=offer.cluster)
+            except AbortTransaction as e:
+                result.launch_failures.append((job.uuid, e.reason))
+                continue
+            by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
+                task_id=task_id, job_uuid=job.uuid, hostname=offer.hostname,
+                slave_id=offer.slave_id, resources=job.resources))
+            result.launched_task_ids.append(task_id)
+        for cluster_name, specs in by_cluster.items():
+            cluster = clusters.get(cluster_name)
+            if cluster is None:
+                continue
+            cluster.kill_lock.acquire_read()
+            try:
+                cluster.launch_tasks(pool_name, specs)
+            finally:
+                cluster.kill_lock.release_read()

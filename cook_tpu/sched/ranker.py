@@ -1,0 +1,145 @@
+"""Rank cycle: store entities -> DRU-ordered pending queue per pool.
+
+The host half of the reference's rank path (reference: rank-jobs
+scheduler.clj:2262, sort-jobs-by-dru-pool :2159, sort-jobs-by-dru-helper
+:2073): gather running+pending per user in the user's task order, hand the
+tensors to the rank kernel (or the CPU fallback), map the ranked order back
+to Job entities, then apply the pool/quota-group global caps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config, PoolQuota
+from ..ops import host_prep, reference_impl
+from ..state.schema import DruMode, Instance, Job, job_usage
+from ..state.store import Store
+
+F32 = np.float32
+_PENDING_START = float(2**62)  # stands in for "no start time yet" (MAX)
+
+
+def _job_feature_key(job: Job, inst: Optional[Instance]) -> Tuple:
+    """Per-user task order (reference: tools.clj task->feature-vector
+    :614-632): running-before-pending via start-time, then priority desc,
+    then stable ids."""
+    start = inst.start_time_ms if inst is not None else _PENDING_START
+    return (-job.priority, start, job.submit_time_ms, job.uuid)
+
+
+def build_user_tasks(pending: List[Job],
+                     running: List[Tuple[Job, Instance]]
+                     ) -> Tuple[List[reference_impl.UserTasks], Dict[int, Job]]:
+    """Group tasks by user in comparator order; ids index into id2job."""
+    per_user: Dict[str, List[Tuple[Tuple, Job, bool]]] = {}
+    for job, inst in running:
+        per_user.setdefault(job.user, []).append(
+            (_job_feature_key(job, inst), job, False))
+    for job in pending:
+        per_user.setdefault(job.user, []).append(
+            (_job_feature_key(job, None), job, True))
+    uts: List[reference_impl.UserTasks] = []
+    id2job: Dict[int, Job] = {}
+    tid = 0
+    for user, entries in per_user.items():
+        entries.sort(key=lambda e: e[0])
+        ids, rows, pend = [], [], []
+        for _key, job, is_pending in entries:
+            ids.append(tid)
+            id2job[tid] = job
+            rows.append([job.resources.cpus, job.resources.mem,
+                         job.resources.gpus, 1.0])
+            pend.append(is_pending)
+            tid += 1
+        uts.append(reference_impl.UserTasks(
+            user, ids, np.array(rows, dtype=F32), pend))
+    return uts, id2job
+
+
+def _quota_vec(q: Dict[str, float]) -> np.ndarray:
+    return np.array([q.get("cpus", np.inf), q.get("mem", np.inf),
+                     q.get("gpus", np.inf), q.get("count", np.inf)], dtype=F32)
+
+
+def _pool_quota_vec(q: PoolQuota) -> np.ndarray:
+    return np.array([q.cpus, q.mem, q.gpus, q.count], dtype=F32)
+
+
+class Ranker:
+    """Per-pool DRU ranking with kernel/fallback dispatch."""
+
+    def __init__(self, store: Store, config: Config, backend: str = "tpu"):
+        self.store = store
+        self.config = config
+        self.backend = backend
+
+    def rank_pool(self, pool_name: str,
+                  dru_mode: DruMode = DruMode.DEFAULT) -> List[Job]:
+        pending = self.store.pending_jobs(pool_name)
+        running = self.store.running_instances(pool_name)
+        if not pending:
+            return []
+        uts, id2job = build_user_tasks(pending, running)
+        shares = {ut.user: tuple(
+            self.store.get_share(ut.user, pool_name).get(d, np.inf)
+            for d in ("cpus", "mem", "gpus")) for ut in uts}
+        quotas = {ut.user: _quota_vec(self.store.get_quota(ut.user, pool_name))
+                  for ut in uts}
+        gpu_mode = dru_mode is DruMode.GPU
+
+        if self.backend == "cpu":
+            ranked_ids = [tid for tid, _dru in reference_impl.rank_by_dru(
+                uts, shares, quotas, gpu_mode=gpu_mode,
+                max_over_quota_jobs=self.config.max_over_quota_jobs)]
+        else:
+            import jax.numpy as jnp
+            from ..ops import rank_kernel
+            from ..ops.dru import RankInputs
+            arrays, task_ids = host_prep.pack_rank_inputs(uts, shares, quotas)
+            res = rank_kernel(
+                RankInputs(**{k: jnp.asarray(v) for k, v in arrays.items()}),
+                gpu_mode=gpu_mode,
+                max_over_quota_jobs=self.config.max_over_quota_jobs)
+            n = int(res.num_ranked)
+            ranked_ids = [task_ids[i] for i in np.asarray(res.order)[:n]]
+
+        ranked = [id2job[t] for t in ranked_ids]
+        return self._apply_pool_quota(pool_name, ranked, running)
+
+    # -- pool + quota-group caps (reference: filter-based-on-quota
+    #    scheduler.clj:2134-2157) ------------------------------------------
+    def _apply_pool_quota(self, pool_name: str, ranked: List[Job],
+                          running: List[Tuple[Job, Instance]]) -> List[Job]:
+        cfg = self.config
+        quota = cfg.pool_quota(pool_name)
+        group_name = cfg.quota_groups.get(pool_name)
+        group_quota = cfg.quota_group_quotas.get(group_name) if group_name else None
+        if quota is None and group_quota is None:
+            return ranked
+
+        job_use = np.array(
+            [[j.resources.cpus, j.resources.mem, j.resources.gpus, 1.0]
+             for j in ranked], dtype=F32)
+        base = np.zeros(4, dtype=F32)
+        for job, _inst in running:
+            base += [job.resources.cpus, job.resources.mem,
+                     job.resources.gpus, 1.0]
+        keep = np.ones(len(ranked), dtype=bool)
+        if quota is not None:
+            keep &= reference_impl.filter_pool_quota(
+                job_use, base, _pool_quota_vec(quota))
+        if group_quota is not None:
+            # aggregate usage across the group's member pools
+            group_base = np.zeros(4, dtype=F32)
+            for member, g in cfg.quota_groups.items():
+                if g != group_name:
+                    continue
+                for job, _inst in self.store.running_instances(member):
+                    group_base += [job.resources.cpus, job.resources.mem,
+                                   job.resources.gpus, 1.0]
+            keep &= reference_impl.filter_pool_quota(
+                job_use, group_base, _pool_quota_vec(group_quota))
+        return [j for j, k in zip(ranked, keep) if k]

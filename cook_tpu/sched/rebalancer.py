@@ -1,0 +1,307 @@
+"""Rebalancer cycle: periodic preemption to restore fair share.
+
+Host half of the reference's rebalancer (reference: rebalancer.clj
+rebalance :434, init-state :222-266, next-state :270-309): build the DRU
+state of all running tasks, walk the top pending jobs, and for each ask the
+preemption kernel for the host whose minimum-DRU victim set is maximal;
+apply decisions by transacting preempted-by-rebalancer failures (mea-culpa)
+and kill the tasks under the cluster write lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..cluster.base import ComputeCluster, Offer
+from ..config import Config
+from ..ops import host_prep
+from ..state.schema import (
+    DruMode,
+    Instance,
+    InstanceStatus,
+    Job,
+    Reasons,
+    Resources,
+    job_usage,
+    add_usage,
+    below_quota,
+)
+from ..state.store import Store
+from .constraints import ConstraintContext, build_constraint_mask
+from .ranker import _job_feature_key
+
+F32 = np.float32
+
+
+@dataclass
+class PreemptionDecision:
+    job_uuid: str
+    hostname: str
+    victim_task_ids: List[str]
+    dru: float
+    spare_only: bool = False
+
+
+@dataclass
+class _Task:
+    task_id: str
+    job: Job
+    inst: Instance
+    dru: float = 0.0
+
+
+class _State:
+    """Mutable cycle state (reference: rebalancer State record)."""
+
+    def __init__(self, store: Store, pool_name: str, dru_mode: DruMode,
+                 running: List[Tuple[Job, Instance]],
+                 spare: Dict[str, Resources]):
+        self.pool_name = pool_name
+        self.gpu_mode = dru_mode is DruMode.GPU
+        self.store = store
+        # user -> tasks in comparator order (running only)
+        self.user_tasks: Dict[str, List[_Task]] = {}
+        for job, inst in running:
+            self.user_tasks.setdefault(job.user, []).append(
+                _Task(inst.task_id, job, inst))
+        for user, tasks in self.user_tasks.items():
+            tasks.sort(key=lambda t: _job_feature_key(t.job, t.inst))
+        self.shares: Dict[str, Tuple[float, float, float]] = {}
+        for user in self.user_tasks:
+            s = store.get_share(user, pool_name)
+            self.shares[user] = (s["cpus"], s["mem"], s["gpus"])
+        for user in self.user_tasks:
+            self._recompute_user(user)
+        self.spare: Dict[str, Resources] = dict(spare)
+        self.preempted_ids: set = set()
+
+    def _share(self, user: str) -> Tuple[float, float, float]:
+        if user not in self.shares:
+            s = self.store.get_share(user, self.pool_name)
+            self.shares[user] = (s["cpus"], s["mem"], s["gpus"])
+        return self.shares[user]
+
+    def _recompute_user(self, user: str) -> None:
+        """Per-user cumulative DRU (reference: dru.clj:50-80)."""
+        share = np.asarray(self._share(user), dtype=F32)
+        cum = np.zeros(3, dtype=F32)
+        for t in self.user_tasks.get(user, []):
+            cum = cum + np.array([t.job.resources.cpus, t.job.resources.mem,
+                                  t.job.resources.gpus], dtype=F32)
+            if self.gpu_mode:
+                t.dru = float(cum[2] / share[2])
+            else:
+                t.dru = float(max(cum[1] / share[1], cum[0] / share[0]))
+
+    def pending_job_dru(self, job: Job) -> float:
+        """Nearest-task dru + the job's own increment (reference:
+        compute-pending-default-job-dru rebalancer.clj:182-209)."""
+        user = job.user
+        tasks = self.user_tasks.get(user, [])
+        key = _job_feature_key(job, None)
+        keys = [_job_feature_key(t.job, t.inst) for t in tasks]
+        i = bisect.bisect_right(keys, key)
+        nearest = tasks[i - 1].dru if i > 0 else 0.0
+        share = self._share(user)
+        if self.gpu_mode:
+            return nearest + job.resources.gpus / share[2]
+        return max(nearest + job.resources.mem / share[1],
+                   nearest + job.resources.cpus / share[0])
+
+    def job_below_quota(self, job: Job) -> bool:
+        """Would the job fit its user's quota if launched (rebalancer.clj
+        job-below-quota :212-221)."""
+        usage = job_usage(job)
+        for t in self.user_tasks.get(job.user, []):
+            usage = add_usage(usage, job_usage(t.job))
+        quota = self.store.get_quota(job.user, self.pool_name)
+        return below_quota(quota, usage)
+
+    def all_tasks(self) -> List[_Task]:
+        return [t for tasks in self.user_tasks.values() for t in tasks]
+
+    def apply_decision(self, job: Job, hostname: str,
+                       victims: List[_Task]) -> None:
+        """next-state (rebalancer.clj:270-309): remove victims, add the
+        pending job as a virtual running task, update spare, rescore."""
+        changed = {job.user}
+        for v in victims:
+            changed.add(v.job.user)
+            self.user_tasks[v.job.user].remove(v)
+            self.preempted_ids.add(v.task_id)
+        virtual = _Task(
+            task_id=f"virtual-{job.uuid}", job=job,
+            inst=Instance(task_id=f"virtual-{job.uuid}", job_uuid=job.uuid,
+                          hostname=hostname,
+                          status=InstanceStatus.RUNNING,
+                          start_time_ms=2**62))
+        lst = self.user_tasks.setdefault(job.user, [])
+        lst.append(virtual)
+        lst.sort(key=lambda t: _job_feature_key(t.job, t.inst))
+        for user in changed:
+            self._recompute_user(user)
+        freed = self.spare.get(hostname, Resources())
+        for v in victims:
+            freed = freed + v.job.resources
+        self.spare[hostname] = freed - job.resources
+
+
+class Rebalancer:
+    def __init__(self, store: Store, config: Config, backend: str = "tpu"):
+        self.store = store
+        self.config = config
+        self.backend = backend
+
+    def rebalance_pool(self, pool_name: str, dru_mode: DruMode,
+                       pending_ranked: List[Job],
+                       clusters: Dict[str, ComputeCluster]
+                       ) -> List[PreemptionDecision]:
+        params = self.config.rebalancer
+        if not pending_ranked:
+            return []
+        running = self.store.running_instances(pool_name)
+        spare: Dict[str, Resources] = {}
+        offers_by_host: Dict[str, Offer] = {}
+        for cluster in clusters.values():
+            if not cluster.accepts_pool(pool_name):
+                continue
+            # hosts() covers fully-utilized hosts with their true
+            # capacity/attributes so constraints evaluate correctly there
+            for offer in cluster.hosts(pool_name):
+                offers_by_host[offer.hostname] = offer
+            for offer in cluster.pending_offers(pool_name):
+                spare[offer.hostname] = offer.available
+                offers_by_host[offer.hostname] = offer
+        state = _State(self.store, pool_name, dru_mode, running, spare)
+
+        decisions: List[PreemptionDecision] = []
+        budget = params.max_preemption
+        for job in pending_ranked:
+            if budget <= 0:
+                break
+            decision = self._decide(state, job, params, offers_by_host)
+            if decision is None:
+                continue
+            victims = decision[1]
+            hostname = decision[0]
+            state.apply_decision(job, hostname, victims)
+            decisions.append(PreemptionDecision(
+                job_uuid=job.uuid, hostname=hostname,
+                victim_task_ids=[v.task_id for v in victims],
+                dru=decision[2], spare_only=not victims))
+            if victims:
+                budget -= 1
+        self._execute(decisions, clusters)
+        return [d for d in decisions if d.victim_task_ids]
+
+    # ----------------------------------------------------------------- core
+    def _decide(self, state: _State, job: Job, params,
+                offers_by_host: Dict[str, Offer]
+                ) -> Optional[Tuple[str, List["_Task"], float]]:
+        pending_dru = state.pending_job_dru(job)
+        job_ok_quota = state.job_below_quota(job)
+
+        tasks = state.all_tasks()
+        # only hosts with a backend inventory entry are preemption targets:
+        # a host known solely from a running task has no attribute/capacity
+        # facts, so constraint evaluation there would be guesswork
+        hostnames = sorted(set(offers_by_host.keys()))
+        if not hostnames:
+            return None
+        host_index = {h: i for i, h in enumerate(hostnames)}
+
+        # eligibility (rebalancer.clj:340-348)
+        def ok(t: _Task) -> bool:
+            if t.task_id in state.preempted_ids or t.task_id.startswith("virtual-"):
+                return False
+            if t.inst.hostname not in host_index:
+                return False  # no backend inventory for this host
+            if not (job_ok_quota or t.job.user == job.user):
+                return False
+            if t.dru < params.safe_dru_threshold:
+                return False
+            return (t.dru - pending_dru) > params.min_dru_diff
+
+        # host constraint check with the match-side compiler
+        offers = [offers_by_host[h] for h in hostnames]
+        ctx = ConstraintContext(
+            max_tasks_per_host=None)  # preemption frees slots; skip count cap
+        host_ok = build_constraint_mask([job], offers, ctx)[0]
+
+        order = sorted(range(len(tasks)),
+                       key=lambda i: (host_index.get(tasks[i].inst.hostname, 0),
+                                      -tasks[i].dru, i))
+        demand = np.array([job.resources.cpus, job.resources.mem,
+                           job.resources.gpus, 0.0], dtype=F32)
+        spare_arr = np.zeros((len(hostnames), 4), dtype=F32)
+        for h, name in enumerate(hostnames):
+            s = state.spare.get(name, Resources())
+            spare_arr[h] = [s.cpus, s.mem, s.gpus, 0.0]
+
+        # gpu feasibility only matters when requested; padding col 3 unused
+        task_dru = np.array([tasks[i].dru for i in order], dtype=F32)
+        task_res = np.array(
+            [[tasks[i].job.resources.cpus, tasks[i].job.resources.mem,
+              tasks[i].job.resources.gpus, 0.0] for i in order], dtype=F32) \
+            if order else np.zeros((0, 4), dtype=F32)
+        task_host = np.array(
+            [host_index.get(tasks[i].inst.hostname, 0) for i in order],
+            dtype=np.int32)
+        eligible = np.array([ok(tasks[i]) for i in order], dtype=bool)
+
+        if self.backend == "cpu" or len(order) == 0:
+            from ..ops.reference_impl import preemption_decision
+            res = preemption_decision(task_dru, task_res, task_host, eligible,
+                                      spare_arr, host_ok, demand)
+            if res is None:
+                return None
+            host, victim_pos, dru = res
+            victims = [tasks[order[p]] for p in victim_pos]
+            return hostnames[host], victims, float(dru)
+
+        import jax.numpy as jnp
+        from ..ops.padding import bucket, pad_to
+        from ..ops.rebalance import RebalanceInputs, preemption_kernel
+        T = bucket(len(order))
+        host_start = np.ones(len(order), dtype=bool)
+        host_start[1:] = task_host[1:] != task_host[:-1]
+        inp = RebalanceInputs(
+            task_dru=jnp.asarray(pad_to(task_dru, T)),
+            task_res=jnp.asarray(pad_to(task_res, T)),
+            task_host=jnp.asarray(pad_to(task_host, T)),
+            host_start=jnp.asarray(pad_to(host_start, T, fill=True)),
+            eligible=jnp.asarray(pad_to(eligible, T, fill=False)),
+            spare=jnp.asarray(spare_arr),
+            host_ok=jnp.asarray(host_ok),
+            demand=jnp.asarray(demand))
+        out = preemption_kernel(inp)
+        if not bool(out.found):
+            return None
+        host = int(out.host)
+        if bool(out.spare_only):
+            return hostnames[host], [], float("inf")
+        mask = np.asarray(out.victim_mask)[:len(order)]
+        victims = [tasks[order[p]] for p in np.nonzero(mask)[0]]
+        return hostnames[host], victims, float(out.decision_dru)
+
+    # -------------------------------------------------------------- execute
+    def _execute(self, decisions: List[PreemptionDecision],
+                 clusters: Dict[str, ComputeCluster]) -> None:
+        """Transact preemptions then kill under the write lock (reference:
+        rebalancer.clj:482-533)."""
+        for d in decisions:
+            for tid in d.victim_task_ids:
+                inst = self.store.instance(tid)
+                if inst is None:
+                    continue
+                self.store.update_instance_status(
+                    tid, InstanceStatus.FAILED,
+                    reason_code=Reasons.PREEMPTED_BY_REBALANCER.code,
+                    preempted=True)
+                cluster = clusters.get(inst.compute_cluster)
+                if cluster is not None:
+                    cluster.safe_kill_task(tid)

@@ -1,0 +1,317 @@
+"""Scheduler driver: wires store, clusters, ranker, matcher, rebalancer.
+
+The equivalent of the reference's leader process (reference:
+create-datomic-scheduler scheduler.clj:2473-2522 + the cycle triggers
+mesos.clj:89-110).  Cycles are explicit ``step_*`` methods so tests and the
+faster-than-real-time simulator drive them deterministically; ``run()``
+drives them on wall-clock threads like the reference's chime channels.
+
+Responsibilities wired here:
+ - status updates: cluster backends -> store state machines
+ - tx-feed side effects: job completed -> kill its live instances
+   (reference: monitor-tx-report-queue scheduler.clj:378-448)
+ - per-pool rank queue (reference: pool-name->pending-jobs-atom)
+ - direct-mode pools: backpressure submission without matching
+   (reference: handle-kubernetes-scheduler-pool scheduler.clj:1747)
+ - reapers: lingering-task killer (max-runtime, scheduler.clj:1888-1953)
+   and straggler handler (scheduler.clj:1955-1986, group.clj)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..cluster.base import ComputeCluster, LaunchSpec
+from ..config import Config
+from ..state.schema import (
+    DruMode,
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+    Reasons,
+    SchedulerKind,
+    new_uuid,
+    now_ms,
+)
+from ..state.store import AbortTransaction, Store
+from .matcher import MatchCycleResult, Matcher
+from .ranker import Ranker
+from .rebalancer import Rebalancer
+
+
+class Scheduler:
+    def __init__(self, store: Store, config: Optional[Config] = None,
+                 clusters: Optional[List[ComputeCluster]] = None,
+                 rank_backend: str = "tpu"):
+        self.store = store
+        self.config = config or Config()
+        self.clusters: Dict[str, ComputeCluster] = {}
+        self.ranker = Ranker(store, self.config, backend=rank_backend)
+        self.matcher = Matcher(store, self.config)
+        self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
+        # pool -> ranked pending jobs, refreshed by the rank cycle
+        self.pending_queues: Dict[str, List[Job]] = {}
+        # job uuid -> reserved hostname from the rebalancer
+        self.reserved_hosts: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # Side-effect worker: cluster kills requested from a thread that
+        # already holds that cluster's kill-lock read side (e.g. a tx-event
+        # delivered during a launch) must run elsewhere or they self-deadlock.
+        self._side_effects: "queue.Queue" = queue.Queue()
+        self._side_effect_thread: Optional[threading.Thread] = None
+        store.subscribe(self._on_tx_events)
+        for cluster in clusters or []:
+            self.add_cluster(cluster)
+        if not store.pools():
+            store.put_pool(Pool(name=self.config.default_pool))
+
+    # ---------------------------------------------------------------- wiring
+    def add_cluster(self, cluster: ComputeCluster) -> None:
+        cluster.initialize(self._on_status_update)
+        self.clusters[cluster.name] = cluster
+
+    def _on_status_update(self, task_id: str, status: InstanceStatus,
+                          reason_code: Optional[int], exit_code=None,
+                          preempted: bool = False, hostname=None) -> None:
+        self.store.update_instance_status(
+            task_id, status, reason_code=reason_code, exit_code=exit_code,
+            preempted=preempted, hostname=hostname)
+
+    def _on_tx_events(self, tx_id: int, events) -> None:
+        """Kill live instances of jobs that reached completed — covers user
+        kills and retroactive cleanup (scheduler.clj:405-447)."""
+        for e in events:
+            if e.kind == "job-state" and e.data.get("new") == "completed":
+                job = self.store.job(e.data["uuid"])
+                if job is None:
+                    continue
+                for tid in job.instances:
+                    inst = self.store.instance(tid)
+                    if inst is None or inst.status not in (
+                            InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                        continue
+                    # ensure the store converges even with a dead backend
+                    self.store.update_instance_status(
+                        tid, InstanceStatus.FAILED,
+                        reason_code=Reasons.KILLED_BY_USER.code)
+                    self._cluster_kill(inst.compute_cluster, tid)
+            if e.kind == "job-state" and e.data.get("new") in (
+                    "running", "completed"):
+                # consume rebalancer reservations once the job launches —
+                # or release them if the job dies while still waiting
+                self.reserved_hosts.pop(e.data.get("uuid"), None)
+
+    # ---------------------------------------------------------------- cycles
+    def step_rank(self) -> Dict[str, List[Job]]:
+        """Rank cycle across all schedulable pools (reference: rank-jobs +
+        reset! pool-name->pending-jobs-atom, scheduler.clj:2286-2296)."""
+        queues: Dict[str, List[Job]] = {}
+        for pool in self.store.pools():
+            if pool.state != "active":
+                continue
+            queues[pool.name] = self.ranker.rank_pool(pool.name, pool.dru_mode)
+        self.pending_queues = queues
+        return queues
+
+    def step_match(self, pool_name: Optional[str] = None
+                   ) -> Dict[str, MatchCycleResult]:
+        """Match cycle for one pool (or all), consuming the ranked queues."""
+        results: Dict[str, MatchCycleResult] = {}
+        pools = ([p for p in self.store.pools() if p.name == pool_name]
+                 if pool_name else self.store.pools())
+        for pool in pools:
+            if pool.state != "active":
+                continue
+            ranked = self.pending_queues.get(pool.name, [])
+            if pool.scheduler is SchedulerKind.DIRECT:
+                results[pool.name] = self._match_direct(pool.name, ranked)
+                continue
+            offers = []
+            for cluster in self.clusters.values():
+                if cluster.accepts_pool(pool.name):
+                    offers.extend(cluster.pending_offers(pool.name))
+            results[pool.name] = self.matcher.match_pool(
+                pool.name, ranked, offers, self.clusters,
+                reserved_hosts=self.reserved_hosts)
+        return results
+
+    def _match_direct(self, pool_name: str, ranked: List[Job]
+                      ) -> MatchCycleResult:
+        """Direct (Kenzo) mode: submit up to the backends' backpressure
+        capacity and let the backend place (scheduler.clj:1728-1771)."""
+        result = MatchCycleResult()
+        capacity = sum(c.max_launchable(pool_name)
+                       for c in self.clusters.values()
+                       if c.accepts_pool(pool_name))
+        considerable = self.matcher.considerable_jobs(
+            pool_name, ranked,
+            min(capacity, self.config.matcher_for_pool(pool_name).max_jobs_considered))
+        result.considered = len(considerable)
+        clusters = [c for c in self.clusters.values()
+                    if c.accepts_pool(pool_name)]
+        if not clusters:
+            result.unmatched = considerable
+            return result
+        i = 0
+        for job in considerable:
+            cluster = clusters[i % len(clusters)]
+            i += 1
+            task_id = new_uuid()
+            try:
+                self.store.launch_instance(job.uuid, task_id, hostname="",
+                                           compute_cluster=cluster.name)
+            except AbortTransaction as e:
+                result.launch_failures.append((job.uuid, e.reason))
+                continue
+            cluster.kill_lock.acquire_read()
+            try:
+                cluster.launch_tasks(pool_name, [LaunchSpec(
+                    task_id=task_id, job_uuid=job.uuid, hostname="",
+                    slave_id="", resources=job.resources)])
+            finally:
+                cluster.kill_lock.release_read()
+            result.launched_task_ids.append(task_id)
+        return result
+
+    def step_rebalance(self) -> Dict[str, list]:
+        """Preemption cycle (reference: start-rebalancer! rebalancer.clj:559)."""
+        if not self.config.rebalancer.enabled:
+            return {}
+        decisions: Dict[str, list] = {}
+        for pool in self.store.pools():
+            if pool.state != "active":
+                continue
+            pool_decisions = self.rebalancer.rebalance_pool(
+                pool.name, pool.dru_mode,
+                self.pending_queues.get(pool.name, []), self.clusters)
+            if pool_decisions:
+                decisions[pool.name] = pool_decisions
+                for d in pool_decisions:
+                    if len(d.victim_task_ids) > 1:
+                        self.reserved_hosts[d.job_uuid] = d.hostname
+        return decisions
+
+    # --------------------------------------------------------------- reapers
+    def step_reapers(self, current_ms: Optional[int] = None) -> List[str]:
+        """Kill tasks over their max runtime (lingering-task killer,
+        scheduler.clj:1888-1953) and straggler instances per group quantile
+        rule (scheduler.clj:1955-1986)."""
+        current = current_ms if current_ms is not None else now_ms()
+        killed: List[str] = []
+        for job, inst in self.store.running_instances():
+            if job.max_runtime_ms and inst.start_time_ms and \
+                    current - inst.start_time_ms > job.max_runtime_ms:
+                self._kill_instance(inst.task_id, Reasons.MAX_RUNTIME_EXCEEDED.code)
+                killed.append(inst.task_id)
+        killed.extend(self._reap_stragglers(current))
+        return killed
+
+    def _reap_stragglers(self, current_ms: int) -> List[str]:
+        killed: List[str] = []
+        groups: Dict[str, List] = {}
+        for job, inst in self.store.running_instances():
+            if job.group:
+                groups.setdefault(job.group, []).append((job, inst))
+        for group_uuid, members in groups.items():
+            group = self.store.group(group_uuid)
+            if group is None or group.straggler_quantile is None \
+                    or group.straggler_multiplier is None:
+                continue
+            runtimes = []
+            for member_uuid in group.jobs:
+                member = self.store.job(member_uuid)
+                if member is None:
+                    continue
+                for tid in member.instances:
+                    mi = self.store.instance(tid)
+                    if mi is not None and mi.status is InstanceStatus.SUCCESS \
+                            and mi.end_time_ms:
+                        runtimes.append(mi.end_time_ms - mi.start_time_ms)
+            if not runtimes:
+                continue
+            runtimes.sort()
+            q_idx = min(len(runtimes) - 1,
+                        int(group.straggler_quantile * len(runtimes)))
+            threshold = runtimes[q_idx] * group.straggler_multiplier
+            for job, inst in members:
+                if current_ms - inst.start_time_ms > threshold:
+                    self._kill_instance(inst.task_id, Reasons.STRAGGLER.code)
+                    killed.append(inst.task_id)
+        return killed
+
+    def _kill_instance(self, task_id: str, reason_code: int) -> None:
+        inst = self.store.instance(task_id)
+        if inst is None:
+            return
+        # transact the authoritative reason first so the backend's own kill
+        # status arrives stale and is dropped (single-writer discipline)
+        self.store.update_instance_status(task_id, InstanceStatus.FAILED,
+                                          reason_code=reason_code)
+        self._cluster_kill(inst.compute_cluster, task_id)
+
+    def _cluster_kill(self, cluster_name: str, task_id: str) -> None:
+        """Kill on the backend; defers to the side-effect worker when the
+        calling thread holds the cluster's kill-lock read side (a write
+        acquire there would self-deadlock)."""
+        cluster = self.clusters.get(cluster_name)
+        if cluster is None:
+            return
+        if cluster.kill_lock.holds_read():
+            self._ensure_side_effect_worker()
+            self._side_effects.put((cluster, task_id))
+        else:
+            cluster.safe_kill_task(task_id)
+
+    def _ensure_side_effect_worker(self) -> None:
+        if self._side_effect_thread is not None \
+                and self._side_effect_thread.is_alive():
+            return
+
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    cluster, task_id = self._side_effects.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                try:
+                    cluster.safe_kill_task(task_id)
+                except Exception:  # pragma: no cover
+                    import logging
+                    logging.getLogger(__name__).exception("deferred kill failed")
+
+        self._side_effect_thread = threading.Thread(target=worker, daemon=True)
+        self._side_effect_thread.start()
+
+    # ------------------------------------------------------------- wall clock
+    def run(self) -> None:
+        """Start background cycle threads (the chime equivalent)."""
+        cfg = self.config
+
+        def loop(interval: float, fn) -> None:
+            while not self._stop.wait(interval):
+                try:
+                    fn()
+                except Exception:  # pragma: no cover - cycle errors are logged
+                    import logging
+                    logging.getLogger(__name__).exception("cycle failed")
+
+        specs = [
+            (cfg.rank_interval_seconds, self.step_rank),
+            (cfg.match_interval_seconds, self.step_match),
+            (cfg.rebalancer.interval_seconds, self.step_rebalance),
+            (cfg.lingering_task_interval_seconds, self.step_reapers),
+        ]
+        for interval, fn in specs:
+            t = threading.Thread(target=loop, args=(interval, fn), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)

@@ -74,6 +74,8 @@ class _Txn:
         self._writes: Dict[Tuple[str, str], Any] = {}
         self._deletes: set = set()
         self.events: List[TxEvent] = []
+        # latch registrations applied atomically with the commit
+        self.latch_registrations: List[Tuple[str, List[str]]] = []
 
     def _get(self, table: str, key: str, for_write: bool) -> Any:
         wk = (table, key)
@@ -176,6 +178,8 @@ class Store:
                 getattr(self, "_" + table)[key] = ent
             for table, key in txn._deletes:
                 getattr(self, "_" + table).pop(key, None)
+            for latch, uuids in txn.latch_registrations:
+                self._latches.setdefault(latch, []).extend(uuids)
             self._tx_id += 1
             if txn.events:
                 self._event_queue.append((self._tx_id, txn.events))
@@ -241,16 +245,15 @@ class Store:
                 job.committed = latch is None
                 txn.put("jobs", job.uuid, job)
                 txn.event("job-created", uuid=job.uuid, user=job.user, pool=job.pool)
+            if latch is not None:
+                # applied atomically with the commit, so a snapshot or a
+                # concurrent commit_latch can never observe the jobs without
+                # their latch entry (which would strand them uncommitted)
+                txn.latch_registrations.append(
+                    (latch, [j.uuid for j in jobs]))
             return [j.uuid for j in jobs]
 
-        # Register the latch under the same lock as the create transaction so
-        # a snapshot or concurrent commit_latch can never observe the jobs
-        # without their latch entry (which would strand them uncommitted).
-        with self._lock:
-            uuids = self.transact(_create)
-            if latch is not None:
-                self._latches.setdefault(latch, []).extend(uuids)
-        return uuids
+        return self.transact(_create)
 
     def commit_latch(self, latch: str) -> None:
         with self._lock:
@@ -298,7 +301,8 @@ class Store:
     def update_instance_status(self, task_id: str, new_status: InstanceStatus,
                                reason_code: Optional[int] = None,
                                exit_code: Optional[int] = None,
-                               preempted: bool = False) -> bool:
+                               preempted: bool = False,
+                               hostname: Optional[str] = None) -> bool:
         """Instance state machine + job writeback (reference:
         :instance/update-state schema.clj:1242-1308). Returns False when the
         transition is illegal (stale status updates are dropped, not errors)."""
@@ -315,6 +319,11 @@ class Store:
                 return False
             old = inst.status
             inst.status = new_status
+            if hostname:
+                # direct-mode backends report placement with the first status
+                inst.hostname = hostname
+                if not inst.slave_id:
+                    inst.slave_id = hostname
             if reason_code is not None:
                 inst.reason_code = reason_code
             if exit_code is not None:
@@ -481,7 +490,7 @@ class Store:
             elif default and dim in default.resources:
                 out[dim] = default.resources[dim]
             else:
-                out[dim] = float(2**1023)  # stands in for Double/MAX_VALUE
+                out[dim] = float("inf")  # stands in for Double/MAX_VALUE
         return out
 
     def retract_share(self, user: str, pool: str) -> None:

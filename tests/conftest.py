@@ -1,14 +1,22 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; all sharding tests run on
 XLA's host-platform device virtualization (the driver separately dry-runs
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment may preload jax at interpreter startup (site hook)
+with a TPU platform selected, so env vars alone are too late — the platform
+is overridden through jax.config before the backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

