@@ -1,0 +1,252 @@
+"""``cs`` command-line interface.
+
+Parity with the reference's CLI subcommands (reference: cli/cook/subcommands/
+— submit, show, wait, jobs, kill, usage, plus admin queue/limits; the
+sandbox-access commands cat/tail/ls/ssh are backend-dependent and surface
+here as ``show``'s sandbox fields).  Cluster selection via --url or the
+COOK_URL environment variable / ~/.cs.json config federation list
+(reference: cli/cook/querying.py multi-cluster federation, deduped by uuid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..client import JobClient, JobClientError
+
+CONFIG_PATH = Path.home() / ".cs.json"
+
+
+def load_urls(args) -> List[str]:
+    if args.url:
+        return [args.url]
+    env = os.environ.get("COOK_URL")
+    if env:
+        return env.split(",")
+    if CONFIG_PATH.exists():
+        cfg = json.loads(CONFIG_PATH.read_text())
+        return [c["url"] for c in cfg.get("clusters", [])]
+    return ["http://127.0.0.1:12321"]
+
+
+def clients(args) -> List[JobClient]:
+    user = args.user or os.environ.get("COOK_USER") \
+        or os.environ.get("USER", "anonymous")
+    return [JobClient(url, user=user) for url in load_urls(args)]
+
+
+def federated_query(args, uuids: List[str]) -> List[Dict]:
+    """Query every configured cluster, dedupe by uuid (reference:
+    cli/cook/querying.py)."""
+    seen: Dict[str, Dict] = {}
+    errors = []
+    for client in clients(args):
+        try:
+            for job in client.query(uuids):
+                seen.setdefault(job["uuid"], job)
+        except (JobClientError, OSError) as e:
+            errors.append(f"{client.url}: {e}")
+    missing = [u for u in uuids if u not in seen]
+    if missing and errors:
+        print("\n".join(errors), file=sys.stderr)
+    return [seen[u] for u in uuids if u in seen]
+
+
+def out(payload) -> None:
+    print(json.dumps(payload, indent=2, default=str))
+
+
+def cmd_submit(args) -> int:
+    spec: Dict = {"command": " ".join(args.command)}
+    for field in ("name", "pool"):
+        value = getattr(args, field)
+        if value:
+            spec[field] = value
+    for field in ("cpus", "mem", "gpus", "priority", "max_retries"):
+        value = getattr(args, field)
+        if value is not None:
+            spec[field] = value
+    if args.env:
+        spec["env"] = dict(kv.split("=", 1) for kv in args.env)
+    if args.label:
+        spec["labels"] = dict(kv.split("=", 1) for kv in args.label)
+    if args.constraint:
+        spec["constraints"] = [c.split(":", 2) for c in args.constraint]
+    client = clients(args)[0]
+    uuids = client.submit([spec])
+    print(uuids[0])
+    return 0
+
+
+def cmd_show(args) -> int:
+    jobs = federated_query(args, args.uuid)
+    if not jobs:
+        print("no matching jobs", file=sys.stderr)
+        return 1
+    out(jobs)
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    client = clients(args)[0]
+    states = args.state.split("+") if args.state else None
+    out(client.jobs(user=args.for_user or client.user, states=states))
+    return 0
+
+
+def cmd_wait(args) -> int:
+    client = clients(args)[0]
+    jobs = client.wait(args.uuid, timeout_s=args.timeout)
+    out(jobs)
+    failed = [j for j in jobs
+              if not any(i["status"] == "success"
+                         for i in j.get("instances", []))]
+    return 1 if failed else 0
+
+
+def cmd_kill(args) -> int:
+    client = clients(args)[0]
+    out(client.kill(args.uuid))
+    return 0
+
+
+def cmd_retry(args) -> int:
+    client = clients(args)[0]
+    out(client.retry(args.uuid[0], args.retries))
+    return 0
+
+
+def cmd_usage(args) -> int:
+    client = clients(args)[0]
+    out(client.usage(args.for_user or client.user))
+    return 0
+
+
+def cmd_unscheduled(args) -> int:
+    client = clients(args)[0]
+    out(client.unscheduled_jobs(args.uuid))
+    return 0
+
+
+def cmd_pools(args) -> int:
+    out(clients(args)[0].pools())
+    return 0
+
+
+def cmd_admin(args) -> int:
+    client = clients(args)[0]
+    if args.admin_cmd == "queue":
+        out(client.queue())
+    elif args.admin_cmd == "share":
+        if args.set:
+            pools = {args.pool or "default":
+                     dict((kv.split("=")[0], float(kv.split("=")[1]))
+                          for kv in args.set)}
+            out(client.set_share(args.for_user, pools))
+        else:
+            out(client.get_share(args.for_user or client.user))
+    elif args.admin_cmd == "quota":
+        if args.set:
+            pools = {args.pool or "default":
+                     dict((kv.split("=")[0], float(kv.split("=")[1]))
+                          for kv in args.set)}
+            out(client.set_quota(args.for_user, pools))
+        else:
+            out(client.get_quota(args.for_user or client.user))
+    elif args.admin_cmd == "stats":
+        out(client.stats())
+    return 0
+
+
+def cmd_config(args) -> int:
+    cfg = {"clusters": [{"name": "default", "url": u}
+                        for u in load_urls(args)]}
+    if args.set_url:
+        cfg = {"clusters": [{"name": "default", "url": args.set_url}]}
+        CONFIG_PATH.write_text(json.dumps(cfg, indent=2))
+    out(cfg)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs", description="cook_tpu scheduler CLI")
+    p.add_argument("--url", help="scheduler URL")
+    p.add_argument("--user", help="submit/query as this user")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("submit", help="submit a job")
+    sp.add_argument("--name")
+    sp.add_argument("--pool")
+    sp.add_argument("--cpus", type=float)
+    sp.add_argument("--mem", type=float)
+    sp.add_argument("--gpus", type=float)
+    sp.add_argument("--priority", type=int)
+    sp.add_argument("--max-retries", dest="max_retries", type=int)
+    sp.add_argument("--env", action="append")
+    sp.add_argument("--label", action="append")
+    sp.add_argument("--constraint", action="append",
+                    help="attr:EQUALS:value")
+    sp.add_argument("command", nargs="+")
+    sp.set_defaults(fn=cmd_submit)
+
+    for name, fn, multi in (("show", cmd_show, True), ("wait", cmd_wait, True),
+                            ("kill", cmd_kill, True),
+                            ("unscheduled", cmd_unscheduled, True)):
+        sp = sub.add_parser(name)
+        sp.add_argument("uuid", nargs="+" if multi else 1)
+        if name == "wait":
+            sp.add_argument("--timeout", type=float, default=300.0)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("retry")
+    sp.add_argument("uuid", nargs=1)
+    sp.add_argument("--retries", type=int, required=True)
+    sp.set_defaults(fn=cmd_retry)
+
+    sp = sub.add_parser("jobs", help="list your jobs")
+    sp.add_argument("--for-user", dest="for_user")
+    sp.add_argument("--state", help="waiting+running+completed")
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("usage")
+    sp.add_argument("--for-user", dest="for_user")
+    sp.set_defaults(fn=cmd_usage)
+
+    sp = sub.add_parser("pools")
+    sp.set_defaults(fn=cmd_pools)
+
+    sp = sub.add_parser("admin")
+    sp.add_argument("admin_cmd",
+                    choices=["queue", "share", "quota", "stats"])
+    sp.add_argument("--for-user", dest="for_user")
+    sp.add_argument("--pool")
+    sp.add_argument("--set", action="append",
+                    help="resource=value (cpus=10)")
+    sp.set_defaults(fn=cmd_admin)
+
+    sp = sub.add_parser("config")
+    sp.add_argument("--set-url", dest="set_url")
+    sp.set_defaults(fn=cmd_config)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except JobClientError as e:
+        print(f"error: {e.message}", file=sys.stderr)
+        return 1
+    except (OSError, TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""Python job client.
+
+Parity with the reference's Python jobclient (reference:
+jobclient/python/cookclient/__init__.py:419 JobClient): submit/query/kill/
+wait plus admin helpers, over stdlib urllib (no extra dependencies).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+
+class JobClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class JobClient:
+    def __init__(self, url: str, user: str = "anonymous",
+                 impersonate: Optional[str] = None, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.user = user
+        self.impersonate = impersonate
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------- plumbing
+    def _request(self, method: str, path: str,
+                 params: Optional[Dict[str, Union[str, Sequence[str]]]] = None,
+                 body: Optional[Dict] = None) -> Any:
+        query = ""
+        if params:
+            pairs = []
+            for k, v in params.items():
+                if isinstance(v, (list, tuple)):
+                    pairs.extend((k, item) for item in v)
+                else:
+                    pairs.append((k, v))
+            query = "?" + urllib.parse.urlencode(pairs)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path + query, data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": self.user,
+                     **({"X-Cook-Impersonate": self.impersonate}
+                        if self.impersonate else {})})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise JobClientError(e.code, message)
+        if path == "/metrics":
+            return raw.decode()
+        return json.loads(raw) if raw else None
+
+    # ---------------------------------------------------------------- jobs
+    def submit(self, jobs: List[Dict], pool: Optional[str] = None,
+               groups: Optional[List[Dict]] = None) -> List[str]:
+        body: Dict[str, Any] = {"jobs": jobs}
+        if pool:
+            body["pool"] = pool
+        if groups:
+            body["groups"] = groups
+        return self._request("POST", "/jobs", body=body)["jobs"]
+
+    def submit_one(self, command: str, **spec) -> str:
+        spec["command"] = command
+        return self.submit([spec])[0]
+
+    def query(self, uuids: Sequence[str]) -> List[Dict]:
+        return self._request("GET", "/jobs", params={"uuid": list(uuids)})
+
+    def job(self, uuid: str) -> Dict:
+        return self._request("GET", f"/jobs/{uuid}")
+
+    def jobs(self, user: Optional[str] = None,
+             states: Optional[Sequence[str]] = None) -> List[Dict]:
+        params: Dict[str, str] = {}
+        if user:
+            params["user"] = user
+        if states:
+            params["state"] = "+".join(states)
+        return self._request("GET", "/jobs", params=params)
+
+    def kill(self, uuids: Sequence[str]) -> Dict:
+        return self._request("DELETE", "/jobs", params={"uuid": list(uuids)})
+
+    def retry(self, uuid: str, retries: int) -> Dict:
+        return self._request("POST", "/retry",
+                             body={"job": uuid, "retries": retries})
+
+    def wait(self, uuids: Sequence[str], timeout_s: float = 300.0,
+             poll_s: float = 0.5) -> List[Dict]:
+        """Block until all jobs complete (reference: cli wait subcommand)."""
+        deadline = time.time() + timeout_s
+        while True:
+            jobs = self.query(uuids)
+            if all(j["state"] == "completed" for j in jobs):
+                return jobs
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"jobs not completed within {timeout_s}s")
+            time.sleep(poll_s)
+
+    def instance(self, task_id: str) -> Dict:
+        return self._request("GET", f"/instances/{task_id}")
+
+    # ---------------------------------------------------------------- admin
+    def usage(self, user: str) -> Dict:
+        return self._request("GET", "/usage", params={"user": user})
+
+    def queue(self) -> Dict:
+        return self._request("GET", "/queue")
+
+    def pools(self) -> List[Dict]:
+        return self._request("GET", "/pools")
+
+    def unscheduled_jobs(self, uuids: Sequence[str]) -> List[Dict]:
+        return self._request("GET", "/unscheduled_jobs",
+                             params={"job": list(uuids)})
+
+    def get_share(self, user: str) -> Dict:
+        return self._request("GET", "/share", params={"user": user})
+
+    def set_share(self, user: str, pools: Dict[str, Dict[str, float]],
+                  reason: str = "") -> Dict:
+        return self._request("POST", "/share",
+                             body={"user": user, "pools": pools,
+                                   "reason": reason})
+
+    def get_quota(self, user: str) -> Dict:
+        return self._request("GET", "/quota", params={"user": user})
+
+    def set_quota(self, user: str, pools: Dict[str, Dict[str, float]],
+                  reason: str = "") -> Dict:
+        return self._request("POST", "/quota",
+                             body={"user": user, "pools": pools,
+                                   "reason": reason})
+
+    def failure_reasons(self) -> List[Dict]:
+        return self._request("GET", "/failure_reasons")
+
+    def stats(self) -> Dict:
+        return self._request("GET", "/stats/instances")
+
+    def info(self) -> Dict:
+        return self._request("GET", "/info")
+
+    def metrics(self) -> str:
+        return self._request("GET", "/metrics")

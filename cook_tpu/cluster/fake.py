@@ -52,6 +52,9 @@ class FakeCluster(ComputeCluster):
         self._default_duration_ms = default_task_duration_ms
         # task_id -> duration override, set by tests/simulator before launch
         self.task_durations_ms: Dict[str, int] = {}
+        # job uuid -> duration fallback (the simulator keys by job, since
+        # task ids are only minted at launch)
+        self.job_durations_ms: Dict[str, int] = {}
         self.task_exit_codes: Dict[str, int] = {}
         self.launched_order: List[str] = []
 
@@ -96,7 +99,9 @@ class FakeCluster(ComputeCluster):
                     spec.hostname = chosen
                     spec.slave_id = chosen
                 duration = self.task_durations_ms.get(
-                    spec.task_id, self._default_duration_ms)
+                    spec.task_id,
+                    self.job_durations_ms.get(spec.job_uuid,
+                                              self._default_duration_ms))
                 self._tasks[spec.task_id] = _RunningTask(
                     spec=spec, started_at_ms=self._now_ms, duration_ms=duration,
                     exit_code=self.task_exit_codes.get(spec.task_id, 0))

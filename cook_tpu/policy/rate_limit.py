@@ -1,0 +1,116 @@
+"""Token-bucket rate limiting.
+
+Mirrors the reference's rate-limit engine (reference:
+scheduler/src/cook/rate_limit/token_bucket_filter.clj — lazy-replenish token
+buckets — and rate_limit/generic.clj:86-157 — a keyed cache of buckets with
+an enforce? flag).  Instances cover the same three planes the reference
+wires (rate_limit.clj:30-56): job submission per user, per-user-per-pool
+launches, and per-compute-cluster launches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class _Bucket:
+    tokens: float
+    last_update_s: float
+
+
+class TokenBucketRateLimiter:
+    """Keyed token buckets: ``bucket_size`` capacity, replenished at
+    ``tokens_per_minute``; going into debt is allowed (the caller spends
+    first, then asks ``time_until_out_of_debt``), matching the reference's
+    earn-then-spend filter semantics."""
+
+    def __init__(self, tokens_per_minute: float, bucket_size: float,
+                 enforce: bool = True,
+                 clock=time.monotonic):
+        self.tokens_per_minute = float(tokens_per_minute)
+        self.bucket_size = float(bucket_size)
+        self.enforce = enforce
+        self._clock = clock
+        self._buckets: Dict[str, _Bucket] = {}
+        self._lock = threading.Lock()
+
+    def _refresh(self, key: str) -> _Bucket:
+        now = self._clock()
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = _Bucket(tokens=self.bucket_size, last_update_s=now)
+            self._buckets[key] = bucket
+        else:
+            earned = (now - bucket.last_update_s) / 60.0 * self.tokens_per_minute
+            bucket.tokens = min(self.bucket_size, bucket.tokens + earned)
+            bucket.last_update_s = now
+        return bucket
+
+    def get_token_count(self, key: str) -> float:
+        with self._lock:
+            return self._refresh(key).tokens
+
+    def spend(self, key: str, n: float = 1.0) -> None:
+        with self._lock:
+            bucket = self._refresh(key)
+            bucket.tokens -= n
+
+    def within_limit(self, key: str) -> bool:
+        """True when the key has tokens (or enforcement is off)."""
+        if not self.enforce:
+            return True
+        return self.get_token_count(key) > 0
+
+    def time_until_out_of_debt_s(self, key: str) -> float:
+        with self._lock:
+            tokens = self._refresh(key).tokens
+        if tokens >= 0:
+            return 0.0
+        return -tokens / self.tokens_per_minute * 60.0
+
+    def flush(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+
+
+class UnlimitedRateLimiter:
+    """The no-op limiter used when a plane is unconfigured."""
+
+    enforce = False
+
+    def get_token_count(self, key: str) -> float:
+        return float("inf")
+
+    def spend(self, key: str, n: float = 1.0) -> None:
+        pass
+
+    def within_limit(self, key: str) -> bool:
+        return True
+
+    def time_until_out_of_debt_s(self, key: str) -> float:
+        return 0.0
+
+    def flush(self) -> None:
+        pass
+
+
+def pool_user_key(pool: str, user: str) -> str:
+    return f"{pool}/{user}"
+
+
+@dataclass
+class RateLimits:
+    """The three rate-limit planes (reference: rate_limit.clj)."""
+
+    job_submission: object = None    # key: user
+    job_launch: object = None        # key: pool/user
+    cluster_launch: object = None    # key: cluster name
+
+    def __post_init__(self):
+        self.job_submission = self.job_submission or UnlimitedRateLimiter()
+        self.job_launch = self.job_launch or UnlimitedRateLimiter()
+        self.cluster_launch = self.cluster_launch or UnlimitedRateLimiter()

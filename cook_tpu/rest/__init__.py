@@ -1,0 +1,1 @@
+from .api import ApiError, ApiServer, CookApi  # noqa: F401

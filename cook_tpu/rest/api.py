@@ -1,0 +1,614 @@
+"""REST API server.
+
+Re-implements the behavior-bearing endpoint surface of the reference's REST
+layer (reference: scheduler/src/cook/rest/api.clj:3640-4019 main-handler)
+over the stdlib threading HTTP server:
+
+  POST   /jobs                batch submit (validation, plugins, queue limits,
+                              submission rate limit, commit-latch atomicity)
+  GET    /jobs?uuid=&user=&state=   query jobs
+  GET    /jobs/<uuid>         one job with instances
+  DELETE /jobs?uuid=...       kill jobs
+  POST   /retry               {"job": uuid, "retries": n}
+  GET    /instances/<task-id>
+  GET    /queue               per-pool ranked queue (leader only)
+  GET    /running             running instances
+  GET    /usage?user=         aggregate running usage per pool
+  GET/POST/DELETE /share      fair-share admin
+  GET/POST/DELETE /quota      quota admin
+  GET    /pools
+  GET    /unscheduled_jobs?job=uuid
+  GET    /failure_reasons
+  GET    /stats/instances
+  GET    /settings, /info, /debug, /metrics
+  POST   /progress/<task-id>  sidecar progress callback
+
+AuthN is the reference's composable scheme reduced to HTTP basic / an
+X-Cook-User header ("open" mode), with admin checks and impersonation via
+X-Cook-Impersonate (reference: rest/authorization.clj, impersonation.clj).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ..config import Config
+from ..policy import PluginRegistry, QueueLimits, RateLimits
+from ..sched.scheduler import Scheduler
+from ..sched.unscheduled import job_reasons
+from ..state.schema import (
+    Constraint,
+    Group,
+    InstanceStatus,
+    Job,
+    JobState,
+    Reasons,
+    Resources,
+    new_uuid,
+    to_json,
+)
+from ..state.store import AbortTransaction, Store
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
+    out = {
+        "uuid": job.uuid, "name": job.name, "command": job.command,
+        "user": job.user, "priority": job.priority, "pool": job.pool,
+        "state": job.state.value,
+        "status": {"waiting": "waiting", "running": "running",
+                   "completed": "completed"}[job.state.value],
+        "cpus": job.resources.cpus, "mem": job.resources.mem,
+        "gpus": job.resources.gpus, "disk": job.resources.disk,
+        "max_retries": job.max_retries, "max_runtime": job.max_runtime_ms,
+        "submit_time": job.submit_time_ms, "labels": job.labels,
+        "env": job.env, "groups": [job.group] if job.group else [],
+        "constraints": [[c.attribute, c.operator, c.pattern]
+                        for c in job.constraints],
+        "disable_mea_culpa_retries": job.disable_mea_culpa_retries,
+    }
+    if include_instances:
+        out["instances"] = []
+        for tid in job.instances:
+            inst = store.instance(tid)
+            if inst is not None:
+                out["instances"].append(instance_to_json(inst))
+    return out
+
+
+def instance_to_json(inst) -> Dict:
+    reason = Reasons.by_code(inst.reason_code) if inst.reason_code is not None \
+        else None
+    return {
+        "task_id": inst.task_id, "job_uuid": inst.job_uuid,
+        "status": inst.status.value, "hostname": inst.hostname,
+        "slave_id": inst.slave_id, "compute_cluster": inst.compute_cluster,
+        "start_time": inst.start_time_ms, "end_time": inst.end_time_ms,
+        "preempted": inst.preempted, "progress": inst.progress,
+        "progress_message": inst.progress_message,
+        "exit_code": inst.exit_code, "ports": inst.ports,
+        "reason_code": inst.reason_code,
+        "reason_string": reason.name if reason else None,
+        "mea_culpa": reason.mea_culpa if reason else None,
+        "sandbox_directory": inst.sandbox_directory,
+        "queue_time": inst.queue_time_ms,
+    }
+
+
+def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
+    """Submission schema -> Job (reference: make-job-txn rest/api.clj:750)."""
+    if "command" not in spec:
+        raise ApiError(400, "job is missing command")
+    priority = int(spec.get("priority", 50))
+    if not 0 <= priority <= 100:
+        raise ApiError(400, "priority must be in [0, 100]")
+    constraints = []
+    for c in spec.get("constraints", []):
+        if len(c) != 3:
+            raise ApiError(400, f"malformed constraint {c}")
+        constraints.append(Constraint(c[0], c[1], c[2]))
+    try:
+        return Job(
+            uuid=spec.get("uuid") or new_uuid(),
+            user=user,
+            command=spec["command"],
+            name=spec.get("name", "cookjob"),
+            resources=Resources(
+                cpus=float(spec.get("cpus", 1.0)),
+                mem=float(spec.get("mem", 128.0)),
+                gpus=float(spec.get("gpus", 0.0)),
+                disk=float(spec.get("disk", 0.0))),
+            priority=priority,
+            max_retries=int(spec.get("max_retries", 1)),
+            max_runtime_ms=int(spec.get("max_runtime", 2**53)),
+            pool=spec.get("pool", default_pool),
+            labels=dict(spec.get("labels", {})),
+            env=dict(spec.get("env", {})),
+            container=spec.get("container"),
+            constraints=constraints,
+            group=spec.get("group"),
+            disable_mea_culpa_retries=bool(
+                spec.get("disable_mea_culpa_retries", False)),
+        )
+    except (TypeError, ValueError) as e:
+        raise ApiError(400, f"malformed job spec: {e}")
+
+
+class CookApi:
+    """Request-handling core, separable from the HTTP plumbing for tests."""
+
+    def __init__(self, store: Store, scheduler: Optional[Scheduler] = None,
+                 config: Optional[Config] = None,
+                 plugins: Optional[PluginRegistry] = None,
+                 rate_limits: Optional[RateLimits] = None,
+                 queue_limits: Optional[QueueLimits] = None,
+                 admins: Optional[List[str]] = None,
+                 impersonators: Optional[List[str]] = None):
+        self.store = store
+        self.scheduler = scheduler
+        self.config = config or (scheduler.config if scheduler else Config())
+        self.plugins = plugins or (scheduler.plugins if scheduler
+                                   else PluginRegistry())
+        self.rate_limits = rate_limits or (
+            scheduler.rate_limits if scheduler else RateLimits())
+        self.queue_limits = queue_limits
+        self.admins = set(admins or [])
+        self.impersonators = set(impersonators or [])
+
+    # ------------------------------------------------------------------ auth
+    def require_admin(self, user: str) -> None:
+        if self.admins and user not in self.admins:
+            raise ApiError(403, f"{user} is not authorized")
+
+    def resolve_user(self, auth_user: str, impersonate: Optional[str]) -> str:
+        if impersonate:
+            if auth_user not in self.impersonators \
+                    and auth_user not in self.admins:
+                raise ApiError(403, f"{auth_user} may not impersonate")
+            return impersonate
+        return auth_user
+
+    # ---------------------------------------------------------------- routes
+    def submit_jobs(self, body: Dict, user: str) -> Dict:
+        specs = body.get("jobs", [])
+        if not specs:
+            raise ApiError(400, "no jobs to submit")
+        pool_override = body.get("pool")
+        # submission rate limit (per user)
+        rl = self.rate_limits.job_submission
+        if rl.enforce and rl.get_token_count(user) < len(specs):
+            raise ApiError(429, "job submission rate limit exceeded")
+        jobs = []
+        for spec in specs:
+            job = parse_job_spec(spec, user, self.config.default_pool)
+            if pool_override:
+                job.pool = pool_override
+            job.pool = self.plugins.pool_selector.select(
+                job, self.config.default_pool)
+            deny = self.plugins.validate_submission(job)
+            if deny:
+                raise ApiError(400, f"job {job.uuid}: {deny}")
+            jobs.append(self.plugins.modify_submission(job))
+        by_pool: Dict[str, int] = {}
+        for job in jobs:
+            by_pool[job.pool] = by_pool.get(job.pool, 0) + 1
+        if self.queue_limits is not None:
+            for pool, n in by_pool.items():
+                msg = self.queue_limits.check_submission(pool, user, n)
+                if msg:
+                    raise ApiError(422, msg)
+        groups = []
+        for gspec in body.get("groups", []):
+            guuid = gspec.get("uuid")
+            if not guuid:
+                raise ApiError(400, "groups must carry a uuid so jobs can "
+                                    "reference them")
+            groups.append(Group(
+                uuid=guuid,
+                name=gspec.get("name", "defaultgroup"),
+                jobs=[j.uuid for j in jobs if j.group == guuid]))
+        # atomic batch visibility via commit latch (metatransaction)
+        latch = new_uuid()
+        try:
+            self.store.create_jobs(jobs, groups=groups, latch=latch)
+        except AbortTransaction as e:
+            raise ApiError(409, e.reason)
+        self.store.commit_latch(latch)
+        rl.spend(user, len(specs))
+        return {"jobs": [j.uuid for j in jobs]}
+
+    def get_jobs(self, params: Dict) -> List[Dict]:
+        uuids = params.get("uuid", [])
+        if uuids:
+            out = []
+            for uuid in uuids:
+                job = self.store.job(uuid)
+                if job is None:
+                    raise ApiError(404, f"no such job {uuid}")
+                out.append(job_to_json(self.store, job))
+            return out
+        user = first(params.get("user"))
+        states = set(first(params.get("state"), "").split("+")) - {""}
+        jobs = self.store.jobs_where(
+            lambda j: (user is None or j.user == user)
+            and (not states or j.state.value in states))
+        return [job_to_json(self.store, j, include_instances=False)
+                for j in jobs]
+
+    def kill_jobs(self, params: Dict, user: str) -> Dict:
+        uuids = params.get("uuid", [])
+        if not uuids:
+            raise ApiError(400, "no uuids given")
+        for uuid in uuids:
+            job = self.store.job(uuid)
+            if job is None:
+                raise ApiError(404, f"no such job {uuid}")
+            if job.user != user:
+                self.require_admin(user)
+        for uuid in uuids:
+            self.store.kill_job(uuid)
+        return {"killed": uuids}
+
+    def retry(self, body: Dict, user: str) -> Dict:
+        uuid = body.get("job")
+        retries = body.get("retries")
+        if uuid is None or retries is None:
+            raise ApiError(400, "need job and retries")
+        job = self.store.job(uuid)
+        if job is None:
+            raise ApiError(404, f"no such job {uuid}")
+        if job.user != user:
+            self.require_admin(user)
+        self.store.retry_job(uuid, int(retries))
+        return {"job": uuid, "retries": retries}
+
+    def queue(self, user: str) -> Dict:
+        self.require_admin(user)
+        if self.scheduler is None:
+            raise ApiError(503, "no scheduler attached")
+        return {pool: [job_to_json(self.store, j, include_instances=False)
+                       for j in jobs[:200]]
+                for pool, jobs in self.scheduler.pending_queues.items()}
+
+    def running(self) -> List[Dict]:
+        return [instance_to_json(inst)
+                for _job, inst in self.store.running_instances()]
+
+    def usage(self, params: Dict) -> Dict:
+        user = first(params.get("user"))
+        if user is None:
+            raise ApiError(400, "user parameter required")
+        out = {"total_usage": {"cpus": 0.0, "mem": 0.0, "gpus": 0.0,
+                               "jobs": 0}, "pools": {}}
+        for pool in self.store.pools():
+            usage = self.store.user_usage(pool.name).get(user)
+            if not usage:
+                continue
+            out["pools"][pool.name] = {
+                "cpus": usage["cpus"], "mem": usage["mem"],
+                "gpus": usage["gpus"], "jobs": int(usage["count"])}
+            out["total_usage"]["cpus"] += usage["cpus"]
+            out["total_usage"]["mem"] += usage["mem"]
+            out["total_usage"]["gpus"] += usage["gpus"]
+            out["total_usage"]["jobs"] += int(usage["count"])
+        return out
+
+    def share_get(self, params: Dict) -> Dict:
+        user = first(params.get("user"))
+        if user is None:
+            raise ApiError(400, "user parameter required")
+        pools = [p.name for p in self.store.pools()] or ["default"]
+        return {pool: _finite(self.store.get_share(user, pool))
+                for pool in pools}
+
+    def share_set(self, body: Dict, user: str) -> Dict:
+        self.require_admin(user)
+        target = body.get("user")
+        if not target:
+            raise ApiError(400, "user required")
+        for pool, resources in body.get("pools", {}).items():
+            self.store.set_share(target, pool, resources,
+                                 reason=body.get("reason", ""))
+        return {"user": target}
+
+    def share_delete(self, params: Dict, user: str) -> Dict:
+        self.require_admin(user)
+        target = first(params.get("user"))
+        for pool in [p.name for p in self.store.pools()] or ["default"]:
+            self.store.retract_share(target, pool)
+        return {"user": target}
+
+    def quota_get(self, params: Dict) -> Dict:
+        user = first(params.get("user"))
+        if user is None:
+            raise ApiError(400, "user parameter required")
+        pools = [p.name for p in self.store.pools()] or ["default"]
+        return {pool: _finite(self.store.get_quota(user, pool))
+                for pool in pools}
+
+    def quota_set(self, body: Dict, user: str) -> Dict:
+        self.require_admin(user)
+        target = body.get("user")
+        if not target:
+            raise ApiError(400, "user required")
+        for pool, resources in body.get("pools", {}).items():
+            resources = dict(resources)
+            count = resources.pop("count", float("inf"))
+            self.store.set_quota(target, pool, resources, count=count,
+                                 reason=body.get("reason", ""))
+        return {"user": target}
+
+    def quota_delete(self, params: Dict, user: str) -> Dict:
+        self.require_admin(user)
+        target = first(params.get("user"))
+        for pool in [p.name for p in self.store.pools()] or ["default"]:
+            self.store.retract_quota(target, pool)
+        return {"user": target}
+
+    def pools(self) -> List[Dict]:
+        return [{"name": p.name, "purpose": p.purpose, "state": p.state,
+                 "dru-mode": p.dru_mode.value,
+                 "scheduler": p.scheduler.value}
+                for p in self.store.pools()]
+
+    def unscheduled(self, params: Dict) -> List[Dict]:
+        uuids = params.get("job", [])
+        out = []
+        for uuid in uuids:
+            job = self.store.job(uuid)
+            if job is None:
+                raise ApiError(404, f"no such job {uuid}")
+            out.append({"uuid": uuid,
+                        "reasons": job_reasons(self.store, job,
+                                               scheduler=self.scheduler,
+                                               queue_limits=self.queue_limits)})
+        return out
+
+    def failure_reasons(self) -> List[Dict]:
+        return [{"code": r.code, "name": r.name, "mea_culpa": r.mea_culpa,
+                 "failure_limit": r.failure_limit}
+                for r in Reasons.all()]
+
+    def stats_instances(self) -> Dict:
+        by_status: Dict[str, int] = {}
+        by_reason: Dict[str, int] = {}
+        with self.store._lock:
+            for inst in self.store._instances.values():
+                by_status[inst.status.value] = \
+                    by_status.get(inst.status.value, 0) + 1
+                if inst.reason_code is not None:
+                    name = Reasons.by_code(inst.reason_code).name
+                    by_reason[name] = by_reason.get(name, 0) + 1
+        return {"by_status": by_status, "by_reason": by_reason}
+
+    def progress(self, task_id: str, body: Dict) -> Dict:
+        ok = self.store.update_instance_progress(
+            task_id, int(body.get("progress_percent", 0)),
+            message=body.get("progress_message", ""),
+            sequence=int(body.get("progress_sequence", 0)))
+        if not ok:
+            raise ApiError(404, f"no such instance {task_id} "
+                                "(or stale sequence)")
+        return {"task_id": task_id}
+
+    def info(self) -> Dict:
+        from .. import __version__
+        return {"version": __version__, "leader": self.scheduler is not None,
+                "authentication-scheme": "open",
+                "start-up-time": 0}
+
+    def debug(self) -> Dict:
+        return {"healthy": True,
+                "pools": [p.name for p in self.store.pools()],
+                "clusters": (list(self.scheduler.clusters)
+                             if self.scheduler else [])}
+
+    def settings(self) -> Dict:
+        cfg = self.config
+        return {
+            "rank-interval-seconds": cfg.rank_interval_seconds,
+            "match-interval-seconds": cfg.match_interval_seconds,
+            "max-over-quota-jobs": cfg.max_over_quota_jobs,
+            "default-pool": cfg.default_pool,
+            "rebalancer": {
+                "enabled": cfg.rebalancer.enabled,
+                "safe-dru-threshold": cfg.rebalancer.safe_dru_threshold,
+                "min-dru-diff": cfg.rebalancer.min_dru_diff,
+                "max-preemption": cfg.rebalancer.max_preemption,
+            },
+        }
+
+    def metrics(self) -> str:
+        """Prometheus text exposition (reference: prometheus_metrics.clj +
+        /metrics handler rest/api.clj:3981)."""
+        from ..utils.metrics import registry
+        lines = registry.expose()
+        # always include live gauges derivable from state
+        with self.store._lock:
+            waiting = sum(1 for j in self.store._jobs.values()
+                          if j.state is JobState.WAITING and j.committed)
+            running = sum(1 for j in self.store._jobs.values()
+                          if j.state is JobState.RUNNING)
+        lines += (f"\ncook_jobs_waiting {waiting}"
+                  f"\ncook_jobs_running {running}\n")
+        return lines
+
+
+def first(values, default=None):
+    if not values:
+        return default
+    return values[0]
+
+
+def _finite(d: Dict[str, float]) -> Dict[str, Any]:
+    return {k: (v if v != float("inf") else None) for k, v in d.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: CookApi = None  # set by server factory
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------- plumbing
+    def log_message(self, fmt, *args):  # pragma: no cover - silence
+        pass
+
+    def _user(self) -> str:
+        auth = self.headers.get("Authorization", "")
+        user = self.headers.get("X-Cook-User", "")
+        if auth.startswith("Basic "):
+            try:
+                user = base64.b64decode(auth[6:]).decode().split(":")[0]
+            except Exception:
+                raise ApiError(401, "malformed basic auth")
+        if not user:
+            user = "anonymous"
+        return self.api.resolve_user(
+            user, self.headers.get("X-Cook-Impersonate"))
+
+    def _body(self) -> Dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if not length:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            raise ApiError(400, "malformed JSON body")
+
+    def _respond(self, status: int, payload) -> None:
+        data = json.dumps(to_json(payload)).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _route(self, method: str) -> None:
+        try:
+            parsed = urllib.parse.urlparse(self.path)
+            params = urllib.parse.parse_qs(parsed.query)
+            payload = self._dispatch(method, parsed.path, params)
+            self._respond(200, payload)
+        except ApiError as e:
+            self._respond(e.status, {"error": e.message})
+        except Exception as e:  # pragma: no cover
+            self._respond(500, {"error": f"internal error: {e}"})
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, method: str, path: str, params: Dict):
+        api = self.api
+        parts = [p for p in path.split("/") if p]
+        if method == "GET":
+            if path == "/jobs" or path == "/rawscheduler":
+                return api.get_jobs(params)
+            if len(parts) == 2 and parts[0] == "jobs":
+                return api.get_jobs({"uuid": [parts[1]]})[0]
+            if len(parts) == 2 and parts[0] == "instances":
+                inst = api.store.instance(parts[1])
+                if inst is None:
+                    raise ApiError(404, f"no such instance {parts[1]}")
+                return instance_to_json(inst)
+            if path == "/queue":
+                return api.queue(self._user())
+            if path == "/running":
+                return api.running()
+            if path == "/usage":
+                return api.usage(params)
+            if path == "/share":
+                return api.share_get(params)
+            if path == "/quota":
+                return api.quota_get(params)
+            if path == "/pools":
+                return api.pools()
+            if path == "/unscheduled_jobs":
+                return api.unscheduled(params)
+            if path == "/failure_reasons":
+                return api.failure_reasons()
+            if path == "/stats/instances":
+                return api.stats_instances()
+            if path == "/settings":
+                return api.settings()
+            if path == "/info":
+                return api.info()
+            if path == "/debug":
+                return api.debug()
+            if path == "/metrics":
+                return {"_raw": api.metrics()}
+        elif method == "POST":
+            if path == "/jobs" or path == "/rawscheduler":
+                return api.submit_jobs(self._body(), self._user())
+            if path == "/retry":
+                return api.retry(self._body(), self._user())
+            if path == "/share":
+                return api.share_set(self._body(), self._user())
+            if path == "/quota":
+                return api.quota_set(self._body(), self._user())
+            if len(parts) == 2 and parts[0] == "progress":
+                return api.progress(parts[1], self._body())
+        elif method == "DELETE":
+            if path == "/jobs" or path == "/rawscheduler":
+                return api.kill_jobs(params, self._user())
+            if path == "/share":
+                return api.share_delete(params, self._user())
+            if path == "/quota":
+                return api.quota_delete(params, self._user())
+        raise ApiError(404, f"no such endpoint {method} {path}")
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class ApiServer:
+    """Threaded HTTP server wrapper."""
+
+    def __init__(self, api: CookApi, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"api": api})
+        # /metrics returns text, special-case the wrapper
+        orig_respond = handler._respond
+
+        def respond(self_h, status, payload):
+            if isinstance(payload, dict) and "_raw" in payload:
+                data = payload["_raw"].encode()
+                self_h.send_response(status)
+                self_h.send_header("Content-Type", "text/plain")
+                self_h.send_header("Content-Length", str(len(data)))
+                self_h.end_headers()
+                self_h.wfile.write(data)
+            else:
+                orig_respond(self_h, status, payload)
+
+        handler._respond = respond
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -67,9 +67,13 @@ class _BackoffState:
 
 
 class Matcher:
-    def __init__(self, store: Store, config: Config):
+    def __init__(self, store: Store, config: Config, plugins=None,
+                 rate_limits=None):
+        from ..policy import PluginRegistry, RateLimits
         self.store = store
         self.config = config
+        self.plugins = plugins or PluginRegistry()
+        self.rate_limits = rate_limits or RateLimits()
         self._backoff: Dict[str, _BackoffState] = {}
 
     # ------------------------------------------------------------ selection
@@ -81,11 +85,15 @@ class Matcher:
         the accumulator includes skipped jobs, tools.clj:899-915)."""
         if limit <= 0:
             return []
+        from ..policy import pool_user_key
+        launch_rl = self.rate_limits.job_launch
         usage: Dict[str, np.ndarray] = {}
         for job, _inst in self.store.running_instances(pool_name):
             u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
             u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
         out: List[Job] = []
+        user_tokens: Dict[str, float] = {}
+        user_seen: Dict[str, int] = {}
         for job in ranked:
             quota = self.store.get_quota(job.user, pool_name)
             qvec = np.array([quota.get("cpus", np.inf), quota.get("mem", np.inf),
@@ -93,10 +101,25 @@ class Matcher:
                             dtype=F32)
             u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
             u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
-            if np.all(u <= qvec):
-                out.append(job)
-                if len(out) >= limit:
-                    break
+            if not np.all(u <= qvec):
+                continue
+            # per-user-per-pool launch rate limit: each user passes at most
+            # token-count jobs per cycle (reference:
+            # filter-pending-jobs-for-ratelimit tools.clj:940-970)
+            if launch_rl.enforce:
+                tokens = user_tokens.setdefault(
+                    job.user,
+                    launch_rl.get_token_count(pool_user_key(pool_name, job.user)))
+                seen = user_seen.get(job.user, 0)
+                user_seen[job.user] = seen + 1
+                if seen >= int(tokens):  # a fractional token is not a launch
+                    continue
+            # launch-filter plugin with cached accept/defer verdicts
+            if not self.plugins.launch_allowed(job):
+                continue
+            out.append(job)
+            if len(out) >= limit:
+                break
         return out
 
     # -------------------------------------------------------------- context
@@ -208,8 +231,21 @@ class Matcher:
         """Transactional guard then cluster launch (reference:
         launch-matched-tasks! scheduler.clj:1028: the store transaction
         failing MUST block the backend launch)."""
+        from ..policy import pool_user_key
+        cluster_rl = self.rate_limits.cluster_launch
+        launch_rl = self.rate_limits.job_launch
+        cluster_budget: Dict[str, float] = {}
         by_cluster: Dict[str, List[LaunchSpec]] = {}
         for job, offer in result.matched:
+            # per-compute-cluster launch rate limit (reference:
+            # filter-matches-for-ratelimit scheduler.clj:887)
+            if cluster_rl.enforce:
+                budget = cluster_budget.setdefault(
+                    offer.cluster, cluster_rl.get_token_count(offer.cluster))
+                if budget < 1:
+                    result.unmatched.append(job)
+                    continue
+                cluster_budget[offer.cluster] = budget - 1
             task_id = new_uuid()
             try:
                 self.store.launch_instance(
@@ -218,6 +254,8 @@ class Matcher:
             except AbortTransaction as e:
                 result.launch_failures.append((job.uuid, e.reason))
                 continue
+            launch_rl.spend(pool_user_key(pool_name, job.user))
+            cluster_rl.spend(offer.cluster)
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
                 task_id=task_id, job_uuid=job.uuid, hostname=offer.hostname,
                 slave_id=offer.slave_id, resources=job.resources))

@@ -46,15 +46,21 @@ from .rebalancer import Rebalancer
 class Scheduler:
     def __init__(self, store: Store, config: Optional[Config] = None,
                  clusters: Optional[List[ComputeCluster]] = None,
-                 rank_backend: str = "tpu"):
+                 rank_backend: str = "tpu", plugins=None, rate_limits=None):
+        from ..policy import PluginRegistry, RateLimits
         self.store = store
         self.config = config or Config()
+        self.plugins = plugins or PluginRegistry()
+        self.rate_limits = rate_limits or RateLimits()
         self.clusters: Dict[str, ComputeCluster] = {}
         self.ranker = Ranker(store, self.config, backend=rank_backend)
-        self.matcher = Matcher(store, self.config)
+        self.matcher = Matcher(store, self.config, plugins=self.plugins,
+                               rate_limits=self.rate_limits)
         self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
         # pool -> ranked pending jobs, refreshed by the rank cycle
         self.pending_queues: Dict[str, List[Job]] = {}
+        # pool -> last MatchCycleResult, feeds the unscheduled explainer
+        self.last_match_results: Dict[str, MatchCycleResult] = {}
         # job uuid -> reserved hostname from the rebalancer
         self.reserved_hosts: Dict[str, str] = {}
         self._stop = threading.Event()
@@ -105,6 +111,13 @@ class Scheduler:
                 # consume rebalancer reservations once the job launches —
                 # or release them if the job dies while still waiting
                 self.reserved_hosts.pop(e.data.get("uuid"), None)
+            if e.kind == "instance-status" and e.data.get("new") in (
+                    "success", "failed"):
+                # InstanceCompletionHandler plugins (plugins/definitions.clj)
+                inst = self.store.instance(e.data["task_id"])
+                job = self.store.job(e.data["job"]) if inst else None
+                if inst is not None and job is not None:
+                    self.plugins.on_instance_completion(job, inst)
 
     # ---------------------------------------------------------------- cycles
     def step_rank(self) -> Dict[str, List[Job]]:
@@ -138,6 +151,7 @@ class Scheduler:
             results[pool.name] = self.matcher.match_pool(
                 pool.name, ranked, offers, self.clusters,
                 reserved_hosts=self.reserved_hosts)
+        self.last_match_results.update(results)
         return results
 
     def _match_direct(self, pool_name: str, ranked: List[Job]
@@ -157,10 +171,20 @@ class Scheduler:
         if not clusters:
             result.unmatched = considerable
             return result
+        from ..policy import pool_user_key
+        launch_rl = self.rate_limits.job_launch
+        cluster_rl = self.rate_limits.cluster_launch
+        cluster_budget = {c.name: cluster_rl.get_token_count(c.name)
+                          for c in clusters} if cluster_rl.enforce else None
         i = 0
         for job in considerable:
             cluster = clusters[i % len(clusters)]
             i += 1
+            if cluster_budget is not None:
+                if cluster_budget[cluster.name] < 1:
+                    result.unmatched.append(job)
+                    continue
+                cluster_budget[cluster.name] -= 1
             task_id = new_uuid()
             try:
                 self.store.launch_instance(job.uuid, task_id, hostname="",
@@ -168,6 +192,8 @@ class Scheduler:
             except AbortTransaction as e:
                 result.launch_failures.append((job.uuid, e.reason))
                 continue
+            launch_rl.spend(pool_user_key(pool_name, job.user))
+            cluster_rl.spend(cluster.name)
             cluster.kill_lock.acquire_read()
             try:
                 cluster.launch_tasks(pool_name, [LaunchSpec(

@@ -1,0 +1,103 @@
+"""Why-is-my-job-pending explainer.
+
+Mirrors the reference's unscheduled-jobs reasons (reference:
+scheduler/src/cook/rest/unscheduled.clj:172 reasons; fenzo_utils.clj:21-99
+for placement-failure conversion): each reason is {reason, data} and several
+can apply at once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..state.schema import InstanceStatus, Job, JobState, below_quota, job_usage, add_usage
+from ..state.store import Store
+
+
+def job_reasons(store: Store, job: Job,
+                scheduler=None,
+                queue_limits=None) -> List[Dict]:
+    """Compute unscheduled reasons for a waiting job."""
+    reasons: List[Dict] = []
+    if job.state is not JobState.WAITING:
+        reasons.append({"reason": f"The job is {job.state.value}.", "data": {}})
+        return reasons
+    if not job.committed:
+        reasons.append({
+            "reason": "The job is not yet committed (its submission batch "
+                      "has not completed).",
+            "data": {}})
+        return reasons
+
+    # attempts so far
+    failures = 0
+    for tid in job.instances:
+        inst = store.instance(tid)
+        if inst is not None and inst.status is InstanceStatus.FAILED:
+            failures += 1
+    if failures:
+        reasons.append({
+            "reason": "The job has failed instances and is waiting to retry.",
+            "data": {"failures": failures,
+                     "max_retries": job.max_retries}})
+
+    # user quota
+    usage = job_usage(job)
+    for other, _inst in store.running_instances(job.pool):
+        if other.user == job.user:
+            usage = add_usage(usage, job_usage(other))
+    quota = store.get_quota(job.user, job.pool)
+    if not below_quota(quota, usage):
+        reasons.append({
+            "reason": "The job would cause you to exceed resource quotas.",
+            "data": {"quota": {k: v for k, v in quota.items()
+                               if v != float("inf")},
+                     "usage": usage}})
+
+    # queue limits
+    if queue_limits is not None:
+        # probe with one hypothetical job: at exactly the limit, n=0 would
+        # pass and the reason would never surface
+        msg = queue_limits.check_submission(job.pool, job.user, 1)
+        if msg:
+            reasons.append({"reason": "You have reached the limit of jobs "
+                                      "you can have in the queue.",
+                            "data": {"detail": msg}})
+
+    if scheduler is not None:
+        # launch rate limit
+        rl = scheduler.rate_limits.job_launch
+        if rl.enforce:
+            from ..policy import pool_user_key
+            key = pool_user_key(job.pool, job.user)
+            if rl.get_token_count(key) <= 0:
+                reasons.append({
+                    "reason": "You are currently rate limited on how many "
+                              "jobs you launch per minute.",
+                    "data": {"seconds_until_out_of_debt":
+                             rl.time_until_out_of_debt_s(key)}})
+        # queue position
+        queue = scheduler.pending_queues.get(job.pool, [])
+        position = next((i for i, j in enumerate(queue)
+                         if j.uuid == job.uuid), None)
+        if position is not None:
+            reasons.append({
+                "reason": "The job is waiting for its turn in the queue.",
+                "data": {"queue_position": position,
+                         "queue_length": len(queue)}})
+        # placement failure from the last match cycle
+        last = getattr(scheduler, "last_match_results", {}).get(job.pool)
+        if last is not None and any(j.uuid == job.uuid for j in last.unmatched):
+            reasons.append({
+                "reason": "The job couldn't be placed on any available hosts.",
+                "data": {"considered": last.considered,
+                         "offers_were_available": bool(last.matched
+                                                       or last.considered)}})
+    if not reasons:
+        reasons.append({
+            "reason": "The job is just waiting for its turn. "
+                      "Check back soon!",
+            "data": {}})
+    return reasons

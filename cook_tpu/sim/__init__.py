@@ -1,0 +1,8 @@
+from .simulator import (  # noqa: F401
+    SimResult,
+    Simulator,
+    generate_example_hosts,
+    generate_example_trace,
+    load_hosts,
+    load_trace,
+)

@@ -1,0 +1,47 @@
+"""CLI: python -m cook_tpu.sim --trace trace.json --hosts hosts.json."""
+
+import argparse
+import json
+import sys
+
+from .simulator import (
+    Simulator,
+    generate_example_hosts,
+    generate_example_trace,
+    load_hosts,
+    load_trace,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cook_tpu.sim")
+    p.add_argument("--trace", help="trace JSON file (default: generated)")
+    p.add_argument("--hosts", help="hosts JSON file (default: generated)")
+    p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--jobs", type=int, default=200,
+                   help="generated trace size")
+    p.add_argument("--n-hosts", type=int, default=20)
+    p.add_argument("--out", help="write task records CSV here")
+    args = p.parse_args(argv)
+
+    trace_entries = (json.load(open(args.trace)) if args.trace
+                     else generate_example_trace(args.jobs))
+    host_entries = (json.load(open(args.hosts)) if args.hosts
+                    else generate_example_hosts(args.n_hosts))
+    sim = Simulator(load_trace(trace_entries), load_hosts(host_entries),
+                    backend=args.backend)
+    result = sim.run()
+    print(json.dumps(result.summary(), indent=2))
+    if args.out:
+        import csv
+        with open(args.out, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=[
+                "job", "user", "task", "host", "status", "start", "end",
+                "preempted"])
+            writer.writeheader()
+            writer.writerows(result.task_records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

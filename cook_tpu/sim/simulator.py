@@ -1,0 +1,232 @@
+"""Faster-than-real-time trace-replay simulator.
+
+The port of the reference's simulator (reference:
+scheduler/test/cook/test/zz_simulator.clj:355-718 + docs/simulator.md and the
+mesos_mock offer fabricator): replay a JSON job trace against the *real*
+scheduler wired to the fake cluster on a virtual clock.  Time advances only
+between events, so runs compare *decisions*, not wall time; the wall-clock
+cost of each rank/match cycle is recorded separately as the performance
+metric (BASELINE.md: match-cycle p50/p99 + placements/sec).
+
+Trace format (one job per entry):
+  {"uuid": ..., "user": "u1", "submit_time": ms, "duration": ms,
+   "cpus": 1.0, "mem": 100.0, "gpus": 0, "priority": 50, "pool": "default"}
+Host file: [{"hostname": "h1", "cpus": 8, "mem": 8192, "gpus": 0, ...}]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..cluster.fake import FakeCluster, FakeHost
+from ..config import Config
+from ..sched.scheduler import Scheduler
+from ..state.schema import InstanceStatus, Job, JobState, Resources, new_uuid
+from ..state.store import Store
+
+
+@dataclass
+class SimResult:
+    completed: int = 0
+    total: int = 0
+    preemptions: int = 0
+    makespan_ms: int = 0
+    wait_times_ms: List[int] = field(default_factory=list)
+    match_wall_ms: List[float] = field(default_factory=list)
+    rank_wall_ms: List[float] = field(default_factory=list)
+    placements: int = 0
+    task_records: List[Dict] = field(default_factory=list)
+
+    def summary(self) -> Dict:
+        wt = np.asarray(self.wait_times_ms or [0])
+        mw = np.asarray(self.match_wall_ms or [0.0])
+        rw = np.asarray(self.rank_wall_ms or [0.0])
+        wall_s = (np.sum(mw) + np.sum(rw)) / 1000.0
+        return {
+            "jobs_total": self.total,
+            "jobs_completed": self.completed,
+            "preemptions": self.preemptions,
+            "makespan_virtual_s": self.makespan_ms / 1000.0,
+            "wait_time_p50_s": float(np.percentile(wt, 50)) / 1000.0,
+            "wait_time_p99_s": float(np.percentile(wt, 99)) / 1000.0,
+            "match_cycle_p50_ms": float(np.percentile(mw, 50)),
+            "match_cycle_p99_ms": float(np.percentile(mw, 99)),
+            "rank_cycle_p50_ms": float(np.percentile(rw, 50)),
+            "placements": self.placements,
+            "placements_per_wall_s": (self.placements / wall_s
+                                      if wall_s > 0 else float("inf")),
+        }
+
+
+def load_trace(entries: List[Dict]) -> List[Job]:
+    jobs = []
+    for e in entries:
+        jobs.append(Job(
+            uuid=e.get("uuid") or new_uuid(),
+            user=e["user"],
+            command=e.get("command", "sim"),
+            resources=Resources(cpus=float(e.get("cpus", 1.0)),
+                                mem=float(e.get("mem", 100.0)),
+                                gpus=float(e.get("gpus", 0.0))),
+            priority=int(e.get("priority", 50)),
+            max_retries=int(e.get("max_retries", 3)),
+            pool=e.get("pool", "default"),
+            submit_time_ms=int(e["submit_time"]),
+            labels={"sim/duration_ms": str(int(e.get("duration", 1000)))},
+        ))
+    jobs.sort(key=lambda j: j.submit_time_ms)
+    return jobs
+
+
+def load_hosts(entries: List[Dict]) -> List[FakeHost]:
+    return [FakeHost(
+        hostname=e["hostname"],
+        capacity=Resources(cpus=float(e.get("cpus", 8.0)),
+                           mem=float(e.get("mem", 8192.0)),
+                           gpus=float(e.get("gpus", 0.0))),
+        pool=e.get("pool", "default"),
+        attributes=dict(e.get("attributes", {})),
+        gpu_model=e.get("gpu_model", ""))
+        for e in entries]
+
+
+class Simulator:
+    def __init__(self, trace: List[Job], hosts: List[FakeHost],
+                 config: Optional[Config] = None, backend: str = "tpu",
+                 rank_interval_ms: int = 5000, match_interval_ms: int = 1000,
+                 rebalance_interval_ms: int = 30000):
+        self.trace = trace
+        self.config = config or Config()
+        if backend == "cpu":
+            self.config.default_matcher.backend = "cpu"
+        self.store = Store()
+        self.cluster = FakeCluster("sim", hosts)
+        self.scheduler = Scheduler(self.store, self.config, [self.cluster],
+                                   rank_backend=backend)
+        self.rank_interval_ms = rank_interval_ms
+        self.match_interval_ms = match_interval_ms
+        self.rebalance_interval_ms = rebalance_interval_ms
+        # job uuid -> virtual duration; the fake cluster resolves durations
+        # at launch time through this shared mapping
+        self._job_durations: Dict[str, int] = {}
+        self.cluster.job_durations_ms = self._job_durations
+
+    def run(self, until_ms: Optional[int] = None,
+            max_virtual_ms: int = 24 * 3600 * 1000) -> SimResult:
+        result = SimResult(total=len(self.trace))
+        if not self.trace:
+            return result
+        pending = list(self.trace)
+        now = pending[0].submit_time_ms
+        next_rank = now
+        next_match = now
+        next_rebalance = now + self.rebalance_interval_ms
+        deadline = until_ms if until_ms is not None \
+            else pending[-1].submit_time_ms + max_virtual_ms
+        start_ms = now
+
+        while now <= deadline:
+            # deliver submissions due now
+            while pending and pending[0].submit_time_ms <= now:
+                job = pending.pop(0)
+                self._job_durations[job.uuid] = int(
+                    job.labels["sim/duration_ms"])
+                self.store.create_jobs([job])
+            # cycles (virtual-time frozen during computation)
+            if now >= next_rank:
+                t0 = time.perf_counter()
+                self.scheduler.step_rank()
+                result.rank_wall_ms.append((time.perf_counter() - t0) * 1000)
+                next_rank = now + self.rank_interval_ms
+            if now >= next_match:
+                t0 = time.perf_counter()
+                match_results = self.scheduler.step_match()
+                result.match_wall_ms.append((time.perf_counter() - t0) * 1000)
+                for res in match_results.values():
+                    result.placements += len(res.launched_task_ids)
+                next_match = now + self.match_interval_ms
+            if now >= next_rebalance:
+                self.scheduler.step_rank()
+                decisions = self.scheduler.step_rebalance()
+                for pool_decisions in decisions.values():
+                    for d in pool_decisions:
+                        result.preemptions += len(d.victim_task_ids)
+                next_rebalance = now + self.rebalance_interval_ms
+            self.scheduler.step_reapers(current_ms=now)
+
+            # advance the clock to the next interesting moment
+            candidates = [next_rank, next_match, next_rebalance]
+            if pending:
+                candidates.append(pending[0].submit_time_ms)
+            completion = self._next_completion_ms()
+            if completion is not None:
+                candidates.append(completion)
+            nxt = min(candidates)
+            if nxt <= now:
+                nxt = now + self.match_interval_ms
+            now = nxt
+            self.cluster.advance_to(now)
+            if not pending and self._all_done():
+                break
+
+        # harvest
+        result.makespan_ms = now - start_ms
+        for job in self.trace:
+            stored = self.store.job(job.uuid)
+            if stored is None:
+                continue
+            if stored.state is JobState.COMPLETED:
+                result.completed += 1
+            for tid in stored.instances:
+                inst = self.store.instance(tid)
+                if inst is None:
+                    continue
+                result.task_records.append({
+                    "job": job.uuid, "user": job.user, "task": tid,
+                    "host": inst.hostname,
+                    "status": inst.status.value,
+                    "start": inst.start_time_ms, "end": inst.end_time_ms,
+                    "preempted": inst.preempted,
+                })
+                if inst.queue_time_ms is not None:
+                    result.wait_times_ms.append(inst.queue_time_ms)
+        return result
+
+    def _next_completion_ms(self) -> Optional[int]:
+        with self.cluster._lock:
+            times = [t.started_at_ms + t.duration_ms
+                     for t in self.cluster._tasks.values()
+                     if t.duration_ms is not None]
+        return min(times) if times else None
+
+    def _all_done(self) -> bool:
+        return not self.store.jobs_where(
+            lambda j: j.state is not JobState.COMPLETED)
+
+
+def generate_example_trace(n_jobs: int = 200, n_users: int = 6,
+                           seed: int = 0, span_ms: int = 60_000,
+                           duration_ms: int = 10_000) -> List[Dict]:
+    """Statistical workload generator (reference: simulator/ subproject)."""
+    rng = np.random.default_rng(seed)
+    return [{
+        "user": f"user{int(rng.integers(0, n_users)):02d}",
+        "submit_time": int(rng.integers(0, span_ms)),
+        "duration": int(rng.exponential(duration_ms)) + 100,
+        "cpus": float(rng.integers(1, 8)),
+        "mem": float(rng.integers(64, 2048)),
+        "priority": int(rng.integers(0, 100)),
+    } for _ in range(n_jobs)]
+
+
+def generate_example_hosts(n_hosts: int = 20, seed: int = 0) -> List[Dict]:
+    rng = np.random.default_rng(seed)
+    return [{"hostname": f"host{i:03d}",
+             "cpus": float(rng.choice([8, 16, 32])),
+             "mem": float(rng.choice([8192, 16384, 32768]))}
+            for i in range(n_hosts)]

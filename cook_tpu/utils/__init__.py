@@ -1,0 +1,8 @@
+from .logging import (  # noqa: F401
+    JsonFormatter,
+    Passport,
+    log_structured,
+    passport,
+    wire_store_passport,
+)
+from .metrics import MetricsRegistry, registry  # noqa: F401

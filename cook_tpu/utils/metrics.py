@@ -1,0 +1,108 @@
+"""Minimal metrics registry with Prometheus text exposition.
+
+Plays the role of the reference's tri-recorded metrics (reference:
+prometheus_metrics.clj — 765 LoC of metric defs with a with-duration macro;
+reporter.clj dropwizard wiring): counters, gauges, and duration histograms
+keyed by (name, labels), exposed at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            5.0, 10.0)
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _labels_str(key: Tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        # histogram state is fixed-size: cumulative bucket counts + count/sum
+        self._histograms: Dict[Tuple[str, Tuple], Dict] = {}
+
+    def counter_inc(self, name: str, value: float = 1.0,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[(name, _labels_key(labels))] = value
+
+    def observe(self, name: str, value_s: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = {"buckets": [0] * len(_BUCKETS), "count": 0, "sum": 0.0}
+                self._histograms[key] = h
+            for i, b in enumerate(_BUCKETS):
+                if value_s <= b:
+                    h["buckets"][i] += 1
+            h["count"] += 1
+            h["sum"] += value_s
+
+    @contextmanager
+    def time(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """The reference's with-duration macro."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0, labels)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "counters": {f"{n}{_labels_str(k)}": v
+                             for (n, k), v in self._counters.items()},
+                "gauges": {f"{n}{_labels_str(k)}": v
+                           for (n, k), v in self._gauges.items()},
+                "histogram_counts": {f"{n}{_labels_str(k)}": v["count"]
+                                     for (n, k), v in self._histograms.items()},
+            }
+
+    def expose(self) -> str:
+        """Prometheus text format."""
+        lines: List[str] = []
+        with self._lock:
+            for (name, key), value in sorted(self._counters.items()):
+                lines.append(f"{name}_total{_labels_str(key)} {value}")
+            for (name, key), value in sorted(self._gauges.items()):
+                lines.append(f"{name}{_labels_str(key)} {value}")
+            for (name, key), h in sorted(self._histograms.items()):
+                for i, b in enumerate(_BUCKETS):
+                    bucket_key = key + (("le", str(b)),)
+                    lines.append(f"{name}_bucket{_labels_str(bucket_key)} "
+                                 f"{h['buckets'][i]}")
+                lines.append(f"{name}_count{_labels_str(key)} {h['count']}")
+                lines.append(f"{name}_sum{_labels_str(key)} {h['sum']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+registry = MetricsRegistry()

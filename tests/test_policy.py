@@ -1,0 +1,230 @@
+"""Policy-layer tests: rate limiting, queue limits, plugins."""
+
+import time
+
+import pytest
+
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config
+from cook_tpu.policy import (
+    JobLaunchFilter,
+    JobSubmissionModifier,
+    JobSubmissionValidator,
+    PluginRegistry,
+    PluginResult,
+    QueueLimits,
+    RateLimits,
+    TokenBucketRateLimiter,
+    pool_user_key,
+)
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import InstanceStatus, Job, JobState, Resources, Store, new_uuid
+
+
+def make_job(user="alice", pool="default", **kw):
+    kw.setdefault("resources", Resources(cpus=1, mem=100))
+    return Job(uuid=new_uuid(), user=user, pool=pool, command="x", **kw)
+
+
+class TestTokenBucket:
+    def test_spend_and_replenish(self):
+        now = [0.0]
+        rl = TokenBucketRateLimiter(tokens_per_minute=60, bucket_size=5,
+                                    clock=lambda: now[0])
+        assert rl.get_token_count("u") == 5
+        for _ in range(5):
+            rl.spend("u")
+        assert rl.get_token_count("u") == 0
+        assert not rl.within_limit("u")
+        now[0] += 2.0  # 2 seconds -> 2 tokens
+        assert rl.get_token_count("u") == pytest.approx(2.0)
+        assert rl.within_limit("u")
+
+    def test_debt_and_time_until_out(self):
+        now = [0.0]
+        rl = TokenBucketRateLimiter(tokens_per_minute=60, bucket_size=2,
+                                    clock=lambda: now[0])
+        rl.spend("u", 5)  # 3 tokens of debt
+        assert rl.time_until_out_of_debt_s("u") == pytest.approx(3.0)
+
+    def test_bucket_caps_at_size(self):
+        now = [0.0]
+        rl = TokenBucketRateLimiter(tokens_per_minute=60, bucket_size=3,
+                                    clock=lambda: now[0])
+        now[0] += 1000
+        assert rl.get_token_count("u") == 3
+
+    def test_enforce_off(self):
+        rl = TokenBucketRateLimiter(1, 1, enforce=False)
+        rl.spend("u", 100)
+        assert rl.within_limit("u")
+
+
+class TestLaunchRateLimitIntegration:
+    def test_launch_rate_limits_users_per_cycle(self):
+        now = [0.0]
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost(f"h{i}", Resources(cpus=8, mem=8192))
+                                    for i in range(4)])
+        rl = RateLimits(job_launch=TokenBucketRateLimiter(
+            tokens_per_minute=0.0001, bucket_size=2, clock=lambda: now[0]))
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          rate_limits=rl)
+        store.create_jobs([make_job() for _ in range(6)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 2  # bucket size caps the cycle
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 0  # tokens spent, none earned
+
+    def test_cluster_launch_rate_limit(self):
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost(f"h{i}", Resources(cpus=8, mem=8192))
+                                    for i in range(4)])
+        rl = RateLimits(cluster_launch=TokenBucketRateLimiter(
+            tokens_per_minute=0.0001, bucket_size=3))
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          rate_limits=rl)
+        store.create_jobs([make_job(user=f"u{i}") for i in range(6)])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 3
+
+
+class TestDirectModeRateLimit:
+    def test_direct_pool_spends_launch_tokens(self):
+        from cook_tpu.state import Pool, SchedulerKind
+        store = Store()
+        hosts = [FakeHost(f"h{i}", Resources(cpus=8, mem=8192), pool="direct")
+                 for i in range(4)]
+        cluster = FakeCluster("c", hosts)
+        rl = RateLimits(job_launch=TokenBucketRateLimiter(
+            tokens_per_minute=0.0001, bucket_size=2))
+        store.put_pool(Pool(name="direct", scheduler=SchedulerKind.DIRECT))
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          rate_limits=rl)
+        store.create_jobs([make_job(pool="direct") for _ in range(6)])
+        sched.step_rank()
+        res = sched.step_match("direct")["direct"]
+        assert len(res.launched_task_ids) == 2
+        sched.step_rank()
+        res = sched.step_match("direct")["direct"]
+        assert len(res.launched_task_ids) == 0  # tokens spent
+
+
+class TestQueueLimits:
+    def test_per_user_cap(self):
+        store = Store()
+        ql = QueueLimits(store, per_user_limit=2)
+        store.create_jobs([make_job(), make_job()])
+        assert ql.check_submission("default", "alice", 1) is not None
+        assert ql.check_submission("default", "bob", 2) is None
+
+    def test_per_pool_cap(self):
+        store = Store()
+        ql = QueueLimits(store, per_pool_limit=3)
+        store.create_jobs([make_job(user=f"u{i}") for i in range(3)])
+        assert ql.check_submission("default", "x", 1) is not None
+        assert ql.check_submission("other", "x", 3) is None
+
+    def test_counts_track_state_transitions(self):
+        store = Store()
+        ql = QueueLimits(store, per_user_limit=10)
+        [uuid] = store.create_jobs([make_job()])
+        assert ql.counts()["pools"]["default"] == 1
+        store.launch_instance(uuid, "t1", "h1")
+        assert ql.counts()["pools"]["default"] == 0
+        store.update_instance_status("t1", InstanceStatus.FAILED, reason_code=7)
+        assert ql.counts()["pools"]["default"] == 1  # mea-culpa requeue
+
+    def test_user_override(self):
+        store = Store()
+        ql = QueueLimits(store, per_user_limit=100,
+                         user_overrides={"greedy": 1})
+        store.create_jobs([make_job(user="greedy")])
+        assert ql.check_submission("default", "greedy", 1) is not None
+
+
+class RejectBigJobs(JobSubmissionValidator):
+    def validate(self, job):
+        if job.resources.cpus > 8:
+            return PluginResult.rejected("too big")
+        return PluginResult.accepted()
+
+
+class AddLabel(JobSubmissionModifier):
+    def modify(self, job):
+        job.labels["injected"] = "yes"
+        return job
+
+
+class DeferAll(JobLaunchFilter):
+    calls = 0
+
+    def check(self, job):
+        DeferAll.calls += 1
+        return PluginResult.deferred("not yet", ttl_s=1000)
+
+
+class TestPlugins:
+    def test_submission_validator(self):
+        reg = PluginRegistry(validators=[RejectBigJobs()])
+        assert reg.validate_submission(
+            make_job(resources=Resources(cpus=16, mem=10))) == "too big"
+        assert reg.validate_submission(make_job()) is None
+
+    def test_submission_modifier(self):
+        reg = PluginRegistry(modifiers=[AddLabel()])
+        job = reg.modify_submission(make_job())
+        assert job.labels["injected"] == "yes"
+
+    def test_launch_filter_defers_and_caches(self):
+        DeferAll.calls = 0
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        reg = PluginRegistry(launch_filters=[DeferAll()])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          plugins=reg)
+        store.create_jobs([make_job()])
+        sched.step_rank()
+        assert sched.step_match()["default"].launched_task_ids == []
+        sched.step_rank()
+        sched.step_match()
+        assert DeferAll.calls == 1  # second cycle hit the verdict cache
+
+    def test_completion_handler_fires(self):
+        seen = []
+
+        class Handler:
+            def on_completion(self, job, instance):
+                seen.append((job.uuid, instance.task_id, instance.status))
+
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        reg = PluginRegistry(completion_handlers=[Handler()])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          plugins=reg)
+        [uuid] = store.create_jobs([make_job()])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        cluster.complete_task(tid)
+        assert seen and seen[0][0] == uuid
+
+    def test_registry_from_config(self):
+        reg = PluginRegistry.from_config({
+            "validators": ["tests.test_policy.RejectBigJobs"],
+            "modifiers": ["tests.test_policy.AddLabel"],
+        })
+        assert len(reg.validators) == 1
+        assert len(reg.modifiers) == 1

@@ -1,0 +1,270 @@
+"""REST API + python client + CLI tests over a live threaded server,
+modeled on the reference's integration tier (SURVEY.md section 4 tier 4)."""
+
+import json
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config
+from cook_tpu.policy import QueueLimits, RateLimits, TokenBucketRateLimiter
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import Resources, Store
+
+
+@pytest.fixture()
+def system():
+    store = Store()
+    cluster = FakeCluster(
+        "fake-1", [FakeHost(f"h{i}", Resources(cpus=8, mem=8192))
+                   for i in range(2)])
+    cfg = Config()
+    cfg.default_matcher.backend = "cpu"
+    sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+    api = CookApi(store, scheduler=sched,
+                  queue_limits=QueueLimits(store, per_user_limit=100),
+                  admins=["admin"], impersonators=["proxy"])
+    server = ApiServer(api)
+    server.start()
+    yield store, cluster, sched, server
+    server.stop()
+
+
+def client_for(server, user="alice") -> JobClient:
+    return JobClient(server.url, user=user)
+
+
+class TestJobsEndpoint:
+    def test_submit_query_lifecycle(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("echo hi", cpus=1, mem=100, name="myjob")
+        job = client.job(uuid)
+        assert job["state"] == "waiting"
+        assert job["name"] == "myjob"
+        assert job["user"] == "alice"
+        sched.step_rank()
+        sched.step_match()
+        job = client.job(uuid)
+        assert job["state"] == "running"
+        assert len(job["instances"]) == 1
+        cluster.complete_task(job["instances"][0]["task_id"])
+        job = client.job(uuid)
+        assert job["state"] == "completed"
+        assert job["instances"][0]["status"] == "success"
+
+    def test_batch_submit_is_atomic(self, system):
+        store, _c, _s, server = system
+        client = client_for(server)
+        # second job malformed -> nothing is created
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "a"}, {"cpus": "x"}])
+        assert e.value.status == 400
+        assert client.jobs(user="alice") == []
+
+    def test_duplicate_uuid_conflict(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        uuid = client.submit_one("echo")
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "echo", "uuid": uuid}])
+        assert e.value.status == 409
+
+    def test_kill_requires_owner_or_admin(self, system):
+        _store, _c, _s, server = system
+        alice, bob = client_for(server), client_for(server, "bob")
+        uuid = alice.submit_one("sleep 100")
+        with pytest.raises(JobClientError) as e:
+            bob.kill([uuid])
+        assert e.value.status == 403
+        admin = client_for(server, "admin")
+        assert admin.kill([uuid])["killed"] == [uuid]
+
+    def test_query_by_user_and_state(self, system):
+        _store, _c, sched, server = system
+        alice = client_for(server)
+        u1 = alice.submit_one("a")
+        sched.step_rank(); sched.step_match()
+        u2 = alice.submit_one("b")
+        running = alice.jobs(user="alice", states=["running"])
+        waiting = alice.jobs(user="alice", states=["waiting"])
+        assert [j["uuid"] for j in running] == [u1]
+        assert [j["uuid"] for j in waiting] == [u2]
+
+    def test_retry_endpoint(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x", max_retries=1)
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        cluster.complete_task(tid, exit_code=3)
+        assert client.job(uuid)["state"] == "completed"
+        client.retry(uuid, 5)
+        assert client.job(uuid)["state"] == "waiting"
+
+    def test_submission_rate_limit(self, system):
+        store, _c, sched, server = system
+        api_rl = sched.rate_limits
+        api_rl.job_submission = TokenBucketRateLimiter(
+            tokens_per_minute=0.001, bucket_size=2)
+        client = client_for(server)
+        client.submit_one("a")
+        client.submit_one("b")
+        with pytest.raises(JobClientError) as e:
+            client.submit_one("c")
+        assert e.value.status == 429
+
+    def test_queue_limit_rejects(self, system):
+        store, _c, _s, server = system
+        client = client_for(server)
+        # per_user_limit=100 from fixture
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x"} for _ in range(101)])
+        assert e.value.status == 422
+
+
+class TestImpersonation:
+    def test_impersonator_submits_as_other(self, system):
+        _store, _c, _s, server = system
+        proxy = JobClient(server.url, user="proxy", impersonate="carol")
+        uuid = proxy.submit_one("x")
+        assert proxy.job(uuid)["user"] == "carol"
+
+    def test_non_impersonator_rejected(self, system):
+        _store, _c, _s, server = system
+        evil = JobClient(server.url, user="evil", impersonate="carol")
+        with pytest.raises(JobClientError) as e:
+            evil.submit_one("x")
+        assert e.value.status == 403
+
+    def test_impersonation_denied_with_empty_admin_list(self):
+        # regression: an empty admins list must not open impersonation to all
+        store = Store()
+        api = CookApi(store, impersonators=["svc"], admins=[])
+        with pytest.raises(Exception) as e:
+            api.resolve_user("mallory", "alice")
+        assert "may not impersonate" in str(e.value)
+        assert api.resolve_user("svc", "alice") == "alice"
+
+
+class TestAdminEndpoints:
+    def test_share_quota_roundtrip(self, system):
+        _store, _c, _s, server = system
+        admin = client_for(server, "admin")
+        admin.set_share("alice", {"default": {"cpus": 10.0, "mem": 1000.0}})
+        share = admin.get_share("alice")
+        assert share["default"]["cpus"] == 10.0
+        admin.set_quota("alice", {"default": {"cpus": 4.0, "count": 2}})
+        quota = admin.get_quota("alice")
+        assert quota["default"]["cpus"] == 4.0
+        # non-admin cannot set
+        with pytest.raises(JobClientError) as e:
+            client_for(server).set_share("bob", {"default": {"cpus": 1}})
+        assert e.value.status == 403
+
+    def test_queue_endpoint_admin_only(self, system):
+        _store, _c, sched, server = system
+        client = client_for(server)
+        client.submit_one("x")
+        sched.step_rank()
+        with pytest.raises(JobClientError):
+            client.queue()
+        q = client_for(server, "admin").queue()
+        assert len(q["default"]) == 1
+
+    def test_usage_and_stats(self, system):
+        _store, _c, sched, server = system
+        client = client_for(server)
+        client.submit_one("x", cpus=2, mem=256)
+        sched.step_rank(); sched.step_match()
+        usage = client.usage("alice")
+        assert usage["total_usage"]["cpus"] == 2.0
+        stats = client.stats()
+        assert stats["by_status"].get("unknown", 0) >= 1 \
+            or stats["by_status"].get("running", 0) >= 1
+
+    def test_info_debug_settings_pools_reasons(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        assert "version" in client.info()
+        assert client.pools()[0]["name"] == "default"
+        reasons = client.failure_reasons()
+        assert any(r["name"] == "preempted-by-rebalancer" and r["mea_culpa"]
+                   for r in reasons)
+
+    def test_metrics_exposition(self, system):
+        _store, _c, _s, server = system
+        text = client_for(server).metrics()
+        assert "cook_jobs_waiting" in text
+
+
+class TestUnscheduledExplainer:
+    def test_waiting_reasons(self, system):
+        store, _c, sched, server = system
+        client = client_for(server)
+        admin = client_for(server, "admin")
+        admin.set_quota("alice", {"default": {"cpus": 0.5}})
+        uuid = client.submit_one("x", cpus=2)
+        sched.step_rank()
+        [explained] = client.unscheduled_jobs([uuid])
+        reasons = [r["reason"] for r in explained["reasons"]]
+        assert any("quota" in r for r in reasons)
+
+
+class TestProgressEndpoint:
+    def test_progress_updates(self, system):
+        store, _c, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x")
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        import urllib.request
+        req = urllib.request.Request(
+            f"{server.url}/progress/{tid}", method="POST",
+            data=json.dumps({"progress_percent": 50,
+                             "progress_message": "halfway",
+                             "progress_sequence": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+        inst = client.instance(tid)
+        assert inst["progress"] == 50
+        assert inst["progress_message"] == "halfway"
+
+
+class TestCli:
+    def test_submit_show_wait_kill_flow(self, system, capsys):
+        store, cluster, sched, server = system
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "--user", "cliuser",
+                     "submit", "--cpus", "1", "--mem", "64", "echo", "hi"]) == 0
+        uuid = capsys.readouterr().out.strip()
+        assert main(["--url", server.url, "show", uuid]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown[0]["uuid"] == uuid
+        sched.step_rank(); sched.step_match()
+        job = store.job(uuid)
+        cluster.complete_task(job.instances[0])
+        assert main(["--url", server.url, "wait", uuid]) == 0
+        capsys.readouterr()
+        assert main(["--url", server.url, "jobs", "--for-user", "cliuser",
+                     "--state", "completed"]) == 0
+        listed = json.loads(capsys.readouterr().out)
+        assert [j["uuid"] for j in listed] == [uuid]
+
+    def test_admin_share_via_cli(self, system, capsys):
+        _store, _c, _s, server = system
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "share", "--for-user", "bob", "--set", "cpus=5"]) == 0
+        capsys.readouterr()
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "share", "--for-user", "bob"]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["default"]["cpus"] == 5.0
+
+    def test_cli_error_handling(self, system, capsys):
+        _store, _c, _s, server = system
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "show", "nonexistent-uuid"]) == 1
