@@ -1,0 +1,7 @@
+from .executor import (  # noqa: F401
+    DEFAULT_PROGRESS_REGEX,
+    ProgressWatcher,
+    TaskExecutor,
+    rest_progress_publisher,
+)
+from .file_server import SandboxFileServer  # noqa: F401

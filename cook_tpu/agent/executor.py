@@ -1,0 +1,175 @@
+"""On-node task executor.
+
+The port of the reference's executor agent (reference: executor/cook/
+executor.py:421-510, subprocess.py, progress.py:123-297):
+
+ - runs the user command in its own process group/session so the whole tree
+   can be signalled;
+ - streams stdout/stderr into sandbox files;
+ - watches a configurable progress regex in the output (and an optional
+   explicit progress file), publishing sequenced updates to the scheduler's
+   ``POST /progress/<task-id>`` endpoint (the sidecar path) or a local
+   callback;
+ - graceful kill via escalating signals to the process group
+   (subprocess.py:102-232): SIGTERM, grace period, SIGKILL;
+ - writes an exit-code sentinel into the sandbox.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import threading
+import time
+import urllib.request
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+DEFAULT_PROGRESS_REGEX = r"progress:?\s+([0-9]*\.?[0-9]+)%?(?:\s+(.*))?"
+
+
+class ProgressWatcher:
+    """Extract monotone progress updates from output lines (reference:
+    progress.py:123-297: latest-by-sequence, capped message length)."""
+
+    def __init__(self, regex: str = DEFAULT_PROGRESS_REGEX,
+                 publish: Optional[Callable[[int, int, str], None]] = None,
+                 max_message_length: int = 512):
+        self.pattern = re.compile(regex)
+        self.publish = publish
+        self.max_message_length = max_message_length
+        self.sequence = 0
+        self.last_percent: Optional[int] = None
+        self.last_message = ""
+
+    def observe_line(self, line: str) -> None:
+        match = self.pattern.search(line)
+        if not match:
+            return
+        try:
+            percent = int(float(match.group(1)))
+        except ValueError:
+            return
+        percent = max(0, min(100, percent))
+        has_msg = match.lastindex is not None and match.lastindex >= 2
+        message = ((match.group(2) if has_msg else "") or "") \
+            .strip()[:self.max_message_length]
+        self.sequence += 1
+        self.last_percent = percent
+        self.last_message = message
+        if self.publish:
+            self.publish(self.sequence, percent, message)
+
+
+def rest_progress_publisher(api_url: str, task_id: str
+                            ) -> Callable[[int, int, str], None]:
+    """Publish to the scheduler's progress endpoint (the sidecar tracker's
+    path, sidecar/cook/sidecar/tracker.py)."""
+
+    def publish(sequence: int, percent: int, message: str) -> None:
+        body = json.dumps({"progress_sequence": sequence,
+                           "progress_percent": percent,
+                           "progress_message": message}).encode()
+        req = urllib.request.Request(
+            f"{api_url}/progress/{task_id}", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except OSError:
+            pass  # progress is best-effort
+
+    return publish
+
+
+class TaskExecutor:
+    def __init__(self, command: str, sandbox: str,
+                 env: Optional[Dict[str, str]] = None,
+                 progress_regex: str = DEFAULT_PROGRESS_REGEX,
+                 progress_publish: Optional[Callable] = None,
+                 kill_grace_period_s: float = 2.0,
+                 shell: str = "/bin/sh"):
+        self.command = command
+        self.sandbox = Path(sandbox)
+        self.env = dict(env or {})
+        self.kill_grace_period_s = kill_grace_period_s
+        self.shell = shell
+        self.watcher = ProgressWatcher(progress_regex, progress_publish)
+        self.process: Optional[subprocess.Popen] = None
+        self.exit_code: Optional[int] = None
+        self._reader_threads = []
+        self._killed = False
+
+    # ------------------------------------------------------------------ run
+    def start(self) -> None:
+        self.sandbox.mkdir(parents=True, exist_ok=True)
+        env = dict(os.environ)
+        env.update(self.env)
+        env["COOK_WORKDIR"] = str(self.sandbox)
+        self.process = subprocess.Popen(
+            [self.shell, "-c", self.command],
+            cwd=str(self.sandbox), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            start_new_session=True)  # own process group + session
+        for stream, name in ((self.process.stdout, "stdout"),
+                             (self.process.stderr, "stderr")):
+            t = threading.Thread(target=self._pump, args=(stream, name),
+                                 daemon=True)
+            t.start()
+            self._reader_threads.append(t)
+
+    def _pump(self, stream, name: str) -> None:
+        """Stream output to the sandbox file, watching for progress
+        (interleaving-safe: one writer per stream, io_helper.py)."""
+        path = self.sandbox / name
+        with open(path, "ab") as f:
+            for raw in iter(stream.readline, b""):
+                f.write(raw)
+                f.flush()
+                try:
+                    self.watcher.observe_line(
+                        raw.decode("utf-8", errors="replace"))
+                except Exception:
+                    pass
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        if self.process is None:
+            return None
+        try:
+            self.exit_code = self.process.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return None
+        for t in self._reader_threads:
+            t.join(timeout=5)
+        (self.sandbox / "exit_code").write_text(str(self.exit_code))
+        return self.exit_code
+
+    # ----------------------------------------------------------------- kill
+    def kill(self) -> int:
+        """Escalating kill of the whole process group (reference:
+        subprocess.py:102-232). Returns the exit code."""
+        if self.process is None:
+            return -1
+        self._killed = True
+        pgid = os.getpgid(self.process.pid)
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        deadline = time.time() + self.kill_grace_period_s
+        while time.time() < deadline:
+            if self.process.poll() is not None:
+                break
+            time.sleep(0.05)
+        if self.process.poll() is None:
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        return self.wait(timeout_s=10) or self.process.returncode
+
+    @property
+    def running(self) -> bool:
+        return self.process is not None and self.process.poll() is None

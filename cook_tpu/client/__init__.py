@@ -44,21 +44,31 @@ class JobClient:
                     pairs.append((k, v))
             query = "?" + urllib.parse.urlencode(pairs)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            self.url + path + query, data=data, method=method,
-            headers={"Content-Type": "application/json",
-                     "X-Cook-User": self.user,
-                     **({"X-Cook-Impersonate": self.impersonate}
-                        if self.impersonate else {})})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                raw = resp.read()
-        except urllib.error.HTTPError as e:
+        url = self.url + path + query
+        headers = {"Content-Type": "application/json",
+                   "X-Cook-User": self.user,
+                   **({"X-Cook-Impersonate": self.impersonate}
+                      if self.impersonate else {})}
+        raw = None
+        for _hop in range(4):  # follow leader redirects (307) incl. POST,
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
             try:
-                message = json.loads(e.read()).get("error", str(e))
-            except Exception:
-                message = str(e)
-            raise JobClientError(e.code, message)
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    raw = resp.read()
+                break
+            except urllib.error.HTTPError as e:
+                if e.code == 307 and e.headers.get("Location"):
+                    url = e.headers["Location"]
+                    continue
+                try:
+                    message = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    message = str(e)
+                raise JobClientError(e.code, message)
+        else:
+            raise JobClientError(508, "redirect loop")
         if path == "/metrics":
             return raw.decode()
         return json.loads(raw) if raw else None

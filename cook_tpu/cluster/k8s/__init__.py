@@ -1,0 +1,8 @@
+from .compute_cluster import KubernetesCluster  # noqa: F401
+from .controller import (  # noqa: F401
+    CookExpected,
+    PodController,
+    PodState,
+    synthesize_pod_state,
+)
+from .fake_api import FakeKubernetesApi, FakeNode, FakePod, WatchEvent  # noqa: F401

@@ -1,0 +1,233 @@
+"""Kubernetes-style compute cluster backend.
+
+Mirrors the reference's KubernetesComputeCluster (reference:
+scheduler/src/cook/kubernetes/compute_cluster.clj:410-741):
+
+ - offers are *synthesized* from watch state: per node, capacity minus the
+   consumption of live pods (generate-offers :68-174, get-capacity/
+   get-consumption api.clj:874-927);
+ - launch builds a pod and feeds the controller (launch-task! :319-347);
+ - startup reconstructs expected state from the store union live pods
+   (determine-cook-expected-state-on-startup :253-288);
+ - autoscaling launches placeholder "synthetic pods" sized like unmatched
+   jobs so a cluster autoscaler provisions nodes (autoscale! :590-715);
+ - max_launchable gives direct-mode backpressure from node/pod headroom
+   (:555-588).
+
+Works against any object with the FakeKubernetesApi surface; a real
+kubernetes client adapter can implement the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ...state.schema import InstanceStatus, Job, Resources
+from ...state.store import Store
+from ..base import ComputeCluster, LaunchSpec, Offer
+from .controller import CookExpected, PodController, synthesize_pod_state
+from .fake_api import FakeKubernetesApi, FakeNode, FakePod
+
+SYNTHETIC_PREFIX = "synthetic-"
+
+
+class KubernetesCluster(ComputeCluster):
+    def __init__(self, name: str, api: Optional[FakeKubernetesApi] = None,
+                 store: Optional[Store] = None,
+                 max_total_pods: int = 10_000,
+                 max_pods_per_node: int = 32,
+                 synthetic_pod_ttl_ms: int = 120_000):
+        super().__init__(name)
+        self.api = api or FakeKubernetesApi()
+        self.store = store
+        self.max_total_pods = max_total_pods
+        self.max_pods_per_node = max_pods_per_node
+        self._watch_registered = False
+        self.controller = PodController(
+            self.api,
+            on_pod_started=self._pod_started,
+            on_pod_completed=self._pod_completed,
+            on_pod_killed=self._pod_killed,
+            managed_filter=lambda pod: self._cook_managed(pod))
+
+    # ------------------------------------------------------------- lifecycle
+    def initialize(self, status_callback) -> None:
+        super().initialize(status_callback)
+        if self.store is not None:
+            self._reconcile_startup()
+        if not self._watch_registered:
+            self.api.watch(self._on_watch_event)
+            self._watch_registered = True
+
+    def shutdown(self) -> None:
+        """Detach from the api (leader handoff: the dying leader must stop
+        reacting before the new one adopts the pods)."""
+        if self._watch_registered:
+            self.api.unwatch(self._on_watch_event)
+            self._watch_registered = False
+
+    def _reconcile_startup(self) -> None:
+        """Expected state = store's live instances for this cluster, union
+        live pods (reference: compute_cluster.clj:253-288)."""
+        expected_live = set()
+        for _job, inst in self.store.running_instances():
+            if inst.compute_cluster == self.name:
+                expected_live.add(inst.task_id)
+                self.controller.set_expected(
+                    inst.task_id,
+                    CookExpected.STARTING
+                    if inst.status is InstanceStatus.UNKNOWN
+                    else CookExpected.RUNNING)
+        for pod in self.api.pods():
+            if not self._cook_managed(pod):
+                continue
+            if pod.name not in expected_live:
+                # live pod with no live instance: the controller's
+                # (MISSING, live) arm will clean it up
+                self.controller.set_expected(pod.name, CookExpected.MISSING)
+        self.controller.scan_all()
+
+    @staticmethod
+    def _cook_managed(pod: FakePod) -> bool:
+        """Only pods we launched are controller-managed; foreign pods on
+        shared nodes consume capacity but are never touched (the reference
+        scopes by namespace/naming, kubernetes/api.clj pod<->job naming)."""
+        return (not pod.synthetic) and "cook/job" in pod.labels
+
+    def _on_watch_event(self, event) -> None:
+        if event.kind == "pod" and self._cook_managed(event.obj):
+            if event.type == "DELETED":
+                self.controller.pod_deleted(event.obj.name)
+            else:
+                self.controller.pod_update(event.obj.name)
+
+    # ------------------------------------------------------------ writebacks
+    def _pod_started(self, pod_name: str) -> None:
+        pod = self.api.pod(pod_name)
+        if self._status_callback:
+            self._status_callback(pod_name, InstanceStatus.RUNNING, None,
+                                  hostname=pod.node_name if pod else None)
+
+    def _pod_completed(self, pod_name: str, exit_code: Optional[int],
+                       reason_code: Optional[int]) -> None:
+        ok = (exit_code or 0) == 0 and reason_code is None
+        if self._status_callback:
+            self._status_callback(
+                pod_name,
+                InstanceStatus.SUCCESS if ok else InstanceStatus.FAILED,
+                reason_code, exit_code=exit_code)
+
+    def _pod_killed(self, pod_name: str, reason_code: int) -> None:
+        if self._status_callback:
+            from ...state.schema import Reasons
+            preempted = reason_code == Reasons.PREEMPTED_BY_REBALANCER.code
+            self._status_callback(pod_name, InstanceStatus.FAILED,
+                                  reason_code, preempted=preempted)
+
+    # --------------------------------------------------------------- offers
+    def pending_offers(self, pool: str) -> List[Offer]:
+        consumption: Dict[str, List[float]] = {}
+        counts: Dict[str, int] = {}
+        for pod in self.api.pods():
+            if pod.node_name and pod.phase in ("Pending", "Running"):
+                u = consumption.setdefault(pod.node_name, [0.0, 0.0, 0.0])
+                u[0] += pod.cpus
+                u[1] += pod.mem
+                u[2] += pod.gpus
+                counts[pod.node_name] = counts.get(pod.node_name, 0) + 1
+        offers = []
+        for node in self.api.nodes():
+            if node.pool != pool or node.unschedulable or node.taints:
+                continue
+            used = consumption.get(node.name, [0.0, 0.0, 0.0])
+            avail = Resources(cpus=max(0.0, node.cpus - used[0]),
+                              mem=max(0.0, node.mem - used[1]),
+                              gpus=max(0.0, node.gpus - used[2]))
+            offers.append(Offer(
+                id=f"{self.name}/{node.name}/{self.api.resource_version}",
+                hostname=node.name, slave_id=node.name, pool=pool,
+                cluster=self.name,
+                available=avail,
+                capacity=Resources(cpus=node.cpus, mem=node.mem,
+                                   gpus=node.gpus),
+                attributes=dict(node.labels),
+                task_count=counts.get(node.name, 0),
+                gpu_model=node.gpu_model))
+        return offers
+
+    def hosts(self, pool: str) -> List[Offer]:
+        return self.pending_offers(pool)
+
+    # --------------------------------------------------------------- launch
+    def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
+        from ...state.schema import Reasons
+        for spec in specs:
+            pod = FakePod(
+                name=spec.task_id,
+                node_name=spec.hostname or None,  # direct mode: unscheduled
+                cpus=spec.resources.cpus, mem=spec.resources.mem,
+                gpus=spec.resources.gpus,
+                labels={"cook/job": spec.job_uuid, "cook/pool": pool})
+            if not self.controller.launch_pod(pod):
+                if self._status_callback:
+                    self._status_callback(
+                        spec.task_id, InstanceStatus.FAILED,
+                        Reasons.REASON_POD_SUBMISSION_FAILED.code)
+
+    def kill_task(self, task_id: str) -> None:
+        self.controller.kill_pod(task_id)
+
+    # ---------------------------------------------------- direct-mode limits
+    def max_launchable(self, pool: str) -> int:
+        """Headroom = min(total pod cap, per-node pod slots) (reference:
+        kubernetes/compute_cluster.clj:555-588)."""
+        pods = [p for p in self.api.pods() if not p.synthetic]
+        total_headroom = self.max_total_pods - len(pods)
+        node_headroom = 0
+        per_node: Dict[str, int] = {}
+        for p in pods:
+            if p.node_name:
+                per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        for node in self.api.nodes():
+            if node.pool == pool and not node.unschedulable:
+                node_headroom += max(
+                    0, self.max_pods_per_node - per_node.get(node.name, 0))
+        return max(0, min(total_headroom, node_headroom))
+
+    # ------------------------------------------------------------ autoscaling
+    def autoscale(self, pool: str, unmatched_jobs: List[Job],
+                  now_ms: int = 0) -> int:
+        """Launch placeholder synthetic pods sized like unmatched jobs so a
+        cluster autoscaler sees unsatisfied demand and provisions nodes
+        (reference: autoscale! kubernetes/compute_cluster.clj:590-715,
+        trigger-autoscaling! scheduler.clj:1178). Returns pods created."""
+        existing = sum(1 for p in self.api.pods() if p.synthetic)
+        budget = max(0, self.max_total_pods - len(self.api.pods()))
+        created = 0
+        for job in unmatched_jobs[:budget]:
+            name = f"{SYNTHETIC_PREFIX}{job.uuid}"
+            if self.api.pod(name) is not None:
+                continue
+            try:
+                self.api.create_pod(FakePod(
+                    name=name, cpus=job.resources.cpus,
+                    mem=job.resources.mem, gpus=job.resources.gpus,
+                    synthetic=True,
+                    labels={"cook/synthetic": "true",
+                            "cook/job": job.uuid},
+                    annotations={"cook/created-ms": str(now_ms)}))
+                created += 1
+            except ValueError:
+                continue
+        return created
+
+    def reap_synthetic_pods(self, launched_job_uuids: List[str]) -> int:
+        """Delete placeholders whose jobs launched for real."""
+        reaped = 0
+        launched = set(launched_job_uuids)
+        for pod in self.api.pods():
+            if pod.synthetic and pod.labels.get("cook/job") in launched:
+                self.api.delete_pod(pod.name)
+                reaped += 1
+        return reaped

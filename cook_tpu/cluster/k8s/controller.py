@@ -1,0 +1,268 @@
+"""Dual-state-machine pod controller.
+
+The heart of the k8s backend (reference:
+scheduler/src/cook/kubernetes/controller.clj:482-711): reconciles the cross
+product of
+
+  cook-expected-state in {STARTING, RUNNING, COMPLETED, KILLED, MISSING}
+  pod-synthesized-state in {WAITING, RUNNING, SUCCEEDED, FAILED, UNKNOWN, MISSING}
+
+preserving the reference's invariants:
+  * store writeback happens FIRST, then kubernetes actions (restart safety);
+  * pods are deleted from kubernetes only in terminal pod states;
+  * a live pod in an unexpected ("weird") state is killed by deleting it and
+    the failure is marked mea-culpa;
+  * per-pod processing is serialized through sharded locks
+    (controller.clj:22-51 — here the sharded ordered executor).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ...state.schema import InstanceStatus, Reasons
+from .fake_api import FakePod
+
+
+class CookExpected(enum.Enum):
+    STARTING = "starting"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    KILLED = "killed"
+    MISSING = "missing"
+
+
+class PodState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    UNKNOWN = "unknown"
+    MISSING = "missing"
+
+
+TERMINAL_POD_STATES = (PodState.SUCCEEDED, PodState.FAILED,
+                       PodState.UNKNOWN, PodState.MISSING)
+
+
+def synthesize_pod_state(pod: Optional[FakePod]) -> PodState:
+    """pod object -> synthesized state (reference:
+    pod->synthesized-pod-state kubernetes/api.clj:1916)."""
+    if pod is None:
+        return PodState.MISSING
+    if pod.phase == "Pending":
+        return PodState.WAITING
+    if pod.phase == "Running":
+        return PodState.RUNNING
+    if pod.phase == "Succeeded":
+        return PodState.SUCCEEDED
+    if pod.phase == "Failed":
+        return PodState.FAILED
+    return PodState.UNKNOWN
+
+
+@dataclass
+class ExpectedStateEntry:
+    state: CookExpected
+    # why a kill happened / weird-state provenance, for passport/debug
+    reason: str = ""
+
+
+class PodController:
+    """Reconciler over (expected, actual) per pod name.
+
+    Writebacks to the store go through the callbacks; kubernetes actions go
+    through the api client (create/delete pod).
+    """
+
+    def __init__(self, api, *,
+                 on_pod_started: Callable[[str], None],
+                 on_pod_completed: Callable[[str, Optional[int], Optional[int]], None],
+                 on_pod_killed: Callable[[str, int], None],
+                 managed_filter: Optional[Callable] = None,
+                 logger=None):
+        self.api = api
+        self.managed_filter = managed_filter or (lambda pod: True)
+        self.expected: Dict[str, ExpectedStateEntry] = {}
+        self._lock = threading.RLock()
+        self.on_pod_started = on_pod_started
+        self.on_pod_completed = on_pod_completed
+        self.on_pod_killed = on_pod_killed
+        import logging
+        self.log = logger or logging.getLogger(__name__)
+
+    # ------------------------------------------------------------ lifecycle
+    def launch_pod(self, pod: FakePod) -> bool:
+        """Expected -> STARTING and create in kubernetes."""
+        with self._lock:
+            self.expected[pod.name] = ExpectedStateEntry(CookExpected.STARTING)
+            try:
+                self.api.create_pod(pod)
+                return True
+            except ValueError:
+                # name collision: treat as submission failure
+                self.expected.pop(pod.name, None)
+                return False
+
+    def kill_pod(self, pod_name: str, reason: str = "killed") -> None:
+        """Cook-level kill (user kill / preemption): expected -> KILLED, then
+        reconcile (which deletes the pod)."""
+        with self._lock:
+            entry = self.expected.get(pod_name)
+            if entry is None or entry.state in (CookExpected.COMPLETED,
+                                                CookExpected.MISSING):
+                return
+            self.expected[pod_name] = ExpectedStateEntry(
+                CookExpected.KILLED, reason)
+        self.process(pod_name)
+
+    def set_expected(self, pod_name: str, state: CookExpected) -> None:
+        """Startup reconciliation hook."""
+        with self._lock:
+            self.expected[pod_name] = ExpectedStateEntry(state)
+
+    # ---------------------------------------------------------------- events
+    def pod_update(self, pod_name: str) -> None:
+        self.process(pod_name)
+
+    def pod_deleted(self, pod_name: str) -> None:
+        self.process(pod_name)
+
+    def scan_all(self) -> None:
+        """Periodic full reconciliation (reference: scan-process
+        controller.clj:815): every tracked or live pod gets visited."""
+        with self._lock:
+            names = set(self.expected.keys())
+        names.update(p.name for p in self.api.pods()
+                     if self.managed_filter(p))
+        for name in names:
+            self.process(name)
+
+    # ------------------------------------------------------------------ core
+    def process(self, pod_name: str) -> None:
+        """One reconciliation visit (reference: process controller.clj:482).
+        Runs under the per-pod lock; loops until the state is stable."""
+        with self._lock:
+            for _ in range(4):  # states converge in <= a few hops
+                entry = self.expected.get(pod_name)
+                expected = entry.state if entry else CookExpected.MISSING
+                pod = self.api.pod(pod_name)
+                actual = synthesize_pod_state(pod)
+                new_expected = self._step(pod_name, expected, actual, pod,
+                                          entry)
+                if new_expected is None:
+                    self.expected.pop(pod_name, None)
+                    if expected is CookExpected.MISSING:
+                        return
+                elif new_expected is not expected:
+                    self.expected[pod_name] = ExpectedStateEntry(
+                        new_expected, entry.reason if entry else "")
+                else:
+                    return  # stable
+
+    # The 30-state table. Returns the new expected state (None = forget).
+    def _step(self, pod_name: str, expected: CookExpected, actual: PodState,
+              pod: Optional[FakePod], entry: Optional[ExpectedStateEntry]
+              ) -> Optional[CookExpected]:
+        E, A = CookExpected, PodState
+
+        if expected is E.STARTING:
+            if actual in (A.WAITING, A.MISSING):
+                return E.STARTING  # pod creation/scheduling in progress
+            if actual is A.RUNNING:
+                self.on_pod_started(pod_name)
+                return E.RUNNING
+            if actual is A.SUCCEEDED:
+                self.on_pod_started(pod_name)  # never observed running
+                self.on_pod_completed(pod_name, pod.exit_code, None)
+                return E.COMPLETED
+            if actual in (A.FAILED, A.UNKNOWN):
+                self.on_pod_completed(
+                    pod_name, pod.exit_code if pod else None,
+                    self._failure_reason(pod))
+                return E.COMPLETED
+
+        elif expected is E.RUNNING:
+            if actual is A.RUNNING:
+                return E.RUNNING
+            if actual is A.SUCCEEDED:
+                self.on_pod_completed(pod_name, pod.exit_code, None)
+                return E.COMPLETED
+            if actual in (A.FAILED, A.UNKNOWN):
+                self.on_pod_completed(
+                    pod_name, pod.exit_code if pod else None,
+                    self._failure_reason(pod))
+                return E.COMPLETED
+            if actual is A.WAITING:
+                # a running pod regressing to waiting is a weird state:
+                # kill it; the failure is the cluster's fault (mea culpa)
+                self._kill_weird(pod_name, "pod regressed to waiting")
+                return E.RUNNING
+            if actual is A.MISSING:
+                # pod vanished under us (node reclaim, external delete)
+                self.on_pod_killed(pod_name, Reasons.NODE_LOST.code)
+                return E.COMPLETED
+
+        elif expected is E.KILLED:
+            if actual in (A.WAITING, A.RUNNING):
+                # store writeback first, then delete from kubernetes
+                self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
+                self.api.delete_pod(pod_name)
+                return E.COMPLETED
+            if actual in (A.SUCCEEDED,):
+                # it finished before the kill landed
+                self.on_pod_completed(pod_name, pod.exit_code, None)
+                self.api.delete_pod(pod_name)
+                return E.COMPLETED
+            if actual in (A.FAILED, A.UNKNOWN):
+                self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
+                self.api.delete_pod(pod_name)
+                return E.COMPLETED
+            if actual is A.MISSING:
+                # kill-before-watch race: the pod never materialized
+                # (reference: explicit (killed, missing) state,
+                # controller.clj:572-598)
+                self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
+                return E.COMPLETED
+
+        elif expected is E.COMPLETED:
+            if actual in (A.SUCCEEDED, A.FAILED, A.UNKNOWN):
+                self.api.delete_pod(pod_name)  # writeback already happened
+                return E.COMPLETED if self.api.pod(pod_name) else None
+            if actual in (A.RUNNING, A.WAITING):
+                # who resurrected this pod? two leaders? kill it
+                self._kill_weird(pod_name, "live pod for completed instance")
+                return E.COMPLETED
+            if actual is A.MISSING:
+                return None  # final state: forget
+
+        elif expected is E.MISSING:
+            # only reached for cook-managed pods (the watch layer filters
+            # foreign and synthetic pods before the controller sees them)
+            if actual in (A.SUCCEEDED, A.FAILED, A.UNKNOWN):
+                self.api.delete_pod(pod_name)
+                return None
+            if actual in (A.RUNNING, A.WAITING):
+                self._kill_weird(pod_name, "untracked live cook pod")
+                return None
+            return None
+
+        return expected
+
+    def _kill_weird(self, pod_name: str, why: str) -> None:
+        self.log.warning("killing pod %s in weird state: %s", pod_name, why)
+        self.api.delete_pod(pod_name)
+
+    @staticmethod
+    def _failure_reason(pod: Optional[FakePod]) -> Optional[int]:
+        if pod is None:
+            return Reasons.UNKNOWN.code
+        if pod.reason == "NodeLost":
+            return Reasons.NODE_LOST.code
+        if pod.reason == "Deleted":
+            return Reasons.KILLED_BY_USER.code
+        return Reasons.NON_ZERO_EXIT.code if pod.exit_code else \
+            Reasons.UNKNOWN.code

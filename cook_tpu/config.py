@@ -69,6 +69,8 @@ class Config:
     quota_groups: Dict[str, str] = field(default_factory=dict)
     quota_group_quotas: Dict[str, PoolQuota] = field(default_factory=dict)
     max_tasks_per_host: Optional[int] = None
+    # synthetic-pod autoscaling after each match cycle (scheduler.clj:1178)
+    autoscaling_enabled: bool = False
     # reapers (scheduler.clj:1888-2016)
     lingering_task_interval_seconds: float = 30.0
     straggler_interval_seconds: float = 30.0

@@ -1,0 +1,6 @@
+from .watch_queue import (  # noqa: F401
+    PyWatchQueue,
+    ShardedWatchQueue,
+    make_watch_queue,
+    native_available,
+)

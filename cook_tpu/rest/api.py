@@ -62,6 +62,12 @@ class ApiError(Exception):
         self.message = message
 
 
+class _Redirect(Exception):
+    def __init__(self, location: str):
+        super().__init__(location)
+        self.location = location
+
+
 def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
     out = {
         "uuid": job.uuid, "name": job.name, "command": job.command,
@@ -154,7 +160,9 @@ class CookApi:
                  rate_limits: Optional[RateLimits] = None,
                  queue_limits: Optional[QueueLimits] = None,
                  admins: Optional[List[str]] = None,
-                 impersonators: Optional[List[str]] = None):
+                 impersonators: Optional[List[str]] = None,
+                 elector=None, node_url: str = ""):
+        from ..policy.incremental import IncrementalConfig
         self.store = store
         self.scheduler = scheduler
         self.config = config or (scheduler.config if scheduler else Config())
@@ -165,6 +173,20 @@ class CookApi:
         self.queue_limits = queue_limits
         self.admins = set(admins or [])
         self.impersonators = set(impersonators or [])
+        # HA: api-only nodes redirect leader-only requests (307) to the
+        # elected leader (reference: leader-redirect, api-only? config.clj:692)
+        self.elector = elector
+        self.node_url = node_url
+        self.incremental = IncrementalConfig()
+
+    def leader_redirect_target(self) -> Optional[str]:
+        """Non-None when this node must redirect scheduler-state requests."""
+        if self.scheduler is not None or self.elector is None:
+            return None
+        url = self.elector.leader_url()
+        if url and url != self.node_url:
+            return url
+        return None
 
     # ------------------------------------------------------------------ auth
     def require_admin(self, user: str) -> None:
@@ -429,6 +451,47 @@ class CookApi:
             },
         }
 
+    # --------------------------------------------- dynamic compute clusters
+    def compute_clusters(self) -> List[Dict]:
+        if self.scheduler is None:
+            raise ApiError(503, "no scheduler attached")
+        return [{"name": c.name, "state": c.state,
+                 "type": type(c).__name__}
+                for c in self.scheduler.clusters.values()]
+
+    def compute_cluster_update(self, name: str, body: Dict,
+                               user: str) -> Dict:
+        """State machine running -> draining -> deleted (reference: dynamic
+        cluster config CRUD, compute_cluster.clj:450-594)."""
+        self.require_admin(user)
+        if self.scheduler is None:
+            raise ApiError(503, "no scheduler attached")
+        cluster = self.scheduler.clusters.get(name)
+        if cluster is None:
+            raise ApiError(404, f"no such cluster {name}")
+        new_state = body.get("state")
+        legal = {"running": {"draining"}, "draining": {"running", "deleted"}}
+        if new_state not in legal.get(cluster.state, set()):
+            raise ApiError(422, f"illegal transition {cluster.state} "
+                                f"-> {new_state}")
+        if new_state == "deleted":
+            self.scheduler.clusters.pop(name)
+        else:
+            cluster.state = new_state
+        return {"name": name, "state": new_state}
+
+    # -------------------------------------------------- incremental config
+    def incremental_get(self) -> Dict:
+        return self.incremental.all()
+
+    def incremental_set(self, body: Dict, user: str) -> Dict:
+        self.require_admin(user)
+        try:
+            self.incremental.set_many(body)  # all-or-nothing
+        except (ValueError, KeyError, TypeError) as e:
+            raise ApiError(400, f"bad incremental config: {e}")
+        return self.incremental.all()
+
     def metrics(self) -> str:
         """Prometheus text exposition (reference: prometheus_metrics.clj +
         /metrics handler rest/api.clj:3981)."""
@@ -499,15 +562,34 @@ class _Handler(BaseHTTPRequestHandler):
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
             self._respond(200, payload)
+        except _Redirect as r:
+            # 307 preserves the method+body, as the reference's
+            # leader-redirect does. Drain any unread body first: leaving it
+            # on the socket corrupts the next keep-alive request.
+            leftover = int(self.headers.get("Content-Length", 0))
+            if leftover:
+                self.rfile.read(leftover)
+            self.send_response(307)
+            self.send_header("Location", r.location)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
         except ApiError as e:
             self._respond(e.status, {"error": e.message})
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": f"internal error: {e}"})
 
     # ------------------------------------------------------------- dispatch
+    _LOCAL_PATHS = {"/info", "/debug", "/metrics", "/failure_reasons",
+                    "/settings"}
+
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
         parts = [p for p in path.split("/") if p]
+        if path not in self._LOCAL_PATHS:
+            target = api.leader_redirect_target()
+            if target is not None:
+                query = urllib.parse.urlparse(self.path).query
+                raise _Redirect(target + path + ("?" + query if query else ""))
         if method == "GET":
             if path == "/jobs" or path == "/rawscheduler":
                 return api.get_jobs(params)
@@ -544,7 +626,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug()
             if path == "/metrics":
                 return {"_raw": api.metrics()}
+            if path == "/compute-clusters":
+                return api.compute_clusters()
+            if path == "/incremental-config":
+                return api.incremental_get()
         elif method == "POST":
+            if len(parts) == 2 and parts[0] == "compute-clusters":
+                return api.compute_cluster_update(parts[1], self._body(),
+                                                  self._user())
+            if path == "/incremental-config":
+                return api.incremental_set(self._body(), self._user())
             if path == "/jobs" or path == "/rawscheduler":
                 return api.submit_jobs(self._body(), self._user())
             if path == "/retry":

@@ -1,0 +1,125 @@
+"""Leader election and HA.
+
+The reference elects a single active scheduler through ZooKeeper/Curator and
+deliberately exits on leadership loss so a supervisor restarts the process
+clean (reference: cook.mesos/start-leader-selector mesos.clj:153-328,
+System/exit on loss :296-313).  Same shape here:
+
+ - :class:`FileLeaderElector` — file-lock election for single-host /
+   multi-process deployments (the interface admits a ZK/k8s-lease
+   implementation later);
+ - the winner's URL is published next to the lock so follower (api-only)
+   nodes can 307-redirect leader-only requests (reference: api-only? nodes
+   config.clj:692 + leader-redirect in rest/api.clj);
+ - on leadership loss the ``on_loss`` callback fires — production wiring
+   should exit the process, mirroring the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+
+class LeaderElector:
+    """Interface: campaign, observe, resign."""
+
+    def campaign(self) -> None:
+        raise NotImplementedError
+
+    def resign(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def is_leader(self) -> bool:
+        raise NotImplementedError
+
+    def leader_url(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+class FileLeaderElector(LeaderElector):
+    def __init__(self, lock_path: str, node_url: str,
+                 on_leadership: Optional[Callable[[], None]] = None,
+                 on_loss: Optional[Callable[[], None]] = None,
+                 poll_interval_s: float = 0.2):
+        self.lock_path = Path(lock_path)
+        self.url_path = Path(str(lock_path) + ".leader")
+        self.node_url = node_url
+        self.on_leadership = on_leadership
+        self.on_loss = on_loss
+        self.poll_interval_s = poll_interval_s
+        self._fd: Optional[int] = None
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- campaign
+    def campaign(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._campaign_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _try_acquire(self) -> bool:
+        import fcntl
+        fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            # flock, not lockf: flock is per open-file-description, so two
+            # electors in one process (tests, embedded followers) conflict
+            # correctly; lockf would silently grant both
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd
+        self.url_path.write_text(self.node_url)
+        return True
+
+    def _campaign_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self._leader = True
+                if self.on_leadership:
+                    self.on_leadership()
+                # hold leadership until resign/stop; the lock is released by
+                # process death, which is what makes failover work
+                while not self._stop.is_set():
+                    time.sleep(self.poll_interval_s)
+                return
+            time.sleep(self.poll_interval_s)
+
+    def resign(self) -> None:
+        import fcntl
+        self._stop.set()
+        was_leader = self._leader
+        self._leader = False
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+            try:
+                if self.url_path.read_text() == self.node_url:
+                    self.url_path.unlink()
+            except OSError:
+                pass
+        if was_leader and self.on_loss:
+            self.on_loss()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def leader_url(self) -> Optional[str]:
+        try:
+            return self.url_path.read_text().strip() or None
+        except OSError:
+            return None

@@ -46,7 +46,8 @@ from .rebalancer import Rebalancer
 class Scheduler:
     def __init__(self, store: Store, config: Optional[Config] = None,
                  clusters: Optional[List[ComputeCluster]] = None,
-                 rank_backend: str = "tpu", plugins=None, rate_limits=None):
+                 rank_backend: str = "tpu", plugins=None, rate_limits=None,
+                 status_queue_shards: Optional[int] = None):
         from ..policy import PluginRegistry, RateLimits
         self.store = store
         self.config = config or Config()
@@ -70,6 +71,14 @@ class Scheduler:
         # delivered during a launch) must run elsewhere or they self-deadlock.
         self._side_effects: "queue.Queue" = queue.Queue()
         self._side_effect_thread: Optional[threading.Thread] = None
+        # Optional sharded in-order status processing (the reference's 19
+        # hash-sharded agents, scheduler.clj:2370-2396; native C++ executor
+        # when available). None = synchronous, for deterministic stepping.
+        self._status_queue = None
+        if status_queue_shards:
+            from ..native import make_watch_queue
+            self._status_queue = make_watch_queue(
+                self._apply_status_payload, status_queue_shards)
         store.subscribe(self._on_tx_events)
         for cluster in clusters or []:
             self.add_cluster(cluster)
@@ -84,9 +93,21 @@ class Scheduler:
     def _on_status_update(self, task_id: str, status: InstanceStatus,
                           reason_code: Optional[int], exit_code=None,
                           preempted: bool = False, hostname=None) -> None:
+        payload = (status, reason_code, exit_code, preempted, hostname)
+        if self._status_queue is not None:
+            self._status_queue.submit(task_id, payload)
+        else:
+            self._apply_status_payload(task_id, payload)
+
+    def _apply_status_payload(self, task_id: str, payload) -> None:
+        status, reason_code, exit_code, preempted, hostname = payload
         self.store.update_instance_status(
             task_id, status, reason_code=reason_code, exit_code=exit_code,
             preempted=preempted, hostname=hostname)
+
+    def flush_status_updates(self) -> None:
+        if self._status_queue is not None:
+            self._status_queue.flush()
 
     def _on_tx_events(self, tx_id: int, events) -> None:
         """Kill live instances of jobs that reached completed — covers user
@@ -145,14 +166,34 @@ class Scheduler:
                 results[pool.name] = self._match_direct(pool.name, ranked)
                 continue
             offers = []
-            for cluster in self.clusters.values():
+            for cluster in list(self.clusters.values()):
                 if cluster.accepts_pool(pool.name):
                     offers.extend(cluster.pending_offers(pool.name))
-            results[pool.name] = self.matcher.match_pool(
+            result = self.matcher.match_pool(
                 pool.name, ranked, offers, self.clusters,
                 reserved_hosts=self.reserved_hosts)
+            results[pool.name] = result
+            self._autoscale(pool.name, result)
         self.last_match_results.update(results)
         return results
+
+    def _autoscale(self, pool_name: str, result: MatchCycleResult) -> None:
+        """Post-match autoscaling: surface unmatched demand as synthetic
+        pods, reap placeholders for jobs that launched (reference:
+        trigger-autoscaling! scheduler.clj:1178-1283)."""
+        if not self.config.autoscaling_enabled:
+            return
+        launched_jobs = [self.store.instance(t).job_uuid
+                         for t in result.launched_task_ids
+                         if self.store.instance(t) is not None]
+        for cluster in list(self.clusters.values()):
+            autoscale = getattr(cluster, "autoscale", None)
+            if autoscale is None or not cluster.accepts_pool(pool_name):
+                continue
+            if result.unmatched:
+                autoscale(pool_name, result.unmatched, now_ms=now_ms())
+            if launched_jobs:
+                cluster.reap_synthetic_pods(launched_jobs)
 
     def _match_direct(self, pool_name: str, ranked: List[Job]
                       ) -> MatchCycleResult:
@@ -160,13 +201,13 @@ class Scheduler:
         capacity and let the backend place (scheduler.clj:1728-1771)."""
         result = MatchCycleResult()
         capacity = sum(c.max_launchable(pool_name)
-                       for c in self.clusters.values()
+                       for c in list(self.clusters.values())
                        if c.accepts_pool(pool_name))
         considerable = self.matcher.considerable_jobs(
             pool_name, ranked,
             min(capacity, self.config.matcher_for_pool(pool_name).max_jobs_considered))
         result.considered = len(considerable)
-        clusters = [c for c in self.clusters.values()
+        clusters = [c for c in list(self.clusters.values())
                     if c.accepts_pool(pool_name)]
         if not clusters:
             result.unmatched = considerable

@@ -1,0 +1,182 @@
+"""On-node agent tests: executor lifecycle, progress tracking, kill
+escalation, sandbox file server (reference test tier: executor/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.agent import (
+    ProgressWatcher,
+    SandboxFileServer,
+    TaskExecutor,
+    rest_progress_publisher,
+)
+
+
+class TestProgressWatcher:
+    def test_extracts_percent_and_message(self):
+        seen = []
+        w = ProgressWatcher(publish=lambda s, p, m: seen.append((s, p, m)))
+        w.observe_line("progress: 25 loading data\n")
+        w.observe_line("no progress here\n")
+        w.observe_line("progress: 80% training\n")
+        assert seen == [(1, 25, "loading data"), (2, 80, "training")]
+
+    def test_clamps_out_of_range(self):
+        w = ProgressWatcher()
+        w.observe_line("progress: 150 overshoot")
+        assert w.last_percent == 100
+
+    def test_custom_regex(self):
+        w = ProgressWatcher(regex=r"\[(\d+)/100\]")
+        w.observe_line("step [42/100] done")
+        assert w.last_percent == 42
+
+
+class TestTaskExecutor:
+    def test_runs_and_captures_output(self, tmp_path):
+        ex = TaskExecutor("echo out-line; echo err-line >&2; exit 0",
+                          sandbox=str(tmp_path / "sb"))
+        ex.start()
+        assert ex.wait(timeout_s=10) == 0
+        assert (tmp_path / "sb" / "stdout").read_text() == "out-line\n"
+        assert (tmp_path / "sb" / "stderr").read_text() == "err-line\n"
+        assert (tmp_path / "sb" / "exit_code").read_text() == "0"
+
+    def test_nonzero_exit(self, tmp_path):
+        ex = TaskExecutor("exit 7", sandbox=str(tmp_path / "sb"))
+        ex.start()
+        assert ex.wait(timeout_s=10) == 7
+
+    def test_progress_from_stdout(self, tmp_path):
+        seen = []
+        ex = TaskExecutor(
+            "echo 'progress: 10 start'; echo 'progress: 90 almost'",
+            sandbox=str(tmp_path / "sb"),
+            progress_publish=lambda s, p, m: seen.append((p, m)))
+        ex.start()
+        ex.wait(timeout_s=10)
+        assert (10, "start") in seen and (90, "almost") in seen
+
+    def test_kill_escalation_sigterm_trapped(self, tmp_path):
+        # the command traps SIGTERM; the executor must escalate to SIGKILL
+        ex = TaskExecutor(
+            "trap '' TERM; while true; do sleep 0.1; done",
+            sandbox=str(tmp_path / "sb"), kill_grace_period_s=0.5)
+        ex.start()
+        time.sleep(0.3)
+        assert ex.running
+        t0 = time.time()
+        code = ex.kill()
+        assert not ex.running
+        assert code != 0
+        assert time.time() - t0 < 10
+
+    def test_kill_takes_down_process_tree(self, tmp_path):
+        # children in the same process group die with the parent
+        ex = TaskExecutor("sleep 300 & sleep 300 & wait",
+                          sandbox=str(tmp_path / "sb"),
+                          kill_grace_period_s=0.5)
+        ex.start()
+        time.sleep(0.3)
+        import os
+        pgid = os.getpgid(ex.process.pid)
+        ex.kill()
+        # no live survivors in the group (zombies may linger until reaped)
+        import subprocess
+        deadline = time.time() + 5
+        live = "unchecked"
+        while time.time() < deadline:
+            out = subprocess.run(["ps", "-o", "pid=,stat=", "-g", str(pgid)],
+                                 capture_output=True, text=True)
+            live = [line for line in out.stdout.splitlines()
+                    if line.strip() and "Z" not in line.split()[1]]
+            if not live:
+                break
+            time.sleep(0.1)
+        assert not live, f"survivors: {live}"
+
+    def test_progress_posted_to_rest_api(self, tmp_path):
+        from cook_tpu.cluster import FakeCluster, FakeHost
+        from cook_tpu.config import Config
+        from cook_tpu.rest import ApiServer, CookApi
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        server = ApiServer(CookApi(store, scheduler=sched))
+        server.start()
+        try:
+            [uuid] = store.create_jobs([Job(
+                uuid=new_uuid(), user="u", command="x",
+                resources=Resources(cpus=1, mem=10))])
+            sched.step_rank()
+            [tid] = sched.step_match()["default"].launched_task_ids
+            ex = TaskExecutor(
+                "echo 'progress: 55 crunching'",
+                sandbox=str(tmp_path / "sb"),
+                progress_publish=rest_progress_publisher(server.url, tid))
+            ex.start()
+            ex.wait(timeout_s=10)
+            deadline = time.time() + 5
+            while time.time() < deadline \
+                    and store.instance(tid).progress != 55:
+                time.sleep(0.05)
+            inst = store.instance(tid)
+            assert inst.progress == 55
+            assert inst.progress_message == "crunching"
+        finally:
+            server.stop()
+
+
+class TestSandboxFileServer:
+    @pytest.fixture()
+    def sandbox(self, tmp_path):
+        (tmp_path / "stdout").write_text("hello sandbox\n")
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "sub" / "data.txt").write_text("nested")
+        (tmp_path / "secret-outside.txt").write_text("x")  # still inside tmp
+        server = SandboxFileServer(str(tmp_path))
+        server.start()
+        yield tmp_path, server
+        server.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, resp.read()
+
+    def test_read_with_offset(self, sandbox):
+        _root, server = sandbox
+        status, body = self._get(
+            f"{server.url}/files/read?path=stdout&offset=6&length=7")
+        assert status == 200
+        assert json.loads(body)["data"] == "sandbox"
+
+    def test_download(self, sandbox):
+        _root, server = sandbox
+        status, body = self._get(f"{server.url}/files/download?path=sub/data.txt")
+        assert status == 200 and body == b"nested"
+
+    def test_browse(self, sandbox):
+        _root, server = sandbox
+        status, body = self._get(f"{server.url}/files/browse?path=")
+        entries = json.loads(body)
+        names = {e["path"] for e in entries}
+        assert "stdout" in names and "sub" in names
+        assert all("size" in e and "mode" in e for e in entries)
+
+    def test_path_traversal_rejected(self, sandbox):
+        _root, server = sandbox
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(f"{server.url}/files/read?path=../../etc/passwd")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(f"{server.url}/files/read?path=%2Fetc%2Fpasswd")
+        assert e.value.code == 404

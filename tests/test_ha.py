@@ -1,0 +1,186 @@
+"""HA tests: leader election, follower redirect, dynamic cluster config,
+incremental config rollouts."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config
+from cook_tpu.policy.incremental import IncrementalConfig
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.sched import Scheduler
+from cook_tpu.sched.election import FileLeaderElector
+from cook_tpu.state import Resources, Store
+
+
+class TestFileLeaderElector:
+    def test_single_candidate_wins(self, tmp_path):
+        events = []
+        elector = FileLeaderElector(
+            tmp_path / "lock", "http://node-a",
+            on_leadership=lambda: events.append("lead"))
+        elector.campaign()
+        deadline = time.time() + 5
+        while time.time() < deadline and not elector.is_leader:
+            time.sleep(0.05)
+        assert elector.is_leader
+        assert elector.leader_url() == "http://node-a"
+        assert events == ["lead"]
+        elector.resign()
+
+    def test_second_candidate_takes_over_on_resign(self, tmp_path):
+        a = FileLeaderElector(tmp_path / "lock", "http://node-a")
+        b = FileLeaderElector(tmp_path / "lock", "http://node-b",
+                              poll_interval_s=0.05)
+        a.campaign()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.05)
+        b.campaign()
+        time.sleep(0.3)
+        assert not b.is_leader  # a holds the lock
+        losses = []
+        a.on_loss = lambda: losses.append(True)
+        a.resign()
+        assert losses == [True]
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.is_leader:
+            time.sleep(0.05)
+        assert b.is_leader
+        assert b.leader_url() == "http://node-b"
+        b.resign()
+
+
+class TestFollowerRedirect:
+    def test_follower_redirects_to_leader(self, tmp_path):
+        # leader node: full scheduler + api
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        leader_api = CookApi(store, scheduler=sched)
+        leader_srv = ApiServer(leader_api)
+        leader_srv.start()
+
+        elector = FileLeaderElector(tmp_path / "lock", leader_srv.url)
+        elector.campaign()
+        deadline = time.time() + 5
+        while time.time() < deadline and not elector.is_leader:
+            time.sleep(0.05)
+
+        # follower node: api-only (no scheduler), knows the elector
+        follower_api = CookApi(Store(), scheduler=None, elector=elector,
+                               node_url="http://follower")
+        follower_srv = ApiServer(follower_api)
+        follower_srv.start()
+        try:
+            # urllib follows 307 automatically incl. method preservation
+            client = JobClient(follower_srv.url, user="alice")
+            uuid = client.submit_one("echo hi")
+            # job landed on the leader's store
+            assert store.job(uuid) is not None
+            # redirected GETs keep their query string (regression)
+            assert client.query([uuid])[0]["uuid"] == uuid
+            # keep-alive survives a redirected POST (body drained)
+            uuid2 = client.submit_one("echo again")
+            assert store.job(uuid2) is not None
+            # local-only endpoints answer without redirect
+            req = urllib.request.Request(follower_srv.url + "/info")
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+        finally:
+            follower_srv.stop()
+            leader_srv.stop()
+            elector.resign()
+
+
+@pytest.fixture()
+def admin_system():
+    store = Store()
+    c1 = FakeCluster("east", [FakeHost("e0", Resources(cpus=8, mem=8192))])
+    c2 = FakeCluster("west", [FakeHost("w0", Resources(cpus=8, mem=8192))])
+    cfg = Config()
+    cfg.default_matcher.backend = "cpu"
+    sched = Scheduler(store, cfg, [c1, c2], rank_backend="cpu")
+    api = CookApi(store, scheduler=sched, admins=["admin"])
+    server = ApiServer(api)
+    server.start()
+    yield store, sched, server
+    server.stop()
+
+
+def _post(url, path, body, user="admin"):
+    req = urllib.request.Request(
+        url + path, method="POST", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", "X-Cook-User": user})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, path, user="admin"):
+    req = urllib.request.Request(url + path,
+                                 headers={"X-Cook-User": user})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+class TestDynamicClusterConfig:
+    def test_drain_and_delete_lifecycle(self, admin_system):
+        store, sched, server = admin_system
+        clusters = _get(server.url, "/compute-clusters")
+        assert {c["name"] for c in clusters} == {"east", "west"}
+        # drain east: it stops offering
+        _post(server.url, "/compute-clusters/east", {"state": "draining"})
+        from cook_tpu.state import Job, new_uuid
+        store.create_jobs([Job(uuid=new_uuid(), user="u", command="x",
+                               resources=Resources(cpus=1, mem=10))])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid] = res.launched_task_ids
+        assert store.instance(tid).compute_cluster == "west"
+        # illegal transition rejected
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, "/compute-clusters/east", {"state": "deleted2"})
+        assert e.value.code == 422
+        # draining -> deleted removes it
+        _post(server.url, "/compute-clusters/east", {"state": "deleted"})
+        assert {c["name"] for c in _get(server.url, "/compute-clusters")} \
+            == {"west"}
+
+    def test_requires_admin(self, admin_system):
+        _store, _sched, server = admin_system
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, "/compute-clusters/west",
+                  {"state": "draining"}, user="peon")
+        assert e.value.code == 403
+
+
+class TestIncrementalConfig:
+    def test_portion_resolution_is_stable_and_proportional(self):
+        cfg = IncrementalConfig()
+        cfg.set("image-version", [{"value": "v1", "portion": 0.7},
+                                  {"value": "v2", "portion": 0.3}])
+        counts = {"v1": 0, "v2": 0}
+        for i in range(2000):
+            v = cfg.resolve("image-version", f"job-{i}")
+            counts[v] += 1
+            # stability: same uuid -> same value
+            assert cfg.resolve("image-version", f"job-{i}") == v
+        assert 0.6 < counts["v1"] / 2000 < 0.8
+
+    def test_portions_must_sum_to_one(self):
+        cfg = IncrementalConfig()
+        with pytest.raises(ValueError):
+            cfg.set("k", [{"value": 1, "portion": 0.5}])
+
+    def test_rest_roundtrip(self, admin_system):
+        _store, _sched, server = admin_system
+        _post(server.url, "/incremental-config",
+              {"sidecar-version": [{"value": "1.0", "portion": 1.0}]})
+        got = _get(server.url, "/incremental-config")
+        assert got["sidecar-version"][0]["value"] == "1.0"

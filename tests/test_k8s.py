@@ -1,0 +1,232 @@
+"""Kubernetes-style backend tests: controller state machine, offer synthesis,
+autoscaling, full scheduler integration (reference test tier:
+scheduler/test/cook/test/kubernetes/*)."""
+
+import pytest
+
+from cook_tpu.cluster.k8s import (
+    CookExpected,
+    FakeKubernetesApi,
+    FakeNode,
+    FakePod,
+    KubernetesCluster,
+)
+from cook_tpu.config import Config
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import (
+    InstanceStatus,
+    Job,
+    JobState,
+    Reasons,
+    Resources,
+    Store,
+    new_uuid,
+)
+
+
+def make_job(user="alice", cpus=1.0, mem=100.0, **kw):
+    return Job(uuid=new_uuid(), user=user, command="x",
+               resources=Resources(cpus=cpus, mem=mem), **kw)
+
+
+def k8s_system(n_nodes=2, cpus=8.0, mem=8192.0):
+    api = FakeKubernetesApi()
+    for i in range(n_nodes):
+        api.add_node(FakeNode(name=f"node{i}", cpus=cpus, mem=mem))
+    store = Store()
+    cluster = KubernetesCluster("k8s-1", api=api, store=store)
+    cfg = Config()
+    cfg.default_matcher.backend = "cpu"
+    sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+    return api, store, cluster, sched
+
+
+class TestOfferSynthesis:
+    def test_capacity_minus_consumption(self):
+        api, store, cluster, _ = k8s_system()
+        api.create_pod(FakePod(name="existing", node_name="node0",
+                               phase="Running", cpus=2.0, mem=1024.0))
+        offers = {o.hostname: o for o in cluster.pending_offers("default")}
+        assert offers["node0"].available.cpus == 6.0
+        assert offers["node0"].available.mem == 7168.0
+        assert offers["node1"].available.cpus == 8.0
+        assert offers["node0"].task_count == 1
+
+    def test_unschedulable_node_excluded(self):
+        api, _s, cluster, _ = k8s_system()
+        api.add_node(FakeNode(name="cordoned", cpus=8, mem=8192,
+                              unschedulable=True))
+        api.add_node(FakeNode(name="tainted", cpus=8, mem=8192,
+                              taints=["maintenance"]))
+        names = {o.hostname for o in cluster.pending_offers("default")}
+        assert "cordoned" not in names and "tainted" not in names
+
+
+class TestControllerLifecycle:
+    def test_full_pod_lifecycle(self):
+        api, store, cluster, sched = k8s_system()
+        [uuid] = store.create_jobs([make_job()])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        [tid] = res.launched_task_ids
+        # pod exists, pending on its assigned node
+        pod = api.pod(tid)
+        assert pod is not None and pod.node_name is not None
+        assert store.job(uuid).state is JobState.RUNNING
+        api.step()  # pod starts running
+        assert store.instance(tid).status is InstanceStatus.RUNNING
+        assert store.instance(tid).hostname == pod.node_name
+        api.finish_pod(tid, exit_code=0)
+        assert store.instance(tid).status is InstanceStatus.SUCCESS
+        assert store.job(uuid).state is JobState.COMPLETED
+        # terminal pod is deleted from kubernetes and forgotten
+        assert api.pod(tid) is None
+        assert tid not in cluster.controller.expected
+
+    def test_pod_failure_marks_instance_failed(self):
+        api, store, cluster, sched = k8s_system()
+        [uuid] = store.create_jobs([make_job(max_retries=2)])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        api.step()
+        api.finish_pod(tid, exit_code=3)
+        inst = store.instance(tid)
+        assert inst.status is InstanceStatus.FAILED
+        assert inst.exit_code == 3
+        assert store.job(uuid).state is JobState.WAITING  # retry
+
+    def test_node_lost_is_mea_culpa(self):
+        api, store, cluster, sched = k8s_system()
+        [uuid] = store.create_jobs([make_job(max_retries=1)])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        api.step()
+        api.lose_node(store.instance(tid).hostname or "node0")
+        inst = store.instance(tid)
+        assert inst.status is InstanceStatus.FAILED
+        assert inst.reason_code == Reasons.NODE_LOST.code
+        # mea culpa: no retry consumed
+        assert store.job(uuid).state is JobState.WAITING
+
+    def test_user_kill_deletes_pod(self):
+        api, store, cluster, sched = k8s_system()
+        [uuid] = store.create_jobs([make_job()])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        api.step()
+        store.kill_job(uuid)
+        assert store.job(uuid).state is JobState.COMPLETED
+        assert api.pod(tid) is None
+
+    def test_kill_before_pod_materializes(self):
+        # the (killed, missing) race: kill lands before the pod is visible
+        api, store, cluster, sched = k8s_system()
+        cluster.controller.set_expected("ghost-task", CookExpected.KILLED)
+        cluster.controller.process("ghost-task")
+        assert "ghost-task" not in cluster.controller.expected
+
+    def test_untracked_live_cook_pod_killed(self):
+        # a cook-labeled pod with no expected state (e.g. from a dead
+        # leader's unrecorded launch) is reaped...
+        api, store, cluster, sched = k8s_system()
+        api.create_pod(FakePod(name="stray", node_name="node0",
+                               phase="Running", cpus=1, mem=64,
+                               labels={"cook/job": "ghost"}))
+        assert api.pod("stray") is None  # watch event triggers the kill
+
+    def test_foreign_pod_left_alone(self):
+        # ...but a foreign workload sharing the node is never touched
+        api, store, cluster, sched = k8s_system()
+        api.create_pod(FakePod(name="daemonset-thing", node_name="node0",
+                               phase="Running", cpus=1, mem=64))
+        cluster.controller.scan_all()
+        assert api.pod("daemonset-thing") is not None
+
+
+class TestStartupReconciliation:
+    def test_leader_restart_adopts_running_pods(self):
+        api, store, cluster, sched = k8s_system()
+        [uuid] = store.create_jobs([make_job()])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        api.step()
+        assert store.instance(tid).status is InstanceStatus.RUNNING
+        # new leader: restore the store, fresh cluster object over same api;
+        # the old leader detaches first
+        blob = store.snapshot()
+        cluster.shutdown()
+        store2 = Store.restore(blob)
+        cluster2 = KubernetesCluster("k8s-1", api=api, store=store2)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched2 = Scheduler(store2, cfg, [cluster2], rank_backend="cpu")
+        # adopted: completing the pod now completes the job in the new store
+        api.finish_pod(tid, exit_code=0)
+        assert store2.instance(tid).status is InstanceStatus.SUCCESS
+        assert store2.job(uuid).state is JobState.COMPLETED
+
+
+class TestAutoscaling:
+    def test_synthetic_pods_created_for_unmatched(self):
+        api, store, cluster, sched = k8s_system(n_nodes=1, cpus=2.0)
+        jobs = [make_job(cpus=2.0) for _ in range(3)]
+        store.create_jobs(jobs)
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.unmatched) == 2
+        created = cluster.autoscale("default", res.unmatched)
+        assert created == 2
+        synthetic = [p for p in api.pods() if p.synthetic]
+        assert len(synthetic) == 2
+        # synthetic pods sized like the jobs they stand in for
+        assert all(p.cpus == 2.0 for p in synthetic)
+        # idempotent
+        assert cluster.autoscale("default", res.unmatched) == 0
+        # once jobs launch, placeholders are reaped
+        reaped = cluster.reap_synthetic_pods([j.uuid for j in jobs])
+        assert reaped == 2
+
+    def test_synthetic_pods_excluded_from_offers_accounting(self):
+        api, store, cluster, sched = k8s_system(n_nodes=1, cpus=8.0)
+        # synthetic pods consume fake-scheduler capacity once scheduled, but
+        # are not tracked by the controller
+        cluster.autoscale("default", [make_job(cpus=4.0)])
+        [pod] = [p for p in api.pods() if p.synthetic]
+        assert pod.name not in cluster.controller.expected
+        cluster.controller.scan_all()
+        assert api.pod(pod.name) is not None  # scan leaves synthetics alone
+
+
+class TestSchedulerAutoscaleIntegration:
+    def test_match_cycle_triggers_autoscaling(self):
+        api = FakeKubernetesApi()
+        api.add_node(FakeNode(name="node0", cpus=2.0, mem=8192.0))
+        store = Store()
+        cluster = KubernetesCluster("k8s-1", api=api, store=store)
+        cfg = Config(autoscaling_enabled=True)
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        store.create_jobs([make_job(cpus=2.0) for _ in range(3)])
+        sched.step_rank()
+        sched.step_match()
+        synthetic = [p for p in api.pods() if p.synthetic]
+        assert len(synthetic) == 2  # one matched, two surfaced as demand
+        # capacity arrives (autoscaler added a node); jobs match for real and
+        # their placeholders are reaped
+        api.add_node(FakeNode(name="node1", cpus=8.0, mem=16384.0))
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 2
+        assert [p for p in api.pods() if p.synthetic] == []
+
+
+class TestDirectModeBackpressure:
+    def test_max_launchable_headroom(self):
+        api, store, cluster, _ = k8s_system(n_nodes=2)
+        cluster.max_pods_per_node = 3
+        assert cluster.max_launchable("default") == 6
+        api.create_pod(FakePod(name="p1", node_name="node0", phase="Running",
+                               cpus=1, mem=10))
+        assert cluster.max_launchable("default") == 5
+        cluster.max_total_pods = 2
+        assert cluster.max_launchable("default") == 1

@@ -1,0 +1,135 @@
+"""Native sharded watch queue tests: build, per-key ordering, parallelism,
+Python-fallback equivalence, scheduler integration."""
+
+import threading
+import time
+
+import pytest
+
+from cook_tpu.native import (
+    PyWatchQueue,
+    make_watch_queue,
+    native_available,
+)
+
+
+@pytest.fixture(params=["native", "python"])
+def queue_factory(request):
+    if request.param == "native":
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        from cook_tpu.native import ShardedWatchQueue
+        return ShardedWatchQueue
+    return PyWatchQueue
+
+
+class TestWatchQueue:
+    def test_per_key_ordering(self, queue_factory):
+        seen = {}
+        lock = threading.Lock()
+
+        def handler(key, payload):
+            with lock:
+                seen.setdefault(key, []).append(payload)
+
+        q = queue_factory(handler, shards=4)
+        try:
+            for i in range(200):
+                for key in ("a", "b", "c", "d", "e"):
+                    q.submit(key, i)
+            q.flush()
+            assert q.pending == 0
+            for key in ("a", "b", "c", "d", "e"):
+                assert seen[key] == list(range(200)), f"key {key} reordered"
+        finally:
+            q.close()
+
+    def test_parallelism_across_shards(self, queue_factory):
+        # a slow key must not block other shards for the full serial time
+        barrier_hits = []
+        lock = threading.Lock()
+
+        def handler(key, payload):
+            if key == "slow":
+                time.sleep(0.05)
+            with lock:
+                barrier_hits.append(key)
+
+        q = queue_factory(handler, shards=8)
+        try:
+            t0 = time.time()
+            for _ in range(10):
+                q.submit("slow")
+            for i in range(50):
+                q.submit(f"fast-{i}")
+            q.flush()
+            elapsed = time.time() - t0
+            # serial would be >= 0.5s for the slow key alone; the fast keys
+            # ran on other shards meanwhile — total stays near slow-key time
+            assert elapsed < 2.0
+            assert len(barrier_hits) == 60
+        finally:
+            q.close()
+
+    def test_handler_error_isolated(self, queue_factory):
+        def handler(key, payload):
+            if payload == "boom":
+                raise ValueError("boom")
+
+        q = queue_factory(handler, shards=2)
+        try:
+            q.submit("k", "boom")
+            q.submit("k", "fine")
+            q.flush()
+            assert q.processed == 2
+            assert len(q.errors()) == 1
+        finally:
+            q.close()
+
+    def test_processed_counters(self, queue_factory):
+        q = queue_factory(lambda k, p: None, shards=2)
+        try:
+            for i in range(25):
+                q.submit(f"k{i}")
+            q.flush()
+            assert q.processed == 25
+        finally:
+            q.close()
+
+
+class TestNativeBuild:
+    def test_native_library_builds_here(self):
+        # this environment ships g++; the native path must actually build
+        assert native_available(), "native watch queue failed to build"
+
+
+class TestSchedulerIntegration:
+    def test_status_updates_via_sharded_queue(self):
+        from cook_tpu.cluster import FakeCluster, FakeHost
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import (InstanceStatus, Job, JobState, Resources,
+                                    Store, new_uuid)
+
+        store = Store()
+        cluster = FakeCluster(
+            "c", [FakeHost(f"h{i}", Resources(cpus=8, mem=8192))
+                  for i in range(4)])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          status_queue_shards=7)
+        jobs = [Job(uuid=new_uuid(), user=f"u{i % 3}", command="x",
+                    resources=Resources(cpus=1, mem=100)) for i in range(12)]
+        store.create_jobs(jobs)
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        sched.flush_status_updates()
+        assert len(res.launched_task_ids) == 12
+        for tid in res.launched_task_ids:
+            assert store.instance(tid).status is InstanceStatus.RUNNING
+        for tid in res.launched_task_ids:
+            cluster.complete_task(tid)
+        sched.flush_status_updates()
+        for job in jobs:
+            assert store.job(job.uuid).state is JobState.COMPLETED
