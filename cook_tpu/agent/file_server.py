@@ -58,7 +58,13 @@ class _FilesHandler(BaseHTTPRequestHandler):
         if parsed.path == "/files/read":
             if target is None or not target.is_file():
                 return self._respond_json(404, {"error": "no such file"})
-            offset = int((params.get("offset") or ["0"])[0])
+            if "offset" not in params:
+                # Mesos files/read semantics (kept by the reference sidecar):
+                # omitting offset returns the current file size, which is how
+                # clients (e.g. tail) discover where the end is.
+                return self._respond_json(
+                    200, {"data": "", "offset": target.stat().st_size})
+            offset = int(params["offset"][0])
             length = min(int((params.get("length") or [str(MAX_READ_LENGTH)])[0]),
                          MAX_READ_LENGTH)
             if offset < 0 or length < 0:
@@ -66,8 +72,11 @@ class _FilesHandler(BaseHTTPRequestHandler):
             with open(target, "rb") as f:
                 f.seek(offset)
                 data = f.read(length)
+            # surrogateescape keeps arbitrary bytes round-trippable: a chunk
+            # boundary may split a multibyte character, and the client glues
+            # chunks back together with .encode('utf-8', 'surrogateescape')
             return self._respond_json(200, {
-                "data": data.decode("utf-8", errors="replace"),
+                "data": data.decode("utf-8", errors="surrogateescape"),
                 "offset": offset})
         if parsed.path == "/files/download":
             if target is None or not target.is_file():

@@ -1,11 +1,13 @@
 """``cs`` command-line interface.
 
 Parity with the reference's CLI subcommands (reference: cli/cook/subcommands/
-— submit, show, wait, jobs, kill, usage, plus admin queue/limits; the
-sandbox-access commands cat/tail/ls/ssh are backend-dependent and surface
-here as ``show``'s sandbox fields).  Cluster selection via --url or the
-COOK_URL environment variable / ~/.cs.json config federation list
-(reference: cli/cook/querying.py multi-cluster federation, deduped by uuid).
+— submit, show, wait, jobs, kill, usage, cat, tail, ls, ssh, plus admin
+queue/limits).  Sandbox access (cat/tail/ls) goes through the instance's
+``output_url`` file server, the analog of the Mesos agent / sidecar files
+API (reference: cli/cook/mesos.py; sidecar file_server.py).  Cluster
+selection via --url or the COOK_URL environment variable / ~/.cs.json
+config federation list (reference: cli/cook/querying.py multi-cluster
+federation, deduped by uuid).
 """
 
 from __future__ import annotations
@@ -14,8 +16,10 @@ import argparse
 import json
 import os
 import sys
+import urllib.parse
+import urllib.request
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..client import JobClient, JobClientError
 
@@ -163,6 +167,104 @@ def cmd_admin(args) -> int:
     return 0
 
 
+def _resolve_instance(args, uuid: str) -> Tuple[Dict, Dict]:
+    """uuid (job or instance) -> (job, instance) for sandbox access
+    (reference: cli/cook/querying.py query_unique_and_run)."""
+    jobs = federated_query(args, [uuid])
+    if jobs:
+        job = jobs[0]
+        insts = job.get("instances", [])
+        if not insts:
+            raise JobClientError(404, f"job {uuid} has no instances")
+        # prefer the running/latest attempt, as the reference does
+        insts = sorted(insts, key=lambda i: (i["status"] == "running",
+                                             i.get("start_time") or 0))
+        return job, insts[-1]
+    for client in clients(args):
+        try:
+            inst = client.instance(uuid)
+            job = client.query([inst["job_uuid"]])[0]
+            return job, inst
+        except (JobClientError, OSError):
+            continue
+    raise JobClientError(404, f"no job or instance {uuid}")
+
+
+def _files_get(inst: Dict, endpoint: str, params: Dict) -> bytes:
+    base = inst.get("output_url")
+    if not base:
+        raise JobClientError(
+            503, f"instance {inst['task_id']} has no sandbox file server "
+                 "(output_url) yet")
+    url = (base.rstrip("/") + "/files/" + endpoint + "?"
+           + urllib.parse.urlencode(params))
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read()
+
+
+def cmd_cat(args) -> int:
+    """Stream a sandbox file to stdout (reference: subcommands/cat.py)."""
+    _job, inst = _resolve_instance(args, args.uuid[0])
+    data = _files_get(inst, "download", {"path": args.path})
+    sys.stdout.buffer.write(data)
+    sys.stdout.buffer.flush()
+    return 0
+
+
+def cmd_tail(args) -> int:
+    """Print the last N lines of a sandbox file (reference:
+    subcommands/tail.py; reads backwards via the offset/length API)."""
+    if args.lines <= 0:
+        return 0
+    _job, inst = _resolve_instance(args, args.uuid[0])
+    probe = json.loads(_files_get(inst, "read", {"path": args.path}))
+    size = probe.get("offset", 0)
+    want = args.bytes if args.bytes else 64 * 1024
+    chunk: bytes = b""
+    offset = size
+    while offset > 0 and chunk.count(b"\n") <= args.lines \
+            and len(chunk) < 16 * want:
+        step = min(want, offset)
+        offset -= step
+        got = json.loads(_files_get(inst, "read", {
+            "path": args.path, "offset": offset, "length": step}))
+        chunk = got["data"].encode("utf-8", "surrogateescape") + chunk
+    lines = chunk.splitlines(keepends=True)[-args.lines:]
+    sys.stdout.buffer.write(b"".join(lines))
+    sys.stdout.buffer.flush()
+    return 0
+
+
+def cmd_ls(args) -> int:
+    """List sandbox directory contents (reference: subcommands/ls.py)."""
+    _job, inst = _resolve_instance(args, args.uuid[0])
+    entries = json.loads(_files_get(inst, "browse",
+                                    {"path": args.path or ""}))
+    if args.json:
+        out(entries)
+        return 0
+    for e in entries:
+        print(f"{e.get('mode', '??????????')} {e.get('nlink', 1):>3} "
+              f"{e.get('size', 0):>12} {e.get('path', '')}")
+    return 0
+
+
+def cmd_ssh(args) -> int:
+    """exec ssh to the instance's host, landing in the sandbox directory
+    (reference: subcommands/ssh.py execs ssh <host> -t cd <sandbox>)."""
+    _job, inst = _resolve_instance(args, args.uuid[0])
+    hostname = inst.get("hostname")
+    if not hostname:
+        print(f"instance {inst['task_id']} has no hostname", file=sys.stderr)
+        return 1
+    sandbox = inst.get("sandbox_directory") or "~"
+    command = ["ssh", "-t", hostname, f"cd {sandbox} ; exec $SHELL -l"]
+    if args.dry_run:
+        print(" ".join(command))
+        return 0
+    os.execvp("ssh", command)  # pragma: no cover - replaces the process
+
+
 def cmd_config(args) -> int:
     cfg = {"clusters": [{"name": "default", "url": u}
                         for u in load_urls(args)]}
@@ -229,6 +331,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--set", action="append",
                     help="resource=value (cpus=10)")
     sp.set_defaults(fn=cmd_admin)
+
+    sp = sub.add_parser("cat", help="print a sandbox file")
+    sp.add_argument("uuid", nargs=1)
+    sp.add_argument("path")
+    sp.set_defaults(fn=cmd_cat)
+
+    sp = sub.add_parser("tail", help="tail a sandbox file")
+    sp.add_argument("uuid", nargs=1)
+    sp.add_argument("path")
+    sp.add_argument("--lines", type=int, default=10)
+    sp.add_argument("--bytes", type=int, default=0,
+                    help="read granularity override")
+    sp.set_defaults(fn=cmd_tail)
+
+    sp = sub.add_parser("ls", help="list sandbox files")
+    sp.add_argument("uuid", nargs=1)
+    sp.add_argument("path", nargs="?", default="")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_ls)
+
+    sp = sub.add_parser("ssh", help="ssh to the instance's sandbox")
+    sp.add_argument("uuid", nargs=1)
+    sp.add_argument("--dry-run", dest="dry_run", action="store_true")
+    sp.set_defaults(fn=cmd_ssh)
 
     sp = sub.add_parser("config")
     sp.add_argument("--set-url", dest="set_url")

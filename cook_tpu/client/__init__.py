@@ -125,6 +125,40 @@ class JobClient:
     def instance(self, task_id: str) -> Dict:
         return self._request("GET", f"/instances/{task_id}")
 
+    def kill_instances(self, task_ids: Sequence[str]) -> Dict:
+        return self._request("DELETE", "/instances",
+                             params={"uuid": list(task_ids)})
+
+    # --------------------------------------------------------------- groups
+    def group(self, uuids: Sequence[str], detailed: bool = False
+              ) -> List[Dict]:
+        params: Dict[str, Any] = {"uuid": list(uuids)}
+        if detailed:
+            params["detailed"] = "true"
+        return self._request("GET", "/group", params=params)
+
+    def kill_groups(self, uuids: Sequence[str]) -> Dict:
+        return self._request("DELETE", "/group",
+                             params={"uuid": list(uuids)})
+
+    def list_jobs(self, user: str, states: Optional[Sequence[str]] = None,
+                  start_ms: Optional[int] = None,
+                  end_ms: Optional[int] = None,
+                  limit: Optional[int] = None) -> List[Dict]:
+        params: Dict[str, Any] = {"user": user}
+        if states:
+            params["state"] = "+".join(states)
+        if start_ms is not None:
+            params["start-ms"] = str(start_ms)
+        if end_ms is not None:
+            params["end-ms"] = str(end_ms)
+        if limit is not None:
+            params["limit"] = str(limit)
+        return self._request("GET", "/list", params=params)
+
+    def shutdown_leader(self) -> Dict:
+        return self._request("POST", "/shutdown-leader", body={})
+
     # ---------------------------------------------------------------- admin
     def usage(self, user: str) -> Dict:
         return self._request("GET", "/usage", params={"user": user})

@@ -45,6 +45,16 @@ class RebalancerConfig:
 
 
 @dataclass
+class OffensiveJobLimits:
+    """A job is offensive iff its required mem or cpus exceeds these limits;
+    offensive jobs are stifled out of the rank queue and aborted
+    (reference: filter-offensive-jobs scheduler.clj:2205-2229)."""
+
+    memory_gb: float = float("inf")
+    cpus: float = float("inf")
+
+
+@dataclass
 class PoolQuota:
     """Pool-level global caps (reference: tools.clj global-pool-quota)."""
 
@@ -74,6 +84,9 @@ class Config:
     # reapers (scheduler.clj:1888-2016)
     lingering_task_interval_seconds: float = 30.0
     straggler_interval_seconds: float = 30.0
+    # offensive-job stifling in the rank cycle (scheduler.clj:2205-2257);
+    # None disables the filter
+    offensive_job_limits: Optional[OffensiveJobLimits] = None
 
     _compiled: List[tuple] = field(default_factory=list, repr=False)
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -108,6 +109,7 @@ def instance_to_json(inst) -> Dict:
         "reason_string": reason.name if reason else None,
         "mea_culpa": reason.mea_culpa if reason else None,
         "sandbox_directory": inst.sandbox_directory,
+        "output_url": inst.output_url,
         "queue_time": inst.queue_time_ms,
     }
 
@@ -261,7 +263,7 @@ class CookApi:
                 out.append(job_to_json(self.store, job))
             return out
         user = first(params.get("user"))
-        states = set(first(params.get("state"), "").split("+")) - {""}
+        states = parse_states(params)
         jobs = self.store.jobs_where(
             lambda j: (user is None or j.user == user)
             and (not states or j.state.value in states))
@@ -294,6 +296,118 @@ class CookApi:
             self.require_admin(user)
         self.store.retry_job(uuid, int(retries))
         return {"job": uuid, "retries": retries}
+
+    def kill_instances(self, params: Dict, user: str) -> Dict:
+        """DELETE /instances?uuid=task-id — kill individual instances
+        without aborting the job (reference: rest/api.clj instance kill)."""
+        task_ids = params.get("uuid", [])
+        if not task_ids:
+            raise ApiError(400, "no uuids given")
+        for tid in task_ids:
+            inst = self.store.instance(tid)
+            if inst is None:
+                raise ApiError(404, f"no such instance {tid}")
+            job = self.store.job(inst.job_uuid)
+            if job is not None and job.user != user:
+                self.require_admin(user)
+        killed = []
+        for tid in task_ids:
+            inst = self.store.instance(tid)
+            if inst.status in (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                if self.scheduler is not None:
+                    self.scheduler.kill_instance(
+                        tid, Reasons.KILLED_BY_USER.code)
+                else:
+                    self.store.update_instance_status(
+                        tid, InstanceStatus.FAILED,
+                        reason_code=Reasons.KILLED_BY_USER.code)
+                killed.append(tid)
+        return {"killed": killed}
+
+    def group_get(self, params: Dict) -> List[Dict]:
+        """GET /group?uuid=...&detailed=true (reference: rest/api.clj
+        read-groups-handler)."""
+        uuids = params.get("uuid", [])
+        if not uuids:
+            raise ApiError(400, "no uuids given")
+        detailed = first(params.get("detailed"), "false") == "true"
+        out = []
+        for uuid in uuids:
+            group = self.store.group(uuid)
+            if group is None:
+                raise ApiError(404, f"no such group {uuid}")
+            entry: Dict[str, Any] = {
+                "uuid": group.uuid, "name": group.name, "jobs": group.jobs}
+            by_state = {"waiting": 0, "running": 0, "completed": 0}
+            for juuid in group.jobs:
+                job = self.store.job(juuid)
+                if job is not None:
+                    by_state[job.state.value] += 1
+            entry.update(by_state)
+            if detailed:
+                entry["detailed"] = [
+                    job_to_json(self.store, self.store.job(j),
+                                include_instances=False)
+                    for j in group.jobs if self.store.job(j) is not None]
+            out.append(entry)
+        return out
+
+    def group_kill(self, params: Dict, user: str) -> Dict:
+        """DELETE /group?uuid=... — kill every job in the groups."""
+        uuids = params.get("uuid", [])
+        if not uuids:
+            raise ApiError(400, "no uuids given")
+        job_uuids = []
+        for uuid in uuids:
+            group = self.store.group(uuid)
+            if group is None:
+                raise ApiError(404, f"no such group {uuid}")
+            for juuid in group.jobs:
+                job = self.store.job(juuid)
+                if job is None:
+                    continue
+                if job.user != user:
+                    self.require_admin(user)
+                job_uuids.append(juuid)
+        for juuid in job_uuids:
+            self.store.kill_job(juuid)
+        return {"killed": job_uuids}
+
+    def list_jobs(self, params: Dict) -> List[Dict]:
+        """GET /list?user=&state=&start-ms=&end-ms=&limit= (reference:
+        rest/api.clj list-resource): jobs filtered by user, state set, and
+        submit-time window, newest first."""
+        user = first(params.get("user"))
+        if user is None:
+            raise ApiError(400, "user parameter required")
+        states = parse_states(params)
+        start_ms = int(first(params.get("start-ms"), 0))
+        end_ms = int(first(params.get("end-ms"), 2**62))
+        limit = int(first(params.get("limit"), 150))
+        if limit <= 0:
+            raise ApiError(400, "limit must be positive")
+        jobs = self.store.jobs_where(
+            lambda j: j.user == user
+            and (not states or j.state.value in states)
+            and start_ms <= j.submit_time_ms < end_ms)
+        jobs.sort(key=lambda j: j.submit_time_ms, reverse=True)
+        return [job_to_json(self.store, j, include_instances=False)
+                for j in jobs[:limit]]
+
+    def shutdown_leader(self, user: str) -> Dict:
+        """POST /shutdown-leader — admin-only; the leader resigns so a
+        follower takes over (reference: the leader deliberately exits and
+        the supervisor restarts it, mesos.clj:296-313)."""
+        self.require_admin(user)
+        if self.scheduler is None:
+            raise ApiError(503, "this node is not the leader")
+        self.scheduler.shutdown()
+        if self.elector is not None:
+            try:
+                self.elector.resign()
+            except Exception:
+                pass
+        return {"shutdown": True}
 
     def queue(self, user: str) -> Dict:
         self.require_admin(user)
@@ -508,6 +622,16 @@ class CookApi:
         return lines
 
 
+def parse_states(params: Dict) -> set:
+    """State filter from query params. '+' is the documented separator, but
+    standard URL decoding turns a literal '+' into a space, so accept
+    space/comma too, and repeated state params."""
+    states = set()
+    for value in params.get("state", []):
+        states.update(s for s in re.split(r"[+,\s]+", value) if s)
+    return states
+
+
 def first(values, default=None):
     if not values:
         return default
@@ -602,6 +726,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return instance_to_json(inst)
             if path == "/queue":
                 return api.queue(self._user())
+            if path == "/group":
+                return api.group_get(params)
+            if path == "/list":
+                return api.list_jobs(params)
             if path == "/running":
                 return api.running()
             if path == "/usage":
@@ -646,9 +774,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.quota_set(self._body(), self._user())
             if len(parts) == 2 and parts[0] == "progress":
                 return api.progress(parts[1], self._body())
+            if path == "/shutdown-leader":
+                return api.shutdown_leader(self._user())
         elif method == "DELETE":
             if path == "/jobs" or path == "/rawscheduler":
                 return api.kill_jobs(params, self._user())
+            if path == "/instances":
+                return api.kill_instances(params, self._user())
+            if path == "/group":
+                return api.group_kill(params, self._user())
             if path == "/share":
                 return api.share_delete(params, self._user())
             if path == "/quota":

@@ -5,5 +5,15 @@ from .constraints import (  # noqa: F401
 )
 from .matcher import MatchCycleResult, Matcher  # noqa: F401
 from .ranker import Ranker, build_user_tasks  # noqa: F401
+from .optimizer import (  # noqa: F401
+    DummyHostFeed,
+    DummyOptimizer,
+    HostFeed,
+    HostInfo,
+    Optimizer,
+    OptimizerConfig,
+    OptimizerCycler,
+    optimizer_cycle,
+)
 from .rebalancer import PreemptionDecision, Rebalancer  # noqa: F401
 from .scheduler import Scheduler  # noqa: F401

@@ -1,0 +1,201 @@
+"""Pluggable scheduling optimizer (forecaster).
+
+Parity with the reference's optimizer subsystem (reference:
+scheduler/src/cook/scheduler/optimizer.clj): ``HostFeed``/``Optimizer``
+protocols, dummy implementations, a validated ``Schedule`` shape, and a
+cycle driver. Like the reference (TODO at mesos.clj:258-267), the produced
+schedule is observational — it is validated and surfaced but not wired to
+launch actions.
+
+Factories are config-driven dotted paths, mirroring the reference's
+``lazy-load-var`` create-fn loading (optimizer.clj:115-124).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class HostInfo:
+    """A purchasable host class (reference: optimizer.clj HostInfo schema)."""
+    count: int
+    instance_type: str
+    cpus: float
+    mem: float
+    gpus: Optional[float] = None
+
+    def validate(self) -> None:
+        if self.count < 0:
+            raise ValueError(f"HostInfo.count must be >= 0, got {self.count}")
+        if self.cpus <= 0 or self.mem <= 0:
+            raise ValueError("HostInfo cpus/mem must be positive")
+        if self.gpus is not None and self.gpus <= 0:
+            raise ValueError("HostInfo gpus, when present, must be positive")
+
+
+class HostFeed:
+    """Service producing info on hosts that can be purchased
+    (reference: optimizer.clj:33 defprotocol HostFeed)."""
+
+    def get_available_host_info(self) -> List[HostInfo]:
+        raise NotImplementedError
+
+
+class Optimizer:
+    """Tool producing a schedule to execute
+    (reference: optimizer.clj:57 defprotocol Optimizer).
+
+    ``produce_schedule(queue, running, available, host_infos)`` returns
+    ``{millis_in_future: {"suggested-matches": {HostInfo: [job uuids]}}}``.
+    """
+
+    def produce_schedule(self, queue: List[Any], running: List[Any],
+                         available: List[Any],
+                         host_infos: List[HostInfo]) -> Dict:
+        raise NotImplementedError
+
+
+class DummyHostFeed(HostFeed):
+    """Returns no purchasable hosts (reference: create-dummy-host-feed)."""
+
+    def __init__(self, config: Optional[Dict] = None):
+        self.config = config or {}
+
+    def get_available_host_info(self) -> List[HostInfo]:
+        return []
+
+
+class DummyOptimizer(Optimizer):
+    """Returns an empty schedule (reference: create-dummy-optimizer)."""
+
+    def __init__(self, config: Optional[Dict] = None):
+        self.config = config or {}
+
+    def produce_schedule(self, queue, running, available, host_infos):
+        return {0: {"suggested-matches": {}}}
+
+
+def validate_schedule(schedule: Dict) -> None:
+    """Structural validation of a Schedule (reference: optimizer.clj Schedule
+    schema + s/validate at :111)."""
+    if not isinstance(schedule, dict):
+        raise ValueError("schedule must be a dict of time-period -> step")
+    for period_ms, step in schedule.items():
+        if not isinstance(period_ms, int) or period_ms < 0:
+            raise ValueError(f"schedule key {period_ms!r} is not a "
+                             "non-negative integer of millis-in-future")
+        if not isinstance(step, dict) or "suggested-matches" not in step:
+            raise ValueError(f"schedule step at {period_ms} is missing "
+                             "'suggested-matches'")
+        matches = step["suggested-matches"]
+        if not isinstance(matches, dict):
+            raise ValueError("suggested-matches must map HostInfo -> [uuid]")
+        for host_info, uuids in matches.items():
+            if not isinstance(host_info, HostInfo):
+                raise ValueError(f"suggested-matches key {host_info!r} is "
+                                 "not a HostInfo")
+            host_info.validate()
+            if not isinstance(uuids, (list, tuple)):
+                raise ValueError("suggested-matches values must be lists of "
+                                 "job uuids")
+
+
+def optimizer_cycle(get_queue: Callable[[], List[Any]],
+                    get_running: Callable[[], List[Any]],
+                    get_offers: Callable[[], List[Any]],
+                    host_feed: HostFeed,
+                    optimizer: Optimizer) -> Dict:
+    """One optimizer cycle (reference: optimizer-cycle! optimizer.clj:90-113):
+    gather queue/running/host info, produce a schedule, validate it."""
+    queue = get_queue()
+    running = get_running()
+    # Offer integration with pools is not implemented in the reference
+    # either (optimizer.clj:106); pass the empty set for parity.
+    available: List[Any] = []
+    host_infos = host_feed.get_available_host_info()
+    for info in host_infos:
+        if not isinstance(info, HostInfo):
+            raise ValueError(f"host feed produced non-HostInfo {info!r}")
+        info.validate()
+    schedule = optimizer.produce_schedule(queue, running, available,
+                                          host_infos)
+    validate_schedule(schedule)
+    return schedule
+
+
+def _load_factory(dotted: str) -> Callable:
+    """Resolve 'pkg.module.fn' (reference: lazy-load-var)."""
+    module_name, _, attr = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(f"factory path {dotted!r} must be module.attr")
+    return getattr(importlib.import_module(module_name), attr)
+
+
+@dataclass
+class OptimizerConfig:
+    """Config-driven construction (reference: start-optimizer-cycles!
+    construct, optimizer.clj:118-123)."""
+    host_feed_create_fn: str = "cook_tpu.sched.optimizer.DummyHostFeed"
+    host_feed_config: Dict = field(default_factory=dict)
+    optimizer_create_fn: str = "cook_tpu.sched.optimizer.DummyOptimizer"
+    optimizer_config: Dict = field(default_factory=dict)
+    interval_seconds: float = 30.0
+
+    def build(self) -> "OptimizerCycler":
+        host_feed = _load_factory(self.host_feed_create_fn)(
+            self.host_feed_config)
+        optimizer = _load_factory(self.optimizer_create_fn)(
+            self.optimizer_config)
+        return OptimizerCycler(host_feed, optimizer, self.interval_seconds)
+
+
+class OptimizerCycler:
+    """Periodic driver (reference: start-optimizer-cycles! optimizer.clj:115).
+    Errors are logged-and-swallowed per cycle, matching the reference's
+    error-handler."""
+
+    def __init__(self, host_feed: HostFeed, optimizer: Optimizer,
+                 interval_seconds: float = 30.0):
+        self.host_feed = host_feed
+        self.optimizer = optimizer
+        self.interval_seconds = interval_seconds
+        self.last_schedule: Optional[Dict] = None
+        self.last_error: Optional[Exception] = None
+        self.cycles = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_cycle(self, get_queue, get_running,
+                  get_offers=lambda: []) -> Optional[Dict]:
+        try:
+            self.last_schedule = optimizer_cycle(
+                get_queue, get_running, get_offers,
+                self.host_feed, self.optimizer)
+            self.last_error = None
+        except Exception as e:
+            log.warning("Error running optimizer cycle", exc_info=e)
+            self.last_error = e
+            return None
+        finally:
+            self.cycles += 1
+        return self.last_schedule
+
+    def start(self, get_queue, get_running, get_offers=lambda: []) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_seconds):
+                self.run_cycle(get_queue, get_running, get_offers)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="optimizer-cycler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -148,9 +148,36 @@ class Scheduler:
         for pool in self.store.pools():
             if pool.state != "active":
                 continue
-            queues[pool.name] = self.ranker.rank_pool(pool.name, pool.dru_mode)
+            ranked = self.ranker.rank_pool(pool.name, pool.dru_mode)
+            queues[pool.name] = self._filter_offensive_jobs(ranked)
         self.pending_queues = queues
         return queues
+
+    def _filter_offensive_jobs(self, ranked: List[Job]) -> List[Job]:
+        """Drop jobs whose mem/cpus exceed the configured limits and abort
+        them off-cycle, returning the inoffensive rest immediately
+        (reference: filter-offensive-jobs + make-offensive-job-stifler,
+        scheduler.clj:2205-2257)."""
+        limits = self.config.offensive_job_limits
+        if limits is None:
+            return ranked
+        max_mem_mb = limits.memory_gb * 1024.0
+        offensive = [j for j in ranked
+                     if j.resources.mem > max_mem_mb
+                     or j.resources.cpus > limits.cpus]
+        if not offensive:
+            return ranked
+        offensive_uuids = {j.uuid for j in offensive}
+
+        def stifle():
+            for job in offensive:
+                try:
+                    self.store.kill_job(job.uuid)
+                except Exception:
+                    pass
+        threading.Thread(target=stifle, daemon=True,
+                         name="offensive-job-stifler").start()
+        return [j for j in ranked if j.uuid not in offensive_uuids]
 
     def step_match(self, pool_name: Optional[str] = None
                    ) -> Dict[str, MatchCycleResult]:
@@ -310,6 +337,12 @@ class Scheduler:
                     self._kill_instance(inst.task_id, Reasons.STRAGGLER.code)
                     killed.append(inst.task_id)
         return killed
+
+    def kill_instance(self, task_id: str, reason_code: int) -> None:
+        """Public single-instance kill: authoritative store transition first,
+        then the backend kill (used by reapers, the rebalancer, and the REST
+        instance-kill endpoint)."""
+        self._kill_instance(task_id, reason_code)
 
     def _kill_instance(self, task_id: str, reason_code: int) -> None:
         inst = self.store.instance(task_id)

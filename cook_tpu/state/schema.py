@@ -247,6 +247,9 @@ class Instance:
     progress_sequence: int = 0
     exit_code: Optional[int] = None
     sandbox_directory: str = ""
+    # base URL of the instance's sandbox file server (the reference exposes
+    # output_url on instance maps for Mesos-agent / sidecar file access)
+    output_url: str = ""
     ports: List[int] = field(default_factory=list)
     queue_time_ms: int = 0
     cancelled: bool = False

@@ -364,6 +364,25 @@ class Store:
 
         return self.transact(_update)
 
+    def update_instance_sandbox(self, task_id: str,
+                                sandbox_directory: Optional[str] = None,
+                                output_url: Optional[str] = None) -> bool:
+        """Sandbox/file-server writeback (reference: the sandbox publisher
+        batches task->sandbox-dir aggregates into Datomic,
+        mesos/sandbox.clj:222-353)."""
+
+        def _update(txn: _Txn) -> bool:
+            inst = txn.instance_w(task_id)
+            if inst is None:
+                return False
+            if sandbox_directory is not None:
+                inst.sandbox_directory = sandbox_directory
+            if output_url is not None:
+                inst.output_url = output_url
+            return True
+
+        return self.transact(_update)
+
     def kill_job(self, job_uuid: str) -> bool:
         """User kill: mark killed + recompute state; the tx feed's
         job-state->completed event triggers instance kills in the scheduler

@@ -1,0 +1,153 @@
+"""Optimizer subsystem + offensive-job filter tests
+(reference behaviors: optimizer.clj; filter-offensive-jobs
+scheduler.clj:2205-2257)."""
+
+import time
+
+import pytest
+
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config, OffensiveJobLimits
+from cook_tpu.sched import Scheduler
+from cook_tpu.sched.optimizer import (
+    DummyHostFeed,
+    DummyOptimizer,
+    HostInfo,
+    OptimizerConfig,
+    OptimizerCycler,
+    optimizer_cycle,
+    validate_schedule,
+)
+from cook_tpu.state import Job, JobState, Resources, Store
+
+
+class TestOptimizerProtocols:
+    def test_dummy_cycle_produces_empty_schedule(self):
+        schedule = optimizer_cycle(
+            get_queue=lambda: [], get_running=lambda: [],
+            get_offers=lambda: [], host_feed=DummyHostFeed(),
+            optimizer=DummyOptimizer())
+        assert schedule == {0: {"suggested-matches": {}}}
+
+    def test_schedule_validation_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            validate_schedule({-5: {"suggested-matches": {}}})
+        with pytest.raises(ValueError):
+            validate_schedule({0: {}})
+        with pytest.raises(ValueError):
+            validate_schedule({0: {"suggested-matches": {"not-hostinfo": []}}})
+        hi = HostInfo(count=2, instance_type="mem-optimized",
+                      cpus=10, mem=200000)
+        validate_schedule({0: {"suggested-matches": {hi: ["uuid-1"]}}})
+        validate_schedule({0: {"suggested-matches": {}},
+                           60000: {"suggested-matches": {hi: []}}})
+
+    def test_hostinfo_validation(self):
+        with pytest.raises(ValueError):
+            HostInfo(count=-1, instance_type="x", cpus=1, mem=1).validate()
+        with pytest.raises(ValueError):
+            HostInfo(count=1, instance_type="x", cpus=0, mem=1).validate()
+        with pytest.raises(ValueError):
+            HostInfo(count=1, instance_type="x", cpus=1, mem=1,
+                     gpus=0).validate()
+
+    def test_custom_optimizer_sees_queue_and_hosts(self):
+        seen = {}
+
+        class Feed(DummyHostFeed):
+            def get_available_host_info(self):
+                return [HostInfo(count=1, instance_type="cpu", cpus=4,
+                                 mem=1024)]
+
+        class Opt(DummyOptimizer):
+            def produce_schedule(self, queue, running, available,
+                                 host_infos):
+                seen.update(queue=queue, running=running,
+                            host_infos=host_infos)
+                return {0: {"suggested-matches": {
+                    host_infos[0]: [j for j in queue]}}}
+
+        schedule = optimizer_cycle(
+            get_queue=lambda: ["j1", "j2"], get_running=lambda: ["t1"],
+            get_offers=lambda: [], host_feed=Feed(), optimizer=Opt())
+        assert seen["queue"] == ["j1", "j2"]
+        assert seen["running"] == ["t1"]
+        [(hi, uuids)] = schedule[0]["suggested-matches"].items()
+        assert uuids == ["j1", "j2"]
+
+    def test_config_driven_factory_loading(self):
+        cycler = OptimizerConfig().build()
+        assert isinstance(cycler.host_feed, DummyHostFeed)
+        assert isinstance(cycler.optimizer, DummyOptimizer)
+
+    def test_cycler_swallows_errors_like_reference(self):
+        class Broken(DummyOptimizer):
+            def produce_schedule(self, *a):
+                raise RuntimeError("boom")
+
+        cycler = OptimizerCycler(DummyHostFeed(), Broken())
+        assert cycler.run_cycle(lambda: [], lambda: []) is None
+        assert isinstance(cycler.last_error, RuntimeError)
+        assert cycler.cycles == 1
+        # a good cycle clears the error
+        cycler.optimizer = DummyOptimizer()
+        assert cycler.run_cycle(lambda: [], lambda: []) is not None
+        assert cycler.last_error is None
+
+
+def _wait_for(pred, timeout_s=5.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestOffensiveJobFilter:
+    def _system(self, limits):
+        store = Store()
+        cluster = FakeCluster(
+            "fake-1", [FakeHost("h0", Resources(cpus=64, mem=1 << 20))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.offensive_job_limits = limits
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        return store, sched
+
+    def test_offensive_jobs_stifled_and_aborted(self):
+        store, sched = self._system(
+            OffensiveJobLimits(memory_gb=1.0, cpus=4.0))
+        store.create_jobs([
+            Job(uuid="ok", user="u", command="x",
+                resources=Resources(cpus=1, mem=512)),
+            Job(uuid="big-mem", user="u", command="x",
+                resources=Resources(cpus=1, mem=2048)),
+            Job(uuid="big-cpu", user="u", command="x",
+                resources=Resources(cpus=8, mem=128)),
+        ])
+        queues = sched.step_rank()
+        assert [j.uuid for j in queues["default"]] == ["ok"]
+        # the stifler aborts offensive jobs asynchronously
+        assert _wait_for(
+            lambda: store.job("big-mem").state is JobState.COMPLETED
+            and store.job("big-cpu").state is JobState.COMPLETED)
+        assert store.job("ok").state is JobState.WAITING
+
+    def test_no_limits_passes_everything(self):
+        store, sched = self._system(None)
+        store.create_jobs([
+            Job(uuid="huge", user="u", command="x",
+                resources=Resources(cpus=512, mem=1 << 30))])
+        queues = sched.step_rank()
+        assert [j.uuid for j in queues["default"]] == ["huge"]
+
+    def test_boundary_is_exclusive(self):
+        # a job exactly at the limit is inoffensive (reference: exceeds)
+        store, sched = self._system(
+            OffensiveJobLimits(memory_gb=1.0, cpus=4.0))
+        store.create_jobs([
+            Job(uuid="at-limit", user="u", command="x",
+                resources=Resources(cpus=4.0, mem=1024.0))])
+        queues = sched.step_rank()
+        assert [j.uuid for j in queues["default"]] == ["at-limit"]

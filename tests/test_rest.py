@@ -268,3 +268,179 @@ class TestCli:
         _store, _c, _s, server = system
         from cook_tpu.cli.main import main
         assert main(["--url", server.url, "show", "nonexistent-uuid"]) == 1
+
+
+class TestGroupEndpoints:
+    def _submit_group(self, client, guuid="g-1", n=2):
+        return client.submit(
+            [{"command": f"job {i}", "group": guuid} for i in range(n)],
+            groups=[{"uuid": guuid, "name": "mygroup"}])
+
+    def test_group_status_counts(self, system):
+        _store, _c, sched, server = system
+        client = client_for(server)
+        uuids = self._submit_group(client)
+        sched.step_rank(); sched.step_match()
+        [entry] = client.group(["g-1"])
+        assert entry["name"] == "mygroup"
+        assert sorted(entry["jobs"]) == sorted(uuids)
+        assert entry["running"] == 2
+        assert entry["waiting"] == 0
+
+    def test_group_detailed(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        uuids = self._submit_group(client, "g-2")
+        [entry] = client.group(["g-2"], detailed=True)
+        assert sorted(j["uuid"] for j in entry["detailed"]) == sorted(uuids)
+
+    def test_group_kill(self, system):
+        store, _c, _s, server = system
+        client = client_for(server)
+        uuids = self._submit_group(client, "g-3")
+        killed = client.kill_groups(["g-3"])["killed"]
+        assert sorted(killed) == sorted(uuids)
+        for u in uuids:
+            assert store.job(u).state.value == "completed"
+
+    def test_group_missing_404(self, system):
+        _store, _c, _s, server = system
+        with pytest.raises(JobClientError) as e:
+            client_for(server).group(["nope"])
+        assert e.value.status == 404
+
+
+class TestListEndpoint:
+    def test_list_filters_and_limit(self, system):
+        store, _c, sched, server = system
+        client = client_for(server)
+        u1 = client.submit_one("a")
+        u2 = client.submit_one("b")
+        sched.step_rank(); sched.step_match()
+        u3 = client.submit_one("c")
+        listed = client.list_jobs("alice")
+        assert {j["uuid"] for j in listed} == {u1, u2, u3}
+        waiting = client.list_jobs("alice", states=["waiting"])
+        assert {j["uuid"] for j in waiting} == {u3}
+        # newest-first + limit
+        limited = client.list_jobs("alice", limit=1)
+        assert len(limited) == 1
+        # time window excluding everything
+        assert client.list_jobs("alice", end_ms=1) == []
+
+    def test_list_requires_user(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        with pytest.raises(JobClientError) as e:
+            client._request("GET", "/list")
+        assert e.value.status == 400
+
+
+class TestInstanceKill:
+    def test_kill_single_instance_keeps_job_retrying(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x", max_retries=3)
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        out = client.kill_instances([tid])
+        assert out["killed"] == [tid]
+        inst = client.instance(tid)
+        assert inst["status"] == "failed"
+        # job goes back to waiting (retries remain), not completed
+        assert client.job(uuid)["state"] == "waiting"
+
+    def test_kill_instance_authz(self, system):
+        _store, _c, sched, server = system
+        alice = client_for(server)
+        bob = client_for(server, "bob")
+        alice.submit_one("x")
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        with pytest.raises(JobClientError) as e:
+            bob.kill_instances([tid])
+        assert e.value.status == 403
+
+
+class TestShutdownLeader:
+    def test_admin_only(self, system):
+        _store, _c, _s, server = system
+        with pytest.raises(JobClientError) as e:
+            client_for(server).shutdown_leader()
+        assert e.value.status == 403
+        assert client_for(server, "admin").shutdown_leader()["shutdown"]
+
+
+class TestCliSandbox:
+    @pytest.fixture()
+    def sandboxed(self, system, tmp_path):
+        store, cluster, sched, server = system
+        from cook_tpu.agent.file_server import SandboxFileServer
+        client = client_for(server)
+        uuid = client.submit_one("x")
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        sandbox = tmp_path / "sandbox"
+        sandbox.mkdir()
+        (sandbox / "stdout").write_text(
+            "".join(f"line {i}\n" for i in range(100)))
+        (sandbox / "stderr").write_text("")
+        fs = SandboxFileServer(str(sandbox))
+        fs.start()
+        store.update_instance_sandbox(
+            tid, sandbox_directory=str(sandbox),
+            output_url=f"http://127.0.0.1:{fs.port}")
+        yield server, uuid, tid
+        fs.stop()
+
+    def test_cat(self, sandboxed, capsys):
+        server, uuid, _tid = sandboxed
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "cat", uuid, "stdout"]) == 0
+        assert capsys.readouterr().out.startswith("line 0\n")
+
+    def test_tail(self, sandboxed, capsys):
+        server, uuid, _tid = sandboxed
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "tail", uuid, "stdout",
+                     "--lines", "3"]) == 0
+        assert capsys.readouterr().out == "line 97\nline 98\nline 99\n"
+
+    def test_tail_small_read_granularity(self, sandboxed, capsys):
+        server, uuid, _tid = sandboxed
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "tail", uuid, "stdout",
+                     "--lines", "5", "--bytes", "16"]) == 0
+        assert capsys.readouterr().out == (
+            "line 95\nline 96\nline 97\nline 98\nline 99\n")
+
+    def test_ls(self, sandboxed, capsys):
+        server, uuid, _tid = sandboxed
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "ls", uuid, "--json"]) == 0
+        entries = json.loads(capsys.readouterr().out)
+        assert {e["path"] for e in entries} == {"stdout", "stderr"}
+
+    def test_ssh_dry_run(self, sandboxed, capsys):
+        server, uuid, _tid = sandboxed
+        from cook_tpu.cli.main import main
+        # hostname is set by the fake cluster at launch
+        assert main(["--url", server.url, "ssh", uuid, "--dry-run"]) == 0
+        line = capsys.readouterr().out.strip()
+        assert line.startswith("ssh -t h")
+        assert "cd " in line
+
+    def test_cat_by_instance_uuid(self, sandboxed, capsys):
+        server, _uuid, tid = sandboxed
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "cat", tid, "stdout"]) == 0
+        assert capsys.readouterr().out.startswith("line 0\n")
+
+    def test_cat_without_file_server_errors(self, system, capsys):
+        _store, _c, sched, server = system
+        from cook_tpu.cli.main import main
+        client = client_for(server)
+        uuid = client.submit_one("x")
+        sched.step_rank(); sched.step_match()
+        assert main(["--url", server.url, "cat", uuid, "stdout"]) == 1
+        assert "output_url" in capsys.readouterr().err
