@@ -217,9 +217,12 @@ def cmd_tail(args) -> int:
     if args.lines <= 0:
         return 0
     _job, inst = _resolve_instance(args, args.uuid[0])
+    from ..agent.file_server import MAX_READ_LENGTH
     probe = json.loads(_files_get(inst, "read", {"path": args.path}))
     size = probe.get("offset", 0)
-    want = args.bytes if args.bytes else 64 * 1024
+    # clamp to the server's per-read cap: a larger request would be
+    # silently shortened and leave holes between stitched chunks
+    want = min(args.bytes if args.bytes else 64 * 1024, MAX_READ_LENGTH)
     chunk: bytes = b""
     offset = size
     while offset > 0 and chunk.count(b"\n") <= args.lines \

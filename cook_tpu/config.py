@@ -84,6 +84,8 @@ class Config:
     # reapers (scheduler.clj:1888-2016)
     lingering_task_interval_seconds: float = 30.0
     straggler_interval_seconds: float = 30.0
+    # user/pool gauge sweeper (monitor.clj:209)
+    monitor_interval_seconds: float = 30.0
     # offensive-job stifling in the rank cycle (scheduler.clj:2205-2257);
     # None disables the filter
     offensive_job_limits: Optional[OffensiveJobLimits] = None

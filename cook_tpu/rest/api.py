@@ -338,17 +338,16 @@ class CookApi:
                 raise ApiError(404, f"no such group {uuid}")
             entry: Dict[str, Any] = {
                 "uuid": group.uuid, "name": group.name, "jobs": group.jobs}
+            jobs = [j for j in (self.store.job(u) for u in group.jobs)
+                    if j is not None]
             by_state = {"waiting": 0, "running": 0, "completed": 0}
-            for juuid in group.jobs:
-                job = self.store.job(juuid)
-                if job is not None:
-                    by_state[job.state.value] += 1
+            for job in jobs:
+                by_state[job.state.value] += 1
             entry.update(by_state)
             if detailed:
                 entry["detailed"] = [
-                    job_to_json(self.store, self.store.job(j),
-                                include_instances=False)
-                    for j in group.jobs if self.store.job(j) is not None]
+                    job_to_json(self.store, j, include_instances=False)
+                    for j in jobs]
             out.append(entry)
         return out
 
@@ -381,9 +380,12 @@ class CookApi:
         if user is None:
             raise ApiError(400, "user parameter required")
         states = parse_states(params)
-        start_ms = int(first(params.get("start-ms"), 0))
-        end_ms = int(first(params.get("end-ms"), 2**62))
-        limit = int(first(params.get("limit"), 150))
+        try:
+            start_ms = int(first(params.get("start-ms"), 0))
+            end_ms = int(first(params.get("end-ms"), 2**62))
+            limit = int(first(params.get("limit"), 150))
+        except ValueError as e:
+            raise ApiError(400, f"malformed query parameter: {e}")
         if limit <= 0:
             raise ApiError(400, "limit must be positive")
         jobs = self.store.jobs_where(

@@ -4,6 +4,7 @@ from .constraints import (  # noqa: F401
     validate_group_placement,
 )
 from .matcher import MatchCycleResult, Matcher  # noqa: F401
+from .monitor import Monitor  # noqa: F401
 from .ranker import Ranker, build_user_tasks  # noqa: F401
 from .optimizer import (  # noqa: F401
     DummyHostFeed,

@@ -1,0 +1,167 @@
+"""User/pool gauge sweeper.
+
+Parity with the reference's monitor (reference: scheduler/src/cook/
+monitor.clj:35-207 set-stats-counters!): per pool, compute per-user
+running/waiting resource stats, derive **starved** users (waiting users
+whose running usage is below their fair share on every dimension),
+**waiting-under-quota** users (waiting users whose running usage is below
+their quota on every dimension), **hungry** (waiting but not starved) and
+**satisfied** (running and not waiting) user counts, and publish everything
+as gauges — including an aggregated pseudo-user ``all`` and zeroing of
+series for users that disappeared since the previous sweep
+(clear-old-counters!, monitor.clj:137-156).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..state.store import Store
+from ..utils.metrics import MetricsRegistry
+from ..utils.metrics import registry as default_registry
+
+_STAT_DIMS = ("cpus", "mem", "jobs")
+
+
+def _job_stats(jobs_with_user: List[Tuple[str, float, float]]
+               ) -> Dict[str, Dict[str, float]]:
+    """[(user, cpus, mem)] -> user -> {cpus, mem, jobs} (reference:
+    get-job-stats monitor.clj:40-57)."""
+    stats: Dict[str, Dict[str, float]] = {}
+    for user, cpus, mem in jobs_with_user:
+        s = stats.setdefault(user, {"cpus": 0.0, "mem": 0.0, "jobs": 0.0})
+        s["cpus"] += cpus
+        s["mem"] += mem
+        s["jobs"] += 1
+    return stats
+
+
+def _with_aggregate(stats: Dict[str, Dict[str, float]]
+                    ) -> Dict[str, Dict[str, float]]:
+    """Add the pseudo-user 'all' summing every user (add-aggregated-stats,
+    monitor.clj:59-68)."""
+    total = {"cpus": 0.0, "mem": 0.0, "jobs": 0.0}
+    for s in stats.values():
+        for k in _STAT_DIMS:
+            total[k] += s.get(k, 0.0)
+    out = dict(stats)
+    out["all"] = total
+    return out
+
+
+def compute_starved_stats(store: Store, pool_name: str,
+                          running: Dict[str, Dict[str, float]],
+                          waiting: Dict[str, Dict[str, float]]
+                          ) -> Dict[str, Dict[str, float]]:
+    """Waiting users whose running usage is strictly below their share on
+    every share dimension; starvation = min(waiting, share - running)
+    (get-starved-job-stats, monitor.clj:70-90)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for user in waiting:
+        share = store.get_share(user, pool_name)
+        used = running.get(user, {})
+        promised = {k: share.get(k, float("inf")) for k in ("cpus", "mem")}
+        if all(used.get(k, 0.0) < v for k, v in promised.items()):
+            out[user] = {
+                k: min(waiting[user].get(k, 0.0),
+                       promised.get(k, float("inf")) - used.get(k, 0.0))
+                for k in _STAT_DIMS if k != "jobs"}
+            out[user]["jobs"] = waiting[user].get("jobs", 0.0)
+    return out
+
+
+def compute_waiting_under_quota_stats(store: Store, pool_name: str,
+                                      running: Dict[str, Dict[str, float]],
+                                      waiting: Dict[str, Dict[str, float]]
+                                      ) -> Dict[str, Dict[str, float]]:
+    """Waiting users whose running usage is strictly below quota on every
+    quota dimension; amount = min(waiting, max(quota - running, 0))
+    (get-waiting-under-quota-job-stats, monitor.clj:92-117)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for user in waiting:
+        quota = store.get_quota(user, pool_name)
+        used = running.get(user, {})
+        promised = {"cpus": quota.get("cpus", float("inf")),
+                    "mem": quota.get("mem", float("inf")),
+                    "jobs": quota.get("count", float("inf"))}
+        if all(used.get(k, 0.0) < v for k, v in promised.items()):
+            out[user] = {
+                k: min(waiting[user].get(k, 0.0),
+                       max(promised[k] - used.get(k, 0.0), 0.0))
+                for k in _STAT_DIMS}
+    return out
+
+
+class Monitor:
+    """Periodic stats sweeper publishing per-user per-pool gauges
+    (start-collecting-stats, monitor.clj:209)."""
+
+    def __init__(self, store: Store,
+                 registry: Optional[MetricsRegistry] = None):
+        self.store = store
+        self.registry = registry if registry is not None else default_registry
+        # (pool, state) -> {user -> stats} from the previous sweep, so
+        # series for vanished users can be zeroed
+        self._previous: Dict[Tuple[str, str], Dict[str, Dict]] = {}
+
+    # ------------------------------------------------------------- one sweep
+    def sweep(self) -> Dict[str, Dict[str, int]]:
+        """Recompute and publish all gauges; returns per-pool user counts
+        (total/starved/hungry/satisfied/waiting_under_quota) for tests and
+        structured logging."""
+        out: Dict[str, Dict[str, int]] = {}
+        for pool in self.store.pools():
+            out[pool.name] = self._sweep_pool(pool.name)
+        return out
+
+    def _sweep_pool(self, pool_name: str) -> Dict[str, int]:
+        running_stats = _job_stats([
+            (job.user, job.resources.cpus, job.resources.mem)
+            for job, _inst in self.store.running_instances(pool_name)])
+        waiting_stats = _job_stats([
+            (job.user, job.resources.cpus, job.resources.mem)
+            for job in self.store.pending_jobs(pool_name)])
+        starved = compute_starved_stats(
+            self.store, pool_name, running_stats, waiting_stats)
+        under_quota = compute_waiting_under_quota_stats(
+            self.store, pool_name, running_stats, waiting_stats)
+
+        running_users = set(running_stats)
+        waiting_users = set(waiting_stats)
+        counts = {
+            "total": len(running_users | waiting_users),
+            "starved": len(starved),
+            "waiting_under_quota": len(under_quota),
+            "hungry": len(waiting_users - set(starved)),
+            "satisfied": len(running_users - waiting_users),
+        }
+        for state, stats in (("running", running_stats),
+                             ("waiting", waiting_stats),
+                             ("starved", starved),
+                             ("waiting-under-quota", under_quota)):
+            self._publish_state(pool_name, state, stats)
+        for state, value in counts.items():
+            self.registry.gauge_set(
+                "cook_user_state_count", float(value),
+                labels={"pool": pool_name, "state": state.replace("_", "-")})
+        return counts
+
+    def _publish_state(self, pool_name: str, state: str,
+                       stats: Dict[str, Dict[str, float]]) -> None:
+        key = (pool_name, state)
+        previous: Set[str] = set(self._previous.get(key, {}))
+        with_all = _with_aggregate(stats) if stats else {
+            "all": {k: 0.0 for k in _STAT_DIMS}}
+        for user in previous - set(with_all):
+            for dim in _STAT_DIMS:
+                self.registry.gauge_set(
+                    "cook_user_resource", 0.0,
+                    labels={"pool": pool_name, "user": user, "state": state,
+                            "resource": dim})
+        self._previous[key] = dict(stats)
+        for user, s in with_all.items():
+            for dim in _STAT_DIMS:
+                self.registry.gauge_set(
+                    "cook_user_resource", float(s.get(dim, 0.0)),
+                    labels={"pool": pool_name, "user": user, "state": state,
+                            "resource": dim})

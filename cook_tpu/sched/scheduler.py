@@ -58,6 +58,8 @@ class Scheduler:
         self.matcher = Matcher(store, self.config, plugins=self.plugins,
                                rate_limits=self.rate_limits)
         self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
+        from .monitor import Monitor
+        self.monitor = Monitor(store)
         # pool -> ranked pending jobs, refreshed by the rank cycle
         self.pending_queues: Dict[str, List[Job]] = {}
         # pool -> last MatchCycleResult, feeds the unscheduled explainer
@@ -405,6 +407,7 @@ class Scheduler:
             (cfg.match_interval_seconds, self.step_match),
             (cfg.rebalancer.interval_seconds, self.step_rebalance),
             (cfg.lingering_task_interval_seconds, self.step_reapers),
+            (cfg.monitor_interval_seconds, self.monitor.sweep),
         ]
         for interval, fn in specs:
             t = threading.Thread(target=loop, args=(interval, fn), daemon=True)

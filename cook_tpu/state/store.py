@@ -14,12 +14,17 @@ metatransaction/core.clj):
   latch commits (reference: metatransactions + :job/commit-latch schema.clj:28).
 - **Snapshot/restore**: full-state JSON round-trip; a new leader resumes by
   re-reading state (SURVEY.md section 5 checkpoint/resume).
+- **Durable redo journal**: every committed transaction's write/delete set is
+  appended as one JSON line; :meth:`Store.open` replays snapshot + journal so
+  a restarted leader re-reads everything, like the reference's leader
+  re-reading Datomic (mesos.clj:296-313). :meth:`checkpoint` compacts.
 """
 
 from __future__ import annotations
 
 import copy
 import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
@@ -74,8 +79,9 @@ class _Txn:
         self._writes: Dict[Tuple[str, str], Any] = {}
         self._deletes: set = set()
         self.events: List[TxEvent] = []
-        # latch registrations applied atomically with the commit
+        # latch registrations/releases applied atomically with the commit
         self.latch_registrations: List[Tuple[str, List[str]]] = []
+        self.latch_pops: List[str] = []
 
     def _get(self, table: str, key: str, for_write: bool) -> Any:
         wk = (table, key)
@@ -166,6 +172,11 @@ class Store:
         self._event_queue: List[Tuple[int, List[TxEvent]]] = []
         self._notify_lock = threading.Lock()
         self._draining = threading.local()
+        # durable redo journal (attached via attach_journal / Store.open)
+        self._journal_file = None
+        self._journal_path: Optional[str] = None
+        self._journal_dir: Optional[str] = None
+        self._journal_fsync = False
 
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
@@ -180,11 +191,35 @@ class Store:
                 getattr(self, "_" + table).pop(key, None)
             for latch, uuids in txn.latch_registrations:
                 self._latches.setdefault(latch, []).extend(uuids)
+            for latch in txn.latch_pops:
+                self._latches.pop(latch, None)
             self._tx_id += 1
+            if self._journal_file is not None and (
+                    txn._writes or txn._deletes or txn.latch_registrations
+                    or txn.latch_pops):
+                self._journal_append(txn)
             if txn.events:
                 self._event_queue.append((self._tx_id, txn.events))
         self._drain_events()
         return result
+
+    def _journal_append(self, txn: _Txn) -> None:
+        """Append one committed transaction to the redo journal (caller holds
+        the store lock, so records are in commit order)."""
+        rec: Dict[str, Any] = {"tx": self._tx_id}
+        if txn._writes:
+            rec["w"] = {f"{table}/{key}": to_json(ent)
+                        for (table, key), ent in txn._writes.items()}
+        if txn._deletes:
+            rec["d"] = [f"{table}/{key}" for table, key in txn._deletes]
+        if txn.latch_registrations:
+            rec["lr"] = txn.latch_registrations
+        if txn.latch_pops:
+            rec["lp"] = txn.latch_pops
+        self._journal_file.write(json.dumps(rec) + "\n")
+        self._journal_file.flush()
+        if self._journal_fsync:
+            os.fsync(self._journal_file.fileno())
 
     def _drain_events(self) -> None:
         """Deliver queued events in commit order. Whoever holds _notify_lock
@@ -256,10 +291,11 @@ class Store:
         return self.transact(_create)
 
     def commit_latch(self, latch: str) -> None:
-        with self._lock:
-            uuids = self._latches.pop(latch, [])
-
         def _commit(txn: _Txn) -> None:
+            # transact holds the store lock while fn runs, so the read of
+            # _latches and the pop below are atomic with the job writes
+            uuids = self._latches.get(latch, [])
+            txn.latch_pops.append(latch)
             for uuid in uuids:
                 job = txn.job_w(uuid)
                 if job is not None:
@@ -479,8 +515,7 @@ class Store:
 
     # ----------------------------------------------------- pools/shares/quota
     def put_pool(self, pool: Pool) -> None:
-        with self._lock:
-            self._pools[pool.name] = pool
+        self.transact(lambda txn: txn.put("pools", pool.name, pool))
 
     def pools(self) -> List[Pool]:
         with self._lock:
@@ -493,8 +528,8 @@ class Store:
 
     def set_share(self, user: str, pool: str, resources: Dict[str, float],
                   reason: str = "") -> None:
-        with self._lock:
-            self._shares[f"{user}/{pool}"] = ShareEntry(user, pool, dict(resources), reason)
+        entry = ShareEntry(user, pool, dict(resources), reason)
+        self.transact(lambda txn: txn.put("shares", f"{user}/{pool}", entry))
 
     def get_share(self, user: str, pool: str) -> Dict[str, float]:
         """Share with 'default'-user then MAX_VALUE fallback per resource
@@ -513,13 +548,12 @@ class Store:
         return out
 
     def retract_share(self, user: str, pool: str) -> None:
-        with self._lock:
-            self._shares.pop(f"{user}/{pool}", None)
+        self.transact(lambda txn: txn.delete("shares", f"{user}/{pool}"))
 
     def set_quota(self, user: str, pool: str, resources: Dict[str, float],
                   count: float = float("inf"), reason: str = "") -> None:
-        with self._lock:
-            self._quotas[f"{user}/{pool}"] = QuotaEntry(user, pool, dict(resources), count, reason)
+        entry = QuotaEntry(user, pool, dict(resources), count, reason)
+        self.transact(lambda txn: txn.put("quotas", f"{user}/{pool}", entry))
 
     def get_quota(self, user: str, pool: str) -> Dict[str, float]:
         """Quota map incl. :count, default-user fallback, infinite default
@@ -544,8 +578,7 @@ class Store:
         return out
 
     def retract_quota(self, user: str, pool: str) -> None:
-        with self._lock:
-            self._quotas.pop(f"{user}/{pool}", None)
+        self.transact(lambda txn: txn.delete("quotas", f"{user}/{pool}"))
 
     def shares(self) -> List[ShareEntry]:
         with self._lock:
@@ -576,29 +609,125 @@ class Store:
         state = json.loads(blob)
         store = cls()
         store._tx_id = state["tx_id"]
-        for k, v in state["jobs"].items():
-            store._jobs[k] = _job_from_json(v)
-        for k, v in state["instances"].items():
-            v = dict(v)
-            v["status"] = InstanceStatus(v["status"])
-            store._instances[k] = Instance(**v)
-        for k, v in state["groups"].items():
-            v = dict(v)
-            v["placement_type"] = GroupPlacementType(v["placement_type"])
-            store._groups[k] = Group(**v)
-        for k, v in state["pools"].items():
-            v = dict(v)
-            v["dru_mode"] = DruMode(v["dru_mode"])
-            v["scheduler"] = SchedulerKind(v["scheduler"])
-            store._pools[k] = Pool(**v)
-        for k, v in state["shares"].items():
-            store._shares[k] = ShareEntry(**v)
-        for k, v in state["quotas"].items():
-            v = dict(v)
-            v["count"] = float(v["count"]) if v["count"] is not None else float("inf")
-            store._quotas[k] = QuotaEntry(**v)
+        for table in ("jobs", "instances", "groups", "pools", "shares",
+                      "quotas"):
+            target = getattr(store, "_" + table)
+            for k, v in state[table].items():
+                target[k] = _entity_from_json(table, v)
         store._latches = {k: list(v) for k, v in state.get("latches", {}).items()}
         return store
+
+    # ------------------------------------------------------- durable journal
+    def attach_journal(self, path: str, fsync: bool = False) -> None:
+        """Start appending every committed transaction to ``path`` as one
+        JSON line. With ``fsync``, each record is fsynced (durable against
+        power loss, not just process crash)."""
+        with self._lock:
+            self._journal_path = path
+            self._journal_fsync = fsync
+            self._journal_file = open(path, "a", encoding="utf-8")
+
+    @classmethod
+    def open(cls, directory: str, fsync: bool = False) -> "Store":
+        """Open a durable store rooted at ``directory`` (snapshot.json +
+        journal.jsonl): load the snapshot if present, replay the journal,
+        resume appending. The equivalent of a new leader re-reading Datomic
+        (reference: mesos.clj:296-313 — replay nothing, just re-read)."""
+        os.makedirs(directory, exist_ok=True)
+        snap_path = os.path.join(directory, "snapshot.json")
+        journal_path = os.path.join(directory, "journal.jsonl")
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                store = cls.restore(f.read())
+        else:
+            store = cls()
+        if os.path.exists(journal_path):
+            with open(journal_path, "rb") as f:
+                data = f.read()
+            # Every append ends with \n, so a line without one is a torn
+            # tail from a crash. Replay up to the last good record, then
+            # truncate the torn bytes — resuming appends after a fragment
+            # would merge into one unparseable line and silently drop every
+            # later record on the NEXT reopen.
+            good = 0
+            for line in data.splitlines(keepends=True):
+                if not line.endswith(b"\n"):
+                    break
+                text = line.strip()
+                if text:
+                    try:
+                        rec = json.loads(text)
+                    except json.JSONDecodeError:
+                        break
+                    store._apply_journal_record(rec)
+                good += len(line)
+            if good < len(data):
+                with open(journal_path, "r+b") as f:
+                    f.truncate(good)
+        store._journal_dir = directory
+        store.attach_journal(journal_path, fsync=fsync)
+        return store
+
+    def _apply_journal_record(self, rec: Dict[str, Any]) -> None:
+        for tk, v in rec.get("w", {}).items():
+            table, key = tk.split("/", 1)
+            getattr(self, "_" + table)[key] = _entity_from_json(table, v)
+        for tk in rec.get("d", []):
+            table, key = tk.split("/", 1)
+            getattr(self, "_" + table).pop(key, None)
+        for latch, uuids in rec.get("lr", []):
+            self._latches.setdefault(latch, []).extend(uuids)
+        for latch in rec.get("lp", []):
+            self._latches.pop(latch, None)
+        self._tx_id = rec.get("tx", self._tx_id)
+
+    def checkpoint(self) -> None:
+        """Compact the journal: atomically write a fresh snapshot, then
+        truncate the journal. Safe at any point — the snapshot covers every
+        journaled transaction."""
+        if self._journal_dir is None:
+            raise ValueError("checkpoint() requires a store from Store.open")
+        with self._lock:
+            snap_path = os.path.join(self._journal_dir, "snapshot.json")
+            tmp = snap_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(self.snapshot())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, snap_path)
+            self._journal_file.close()
+            self._journal_file = open(self._journal_path, "w",
+                                      encoding="utf-8")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal_file is not None:
+                self._journal_file.close()
+                self._journal_file = None
+
+
+def _entity_from_json(table: str, v: Dict[str, Any]) -> Any:
+    """Inverse of ``to_json`` per entity table (shared by snapshot restore
+    and journal replay)."""
+    if table == "jobs":
+        return _job_from_json(v)
+    v = dict(v)
+    if table == "instances":
+        v["status"] = InstanceStatus(v["status"])
+        return Instance(**v)
+    if table == "groups":
+        v["placement_type"] = GroupPlacementType(v["placement_type"])
+        return Group(**v)
+    if table == "pools":
+        v["dru_mode"] = DruMode(v["dru_mode"])
+        v["scheduler"] = SchedulerKind(v["scheduler"])
+        return Pool(**v)
+    if table == "shares":
+        return ShareEntry(**v)
+    if table == "quotas":
+        v["count"] = float(v["count"]) if v["count"] is not None else float("inf")
+        return QuotaEntry(**v)
+    raise ValueError(f"unknown entity table {table}")
 
 
 def _job_from_json(v: Dict[str, Any]) -> Job:

@@ -1,0 +1,98 @@
+"""User/pool gauge sweeper tests (reference behaviors:
+set-stats-counters! monitor.clj:35-207)."""
+
+from cook_tpu.sched.monitor import Monitor
+from cook_tpu.state import InstanceStatus, Job, Pool, Resources, Store
+from cook_tpu.utils.metrics import MetricsRegistry
+
+
+def make_store() -> Store:
+    store = Store()
+    store.put_pool(Pool(name="default"))
+    return store
+
+
+def make_job(uuid, user, cpus=1.0, mem=100.0):
+    return Job(uuid=uuid, user=user, command="x",
+               resources=Resources(cpus=cpus, mem=mem))
+
+
+def run_job(store, uuid, host="h0"):
+    store.launch_instance(uuid, f"task-{uuid}", host)
+    store.update_instance_status(f"task-{uuid}", InstanceStatus.RUNNING)
+
+
+class TestMonitorSweep:
+    def test_user_classification(self):
+        store = make_store()
+        # alice: running 4 cpus, share 2 -> not starved (over share), waiting
+        store.create_jobs([make_job("a1", "alice", cpus=4),
+                           make_job("a2", "alice", cpus=1)])
+        run_job(store, "a1")
+        store.set_share("alice", "default", {"cpus": 2.0, "mem": 1e9})
+        # bob: waiting only, share large -> starved
+        store.create_jobs([make_job("b1", "bob", cpus=1)])
+        store.set_share("bob", "default", {"cpus": 10.0, "mem": 1e9})
+        # carol: running only -> satisfied
+        store.create_jobs([make_job("c1", "carol", cpus=1)])
+        run_job(store, "c1")
+        registry = MetricsRegistry()
+        counts = Monitor(store, registry).sweep()["default"]
+        assert counts["total"] == 3
+        assert counts["starved"] == 1          # bob
+        assert counts["hungry"] == 1           # alice (waiting, not starved)
+        assert counts["satisfied"] == 1        # carol
+        assert counts["waiting_under_quota"] == 2  # alice + bob (inf quota)
+
+    def test_starvation_amount_capped_by_share_gap(self):
+        store = make_store()
+        store.create_jobs([make_job("r1", "dave", cpus=2),
+                           make_job("w1", "dave", cpus=8)])
+        run_job(store, "r1")
+        store.set_share("dave", "default", {"cpus": 5.0, "mem": 1e9})
+        from cook_tpu.sched.monitor import compute_starved_stats
+        running = {"dave": {"cpus": 2.0, "mem": 100.0, "jobs": 1.0}}
+        waiting = {"dave": {"cpus": 8.0, "mem": 100.0, "jobs": 1.0}}
+        starved = compute_starved_stats(store, "default", running, waiting)
+        # starvation = min(waiting 8, share 5 - running 2) = 3
+        assert starved["dave"]["cpus"] == 3.0
+
+    def test_waiting_under_quota_respects_count(self):
+        store = make_store()
+        store.create_jobs([make_job("q1", "erin"), make_job("q2", "erin")])
+        run_job(store, "q1")
+        # count quota 1, already running 1 -> NOT under quota
+        store.set_quota("erin", "default", {"cpus": 100.0, "mem": 1e9},
+                        count=1)
+        registry = MetricsRegistry()
+        counts = Monitor(store, registry).sweep()["default"]
+        assert counts["waiting_under_quota"] == 0
+
+    def test_gauges_published_and_stale_zeroed(self):
+        store = make_store()
+        store.create_jobs([make_job("g1", "frank")])
+        registry = MetricsRegistry()
+        monitor = Monitor(store, registry)
+        monitor.sweep()
+        text = registry.expose()
+        assert 'cook_user_resource' in text
+        assert 'user="frank"' in text and 'user="all"' in text
+        assert 'cook_user_state_count' in text
+        # frank's job completes; his waiting series must drop to zero
+        store.kill_job("g1")
+        monitor.sweep()
+        snap = registry.snapshot()
+        gauges = snap.get("gauges", snap)
+        found = [
+            (k, v) for k, v in _flatten(gauges)
+            if "cook_user_resource" in str(k) and "frank" in str(k)
+            and "waiting" in str(k) and "cpus" in str(k)]
+        assert found and all(v == 0.0 for _k, v in found)
+
+
+def _flatten(obj, prefix=()):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _flatten(v, prefix + (k,))
+    else:
+        yield prefix, obj

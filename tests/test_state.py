@@ -337,3 +337,98 @@ class TestSnapshotRestore:
         # restored store is live: finish the instance
         restored.update_instance_status("t1", InstanceStatus.SUCCESS)
         assert restored.job(uuid).state is JobState.COMPLETED
+
+
+class TestDurableStore:
+    def test_crash_and_reopen_replays_journal(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [uuid] = store.create_jobs([make_job()])
+        store.launch_instance(uuid, "t1", "host-a")
+        store.update_instance_status("t1", InstanceStatus.RUNNING)
+        store.set_share("alice", "default", {"cpus": 5.0})
+        tx_before = store._tx_id
+        # simulate a crash: no close(), no checkpoint — just reopen
+        reopened = Store.open(d)
+        assert reopened.job(uuid).state is JobState.RUNNING
+        assert reopened.instance("t1").status is InstanceStatus.RUNNING
+        assert reopened.get_share("alice", "default")["cpus"] == 5.0
+        assert reopened._tx_id == tx_before
+        # the reopened store is live and keeps journaling
+        reopened.update_instance_status("t1", InstanceStatus.SUCCESS)
+        third = Store.open(d)
+        assert third.job(uuid).state is JobState.COMPLETED
+
+    def test_checkpoint_compacts_journal(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        uuids = store.create_jobs([make_job() for _ in range(5)])
+        journal = tmp_path / "state" / "journal.jsonl"
+        assert journal.stat().st_size > 0
+        store.checkpoint()
+        assert journal.stat().st_size == 0
+        assert (tmp_path / "state" / "snapshot.json").exists()
+        # post-checkpoint writes land in the fresh journal
+        store.kill_job(uuids[0])
+        reopened = Store.open(d)
+        assert reopened.job(uuids[0]).state is JobState.COMPLETED
+        assert reopened.job(uuids[1]).state is JobState.WAITING
+
+    def test_torn_tail_write_is_ignored(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [uuid] = store.create_jobs([make_job()])
+        store.close()
+        journal = tmp_path / "state" / "journal.jsonl"
+        with open(journal, "a") as f:
+            f.write('{"tx": 99, "w": {"jobs/zzz": {"uu')  # torn record
+        reopened = Store.open(d)
+        assert reopened.job(uuid) is not None
+        assert reopened.job("zzz") is None
+
+    def test_uncommitted_latch_survives_restart_invisible(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        job = make_job()
+        store.create_jobs([job], latch="latch-1")
+        assert store.pending_jobs("default") == []
+        reopened = Store.open(d)
+        # still registered and still invisible
+        assert reopened.pending_jobs("default") == []
+        reopened.commit_latch("latch-1")
+        assert [j.uuid for j in reopened.pending_jobs("default")] == [job.uuid]
+        final = Store.open(d)
+        assert [j.uuid for j in final.pending_jobs("default")] == [job.uuid]
+
+    def test_quota_inf_roundtrips_through_journal(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        store.set_quota("bob", "default", {"cpus": 4.0})  # count defaults inf
+        reopened = Store.open(d)
+        assert reopened.get_quota("bob", "default")["count"] == float("inf")
+        assert reopened.get_quota("bob", "default")["cpus"] == 4.0
+
+    def test_retract_share_durable(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        store.set_share("alice", "default", {"cpus": 2.0})
+        store.retract_share("alice", "default")
+        reopened = Store.open(d)
+        # falls back to the infinite default
+        assert reopened.get_share("alice", "default")["cpus"] == float("inf")
+
+    def test_writes_after_torn_tail_recovery_survive_next_reopen(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [u1] = store.create_jobs([make_job()])
+        store.close()
+        journal = tmp_path / "state" / "journal.jsonl"
+        with open(journal, "a") as f:
+            f.write('{"tx": 99, "w"')  # torn record, no newline
+        # recovery truncates the torn bytes; new writes append cleanly
+        recovered = Store.open(d)
+        [u2] = recovered.create_jobs([make_job()])
+        recovered.close()
+        final = Store.open(d)
+        assert final.job(u1) is not None
+        assert final.job(u2) is not None, "post-recovery write was lost"
