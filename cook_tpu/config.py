@@ -20,7 +20,9 @@ class MatcherConfig:
     config.clj:110-117)."""
 
     # "tpu-greedy" = bit-exact greedy scan kernel; "tpu-auction" = top-K
-    # auction kernel for large queues; "cpu" = numpy fallback.
+    # auction kernel for large queues; "tpu-auction-pallas" = same auction
+    # but the preference build is a blockwise Pallas kernel (no J x H score
+    # matrix in HBM); "cpu" = numpy fallback.
     backend: str = "tpu-greedy"
     max_jobs_considered: int = 1000
     # head-of-queue fairness backoff (scheduler.clj:1613-1651)

@@ -106,8 +106,6 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
     statistically — the greedy kernel remains the bit-exact parity mode.
     """
     J, H = inp.constraint_mask.shape
-    job_idx = jnp.arange(J, dtype=jnp.int32)
-
     feasible0 = (jnp.all(inp.avail[None, :, :] >= inp.job_res[:, None, :], axis=2)
                  & inp.constraint_mask & inp.valid[:, None])
     used = inp.capacity - inp.avail
@@ -117,6 +115,35 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
     fit = jnp.where(feasible0, fit * 0.5, NEG_INF)
     K = min(num_prefs, H)
     pref_fit, pref_host = jax.lax.top_k(fit, K)        # [J, K]
+    return _auction_rounds(inp, pref_fit, pref_host, num_rounds)
+
+
+def auction_match_pallas(inp: MatchInputs, *, num_prefs: int = 16,
+                         num_rounds: int = 24, interpret=None
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Auction assignment whose preference build runs as a blockwise Pallas
+    kernel (ops/pallas_match.py) — same result as
+    :func:`auction_match_kernel`, but the J x H score matrix never touches
+    HBM.  Preferred on TPU at large J x H."""
+    from . import pallas_match
+    pref_fit, pref_host = pallas_match.topk_prefs(
+        inp.job_res, inp.constraint_mask, inp.valid, inp.avail, inp.capacity,
+        k=num_prefs, interpret=interpret)
+    return _auction_rounds_jit(inp, pref_fit, pref_host,
+                               num_rounds=num_rounds)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rounds",))
+def _auction_rounds_jit(inp, pref_fit, pref_host, *, num_rounds):
+    return _auction_rounds(inp, pref_fit, pref_host, num_rounds)
+
+
+def _auction_rounds(inp: MatchInputs, pref_fit: jax.Array,
+                    pref_host: jax.Array, num_rounds: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    J, H = inp.constraint_mask.shape
+    job_idx = jnp.arange(J, dtype=jnp.int32)
+    K = pref_host.shape[1]
     pref_ok = pref_fit > NEG_INF
 
     def one_round(state, _):

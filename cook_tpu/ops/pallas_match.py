@@ -1,0 +1,165 @@
+"""Pallas TPU kernel for the match-cycle preference build.
+
+The auction matcher (ops/match.py, replacing the reference's Fenzo
+``scheduleOnce`` hot loop, scheduler.clj:617-687) starts by scoring every
+(job, host) pair — feasibility under the offered resources plus the
+cpuMemBinPacker fitness (config.clj:108) — and keeping each job's top-K
+hosts.  Done naively that materializes an f32[J, H] score matrix in HBM:
+at the BASELINE.md scale (1M jobs x 50k offers) that is ~200 GB of HBM
+traffic, far past a v5e chip's budget.
+
+This kernel computes the scores *blockwise in VMEM* and carries a running
+top-K per job tile across host tiles, so HBM traffic is O(J*R + H*R + J*K)
+— the inputs and the result, never the J x H cross product.  The host axis
+is the innermost grid dimension; VMEM scratch persists across the
+sequential TPU grid, which is what makes the running top-K merge legal.
+
+Resource comparisons are unrolled over the (tiny, static) resource axis so
+every op in the kernel is a 2-D [TJ, TH] VPU op; the top-K merge is K
+unrolled selection passes over the concatenated [TJ, K+TH] candidate
+buffer (max + first-argmax-via-iota + mask), avoiding any sort/top_k
+primitive inside the kernel.
+
+On CPU (tests, fallback deployments) the kernel runs in interpret mode;
+parity with the plain-XLA formulation in ops/match.py is bit-exact and
+asserted in tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# pltpu is importable on CPU builds too (needed even for interpret-mode
+# scratch shapes); if this import fails the pallas path is unusable and the
+# caller should select a plain-XLA matcher backend instead.
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+_BIG = 2**31 - 1  # python literal: module-level jnp consts would be captured
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
+            out_fit_ref, out_host_ref, run_fit, run_host, *, n_res: int,
+            k: int, tile_h: int):
+    """One (job-tile, host-tile) grid step: score the tile, merge top-K."""
+    h = pl.program_id(1)
+    tj = cmask_ref.shape[0]
+
+    @pl.when(h == 0)
+    def _init():
+        run_fit[:] = jnp.full((tj, k), NEG_INF, dtype=jnp.float32)
+        run_host[:] = jnp.zeros((tj, k), dtype=jnp.int32)
+
+    # --- score this [TJ, TH] tile; unrolled over the static resource axis
+    feas = cmask_ref[:] > 0.0
+    for r in range(n_res):
+        need_col = res_ref[:, r:r + 1]            # [TJ, 1]
+        avail_row = avail_t_ref[r:r + 1, :]       # [1, TH]
+        feas &= avail_row >= need_col
+    # cpuMemBinPacker fitness on resources 0 (cpus) and 1 (mem)
+    fit = jnp.zeros_like(cmask_ref[:])
+    for r in (0, 1):
+        cap_row = jnp.maximum(cap_t_ref[r:r + 1, :], 1e-9)
+        used_row = cap_t_ref[r:r + 1, :] - avail_t_ref[r:r + 1, :]
+        fit += (used_row + res_ref[:, r:r + 1]) / cap_row
+    score = jnp.where(feas, fit * 0.5, NEG_INF)   # [TJ, TH]
+
+    tile_iota = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
+    host_idx = tile_iota + h * tile_h
+
+    # --- merge running top-K with this tile's scores.  Previous top-K
+    # entries sit at positions < TH entries, and run_fit is sorted
+    # descending, so "first position achieving the max" reproduces
+    # lax.top_k's lowest-host-index tie-breaking exactly.
+    combined = jnp.concatenate([run_fit[:], score], axis=1)       # [TJ, K+TH]
+    combined_idx = jnp.concatenate([run_host[:], host_idx], axis=1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, combined.shape, 1)
+    for kk in range(k):
+        m = jnp.max(combined, axis=1, keepdims=True)              # [TJ, 1]
+        first = jnp.min(jnp.where(combined == m, pos, _BIG), axis=1,
+                        keepdims=True)
+        sel = pos == first
+        run_fit[:, kk:kk + 1] = m
+        run_host[:, kk:kk + 1] = jnp.sum(
+            jnp.where(sel, combined_idx, 0), axis=1, keepdims=True)
+        combined = jnp.where(sel, NEG_INF, combined)
+
+    @pl.when(h == pl.num_programs(1) - 1)
+    def _emit():
+        out_fit_ref[:] = run_fit[:]
+        out_host_ref[:] = run_host[:]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile_j", "tile_h",
+                                             "interpret"))
+def _topk_prefs_padded(job_res, cmask_f32, avail_t, cap_t, *, k: int,
+                       tile_j: int, tile_h: int, interpret: bool):
+    jp, n_res = job_res.shape
+    hp = avail_t.shape[1]
+    grid = (jp // tile_j, hp // tile_h)
+    kernel = functools.partial(_kernel, n_res=n_res, k=k, tile_h=tile_h)
+    out_shape = (jax.ShapeDtypeStruct((jp, k), jnp.float32),
+                 jax.ShapeDtypeStruct((jp, k), jnp.int32))
+    mem = {"memory_space": pltpu.VMEM}
+    scratch = [pltpu.VMEM((tile_j, k), jnp.float32),
+               pltpu.VMEM((tile_j, k), jnp.int32)]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_j, n_res), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((tile_j, tile_h), lambda j, h: (j, h), **mem),
+            pl.BlockSpec((n_res, tile_h), lambda j, h: (0, h), **mem),
+            pl.BlockSpec((n_res, tile_h), lambda j, h: (0, h), **mem),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile_j, k), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((tile_j, k), lambda j, h: (j, 0), **mem),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(job_res, cmask_f32, avail_t, cap_t)
+
+
+def topk_prefs(job_res: jax.Array, constraint_mask: jax.Array,
+               valid: jax.Array, avail: jax.Array, capacity: jax.Array,
+               k: int = 16, *, tile_j: int = 128, tile_h: int = 128,
+               interpret: Optional[bool] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Blockwise top-K host preferences per job.
+
+    Args mirror ops.match.MatchInputs: job_res f32[J, R], constraint_mask
+    bool[J, H], valid bool[J], avail/capacity f32[H, R].  Returns
+    (pref_fit f32[J, K], pref_host i32[J, K]) identical to
+    ``lax.top_k(score, K)`` over the full score matrix, without ever
+    materializing it.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    j, n_res = job_res.shape
+    h = avail.shape[0]
+    k = min(k, h)
+    jp, hp = _cdiv(j, tile_j) * tile_j, _cdiv(h, tile_h) * tile_h
+
+    cmask = constraint_mask & valid[:, None]
+    cmask_f32 = jnp.zeros((jp, hp), jnp.float32).at[:j, :h].set(
+        cmask.astype(jnp.float32))
+    job_res_p = jnp.zeros((jp, n_res), jnp.float32).at[:j].set(job_res)
+    # padded hosts: avail = -1 so nothing fits them, capacity = 1
+    avail_p = jnp.full((hp, n_res), -1.0, jnp.float32).at[:h].set(avail)
+    cap_p = jnp.ones((hp, n_res), jnp.float32).at[:h].set(capacity)
+
+    fit, host = _topk_prefs_padded(
+        job_res_p, cmask_f32, avail_p.T, cap_p.T, k=k, tile_j=tile_j,
+        tile_h=tile_h, interpret=bool(interpret))
+    return fit[:j], host[:j]

@@ -217,7 +217,13 @@ class Matcher:
             avail=jnp.asarray(arrays["avail"]),
             capacity=jnp.asarray(arrays["capacity"]),
             valid=jnp.asarray(arrays["valid"]))
-        if mc.backend == "tpu-auction":
+        if mc.backend == "tpu-auction-pallas":
+            # blockwise-VMEM preference build; J x H never touches HBM
+            from ..ops.match import auction_match_pallas
+            assign, _ = auction_match_pallas(
+                inp, num_prefs=mc.auction_num_prefs,
+                num_rounds=mc.auction_num_rounds)
+        elif mc.backend == "tpu-auction":
             assign, _ = auction_match_kernel(
                 inp, num_prefs=mc.auction_num_prefs,
                 num_rounds=mc.auction_num_rounds)

@@ -185,6 +185,15 @@ class Store:
         with self._lock:
             txn = _Txn(self)
             result = fn(txn)  # AbortTransaction propagates; nothing installed
+            self._tx_id += 1
+            # Write-ahead: journal BEFORE installing, so a failed append
+            # (disk full, bad fd) aborts the transaction instead of leaving
+            # committed in-memory state that silently vanishes on replay.
+            # A torn tail line is truncated by recovery on the next open.
+            if self._journal_file is not None and (
+                    txn._writes or txn._deletes or txn.latch_registrations
+                    or txn.latch_pops):
+                self._journal_append(txn)
             for (table, key), ent in txn._writes.items():
                 getattr(self, "_" + table)[key] = ent
             for table, key in txn._deletes:
@@ -193,11 +202,6 @@ class Store:
                 self._latches.setdefault(latch, []).extend(uuids)
             for latch in txn.latch_pops:
                 self._latches.pop(latch, None)
-            self._tx_id += 1
-            if self._journal_file is not None and (
-                    txn._writes or txn._deletes or txn.latch_registrations
-                    or txn.latch_pops):
-                self._journal_append(txn)
             if txn.events:
                 self._event_queue.append((self._tx_id, txn.events))
         self._drain_events()
@@ -685,8 +689,9 @@ class Store:
         """Compact the journal: atomically write a fresh snapshot, then
         truncate the journal. Safe at any point — the snapshot covers every
         journaled transaction."""
-        if self._journal_dir is None:
-            raise ValueError("checkpoint() requires a store from Store.open")
+        if self._journal_dir is None or self._journal_file is None:
+            raise ValueError(
+                "checkpoint() requires an open store from Store.open")
         with self._lock:
             snap_path = os.path.join(self._journal_dir, "snapshot.json")
             tmp = snap_path + ".tmp"
