@@ -67,6 +67,16 @@ class PoolQuota:
 
 
 @dataclass
+class EstimatedCompletionConfig:
+    """estimated-completion constraint knobs (reference:
+    config/estimated-completion-config, constraints.clj:408-432). Disabled
+    unless both multiplier and host_lifetime_mins are set."""
+    expected_runtime_multiplier: Optional[float] = None
+    host_lifetime_mins: Optional[int] = None
+    agent_start_grace_period_mins: int = 10
+
+
+@dataclass
 class Config:
     rank_interval_seconds: float = 5.0         # mesos.clj:108
     match_interval_seconds: float = 1.0        # target-per-pool-match-interval
@@ -81,6 +91,8 @@ class Config:
     quota_groups: Dict[str, str] = field(default_factory=dict)
     quota_group_quotas: Dict[str, PoolQuota] = field(default_factory=dict)
     max_tasks_per_host: Optional[int] = None
+    estimated_completion: EstimatedCompletionConfig = field(
+        default_factory=EstimatedCompletionConfig)
     # synthetic-pod autoscaling after each match cycle (scheduler.clj:1178)
     autoscaling_enabled: bool = False
     # reapers (scheduler.clj:1888-2016)

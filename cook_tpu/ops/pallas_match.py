@@ -59,14 +59,16 @@ def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
         run_fit[:] = jnp.full((tj, k), NEG_INF, dtype=jnp.float32)
         run_host[:] = jnp.zeros((tj, k), dtype=jnp.int32)
 
-    # --- score this [TJ, TH] tile; unrolled over the static resource axis
-    feas = cmask_ref[:] > 0.0
+    # --- score this [TJ, TH] tile; unrolled over the static resource axis.
+    # The mask travels through HBM as int8 (1 byte/element); upcast in VMEM
+    # before comparing — Mosaic lacks vector i8 compares on this target.
+    feas = cmask_ref[:].astype(jnp.int32) > 0
     for r in range(n_res):
         need_col = res_ref[:, r:r + 1]            # [TJ, 1]
         avail_row = avail_t_ref[r:r + 1, :]       # [1, TH]
         feas &= avail_row >= need_col
     # cpuMemBinPacker fitness on resources 0 (cpus) and 1 (mem)
-    fit = jnp.zeros_like(cmask_ref[:])
+    fit = jnp.zeros(feas.shape, dtype=jnp.float32)
     for r in (0, 1):
         cap_row = jnp.maximum(cap_t_ref[r:r + 1, :], 1e-9)
         used_row = cap_t_ref[r:r + 1, :] - avail_t_ref[r:r + 1, :]
@@ -101,7 +103,7 @@ def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
 
 @functools.partial(jax.jit, static_argnames=("k", "tile_j", "tile_h",
                                              "interpret"))
-def _topk_prefs_padded(job_res, cmask_f32, avail_t, cap_t, *, k: int,
+def _topk_prefs_padded(job_res, cmask_i8, avail_t, cap_t, *, k: int,
                        tile_j: int, tile_h: int, interpret: bool):
     jp, n_res = job_res.shape
     hp = avail_t.shape[1]
@@ -128,7 +130,7 @@ def _topk_prefs_padded(job_res, cmask_f32, avail_t, cap_t, *, k: int,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
-    )(job_res, cmask_f32, avail_t, cap_t)
+    )(job_res, cmask_i8, avail_t, cap_t)
 
 
 def topk_prefs(job_res: jax.Array, constraint_mask: jax.Array,
@@ -151,15 +153,17 @@ def topk_prefs(job_res: jax.Array, constraint_mask: jax.Array,
     k = min(k, h)
     jp, hp = _cdiv(j, tile_j) * tile_j, _cdiv(h, tile_h) * tile_h
 
+    # int8, not f32: the padded mask is the only J x H array this path
+    # touches, keep it at 1 byte/element
     cmask = constraint_mask & valid[:, None]
-    cmask_f32 = jnp.zeros((jp, hp), jnp.float32).at[:j, :h].set(
-        cmask.astype(jnp.float32))
+    cmask_i8 = jnp.zeros((jp, hp), jnp.int8).at[:j, :h].set(
+        cmask.astype(jnp.int8))
     job_res_p = jnp.zeros((jp, n_res), jnp.float32).at[:j].set(job_res)
     # padded hosts: avail = -1 so nothing fits them, capacity = 1
     avail_p = jnp.full((hp, n_res), -1.0, jnp.float32).at[:h].set(avail)
     cap_p = jnp.ones((hp, n_res), jnp.float32).at[:h].set(capacity)
 
     fit, host = _topk_prefs_padded(
-        job_res_p, cmask_f32, avail_p.T, cap_p.T, k=k, tile_j=tile_j,
+        job_res_p, cmask_i8, avail_p.T, cap_p.T, k=k, tile_j=tile_j,
         tile_h=tile_h, interpret=bool(interpret))
     return fit[:j], host[:j]

@@ -31,6 +31,7 @@ X-Cook-Impersonate (reference: rest/authorization.clj, impersonation.clj).
 from __future__ import annotations
 
 import base64
+import hmac
 import json
 import re
 import threading
@@ -57,10 +58,12 @@ from ..state.store import AbortTransaction, Store
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}
 
 
 class _Redirect(Exception):
@@ -163,7 +166,9 @@ class CookApi:
                  queue_limits: Optional[QueueLimits] = None,
                  admins: Optional[List[str]] = None,
                  impersonators: Optional[List[str]] = None,
-                 elector=None, node_url: str = ""):
+                 elector=None, node_url: str = "",
+                 basic_auth_users: Optional[Dict[str, str]] = None,
+                 cors_origins: Optional[List[str]] = None):
         from ..policy.incremental import IncrementalConfig
         self.store = store
         self.scheduler = scheduler
@@ -180,6 +185,19 @@ class CookApi:
         self.elector = elector
         self.node_url = node_url
         self.incremental = IncrementalConfig()
+        # HTTP-basic verification (reference: basic_auth.clj). None = "open"
+        # mode: the username is taken from Basic/X-Cook-User unverified.
+        self.basic_auth_users = basic_auth_users
+        # CORS allowed-origin regexes (reference: cors.clj; same-origin
+        # requests are always allowed, cross-origin must match a pattern)
+        self.cors_origins = [re.compile(p) for p in (cors_origins or [])]
+
+    def origin_allowed(self, origin: str) -> bool:
+        return any(rx.fullmatch(origin) for rx in self.cors_origins)
+
+    def check_basic_auth(self, user: str, password: str) -> bool:
+        want = (self.basic_auth_users or {}).get(user)
+        return want is not None and hmac.compare_digest(want, password)
 
     def leader_redirect_target(self) -> Optional[str]:
         """Non-None when this node must redirect scheduler-state requests."""
@@ -652,18 +670,30 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # pragma: no cover - silence
         pass
 
-    def _user(self) -> str:
+    def _authenticate(self) -> str:
+        """Resolve (and in verified mode, check) the caller identity; runs
+        for EVERY request before dispatch (reference: the auth middleware
+        wraps the whole handler stack, components.clj:266-284)."""
         auth = self.headers.get("Authorization", "")
         user = self.headers.get("X-Cook-User", "")
+        password = None
         if auth.startswith("Basic "):
             try:
-                user = base64.b64decode(auth[6:]).decode().split(":")[0]
+                user, _, password = \
+                    base64.b64decode(auth[6:]).decode().partition(":")
             except Exception:
                 raise ApiError(401, "malformed basic auth")
-        if not user:
-            user = "anonymous"
+        if self.api.basic_auth_users is not None:
+            # verified mode: credentials are required and checked
+            if password is None or not self.api.check_basic_auth(user, password):
+                raise ApiError(401, "bad credentials",
+                               headers={"WWW-Authenticate":
+                                        'Basic realm="cook"'})
+        return user or "anonymous"
+
+    def _user(self) -> str:
         return self.api.resolve_user(
-            user, self.headers.get("X-Cook-Impersonate"))
+            self._auth_user, self.headers.get("X-Cook-Impersonate"))
 
     def _body(self) -> Dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -674,16 +704,28 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError:
             raise ApiError(400, "malformed JSON body")
 
-    def _respond(self, status: int, payload) -> None:
+    def _cors_headers(self) -> None:
+        origin = self.headers.get("Origin")
+        if origin and self.api.origin_allowed(origin):
+            self.send_header("Access-Control-Allow-Origin", origin)
+            self.send_header("Access-Control-Allow-Credentials", "true")
+            self.send_header("Vary", "Origin")
+
+    def _respond(self, status: int, payload,
+                 extra_headers: Optional[Dict[str, str]] = None) -> None:
         data = json.dumps(to_json(payload)).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self._cors_headers()
         self.end_headers()
         self.wfile.write(data)
 
     def _route(self, method: str) -> None:
         try:
+            self._auth_user = self._authenticate()
             parsed = urllib.parse.urlparse(self.path)
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
@@ -700,7 +742,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
         except ApiError as e:
-            self._respond(e.status, {"error": e.message})
+            self._respond(e.status, {"error": e.message},
+                          extra_headers=e.headers)
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": f"internal error: {e}"})
 
@@ -791,6 +834,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.quota_delete(params, self._user())
         raise ApiError(404, f"no such endpoint {method} {path}")
 
+    def do_OPTIONS(self):
+        """CORS preflight (reference: cors.clj preflight handling): 200 with
+        allow headers for an allowed origin, 403 otherwise."""
+        origin = self.headers.get("Origin", "")
+        if not self.api.origin_allowed(origin):
+            self._respond(403, {"error": f"Origin {origin} not allowed"})
+            return
+        self.send_response(200)
+        self.send_header("Access-Control-Allow-Origin", origin)
+        self.send_header("Access-Control-Allow-Credentials", "true")
+        self.send_header("Access-Control-Allow-Methods",
+                         "GET, POST, DELETE, OPTIONS")
+        self.send_header(
+            "Access-Control-Allow-Headers",
+            self.headers.get("Access-Control-Request-Headers", "*"))
+        self.send_header("Access-Control-Max-Age", "86400")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_GET(self):
         self._route("GET")
 
@@ -809,7 +871,7 @@ class ApiServer:
         # /metrics returns text, special-case the wrapper
         orig_respond = handler._respond
 
-        def respond(self_h, status, payload):
+        def respond(self_h, status, payload, extra_headers=None):
             if isinstance(payload, dict) and "_raw" in payload:
                 data = payload["_raw"].encode()
                 self_h.send_response(status)
@@ -818,7 +880,8 @@ class ApiServer:
                 self_h.end_headers()
                 self_h.wfile.write(data)
             else:
-                orig_respond(self_h, status, payload)
+                orig_respond(self_h, status, payload,
+                             extra_headers=extra_headers)
 
         handler._respond = respond
         self.server = ThreadingHTTPServer((host, port), handler)

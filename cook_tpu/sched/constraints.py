@@ -19,7 +19,10 @@ Implemented (reference locations):
   rebalancer-reservation constraints.clj:242 — reserved hosts only for their job
   checkpoint-locality   constraints.clj:218  — restarted checkpointed jobs pinned
                                                to their previous location attribute
-  group unique-host / attribute-equals (running cotasks)
+  estimated-completion  constraints.clj:385  — don't place a job on a host
+                                               expected to die before the
+                                               job's estimated end time
+  group unique-host / balanced / attribute-equals (running cotasks)
                         constraints.clj:586-676
 """
 
@@ -47,8 +50,10 @@ class ConstraintContext:
     # job uuid -> reserved hostname (rebalancer reservations,
     # rebalancer.clj:419-432, consumed at scheduler.clj:645-653)
     reserved_hosts: Dict[str, str] = field(default_factory=dict)
-    # group uuid -> hostnames of *running* cotasks
-    group_running_hosts: Dict[str, Set[str]] = field(default_factory=dict)
+    # group uuid -> hostnames of *running* cotasks, WITH multiplicity (two
+    # cotasks on one host count twice for BALANCED frequencies; unique-host
+    # membership checks are unaffected). Any iterable works.
+    group_running_hosts: Dict[str, List[str]] = field(default_factory=dict)
     # group uuid -> attribute value of running cotasks (attribute-equals)
     group_attr_values: Dict[str, str] = field(default_factory=dict)
     # group uuid -> Group entity (for placement type/attribute)
@@ -56,6 +61,36 @@ class ConstraintContext:
     # job uuid -> checkpoint location attribute value to pin to
     checkpoint_locations: Dict[str, str] = field(default_factory=dict)
     max_tasks_per_host: Optional[int] = None
+    # hostname -> attribute map for hosts NOT in the current offer set
+    # (running cotask hosts); offers take precedence
+    host_attributes: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # estimated-completion (constraints.clj:385): job uuid -> estimated end
+    # time (epoch ms); hosts advertise "host-start-time" (epoch seconds) and
+    # die host_lifetime_mins after it
+    estimated_end_ms: Dict[str, int] = field(default_factory=dict)
+    host_lifetime_mins: Optional[int] = None
+
+    def host_attrs(self, hostname: str,
+                   offer_attrs: Dict[str, Dict[str, str]]) -> Dict[str, str]:
+        attrs = offer_attrs.get(hostname)
+        return attrs if attrs is not None else \
+            self.host_attributes.get(hostname, {})
+
+
+def _balanced_ok(freqs: Dict[Optional[str], int], value: Optional[str],
+                 minimum: int) -> bool:
+    """balanced-host-placement evaluate (constraints.clj:600-627): placing on
+    ``value`` keeps the group's spread over the attribute balanced; forcing
+    minim to 0 while fewer than ``minimum`` distinct values are used pushes
+    new tasks onto unused values first."""
+    if not freqs:
+        return True
+    target_freq = freqs.get(value)
+    if target_freq is None:
+        return True
+    minim = 0 if minimum > len(freqs) else min(freqs.values())
+    maxim = max(freqs.values())
+    return minim == maxim or target_freq < maxim
 
 
 def build_constraint_mask(jobs: List[Job], offers: List[Offer],
@@ -71,6 +106,20 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
     host_disk_type = [o.disk_type for o in offers]
     host_names = [o.hostname for o in offers]
     host_tasks = np.array([o.task_count for o in offers], dtype=np.int32)
+    offer_attrs = {o.hostname: o.attributes for o in offers}
+
+    # estimated-completion: epoch-ms each host is expected to die, +inf when
+    # it doesn't advertise "host-start-time" (constraints.clj:392-399)
+    host_death_ms = np.full(H, np.inf)
+    if ctx.host_lifetime_mins is not None:
+        for h, o in enumerate(offers):
+            start = o.attributes.get("host-start-time")
+            if start is not None:
+                try:
+                    host_death_ms[h] = (float(start) * 1000.0
+                                        + ctx.host_lifetime_mins * 60_000.0)
+                except (TypeError, ValueError):
+                    pass  # unparseable attr: treat the host as immortal
 
     # hosts reserved for some job are off-limits to every other job
     reserved_by = {h: u for u, h in ctx.reserved_hosts.items()}
@@ -108,6 +157,11 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
                 row &= np.array([o.attributes.get(c.attribute) == c.pattern
                                  for o in offers])
 
+        # estimated-completion: skip hosts dying before the job would finish
+        est_end = ctx.estimated_end_ms.get(job.uuid)
+        if est_end is not None and ctx.host_lifetime_mins is not None:
+            row &= est_end < host_death_ms
+
         # checkpoint locality: pin to prior location
         loc = ctx.checkpoint_locations.get(job.uuid)
         if loc:
@@ -131,10 +185,29 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
                         row[h] = False
             elif ptype is GroupPlacementType.ATTRIBUTE_EQUALS:
                 attr = getattr(group, "placement_attribute", None)
-                want = ctx.group_attr_values.get(job.group)
-                if attr and want is not None:
-                    row &= np.array([o.attributes.get(attr) == want
-                                     for o in offers])
+                if attr:
+                    # allowed values: explicit pin, else the attribute values
+                    # of hosts already running cotasks (constraints.clj:628)
+                    want = ctx.group_attr_values.get(job.group)
+                    allowed = {want} if want is not None else {
+                        ctx.host_attrs(hn, offer_attrs).get(attr)
+                        for hn in ctx.group_running_hosts.get(job.group, ())}
+                    allowed.discard(None)
+                    if allowed:
+                        row &= np.array([o.attributes.get(attr) in allowed
+                                         for o in offers])
+            elif ptype is GroupPlacementType.BALANCED:
+                attr = getattr(group, "placement_attribute", None)
+                minimum = getattr(group, "placement_minimum", 2) or 2
+                if attr:
+                    freqs: Dict[Optional[str], int] = {}
+                    for hn in ctx.group_running_hosts.get(job.group, ()):
+                        v = ctx.host_attrs(hn, offer_attrs).get(attr)
+                        freqs[v] = freqs.get(v, 0) + 1
+                    if freqs:
+                        row &= np.array([
+                            _balanced_ok(freqs, o.attributes.get(attr), minimum)
+                            for o in offers])
     return mask
 
 
@@ -150,9 +223,22 @@ def validate_group_placement(jobs: List[Job], assignments: np.ndarray,
     cycle, like a Fenzo failure would).
     """
     out = assignments.copy()
+    offer_attrs = {o.hostname: o.attributes for o in offers}
     group_hosts: Dict[str, Set[str]] = {
         g: set(hs) for g, hs in ctx.group_running_hosts.items()}
     group_attr: Dict[str, str] = dict(ctx.group_attr_values)
+    # BALANCED: running attribute-value frequencies, updated as the batch
+    # commits placements in rank order
+    group_freqs: Dict[str, Dict[Optional[str], int]] = {}
+    for g, hns in ctx.group_running_hosts.items():
+        group = ctx.groups.get(g)
+        if getattr(group, "placement_type", None) is GroupPlacementType.BALANCED:
+            attr = getattr(group, "placement_attribute", None)
+            if attr:
+                freqs = group_freqs.setdefault(g, {})
+                for hn in hns:
+                    v = ctx.host_attrs(hn, offer_attrs).get(attr)
+                    freqs[v] = freqs.get(v, 0) + 1
     for j, job in enumerate(jobs):
         h = int(out[j])
         if h < 0 or job.group is None:
@@ -175,5 +261,15 @@ def validate_group_placement(jobs: List[Job], assignments: np.ndarray,
                     if val is not None:
                         group_attr[job.group] = val
                 elif val != fixed:
+                    out[j] = -1
+        elif ptype is GroupPlacementType.BALANCED:
+            attr = getattr(group, "placement_attribute", None)
+            minimum = getattr(group, "placement_minimum", 2) or 2
+            if attr:
+                freqs = group_freqs.setdefault(job.group, {})
+                val = offers[h].attributes.get(attr)
+                if _balanced_ok(freqs, val, minimum):
+                    freqs[val] = freqs.get(val, 0) + 1
+                else:
                     out[j] = -1
     return out

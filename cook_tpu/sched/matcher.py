@@ -24,7 +24,7 @@ import numpy as np
 from ..cluster.base import ComputeCluster, LaunchSpec, Offer
 from ..config import Config, MatcherConfig
 from ..ops import host_prep, reference_impl
-from ..state.schema import InstanceStatus, Job, new_uuid
+from ..state.schema import InstanceStatus, Job, Reasons, new_uuid, now_ms
 from ..state.store import AbortTransaction, Store
 from .constraints import (
     ConstraintContext,
@@ -126,25 +126,49 @@ class Matcher:
     def _constraint_context(self, jobs: List[Job],
                             reserved_hosts: Optional[Dict[str, str]] = None
                             ) -> ConstraintContext:
+        ec = self.config.estimated_completion
+        ec_on = (ec.expected_runtime_multiplier is not None
+                 and ec.host_lifetime_mins is not None)
         ctx = ConstraintContext(
             reserved_hosts=dict(reserved_hosts or {}),
-            max_tasks_per_host=self.config.max_tasks_per_host)
+            max_tasks_per_host=self.config.max_tasks_per_host,
+            host_lifetime_mins=ec.host_lifetime_mins if ec_on else None)
         for job in jobs:
             full = self.store.job(job.uuid)
             if full is None:
                 continue
             failed = set()
+            node_lost_runtimes = [0.0]
             for tid in full.instances:
                 inst = self.store.instance(tid)
                 if inst is not None and inst.status is InstanceStatus.FAILED:
                     failed.add(inst.hostname)
+                    if (inst.reason_code == Reasons.NODE_LOST.code
+                            and inst.end_time_ms and inst.start_time_ms):
+                        node_lost_runtimes.append(
+                            inst.end_time_ms - inst.start_time_ms)
             if failed:
                 ctx.failed_hosts[job.uuid] = failed
+            # estimated-completion end time: max of scaled expected runtime
+            # and prior node-lost runtimes, capped so a job that nearly fills
+            # a host lifetime still accepts young hosts
+            # (build-estimated-completion-constraint, constraints.clj:408)
+            if ec_on:
+                expected = (full.expected_runtime_ms or 0) \
+                    * ec.expected_runtime_multiplier
+                max_expected = max([expected] + node_lost_runtimes)
+                if max_expected > 0:
+                    longest = (ec.host_lifetime_mins
+                               - ec.agent_start_grace_period_mins) * 60_000
+                    ctx.estimated_end_ms[job.uuid] = int(
+                        now_ms() + min(max_expected, longest))
             if job.group:
                 group = self.store.group(job.group)
                 if group is not None and job.group not in ctx.groups:
                     ctx.groups[job.group] = group
-                    hosts = set()
+                    # list, not set: BALANCED frequencies count cotasks per
+                    # host with multiplicity
+                    hosts = []
                     for member_uuid in group.jobs:
                         member = self.store.job(member_uuid)
                         if member is None:
@@ -153,10 +177,31 @@ class Matcher:
                             inst = self.store.instance(tid)
                             if inst is not None and inst.status in (
                                     InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
-                                hosts.add(inst.hostname)
+                                hosts.append(inst.hostname)
                     if hosts:
                         ctx.group_running_hosts[job.group] = hosts
         return ctx
+
+    def _fill_cotask_host_attributes(self, ctx: ConstraintContext,
+                                     pool_name: str, offers: List[Offer],
+                                     clusters: Dict[str, ComputeCluster]
+                                     ) -> None:
+        """Attribute maps for running-cotask hosts that are NOT in the offer
+        set (fully-packed hosts emit no offer): without them, balanced /
+        attribute-equals groups would silently ignore those cotasks."""
+        needed = {hn for hosts in ctx.group_running_hosts.values()
+                  for hn in hosts}
+        needed -= {o.hostname for o in offers}
+        if not needed:
+            return
+        for cluster in clusters.values():
+            try:
+                all_hosts = cluster.hosts(pool_name)
+            except Exception:
+                continue
+            for h in all_hosts:
+                if h.hostname in needed:
+                    ctx.host_attributes[h.hostname] = h.attributes
 
     # ----------------------------------------------------------------- match
     def match_pool(self, pool_name: str, ranked: List[Job],
@@ -178,6 +223,7 @@ class Matcher:
             return result
 
         ctx = self._constraint_context(considerable, reserved_hosts)
+        self._fill_cotask_host_attributes(ctx, pool_name, offers, clusters)
         cmask = build_constraint_mask(considerable, offers, ctx)
         job_res = [[j.resources.cpus, j.resources.mem, j.resources.gpus,
                     j.resources.disk] for j in considerable]

@@ -177,12 +177,16 @@ class Store:
         self._journal_path: Optional[str] = None
         self._journal_dir: Optional[str] = None
         self._journal_fsync = False
+        self._journal_poisoned = False
 
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
         """Run ``fn`` transactionally. Its writes are installed atomically on
         normal return; AbortTransaction rolls back and re-raises."""
         with self._lock:
+            if self._journal_poisoned:
+                raise RuntimeError(
+                    "journal poisoned by a failed append; reopen the store")
             txn = _Txn(self)
             result = fn(txn)  # AbortTransaction propagates; nothing installed
             self._tx_id += 1
@@ -209,7 +213,14 @@ class Store:
 
     def _journal_append(self, txn: _Txn) -> None:
         """Append one committed transaction to the redo journal (caller holds
-        the store lock, so records are in commit order)."""
+        the store lock, so records are in commit order).
+
+        On a failed append the torn fragment is truncated away so later
+        appends stay parseable; if even the truncate fails the journal is
+        poisoned (closed) and every subsequent transact raises — recovery
+        only repairs a torn TAIL, so writing anything after an unexcised
+        fragment would silently discard it and everything later on replay.
+        """
         rec: Dict[str, Any] = {"tx": self._tx_id}
         if txn._writes:
             rec["w"] = {f"{table}/{key}": to_json(ent)
@@ -220,10 +231,29 @@ class Store:
             rec["lr"] = txn.latch_registrations
         if txn.latch_pops:
             rec["lp"] = txn.latch_pops
-        self._journal_file.write(json.dumps(rec) + "\n")
-        self._journal_file.flush()
-        if self._journal_fsync:
-            os.fsync(self._journal_file.fileno())
+        f = self._journal_file
+        # every append flushes, so the buffer is empty here and tell() is
+        # the true end-of-good-records offset
+        good_offset = f.tell()
+        try:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            if self._journal_fsync:
+                os.fsync(f.fileno())
+        except Exception:
+            try:
+                f.seek(good_offset)
+                f.truncate(good_offset)
+            except Exception:
+                # can't excise the torn fragment: poison the journal so no
+                # later record can be appended after it
+                self._journal_file = None
+                self._journal_poisoned = True
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            raise
 
     def _drain_events(self) -> None:
         """Deliver queued events in commit order. Whoever holds _notify_lock

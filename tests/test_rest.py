@@ -444,3 +444,83 @@ class TestCliSandbox:
         sched.step_rank(); sched.step_match()
         assert main(["--url", server.url, "cat", uuid, "stdout"]) == 1
         assert "output_url" in capsys.readouterr().err
+
+
+class TestAuthAndCors:
+    def _server(self, **api_kw):
+        store = Store()
+        api = CookApi(store, **api_kw)
+        server = ApiServer(api)
+        server.start()
+        return server
+
+    def test_basic_auth_verified_mode(self):
+        import base64
+        import urllib.request
+        server = self._server(basic_auth_users={"alice": "s3cret"})
+        try:
+            # no credentials -> 401 with challenge
+            req = urllib.request.Request(server.url + "/jobs?user=alice")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+            assert "Basic" in e.value.headers.get("WWW-Authenticate", "")
+            # wrong password -> 401
+            bad = base64.b64encode(b"alice:wrong").decode()
+            req = urllib.request.Request(server.url + "/jobs?user=alice",
+                                         headers={"Authorization": f"Basic {bad}"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+            # X-Cook-User alone is not accepted in verified mode
+            req = urllib.request.Request(server.url + "/jobs?user=alice",
+                                         headers={"X-Cook-User": "alice"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+            # good credentials pass
+            good = base64.b64encode(b"alice:s3cret").decode()
+            req = urllib.request.Request(server.url + "/jobs?user=alice",
+                                         headers={"Authorization": f"Basic {good}"})
+            assert json.loads(urllib.request.urlopen(req).read()) == []
+        finally:
+            server.stop()
+
+    def test_cors_preflight_and_headers(self):
+        import urllib.request
+        server = self._server(cors_origins=[r"https://good\.example"])
+        try:
+            # preflight from an allowed origin
+            req = urllib.request.Request(
+                server.url + "/jobs", method="OPTIONS",
+                headers={"Origin": "https://good.example",
+                         "Access-Control-Request-Method": "POST"})
+            resp = urllib.request.urlopen(req)
+            assert resp.status == 200
+            assert resp.headers["Access-Control-Allow-Origin"] == \
+                "https://good.example"
+            assert "POST" in resp.headers["Access-Control-Allow-Methods"]
+            # preflight from a disallowed origin
+            req = urllib.request.Request(
+                server.url + "/jobs", method="OPTIONS",
+                headers={"Origin": "https://evil.example"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 403
+            # normal request echoes CORS headers for allowed origins
+            req = urllib.request.Request(
+                server.url + "/jobs?user=alice",
+                headers={"Origin": "https://good.example",
+                         "X-Cook-User": "alice"})
+            resp = urllib.request.urlopen(req)
+            assert resp.headers["Access-Control-Allow-Origin"] == \
+                "https://good.example"
+            # ...and omits them for others (open mode still serves same-origin)
+            req = urllib.request.Request(
+                server.url + "/jobs?user=alice",
+                headers={"Origin": "https://evil.example",
+                         "X-Cook-User": "alice"})
+            resp = urllib.request.urlopen(req)
+            assert resp.headers.get("Access-Control-Allow-Origin") is None
+        finally:
+            server.stop()

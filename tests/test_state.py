@@ -432,3 +432,61 @@ class TestDurableStore:
         final = Store.open(d)
         assert final.job(u1) is not None
         assert final.job(u2) is not None, "post-recovery write was lost"
+
+    def test_failed_append_aborts_tx_and_excises_fragment(self, tmp_path):
+        """A journal append that dies mid-write must abort the transaction,
+        cut the torn fragment back out, and leave the journal appendable."""
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [u1] = store.create_jobs([make_job()])
+
+        real_file = store._journal_file
+
+        class TornWriter:
+            """Writes half the record, then dies (simulated ENOSPC)."""
+            def __init__(self, f):
+                self.f = f
+            def tell(self):
+                return self.f.tell()
+            def write(self, s):
+                self.f.write(s[: len(s) // 2])
+                raise OSError(28, "No space left on device")
+            def __getattr__(self, name):
+                return getattr(self.f, name)
+
+        store._journal_file = TornWriter(real_file)
+        with pytest.raises(OSError):
+            store.create_jobs([make_job()])
+        store._journal_file = real_file
+        # aborted tx is not visible in memory
+        assert len(store.jobs_where(lambda j: True)) == 1
+        # journal recovered: later transactions append after the excised
+        # fragment and a reopen sees exactly the committed state
+        [u3] = store.create_jobs([make_job()])
+        reopened = Store.open(d)
+        assert {j.uuid for j in reopened.jobs_where(lambda j: True)} == {u1, u3}
+
+    def test_unrecoverable_append_failure_poisons_store(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        store.create_jobs([make_job()])
+
+        class BrokenWriter:
+            def tell(self):
+                return 0
+            def write(self, s):
+                raise OSError(5, "I/O error")
+            def seek(self, *a):
+                raise OSError(5, "I/O error")
+            def truncate(self, *a):
+                raise OSError(5, "I/O error")
+            def close(self):
+                pass
+
+        store._journal_file = BrokenWriter()
+        with pytest.raises(OSError):
+            store.create_jobs([make_job()])
+        # journal is poisoned: durable writes now refuse instead of
+        # silently diverging from what a replay would reconstruct
+        with pytest.raises(RuntimeError, match="poisoned"):
+            store.create_jobs([make_job()])
