@@ -565,10 +565,12 @@ class CookApi:
                 "start-up-time": 0}
 
     def debug(self) -> Dict:
+        from ..utils.tracing import tracer
         return {"healthy": True,
                 "pools": [p.name for p in self.store.pools()],
                 "clusters": (list(self.scheduler.clusters)
-                             if self.scheduler else [])}
+                             if self.scheduler else []),
+                "recent-spans": tracer.recent(limit=50)}
 
     def settings(self) -> Dict:
         cfg = self.config

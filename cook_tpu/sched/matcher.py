@@ -26,6 +26,7 @@ from ..config import Config, MatcherConfig
 from ..ops import host_prep, reference_impl
 from ..state.schema import InstanceStatus, Job, Reasons, new_uuid, now_ms
 from ..state.store import AbortTransaction, Store
+from ..utils import tracing
 from .constraints import (
     ConstraintContext,
     build_constraint_mask,
@@ -232,8 +233,11 @@ class Matcher:
         cap = [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
                 o.capacity.disk] for o in offers]
 
-        assign = self._dispatch(mc, job_res, cmask, avail, cap)
-        assign = validate_group_placement(considerable, assign, offers, ctx)
+        with tracing.span("match.schedule-once", pool=pool_name,
+                          backend=mc.backend, jobs=len(considerable),
+                          offers=len(offers)):
+            assign = self._dispatch(mc, job_res, cmask, avail, cap)
+            assign = validate_group_placement(considerable, assign, offers, ctx)
 
         # head-of-queue backoff bookkeeping
         result.head_matched = bool(assign[0] >= 0)
@@ -318,6 +322,8 @@ class Matcher:
                 continue
             cluster.kill_lock.acquire_read()
             try:
-                cluster.launch_tasks(pool_name, specs)
+                with tracing.span("cluster.launch-tasks", pool=pool_name,
+                                  cluster=cluster_name, tasks=len(specs)):
+                    cluster.launch_tasks(pool_name, specs)
             finally:
                 cluster.kill_lock.release_read()

@@ -38,6 +38,7 @@ from ..state.schema import (
     now_ms,
 )
 from ..state.store import AbortTransaction, Store
+from ..utils import tracing
 from .matcher import MatchCycleResult, Matcher
 from .ranker import Ranker
 from .rebalancer import Rebalancer
@@ -147,11 +148,14 @@ class Scheduler:
         """Rank cycle across all schedulable pools (reference: rank-jobs +
         reset! pool-name->pending-jobs-atom, scheduler.clj:2286-2296)."""
         queues: Dict[str, List[Job]] = {}
-        for pool in self.store.pools():
-            if pool.state != "active":
-                continue
-            ranked = self.ranker.rank_pool(pool.name, pool.dru_mode)
-            queues[pool.name] = self._filter_offensive_jobs(ranked)
+        with tracing.span("rank.cycle"):
+            for pool in self.store.pools():
+                if pool.state != "active":
+                    continue
+                with tracing.span("rank.pool", pool=pool.name) as sp:
+                    ranked = self.ranker.rank_pool(pool.name, pool.dru_mode)
+                    sp.set_tag("jobs", len(ranked))
+                queues[pool.name] = self._filter_offensive_jobs(ranked)
         self.pending_queues = queues
         return queues
 
@@ -191,18 +195,19 @@ class Scheduler:
             if pool.state != "active":
                 continue
             ranked = self.pending_queues.get(pool.name, [])
-            if pool.scheduler is SchedulerKind.DIRECT:
-                results[pool.name] = self._match_direct(pool.name, ranked)
-                continue
-            offers = []
-            for cluster in list(self.clusters.values()):
-                if cluster.accepts_pool(pool.name):
-                    offers.extend(cluster.pending_offers(pool.name))
-            result = self.matcher.match_pool(
-                pool.name, ranked, offers, self.clusters,
-                reserved_hosts=self.reserved_hosts)
-            results[pool.name] = result
-            self._autoscale(pool.name, result)
+            with tracing.span("scheduler.pool-handler", pool=pool.name):
+                if pool.scheduler is SchedulerKind.DIRECT:
+                    results[pool.name] = self._match_direct(pool.name, ranked)
+                    continue
+                offers = []
+                for cluster in list(self.clusters.values()):
+                    if cluster.accepts_pool(pool.name):
+                        offers.extend(cluster.pending_offers(pool.name))
+                result = self.matcher.match_pool(
+                    pool.name, ranked, offers, self.clusters,
+                    reserved_hosts=self.reserved_hosts)
+                results[pool.name] = result
+                self._autoscale(pool.name, result)
         self.last_match_results.update(results)
         return results
 
@@ -282,9 +287,10 @@ class Scheduler:
         for pool in self.store.pools():
             if pool.state != "active":
                 continue
-            pool_decisions = self.rebalancer.rebalance_pool(
-                pool.name, pool.dru_mode,
-                self.pending_queues.get(pool.name, []), self.clusters)
+            with tracing.span("rebalancer.pool", pool=pool.name):
+                pool_decisions = self.rebalancer.rebalance_pool(
+                    pool.name, pool.dru_mode,
+                    self.pending_queues.get(pool.name, []), self.clusters)
             if pool_decisions:
                 decisions[pool.name] = pool_decisions
                 for d in pool_decisions:

@@ -94,6 +94,9 @@ class MetricsRegistry:
                     bucket_key = key + (("le", str(b)),)
                     lines.append(f"{name}_bucket{_labels_str(bucket_key)} "
                                  f"{h['buckets'][i]}")
+                inf_key = key + (("le", "+Inf"),)
+                lines.append(f"{name}_bucket{_labels_str(inf_key)} "
+                             f"{h['count']}")
                 lines.append(f"{name}_count{_labels_str(key)} {h['count']}")
                 lines.append(f"{name}_sum{_labels_str(key)} {h['sum']}")
         return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,148 @@
+"""Tracing spans around scheduler stages and kernel dispatches.
+
+Plays the role of the reference's OpenTracing integration: every stage of
+the match path is wrapped in a span carrying pool/cluster tags (reference:
+scheduler.clj:2438 `scheduler.pool-handler`, scheduler.clj:662-671
+`match-offer-to-scheduler.fenzo-schedule-once`,
+kubernetes/compute_cluster.clj:425 `k8s.launch-tasks`). Durations are
+tri-recorded the way the reference records them (prometheus_metrics.clj
+with-duration + structured match-cycle log documents): each finished span
+
+  1. observes `cook_span_duration_seconds{span=..., <tags>}` on the global
+     metrics registry,
+  2. emits a structured JSON log line on the `cook.trace` logger,
+  3. lands in an in-memory ring buffer served by the /debug REST endpoint.
+
+Spans nest via a thread-local stack so kernel dispatch spans inherit a
+trace id from the enclosing cycle span — enough to reconstruct per-cycle
+flamegraphs offline without an external collector (zero-egress friendly).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from cook_tpu.utils.metrics import registry
+
+_log = logging.getLogger("cook.trace")
+
+_MAX_FINISHED = 4096
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "tags",
+                 "start_s", "duration_s", "error")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 tags: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.tags = tags
+        self.start_s = time.time()
+        self.duration_s: Optional[float] = None
+        self.error: Optional[str] = None
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {"span": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start": self.start_s, "duration_ms":
+                round((self.duration_s or 0.0) * 1000.0, 3),
+                "error": self.error, **self.tags}
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self.finished: List[Dict[str, Any]] = []
+        self.enabled = True
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def current(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    @contextmanager
+    def span(self, name: str, **tags: Any):
+        """Open a span; tags with None values are dropped (matches the
+        reference's optional pool/cluster tags)."""
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        tags = {k: v for k, v in tags.items() if v is not None}
+        parent = self.current()
+        trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
+        parent_id = parent.span_id if parent else None
+        sp = Span(name, trace_id, parent_id, tags)
+        self._stack().append(sp)
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.error = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            sp.duration_s = time.perf_counter() - t0
+            self._stack().pop()
+            self._record(sp)
+
+    def _record(self, sp: Span) -> None:
+        metric_labels = {"span": sp.name}
+        for key in ("pool", "cluster"):
+            if key in sp.tags:
+                metric_labels[key] = str(sp.tags[key])
+        registry.observe("cook_span_duration_seconds", sp.duration_s or 0.0,
+                         metric_labels)
+        doc = sp.to_doc()
+        _log.debug(sp.name, extra={"doc": doc})
+        with self._lock:
+            self.finished.append(doc)
+            if len(self.finished) > _MAX_FINISHED:
+                del self.finished[:_MAX_FINISHED // 2]
+
+    def recent(self, limit: int = 100,
+               name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            if name is None:
+                return self.finished[-limit:]
+            docs = [d for d in self.finished if d["span"] == name]
+        return docs[-limit:]
+
+    def traces(self, trace_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [d for d in self.finished if d["trace_id"] == trace_id]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.finished.clear()
+
+
+class _NoopSpan:
+    def set_tag(self, key: str, value: Any) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+tracer = Tracer()
+
+
+def span(name: str, **tags: Any):
+    """Module-level shorthand: `with tracing.span("match.cycle", pool=p):`"""
+    return tracer.span(name, **tags)

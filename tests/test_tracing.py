@@ -1,0 +1,109 @@
+"""Tracing spans around scheduler stages (reference: opentracing spans
+scheduler.clj:2438, :662-671; tri-recorded durations prometheus_metrics.clj)."""
+
+import threading
+
+from cook_tpu.utils.metrics import registry
+from cook_tpu.utils.tracing import span, tracer
+
+
+def setup_function(_fn):
+    tracer.reset()
+    registry.reset()
+
+
+def test_span_records_duration_and_tags():
+    with span("match.schedule-once", pool="alpha", jobs=10) as sp:
+        sp.set_tag("offers", 5)
+    docs = tracer.recent()
+    assert len(docs) == 1
+    d = docs[0]
+    assert d["span"] == "match.schedule-once"
+    assert d["pool"] == "alpha"
+    assert d["jobs"] == 10 and d["offers"] == 5
+    assert d["duration_ms"] >= 0
+    assert d["error"] is None
+    snap = registry.snapshot()
+    assert any("cook_span_duration_seconds" in k
+               for k in snap["histogram_counts"])
+
+
+def test_nested_spans_share_trace_id():
+    with span("scheduler.pool-handler", pool="p"):
+        with span("match.schedule-once", pool="p"):
+            pass
+    inner, outer = tracer.recent()
+    assert inner["trace_id"] == outer["trace_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] is None
+    assert tracer.traces(inner["trace_id"]) == [inner, outer]
+
+
+def test_span_captures_error():
+    try:
+        with span("rank.cycle"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    (d,) = tracer.recent()
+    assert "ValueError: boom" == d["error"]
+
+
+def test_none_tags_dropped():
+    with span("x", pool=None, cluster="c"):
+        pass
+    (d,) = tracer.recent()
+    assert "pool" not in d and d["cluster"] == "c"
+
+
+def test_threads_have_independent_stacks():
+    errs = []
+
+    def worker():
+        try:
+            with span("worker.span"):
+                assert tracer.current().name == "worker.span"
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    with span("main.span"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert tracer.current().name == "main.span"
+    assert not errs
+    names = {d["span"] for d in tracer.recent()}
+    assert names == {"worker.span", "main.span"}
+    # the worker span must not have been parented under main.span
+    wdoc = [d for d in tracer.recent() if d["span"] == "worker.span"][0]
+    assert wdoc["parent_id"] is None
+
+
+def test_scheduler_cycles_emit_spans():
+    from cook_tpu.cluster import FakeCluster, FakeHost
+    from cook_tpu.config import Config
+    from cook_tpu.sched import Scheduler
+    from cook_tpu.state import Job, Resources, Store, new_uuid
+
+    store = Store()
+    cluster = FakeCluster("fake-1", [FakeHost(
+        hostname="h0", capacity=Resources(cpus=8.0, mem=8192.0))])
+    config = Config()
+    config.default_matcher.backend = "cpu"
+    sched = Scheduler(store, config, [cluster], rank_backend="cpu")
+    store.create_jobs([Job(uuid=new_uuid(), user="alice", command="true",
+                           pool="default",
+                           resources=Resources(cpus=1.0, mem=100.0))])
+    tracer.reset()
+    sched.step_rank()
+    sched.step_match()
+    docs = tracer.recent(limit=1000)
+    names = {d["span"] for d in docs}
+    assert {"rank.cycle", "rank.pool", "scheduler.pool-handler",
+            "match.schedule-once", "cluster.launch-tasks"} <= names
+    # pool-handler and its kernel dispatch share one trace
+    handler = [d for d in docs if d["span"] == "scheduler.pool-handler"][0]
+    kernel = [d for d in docs if d["span"] == "match.schedule-once"][0]
+    assert kernel["trace_id"] == handler["trace_id"]
+    assert kernel["parent_id"] == handler["span_id"]
+    assert kernel["backend"] == "cpu"
