@@ -1,2 +1,7 @@
 from .base import ComputeCluster, LaunchSpec, Offer, ReadWriteLock  # noqa: F401
 from .fake import FakeCluster, FakeHost  # noqa: F401
+from .remote import (  # noqa: F401
+    AgentConnection,
+    LocalAgentProcess,
+    RemoteComputeCluster,
+)

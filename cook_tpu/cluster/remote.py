@@ -1,0 +1,437 @@
+"""Remote compute cluster over the native C++ transport.
+
+The framework's equivalent of the reference's Mesos backend: the scheduler
+binds a *native* driver (libcooktransport.so, built from
+``native/transport.cpp``) the way the reference binds the C++
+MesosSchedulerDriver through JNI (reference: mesos_compute_cluster.clj:
+206-238, project.clj:207 twosigma/mesomatic), and on-node ``cook_agentd``
+daemons play the role of the Mesos agent + custom executor pair
+(reference: executor/cook/executor.py): they run task commands in their own
+process groups under per-task sandboxes and stream status updates back.
+
+Semantics mirrored from the reference backend:
+  - offers synthesized as capacity minus tracked consumption per host
+    (the k8s-style model, kubernetes/compute_cluster.clj:68-174);
+  - status updates delivered through the scheduler's callback exactly like
+    mesos status-update -> write-status-to-datomic (scheduler.clj:217);
+  - reconciliation on (re)connect (scheduler.clj:1828-1878): the agent's
+    REGISTERED frame carries its live task ids, and RECONCILE replays the
+    authoritative per-task state; tasks the store considers live but the
+    agent no longer knows become NODE_LOST (mea-culpa);
+  - sandbox directory writeback (mesos/sandbox.clj:222-353) via the STATUS
+    frame's sandbox field.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..state.schema import InstanceStatus, Reasons, Resources
+from ..utils import tracing
+from .base import ComputeCluster, LaunchSpec, Offer
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "native" / "transport.cpp"
+_BUILD_DIR = _REPO_ROOT / "native" / "build"
+_LIB = _BUILD_DIR / "libcooktransport.so"
+_AGENTD = _BUILD_DIR / "cook_agentd"
+
+_SEP = "\x1f"
+_BUF_CAP = 1 << 20
+
+
+def _build(target: Path, extra: List[str]) -> Optional[Path]:
+    if target.exists() and target.stat().st_mtime >= _SRC.stat().st_mtime:
+        return target
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-pthread", "-std=c++17", *extra, str(_SRC),
+             "-o", str(target)],
+            check=True, capture_output=True, timeout=180)
+        return target
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+
+
+def build_agentd() -> Optional[Path]:
+    return _build(_AGENTD, ["-DCOOK_AGENT_MAIN"])
+
+
+_lib_handle = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib_handle, _lib_tried
+    if _lib_tried:
+        return _lib_handle
+    _lib_tried = True
+    path = _build(_LIB, ["-shared", "-fPIC"])
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.ctd_connect.restype = ctypes.c_void_p
+    lib.ctd_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.ctd_agent_info.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.ctd_launch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_double,
+                               ctypes.c_double]
+    lib.ctd_kill.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ctd_reconcile.argtypes = [ctypes.c_void_p]
+    lib.ctd_ping.argtypes = [ctypes.c_void_p]
+    lib.ctd_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                             ctypes.c_int]
+    lib.ctd_connected.argtypes = [ctypes.c_void_p]
+    lib.ctd_close.argtypes = [ctypes.c_void_p]
+    _lib_handle = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None and build_agentd() is not None
+
+
+class AgentConnection:
+    """One driver connection to one cook_agentd (ctypes over the C API)."""
+
+    def __init__(self, host: str, port: int, timeout_ms: int = 5000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native transport unavailable")
+        self._lib = lib
+        self._handle = lib.ctd_connect(host.encode(), port, timeout_ms)
+        if not self._handle:
+            raise ConnectionError(f"agent {host}:{port} unreachable")
+        self._buf = ctypes.create_string_buffer(_BUF_CAP)
+        self._lock = threading.Lock()  # guards handle lifetime vs close
+        info = self._call_str(lib.ctd_agent_info)
+        (self.agent_id, self.hostname, cpus, mem, gpus, disk,
+         running_csv) = info.split(_SEP)
+        self.capacity = Resources(cpus=float(cpus), mem=float(mem),
+                                  gpus=float(gpus), disk=float(disk))
+        self.running_at_connect = ([t for t in running_csv.split(",") if t]
+                                   if running_csv else [])
+
+    def _call_str(self, fn) -> str:
+        n = fn(self._handle, self._buf, _BUF_CAP)
+        if n < 0:
+            raise RuntimeError("transport call failed")
+        return self._buf.value.decode()
+
+    def launch(self, task_id: str, command: str, cpus: float,
+               mem: float) -> bool:
+        with self._lock:
+            if not self._handle:
+                return False
+            return self._lib.ctd_launch(self._handle, task_id.encode(),
+                                        command.encode(), cpus, mem) == 0
+
+    def kill(self, task_id: str, grace_ms: int = 3000) -> bool:
+        with self._lock:
+            if not self._handle:
+                return False
+            return self._lib.ctd_kill(self._handle, task_id.encode(),
+                                      grace_ms) == 0
+
+    def reconcile(self) -> bool:
+        with self._lock:
+            if not self._handle:
+                return False
+            return self._lib.ctd_reconcile(self._handle) == 0
+
+    def poll(self, timeout_ms: int = 100) -> Optional[List[str]]:
+        """Next event's fields; None on timeout; raises on closed."""
+        if not self._handle:
+            raise ConnectionError("closed")
+        n = self._lib.ctd_poll(self._handle, self._buf, _BUF_CAP, timeout_ms)
+        if n == 0:
+            return None
+        if n < 0:
+            raise ConnectionError("agent connection closed")
+        return self._buf.value.decode().split(_SEP)
+
+    @property
+    def connected(self) -> bool:
+        return bool(self._handle) and \
+            self._lib.ctd_connected(self._handle) == 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.ctd_close(self._handle)
+                self._handle = None
+
+
+class LocalAgentProcess:
+    """Spawn a cook_agentd on this machine (tests/single-node deployments)."""
+
+    def __init__(self, hostname: str, cpus: float = 4.0, mem: float = 4096.0,
+                 gpus: float = 0.0, disk: float = 0.0,
+                 workdir: str = "/tmp/cook-agentd"):
+        agentd = build_agentd()
+        if agentd is None:
+            raise RuntimeError("cook_agentd unavailable (no C++ toolchain?)")
+        Path(workdir).mkdir(parents=True, exist_ok=True)
+        self.hostname = hostname
+        self.proc = subprocess.Popen(
+            [str(agentd), "--port", "0", "--hostname", hostname,
+             "--cpus", str(cpus), "--mem", str(mem), "--gpus", str(gpus),
+             "--disk", str(disk), "--workdir", workdir],
+            stdout=subprocess.PIPE, text=True)
+        line = self.proc.stdout.readline()
+        if not line.startswith("PORT "):
+            self.proc.kill()
+            raise RuntimeError(f"agentd failed to start: {line!r}")
+        self.port = int(line.split()[1])
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            self.proc.kill()
+
+
+class RemoteComputeCluster(ComputeCluster):
+    """ComputeCluster backed by cook_agentd daemons over the native driver."""
+
+    def __init__(self, name: str, endpoints: List[Tuple[str, int]],
+                 pool: str = "default", store=None,
+                 kill_grace_ms: int = 3000):
+        super().__init__(name)
+        self.pool = pool
+        self.store = store  # optional: sandbox writeback target
+        self.kill_grace_ms = kill_grace_ms
+        self._endpoints = endpoints
+        self._agents: Dict[str, AgentConnection] = {}  # hostname -> conn
+        self._lock = threading.RLock()
+        # task_id -> (hostname, resources); consumption tracking for offers
+        self._tasks: Dict[str, Tuple[str, Resources]] = {}
+        self._pumps: List[threading.Thread] = []
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, status_callback: Callable) -> None:
+        super().initialize(status_callback)
+        for host, port in self._endpoints:
+            # one dead node must not prevent scheduling on healthy ones
+            try:
+                self._connect_agent(host, port)
+            except (ConnectionError, RuntimeError) as e:
+                logging.getLogger(__name__).warning(
+                    "agent %s:%s unreachable at startup: %s", host, port, e)
+        self._reconcile_store_tasks()
+
+    def _connect_agent(self, host: str, port: int) -> AgentConnection:
+        conn = AgentConnection(host, port)
+        with self._lock:
+            self._agents[conn.hostname] = conn
+            # Adopt tasks already running on the agent (reconnect after a
+            # scheduler restart) so offers subtract their consumption.
+            for task_id in conn.running_at_connect:
+                if task_id not in self._tasks:
+                    self._tasks[task_id] = (
+                        conn.hostname, self._task_resources(task_id))
+        # Reconciliation (scheduler.clj:1828-1878): replay authoritative
+        # state for every task the agent knows about.
+        conn.reconcile()
+        pump = threading.Thread(target=self._pump, args=(conn,), daemon=True,
+                                name=f"agent-pump-{conn.hostname}")
+        pump.start()
+        self._pumps.append(pump)
+        return conn
+
+    def _task_resources(self, task_id: str) -> Resources:
+        """Best-effort resource lookup for an adopted task."""
+        if self.store is not None:
+            inst = self.store.instance(task_id)
+            if inst is not None:
+                job = self.store.job(inst.job_uuid)
+                if job is not None:
+                    return job.resources
+        return Resources()
+
+    def _reconcile_store_tasks(self) -> None:
+        """Tasks the store believes are live on this cluster but no agent
+        knows about are NODE_LOST, mea-culpa (the reference's task
+        reconciliation on (re)register, scheduler.clj:1828-1878)."""
+        if self.store is None:
+            return
+        cb = self._status_callback
+        with self._lock:
+            known = set(self._tasks)
+        for job, inst in self.store.running_instances():
+            if inst.compute_cluster != self.name:
+                continue
+            if inst.task_id not in known and cb is not None:
+                cb(inst.task_id, InstanceStatus.FAILED,
+                   Reasons.NODE_LOST.code, hostname=inst.hostname)
+
+    def add_agent(self, host: str, port: int) -> None:
+        """Dynamic agent registration (elastic capacity)."""
+        self._connect_agent(host, port)
+
+    # -- status pump --------------------------------------------------------
+    def _pump(self, conn: AgentConnection) -> None:
+        while not self._stopping.is_set():
+            try:
+                ev = conn.poll(timeout_ms=200)
+            except ConnectionError:
+                if not self._stopping.is_set():
+                    self._on_agent_lost(conn)
+                return
+            if ev is None or not ev:
+                continue
+            if ev[0] == "STATUS" and len(ev) >= 5:
+                self._on_status(conn, task_id=ev[1], state=ev[2],
+                                exit_code=int(ev[3] or 0), sandbox=ev[4])
+
+    def _on_status(self, conn: AgentConnection, task_id: str, state: str,
+                   exit_code: int, sandbox: str) -> None:
+        if self.store is not None and sandbox:
+            try:
+                self.store.update_instance_sandbox(
+                    task_id, sandbox_directory=sandbox)
+            except Exception:
+                pass
+        cb = self._status_callback
+        if state == "running":
+            with self._lock:
+                # replayed running status after reconnect: adopt the task
+                if task_id not in self._tasks:
+                    self._tasks[task_id] = (
+                        conn.hostname, self._task_resources(task_id))
+            if cb:
+                cb(task_id, InstanceStatus.RUNNING, None,
+                   hostname=conn.hostname)
+            return
+        # terminal: release tracked consumption
+        with self._lock:
+            self._tasks.pop(task_id, None)
+        if cb is None:
+            return
+        if state == "finished":
+            cb(task_id, InstanceStatus.SUCCESS, None, exit_code=exit_code,
+               hostname=conn.hostname)
+        elif state == "killed":
+            cb(task_id, InstanceStatus.FAILED, Reasons.KILLED_BY_USER.code,
+               exit_code=exit_code, hostname=conn.hostname)
+        else:  # failed
+            cb(task_id, InstanceStatus.FAILED, Reasons.NON_ZERO_EXIT.code,
+               exit_code=exit_code, hostname=conn.hostname)
+
+    def _on_agent_lost(self, conn: AgentConnection) -> None:
+        """Connection dropped: its tasks are NODE_LOST (mea-culpa), exactly
+        the reference's slave-lost semantics."""
+        with self._lock:
+            if self._agents.get(conn.hostname) is conn:
+                del self._agents[conn.hostname]
+            lost = [t for t, (h, _) in self._tasks.items()
+                    if h == conn.hostname]
+            for t in lost:
+                del self._tasks[t]
+        cb = self._status_callback
+        if cb:
+            for t in lost:
+                cb(t, InstanceStatus.FAILED, Reasons.NODE_LOST.code,
+                   hostname=conn.hostname)
+        conn.close()  # release the fd/driver; reader thread already exited
+
+    # -- scheduling ---------------------------------------------------------
+    def pending_offers(self, pool: str) -> List[Offer]:
+        if pool != self.pool:
+            return []
+        offers = []
+        with self._lock:
+            consumption: Dict[str, Resources] = {}
+            counts: Dict[str, int] = {}
+            for h, res in self._tasks.values():
+                consumption[h] = consumption.get(h, Resources()) + res
+                counts[h] = counts.get(h, 0) + 1
+            for hostname, conn in self._agents.items():
+                used = consumption.get(hostname, Resources())
+                avail = conn.capacity - used
+                if not avail.non_negative():
+                    avail = Resources()
+                offers.append(Offer(
+                    id=f"{self.name}/{hostname}",
+                    hostname=hostname, slave_id=conn.agent_id, pool=pool,
+                    available=avail, capacity=conn.capacity,
+                    cluster=self.name,
+                    task_count=counts.get(hostname, 0)))
+        return offers
+
+    def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
+        for spec in specs:
+            with self._lock:
+                conn = self._agents.get(spec.hostname)
+                if conn is not None:
+                    self._tasks[spec.task_id] = (spec.hostname, spec.resources)
+            if conn is None:
+                cb = self._status_callback
+                if cb:
+                    cb(spec.task_id, InstanceStatus.FAILED,
+                       Reasons.CONTAINER_LAUNCH_FAILED.code,
+                       hostname=spec.hostname)
+                continue
+            command = self._task_command(spec)
+            if command is None:
+                # job vanished between match and launch, or has no command:
+                # running a placeholder would report SUCCESS for work that
+                # never happened
+                with self._lock:
+                    self._tasks.pop(spec.task_id, None)
+                cb = self._status_callback
+                if cb:
+                    cb(spec.task_id, InstanceStatus.FAILED,
+                       Reasons.CONTAINER_LAUNCH_FAILED.code,
+                       hostname=spec.hostname)
+                continue
+            with tracing.span("remote.launch", cluster=self.name,
+                              hostname=spec.hostname):
+                ok = conn.launch(spec.task_id, command,
+                                 spec.resources.cpus, spec.resources.mem)
+            if not ok:
+                with self._lock:
+                    self._tasks.pop(spec.task_id, None)
+                cb = self._status_callback
+                if cb:
+                    cb(spec.task_id, InstanceStatus.FAILED,
+                       Reasons.CONTAINER_LAUNCH_FAILED.code,
+                       hostname=spec.hostname)
+
+    def _task_command(self, spec: LaunchSpec) -> Optional[str]:
+        """The command to run, or None when it cannot be determined (which
+        must fail the launch, not silently succeed). Without a store this
+        backend is a pure transport under test; 'true' keeps it driveable."""
+        if self.store is None:
+            return "true"
+        job = self.store.job(spec.job_uuid)
+        if job is not None and job.command:
+            return job.command
+        return None
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            entry = self._tasks.get(task_id)
+            conn = self._agents.get(entry[0]) if entry else None
+        if conn is not None:
+            conn.kill(task_id, self.kill_grace_ms)
+
+    # -- teardown -----------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stopping.set()
+        for pump in self._pumps:
+            pump.join(timeout=2)
+        with self._lock:
+            agents = list(self._agents.values())
+            self._agents.clear()
+        for conn in agents:
+            conn.close()

@@ -1,0 +1,574 @@
+// Native cluster transport: the framework's equivalent of the reference's
+// libmesos C++ scheduler driver + on-node executor pair (reference:
+// mesos_compute_cluster.clj:206-238 binds MesosSchedulerDriver via JNI;
+// executor/cook/executor.py runs the command in its own process group and
+// streams status frames).
+//
+// One source file, two artifacts:
+//   cook_agentd          (g++ ... -DCOOK_AGENT_MAIN -o cook_agentd)
+//     On-node agent daemon: advertises host resources, runs task commands in
+//     their own sessions (process groups) under a per-task sandbox dir with
+//     stdout/stderr capture, escalates SIGTERM -> SIGKILL on kill, reaps
+//     children and broadcasts status updates to every connected driver.
+//   libcooktransport.so  (g++ -shared -fPIC ...)
+//     Scheduler-side driver with a C API (ctypes-friendly): connect to an
+//     agent, launch/kill/reconcile, and poll an event queue fed by a
+//     background reader thread — the moral equivalent of the
+//     MesosSchedulerDriver callback surface, minus the JVM.
+//
+// Wire protocol (both directions): frame = u32_be payload_len, payload =
+// repeated (u32_be field_len + field_bytes); field[0] is the message type.
+//   driver -> agent:  LAUNCH(task_id, command, cpus, mem)
+//                     KILL(task_id, grace_ms)  RECONCILE()  PING()
+//   agent  -> driver: REGISTERED(agent_id, hostname, cpus, mem, gpus, disk,
+//                                running_task_ids_csv)
+//                     STATUS(task_id, state, exit_code, sandbox)
+//                       state in {running, finished, failed, killed}
+//                     RECONCILE_DONE()  PONG()
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 16u * 1024 * 1024;
+constexpr char kSep = '\x1f';  // unit separator for flattened driver events
+
+// ---------------------------------------------------------------- framing
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return false;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void put_u32(std::string* out, uint32_t v) {
+  uint32_t be = htonl(v);
+  out->append(reinterpret_cast<const char*>(&be), 4);
+}
+
+bool send_frame(int fd, const std::vector<std::string>& fields) {
+  std::string payload;
+  for (const auto& f : fields) {
+    put_u32(&payload, static_cast<uint32_t>(f.size()));
+    payload += f;
+  }
+  std::string frame;
+  put_u32(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return write_exact(fd, frame.data(), frame.size());
+}
+
+bool recv_frame(int fd, std::vector<std::string>* fields) {
+  uint32_t len_be = 0;
+  if (!read_exact(fd, &len_be, 4)) return false;
+  uint32_t len = ntohl(len_be);
+  if (len > kMaxFrame) return false;
+  std::string payload(len, '\0');
+  if (len > 0 && !read_exact(fd, &payload[0], len)) return false;
+  fields->clear();
+  size_t off = 0;
+  while (off + 4 <= payload.size()) {
+    uint32_t flen = ntohl(*reinterpret_cast<const uint32_t*>(&payload[off]));
+    off += 4;
+    if (off + flen > payload.size()) return false;
+    fields->emplace_back(payload.substr(off, flen));
+    off += flen;
+  }
+  return off == payload.size();
+}
+
+// ------------------------------------------------------------------ agent
+
+void mkdir_p(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    cur += path[i];
+    if ((path[i] == '/' && cur.size() > 1) || i + 1 == path.size()) {
+      ::mkdir(cur.c_str(), 0755);  // EEXIST is fine
+    }
+  }
+}
+
+struct AgentTask {
+  pid_t pid = -1;
+  std::string state;  // running | finished | failed | killed
+  int exit_code = 0;
+  bool kill_requested = false;
+  std::string sandbox;
+};
+
+struct AgentState {
+  std::mutex mu;
+  std::map<std::string, AgentTask> tasks;
+  std::deque<std::string> terminal_order;  // FIFO for bounded retention
+  std::set<int> clients;           // connected driver fds
+  std::mutex write_mu;             // serializes all frame writes
+  std::string agent_id, hostname, workdir;
+  double cpus = 1, mem = 1024, gpus = 0, disk = 0;
+};
+
+// Terminal tasks are kept for driver reconciliation but bounded: the map
+// must not grow forever on a long-lived agent.
+constexpr size_t kMaxTerminalTasks = 1024;
+
+AgentState* g_agent = nullptr;
+
+// caller holds g_agent->mu
+void note_terminal_locked(const std::string& task_id) {
+  g_agent->terminal_order.push_back(task_id);
+  while (g_agent->terminal_order.size() > kMaxTerminalTasks) {
+    const std::string& old = g_agent->terminal_order.front();
+    auto it = g_agent->tasks.find(old);
+    if (it != g_agent->tasks.end() && it->second.state != "running")
+      g_agent->tasks.erase(it);
+    g_agent->terminal_order.pop_front();
+  }
+}
+
+void agent_broadcast(const std::vector<std::string>& fields) {
+  std::vector<int> fds;
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    fds.assign(g_agent->clients.begin(), g_agent->clients.end());
+  }
+  std::lock_guard<std::mutex> lk(g_agent->write_mu);
+  for (int fd : fds) send_frame(fd, fields);  // dead fds fail silently
+}
+
+void agent_status(const std::string& task_id, const AgentTask& t) {
+  agent_broadcast({"STATUS", task_id, t.state, std::to_string(t.exit_code),
+                   t.sandbox});
+}
+
+// Reap exited children, classify, broadcast. waitpid(-1) is safe here: the
+// agent forks only task children.
+void agent_reaper() {
+  for (;;) {
+    int st = 0;
+    pid_t pid = ::waitpid(-1, &st, 0);
+    if (pid < 0) {
+      if (errno == ECHILD) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        continue;
+      }
+      if (errno == EINTR) continue;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      continue;
+    }
+    std::string task_id;
+    AgentTask snapshot;
+    {
+      std::lock_guard<std::mutex> lk(g_agent->mu);
+      for (auto& kv : g_agent->tasks) {
+        if (kv.second.pid == pid && kv.second.state == "running") {
+          int code = WIFEXITED(st) ? WEXITSTATUS(st)
+                                   : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
+          kv.second.exit_code = code;
+          kv.second.state = kv.second.kill_requested
+                                ? "killed"
+                                : (code == 0 ? "finished" : "failed");
+          task_id = kv.first;
+          snapshot = kv.second;
+          note_terminal_locked(task_id);
+          break;
+        }
+      }
+    }
+    if (!task_id.empty()) agent_status(task_id, snapshot);
+  }
+}
+
+void agent_launch(const std::string& task_id, const std::string& command) {
+  std::string sandbox = g_agent->workdir + "/" + task_id;
+  ::mkdir(sandbox.c_str(), 0755);
+  pid_t pid = ::fork();
+  if (pid == 0) {
+    ::setsid();  // own session/process group: kill(-pid) reaches the tree
+    if (::chdir(sandbox.c_str()) != 0) _exit(127);
+    int out = ::open("stdout", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+    int err = ::open("stderr", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+    if (out >= 0) ::dup2(out, 1);
+    if (err >= 0) ::dup2(err, 2);
+    ::setenv("COOK_TASK_ID", task_id.c_str(), 1);
+    ::setenv("COOK_SANDBOX", sandbox.c_str(), 1);
+    ::execl("/bin/sh", "sh", "-c", command.c_str(), nullptr);
+    _exit(127);
+  }
+  AgentTask t;
+  t.sandbox = sandbox;
+  if (pid < 0) {
+    t.state = "failed";
+    t.exit_code = 127;
+    {
+      std::lock_guard<std::mutex> lk(g_agent->mu);
+      g_agent->tasks[task_id] = t;
+      note_terminal_locked(task_id);
+    }
+    agent_status(task_id, t);
+    return;
+  }
+  t.pid = pid;
+  t.state = "running";
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    g_agent->tasks[task_id] = t;
+  }
+  agent_status(task_id, t);
+}
+
+void agent_kill(const std::string& task_id, int grace_ms) {
+  pid_t pid = -1;
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    auto it = g_agent->tasks.find(task_id);
+    if (it == g_agent->tasks.end() || it->second.state != "running") return;
+    it->second.kill_requested = true;
+    pid = it->second.pid;
+  }
+  ::kill(-pid, SIGTERM);
+  std::thread([task_id, pid, grace_ms] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(grace_ms));
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    auto it = g_agent->tasks.find(task_id);
+    if (it != g_agent->tasks.end() && it->second.state == "running" &&
+        it->second.pid == pid) {
+      ::kill(-pid, SIGKILL);
+    }
+  }).detach();
+}
+
+void agent_connection(int fd) {
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    g_agent->clients.insert(fd);
+  }
+  // REGISTERED: identity + capacity + running tasks for reconciliation
+  std::string running_csv;
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    for (const auto& kv : g_agent->tasks) {
+      if (kv.second.state == "running") {
+        if (!running_csv.empty()) running_csv += ",";
+        running_csv += kv.first;
+      }
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_agent->write_mu);
+    send_frame(fd, {"REGISTERED", g_agent->agent_id, g_agent->hostname,
+                    std::to_string(g_agent->cpus), std::to_string(g_agent->mem),
+                    std::to_string(g_agent->gpus), std::to_string(g_agent->disk),
+                    running_csv});
+  }
+  std::vector<std::string> f;
+  while (recv_frame(fd, &f)) {
+    if (f.empty()) continue;
+    const std::string& type = f[0];
+    if (type == "LAUNCH" && f.size() >= 3) {
+      agent_launch(f[1], f[2]);
+    } else if (type == "KILL" && f.size() >= 3) {
+      agent_kill(f[1], std::atoi(f[2].c_str()));
+    } else if (type == "RECONCILE") {
+      std::vector<std::pair<std::string, AgentTask>> snap;
+      {
+        std::lock_guard<std::mutex> lk(g_agent->mu);
+        for (const auto& kv : g_agent->tasks) snap.push_back(kv);
+      }
+      for (const auto& kv : snap) {
+        std::lock_guard<std::mutex> lk(g_agent->write_mu);
+        send_frame(fd, {"STATUS", kv.first, kv.second.state,
+                        std::to_string(kv.second.exit_code),
+                        kv.second.sandbox});
+      }
+      std::lock_guard<std::mutex> lk(g_agent->write_mu);
+      send_frame(fd, {"RECONCILE_DONE"});
+    } else if (type == "PING") {
+      std::lock_guard<std::mutex> lk(g_agent->write_mu);
+      send_frame(fd, {"PONG"});
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    g_agent->clients.erase(fd);
+  }
+  ::close(fd);
+}
+
+int agent_main(int argc, char** argv) {
+  ::signal(SIGPIPE, SIG_IGN);
+  g_agent = new AgentState();
+  int port = 0;
+  char hostbuf[256] = {0};
+  ::gethostname(hostbuf, sizeof(hostbuf) - 1);
+  g_agent->hostname = hostbuf;
+  g_agent->workdir = "/tmp/cook-agentd";
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string a = argv[i];
+    const char* v = argv[i + 1];
+    if (a == "--port") port = std::atoi(v);
+    else if (a == "--cpus") g_agent->cpus = std::atof(v);
+    else if (a == "--mem") g_agent->mem = std::atof(v);
+    else if (a == "--gpus") g_agent->gpus = std::atof(v);
+    else if (a == "--disk") g_agent->disk = std::atof(v);
+    else if (a == "--hostname") g_agent->hostname = v;
+    else if (a == "--workdir") g_agent->workdir = v;
+  }
+  g_agent->workdir += "/" + g_agent->hostname;
+  mkdir_p(g_agent->workdir);
+
+  // CLOEXEC everywhere: forked task children must not inherit the driver
+  // connection, or an orphaned task holds the TCP session open after the
+  // agent dies and the scheduler never sees the node as lost
+  int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  g_agent->agent_id =
+      g_agent->hostname + ":" + std::to_string(ntohs(addr.sin_port));
+  if (::listen(lfd, 16) != 0) {
+    ::perror("listen");
+    return 1;
+  }
+  // announce the bound port (stdout line 1) so a parent that passed
+  // --port 0 can discover it
+  ::printf("PORT %d\n", ntohs(addr.sin_port));
+  ::fflush(stdout);
+  std::thread(agent_reaper).detach();
+  for (;;) {
+    int cfd = ::accept4(lfd, nullptr, nullptr, SOCK_CLOEXEC);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // bound broadcast writes: a stalled driver must not wedge the reaper
+    // (agent_broadcast holds write_mu across all clients)
+    timeval snd_tv{5, 0};
+    ::setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &snd_tv, sizeof(snd_tv));
+    std::thread(agent_connection, cfd).detach();
+  }
+  return 0;
+}
+
+// ----------------------------------------------------------------- driver
+
+struct Driver {
+  int fd = -1;
+  std::thread reader;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> events;
+  std::atomic<bool> closed{false};
+  std::mutex write_mu;
+  std::string info;  // agent_id SEP hostname SEP cpus SEP mem SEP gpus SEP disk SEP running
+};
+
+void driver_reader(Driver* d) {
+  std::vector<std::string> f;
+  while (recv_frame(d->fd, &f)) {
+    std::string flat;
+    for (size_t i = 0; i < f.size(); ++i) {
+      if (i) flat += kSep;
+      flat += f[i];
+    }
+    std::lock_guard<std::mutex> lk(d->mu);
+    d->events.push_back(flat);
+    d->cv.notify_all();
+  }
+  d->closed.store(true);
+  std::lock_guard<std::mutex> lk(d->mu);
+  d->cv.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to an agent; block until REGISTERED arrives. NULL on failure.
+void* ctd_connect(const char* host, int port, int timeout_ms) {
+  ::signal(SIGPIPE, SIG_IGN);
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
+    return nullptr;
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    ::freeaddrinfo(res);
+    return nullptr;
+  }
+  // non-blocking connect so timeout_ms bounds the TCP handshake too (a
+  // blackholed endpoint would otherwise block for the OS default ~2 min)
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  ::freeaddrinfo(res);
+  if (rc != 0 && errno != EINPROGRESS) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (rc != 0) {
+    fd_set wfds;
+    FD_ZERO(&wfds);
+    FD_SET(fd, &wfds);
+    timeval ctv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    if (::select(fd + 1, nullptr, &wfds, nullptr, &ctv) <= 0) {
+      ::close(fd);
+      return nullptr;
+    }
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+    if (soerr != 0) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  ::fcntl(fd, F_SETFL, flags);  // back to blocking
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<std::string> f;
+  if (!recv_frame(fd, &f) || f.empty() || f[0] != "REGISTERED") {
+    ::close(fd);
+    return nullptr;
+  }
+  timeval tv0{0, 0};  // reader thread blocks indefinitely from here on
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv0, sizeof(tv0));
+  Driver* d = new Driver();
+  d->fd = fd;
+  for (size_t i = 1; i < f.size(); ++i) {
+    if (i > 1) d->info += kSep;
+    d->info += f[i];
+  }
+  d->reader = std::thread(driver_reader, d);
+  return d;
+}
+
+int ctd_agent_info(void* h, char* buf, int cap) {
+  Driver* d = static_cast<Driver*>(h);
+  int n = static_cast<int>(d->info.size());
+  if (n + 1 > cap) return -1;
+  ::memcpy(buf, d->info.data(), d->info.size());
+  buf[n] = '\0';
+  return n;
+}
+
+static int ctd_send(void* h, const std::vector<std::string>& fields) {
+  Driver* d = static_cast<Driver*>(h);
+  if (d->closed.load()) return -1;
+  std::lock_guard<std::mutex> lk(d->write_mu);
+  return send_frame(d->fd, fields) ? 0 : -1;
+}
+
+int ctd_launch(void* h, const char* task_id, const char* command, double cpus,
+               double mem) {
+  return ctd_send(h, {"LAUNCH", task_id, command, std::to_string(cpus),
+                      std::to_string(mem)});
+}
+
+int ctd_kill(void* h, const char* task_id, int grace_ms) {
+  return ctd_send(h, {"KILL", task_id, std::to_string(grace_ms)});
+}
+
+int ctd_reconcile(void* h) { return ctd_send(h, {"RECONCILE"}); }
+
+int ctd_ping(void* h) { return ctd_send(h, {"PING"}); }
+
+// Next event (fields joined by 0x1f) into buf. Returns length, 0 on
+// timeout, -1 when the connection is closed and the queue is drained.
+int ctd_poll(void* h, char* buf, int cap, int timeout_ms) {
+  Driver* d = static_cast<Driver*>(h);
+  std::unique_lock<std::mutex> lk(d->mu);
+  if (d->events.empty()) {
+    d->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                   [d] { return !d->events.empty() || d->closed.load(); });
+  }
+  if (d->events.empty()) return d->closed.load() ? -1 : 0;
+  std::string ev = std::move(d->events.front());
+  d->events.pop_front();
+  lk.unlock();
+  int n = static_cast<int>(ev.size());
+  if (n + 1 > cap) return -1;
+  ::memcpy(buf, ev.data(), ev.size());
+  buf[n] = '\0';
+  return n;
+}
+
+int ctd_connected(void* h) {
+  return static_cast<Driver*>(h)->closed.load() ? 0 : 1;
+}
+
+void ctd_close(void* h) {
+  Driver* d = static_cast<Driver*>(h);
+  ::shutdown(d->fd, SHUT_RDWR);
+  if (d->reader.joinable()) d->reader.join();
+  ::close(d->fd);
+  delete d;
+}
+
+}  // extern "C"
+
+#ifdef COOK_AGENT_MAIN
+int main(int argc, char** argv) { return agent_main(argc, argv); }
+#endif

@@ -1,0 +1,331 @@
+"""Native transport cluster backend: cook_agentd + libcooktransport driver
+(the framework's libmesos-equivalent, reference: mesos_compute_cluster.clj
++ executor/cook/executor.py)."""
+
+import time
+from pathlib import Path
+
+import pytest
+
+from cook_tpu.cluster.remote import (
+    AgentConnection,
+    LocalAgentProcess,
+    RemoteComputeCluster,
+    native_available,
+)
+from cook_tpu.state.schema import InstanceStatus, JobState, Reasons
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="C++ toolchain unavailable")
+
+
+@pytest.fixture
+def agent(tmp_path):
+    a = LocalAgentProcess("nodeA", cpus=4.0, mem=4096.0,
+                          workdir=str(tmp_path))
+    yield a
+    a.stop()
+
+
+def wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestAgentConnection:
+    def test_registered_info(self, agent):
+        conn = AgentConnection("127.0.0.1", agent.port)
+        assert conn.hostname == "nodeA"
+        assert conn.capacity.cpus == 4.0 and conn.capacity.mem == 4096.0
+        assert conn.running_at_connect == []
+        conn.close()
+
+    def test_launch_status_stream(self, agent):
+        conn = AgentConnection("127.0.0.1", agent.port)
+        assert conn.launch("t-ok", "echo out; echo err >&2; exit 0", 1, 64)
+        events = []
+        while len(events) < 2:
+            ev = conn.poll(timeout_ms=2000)
+            assert ev is not None, f"timed out, got {events}"
+            events.append(ev)
+        assert events[0][:3] == ["STATUS", "t-ok", "running"]
+        assert events[1][:4] == ["STATUS", "t-ok", "finished", "0"]
+        sandbox = events[1][4]
+        assert open(sandbox + "/stdout").read() == "out\n"
+        assert open(sandbox + "/stderr").read() == "err\n"
+        conn.close()
+
+    def test_nonzero_exit_is_failed(self, agent):
+        conn = AgentConnection("127.0.0.1", agent.port)
+        conn.launch("t-bad", "exit 3", 1, 64)
+        terminal = None
+        for _ in range(20):
+            ev = conn.poll(timeout_ms=2000)
+            if ev and ev[1] == "t-bad" and ev[2] != "running":
+                terminal = ev
+                break
+        assert terminal[2] == "failed" and terminal[3] == "3"
+        conn.close()
+
+    def test_kill_escalation(self, agent):
+        conn = AgentConnection("127.0.0.1", agent.port)
+        # the shell ignores TERM and respawns its sleep children, so only
+        # the SIGKILL escalation can end it; "running" is broadcast at fork
+        # time, so wait for the ready marker before killing or the TERM can
+        # land before the trap is installed
+        conn.launch("t-stuck",
+                    "trap '' TERM; touch ready; while true; do sleep 0.2; done",
+                    1, 64)
+        ev = conn.poll(timeout_ms=2000)
+        assert ev[2] == "running"
+        sandbox = ev[4]
+        assert wait_for(lambda: (Path(sandbox) / "ready").exists())
+        conn.kill("t-stuck", grace_ms=300)
+        terminal = None
+        for _ in range(40):
+            ev = conn.poll(timeout_ms=500)
+            if ev and ev[1] == "t-stuck" and ev[2] != "running":
+                terminal = ev
+                break
+        assert terminal is not None, "kill escalation never landed"
+        assert terminal[2] == "killed"
+        assert terminal[3] == str(128 + 9)  # SIGKILL
+        conn.close()
+
+    def test_reconcile_replays_state(self, agent):
+        c1 = AgentConnection("127.0.0.1", agent.port)
+        c1.launch("t-live", "sleep 30", 1, 64)
+        assert c1.poll(timeout_ms=2000)[2] == "running"
+        # a second driver connection sees the live task at registration
+        c2 = AgentConnection("127.0.0.1", agent.port)
+        assert c2.running_at_connect == ["t-live"]
+        c2.reconcile()
+        seen = []
+        while True:
+            ev = c2.poll(timeout_ms=2000)
+            assert ev is not None
+            if ev[0] == "RECONCILE_DONE":
+                break
+            seen.append(ev)
+        assert ["STATUS", "t-live", "running"] in [e[:3] for e in seen]
+        c1.kill("t-live", grace_ms=100)
+        c1.close()
+        c2.close()
+
+
+class TestRemoteComputeCluster:
+    def _mk(self, agents, store=None):
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", a.port) for a in agents], store=store,
+            kill_grace_ms=300)
+        return cluster
+
+    def test_offers_track_consumption(self, agent):
+        from cook_tpu.cluster.base import LaunchSpec
+        from cook_tpu.state.schema import Resources
+
+        updates = []
+        cluster = self._mk([agent])
+        cluster.initialize(lambda tid, st, rc, **kw: updates.append((tid, st)))
+        [offer] = cluster.pending_offers("default")
+        assert offer.hostname == "nodeA" and offer.available.cpus == 4.0
+        cluster.launch_tasks("default", [LaunchSpec(
+            task_id="t-c1", job_uuid="j1", hostname="nodeA", slave_id="",
+            resources=Resources(cpus=1.5, mem=512.0))])
+        [offer] = cluster.pending_offers("default")
+        assert offer.available.cpus == 2.5 and offer.task_count == 1
+        # default command is "true" (no store) -> completes, frees capacity
+        assert wait_for(lambda: (("t-c1", InstanceStatus.SUCCESS) in updates))
+        [offer] = cluster.pending_offers("default")
+        assert offer.available.cpus == 4.0
+        cluster.shutdown()
+
+    def test_agent_loss_is_node_lost(self, tmp_path):
+        from cook_tpu.cluster.base import LaunchSpec
+        from cook_tpu.state.schema import Resources
+
+        from cook_tpu.state import Job, Store, new_uuid
+
+        agent = LocalAgentProcess("nodeB", workdir=str(tmp_path / "b"))
+        updates = []
+        store = Store()
+        job = Job(uuid=new_uuid(), user="alice", command="sleep 60",
+                  pool="default", resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        cluster = self._mk([agent], store=store)
+        cluster.initialize(
+            lambda tid, st, rc, **kw: updates.append((tid, st, rc)))
+        cluster.launch_tasks("default", [LaunchSpec(
+            task_id="t-lost", job_uuid=job.uuid, hostname="nodeB",
+            slave_id="", resources=Resources(cpus=1.0, mem=64.0))])
+        assert wait_for(lambda: any(t == "t-lost" and s is InstanceStatus.RUNNING
+                                    for t, s, _ in updates))
+        agent.proc.kill()  # node dies hard
+        assert wait_for(lambda: any(
+            t == "t-lost" and s is InstanceStatus.FAILED
+            and rc == Reasons.NODE_LOST.code for t, s, rc in updates))
+        assert cluster.pending_offers("default") == []
+        cluster.shutdown()
+
+
+class TestReconnectAndRobustness:
+    def test_unreachable_endpoint_does_not_block_healthy(self, agent):
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", 1), ("127.0.0.1", agent.port)])
+        cluster.initialize(lambda *a, **k: None)
+        assert [o.hostname for o in cluster.pending_offers("default")] \
+            == ["nodeA"]
+        cluster.shutdown()
+
+    def test_restart_adopts_live_tasks(self, agent):
+        """Scheduler restart: a fresh cluster object reconnecting to an
+        agent with a live task must subtract its consumption from offers
+        (reference: state reconstruction on re-register)."""
+        from cook_tpu.state import Job, Store, new_uuid
+        from cook_tpu.state.schema import Resources
+
+        store = Store()
+        job = Job(uuid=new_uuid(), user="a", command="sleep 30",
+                  pool="default", resources=Resources(cpus=2.0, mem=256.0))
+        store.create_jobs([job])
+        c1 = self_mk = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        c1.initialize(lambda *a, **k: None)
+        from cook_tpu.cluster.base import LaunchSpec
+        store.launch_instance(job.uuid, "t-adopt", hostname="nodeA",
+                              compute_cluster="remote-1")
+        c1.launch_tasks("default", [LaunchSpec(
+            task_id="t-adopt", job_uuid=job.uuid, hostname="nodeA",
+            slave_id="", resources=job.resources)])
+        assert wait_for(lambda: c1.pending_offers("default")[0]
+                        .available.cpus == 2.0)
+        # "restart": new cluster object, same agent
+        c2 = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        c2.initialize(lambda *a, **k: None)
+        [offer] = c2.pending_offers("default")
+        assert offer.available.cpus == 2.0  # 4 - 2 adopted
+        assert offer.task_count == 1
+        c1.kill_task("t-adopt")
+        c1.shutdown()
+        c2.shutdown()
+
+    def test_store_reconcile_marks_unknown_tasks_node_lost(self, agent):
+        """A task the store believes is running on this cluster but no
+        agent knows about becomes NODE_LOST at initialize."""
+        from cook_tpu.state import Job, Store, new_uuid
+        from cook_tpu.state.schema import Resources
+
+        store = Store()
+        job = Job(uuid=new_uuid(), user="a", command="sleep 30",
+                  pool="default", resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        store.launch_instance(job.uuid, "t-ghost", hostname="gone-node",
+                              compute_cluster="remote-1")
+        store.update_instance_status("t-ghost", InstanceStatus.RUNNING)
+        updates = []
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cluster.initialize(
+            lambda tid, st, rc, **kw: updates.append((tid, st, rc)))
+        assert ("t-ghost", InstanceStatus.FAILED,
+                Reasons.NODE_LOST.code) in updates
+        cluster.shutdown()
+
+    def test_missing_job_command_fails_launch(self, agent):
+        """No silent 'true' substitute: an unresolvable command must fail
+        the task, not fake a success."""
+        from cook_tpu.cluster.base import LaunchSpec
+        from cook_tpu.state import Store
+        from cook_tpu.state.schema import Resources
+
+        store = Store()  # job uuid not present
+        updates = []
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cluster.initialize(
+            lambda tid, st, rc, **kw: updates.append((tid, st, rc)))
+        cluster.launch_tasks("default", [LaunchSpec(
+            task_id="t-nocmd", job_uuid="no-such-job", hostname="nodeA",
+            slave_id="", resources=Resources(cpus=1.0, mem=64.0))])
+        assert ("t-nocmd", InstanceStatus.FAILED,
+                Reasons.CONTAINER_LAUNCH_FAILED.code) in updates
+        [offer] = cluster.pending_offers("default")
+        assert offer.available.cpus == 4.0  # nothing left tracked
+        cluster.shutdown()
+
+
+class TestSchedulerIntegration:
+    def test_end_to_end_real_processes(self, agent, tmp_path):
+        """submit -> rank -> match -> native launch -> real /bin/sh run ->
+        status -> job completed, with sandbox writeback."""
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        marker = tmp_path / "ran.txt"
+        good = Job(uuid=new_uuid(), user="alice",
+                   command=f"echo done > {marker}",
+                   pool="default", resources=Resources(cpus=1.0, mem=128.0))
+        bad = Job(uuid=new_uuid(), user="bob", command="exit 7",
+                  pool="default", max_retries=1,
+                  resources=Resources(cpus=1.0, mem=128.0))
+        store.create_jobs([good, bad])
+        sched.step_rank()
+        res = sched.step_match()["default"]
+        assert len(res.launched_task_ids) == 2
+
+        def settled():
+            sched.flush_status_updates()
+            return (store.job(good.uuid).state is JobState.COMPLETED
+                    and store.job(bad.uuid).state is JobState.COMPLETED)
+        assert wait_for(settled, timeout=15)
+        assert marker.read_text().strip() == "done"
+        g_insts = [store.instance(t) for t in store.job(good.uuid).instances]
+        assert any(i.status is InstanceStatus.SUCCESS for i in g_insts)
+        b_insts = [store.instance(t) for t in store.job(bad.uuid).instances]
+        failed = [i for i in b_insts if i.status is InstanceStatus.FAILED]
+        assert failed and failed[0].exit_code == 7
+        assert failed[0].sandbox_directory  # writeback happened
+        cluster.shutdown()
+
+    def test_kill_running_job(self, agent):
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store,
+            kill_grace_ms=300)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        job = Job(uuid=new_uuid(), user="alice", command="sleep 60",
+                  pool="default", resources=Resources(cpus=1.0, mem=128.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+
+        def running():
+            sched.flush_status_updates()
+            inst = store.instance(tid)
+            return inst is not None and inst.status is InstanceStatus.RUNNING
+        assert wait_for(running)
+        store.kill_job(job.uuid)  # tx-report side effect kills the live task
+
+        def dead():
+            sched.flush_status_updates()
+            return store.job(job.uuid).state is JobState.COMPLETED
+        assert wait_for(dead, timeout=15)
+        cluster.shutdown()
