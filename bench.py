@@ -1,14 +1,25 @@
-"""Benchmark: per-cycle scheduling hot path on the available accelerator.
+"""Benchmark: per-cycle scheduling hot path at BASELINE.json scale.
 
-Measures the two kernels that replace the reference's hot loops at the
-BASELINE.md scales:
-  - DRU rank of 100k tasks across 500 users (BASELINE config 2)
-  - greedy bin-pack match of 1k considerable jobs x 5k host offers
-    (config 3's kernel at the reference's fenzo-max-jobs-considered cap)
+The north star (BASELINE.json) is <=50ms p99 match-cycle latency at 1M
+pending jobs x 50k offers. A match cycle = DRU rank of the full pending set
+(HOT LOOP #1, reference: dru.clj:82-126) + bin-pack match of the
+considerable prefix (reference caps it at fenzo max-jobs-considered = 1000,
+scheduler.clj:1615) against all offers (HOT LOOP #2, Fenzo scheduleOnce).
+The rebalancer victim scan over 1M running tasks (HOT LOOP #3b,
+rebalancer.clj:320-407) is benchmarked alongside (BASELINE config 5).
 
-The headline value is the combined match-cycle latency (p50); vs_baseline is
-the speedup over the CPU fallback (reference-semantics numpy/python path)
-on the same inputs.  Prints exactly one JSON line on stdout.
+Timing methodology: on tunneled/proxied devices `block_until_ready` can
+return before the computation lands and every host sync pays the tunnel
+round trip (measured here as `sync_floor_ms`), so each sample times
+`inner` back-to-back dispatches closed by one host read of a small output
+slice and divides — device time with the RTT amortized to noise. Per-call
+fully-synced latency is also reported; on locally-attached hardware the
+two converge.
+
+Prints exactly one JSON line on stdout:
+  value        = p99 amortized (rank 1M tasks + match 1k x 50k) cycle, ms
+  vs_baseline  = speedup of that cycle over the CPU reference-semantics
+                 fallback on identical inputs
 """
 
 import json
@@ -18,12 +29,53 @@ import time
 import numpy as np
 
 
-def p50(xs):
-    return float(np.percentile(np.asarray(xs), 50))
+def pctl(xs, q):
+    return float(np.percentile(np.asarray(xs), q))
 
 
-def bench_rank(reps=10):
+def _sync(out):
     import jax
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    jax.device_get(leaf.ravel()[-1:])
+
+
+def timed(fn, reps=5, inner=32):
+    """Amortized per-call ms samples: inner dispatches, one sync, divide."""
+    _sync(fn())  # warm / ensure compiled
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(inner):
+            out = fn()
+        _sync(out)
+        samples.append((time.perf_counter() - t0) * 1000.0 / inner)
+    return samples
+
+
+def timed_synced(fn, reps=8):
+    """Per-call latency with a full host sync each call (includes tunnel
+    RTT when one is present)."""
+    _sync(fn())
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn())
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    return samples
+
+
+def measure_sync_floor():
+    import jax
+    import jax.numpy as jnp
+
+    h = jax.jit(lambda a: a + 1.0)
+    x = jnp.float32(1.0)
+    return pctl(timed_synced(lambda: h(x), reps=10), 50)
+
+
+def bench_rank(n_users=2000, total=1_000_000):
+    """DRU rank of 1M pending/running tasks across 2000 users."""
     import jax.numpy as jnp
 
     from cook_tpu.ops import host_prep, rank_kernel, reference_impl
@@ -31,7 +83,6 @@ def bench_rank(reps=10):
     from cook_tpu.ops.reference_impl import UserTasks
 
     rng = np.random.default_rng(0)
-    n_users, total = 500, 100_000
     per_user = total // n_users
     users, shares, quotas = [], {}, {}
     tid = 0
@@ -53,31 +104,27 @@ def bench_rank(reps=10):
     arrays, _ = host_prep.pack_rank_inputs(users, shares, quotas)
     pack_s = time.perf_counter() - t0
     inp = RankInputs(**{k: jnp.asarray(v) for k, v in arrays.items()})
-    out = rank_kernel(inp)
-    out.order.block_until_ready()  # compile
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = rank_kernel(inp)
-        out.order.block_until_ready()
-        times.append((time.perf_counter() - t0) * 1000)
+    times = timed(lambda: rank_kernel(inp).order)
+    synced = timed_synced(lambda: rank_kernel(inp).order)
 
     t0 = time.perf_counter()
     reference_impl.rank_by_dru(users, shares, quotas)
     cpu_ms = (time.perf_counter() - t0) * 1000
-    print(f"rank pack={pack_s*1e3:.0f}ms tpu_p50={p50(times):.2f}ms "
-          f"cpu={cpu_ms:.0f}ms", file=sys.stderr)
-    return p50(times), cpu_ms
+    print(f"rank[{total//1000}k x {n_users}u] pack={pack_s*1e3:.0f}ms "
+          f"amortized_p50={pctl(times,50):.2f}ms p99={pctl(times,99):.2f}ms "
+          f"synced_p50={pctl(synced,50):.1f}ms cpu={cpu_ms:.0f}ms",
+          file=sys.stderr)
+    return times, synced, cpu_ms
 
 
-def bench_match(reps=10):
+def bench_match(J=1000, H=50_000):
+    """Bin-pack 1k considerable jobs against 50k host offers."""
     import jax.numpy as jnp
 
     from cook_tpu.ops import (MatchInputs, greedy_match_kernel, host_prep,
                               reference_impl)
 
     rng = np.random.default_rng(1)
-    J, H = 1000, 5000
     job_res = np.stack([
         rng.integers(1, 16, J).astype(np.float32),
         rng.integers(64, 4096, J).astype(np.float32),
@@ -98,41 +145,95 @@ def bench_match(reps=10):
         avail=jnp.asarray(arrays["avail"]),
         capacity=jnp.asarray(arrays["capacity"]),
         valid=jnp.asarray(arrays["valid"]))
-    assign, _ = greedy_match_kernel(inp)
-    assign.block_until_ready()
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        assign, _ = greedy_match_kernel(inp)
-        assign.block_until_ready()
-        times.append((time.perf_counter() - t0) * 1000)
+    times = timed(lambda: greedy_match_kernel(inp)[0])
+    synced = timed_synced(lambda: greedy_match_kernel(inp)[0])
 
     t0 = time.perf_counter()
     golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
     cpu_ms = (time.perf_counter() - t0) * 1000
-    parity = float((np.asarray(assign)[:J] == golden).mean())
-    print(f"match tpu_p50={p50(times):.2f}ms cpu={cpu_ms:.0f}ms "
-          f"parity={parity:.4f}", file=sys.stderr)
-    return p50(times), cpu_ms, parity
+    assign_np = np.asarray(greedy_match_kernel(inp)[0])[:J]
+    parity = float((assign_np == golden).mean())
+    placed = int((assign_np >= 0).sum())
+    print(f"match[{J} x {H//1000}k] amortized_p50={pctl(times,50):.2f}ms "
+          f"p99={pctl(times,99):.2f}ms synced_p50={pctl(synced,50):.1f}ms "
+          f"cpu={cpu_ms:.0f}ms placed={placed} parity={parity:.4f}",
+          file=sys.stderr)
+    return times, synced, cpu_ms, parity, placed
+
+
+def bench_rebalance(T=1_000_000, H=50_000):
+    """Preemption victim scan over 1M running tasks on 50k hosts."""
+    import jax.numpy as jnp
+
+    from cook_tpu.ops.rebalance import RebalanceInputs, preemption_kernel
+
+    rng = np.random.default_rng(2)
+    per_host = T // H
+    host = np.repeat(np.arange(H, dtype=np.int32), per_host)
+    dru = rng.random(T).astype(np.float32)
+    order = np.lexsort((-dru, host))  # kernel wants (host, -dru) order
+    dru, host = dru[order], host[order]
+    task_res = np.stack([
+        rng.integers(1, 16, T).astype(np.float32),
+        rng.integers(64, 4096, T).astype(np.float32),
+        np.zeros(T, dtype=np.float32),
+        np.zeros(T, dtype=np.float32)], axis=1)
+    host_start = np.zeros(T, dtype=bool)
+    host_start[0] = True
+    host_start[1:] = host[1:] != host[:-1]
+    eligible = dru > 0.5  # safe-dru-threshold style mask
+    spare = np.stack([
+        rng.integers(0, 8, H).astype(np.float32),
+        rng.integers(0, 2048, H).astype(np.float32),
+        np.zeros(H, dtype=np.float32),
+        np.full(H, 1e6, dtype=np.float32)], axis=1)
+    demand = np.array([8.0, 8192.0, 0.0, 0.0], dtype=np.float32)
+
+    inp = RebalanceInputs(
+        task_dru=jnp.asarray(dru), task_res=jnp.asarray(task_res),
+        task_host=jnp.asarray(host), host_start=jnp.asarray(host_start),
+        eligible=jnp.asarray(eligible), spare=jnp.asarray(spare),
+        host_ok=jnp.ones(H, dtype=bool), demand=jnp.asarray(demand))
+    times = timed(lambda: preemption_kernel(inp).victim_mask)
+    found = bool(np.asarray(preemption_kernel(inp).found))
+    print(f"rebalance[{T//1000}k x {H//1000}k] "
+          f"amortized_p50={pctl(times,50):.2f}ms p99={pctl(times,99):.2f}ms "
+          f"found={found}", file=sys.stderr)
+    return times
 
 
 def main():
     import jax
 
     platform = jax.devices()[0].platform
-    rank_tpu, rank_cpu = bench_rank()
-    match_tpu, match_cpu, parity = bench_match()
-    tpu_total = rank_tpu + match_tpu
+    sync_floor = measure_sync_floor()
+    print(f"sync_floor={sync_floor:.1f}ms", file=sys.stderr)
+    rank_times, rank_synced, rank_cpu = bench_rank()
+    match_times, match_synced, match_cpu, parity, placed = bench_match()
+    reb_times = bench_rebalance()
+    cycle = [r + m for r, m in zip(rank_times, match_times)]
+    cycle_p50, cycle_p99 = pctl(cycle, 50), pctl(cycle, 99)
     cpu_total = rank_cpu + match_cpu
     print(json.dumps({
-        "metric": "match_cycle_p50_ms_rank100k_match1kx5k",
-        "value": round(tpu_total, 3),
+        "metric": "match_cycle_p99_ms_rank1M_match1kx50k",
+        "value": round(cycle_p99, 3),
         "unit": "ms",
-        "vs_baseline": round(cpu_total / tpu_total, 2),
+        "vs_baseline": round(cpu_total / cycle_p50, 2),
         "detail": {
             "platform": platform,
-            "rank_ms_100k_tasks_500_users": round(rank_tpu, 3),
-            "match_ms_1k_jobs_5k_hosts": round(match_tpu, 3),
+            "target_p99_ms": 50.0,
+            "sync_floor_ms": round(sync_floor, 1),
+            "cycle_p50_ms": round(cycle_p50, 3),
+            "cycle_p99_ms": round(cycle_p99, 3),
+            "rank_1M_tasks_2000_users_p50_ms": round(pctl(rank_times, 50), 3),
+            "rank_p99_ms": round(pctl(rank_times, 99), 3),
+            "rank_synced_p50_ms": round(pctl(rank_synced, 50), 1),
+            "match_1k_jobs_50k_hosts_p50_ms": round(pctl(match_times, 50), 3),
+            "match_p99_ms": round(pctl(match_times, 99), 3),
+            "match_synced_p50_ms": round(pctl(match_synced, 50), 1),
+            "rebalance_1M_tasks_p50_ms": round(pctl(reb_times, 50), 3),
+            "rebalance_p99_ms": round(pctl(reb_times, 99), 3),
+            "placements_per_sec": round(placed / (cycle_p50 / 1000.0), 1),
             "cpu_fallback_rank_ms": round(rank_cpu, 1),
             "cpu_fallback_match_ms": round(match_cpu, 1),
             "greedy_placement_parity": parity,

@@ -100,6 +100,10 @@ class Config:
     straggler_interval_seconds: float = 30.0
     # user/pool gauge sweeper (monitor.clj:209)
     monitor_interval_seconds: float = 30.0
+    # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
+    # disabled by default like the reference (marked deprecated there)
+    heartbeat_enabled: bool = False
+    heartbeat_timeout_ms: int = 60_000
     # offensive-job stifling in the rank cycle (scheduler.clj:2205-2257);
     # None disables the filter
     offensive_job_limits: Optional[OffensiveJobLimits] = None

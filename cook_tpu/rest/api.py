@@ -556,6 +556,9 @@ class CookApi:
         if not ok:
             raise ApiError(404, f"no such instance {task_id} "
                                 "(or stale sequence)")
+        if self.scheduler is not None:
+            # progress frames double as liveness (heartbeat.clj:100-123)
+            self.scheduler.heartbeat(task_id)
         return {"task_id": task_id}
 
     def info(self) -> Dict:

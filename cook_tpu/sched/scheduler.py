@@ -61,6 +61,12 @@ class Scheduler:
         self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
         from .monitor import Monitor
         self.monitor = Monitor(store)
+        from .heartbeat import HeartbeatTracker
+        self.heartbeats = HeartbeatTracker(self.config.heartbeat_timeout_ms)
+        # Single clock for heartbeat stamps and reaper sweeps; the simulator
+        # replaces it with its virtual clock so expiry math never mixes
+        # timebases.
+        self.clock = now_ms
         # pool -> ranked pending jobs, refreshed by the rank cycle
         self.pending_queues: Dict[str, List[Job]] = {}
         # pool -> last MatchCycleResult, feeds the unscheduled explainer
@@ -87,6 +93,10 @@ class Scheduler:
             self.add_cluster(cluster)
         if not store.pools():
             store.put_pool(Pool(name=self.config.default_pool))
+        # Resume path: instances already live in a reopened store predate
+        # this scheduler's tx subscription, so watch them now.
+        for _job, inst in store.running_instances():
+            self.heartbeats.watch(inst.task_id, self.clock())
 
     # ---------------------------------------------------------------- wiring
     def add_cluster(self, cluster: ComputeCluster) -> None:
@@ -104,9 +114,17 @@ class Scheduler:
 
     def _apply_status_payload(self, task_id: str, payload) -> None:
         status, reason_code, exit_code, preempted, hostname = payload
+        if status is InstanceStatus.RUNNING:
+            self.heartbeats.beat(task_id, self.clock())
         self.store.update_instance_status(
             task_id, status, reason_code=reason_code, exit_code=exit_code,
             preempted=preempted, hostname=hostname)
+
+    def heartbeat(self, task_id: str) -> None:
+        """Explicit liveness signal from an executor/sidecar (progress
+        frames route here too, matching the reference where any framework
+        message resets the heartbeat timer, heartbeat.clj:100-123)."""
+        self.heartbeats.beat(task_id, self.clock())
 
     def flush_status_updates(self) -> None:
         if self._status_queue is not None:
@@ -135,8 +153,12 @@ class Scheduler:
                 # consume rebalancer reservations once the job launches —
                 # or release them if the job dies while still waiting
                 self.reserved_hosts.pop(e.data.get("uuid"), None)
+            if e.kind == "instance-created":
+                # start the heartbeat clock at launch (heartbeat.clj:92)
+                self.heartbeats.watch(e.data["task_id"], self.clock())
             if e.kind == "instance-status" and e.data.get("new") in (
                     "success", "failed"):
+                self.heartbeats.forget(e.data["task_id"])
                 # InstanceCompletionHandler plugins (plugins/definitions.clj)
                 inst = self.store.instance(e.data["task_id"])
                 job = self.store.job(e.data["job"]) if inst else None
@@ -303,7 +325,7 @@ class Scheduler:
         """Kill tasks over their max runtime (lingering-task killer,
         scheduler.clj:1888-1953) and straggler instances per group quantile
         rule (scheduler.clj:1955-1986)."""
-        current = current_ms if current_ms is not None else now_ms()
+        current = current_ms if current_ms is not None else self.clock()
         killed: List[str] = []
         for job, inst in self.store.running_instances():
             if job.max_runtime_ms and inst.start_time_ms and \
@@ -311,6 +333,11 @@ class Scheduler:
                 self._kill_instance(inst.task_id, Reasons.MAX_RUNTIME_EXCEEDED.code)
                 killed.append(inst.task_id)
         killed.extend(self._reap_stragglers(current))
+        if self.config.heartbeat_enabled:
+            for task_id in self.heartbeats.expired(current):
+                self._kill_instance(task_id, Reasons.HEARTBEAT_LOST.code)
+                self.heartbeats.forget(task_id)
+                killed.append(task_id)
         return killed
 
     def _reap_stragglers(self, current_ms: int) -> List[str]:

@@ -123,6 +123,8 @@ class Simulator:
             return result
         pending = list(self.trace)
         now = pending[0].submit_time_ms
+        # heartbeat/reaper stamps must use the virtual clock, not wall time
+        self.scheduler.clock = lambda: now
         next_rank = now
         next_match = now
         next_rebalance = now + self.rebalance_interval_ms

@@ -1,0 +1,120 @@
+"""Heartbeat timeout killer (reference: mesos/heartbeat.clj:66-147)."""
+
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config
+from cook_tpu.sched import Scheduler
+from cook_tpu.sched.heartbeat import HeartbeatTracker
+from cook_tpu.state import (
+    InstanceStatus,
+    Job,
+    JobState,
+    Reasons,
+    Resources,
+    Store,
+    new_uuid,
+)
+
+
+class TestTracker:
+    def test_watch_beat_expire(self):
+        hb = HeartbeatTracker(timeout_ms=1000)
+        hb.watch("t1", now=0)
+        hb.watch("t2", now=0)
+        assert hb.expired(now=500) == []
+        hb.beat("t1", now=900)
+        assert hb.expired(now=1500) == ["t2"]
+        hb.forget("t2")
+        assert hb.expired(now=1500) == []
+        assert hb.last_beat("t1") == 900
+
+    def test_beat_before_watch_is_ignored(self):
+        # stale liveness after forget() must not re-track (leak + spurious
+        # kill); watch() is the sole insert point
+        hb = HeartbeatTracker(timeout_ms=1000)
+        hb.beat("t1", now=500)
+        assert hb.tracked_count() == 0
+        hb.watch("t1", now=0)
+        hb.forget("t1")
+        hb.beat("t1", now=600)
+        assert hb.tracked_count() == 0
+
+
+def mk_env(heartbeat_enabled=True, timeout_ms=1000):
+    store = Store()
+    cluster = FakeCluster("fake-1", [FakeHost(
+        hostname="h0", capacity=Resources(cpus=8.0, mem=8192.0))])
+    config = Config()
+    config.default_matcher.backend = "cpu"
+    config.heartbeat_enabled = heartbeat_enabled
+    config.heartbeat_timeout_ms = timeout_ms
+    sched = Scheduler(store, config, [cluster], rank_backend="cpu")
+    return store, cluster, sched
+
+
+class TestSchedulerIntegration:
+    def test_silent_task_killed_mea_culpa(self):
+        store, cluster, sched = mk_env()
+        job = Job(uuid=new_uuid(), user="a", command="x", pool="default",
+                  resources=Resources(cpus=1.0, mem=64.0), max_retries=5)
+        store.create_jobs([job])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        assert sched.heartbeats.tracked_count() == 1
+        base = sched.heartbeats.last_beat(tid)
+        # silent past the timeout -> killed as HEARTBEAT_LOST
+        killed = sched.step_reapers(current_ms=base + 5000)
+        assert killed == [tid]
+        inst = store.instance(tid)
+        assert inst.status is InstanceStatus.FAILED
+        assert inst.reason_code == Reasons.HEARTBEAT_LOST.code
+        # mea-culpa: retry budget untouched, job back to waiting
+        assert store.job(job.uuid).state is JobState.WAITING
+        assert sched.heartbeats.tracked_count() == 0
+
+    def test_beating_task_survives(self):
+        store, cluster, sched = mk_env()
+        job = Job(uuid=new_uuid(), user="a", command="x", pool="default",
+                  resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        base = sched.heartbeats.last_beat(tid)
+        sched.heartbeats.beat(tid, base + 4500)
+        assert sched.step_reapers(current_ms=base + 5000) == []
+        assert store.instance(tid).status is not InstanceStatus.FAILED
+
+    def test_disabled_by_default(self):
+        store, cluster, sched = mk_env(heartbeat_enabled=False)
+        job = Job(uuid=new_uuid(), user="a", command="x", pool="default",
+                  resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        base = sched.heartbeats.last_beat(tid)
+        assert sched.step_reapers(current_ms=base + 10 ** 9) == []
+
+    def test_restart_watches_preexisting_running_instances(self):
+        store = Store()
+        job = Job(uuid=new_uuid(), user="a", command="x", pool="default",
+                  resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        store.launch_instance(job.uuid, "t-pre", hostname="h0",
+                              compute_cluster="fake-1")
+        store.update_instance_status("t-pre", InstanceStatus.RUNNING)
+        # a fresh scheduler on a reopened store adopts the live instance
+        config = Config()
+        config.default_matcher.backend = "cpu"
+        config.heartbeat_enabled = True
+        sched = Scheduler(store, config, [], rank_backend="cpu")
+        assert sched.heartbeats.tracked_count() == 1
+        assert sched.heartbeats.last_beat("t-pre") is not None
+
+    def test_terminal_status_forgets(self):
+        store, cluster, sched = mk_env()
+        job = Job(uuid=new_uuid(), user="a", command="x", pool="default",
+                  resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        [tid] = sched.step_match()["default"].launched_task_ids
+        cluster.complete_task(tid)
+        assert sched.heartbeats.tracked_count() == 0

@@ -192,17 +192,20 @@ class TestReconnectAndRobustness:
         job = Job(uuid=new_uuid(), user="a", command="sleep 30",
                   pool="default", resources=Resources(cpus=2.0, mem=256.0))
         store.create_jobs([job])
-        c1 = self_mk = RemoteComputeCluster(
+        updates = []
+        c1 = RemoteComputeCluster(
             "remote-1", [("127.0.0.1", agent.port)], store=store)
-        c1.initialize(lambda *a, **k: None)
+        c1.initialize(lambda tid, st, rc, **kw: updates.append((tid, st)))
         from cook_tpu.cluster.base import LaunchSpec
         store.launch_instance(job.uuid, "t-adopt", hostname="nodeA",
                               compute_cluster="remote-1")
         c1.launch_tasks("default", [LaunchSpec(
             task_id="t-adopt", job_uuid=job.uuid, hostname="nodeA",
             slave_id="", resources=job.resources)])
-        assert wait_for(lambda: c1.pending_offers("default")[0]
-                        .available.cpus == 2.0)
+        # wait until the agent actually runs it (launch_tasks tracks the
+        # task synchronously, before the agent has forked)
+        assert wait_for(lambda: ("t-adopt", InstanceStatus.RUNNING)
+                        in updates)
         # "restart": new cluster object, same agent
         c2 = RemoteComputeCluster(
             "remote-1", [("127.0.0.1", agent.port)], store=store)
