@@ -162,7 +162,7 @@ class Matcher:
                     longest = (ec.host_lifetime_mins
                                - ec.agent_start_grace_period_mins) * 60_000
                     ctx.estimated_end_ms[job.uuid] = int(
-                        now_ms() + min(max_expected, longest))
+                        self.store.clock() + min(max_expected, longest))
             if job.group:
                 group = self.store.group(job.group)
                 if group is not None and job.group not in ctx.groups:

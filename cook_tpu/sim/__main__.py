@@ -1,4 +1,5 @@
-"""CLI: python -m cook_tpu.sim --trace trace.json --hosts hosts.json."""
+"""CLI: python -m cook_tpu.sim --trace trace.json --hosts hosts.json
+     or: python -m cook_tpu.sim --workload spec.json [--emit-trace t.json]"""
 
 import argparse
 import json
@@ -11,11 +12,19 @@ from .simulator import (
     load_hosts,
     load_trace,
 )
+from .workload import generate_hosts, generate_trace
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cook_tpu.sim")
     p.add_argument("--trace", help="trace JSON file (default: generated)")
+    p.add_argument("--workload",
+                   help="statistical workload spec JSON; synthesizes the "
+                        "trace instead of --trace (simulator/ parity)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="workload generation seed (overrides spec)")
+    p.add_argument("--emit-trace",
+                   help="also write the synthesized trace JSON here")
     p.add_argument("--hosts", help="hosts JSON file (default: generated)")
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--jobs", type=int, default=200,
@@ -24,10 +33,20 @@ def main(argv=None) -> int:
     p.add_argument("--out", help="write task records CSV here")
     args = p.parse_args(argv)
 
-    trace_entries = (json.load(open(args.trace)) if args.trace
-                     else generate_example_trace(args.jobs))
-    host_entries = (json.load(open(args.hosts)) if args.hosts
-                    else generate_example_hosts(args.n_hosts))
+    if args.workload:
+        spec = json.load(open(args.workload))
+        trace_entries = generate_trace(spec, seed=args.seed)
+        if args.emit_trace:
+            with open(args.emit_trace, "w") as f:
+                json.dump(trace_entries, f)
+        host_entries = (json.load(open(args.hosts)) if args.hosts
+                        else generate_hosts(args.n_hosts))
+    else:
+        trace_entries = (json.load(open(args.trace)) if args.trace
+                         else generate_example_trace(
+                             args.jobs, seed=args.seed or 0))
+        host_entries = (json.load(open(args.hosts)) if args.hosts
+                        else generate_example_hosts(args.n_hosts))
     sim = Simulator(load_trace(trace_entries), load_hosts(host_entries),
                     backend=args.backend)
     result = sim.run()

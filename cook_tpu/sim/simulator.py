@@ -123,8 +123,10 @@ class Simulator:
             return result
         pending = list(self.trace)
         now = pending[0].submit_time_ms
-        # heartbeat/reaper stamps must use the virtual clock, not wall time
+        # every stamp (queue/start/end times, heartbeats, reaper sweeps)
+        # must use the virtual clock, or wait-time metrics mix epochs
         self.scheduler.clock = lambda: now
+        self.store.clock = lambda: now
         next_rank = now
         next_match = now
         next_rebalance = now + self.rebalance_interval_ms

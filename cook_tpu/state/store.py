@@ -147,7 +147,7 @@ class _Txn:
             old = job.state
             job.state = new_state
             if new_state is JobState.WAITING:
-                job.last_waiting_start_ms = now_ms()
+                job.last_waiting_start_ms = self._store.clock()
             self.event("job-state", uuid=job.uuid, old=old.value,
                        new=new_state.value, reason=reason)
 
@@ -157,6 +157,10 @@ class Store:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
+        # Injectable clock for every entity timestamp (submit/start/end/
+        # queue-time); the simulator swaps in its virtual clock so recorded
+        # wait times stay in trace time instead of mixing epochs.
+        self.clock = now_ms
         self._jobs: Dict[str, Job] = {}
         self._instances: Dict[str, Instance] = {}
         self._groups: Dict[str, Group] = {}
@@ -309,7 +313,7 @@ class Store:
                     txn.abort(f"duplicate job uuid {job.uuid}")
                 job = copy.deepcopy(job)
                 if not job.submit_time_ms:
-                    job.submit_time_ms = now_ms()
+                    job.submit_time_ms = self.clock()
                 job.last_waiting_start_ms = job.submit_time_ms
                 job.committed = latch is None
                 txn.put("jobs", job.uuid, job)
@@ -353,7 +357,7 @@ class Store:
             deny = machines.allowed_to_start(job, txn.instances_of(job))
             if deny is not None:
                 txn.abort(deny)
-            t = now_ms()
+            t = self.clock()
             inst = Instance(task_id=task_id, job_uuid=job_uuid, hostname=hostname,
                             slave_id=slave_id or hostname, compute_cluster=compute_cluster,
                             status=InstanceStatus.UNKNOWN, start_time_ms=t,
@@ -401,9 +405,9 @@ class Store:
             if preempted:
                 inst.preempted = True
             if new_status in (InstanceStatus.SUCCESS, InstanceStatus.FAILED):
-                inst.end_time_ms = now_ms()
+                inst.end_time_ms = self.clock()
             if new_status is InstanceStatus.RUNNING and inst.mesos_start_time_ms is None:
-                inst.mesos_start_time_ms = now_ms()
+                inst.mesos_start_time_ms = self.clock()
             if old is not new_status:
                 txn.event("instance-status", task_id=task_id, job=inst.job_uuid,
                           old=old.value, new=new_status.value, reason=reason_code)
@@ -484,7 +488,7 @@ class Store:
                 has_success = any(i.status is InstanceStatus.SUCCESS for i in insts.values())
                 if not has_success and job.attempts_used(insts) < retries:
                     job.state = JobState.WAITING
-                    job.last_waiting_start_ms = now_ms()
+                    job.last_waiting_start_ms = self.clock()
                     txn.event("job-state", uuid=job_uuid, old="completed",
                               new="waiting", reason="retry")
             return True

@@ -1,0 +1,89 @@
+"""Statistical workload generator (reference: simulator/ system simulator)."""
+
+import numpy as np
+
+from cook_tpu.sim.simulator import Simulator, load_hosts, load_trace
+from cook_tpu.sim.workload import (
+    generate_hosts,
+    generate_trace,
+    sample,
+)
+
+SPEC = {
+    "seed": 7,
+    "horizon_ms": 600_000,  # 10 virtual minutes
+    "user_classes": [
+        {"name": "batch", "users": 3, "arrival_rate_per_min": 6.0,
+         "duration_ms": {"dist": "lognormal", "mu": 9.5, "sigma": 0.5},
+         "cpus": {"dist": "choice", "values": [1, 2, 4],
+                  "weights": [0.6, 0.3, 0.1]},
+         "mem": {"dist": "uniform", "low": 128, "high": 1024},
+         "priority": {"dist": "constant", "value": 50}},
+        {"name": "interactive", "users": 2, "arrival_rate_per_min": 2.0,
+         "duration_ms": {"dist": "exponential", "scale": 20_000},
+         "cpus": 1.0, "mem": 256.0,
+         "priority": {"dist": "constant", "value": 90}},
+    ],
+}
+
+
+class TestDistributions:
+    def test_sample_kinds(self):
+        rng = np.random.default_rng(0)
+        assert (sample(3.0, rng, 4) == 3.0).all()
+        assert (sample({"dist": "constant", "value": 2}, rng, 4) == 2.0).all()
+        u = sample({"dist": "uniform", "low": 1, "high": 2}, rng, 1000)
+        assert (u >= 1).all() and (u <= 2).all()
+        c = sample({"dist": "choice", "values": [1, 5]}, rng, 1000)
+        assert set(np.unique(c)) <= {1.0, 5.0}
+        ln = sample({"dist": "lognormal", "mu": 0.0, "sigma": 0.1}, rng, 1000)
+        assert 0.8 < float(np.median(ln)) < 1.2
+
+
+class TestGenerator:
+    def test_deterministic_for_seed(self):
+        assert generate_trace(SPEC) == generate_trace(SPEC)
+        assert generate_trace(SPEC, seed=1) != generate_trace(SPEC, seed=2)
+
+    def test_shape_and_rates(self):
+        entries = generate_trace(SPEC)
+        assert entries == sorted(entries, key=lambda e: e["submit_time"])
+        users = {e["user"] for e in entries}
+        assert users <= {"batch000", "batch001", "batch002",
+                         "interactive000", "interactive001"}
+        # 3 users x 6/min x 10 min = ~180 batch arrivals; allow 4 sigma
+        batch = [e for e in entries if e["user"].startswith("batch")]
+        assert 120 <= len(batch) <= 250, len(batch)
+        assert all(0 <= e["submit_time"] < SPEC["horizon_ms"]
+                   for e in entries)
+        assert all(e["duration"] >= 1 for e in entries)
+        interactive = [e for e in entries
+                       if e["user"].startswith("interactive")]
+        assert all(e["priority"] == 90 for e in interactive)
+
+    def test_hosts(self):
+        hosts = generate_hosts(3, cpus=8.0)
+        assert [h["hostname"] for h in hosts] == \
+            ["host0000", "host0001", "host0002"]
+        assert all(h["cpus"] == 8.0 for h in hosts)
+
+
+class TestEndToEnd:
+    def test_generated_workload_runs_through_simulator(self):
+        spec = {
+            "seed": 3, "horizon_ms": 120_000,
+            "user_classes": [
+                {"name": "u", "users": 2, "arrival_rate_per_min": 5.0,
+                 "duration_ms": {"dist": "constant", "value": 5_000},
+                 "cpus": 1.0, "mem": 128.0}],
+        }
+        trace = load_trace(generate_trace(spec))
+        hosts = load_hosts(generate_hosts(4, cpus=4.0, mem=4096.0))
+        sim = Simulator(trace, hosts, backend="cpu")
+        result = sim.run()
+        assert result.total == len(trace) > 0
+        # ample capacity: everything completes with bounded waits
+        assert result.completed == result.total
+        s = result.summary()
+        assert s["wait_time_p50_s"] < 30.0
+        assert s["placements"] == result.total
