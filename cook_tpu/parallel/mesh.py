@@ -15,13 +15,31 @@ import numpy as np
 from jax.sharding import Mesh
 
 POOL_AXIS = "pool"
+DCN_AXIS = "dcn"
 
 
 def pool_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """1-D mesh over the pool axis. With multi-slice topologies a 2-D
-    ("slice", "pool") mesh would put independent pools on DCN and keep
-    reconciliation collectives on ICI; single-slice uses all devices."""
+    """1-D mesh over the pool axis; single-slice, collectives ride ICI."""
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (POOL_AXIS,))
+
+
+def multislice_pool_mesh(n_slices: int,
+                         devices_per_slice: Optional[int] = None) -> Mesh:
+    """2-D ("dcn", "pool") mesh for multi-slice topologies: pools shard over
+    BOTH axes (each slice owns an independent pool block — pool cycles never
+    communicate within a cycle except reconciliation), so the only
+    cross-slice traffic is the small matched-usage all-gather / placement
+    psum, which is exactly what belongs on DCN; everything bandwidth-heavy
+    stays slice-local on ICI (SURVEY.md section 5 distributed-backend
+    mapping)."""
+    devices = jax.devices()
+    if devices_per_slice is None:
+        devices_per_slice = len(devices) // n_slices
+    need = n_slices * devices_per_slice
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_slices, devices_per_slice)
+    return Mesh(grid, (DCN_AXIS, POOL_AXIS))

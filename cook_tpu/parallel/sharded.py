@@ -121,18 +121,26 @@ def make_pool_cycle(mesh: Mesh, *, gpu_mode: bool = False,
             inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
             inp.pending, inp.valid, inp.job_res, inp.cmask, inp.avail,
             inp.capacity)
-        # ICI reconciliation: every device sees every pool's matched usage
-        # (quota groups span pools) and the global placement count.
-        matched_usage_global = jax.lax.all_gather(
-            matched_usage, POOL_AXIS, axis=0, tiled=True)
+        # Reconciliation: every device sees every pool's matched usage
+        # (quota groups span pools) and the global placement count. On a
+        # 1-D mesh this rides ICI; on a ("dcn", "pool") multi-slice mesh
+        # the gather spans both axes — the ONLY cross-slice traffic, sized
+        # [pools, 4] + a scalar, which is what belongs on DCN.
+        matched_usage_global = matched_usage
+        for axis in reversed(axes):
+            matched_usage_global = jax.lax.all_gather(
+                matched_usage_global, axis, axis=0, tiled=True)
         total = jax.lax.psum(jnp.sum((assign >= 0).astype(jnp.int32)),
-                             POOL_AXIS)
+                             axes)
         return PoolCycleResult(order=order, num_ranked=num_ranked, dru=dru,
                                assign=assign,
                                matched_usage=matched_usage_global,
                                total_matched=total)
 
-    spec = P(POOL_AXIS)
+    # pools shard over every mesh axis: ("pool",) single-slice, or
+    # ("dcn", "pool") with slice-independent pool blocks
+    axes = tuple(mesh.axis_names)
+    spec = P(axes)
     sharded = shard_map(
         cycle_body, mesh=mesh,
         in_specs=(PoolCycleInputs(*(spec,) * len(PoolCycleInputs._fields)),),

@@ -115,7 +115,20 @@ class PluginRegistry:
     @classmethod
     def from_config(cls, spec: Dict[str, Any]) -> "PluginRegistry":
         """Instantiate plugins from dotted-path factory names, the moral
-        equivalent of the reference's symbol-resolving factory-fn loading."""
+        equivalent of the reference's symbol-resolving factory-fn loading.
+
+        Each entry is either a dotted path string (no-arg construction) or
+        ``{"factory": path, "kwargs": {...}}`` for parameterized plugins
+        like PoolMoverPlugin."""
+
+        def build(entry):
+            if isinstance(entry, str):
+                path, kwargs = entry, {}
+            else:
+                path, kwargs = entry["factory"], entry.get("kwargs", {})
+            module, _, attr = path.rpartition(".")
+            return getattr(importlib.import_module(module), attr)(**kwargs)
+
         reg = cls()
         slots = {
             "validators": reg.validators, "modifiers": reg.modifiers,
@@ -124,15 +137,12 @@ class PluginRegistry:
             "adjusters": reg.adjusters,
         }
         for slot, target in slots.items():
-            for path in spec.get(slot, []):
-                module, _, attr = path.rpartition(".")
-                target.append(getattr(importlib.import_module(module), attr)())
+            for entry in spec.get(slot, []):
+                target.append(build(entry))
         for slot in ("pool_selector", "router", "file_url_generator"):
-            path = spec.get(slot)
-            if path:
-                module, _, attr = path.rpartition(".")
-                setattr(reg, slot,
-                        getattr(importlib.import_module(module), attr)())
+            entry = spec.get(slot)
+            if entry:
+                setattr(reg, slot, build(entry))
         return reg
 
     # ------------------------------------------------------------- dispatch
@@ -181,3 +191,35 @@ class PluginRegistry:
                 import logging
                 logging.getLogger(__name__).exception(
                     "completion plugin failed")
+
+
+class PoolMoverPlugin(JobSubmissionModifier):
+    """Migrate a portion of configured users' jobs to a destination pool at
+    submission time (reference: plugins/pool_mover.clj — gradual pool
+    migration driven by per-user portions).
+
+    ``moves`` maps source pool -> {"destination": pool, "users": {user:
+    portion}}; a job moves when the fraction derived from its uuid hash is
+    below the user's portion, so rollouts are deterministic per job and
+    tunable per user (same portion mechanism as incremental config).
+    """
+
+    def __init__(self, moves: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.moves = moves or {}
+        for src, rule in self.moves.items():
+            if "destination" not in rule:
+                raise ValueError(
+                    f"pool-mover rule for {src!r} missing 'destination'")
+
+    def modify(self, job: Job) -> Job:
+        from .incremental import _uuid_to_unit_interval
+
+        rule = self.moves.get(job.pool)
+        if not rule:
+            return job
+        portion = rule.get("users", {}).get(job.user)
+        if portion is None:
+            return job
+        if _uuid_to_unit_interval(job.uuid) < float(portion):
+            job.pool = rule["destination"]
+        return job

@@ -24,7 +24,7 @@ import numpy as np
 from ..cluster.base import ComputeCluster, LaunchSpec, Offer
 from ..config import Config, MatcherConfig
 from ..ops import host_prep, reference_impl
-from ..state.schema import InstanceStatus, Job, Reasons, new_uuid, now_ms
+from ..state.schema import InstanceStatus, Job, Reasons, new_uuid
 from ..state.store import AbortTransaction, Store
 from ..utils import tracing
 from .constraints import (

@@ -63,10 +63,10 @@ class Scheduler:
         self.monitor = Monitor(store)
         from .heartbeat import HeartbeatTracker
         self.heartbeats = HeartbeatTracker(self.config.heartbeat_timeout_ms)
-        # Single clock for heartbeat stamps and reaper sweeps; the simulator
-        # replaces it with its virtual clock so expiry math never mixes
-        # timebases.
-        self.clock = now_ms
+        # Heartbeat stamps and reaper sweeps follow the store's injectable
+        # clock (one patch point: the simulator swaps store.clock for its
+        # virtual clock and everything stays in one timebase).
+        self.clock = lambda: self.store.clock()
         # pool -> ranked pending jobs, refreshed by the rank cycle
         self.pending_queues: Dict[str, List[Job]] = {}
         # pool -> last MatchCycleResult, feeds the unscheduled explainer

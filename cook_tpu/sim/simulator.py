@@ -124,8 +124,8 @@ class Simulator:
         pending = list(self.trace)
         now = pending[0].submit_time_ms
         # every stamp (queue/start/end times, heartbeats, reaper sweeps)
-        # must use the virtual clock, or wait-time metrics mix epochs
-        self.scheduler.clock = lambda: now
+        # follows the store clock; one patch keeps the whole system in
+        # virtual trace time
         self.store.clock = lambda: now
         next_rank = now
         next_match = now

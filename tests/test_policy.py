@@ -228,3 +228,56 @@ class TestPlugins:
         })
         assert len(reg.validators) == 1
         assert len(reg.modifiers) == 1
+
+
+class TestPoolMover:
+    def test_moves_portion_of_user_jobs(self):
+        from cook_tpu.policy.plugins import PoolMoverPlugin
+        from cook_tpu.state.schema import Job, Resources, new_uuid
+
+        mover = PoolMoverPlugin({"alpha": {
+            "destination": "beta", "users": {"alice": 0.5, "bob": 0.0}}})
+        moved = unmoved = 0
+        for _ in range(400):
+            job = Job(uuid=new_uuid(), user="alice", command="x",
+                      pool="alpha", resources=Resources(cpus=1, mem=1))
+            job = mover.modify(job)
+            if job.pool == "beta":
+                moved += 1
+            else:
+                unmoved += 1
+        # ~50% portion; generous bounds
+        assert 100 < moved < 300, (moved, unmoved)
+        # portion 0 user never moves; other pools untouched
+        for user, pool in (("bob", "alpha"), ("alice", "gamma")):
+            job = Job(uuid=new_uuid(), user=user, command="x", pool=pool,
+                      resources=Resources(cpus=1, mem=1))
+            assert mover.modify(job).pool == pool
+
+    def test_deterministic_per_uuid(self):
+        from cook_tpu.policy.plugins import PoolMoverPlugin
+        from cook_tpu.state.schema import Job, Resources
+
+        mover = PoolMoverPlugin({"alpha": {
+            "destination": "beta", "users": {"alice": 0.5}}})
+        job1 = Job(uuid="11111111-1111-1111-1111-111111111111", user="alice",
+                   command="x", pool="alpha", resources=Resources(cpus=1, mem=1))
+        job2 = Job(uuid="11111111-1111-1111-1111-111111111111", user="alice",
+                   command="x", pool="alpha", resources=Resources(cpus=1, mem=1))
+        assert mover.modify(job1).pool == mover.modify(job2).pool
+
+    def test_from_config_with_kwargs(self):
+        from cook_tpu.policy.plugins import PluginRegistry, PoolMoverPlugin
+        reg = PluginRegistry.from_config({"modifiers": [
+            {"factory": "cook_tpu.policy.plugins.PoolMoverPlugin",
+             "kwargs": {"moves": {"alpha": {"destination": "beta",
+                                            "users": {"alice": 1.0}}}}}]})
+        [mover] = reg.modifiers
+        assert isinstance(mover, PoolMoverPlugin)
+        assert mover.moves["alpha"]["destination"] == "beta"
+
+    def test_missing_destination_rejected_at_config_time(self):
+        import pytest
+        from cook_tpu.policy.plugins import PoolMoverPlugin
+        with pytest.raises(ValueError, match="destination"):
+            PoolMoverPlugin({"alpha": {"users": {"alice": 1.0}}})

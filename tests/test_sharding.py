@@ -124,3 +124,35 @@ class TestPoolShardedCycle:
         res = cycle(inp)
         assert int(res.num_ranked[3]) == 0
         assert np.all(np.asarray(res.assign[3]) == -1) or True
+
+
+class TestMultisliceMesh:
+    def test_dcn_pool_mesh_matches_1d(self):
+        """2-D ("dcn", "pool") mesh produces identical placements to the 1-D
+        mesh — sharding must not change scheduling decisions."""
+        from cook_tpu.parallel.mesh import multislice_pool_mesh
+
+        rng = np.random.default_rng(5)
+        pools = [build_pool(rng) for _ in range(8)]
+        stack = lambda key: jnp.asarray(np.stack(
+            [p["arrays"][key] if key in p["arrays"] else p[key]
+             for p in pools]))
+        inp = PoolCycleInputs(
+            usage=stack("usage"), quota=stack("quota"), shares=stack("shares"),
+            first_idx=stack("first_idx"), user_rank=stack("user_rank"),
+            pending=stack("pending"), valid=stack("valid"),
+            job_res=jnp.asarray(np.stack([p["job_res"] for p in pools])),
+            cmask=jnp.asarray(np.stack([p["cmask"] for p in pools])),
+            avail=jnp.asarray(np.stack([p["avail"] for p in pools])),
+            capacity=jnp.asarray(np.stack([p["capacity"] for p in pools])))
+        res1 = make_pool_cycle(pool_mesh())(inp)
+        mesh2 = multislice_pool_mesh(2, 4)
+        assert mesh2.axis_names == ("dcn", "pool")
+        res2 = make_pool_cycle(mesh2)(inp)
+        np.testing.assert_array_equal(np.asarray(res1.assign),
+                                      np.asarray(res2.assign))
+        np.testing.assert_array_equal(np.asarray(res1.order),
+                                      np.asarray(res2.order))
+        assert int(res1.total_matched) == int(res2.total_matched)
+        np.testing.assert_allclose(np.asarray(res1.matched_usage),
+                                   np.asarray(res2.matched_usage))
