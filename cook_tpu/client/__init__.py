@@ -24,11 +24,17 @@ class JobClientError(Exception):
 
 class JobClient:
     def __init__(self, url: str, user: str = "anonymous",
-                 impersonate: Optional[str] = None, timeout_s: float = 30.0):
+                 impersonate: Optional[str] = None, timeout_s: float = 30.0,
+                 token: Optional[str] = None,
+                 basic_auth: Optional[tuple] = None):
         self.url = url.rstrip("/")
         self.user = user
         self.impersonate = impersonate
         self.timeout_s = timeout_s
+        # bearer/negotiate ticket (rest/auth.py HmacTokenAuthenticator) or
+        # (user, password) basic credentials for verified servers
+        self.token = token
+        self.basic_auth = basic_auth
 
     # ------------------------------------------------------------- plumbing
     def _request(self, method: str, path: str,
@@ -49,6 +55,13 @@ class JobClient:
                    "X-Cook-User": self.user,
                    **({"X-Cook-Impersonate": self.impersonate}
                       if self.impersonate else {})}
+        if self.token:
+            headers["Authorization"] = "Bearer " + self.token
+        elif self.basic_auth:
+            import base64
+            cred = base64.b64encode(
+                f"{self.basic_auth[0]}:{self.basic_auth[1]}".encode()).decode()
+            headers["Authorization"] = "Basic " + cred
         raw = None
         for _hop in range(4):  # follow leader redirects (307) incl. POST,
             req = urllib.request.Request(url, data=data, method=method,

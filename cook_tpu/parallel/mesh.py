@@ -37,6 +37,10 @@ def multislice_pool_mesh(n_slices: int,
     mapping)."""
     devices = jax.devices()
     if devices_per_slice is None:
+        if len(devices) % n_slices != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {n_slices} "
+                "slices; pass devices_per_slice explicitly")
         devices_per_slice = len(devices) // n_slices
     need = n_slices * devices_per_slice
     if len(devices) < need:

@@ -168,7 +168,8 @@ class CookApi:
                  impersonators: Optional[List[str]] = None,
                  elector=None, node_url: str = "",
                  basic_auth_users: Optional[Dict[str, str]] = None,
-                 cors_origins: Optional[List[str]] = None):
+                 cors_origins: Optional[List[str]] = None,
+                 authenticators: Optional[List] = None):
         from ..policy.incremental import IncrementalConfig
         self.store = store
         self.scheduler = scheduler
@@ -188,6 +189,18 @@ class CookApi:
         # HTTP-basic verification (reference: basic_auth.clj). None = "open"
         # mode: the username is taken from Basic/X-Cook-User unverified.
         self.basic_auth_users = basic_auth_users
+        # Pluggable scheme chain (reference: spnego/basic/open composition,
+        # components.clj:266-284). When set, authentication is mandatory.
+        # basic_auth_users is sugar for a single-Basic chain so verified
+        # basic auth has exactly one code path.
+        from .auth import AuthChain, BasicAuthenticator
+        if authenticators:
+            self.auth_chain = AuthChain(authenticators)
+        elif basic_auth_users is not None:
+            self.auth_chain = AuthChain(
+                [BasicAuthenticator(dict(basic_auth_users))])
+        else:
+            self.auth_chain = None
         # CORS allowed-origin regexes (reference: cors.clj; same-origin
         # requests are always allowed, cross-origin must match a pattern)
         self.cors_origins = [re.compile(p) for p in (cors_origins or [])]
@@ -679,21 +692,22 @@ class _Handler(BaseHTTPRequestHandler):
         """Resolve (and in verified mode, check) the caller identity; runs
         for EVERY request before dispatch (reference: the auth middleware
         wraps the whole handler stack, components.clj:266-284)."""
+        if self.api.auth_chain is not None:
+            from .auth import AuthError
+            try:
+                return self.api.auth_chain.authenticate(self.headers)
+            except AuthError as e:
+                headers = ({"WWW-Authenticate": e.challenge}
+                           if e.challenge else None)
+                raise ApiError(401, e.message, headers=headers)
+        # open mode: identity from unverified Basic or the trusted header
         auth = self.headers.get("Authorization", "")
         user = self.headers.get("X-Cook-User", "")
-        password = None
         if auth.startswith("Basic "):
             try:
-                user, _, password = \
-                    base64.b64decode(auth[6:]).decode().partition(":")
+                user = base64.b64decode(auth[6:]).decode().partition(":")[0]
             except Exception:
                 raise ApiError(401, "malformed basic auth")
-        if self.api.basic_auth_users is not None:
-            # verified mode: credentials are required and checked
-            if password is None or not self.api.check_basic_auth(user, password):
-                raise ApiError(401, "bad credentials",
-                               headers={"WWW-Authenticate":
-                                        'Basic realm="cook"'})
         return user or "anonymous"
 
     def _user(self) -> str:

@@ -1,0 +1,141 @@
+"""Pluggable request authentication schemes.
+
+The reference composes SPNEGO/Kerberos, HTTP basic, and open (trusted
+header) authentication in its middleware stack (reference:
+rest/spnego.clj, rest/basic_auth.clj, composable is-authorized-fn
+rest/authorization.clj, wired at components.clj:266-284). This module is
+that seam: an ordered chain of Authenticators; the first one that resolves
+an identity wins, and configuring a chain makes authentication mandatory.
+
+SPNEGO itself needs a KDC, which is out of scope for this image; its slot
+is filled by :class:`HmacTokenAuthenticator` — self-contained signed
+tickets (user, expiry, HMAC) presented as ``Authorization: Bearer`` or
+``Negotiate``, the moral shape of a kerberos service ticket: issued out of
+band, verified statelessly, time-bounded.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import time
+from typing import Callable, Dict, Optional, Union
+
+
+class AuthError(Exception):
+    """Malformed or rejected credentials (maps to HTTP 401)."""
+
+    def __init__(self, message: str, challenge: Optional[str] = None):
+        super().__init__(message)
+        self.message = message
+        self.challenge = challenge
+
+
+class Authenticator:
+    """One authentication scheme. Returns the identity, or None when the
+    request carries no credentials for this scheme (the chain moves on);
+    raises AuthError when credentials are present but invalid."""
+
+    challenge: Optional[str] = None
+
+    def authenticate(self, headers) -> Optional[str]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class HeaderTrustAuthenticator(Authenticator):
+    """Open mode: trust a proxy-provided identity header (the reference's
+    one-user-per-request open auth)."""
+
+    def __init__(self, header: str = "X-Cook-User"):
+        self.header = header
+
+    def authenticate(self, headers) -> Optional[str]:
+        return headers.get(self.header) or None
+
+
+class BasicAuthenticator(Authenticator):
+    """HTTP basic with a user->password table or a check callable."""
+
+    challenge = 'Basic realm="cook"'
+
+    def __init__(self, users: Union[Dict[str, str],
+                                    Callable[[str, str], bool]]):
+        if callable(users):
+            self._check = users
+        else:
+            # constant-time compare: don't leak password prefixes via timing
+            self._check = lambda u, p: hmac.compare_digest(
+                users.get(u, ""), p)
+
+    def authenticate(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            return None
+        try:
+            user, _, password = \
+                base64.b64decode(auth[6:]).decode().partition(":")
+        except Exception:
+            raise AuthError("malformed basic auth", self.challenge)
+        if not user or not self._check(user, password):
+            raise AuthError("bad credentials", self.challenge)
+        return user
+
+
+class HmacTokenAuthenticator(Authenticator):
+    """Signed ticket auth: ``base64(user:expiry_epoch_s:hexmac)``.
+
+    mint() issues tickets (the KDC stand-in); authenticate() verifies them
+    statelessly. Accepted under ``Authorization: Bearer <t>`` or
+    ``Negotiate <t>`` (the header SPNEGO uses)."""
+
+    challenge = "Negotiate"
+
+    def __init__(self, secret: Union[str, bytes],
+                 default_ttl_s: float = 8 * 3600.0):
+        self.secret = secret.encode() if isinstance(secret, str) else secret
+        self.default_ttl_s = default_ttl_s
+
+    def _mac(self, user: str, expiry_s: int) -> str:
+        msg = f"{user}:{expiry_s}".encode()
+        return hmac.new(self.secret, msg, hashlib.sha256).hexdigest()
+
+    def mint(self, user: str, ttl_s: Optional[float] = None) -> str:
+        expiry = int(time.time() + (ttl_s if ttl_s is not None
+                                    else self.default_ttl_s))
+        raw = f"{user}:{expiry}:{self._mac(user, expiry)}"
+        return base64.b64encode(raw.encode()).decode()
+
+    def authenticate(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization", "")
+        scheme, _, token = auth.partition(" ")
+        if scheme not in ("Bearer", "Negotiate") or not token:
+            return None
+        try:
+            user, expiry_str, mac = \
+                base64.b64decode(token).decode().rsplit(":", 2)
+            expiry = int(expiry_str)
+        except Exception:
+            raise AuthError("malformed token", self.challenge)
+        if not hmac.compare_digest(mac, self._mac(user, expiry)):
+            raise AuthError("bad token signature", self.challenge)
+        if time.time() > expiry:
+            raise AuthError("token expired", self.challenge)
+        return user
+
+
+class AuthChain:
+    """Ordered schemes; first resolved identity wins. A configured chain
+    makes authentication mandatory (no anonymous fallthrough)."""
+
+    def __init__(self, authenticators):
+        self.authenticators = list(authenticators)
+
+    def authenticate(self, headers) -> str:
+        for a in self.authenticators:
+            user = a.authenticate(headers)
+            if user:
+                return user
+        challenges = [a.challenge for a in self.authenticators if a.challenge]
+        raise AuthError("authentication required",
+                        challenges[0] if challenges else None)

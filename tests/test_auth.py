@@ -1,0 +1,116 @@
+"""Pluggable auth chain (reference: spnego/basic/open composition,
+components.clj:266-284; rest/spnego.clj; rest/basic_auth.clj)."""
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.rest.api import ApiServer, CookApi
+from cook_tpu.rest.auth import (
+    AuthChain,
+    AuthError,
+    BasicAuthenticator,
+    HeaderTrustAuthenticator,
+    HmacTokenAuthenticator,
+)
+from cook_tpu.state import Store
+
+
+class TestSchemes:
+    def test_header_trust(self):
+        a = HeaderTrustAuthenticator()
+        assert a.authenticate({"X-Cook-User": "alice"}) == "alice"
+        assert a.authenticate({}) is None
+
+    def test_basic(self):
+        import base64
+        a = BasicAuthenticator({"alice": "pw"})
+        hdr = {"Authorization": "Basic "
+               + base64.b64encode(b"alice:pw").decode()}
+        assert a.authenticate(hdr) == "alice"
+        bad = {"Authorization": "Basic "
+               + base64.b64encode(b"alice:nope").decode()}
+        with pytest.raises(AuthError):
+            a.authenticate(bad)
+        assert a.authenticate({}) is None  # no credentials -> chain moves on
+
+    def test_token_roundtrip_and_expiry(self):
+        a = HmacTokenAuthenticator("secret", default_ttl_s=3600)
+        tok = a.mint("alice")
+        assert a.authenticate({"Authorization": f"Bearer {tok}"}) == "alice"
+        assert a.authenticate({"Authorization": f"Negotiate {tok}"}) == "alice"
+        expired = a.mint("alice", ttl_s=-1)
+        with pytest.raises(AuthError, match="expired"):
+            a.authenticate({"Authorization": f"Bearer {expired}"})
+
+    def test_token_tamper_and_wrong_secret(self):
+        a = HmacTokenAuthenticator("secret")
+        other = HmacTokenAuthenticator("other-secret")
+        tok = other.mint("alice")
+        with pytest.raises(AuthError, match="signature"):
+            a.authenticate({"Authorization": f"Bearer {tok}"})
+        with pytest.raises(AuthError):
+            a.authenticate({"Authorization": "Bearer not-base64!!"})
+
+    def test_username_with_colons_survives(self):
+        a = HmacTokenAuthenticator("s")
+        tok = a.mint("svc:job:runner")
+        assert a.authenticate({"Authorization": f"Bearer {tok}"}) \
+            == "svc:job:runner"
+
+    def test_chain_order_and_mandatory(self):
+        chain = AuthChain([HmacTokenAuthenticator("s"),
+                           HeaderTrustAuthenticator()])
+        assert chain.authenticate({"X-Cook-User": "bob"}) == "bob"
+        with pytest.raises(AuthError, match="authentication required"):
+            chain.authenticate({})
+
+
+class TestRestIntegration:
+    def _serve(self, **kw):
+        srv = ApiServer(CookApi(Store(), **kw))
+        srv.start()
+        return srv
+
+    def test_token_auth_end_to_end(self):
+        minter = HmacTokenAuthenticator("topsecret")
+        srv = self._serve(authenticators=[minter])
+        try:
+            ok = JobClient(f"http://127.0.0.1:{srv.port}",
+                           token=minter.mint("alice"))
+            [u] = ok.submit([{"command": "true", "cpus": 1.0, "mem": 10.0}])
+            assert ok.job(u)["user"] == "alice"
+            # no credentials -> 401 with a challenge
+            with pytest.raises(JobClientError) as ei:
+                JobClient(f"http://127.0.0.1:{srv.port}").jobs()
+            assert ei.value.status == 401
+            # the spoofable header is NOT accepted when a chain is set
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/jobs",
+                headers={"X-Cook-User": "mallory"})
+            with pytest.raises(urllib.error.HTTPError) as he:
+                urllib.request.urlopen(req)
+            assert he.value.code == 401
+            assert he.value.headers.get("WWW-Authenticate") == "Negotiate"
+        finally:
+            srv.stop()
+
+    def test_mixed_chain_basic_fallback(self):
+        chain = [HmacTokenAuthenticator("s"),
+                 BasicAuthenticator({"bob": "hunter2"})]
+        srv = self._serve(authenticators=chain)
+        try:
+            c = JobClient(f"http://127.0.0.1:{srv.port}",
+                          basic_auth=("bob", "hunter2"))
+            [u] = c.submit([{"command": "true", "cpus": 1.0, "mem": 10.0}])
+            assert c.job(u)["user"] == "bob"
+            bad = JobClient(f"http://127.0.0.1:{srv.port}",
+                            basic_auth=("bob", "wrong"))
+            with pytest.raises(JobClientError) as ei:
+                bad.jobs()
+            assert ei.value.status == 401
+        finally:
+            srv.stop()
