@@ -29,37 +29,48 @@ def pack_rank_inputs(users: List[UserTasks],
     Returns (arrays dict, task_ids list).
     """
     users = sorted(users, key=lambda u: u.user)
-    usage_rows, quota_rows, share_rows = [], [], []
-    first_idx, user_rank, pending, task_ids = [], [], [], []
-    offset = 0
-    for rank, ut in enumerate(users):
-        n = len(ut.task_ids)
-        share = np.asarray(shares[ut.user], dtype=F32)
-        quota = np.asarray(quotas[ut.user], dtype=F32)
-        for i in range(n):
-            usage_rows.append(ut.usage[i])
-            quota_rows.append(quota)
-            share_rows.append(share)
-            first_idx.append(offset)
-            user_rank.append(rank)
-            pending.append(ut.pending[i])
-            task_ids.append(ut.task_ids[i])
-        offset += n
-
-    if not task_ids:  # canonical 1-row all-padding layout
-        usage_rows = [np.zeros(4, dtype=F32)]
-        quota_rows = [np.full(4, np.inf, dtype=F32)]
-        share_rows = [np.full(3, np.inf, dtype=F32)]
-        first_idx, user_rank, pending = [0], [0], [False]
-    arrays = {
-        "usage": np.array(usage_rows, dtype=F32),
-        "quota": np.array(quota_rows, dtype=F32),
-        "shares": np.array(share_rows, dtype=F32),
-        "first_idx": np.array(first_idx, dtype=np.int32),
-        "user_rank": np.array(user_rank, dtype=np.int32),
-        "pending": np.array(pending, dtype=bool),
-        "valid": np.full(len(first_idx), bool(task_ids)),
-    }
+    users = [u for u in users if len(u.task_ids)]
+    if users:
+        # O(users) Python, O(tasks) numpy: per-user blocks are repeated /
+        # concatenated wholesale rather than appended one task at a time
+        # (the round-1 per-task loop was the host-side hot spot at 1M tasks).
+        counts = np.array([len(u.task_ids) for u in users], dtype=np.int64)
+        total = int(counts.sum())
+        starts = (np.cumsum(counts) - counts).astype(np.int32)
+        usage = np.concatenate(
+            [np.asarray(u.usage, dtype=F32).reshape(len(u.task_ids), -1)
+             for u in users], axis=0)
+        quota = np.repeat(
+            np.stack([np.asarray(quotas[u.user], dtype=F32) for u in users]),
+            counts, axis=0)
+        share = np.repeat(
+            np.stack([np.asarray(shares[u.user], dtype=F32) for u in users]),
+            counts, axis=0)
+        first = np.repeat(starts, counts)
+        rank = np.repeat(np.arange(len(users), dtype=np.int32), counts)
+        pend = np.concatenate(
+            [np.asarray(u.pending, dtype=bool) for u in users])
+        task_ids = [t for u in users for t in u.task_ids]
+        arrays = {
+            "usage": usage,
+            "quota": quota,
+            "shares": share,
+            "first_idx": first,
+            "user_rank": rank,
+            "pending": pend,
+            "valid": np.ones(total, dtype=bool),
+        }
+    else:  # canonical 1-row all-padding layout
+        task_ids = []
+        arrays = {
+            "usage": np.zeros((1, 4), dtype=F32),
+            "quota": np.full((1, 4), np.inf, dtype=F32),
+            "shares": np.full((1, 3), np.inf, dtype=F32),
+            "first_idx": np.zeros(1, dtype=np.int32),
+            "user_rank": np.zeros(1, dtype=np.int32),
+            "pending": np.zeros(1, dtype=bool),
+            "valid": np.zeros(1, dtype=bool),
+        }
     if pad:
         size = bucket(arrays["usage"].shape[0])
         arrays["usage"] = pad_to(arrays["usage"], size)

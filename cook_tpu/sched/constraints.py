@@ -102,11 +102,36 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
         return mask
 
     host_gpu = np.array([o.capacity.gpus > 0 for o in offers], dtype=bool)
-    host_gpu_model = [o.gpu_model for o in offers]
-    host_disk_type = [o.disk_type for o in offers]
+    host_gpu_model = np.array([o.gpu_model for o in offers], dtype=object)
+    host_disk_type = np.array([o.disk_type for o in offers], dtype=object)
     host_names = [o.hostname for o in offers]
+    host_index = {name: h for h, name in enumerate(host_names)}
     host_tasks = np.array([o.task_count for o in offers], dtype=np.int32)
     offer_attrs = {o.hostname: o.attributes for o in offers}
+
+    # Attribute columns and (attr, value) equality masks are shared across
+    # jobs; caching keeps the build O(unique-attrs x H) numpy instead of
+    # O(J x H) Python (round-1 weak spot #3).
+    attr_cols: Dict[str, np.ndarray] = {}
+    eq_masks: Dict[tuple, np.ndarray] = {}
+
+    def attr_col(attr: str) -> np.ndarray:
+        col = attr_cols.get(attr)
+        if col is None:
+            col = np.array([o.attributes.get(attr) for o in offers],
+                           dtype=object)
+            attr_cols[attr] = col
+        return col
+
+    def cached_mask(key, compute) -> np.ndarray:
+        m = eq_masks.get(key)
+        if m is None:
+            m = compute()
+            eq_masks[key] = m
+        return m
+
+    def attr_equals(attr: str, value) -> np.ndarray:
+        return cached_mask((attr, value), lambda: attr_col(attr) == value)
 
     # estimated-completion: epoch-ms each host is expected to die, +inf when
     # it doesn't advertise "host-start-time" (constraints.clj:392-399)
@@ -121,41 +146,55 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
                 except (TypeError, ValueError):
                     pass  # unparseable attr: treat the host as immortal
 
-    # hosts reserved for some job are off-limits to every other job
-    reserved_by = {h: u for u, h in ctx.reserved_hosts.items()}
+    # hosts reserved for some job are off-limits to every other job;
+    # precompute the reserved host indices + owners once
+    reserved_idx, reserved_owner = [], []
+    for owner_uuid, hname in ctx.reserved_hosts.items():
+        h = host_index.get(hname)
+        if h is not None:
+            reserved_idx.append(h)
+            reserved_owner.append(owner_uuid)
+    reserved_idx = np.array(reserved_idx, dtype=np.int64)
+    reserved_owner = np.array(reserved_owner, dtype=object)
 
     if ctx.max_tasks_per_host is not None:
         mask &= (host_tasks < ctx.max_tasks_per_host)[None, :]
 
+    # group UNIQUE running-cotask host indices, computed once per group
+    unique_group_idx: Dict[str, np.ndarray] = {}
+
     for j, job in enumerate(jobs):
         row = mask[j]
 
-        # novel-host
+        # novel-host: O(|failed|) lookups, not O(H)
         failed = ctx.failed_hosts.get(job.uuid)
         if failed:
-            for h, name in enumerate(host_names):
-                if name in failed:
-                    row[h] = False
+            idx = [host_index[n] for n in failed if n in host_index]
+            if idx:
+                row[idx] = False
 
         # gpu-host: bidirectional isolation
         if job.resources.gpus > 0:
             row &= host_gpu
             wanted_model = job.labels.get(GPU_MODEL_LABEL)
             if wanted_model:
-                row &= np.array([m == wanted_model for m in host_gpu_model])
+                row &= cached_mask(
+                    ("~gpu-model", wanted_model),
+                    lambda: host_gpu_model == wanted_model)
         else:
             row &= ~host_gpu
 
         # disk-type affinity
         wanted_disk = job.labels.get(DISK_TYPE_LABEL)
         if wanted_disk:
-            row &= np.array([d == wanted_disk for d in host_disk_type])
+            row &= cached_mask(
+                ("~disk-type", wanted_disk),
+                lambda: host_disk_type == wanted_disk)
 
         # user-specified attribute constraints (EQUALS)
         for c in job.constraints:
             if c.operator.upper() == "EQUALS":
-                row &= np.array([o.attributes.get(c.attribute) == c.pattern
-                                 for o in offers])
+                row &= attr_equals(c.attribute, c.pattern)
 
         # estimated-completion: skip hosts dying before the job would finish
         est_end = ctx.estimated_end_ms.get(job.uuid)
@@ -165,24 +204,28 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
         # checkpoint locality: pin to prior location
         loc = ctx.checkpoint_locations.get(job.uuid)
         if loc:
-            row &= np.array([o.attributes.get(LOCATION_ATTRIBUTE) == loc
-                             for o in offers])
+            row &= attr_equals(LOCATION_ATTRIBUTE, loc)
 
-        # rebalancer reservations
-        for h, name in enumerate(host_names):
-            owner = reserved_by.get(name)
-            if owner is not None and owner != job.uuid:
-                row[h] = False
+        # rebalancer reservations: block hosts reserved for OTHER jobs
+        if reserved_idx.size:
+            blocked = reserved_idx[reserved_owner != job.uuid]
+            if blocked.size:
+                row[blocked] = False
 
         # group placement vs RUNNING cotasks (within-batch handled post-match)
         if job.group is not None:
             group = ctx.groups.get(job.group)
             ptype = getattr(group, "placement_type", None)
             if ptype is GroupPlacementType.UNIQUE:
-                running = ctx.group_running_hosts.get(job.group, set())
-                for h, name in enumerate(host_names):
-                    if name in running:
-                        row[h] = False
+                idx = unique_group_idx.get(job.group)
+                if idx is None:
+                    running = ctx.group_running_hosts.get(job.group, ())
+                    idx = np.array(
+                        sorted({host_index[n] for n in set(running)
+                                if n in host_index}), dtype=np.int64)
+                    unique_group_idx[job.group] = idx
+                if idx.size:
+                    row[idx] = False
             elif ptype is GroupPlacementType.ATTRIBUTE_EQUALS:
                 attr = getattr(group, "placement_attribute", None)
                 if attr:
@@ -194,20 +237,36 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
                         for hn in ctx.group_running_hosts.get(job.group, ())}
                     allowed.discard(None)
                     if allowed:
-                        row &= np.array([o.attributes.get(attr) in allowed
-                                         for o in offers])
+                        key = ("~in", job.group, attr)
+                        m = eq_masks.get(key)
+                        if m is None:
+                            col = attr_col(attr)
+                            m = np.zeros(H, dtype=bool)
+                            for v in allowed:
+                                m |= col == v
+                            eq_masks[key] = m
+                        row &= m
             elif ptype is GroupPlacementType.BALANCED:
                 attr = getattr(group, "placement_attribute", None)
                 minimum = getattr(group, "placement_minimum", 2) or 2
                 if attr:
-                    freqs: Dict[Optional[str], int] = {}
-                    for hn in ctx.group_running_hosts.get(job.group, ()):
-                        v = ctx.host_attrs(hn, offer_attrs).get(attr)
-                        freqs[v] = freqs.get(v, 0) + 1
-                    if freqs:
-                        row &= np.array([
-                            _balanced_ok(freqs, o.attributes.get(attr), minimum)
-                            for o in offers])
+                    key = ("~balanced", job.group, attr)
+                    m = eq_masks.get(key)
+                    if m is None:
+                        freqs: Dict[Optional[str], int] = {}
+                        for hn in ctx.group_running_hosts.get(job.group, ()):
+                            v = ctx.host_attrs(hn, offer_attrs).get(attr)
+                            freqs[v] = freqs.get(v, 0) + 1
+                        if freqs:
+                            col = attr_col(attr)
+                            ok = {v: _balanced_ok(freqs, v, minimum)
+                                  for v in set(col.tolist())}
+                            m = np.array([ok[v] for v in col.tolist()],
+                                         dtype=bool)
+                        else:
+                            m = np.ones(H, dtype=bool)
+                        eq_masks[key] = m
+                    row &= m
     return mask
 
 
