@@ -81,6 +81,10 @@ class Config:
     rank_interval_seconds: float = 5.0         # mesos.clj:108
     match_interval_seconds: float = 1.0        # target-per-pool-match-interval
     max_over_quota_jobs: int = 100             # config.clj:413-416
+    # "fused": production path — one device dispatch runs rank+admission+
+    # match for all pools (sched/fused.py); "split": host-driven per-pool
+    # step_rank/step_match (CPU fallback, deterministic tests)
+    cycle_mode: str = "fused"
     default_pool: str = "default"
     # pool-regex -> matcher config, first match wins (config.clj:798)
     pool_matchers: List[tuple] = field(default_factory=list)

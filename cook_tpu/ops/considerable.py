@@ -1,0 +1,112 @@
+"""Device-side considerable-job selection: the match-time admission filters
+of the reference, computed in rank order on device.
+
+The reference walks the ranked queue one job at a time
+(pending-jobs->considerable-jobs, reference:
+scheduler/src/cook/scheduler/scheduler.clj:729 + the quota/rate-limit
+accumulators in tools.clj:899-970) accumulating per-user usage and
+launch-rate tokens.  Here the same admission logic is a handful of
+segmented prefix sums so the fused pool cycle can go rank -> considerable
+-> match without a host round trip:
+
+  1. pool quota / quota-group caps over the ranked pending prefix
+     (filter-based-on-quota scheduler.clj:2134; the cumulative-usage
+     accumulator includes filtered jobs, tools.clj:917-933);
+  2. per-user quota over running + earlier-queued jobs (accumulator
+     includes jobs that fail the check, tools.clj:899-915);
+  3. per-user launch-rate token caps — a user's k-th quota-passing job is
+     admitted iff k <= floor(tokens) (filter-pending-jobs-for-ratelimit
+     tools.clj:940-970);
+  4. host-computed launch-plugin verdicts (launch_ok) — the escape hatch
+     for arbitrary host predicates (plugins/launch.clj:140);
+  5. the head-of-queue backoff cap: at most ``num_considerable`` admitted
+     jobs per cycle (scheduler.clj:1613-1651), passed as a traced scalar so
+     backoff changes never recompile.
+
+Users are NOT contiguous in rank order, so per-user prefix sums go through
+one lexsort to user-major order and back (O(T log T) on device, no host
+work).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import scan as scanlib
+
+
+class ConsiderableResult(NamedTuple):
+    match_valid: jax.Array   # bool[T] admitted for matching (rank order)
+    queue_ok: jax.Array      # bool[T] survived pool/group quota + enqueue
+    accepted: jax.Array      # bool[T] admitted before the cap (rank order)
+
+
+def per_user_prefix(user: jax.Array, x: jax.Array,
+                    include: jax.Array) -> jax.Array:
+    """Inclusive per-user prefix sum of ``x`` over rows where ``include``,
+    evaluated in the CURRENT row order (rows of one user need not be
+    contiguous).  Returns an array aligned with the input order."""
+    T = user.shape[0]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    perm = jnp.lexsort((pos, user))  # user-major, stable in current order
+    inc = include[perm]
+    vals = x[perm] * inc.astype(x.dtype).reshape((T,) + (1,) * (x.ndim - 1))
+    u_sorted = user[perm]
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), u_sorted[1:] != u_sorted[:-1]])
+    cum = scanlib.segmented_cumsum(vals, first)
+    out = jnp.zeros_like(cum).at[perm].set(cum)
+    return out
+
+
+def considerable_body(usage_r: jax.Array, quota_r: jax.Array,
+                      user_r: jax.Array, run_base_r: jax.Array,
+                      tokens_r: jax.Array, launch_ok_r: jax.Array,
+                      enqueue_ok_r: jax.Array, rankable_r: jax.Array,
+                      pool_base: jax.Array, pool_quota: jax.Array,
+                      group_base: jax.Array, group_quota: jax.Array,
+                      num_considerable: jax.Array) -> ConsiderableResult:
+    """All inputs are in RANK order (suffix _r).
+
+    usage_r      f32[T, 4] per-task (cpus, mem, gpus, count)
+    quota_r      f32[T, 4] the task's user's quota
+    user_r       i32[T]    user rank ids
+    run_base_r   f32[T, 4] the task's user's running usage in this pool
+    tokens_r     f32[T]    the user's launch-rate token budget (inf = off)
+    launch_ok_r  bool[T]   host plugin verdicts
+    enqueue_ok_r bool[T]   False for host-stifled (offensive) jobs
+    rankable_r   bool[T]   pending tasks that survived over-quota limiting
+    pool_base    f32[4]    pool running usage;  pool_quota f32[4] (inf=off)
+    group_base   f32[4]    quota-group running usage; group_quota f32[4]
+    num_considerable i32[] backoff cap on admitted jobs
+    """
+    # 1. pool + quota-group caps over the ranked pending prefix; the
+    #    cumulative accumulator includes every rankable job (kept or not)
+    pend_usage = usage_r * rankable_r[:, None]
+    cum_pool = jnp.cumsum(pend_usage, axis=0)
+    pq_ok = jnp.all(cum_pool + pool_base[None, :] <= pool_quota[None, :],
+                    axis=-1)
+    gq_ok = jnp.all(cum_pool + group_base[None, :] <= group_quota[None, :],
+                    axis=-1)
+    queue_ok = rankable_r & pq_ok & gq_ok & enqueue_ok_r
+
+    # 2. per-user quota: running base + cumulative queued usage (all queued
+    #    jobs accumulate, pass or fail)
+    cum_user = per_user_prefix(user_r, usage_r, queue_ok)
+    quota_ok = queue_ok & jnp.all(cum_user + run_base_r <= quota_r, axis=-1)
+
+    # 3. launch-rate tokens: inclusive index among the user's quota-passing
+    #    jobs must fit the token budget
+    cnt = per_user_prefix(
+        user_r, jnp.ones((user_r.shape[0],), dtype=jnp.float32), quota_ok)
+    rl_ok = quota_ok & (cnt <= jnp.floor(tokens_r))
+
+    # 4. + 5. plugin verdicts, then the backoff cap on admitted jobs
+    accepted = rl_ok & launch_ok_r
+    admitted_prefix = jnp.cumsum(accepted.astype(jnp.int32))
+    match_valid = accepted & (admitted_prefix <= num_considerable)
+    return ConsiderableResult(match_valid=match_valid, queue_ok=queue_ok,
+                              accepted=accepted)

@@ -1,37 +1,48 @@
-"""Pool-sharded fused scheduling cycle: rank + match on a device mesh.
+"""Pool-sharded fused scheduling cycle: rank + considerable + match on a
+device mesh.
 
-One jitted step runs EVERY pool's rank (DRU segmented prefix sums + sort) and
-match (greedy bin-pack scan) with pools sharded over the mesh's "pool" axis
-via ``shard_map``; cross-pool facts are reconciled with XLA collectives:
+One jitted step runs EVERY pool's rank (DRU segmented prefix sums + sort),
+considerable-job admission (pool/group quota, per-user quota, launch-rate
+tokens, plugin verdicts, head-of-queue backoff cap — see
+ops/considerable.py) and match (greedy bin-pack scan) with pools sharded
+over the mesh's "pool" axis via ``shard_map``; cross-pool facts are
+reconciled with XLA collectives:
 
- - per-pool matched-resource totals are ``all_gather``'d so quota-group caps
-   spanning pools (reference: scheduler.clj:2125-2157 quota-group
-   aggregation) can be enforced against a globally consistent view;
- - a ``psum`` of per-pool placement counts gives the global cycle telemetry
-   the reference logs per match cycle (scheduler.clj:1210-1280).
+ - per-pool RUNNING usage and quota-group ids are ``all_gather``'d so
+   quota-group caps spanning pools (reference: scheduler.clj:2125-2157
+   quota-group aggregation) are ENFORCED inside the cycle against a
+   globally consistent view — each pool caps its ranked prefix by the
+   group's running total, matching the host path's
+   Ranker._apply_pool_quota;
+ - per-pool matched-resource totals are ``all_gather``'d for the global
+   cycle telemetry the reference logs per match cycle
+   (scheduler.clj:1210-1280), along with a ``psum`` placement count.
 
-The match job axis is aligned with the rank task axis (running-task rows are
-never valid match rows), so the ranked order permutes match inputs entirely
-on device — no host round-trip between rank and match.
+The match job axis is aligned with the rank task axis (running-task rows
+are never admitted), so the ranked order permutes match inputs entirely on
+device — no host round-trip between rank and match.
 
 This module is the scale axis of the framework (SURVEY.md section 5
-"long-context" slot): pools across devices, and within a pool the job/offer
-tensors are bucketed so XLA tiles them onto the VPU/MXU.
+"long-context" slot): pools across devices, and within a pool the
+job/offer tensors are bucketed so XLA tiles them onto the VPU/MXU.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+import numpy as np
 
 from ..ops import dru as dru_ops
 from ..ops import match as match_ops
+from ..ops.considerable import considerable_body
+from ..ops.scan import segmented_cumsum_by_first_idx
 from .mesh import POOL_AXIS
+
+INF = jnp.inf
 
 
 class PoolCycleInputs(NamedTuple):
@@ -39,6 +50,9 @@ class PoolCycleInputs(NamedTuple):
 
     Task/job axes are shared: row t is one task; pending rows double as
     match candidates (job_res/cmask); running rows have pending=False.
+    Admission-side arrays come from the host control plane (see
+    sched/fused.py): plugin verdicts, rate-limit token budgets, the
+    offensive-job filter, backoff caps, and pool/quota-group caps.
     """
 
     # rank side [P, T, ...]
@@ -49,103 +63,207 @@ class PoolCycleInputs(NamedTuple):
     user_rank: jax.Array   # i32[P, T]
     pending: jax.Array     # bool[P, T]
     valid: jax.Array       # bool[P, T]
+    # admission side
+    enqueue_ok: jax.Array        # bool[P, T] False = host-stifled job
+    launch_ok: jax.Array         # bool[P, T] launch-plugin verdicts
+    tokens: jax.Array            # f32[P, T] user launch-rate budget (inf=off)
+    num_considerable: jax.Array  # i32[P] backoff cap on admitted jobs
+    pool_quota: jax.Array        # f32[P, 4] pool cap (inf = uncapped)
+    group_quota: jax.Array       # f32[P, 4] quota-group cap (inf = uncapped)
+    group_id: jax.Array          # i32[P] quota-group id, -1 = none
     # match side
     job_res: jax.Array     # f32[P, T, R]
     cmask: jax.Array       # bool[P, T, H]
     avail: jax.Array       # f32[P, H, R]
     capacity: jax.Array    # f32[P, H, R]
 
+    @classmethod
+    def build(cls, *, usage, quota, shares, first_idx, user_rank, pending,
+              valid, job_res, cmask, avail, capacity, enqueue_ok=None,
+              launch_ok=None, tokens=None, num_considerable=None,
+              pool_quota=None, group_quota=None, group_id=None
+              ) -> "PoolCycleInputs":
+        """Fill permissive defaults for the admission-side arrays (all jobs
+        admitted, no caps) so kernel-level callers and tests can exercise
+        rank+match alone."""
+        P, T = np.shape(pending)[:2]
+        ones = jnp.ones((P, T), dtype=bool)
+        return cls(
+            usage=usage, quota=quota, shares=shares, first_idx=first_idx,
+            user_rank=user_rank, pending=pending, valid=valid,
+            enqueue_ok=ones if enqueue_ok is None else enqueue_ok,
+            launch_ok=ones if launch_ok is None else launch_ok,
+            tokens=(jnp.full((P, T), INF, dtype=jnp.float32)
+                    if tokens is None else tokens),
+            num_considerable=(jnp.full((P,), T, dtype=jnp.int32)
+                              if num_considerable is None
+                              else num_considerable),
+            pool_quota=(jnp.full((P, 4), INF, dtype=jnp.float32)
+                        if pool_quota is None else pool_quota),
+            group_quota=(jnp.full((P, 4), INF, dtype=jnp.float32)
+                         if group_quota is None else group_quota),
+            group_id=(jnp.full((P,), -1, dtype=jnp.int32)
+                      if group_id is None else group_id),
+            job_res=job_res, cmask=cmask, avail=avail, capacity=capacity)
+
 
 class PoolCycleResult(NamedTuple):
     order: jax.Array          # i32[P, T] rank order (pending first)
-    num_ranked: jax.Array     # i32[P]
-    dru: jax.Array            # f32[P, T]
+    num_ranked: jax.Array     # i32[P] rankable pending count
+    dru: jax.Array            # f32[P, T] per-task DRU score (task order)
     assign: jax.Array         # i32[P, T] host or -1, in RANK order
-    matched_usage: jax.Array  # f32[P, 4] resources matched per pool (global view)
+    match_valid: jax.Array    # bool[P, T] admitted for matching (RANK order)
+    queue_ok: jax.Array       # bool[P, T] queue membership (RANK order)
+    accepted: jax.Array       # bool[P, T] admitted pre-cap (RANK order)
+    matched_usage: jax.Array  # f32[P, 4] resources matched per pool (global)
     total_matched: jax.Array  # i32[] global placement count
 
 
-def _rank_one_pool(usage, quota, shares, first_idx, user_rank, pending, valid,
-                   gpu_mode: bool, max_over_quota_jobs: int):
+def _segment_totals(cum: jax.Array, first_idx: jax.Array) -> jax.Array:
+    """Broadcast each contiguous segment's total (the value of the inclusive
+    prefix sum at the segment's last row) back to every row of the segment."""
+    T = first_idx.shape[0]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    is_last = jnp.concatenate(
+        [first_idx[1:] != first_idx[:-1], jnp.ones((1,), dtype=bool)])
+    seg_last = jax.lax.cummin(jnp.where(is_last, pos, T - 1), axis=0,
+                              reverse=True)
+    return cum[seg_last]
+
+
+def _user_running_base(usage, pending, valid, first_idx) -> jax.Array:
+    """f32[T, 4]: each task's user's total RUNNING usage in this pool
+    (the accumulator seed of pending-jobs->considerable-jobs,
+    scheduler.clj:729 / tools.clj:899-915)."""
+    run_usage = usage * (valid & ~pending)[:, None]
+    cum_run = segmented_cumsum_by_first_idx(run_usage, first_idx)
+    return _segment_totals(cum_run, first_idx)
+
+
+def _pool_cycle_one(usage, quota, shares, first_idx, user_rank, pending,
+                    valid, enqueue_ok, launch_ok, tokens, num_considerable,
+                    pool_quota, group_quota, pool_base, group_base,
+                    job_res, cmask, avail, capacity,
+                    gpu_mode: bool, max_over_quota_jobs: int):
+    """One pool's full rank -> considerable -> match, all on device."""
     order, num_ranked, dru, _keep, rankable = dru_ops.rank_body(
         usage, quota, shares, first_idx, user_rank, pending, valid,
         gpu_mode, max_over_quota_jobs)
-    return order, num_ranked, dru, rankable
+    run_base = _user_running_base(usage, pending, valid, first_idx)
 
+    # permute every admission input into rank order
+    cr = considerable_body(
+        usage_r=usage[order], quota_r=quota[order],
+        user_r=user_rank[order], run_base_r=run_base[order],
+        tokens_r=tokens[order], launch_ok_r=launch_ok[order],
+        enqueue_ok_r=enqueue_ok[order], rankable_r=rankable[order],
+        pool_base=pool_base, pool_quota=pool_quota,
+        group_base=group_base, group_quota=group_quota,
+        num_considerable=num_considerable)
 
-def _match_one_pool(job_res, cmask, avail, capacity, valid):
-    assign, _avail = match_ops.greedy_assign(job_res, cmask, valid, avail,
-                                             capacity)
-    return assign
+    sorted_res = jnp.take(job_res, order, axis=0)
+    sorted_mask = jnp.take(cmask, order, axis=0)
+    assign, _avail = match_ops.greedy_assign(
+        sorted_res, sorted_mask, cr.match_valid, avail, capacity)
+    matched = (assign >= 0)
+    matched_usage = jnp.sum(sorted_res * matched[:, None], axis=0)[:4]
+    return (order, num_ranked, dru, assign, cr.match_valid, cr.queue_ok,
+            cr.accepted, matched_usage)
 
 
 def single_pool_cycle(usage, quota, shares, first_idx, user_rank, pending,
                       valid, job_res, cmask, avail, capacity,
-                      gpu_mode: bool = False, max_over_quota_jobs: int = 100):
-    """Single-chip fused rank+match step (the framework's 'forward pass'):
-    DRU-rank all tasks, permute pending jobs into rank order, greedy
-    bin-pack them against the offers. Jittable as-is."""
-    order, num_ranked, dru, rankable = _rank_one_pool(
+                      gpu_mode: bool = False, max_over_quota_jobs: int = 100,
+                      enqueue_ok=None, launch_ok=None, tokens=None,
+                      num_considerable=None, pool_quota=None,
+                      group_quota=None, group_base=None):
+    """Single-chip fused rank+considerable+match step (the framework's
+    'forward pass').  Jittable as-is; admission inputs default to
+    permissive."""
+    T = pending.shape[0]
+    ones = jnp.ones((T,), dtype=bool)
+    enqueue_ok = ones if enqueue_ok is None else enqueue_ok
+    launch_ok = ones if launch_ok is None else launch_ok
+    tokens = (jnp.full((T,), INF, dtype=jnp.float32)
+              if tokens is None else tokens)
+    num_considerable = (jnp.asarray(T, dtype=jnp.int32)
+                        if num_considerable is None else num_considerable)
+    pool_quota = (jnp.full((4,), INF, dtype=jnp.float32)
+                  if pool_quota is None else pool_quota)
+    group_quota = (jnp.full((4,), INF, dtype=jnp.float32)
+                   if group_quota is None else group_quota)
+    pool_base = jnp.sum(usage * (valid & ~pending)[:, None], axis=0)[:4]
+    group_base = pool_base if group_base is None else group_base
+    (order, num_ranked, dru, assign, _mv, _qok, _acc, _mu) = _pool_cycle_one(
         usage, quota, shares, first_idx, user_rank, pending, valid,
+        enqueue_ok, launch_ok, tokens, num_considerable, pool_quota,
+        group_quota, pool_base, group_base, job_res, cmask, avail, capacity,
         gpu_mode, max_over_quota_jobs)
-    sorted_res = jnp.take(job_res, order, axis=0)
-    sorted_mask = jnp.take(cmask, order, axis=0)
-    sorted_ok = jnp.take(rankable, order, axis=0)
-    assign = _match_one_pool(sorted_res, sorted_mask, avail, capacity,
-                             sorted_ok)
     return order, num_ranked, dru, assign
 
 
-def make_pool_cycle(mesh: Mesh, *, gpu_mode: bool = False,
+def make_pool_cycle(mesh, *, gpu_mode: bool = False,
                     max_over_quota_jobs: int = 100):
     """Build the jitted pool-sharded cycle for a mesh."""
-
-    def cycle_body(inp: PoolCycleInputs) -> PoolCycleResult:
-        # local block: leading dim = pools on this device
-        def per_pool(usage, quota, shares, first_idx, user_rank, pending,
-                     valid, job_res, cmask, avail, capacity):
-            order, num_ranked, dru, rankable = _rank_one_pool(
-                usage, quota, shares, first_idx, user_rank, pending, valid,
-                gpu_mode, max_over_quota_jobs)
-            sorted_res = jnp.take(job_res, order, axis=0)
-            sorted_mask = jnp.take(cmask, order, axis=0)
-            sorted_ok = jnp.take(rankable, order, axis=0)
-            assign = _match_one_pool(sorted_res, sorted_mask, avail,
-                                     capacity, sorted_ok)
-            matched = (assign >= 0)
-            matched_usage = jnp.sum(
-                sorted_res * matched[:, None], axis=0)[:4]
-            return order, num_ranked, dru, assign, matched_usage
-
-        order, num_ranked, dru, assign, matched_usage = jax.vmap(per_pool)(
-            inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
-            inp.pending, inp.valid, inp.job_res, inp.cmask, inp.avail,
-            inp.capacity)
-        # Reconciliation: every device sees every pool's matched usage
-        # (quota groups span pools) and the global placement count. On a
-        # 1-D mesh this rides ICI; on a ("dcn", "pool") multi-slice mesh
-        # the gather spans both axes — the ONLY cross-slice traffic, sized
-        # [pools, 4] + a scalar, which is what belongs on DCN.
-        matched_usage_global = matched_usage
-        for axis in reversed(axes):
-            matched_usage_global = jax.lax.all_gather(
-                matched_usage_global, axis, axis=0, tiled=True)
-        total = jax.lax.psum(jnp.sum((assign >= 0).astype(jnp.int32)),
-                             axes)
-        return PoolCycleResult(order=order, num_ranked=num_ranked, dru=dru,
-                               assign=assign,
-                               matched_usage=matched_usage_global,
-                               total_matched=total)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
 
     # pools shard over every mesh axis: ("pool",) single-slice, or
     # ("dcn", "pool") with slice-independent pool blocks
     axes = tuple(mesh.axis_names)
     spec = P(axes)
+
+    def cycle_body(inp: PoolCycleInputs) -> PoolCycleResult:
+        # Pass 1 (cheap, vmapped): per-pool RUNNING usage for pool quota and
+        # for the quota-group all_gather.
+        pool_base = jax.vmap(
+            lambda u, p, v: jnp.sum(u * (v & ~p)[:, None], axis=0)[:4]
+        )(inp.usage, inp.pending, inp.valid)
+
+        # Reconciliation collective #1: running usage + group ids of every
+        # pool, so each pool can enforce its quota-group's cap against the
+        # global running total (reference: scheduler.clj:2125-2157). On a
+        # 1-D mesh this rides ICI; on ("dcn", "pool") it is the only
+        # cross-slice traffic, sized [pools, 4] + [pools].
+        base_all, gid_all = pool_base, inp.group_id
+        for axis in reversed(axes):
+            base_all = jax.lax.all_gather(base_all, axis, axis=0, tiled=True)
+            gid_all = jax.lax.all_gather(gid_all, axis, axis=0, tiled=True)
+        group_base = jax.vmap(
+            lambda gid: jnp.sum(
+                base_all * ((gid_all == gid) & (gid >= 0))[:, None], axis=0)
+        )(inp.group_id)
+
+        # Pass 2: the full fused cycle per local pool.
+        per_pool = functools.partial(_pool_cycle_one, gpu_mode=gpu_mode,
+                                     max_over_quota_jobs=max_over_quota_jobs)
+        (order, num_ranked, dru, assign, match_valid, queue_ok, accepted,
+         matched_usage) = jax.vmap(per_pool)(
+            inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
+            inp.pending, inp.valid, inp.enqueue_ok, inp.launch_ok,
+            inp.tokens, inp.num_considerable, inp.pool_quota,
+            inp.group_quota, pool_base, group_base, inp.job_res, inp.cmask,
+            inp.avail, inp.capacity)
+
+        # Reconciliation collective #2: global matched usage + placement
+        # count (cycle telemetry, scheduler.clj:1210-1280).
+        matched_usage_global = matched_usage
+        for axis in reversed(axes):
+            matched_usage_global = jax.lax.all_gather(
+                matched_usage_global, axis, axis=0, tiled=True)
+        total = jax.lax.psum(jnp.sum((assign >= 0).astype(jnp.int32)), axes)
+        return PoolCycleResult(order=order, num_ranked=num_ranked, dru=dru,
+                               assign=assign, match_valid=match_valid,
+                               queue_ok=queue_ok, accepted=accepted,
+                               matched_usage=matched_usage_global,
+                               total_matched=total)
+
     sharded = shard_map(
         cycle_body, mesh=mesh,
         in_specs=(PoolCycleInputs(*(spec,) * len(PoolCycleInputs._fields)),),
         out_specs=PoolCycleResult(
             order=spec, num_ranked=spec, dru=spec, assign=spec,
+            match_valid=spec, queue_ok=spec, accepted=spec,
             matched_usage=P(), total_matched=P()),
         check_vma=False)
     return jax.jit(sharded)

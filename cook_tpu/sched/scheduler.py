@@ -75,6 +75,8 @@ class Scheduler:
         self.reserved_hosts: Dict[str, str] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # fused production cycle driver, created lazily on first step_cycle
+        self._fused = None
         # Side-effect worker: cluster kills requested from a thread that
         # already holds that cluster's kill-lock read side (e.g. a tx-event
         # delivered during a launch) must run elsewhere or they self-deadlock.
@@ -196,6 +198,13 @@ class Scheduler:
         if not offensive:
             return ranked
         offensive_uuids = {j.uuid for j in offensive}
+        self._stifle_offensive(offensive)
+        return [j for j in ranked if j.uuid not in offensive_uuids]
+
+    def _stifle_offensive(self, offensive: List[Job]) -> None:
+        """Abort offensive jobs off-cycle (the stifler thread)."""
+        if not offensive:
+            return
 
         def stifle():
             for job in offensive:
@@ -205,7 +214,49 @@ class Scheduler:
                     pass
         threading.Thread(target=stifle, daemon=True,
                          name="offensive-job-stifler").start()
-        return [j for j in ranked if j.uuid not in offensive_uuids]
+
+    def step_cycle(self) -> Dict[str, MatchCycleResult]:
+        """PRODUCTION cycle: rank + admission + match for every active
+        non-direct pool in ONE fused device dispatch
+        (sched/fused.FusedCycleDriver over parallel/sharded.make_pool_cycle),
+        then the transactional launch path on host.  Direct (Kenzo) pools
+        keep the host path (there is no match kernel to fuse).
+
+        Replaces the reference's per-pool handler round-robin
+        (scheduler.clj:2398-2517) with a single dispatch; step_rank/
+        step_match remain for the CPU fallback and deterministic tests.
+        """
+        if self._fused is None:
+            from .fused import FusedCycleDriver
+            self._fused = FusedCycleDriver(
+                self.store, self.config, self.matcher, self.plugins,
+                self.rate_limits)
+        with tracing.span("fused.cycle"):
+            queues, results = self._fused.step(self)
+        # direct pools: host rank + backpressure submission
+        for pool in self.store.pools():
+            if pool.state != "active" or pool.scheduler is not SchedulerKind.DIRECT:
+                continue
+            ranked = self._filter_offensive_jobs(
+                self.ranker.rank_pool(pool.name, pool.dru_mode))
+            queues[pool.name] = ranked
+            results[pool.name] = self._match_direct(pool.name, ranked)
+        # queues were computed pre-launch; prune the jobs this cycle launched
+        # so consumers (rebalancer, /queue, direct pools) see current state
+        launched_uuids = set()
+        for result in results.values():
+            for tid in result.launched_task_ids:
+                inst = self.store.instance(tid)
+                if inst is not None:
+                    launched_uuids.add(inst.job_uuid)
+        if launched_uuids:
+            queues = {p: [j for j in q if j.uuid not in launched_uuids]
+                      for p, q in queues.items()}
+        self.pending_queues = queues
+        for pool_name, result in results.items():
+            self._autoscale(pool_name, result)
+        self.last_match_results.update(results)
+        return results
 
     def step_match(self, pool_name: Optional[str] = None
                    ) -> Dict[str, MatchCycleResult]:
@@ -435,9 +486,13 @@ class Scheduler:
                     import logging
                     logging.getLogger(__name__).exception("cycle failed")
 
-        specs = [
-            (cfg.rank_interval_seconds, self.step_rank),
-            (cfg.match_interval_seconds, self.step_match),
+        if cfg.cycle_mode == "fused" and self.ranker.backend != "cpu":
+            # production path: one fused rank+match dispatch per cycle
+            specs = [(cfg.match_interval_seconds, self.step_cycle)]
+        else:
+            specs = [(cfg.rank_interval_seconds, self.step_rank),
+                     (cfg.match_interval_seconds, self.step_match)]
+        specs += [
             (cfg.rebalancer.interval_seconds, self.step_rebalance),
             (cfg.lingering_task_interval_seconds, self.step_reapers),
             (cfg.monitor_interval_seconds, self.monitor.sweep),

@@ -99,7 +99,8 @@ class Simulator:
     def __init__(self, trace: List[Job], hosts: List[FakeHost],
                  config: Optional[Config] = None, backend: str = "tpu",
                  rank_interval_ms: int = 5000, match_interval_ms: int = 1000,
-                 rebalance_interval_ms: int = 30000):
+                 rebalance_interval_ms: int = 30000,
+                 cycle_mode: Optional[str] = None):
         self.trace = trace
         self.config = config or Config()
         if backend == "cpu":
@@ -111,6 +112,13 @@ class Simulator:
         self.rank_interval_ms = rank_interval_ms
         self.match_interval_ms = match_interval_ms
         self.rebalance_interval_ms = rebalance_interval_ms
+        # "fused": drive the production one-dispatch cycle
+        # (Scheduler.step_cycle) instead of split rank/match steps.
+        # Default follows Config.cycle_mode, except the no-JAX cpu backend
+        # which only has the split path.
+        if cycle_mode is None:
+            cycle_mode = "split" if backend == "cpu" else self.config.cycle_mode
+        self.cycle_mode = cycle_mode
         # job uuid -> virtual duration; the fake cluster resolves durations
         # at launch time through this shared mapping
         self._job_durations: Dict[str, int] = {}
@@ -142,20 +150,26 @@ class Simulator:
                     job.labels["sim/duration_ms"])
                 self.store.create_jobs([job])
             # cycles (virtual-time frozen during computation)
-            if now >= next_rank:
+            if now >= next_rank and self.cycle_mode != "fused":
                 t0 = time.perf_counter()
                 self.scheduler.step_rank()
                 result.rank_wall_ms.append((time.perf_counter() - t0) * 1000)
                 next_rank = now + self.rank_interval_ms
             if now >= next_match:
                 t0 = time.perf_counter()
-                match_results = self.scheduler.step_match()
+                if self.cycle_mode == "fused":
+                    match_results = self.scheduler.step_cycle()
+                else:
+                    match_results = self.scheduler.step_match()
                 result.match_wall_ms.append((time.perf_counter() - t0) * 1000)
                 for res in match_results.values():
                     result.placements += len(res.launched_task_ids)
                 next_match = now + self.match_interval_ms
             if now >= next_rebalance:
-                self.scheduler.step_rank()
+                # split mode re-ranks so the rebalancer sees post-launch
+                # queues; the fused cycle already pruned launched jobs
+                if self.cycle_mode != "fused":
+                    self.scheduler.step_rank()
                 decisions = self.scheduler.step_rebalance()
                 for pool_decisions in decisions.values():
                     for d in pool_decisions:

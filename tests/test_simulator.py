@@ -43,19 +43,24 @@ class TestSimulator:
             e["uuid"] = f"job-{i:04d}"
         host_entries = generate_example_hosts(n_hosts=8, seed=3)
         placements = {}
-        for backend in ("cpu", "tpu"):
+        for backend, cycle_mode in (
+                ("cpu", "split"), ("tpu", "split"), ("tpu", "fused")):
+            # identical rank/match cadence across modes: the fused cycle
+            # re-ranks every dispatch, so give split mode the same cadence
             sim = Simulator(load_trace(trace_entries),
-                            load_hosts(host_entries), backend=backend)
+                            load_hosts(host_entries), backend=backend,
+                            cycle_mode=cycle_mode, rank_interval_ms=1000)
             result = sim.run()
             assert result.completed == 80
-            placements[backend] = {
-                r["task"]: r["host"] for r in result.task_records}
+            key = f"{backend}/{cycle_mode}"
             # compare (job -> ordered host list) instead of task ids
-            placements[backend + "_by_job"] = sorted(
+            placements[key + "_by_job"] = sorted(
                 (r["job"], r["host"], r["status"])
                 for r in result.task_records)
-        # full decision parity: same job -> host assignments on both backends
-        assert placements["cpu_by_job"] == placements["tpu_by_job"]
+        # full decision parity: same job -> host assignments across the CPU
+        # fallback, the split kernel path, and the fused production cycle
+        assert placements["cpu/split_by_job"] == placements["tpu/split_by_job"]
+        assert placements["cpu/split_by_job"] == placements["tpu/fused_by_job"]
 
     def test_cli_entry(self, tmp_path, capsys):
         from cook_tpu.sim.__main__ import main
