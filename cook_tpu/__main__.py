@@ -1,0 +1,9 @@
+"""``python -m cook_tpu --config cook.json`` — the node entry point
+(reference: scheduler/src/cook/components.clj:345-365 -main)."""
+
+import sys
+
+from .daemon import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,3 +193,17 @@ class FakeCluster(ComputeCluster):
             self._status_callback(task_id, status, reason_code,
                                   exit_code=exit_code, preempted=preempted,
                                   hostname=hostname)
+
+
+def factory(store=None, name: str = "fake", n_hosts: int = 4,
+            cpus: float = 8.0, mem: float = 8192.0, gpus: float = 0.0,
+            pool: str = "default", attributes=None,
+            default_task_duration_ms=None) -> "FakeCluster":
+    """Config-driven construction for the daemon (the analog of the
+    reference's compute-cluster factory-fn, compute_cluster.clj:483-497)."""
+    hosts = [FakeHost(hostname=f"{name}-h{i}", pool=pool,
+                      capacity=Resources(cpus=cpus, mem=mem, gpus=gpus),
+                      attributes=dict(attributes or {}))
+             for i in range(n_hosts)]
+    return FakeCluster(name, hosts,
+                       default_task_duration_ms=default_task_duration_ms)

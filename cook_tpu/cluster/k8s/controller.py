@@ -5,13 +5,25 @@ scheduler/src/cook/kubernetes/controller.clj:482-711): reconciles the cross
 product of
 
   cook-expected-state in {STARTING, RUNNING, COMPLETED, KILLED, MISSING}
-  pod-synthesized-state in {WAITING, RUNNING, SUCCEEDED, FAILED, UNKNOWN, MISSING}
+  pod-synthesized-state in {WAITING, RUNNING, SUCCEEDED, FAILED, UNKNOWN,
+                            DELETING, MISSING}
 
-preserving the reference's invariants:
+— the reference's "30-state table" plus its DELETING arms — preserving the
+reference's invariants:
   * store writeback happens FIRST, then kubernetes actions (restart safety);
-  * pods are deleted from kubernetes only in terminal pod states;
+  * pods are deleted from kubernetes only in terminal pod states
+    (UNKNOWN counts as terminal, forced retry at the cook level);
   * a live pod in an unexpected ("weird") state is killed by deleting it and
     the failure is marked mea-culpa;
+  * (RUNNING, WAITING) — a pod regressing to waiting means the node
+    preempted/moved it (GKE preemptible semantics): kill the pod AND write
+    a mea-culpa preemption so the retry is free (controller.clj
+    handle-pod-preemption);
+  * (KILLED, MISSING) — the kill-races-the-watch case: opportunistically
+    kill using the launch-time pod object saved in the expected-state entry
+    (controller.clj :launch-pod);
+  * (MISSING, DELETING) with an old deletion timestamp — escalate to a
+    grace-0 hard kill (controller.clj kill-pod-hard);
   * per-pod processing is serialized through sharded locks
     (controller.clj:22-51 — here the sharded ordered executor).
 """
@@ -20,10 +32,10 @@ from __future__ import annotations
 
 import enum
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
-from ...state.schema import InstanceStatus, Reasons
+from ...state.schema import Reasons
 from .fake_api import FakePod
 
 
@@ -41,11 +53,15 @@ class PodState(enum.Enum):
     SUCCEEDED = "succeeded"
     FAILED = "failed"
     UNKNOWN = "unknown"
+    DELETING = "deleting"
     MISSING = "missing"
 
 
 TERMINAL_POD_STATES = (PodState.SUCCEEDED, PodState.FAILED,
                        PodState.UNKNOWN, PodState.MISSING)
+
+# how long a DELETING pod may linger before the hard kill
+OLD_DELETION_MS = 60_000
 
 
 def synthesize_pod_state(pod: Optional[FakePod]) -> PodState:
@@ -53,6 +69,8 @@ def synthesize_pod_state(pod: Optional[FakePod]) -> PodState:
     pod->synthesized-pod-state kubernetes/api.clj:1916)."""
     if pod is None:
         return PodState.MISSING
+    if pod.deleted and pod.phase in ("Pending", "Running"):
+        return PodState.DELETING
     if pod.phase == "Pending":
         return PodState.WAITING
     if pod.phase == "Running":
@@ -69,6 +87,10 @@ class ExpectedStateEntry:
     state: CookExpected
     # why a kill happened / weird-state provenance, for passport/debug
     reason: str = ""
+    # the pod object we asked kubernetes to create, kept so a kill that
+    # races ahead of the watch can still name its target
+    # (reference: :launch-pod in the cook-expected-state dict)
+    launch_pod: Optional[FakePod] = None
 
 
 class PodController:
@@ -82,7 +104,9 @@ class PodController:
                  on_pod_started: Callable[[str], None],
                  on_pod_completed: Callable[[str, Optional[int], Optional[int]], None],
                  on_pod_killed: Callable[[str, int], None],
+                 on_pod_preempted: Optional[Callable[[str], None]] = None,
                  managed_filter: Optional[Callable] = None,
+                 clock: Callable[[], int] = lambda: 0,
                  logger=None):
         self.api = api
         self.managed_filter = managed_filter or (lambda pod: True)
@@ -91,6 +115,10 @@ class PodController:
         self.on_pod_started = on_pod_started
         self.on_pod_completed = on_pod_completed
         self.on_pod_killed = on_pod_killed
+        self.on_pod_preempted = on_pod_preempted or (
+            lambda pod_name: on_pod_killed(
+                pod_name, Reasons.PREEMPTED_BY_POOL.code))
+        self.clock = clock
         import logging
         self.log = logger or logging.getLogger(__name__)
 
@@ -98,7 +126,8 @@ class PodController:
     def launch_pod(self, pod: FakePod) -> bool:
         """Expected -> STARTING and create in kubernetes."""
         with self._lock:
-            self.expected[pod.name] = ExpectedStateEntry(CookExpected.STARTING)
+            self.expected[pod.name] = ExpectedStateEntry(
+                CookExpected.STARTING, launch_pod=pod)
             try:
                 self.api.create_pod(pod)
                 return True
@@ -116,7 +145,8 @@ class PodController:
                                                 CookExpected.MISSING):
                 return
             self.expected[pod_name] = ExpectedStateEntry(
-                CookExpected.KILLED, reason)
+                CookExpected.KILLED, reason,
+                launch_pod=entry.launch_pod)
         self.process(pod_name)
 
     def set_expected(self, pod_name: str, state: CookExpected) -> None:
@@ -159,11 +189,13 @@ class PodController:
                         return
                 elif new_expected is not expected:
                     self.expected[pod_name] = ExpectedStateEntry(
-                        new_expected, entry.reason if entry else "")
+                        new_expected, entry.reason if entry else "",
+                        launch_pod=entry.launch_pod if entry else None)
                 else:
                     return  # stable
 
-    # The 30-state table. Returns the new expected state (None = forget).
+    # The full transition table. Returns the new expected state
+    # (None = forget the entry).
     def _step(self, pod_name: str, expected: CookExpected, actual: PodState,
               pod: Optional[FakePod], entry: Optional[ExpectedStateEntry]
               ) -> Optional[CookExpected]:
@@ -179,10 +211,20 @@ class PodController:
                 self.on_pod_started(pod_name)  # never observed running
                 self.on_pod_completed(pod_name, pod.exit_code, None)
                 return E.COMPLETED
-            if actual in (A.FAILED, A.UNKNOWN):
+            if actual is A.FAILED:
                 self.on_pod_completed(
-                    pod_name, pod.exit_code if pod else None,
-                    self._failure_reason(pod))
+                    pod_name, pod.exit_code, self._failure_reason(pod))
+                return E.COMPLETED
+            if actual is A.UNKNOWN:
+                # terminal-as-far-as-we're-concerned + kill the weird pod;
+                # mea-culpa so the retry is free
+                self.on_pod_completed(pod_name, pod.exit_code if pod else None,
+                                      Reasons.UNKNOWN_MEA_CULPA.code)
+                self._kill_weird(pod_name, "unknown pod phase while starting")
+                return E.COMPLETED
+            if actual is A.DELETING:
+                # deleted before it ever ran: something external killed it
+                self.on_pod_killed(pod_name, Reasons.NODE_LOST.code)
                 return E.COMPLETED
 
         elif expected is E.RUNNING:
@@ -191,17 +233,26 @@ class PodController:
             if actual is A.SUCCEEDED:
                 self.on_pod_completed(pod_name, pod.exit_code, None)
                 return E.COMPLETED
-            if actual in (A.FAILED, A.UNKNOWN):
+            if actual is A.FAILED:
                 self.on_pod_completed(
-                    pod_name, pod.exit_code if pod else None,
-                    self._failure_reason(pod))
+                    pod_name, pod.exit_code, self._failure_reason(pod))
+                return E.COMPLETED
+            if actual is A.UNKNOWN:
+                self.on_pod_completed(pod_name, pod.exit_code if pod else None,
+                                      Reasons.UNKNOWN_MEA_CULPA.code)
+                self._kill_weird(pod_name, "unknown pod phase while running")
                 return E.COMPLETED
             if actual is A.WAITING:
-                # a running pod regressing to waiting is a weird state:
-                # kill it; the failure is the cluster's fault (mea culpa)
-                self._kill_weird(pod_name, "pod regressed to waiting")
-                return E.RUNNING
-            if actual is A.MISSING:
+                # a running pod regressing to waiting means the node
+                # preempted/moved it (GKE preemptible): kill the pod and
+                # write a mea-culpa PREEMPTION so the retry is free
+                # (reference: handle-pod-preemption, controller.clj)
+                self.log.info("pod %s regressed running->waiting: preempted",
+                              pod_name)
+                self.api.delete_pod(pod_name)
+                self.on_pod_preempted(pod_name)
+                return E.COMPLETED
+            if actual in (A.MISSING, A.DELETING):
                 # pod vanished under us (node reclaim, external delete)
                 self.on_pod_killed(pod_name, Reasons.NODE_LOST.code)
                 return E.COMPLETED
@@ -212,30 +263,50 @@ class PodController:
                 self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
                 self.api.delete_pod(pod_name)
                 return E.COMPLETED
-            if actual in (A.SUCCEEDED,):
+            if actual is A.SUCCEEDED:
                 # it finished before the kill landed
                 self.on_pod_completed(pod_name, pod.exit_code, None)
                 self.api.delete_pod(pod_name)
                 return E.COMPLETED
-            if actual in (A.FAILED, A.UNKNOWN):
+            if actual is A.FAILED:
                 self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
                 self.api.delete_pod(pod_name)
                 return E.COMPLETED
+            if actual is A.UNKNOWN:
+                self.on_pod_completed(pod_name, pod.exit_code if pod else None,
+                                      Reasons.UNKNOWN_MEA_CULPA.code)
+                self._kill_weird(pod_name, "unknown pod phase while killed")
+                return E.COMPLETED
+            if actual is A.DELETING:
+                # expected step of the deletion path
+                self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
+                return E.COMPLETED
             if actual is A.MISSING:
-                # kill-before-watch race: the pod never materialized
-                # (reference: explicit (killed, missing) state,
-                # controller.clj:572-598)
+                # kill raced ahead of the watch: the pod may exist even
+                # though our watch state says missing — opportunistically
+                # kill the launch-time pod object (controller.clj
+                # :launch-pod) so it cannot leak, then write back
+                if entry is not None and entry.launch_pod is not None:
+                    self.log.info(
+                        "opportunistic kill of %s (kill raced the watch)",
+                        pod_name)
+                    self.api.delete_pod(pod_name)
                 self.on_pod_killed(pod_name, Reasons.KILLED_BY_USER.code)
                 return E.COMPLETED
 
         elif expected is E.COMPLETED:
-            if actual in (A.SUCCEEDED, A.FAILED, A.UNKNOWN):
+            if actual in (A.SUCCEEDED, A.FAILED):
                 self.api.delete_pod(pod_name)  # writeback already happened
+                return E.COMPLETED if self.api.pod(pod_name) else None
+            if actual is A.UNKNOWN:
+                self._kill_weird(pod_name, "unknown pod phase after complete")
                 return E.COMPLETED if self.api.pod(pod_name) else None
             if actual in (A.RUNNING, A.WAITING):
                 # who resurrected this pod? two leaders? kill it
                 self._kill_weird(pod_name, "live pod for completed instance")
                 return E.COMPLETED
+            if actual is A.DELETING:
+                return None  # deletion in progress; nothing left to do
             if actual is A.MISSING:
                 return None  # final state: forget
 
@@ -243,10 +314,20 @@ class PodController:
             # only reached for cook-managed pods (the watch layer filters
             # foreign and synthetic pods before the controller sees them)
             if actual in (A.SUCCEEDED, A.FAILED, A.UNKNOWN):
-                self.api.delete_pod(pod_name)
+                self._kill_weird(pod_name, "terminal pod with no record")
                 return None
             if actual in (A.RUNNING, A.WAITING):
                 self._kill_weird(pod_name, "untracked live cook pod")
+                return None
+            if actual is A.DELETING:
+                # stuck deletion: past the deadline, escalate to a grace-0
+                # hard kill (reference: kill-pod-hard for old deletion
+                # timestamps)
+                if pod is not None and pod.deletion_ms is not None and \
+                        self.clock() - pod.deletion_ms > OLD_DELETION_MS:
+                    self.log.warning("hard-killing pod %s stuck deleting",
+                                     pod_name)
+                    self.api.delete_pod(pod_name, grace_period_s=0)
                 return None
             return None
 

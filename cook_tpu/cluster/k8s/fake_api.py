@@ -39,10 +39,20 @@ class FakePod:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     deleted: bool = False                  # deletion timestamp set
+    deletion_ms: Optional[int] = None      # when the delete was requested
+    creation_ms: int = 0
     exit_code: Optional[int] = None
     reason: str = ""
+    # scheduling condition message, e.g. "Unschedulable: taint mismatch"
+    # (the PodScheduled=False condition the stuck-pod detector reads,
+    # reference: kubernetes/api.clj:1820-1846)
+    unschedulable_reason: str = ""
     synthetic: bool = False                # autoscaling placeholder
     resource_version: int = 0
+    # rich pod spec compiled from the job (containers/volumes/env/
+    # tolerations/priority...; reference: task-metadata->pod
+    # kubernetes/api.clj:1370-1813)
+    spec: Dict[str, object] = field(default_factory=dict)
 
 
 class WatchEvent:
@@ -67,6 +77,9 @@ class FakeKubernetesApi:
         self._watchers: List[Callable[[WatchEvent], None]] = []
         # simulation: pods auto-advance on step()
         self.auto_schedule = True
+        # when True, graceful deletes linger in DELETING until
+        # finish_deletion (exercises the controller's deleting arms)
+        self.sticky_deletion = False
 
     # ------------------------------------------------------------- plumbing
     def _emit(self, kind: str, type_: str, obj) -> None:
@@ -122,14 +135,25 @@ class FakeKubernetesApi:
             self._pods[pod.name] = pod
             self._emit("pod", "ADDED", pod)
 
-    def delete_pod(self, name: str) -> None:
+    def delete_pod(self, name: str, grace_period_s: Optional[float] = None,
+                   now_ms: int = 0) -> None:
         """Graceful delete: marks deletion; the object disappears on the next
-        lifecycle step (watch sees MODIFIED then DELETED)."""
+        lifecycle step (watch sees MODIFIED then DELETED).
+        ``grace_period_s=0`` is the hard kill the controller issues for pods
+        stuck DELETING past their deadline (controller.clj kill-pod-hard)."""
         with self._lock:
             pod = self._pods.get(name)
             if pod is None:
                 return
             pod.deleted = True
+            if pod.deletion_ms is None:
+                pod.deletion_ms = now_ms
+            if self.sticky_deletion and grace_period_s != 0:
+                # simulate a slow kubelet: the pod lingers with its
+                # deletionTimestamp set (synthesized state DELETING) until
+                # finish_deletion or a grace-0 hard kill
+                self._emit("pod", "MODIFIED", pod)
+                return
             if pod.phase not in ("Succeeded", "Failed"):
                 # killing a live pod fails it first
                 pod.phase = "Failed"
@@ -139,6 +163,21 @@ class FakeKubernetesApi:
             # pop so only one caller emits the DELETED event
             if self._pods.pop(name, None) is not None:
                 self._emit("pod", "DELETED", pod)
+
+    def finish_deletion(self, name: str) -> None:
+        """Simulation hook: the kubelet finally releases a DELETING pod."""
+        with self._lock:
+            pod = self._pods.pop(name, None)
+            if pod is not None:
+                self._emit("pod", "DELETED", pod)
+
+    def mark_unschedulable(self, name: str, reason: str) -> None:
+        """Simulation hook: kube-scheduler reports PodScheduled=False."""
+        with self._lock:
+            pod = self._pods.get(name)
+            if pod is not None:
+                pod.unschedulable_reason = reason
+                self._emit("pod", "MODIFIED", pod)
 
     def pods(self) -> List[FakePod]:
         with self._lock:

@@ -1,0 +1,160 @@
+"""Pod-spec compiler: Job -> rich pod specification.
+
+The analog of the reference's ``task-metadata->pod`` (reference:
+scheduler/src/cook/kubernetes/api.clj:1370-1813) and its checkpointing
+injection (api.clj:1173-1267): the job's container image/volumes, env,
+checkpoint volumes + env + init container (with incremental-config-driven
+image selection), tolerations, priority class, GPU/disk node selectors, and
+the shm volume are compiled into a plain dict carried on the pod object.
+
+The dict IS the contract: the fake API stores it verbatim; a real client
+adapter translates it to V1Pod fields 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ...state.schema import Checkpoint, Job
+
+# well-known labels (shared with sched/constraints.py)
+GPU_MODEL_LABEL = "gpu-model"
+DISK_TYPE_LABEL = "disk-type"
+
+COOK_WORKDIR = "/mnt/sandbox"
+CHECKPOINT_VOLUME = "cook-checkpoint"
+CHECKPOINT_MOUNT = "/mnt/checkpoint"
+DEFAULT_CHECKPOINT_INIT_IMAGE = "cook/checkpoint-init:stable"
+DEFAULT_SIDECAR_IMAGE = "cook/sidecar:stable"
+DEFAULT_SHM_MB = 64
+
+
+def build_pod_spec(job: Job, pool: str,
+                   incremental: Optional[Any] = None,
+                   sidecar: bool = True) -> Dict[str, Any]:
+    """Compile one job's pod specification.
+
+    ``incremental`` is a policy.incremental.IncrementalConfig used for
+    gradual image rollouts (the reference resolves the checkpoint init
+    image per job-uuid hash, api.clj:1226 + config_incremental.clj).
+    """
+    container = job.container or {}
+    image = container.get("image", "cook/default-runtime:stable")
+
+    env = [{"name": "COOK_JOB_UUID", "value": job.uuid},
+           {"name": "COOK_JOB_USER", "value": job.user},
+           {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
+           {"name": "COOK_POOL", "value": pool}]
+    env.extend({"name": k, "value": v} for k, v in sorted(job.env.items()))
+
+    volumes = [{"name": "cook-workdir", "empty_dir": {}}]
+    mounts = [{"name": "cook-workdir", "mount_path": COOK_WORKDIR}]
+    for vol in container.get("volumes", []):
+        # user volumes: {"host-path": ..., "container-path": ..., "mode": ...}
+        name = f"uservol-{len(volumes)}"
+        volumes.append({"name": name,
+                        "host_path": vol.get("host-path", "")})
+        mounts.append({"name": name,
+                       "mount_path": vol.get("container-path",
+                                             vol.get("host-path", "")),
+                       "read_only": vol.get("mode", "RW") == "RO"})
+
+    # shm volume (api.clj shm handling): jobs can ask for a bigger /dev/shm
+    shm_mb = int(job.labels.get("shm-size-mb", 0) or 0)
+    if shm_mb:
+        volumes.append({"name": "shm",
+                        "empty_dir": {"medium": "Memory",
+                                      "size_limit_mb": shm_mb}})
+        mounts.append({"name": "shm", "mount_path": "/dev/shm"})
+
+    init_containers = []
+    tolerations = [
+        # cook nodes are tainted so only cook pods land on them
+        {"key": "cook-pool", "operator": "Equal", "value": pool,
+         "effect": "NoSchedule"},
+    ]
+    node_selector: Dict[str, str] = {}
+
+    # GPU jobs: node selector on gpu model + toleration
+    if job.resources.gpus > 0:
+        model = job.labels.get(GPU_MODEL_LABEL)
+        if model:
+            node_selector[GPU_MODEL_LABEL] = model
+        tolerations.append({"key": "nvidia.com/gpu", "operator": "Exists",
+                            "effect": "NoSchedule"})
+    disk_type = job.labels.get(DISK_TYPE_LABEL)
+    if disk_type:
+        node_selector[DISK_TYPE_LABEL] = disk_type
+
+    # checkpointing (api.clj:1173-1267): volume + env + init container whose
+    # image can roll out gradually via incremental config
+    checkpoint: Optional[Checkpoint] = job.checkpoint
+    if checkpoint is not None:
+        volumes.append({"name": CHECKPOINT_VOLUME, "empty_dir": {}})
+        mounts.append({"name": CHECKPOINT_VOLUME,
+                       "mount_path": CHECKPOINT_MOUNT})
+        env.append({"name": "COOK_CHECKPOINT_MODE",
+                    "value": checkpoint.mode.value})
+        env.append({"name": "COOK_CHECKPOINT_PATH",
+                    "value": CHECKPOINT_MOUNT})
+        if checkpoint.period_sec:
+            env.append({"name": "COOK_CHECKPOINT_PERIOD_SEC",
+                        "value": str(checkpoint.period_sec)})
+        init_image = DEFAULT_CHECKPOINT_INIT_IMAGE
+        if incremental is not None:
+            resolved = incremental.resolve("checkpoint-init-image", job.uuid)
+            if resolved:
+                init_image = resolved
+        init_containers.append({
+            "name": "checkpoint-init",
+            "image": init_image,
+            "volume_mounts": [{"name": CHECKPOINT_VOLUME,
+                               "mount_path": CHECKPOINT_MOUNT}],
+            "env": [{"name": "COOK_JOB_UUID", "value": job.uuid}],
+        })
+        for extra in checkpoint.volume_mounts:
+            mounts.append({"name": CHECKPOINT_VOLUME, "mount_path": extra,
+                           "sub_path": extra.strip("/")})
+
+    containers = [{
+        "name": "cook-job",
+        "image": image,
+        "command": ["/bin/sh", "-c", job.command],
+        "env": env,
+        "volume_mounts": mounts,
+        "resources": {
+            "requests": {"cpu": job.resources.cpus,
+                         "memory_mb": job.resources.mem,
+                         "gpu": job.resources.gpus},
+            "limits": {"memory_mb": job.resources.mem,
+                       "gpu": job.resources.gpus},
+        },
+        "working_dir": COOK_WORKDIR,
+    }]
+    if sidecar:
+        # progress tracker + file server (the reference's sidecar container,
+        # api.clj sidecar handling; our agent/file_server.py is the server)
+        containers.append({
+            "name": "cook-sidecar",
+            "image": DEFAULT_SIDECAR_IMAGE,
+            "env": [{"name": "COOK_JOB_UUID", "value": job.uuid},
+                    {"name": "COOK_WORKDIR", "value": COOK_WORKDIR}],
+            "volume_mounts": [{"name": "cook-workdir",
+                               "mount_path": COOK_WORKDIR}],
+        })
+
+    # priority class from the pool (synthetic pods ride a lower class so
+    # real pods preempt them; api.clj priority-class handling)
+    priority_class = job.labels.get("priority-class",
+                                    f"cook-pool-{pool}")
+
+    return {
+        "containers": containers,
+        "init_containers": init_containers,
+        "volumes": volumes,
+        "tolerations": tolerations,
+        "node_selector": node_selector,
+        "priority_class": priority_class,
+        "restart_policy": "Never",
+        "labels": dict(job.labels),
+    }

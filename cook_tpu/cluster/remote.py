@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+from collections import OrderedDict
 import subprocess
 import threading
 from pathlib import Path
@@ -147,20 +148,34 @@ class AgentConnection:
             return self._lib.ctd_reconcile(self._handle) == 0
 
     def poll(self, timeout_ms: int = 100) -> Optional[List[str]]:
-        """Next event's fields; None on timeout; raises on closed."""
+        """Next event's fields; None on timeout; raises on closed.
+
+        Only the pump thread calls poll, and close() is only invoked from
+        the pump thread itself or after its join (see
+        RemoteComputeCluster.shutdown), so the blocking C call needs no
+        lock.  rc -2 = event larger than the buffer: grow and retry (the
+        event stays queued agent-side) instead of misreading a big frame
+        as connection loss and NODE_LOSTing every task."""
         if not self._handle:
             raise ConnectionError("closed")
-        n = self._lib.ctd_poll(self._handle, self._buf, _BUF_CAP, timeout_ms)
-        if n == 0:
-            return None
-        if n < 0:
-            raise ConnectionError("agent connection closed")
-        return self._buf.value.decode().split(_SEP)
+        while True:
+            n = self._lib.ctd_poll(self._handle, self._buf,
+                                   ctypes.sizeof(self._buf), timeout_ms)
+            if n == 0:
+                return None
+            if n == -2:
+                self._buf = ctypes.create_string_buffer(
+                    ctypes.sizeof(self._buf) * 4)
+                continue
+            if n < 0:
+                raise ConnectionError("agent connection closed")
+            return self._buf.value.decode().split(_SEP)
 
     @property
     def connected(self) -> bool:
-        return bool(self._handle) and \
-            self._lib.ctd_connected(self._handle) == 1
+        with self._lock:  # vs concurrent close(): no use-after-free reads
+            return bool(self._handle) and \
+                self._lib.ctd_connected(self._handle) == 1
 
     def close(self) -> None:
         with self._lock:
@@ -214,8 +229,13 @@ class RemoteComputeCluster(ComputeCluster):
         self._lock = threading.RLock()
         # task_id -> (hostname, resources); consumption tracking for offers
         self._tasks: Dict[str, Tuple[str, Resources]] = {}
-        self._pumps: List[threading.Thread] = []
+        # (pump thread, its connection): shutdown() may only close a
+        # connection whose pump has actually joined (use-after-free guard)
+        self._pumps: List[Tuple[threading.Thread, "AgentConnection"]] = []
         self._stopping = threading.Event()
+        # task ids already seen terminal: a late replayed "running" frame
+        # must not re-adopt them into consumption tracking
+        self._terminal_seen: "OrderedDict[str, None]" = OrderedDict()
 
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, status_callback: Callable) -> None:
@@ -245,7 +265,7 @@ class RemoteComputeCluster(ComputeCluster):
         pump = threading.Thread(target=self._pump, args=(conn,), daemon=True,
                                 name=f"agent-pump-{conn.hostname}")
         pump.start()
-        self._pumps.append(pump)
+        self._pumps.append((pump, conn))
         return conn
 
     def _task_resources(self, task_id: str) -> Resources:
@@ -304,6 +324,11 @@ class RemoteComputeCluster(ComputeCluster):
         cb = self._status_callback
         if state == "running":
             with self._lock:
+                if task_id in self._terminal_seen:
+                    # out-of-order/replayed "running" after a terminal
+                    # status: adopting it would leak tracked consumption
+                    # on that host's offers forever
+                    return
                 # replayed running status after reconnect: adopt the task
                 if task_id not in self._tasks:
                     self._tasks[task_id] = (
@@ -312,9 +337,13 @@ class RemoteComputeCluster(ComputeCluster):
                 cb(task_id, InstanceStatus.RUNNING, None,
                    hostname=conn.hostname)
             return
-        # terminal: release tracked consumption
+        # terminal: release tracked consumption; remember the terminal so a
+        # late "running" replay is dropped (bounded memory)
         with self._lock:
             self._tasks.pop(task_id, None)
+            self._terminal_seen[task_id] = None
+            while len(self._terminal_seen) > 4096:
+                self._terminal_seen.popitem(last=False)
         if cb is None:
             return
         if state == "finished":
@@ -428,10 +457,29 @@ class RemoteComputeCluster(ComputeCluster):
     # -- teardown -----------------------------------------------------------
     def shutdown(self) -> None:
         self._stopping.set()
-        for pump in self._pumps:
+        closable = []
+        for pump, conn in self._pumps:
             pump.join(timeout=2)
+            if pump.is_alive():
+                # the pump may still be inside ctd_poll; closing now would
+                # delete the C driver under it (use-after-free). Leak the
+                # handle instead — the daemon thread dies with the process.
+                logging.getLogger(__name__).warning(
+                    "agent pump for %s did not exit; leaking its handle",
+                    conn.hostname)
+            else:
+                closable.append(conn)
         with self._lock:
-            agents = list(self._agents.values())
             self._agents.clear()
-        for conn in agents:
+        for conn in closable:
             conn.close()
+
+
+def factory(store=None, name: str = "native", endpoints=None,
+            pool: str = "default", kill_grace_ms: int = 3000
+            ) -> "RemoteComputeCluster":
+    """Config-driven construction for the daemon: ``endpoints`` is a list of
+    [host, port] pairs of running cook_agentd daemons."""
+    eps = [(h, int(p)) for h, p in (endpoints or [])]
+    return RemoteComputeCluster(name, eps, pool=pool, store=store,
+                                kill_grace_ms=kill_grace_ms)

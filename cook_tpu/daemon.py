@@ -1,0 +1,236 @@
+"""Process shell: config file -> store -> election -> clusters -> scheduler
+-> REST, serving until leadership loss.
+
+The equivalent of the reference's ``-main`` component graph (reference:
+scheduler/src/cook/components.clj:345-365 -main + eager component compile
+:257-343) and its leader-selector lifecycle (mesos.clj:153-328): every node
+serves the REST API immediately; one node wins the election and becomes the
+scheduler; on leadership loss the process EXITS NONZERO so a supervisor
+restarts it clean (mesos.clj:296-313 System/exit).  ``api_only`` nodes never
+campaign and 307-redirect leader-only requests (config.clj:692).
+
+Config file is JSON or TOML:
+
+    {
+      "port": 12321,
+      "host": "127.0.0.1",
+      "data_dir": "/var/lib/cook",        # durable store (snapshot+journal)
+      "election_dir": "/var/lib/cook",    # lock shared by contending nodes
+      "api_only": false,
+      "admins": ["admin"],
+      "impersonators": [],
+      "basic_auth_users": null,           # {"user": "password"} or null=open
+      "clusters": [
+        {"factory": "cook_tpu.cluster.fake.factory",
+         "kwargs": {"name": "fake-1", "n_hosts": 4}}
+      ],
+      "plugins": {},                      # PluginRegistry.from_config spec
+      "scheduler": {"cycle_mode": "fused", "rank_backend": "tpu", ...}
+    }
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import signal
+import sys
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .config import Config
+from .policy import PluginRegistry, QueueLimits, RateLimits
+from .rest.api import ApiServer, CookApi
+from .sched import Scheduler
+from .sched.election import FileLeaderElector
+from .state.store import Store
+
+# Config fields settable straight from the "scheduler" config section.
+_SCALAR_CONFIG_FIELDS = (
+    "rank_interval_seconds", "match_interval_seconds", "max_over_quota_jobs",
+    "cycle_mode", "default_pool", "autoscaling_enabled",
+    "lingering_task_interval_seconds", "straggler_interval_seconds",
+    "monitor_interval_seconds", "max_tasks_per_host", "heartbeat_enabled",
+    "heartbeat_timeout_ms",
+)
+
+
+def load_config_file(path: str) -> Dict:
+    text = Path(path).read_text()
+    if path.endswith(".toml"):
+        import tomllib
+        return tomllib.loads(text)
+    return json.loads(text)
+
+
+def build_scheduler_config(spec: Dict) -> Config:
+    cfg = Config()
+    for key in _SCALAR_CONFIG_FIELDS:
+        if key in spec and hasattr(cfg, key):
+            setattr(cfg, key, spec[key])
+    if "default_matcher" in spec:
+        for k, v in spec["default_matcher"].items():
+            if hasattr(cfg.default_matcher, k):
+                setattr(cfg.default_matcher, k, v)
+    if "rebalancer" in spec:
+        for k, v in spec["rebalancer"].items():
+            if hasattr(cfg.rebalancer, k):
+                setattr(cfg.rebalancer, k, v)
+    return cfg
+
+
+def build_clusters(specs: List[Dict], store: Store) -> List:
+    """Dotted-path cluster factories, the analog of the reference's
+    factory-fn template instantiation (compute_cluster.clj:483-497)."""
+    clusters = []
+    for spec in specs or []:
+        path = spec["factory"]
+        module, _, attr = path.rpartition(".")
+        factory = getattr(importlib.import_module(module), attr)
+        kwargs = dict(spec.get("kwargs", {}))
+        clusters.append(factory(store=store, **kwargs))
+    return clusters
+
+
+class CookDaemon:
+    """One node's lifecycle.  ``run()`` blocks until shutdown and returns
+    the process exit code (nonzero on leadership loss, the supervisor
+    restart contract)."""
+
+    def __init__(self, conf: Dict, port_override: Optional[int] = None,
+                 api_only: Optional[bool] = None):
+        self.conf = conf
+        self.host = conf.get("host", "127.0.0.1")
+        self.port = port_override if port_override is not None \
+            else int(conf.get("port", 0))
+        self.api_only = bool(conf.get("api_only", False)
+                             if api_only is None else api_only)
+        self.data_dir = conf.get("data_dir")
+        self.exit_code = 0
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.store: Optional[Store] = None
+        self.scheduler: Optional[Scheduler] = None
+        self.api: Optional[CookApi] = None
+        self.server: Optional[ApiServer] = None
+        self.elector: Optional[FileLeaderElector] = None
+
+    # -------------------------------------------------------------- assembly
+    def start(self) -> None:
+        conf = self.conf
+        self.store = (Store.open(self.data_dir) if self.data_dir else Store())
+        sched_spec = dict(conf.get("scheduler", {}))
+        self.sched_config = build_scheduler_config(sched_spec)
+        self.rank_backend = sched_spec.get("rank_backend", "tpu")
+        self.plugins = PluginRegistry.from_config(conf.get("plugins", {}))
+        self.rate_limits = RateLimits()
+        self.queue_limits = QueueLimits(store=self.store)
+
+        # REST serves on every node from the start (api-only nodes 307
+        # leader-only requests via the elector's published URL)
+        self.api = CookApi(
+            self.store, scheduler=None, config=self.sched_config,
+            plugins=self.plugins, rate_limits=self.rate_limits,
+            queue_limits=self.queue_limits,
+            admins=conf.get("admins"), impersonators=conf.get("impersonators"),
+            basic_auth_users=conf.get("basic_auth_users"),
+            cors_origins=conf.get("cors_origins"))
+        self.server = ApiServer(self.api, host=self.host, port=self.port)
+        self.server.start()
+        self.node_url = f"http://{self.host}:{self.server.port}"
+
+        election_dir = conf.get("election_dir") or self.data_dir or "."
+        self.elector = FileLeaderElector(
+            str(Path(election_dir) / "cook-leader.lock"), self.node_url,
+            on_leadership=self._on_leadership, on_loss=self._on_loss)
+        self.api.elector = self.elector
+        self.api.node_url = self.node_url
+        if not self.api_only:
+            self.elector.campaign()
+
+    def _on_leadership(self) -> None:
+        """PROCESS-GLOBAL TRANSITION: this node becomes THE scheduler
+        (reference: LeaderSelectorListener.takeLeadership mesos.clj:193)."""
+        try:
+            with self._lock:
+                clusters = build_clusters(self.conf.get("clusters", []),
+                                          self.store)
+                self.scheduler = Scheduler(
+                    self.store, self.sched_config, clusters,
+                    rank_backend=self.rank_backend, plugins=self.plugins,
+                    rate_limits=self.rate_limits)
+                self.scheduler.run()
+                self.api.scheduler = self.scheduler
+        except Exception:
+            # A failed takeover (bad cluster factory, store corruption...)
+            # must NOT leave this node holding the leader lock with no
+            # scheduler: exit nonzero so the supervisor restarts us and a
+            # peer can win the election.
+            import traceback
+            traceback.print_exc()
+            self.exit_code = 1
+            self._done.set()
+
+    def _on_loss(self) -> None:
+        """Leadership lost -> exit nonzero; the supervisor restarts us
+        (mesos.clj:296-313)."""
+        self.exit_code = 1
+        self._done.set()
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self) -> int:
+        self.start()
+        signal.signal(signal.SIGTERM, self._sigterm)
+        signal.signal(signal.SIGINT, self._sigterm)
+        print(f"cook_tpu: serving {self.node_url}"
+              + (" (api-only)" if self.api_only else " (campaigning)"),
+              flush=True)
+        self._done.wait()
+        self.shutdown()
+        return self.exit_code
+
+    def _sigterm(self, _signum, _frame) -> None:
+        self.exit_code = 0
+        self._done.set()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self.scheduler is not None:
+                self.scheduler.shutdown()
+                for cluster in self.scheduler.clusters.values():
+                    shutdown = getattr(cluster, "shutdown", None)
+                    if shutdown:
+                        try:
+                            shutdown()
+                        except Exception:
+                            pass
+        if self.elector is not None:
+            # resign AFTER scheduler stop; suppress on_loss (clean exit)
+            self.elector.on_loss = None
+            self.elector.resign()
+        if self.server is not None:
+            self.server.stop()
+        if self.store is not None and self.data_dir:
+            try:
+                self.store.checkpoint()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m cook_tpu",
+        description="Cook-TPU scheduler node (leader-elected)")
+    parser.add_argument("--config", required=True,
+                        help="JSON or TOML config file")
+    parser.add_argument("--port", type=int, default=None,
+                        help="override the configured REST port")
+    parser.add_argument("--api-only", action="store_true", default=None,
+                        help="serve the API without campaigning for leader")
+    args = parser.parse_args(argv)
+    conf = load_config_file(args.config)
+    daemon = CookDaemon(conf, port_override=args.port,
+                        api_only=args.api_only)
+    return daemon.run()

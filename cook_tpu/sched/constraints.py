@@ -332,3 +332,111 @@ def validate_group_placement(jobs: List[Job], assignments: np.ndarray,
                 else:
                     out[j] = -1
     return out
+
+
+# Constraint names follow the reference's Fenzo constraint class names so
+# the unscheduled explainer's message table lines up
+# (unscheduled.clj constraint-name->message).
+def explain_placement_failure(job: Job, offers: List[Offer],
+                              ctx: ConstraintContext,
+                              avail: Optional[np.ndarray] = None) -> Dict:
+    """Per-host failure census for ONE job: which resource dimensions and
+    which constraints excluded how many hosts (reference:
+    fenzo_utils.clj summarize-placement-failure — Fenzo reports
+    AssignmentFailures per host; here each cause is recomputed as a
+    vectorized mask over the offer axis).
+
+    Returns {"resources": {dim: host_count}, "constraints": {name: count}}.
+    Only called for under-investigation jobs, so host-side numpy is fine.
+    """
+    H = len(offers)
+    out = {"resources": {}, "constraints": {}}
+    if H == 0:
+        return out
+    if avail is None:
+        avail = np.array([[o.available.cpus, o.available.mem,
+                           o.available.gpus, o.available.disk]
+                          for o in offers], dtype=np.float32)
+    need = np.array([job.resources.cpus, job.resources.mem,
+                     job.resources.gpus, job.resources.disk],
+                    dtype=np.float32)
+    for d, dim in enumerate(("cpus", "mem", "gpus", "disk")):
+        n = int((avail[:, d] < need[d]).sum())
+        if n:
+            out["resources"][dim] = n
+
+    def count(name: str, bad_mask: np.ndarray) -> None:
+        n = int(np.asarray(bad_mask).sum())
+        if n:
+            out["constraints"][name] = n
+
+    host_names = [o.hostname for o in offers]
+    failed = ctx.failed_hosts.get(job.uuid) or set()
+    count("novel_host_constraint",
+          np.array([h in failed for h in host_names]))
+    host_gpu = np.array([o.capacity.gpus > 0 for o in offers])
+    if job.resources.gpus > 0:
+        count("gpu_host_constraint", ~host_gpu)
+        model = job.labels.get(GPU_MODEL_LABEL)
+        if model:
+            count("gpu_model_constraint",
+                  host_gpu & np.array([o.gpu_model != model for o in offers]))
+    else:
+        count("non_gpu_host_constraint", host_gpu)
+    disk = job.labels.get(DISK_TYPE_LABEL)
+    if disk:
+        count("disk_type_constraint",
+              np.array([o.disk_type != disk for o in offers]))
+    for c in job.constraints:
+        if c.operator.upper() == "EQUALS":
+            count(f"user_defined_constraint:{c.attribute}",
+                  np.array([o.attributes.get(c.attribute) != c.pattern
+                            for o in offers]))
+    loc = ctx.checkpoint_locations.get(job.uuid)
+    if loc:
+        count("checkpoint_locality_constraint",
+              np.array([o.attributes.get(LOCATION_ATTRIBUTE) != loc
+                        for o in offers]))
+    reserved_other = {h for u, h in ctx.reserved_hosts.items()
+                      if u != job.uuid}
+    count("rebalancer_reservation_constraint",
+          np.array([h in reserved_other for h in host_names]))
+    if ctx.max_tasks_per_host is not None:
+        count("max_tasks_per_host_constraint",
+              np.array([o.task_count >= ctx.max_tasks_per_host
+                        for o in offers]))
+    if job.group is not None:
+        group = ctx.groups.get(job.group)
+        ptype = getattr(group, "placement_type", None)
+        running = ctx.group_running_hosts.get(job.group, ())
+        if ptype is GroupPlacementType.UNIQUE:
+            count("unique_host_constraint",
+                  np.array([h in set(running) for h in host_names]))
+        elif ptype is GroupPlacementType.ATTRIBUTE_EQUALS:
+            attr = getattr(group, "placement_attribute", None)
+            if attr:
+                offer_attrs = {o.hostname: o.attributes for o in offers}
+                want = ctx.group_attr_values.get(job.group)
+                allowed = {want} if want is not None else {
+                    ctx.host_attrs(hn, offer_attrs).get(attr)
+                    for hn in running}
+                allowed.discard(None)
+                if allowed:
+                    count("attribute-equals-host-placement-group-constraint",
+                          np.array([o.attributes.get(attr) not in allowed
+                                    for o in offers]))
+        elif ptype is GroupPlacementType.BALANCED:
+            attr = getattr(group, "placement_attribute", None)
+            minimum = getattr(group, "placement_minimum", 2) or 2
+            if attr:
+                offer_attrs = {o.hostname: o.attributes for o in offers}
+                freqs: Dict[Optional[str], int] = {}
+                for hn in running:
+                    v = ctx.host_attrs(hn, offer_attrs).get(attr)
+                    freqs[v] = freqs.get(v, 0) + 1
+                if freqs:
+                    count("balanced-host-placement-group-constraint",
+                          np.array([not _balanced_ok(
+                              freqs, o.attributes.get(attr), minimum)
+                              for o in offers]))
+    return out

@@ -369,6 +369,8 @@ class FusedCycleDriver:
         cand_assign[cand_assign >= len(pp.offers)] = -1
         cand_assign = validate_group_placement(
             cand_jobs, cand_assign, pp.offers, pp.ctx)
+        self.matcher.record_placement_failures(
+            cand_jobs, cand_assign, pp.offers, pp.ctx)
 
         result.head_matched = bool(cand_assign[0] >= 0)
         mc = self.config.matcher_for_pool(pool_name)

@@ -238,6 +238,7 @@ class Matcher:
                           offers=len(offers)):
             assign = self._dispatch(mc, job_res, cmask, avail, cap)
             assign = validate_group_placement(considerable, assign, offers, ctx)
+        self.record_placement_failures(considerable, assign, offers, ctx)
 
         # head-of-queue backoff bookkeeping
         result.head_matched = bool(assign[0] >= 0)
@@ -251,6 +252,23 @@ class Matcher:
                 result.matched.append((job, offers[h]))
         self._launch(pool_name, result, clusters)
         return result
+
+    def record_placement_failures(self, jobs: List[Job], assign: np.ndarray,
+                                  offers: List[Offer],
+                                  ctx: ConstraintContext) -> None:
+        """Persist per-host failure summaries for unmatched jobs the
+        explainer put under investigation (reference:
+        record-placement-failures! fenzo_utils.clj:75-99)."""
+        from .constraints import explain_placement_failure
+        for j, job in enumerate(jobs):
+            if int(assign[j]) >= 0:
+                continue
+            fresh = self.store.job(job.uuid)
+            if fresh is None or not fresh.under_investigation:
+                continue
+            summary = explain_placement_failure(job, offers, ctx)
+            self.store.set_placement_investigation(
+                job.uuid, under_investigation=False, failure=summary)
 
     def _dispatch(self, mc: MatcherConfig, job_res, cmask, avail, cap
                   ) -> np.ndarray:
@@ -316,14 +334,39 @@ class Matcher:
                 task_id=task_id, job_uuid=job.uuid, hostname=offer.hostname,
                 slave_id=offer.slave_id, resources=job.resources))
             result.launched_task_ids.append(task_id)
-        for cluster_name, specs in by_cluster.items():
-            cluster = clusters.get(cluster_name)
-            if cluster is None:
-                continue
+        # per-cluster launches fan out in parallel (reference: future per
+        # cluster, scheduler.clj:1034-1048) — one slow backend must not
+        # serialize the others
+        def launch_on(cluster, specs):
             cluster.kill_lock.acquire_read()
             try:
                 with tracing.span("cluster.launch-tasks", pool=pool_name,
-                                  cluster=cluster_name, tasks=len(specs)):
+                                  cluster=cluster.name, tasks=len(specs)):
                     cluster.launch_tasks(pool_name, specs)
             finally:
                 cluster.kill_lock.release_read()
+
+        targets = [(clusters[name], specs)
+                   for name, specs in by_cluster.items() if name in clusters]
+        if len(targets) == 1:
+            launch_on(*targets[0])
+        elif targets:
+            import threading
+            errors: List[BaseException] = []
+
+            def launch_guarded(cluster, specs):
+                try:
+                    launch_on(cluster, specs)
+                except BaseException as e:  # propagate after join
+                    errors.append(e)
+
+            threads = [threading.Thread(target=launch_guarded, args=t,
+                                        name=f"launch-{t[0].name}")
+                       for t in targets]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            if errors:
+                # surface like the sequential path would: first failure wins
+                raise errors[0]

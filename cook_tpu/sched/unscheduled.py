@@ -1,9 +1,17 @@
 """Why-is-my-job-pending explainer.
 
 Mirrors the reference's unscheduled-jobs reasons (reference:
-scheduler/src/cook/rest/unscheduled.clj:172 reasons; fenzo_utils.clj:21-99
-for placement-failure conversion): each reason is {reason, data} and several
-can apply at once.
+scheduler/src/cook/unscheduled.clj reasons :172 — exhausted retries,
+quota/share limits, queue position with jobs-ahead, launch rate limit,
+plugin filter, placement failure; fenzo_utils.clj:21-99 for the
+placement-failure summary).  Each reason is {reason, data}; several can
+apply at once.
+
+Placement failures use the reference's two-step "under investigation"
+workflow: the first ask flags the job (:job/under-investigation), the next
+match cycle records a per-host failure census for it
+(Matcher.record_placement_failures), and subsequent asks present the
+detailed host counts per cause.
 """
 
 from __future__ import annotations
@@ -12,8 +20,60 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..state.schema import InstanceStatus, Job, JobState, below_quota, job_usage, add_usage
+from ..state.schema import (
+    InstanceStatus,
+    Job,
+    JobState,
+    add_usage,
+    below_quota,
+    job_usage,
+)
 from ..state.store import Store
+
+# constraint name -> human message (reference: unscheduled.clj
+# constraint-name->message)
+CONSTRAINT_MESSAGES = {
+    "novel_host_constraint": "Job already ran on this host.",
+    "gpu_host_constraint": "Host has no GPU support.",
+    "non_gpu_host_constraint":
+        "Host is reserved for jobs that need GPU support.",
+    "attribute-equals-host-placement-group-constraint":
+        "Host had a different attribute than other jobs in the group.",
+    "unique_host_constraint": "Group cotask already runs on this host.",
+    "balanced-host-placement-group-constraint":
+        "Placing here would unbalance the group's spread.",
+    "rebalancer_reservation_constraint":
+        "Host is reserved for a preempting job.",
+    "checkpoint_locality_constraint":
+        "Host is outside the job's prior checkpoint location.",
+    "max_tasks_per_host_constraint": "Host is at its task-count limit.",
+    "disk_type_constraint": "Host has a different disk type.",
+    "gpu_model_constraint": "Host has a different GPU model.",
+}
+
+
+def placement_failure_for_user(summary: Dict) -> List[Dict]:
+    """Serialized failure census -> presentation rows (reference:
+    fenzo-failures-for-user, unscheduled.clj)."""
+    rows: List[Dict] = []
+    for dim, n in (summary.get("resources") or {}).items():
+        rows.append({"reason": f"Not enough {dim} available.",
+                     "host_count": n})
+    for name, n in (summary.get("constraints") or {}).items():
+        rows.append({"reason": CONSTRAINT_MESSAGES.get(name, name),
+                     "host_count": n})
+    return rows
+
+
+def _limit_excess(limits: Dict[str, float], usage: Dict[str, float]) -> Dict:
+    """How usage would exceed limits (reference:
+    how-job-would-exceed-resource-limits, unscheduled.clj): returns
+    {dim: {"limit": l, "usage": u}} for each exceeded dimension."""
+    out = {}
+    for dim, lim in limits.items():
+        if lim != float("inf") and usage.get(dim, 0.0) > lim:
+            out[dim] = {"limit": lim, "usage": usage.get(dim, 0.0)}
+    return out
 
 
 def job_reasons(store: Store, job: Job,
@@ -31,19 +91,27 @@ def job_reasons(store: Store, job: Job,
             "data": {}})
         return reasons
 
-    # attempts so far
-    failures = 0
-    for tid in job.instances:
-        inst = store.instance(tid)
-        if inst is not None and inst.status is InstanceStatus.FAILED:
-            failures += 1
-    if failures:
+    # exhausted retries (reference: check-exhausted-retries)
+    instances = {t: store.instance(t) for t in job.instances}
+    instances = {t: i for t, i in instances.items() if i is not None}
+    attempts = job.attempts_used(instances)
+    if attempts >= job.max_retries:
         reasons.append({
-            "reason": "The job has failed instances and is waiting to retry.",
-            "data": {"failures": failures,
-                     "max_retries": job.max_retries}})
+            "reason": "Job has exhausted its maximum number of retries.",
+            "data": {"max_retries": job.max_retries,
+                     "instance_count": attempts}})
+    else:
+        failures = sum(1 for i in instances.values()
+                       if i.status is InstanceStatus.FAILED)
+        if failures:
+            reasons.append({
+                "reason": "The job has failed instances and is waiting to "
+                          "retry.",
+                "data": {"failures": failures,
+                         "max_retries": job.max_retries}})
 
-    # user quota
+    # user quota and share limits (reference: check-exceeds-limit applied to
+    # both quota and share read-fns)
     usage = job_usage(job)
     for other, _inst in store.running_instances(job.pool):
         if other.user == job.user:
@@ -52,9 +120,13 @@ def job_reasons(store: Store, job: Job,
     if not below_quota(quota, usage):
         reasons.append({
             "reason": "The job would cause you to exceed resource quotas.",
-            "data": {"quota": {k: v for k, v in quota.items()
-                               if v != float("inf")},
-                     "usage": usage}})
+            "data": _limit_excess(quota, usage)})
+    share = store.get_share(job.user, job.pool)
+    share_excess = _limit_excess(share, usage)
+    if share_excess:
+        reasons.append({
+            "reason": "The job would cause you to exceed resource shares.",
+            "data": share_excess})
 
     # queue limits
     if queue_limits is not None:
@@ -78,23 +150,50 @@ def job_reasons(store: Store, job: Job,
                               "jobs you launch per minute.",
                     "data": {"seconds_until_out_of_debt":
                              rl.time_until_out_of_debt_s(key)}})
-        # queue position
+        # queue position + the jobs ahead (reference: check-queue-position
+        # returns up to 10 uuids of the USER'S OWN jobs ahead in line —
+        # never another user's uuids)
         queue = scheduler.pending_queues.get(job.pool, [])
         position = next((i for i, j in enumerate(queue)
                          if j.uuid == job.uuid), None)
-        if position is not None:
+        if position is not None and position > 0:
+            own_ahead = [j.uuid for j in queue[:position]
+                         if j.user == job.user]
+            if own_ahead:
+                reasons.append({
+                    "reason": f"You have {len(own_ahead)} other jobs ahead "
+                              "in the queue.",
+                    "data": {"queue_position": position,
+                             "queue_length": len(queue),
+                             "jobs": own_ahead[:10]}})
+        # launch-filter plugin verdict (reference: check-plugin-filter)
+        plugins = getattr(scheduler, "plugins", None)
+        if plugins is not None and plugins.launch_filters \
+                and not plugins.launch_allowed(job):
             reasons.append({
-                "reason": "The job is waiting for its turn in the queue.",
-                "data": {"queue_position": position,
-                         "queue_length": len(queue)}})
-        # placement failure from the last match cycle
+                "reason": "The launch filter plugin is blocking the job "
+                          "launch.",
+                "data": {"plugins": [type(f).__name__
+                                     for f in plugins.launch_filters]}})
+        # placement failure: the two-step under-investigation workflow
+        # (reference: check-fenzo-placement unscheduled.clj)
         last = getattr(scheduler, "last_match_results", {}).get(job.pool)
-        if last is not None and any(j.uuid == job.uuid for j in last.unmatched):
+        unmatched_last_cycle = last is not None and any(
+            j.uuid == job.uuid for j in last.unmatched)
+        if job.last_placement_failure:
             reasons.append({
-                "reason": "The job couldn't be placed on any available hosts.",
-                "data": {"considered": last.considered,
-                         "offers_were_available": bool(last.matched
-                                                       or last.considered)}})
+                "reason": "The job couldn't be placed on any available "
+                          "hosts.",
+                "data": {"reasons": placement_failure_for_user(
+                    job.last_placement_failure)}})
+        elif unmatched_last_cycle:
+            if not job.under_investigation:
+                store.set_placement_investigation(
+                    job.uuid, under_investigation=True)
+            reasons.append({
+                "reason": "The job is now under investigation. Check back "
+                          "in a minute for more details!",
+                "data": {}})
     if not reasons:
         reasons.append({
             "reason": "The job is just waiting for its turn. "

@@ -79,6 +79,12 @@ class Reasons:
     STRAGGLER = Reason(11, "straggler", mea_culpa=True)
     CANCELLED_DURING_LAUNCH = Reason(12, "cancelled-during-launch", mea_culpa=True)
     REASON_POD_SUBMISSION_FAILED = Reason(13, "pod-submission-failed", mea_culpa=True, failure_limit=10)
+    # pod entered phase Unknown: kubernetes lost track of it; the cluster's
+    # fault, retry free (reference: the controller's :pod/unknown arms)
+    UNKNOWN_MEA_CULPA = Reason(14, "unknown-mea-culpa", mea_culpa=True, failure_limit=3)
+    # stuck/unschedulable pod reaped by the detector
+    # (reference: kubernetes/api.clj:1820-1846)
+    POD_STUCK = Reason(15, "pod-stuck", mea_culpa=True, failure_limit=3)
 
     _by_code: Dict[int, Reason] = {}
     _by_name: Dict[str, Reason] = {}
@@ -204,7 +210,13 @@ class Job:
     # rebalancer host reservation consumed by the matcher (rebalancer.clj:419-432)
     reserved_host: Optional[str] = None
     # "under investigation" flag driving the unscheduled-jobs explainer
+    # (reference: :job/under-investigation; the next match cycle records a
+    # placement-failure summary for investigated jobs, fenzo_utils.clj:75-99)
     under_investigation: bool = False
+    # {"resources": {"cpus": host_count, ...},
+    #  "constraints": {"novel_host_constraint": host_count, ...}}
+    # (reference: :job/last-fenzo-placement-failure)
+    last_placement_failure: Optional[Dict[str, Any]] = None
     last_waiting_start_ms: int = 0
 
     def attempts_used(self, instances: Dict[str, "Instance"]) -> int:

@@ -474,6 +474,25 @@ class Store:
 
         return self.transact(_kill)
 
+    def set_placement_investigation(self, job_uuid: str,
+                                    under_investigation: Optional[bool] = None,
+                                    failure: Optional[Dict] = None) -> bool:
+        """Update the unscheduled-explainer investigation state (reference:
+        :job/under-investigation + :job/last-fenzo-placement-failure,
+        unscheduled.clj check-fenzo-placement + fenzo_utils.clj:75-99)."""
+
+        def _set(txn: _Txn) -> bool:
+            job = txn.job_w(job_uuid)
+            if job is None:
+                return False
+            if under_investigation is not None:
+                job.under_investigation = under_investigation
+            if failure is not None:
+                job.last_placement_failure = failure
+            return True
+
+        return self.transact(_set)
+
     def retry_job(self, job_uuid: str, retries: int) -> bool:
         """Set max-retries; resurrect a completed job back to waiting if it
         now has attempts left (reference: tools.clj retry-job!)."""

@@ -144,6 +144,12 @@ struct AgentTask {
   int exit_code = 0;
   bool kill_requested = false;
   std::string sandbox;
+  // STATUS-ordering handshake between agent_launch and the reaper: the
+  // terminal STATUS must never be broadcast before the "running" STATUS
+  // for the same task (a late "running" would make the driver re-adopt a
+  // finished task and leak tracked consumption).
+  bool running_sent = false;
+  bool terminal_pending = false;
 };
 
 struct AgentState {
@@ -207,6 +213,9 @@ void agent_reaper() {
     std::string task_id;
     AgentTask snapshot;
     {
+      // agent_launch holds mu across fork()->map-insert, so by the time we
+      // can take the lock the entry for this pid is guaranteed to exist —
+      // a fast-exiting child can never have its status discarded.
       std::lock_guard<std::mutex> lk(g_agent->mu);
       for (auto& kv : g_agent->tasks) {
         if (kv.second.pid == pid && kv.second.state == "running") {
@@ -216,9 +225,15 @@ void agent_reaper() {
           kv.second.state = kv.second.kill_requested
                                 ? "killed"
                                 : (code == 0 ? "finished" : "failed");
-          task_id = kv.first;
-          snapshot = kv.second;
-          note_terminal_locked(task_id);
+          note_terminal_locked(kv.first);
+          if (kv.second.running_sent) {
+            task_id = kv.first;
+            snapshot = kv.second;
+          } else {
+            // "running" not broadcast yet: the launch thread will send
+            // running first, see terminal_pending, and send this terminal
+            kv.second.terminal_pending = true;
+          }
           break;
         }
       }
@@ -230,39 +245,61 @@ void agent_reaper() {
 void agent_launch(const std::string& task_id, const std::string& command) {
   std::string sandbox = g_agent->workdir + "/" + task_id;
   ::mkdir(sandbox.c_str(), 0755);
-  pid_t pid = ::fork();
-  if (pid == 0) {
-    ::setsid();  // own session/process group: kill(-pid) reaches the tree
-    if (::chdir(sandbox.c_str()) != 0) _exit(127);
-    int out = ::open("stdout", O_CREAT | O_WRONLY | O_TRUNC, 0644);
-    int err = ::open("stderr", O_CREAT | O_WRONLY | O_TRUNC, 0644);
-    if (out >= 0) ::dup2(out, 1);
-    if (err >= 0) ::dup2(err, 2);
-    ::setenv("COOK_TASK_ID", task_id.c_str(), 1);
-    ::setenv("COOK_SANDBOX", sandbox.c_str(), 1);
-    ::execl("/bin/sh", "sh", "-c", command.c_str(), nullptr);
-    _exit(127);
-  }
   AgentTask t;
   t.sandbox = sandbox;
-  if (pid < 0) {
-    t.state = "failed";
-    t.exit_code = 127;
-    {
-      std::lock_guard<std::mutex> lk(g_agent->mu);
+  pid_t pid;
+  {
+    // Hold mu across fork() -> map insert: the reaper also takes mu before
+    // classifying a reaped pid, so a child that exits instantly cannot be
+    // reaped-and-dropped before its task entry exists (the round-1 lost
+    // exit-status race). The child only execs, it never touches the lock.
+    std::lock_guard<std::mutex> lk(g_agent->mu);
+    pid = ::fork();
+    if (pid == 0) {
+      ::setsid();  // own session/process group: kill(-pid) reaches the tree
+      if (::chdir(sandbox.c_str()) != 0) _exit(127);
+      int out = ::open("stdout", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+      int err = ::open("stderr", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+      if (out >= 0) ::dup2(out, 1);
+      if (err >= 0) ::dup2(err, 2);
+      ::setenv("COOK_TASK_ID", task_id.c_str(), 1);
+      ::setenv("COOK_SANDBOX", sandbox.c_str(), 1);
+      ::execl("/bin/sh", "sh", "-c", command.c_str(), nullptr);
+      _exit(127);
+    }
+    if (pid < 0) {
+      t.state = "failed";
+      t.exit_code = 127;
       g_agent->tasks[task_id] = t;
       note_terminal_locked(task_id);
+    } else {
+      t.pid = pid;
+      t.state = "running";
+      g_agent->tasks[task_id] = t;
     }
+  }
+  if (pid < 0) {
     agent_status(task_id, t);
     return;
   }
-  t.pid = pid;
-  t.state = "running";
+  agent_status(task_id, t);  // "running" is always broadcast first
+  // If the reaper classified the task while "running" was in flight it
+  // deferred the terminal broadcast to us (terminal_pending).
+  AgentTask snapshot;
+  bool terminal = false;
   {
     std::lock_guard<std::mutex> lk(g_agent->mu);
-    g_agent->tasks[task_id] = t;
+    auto it = g_agent->tasks.find(task_id);
+    if (it != g_agent->tasks.end()) {
+      it->second.running_sent = true;
+      if (it->second.terminal_pending) {
+        it->second.terminal_pending = false;
+        snapshot = it->second;
+        terminal = true;
+      }
+    }
   }
-  agent_status(task_id, t);
+  if (terminal) agent_status(task_id, snapshot);
 }
 
 void agent_kill(const std::string& task_id, int grace_ms) {
@@ -347,6 +384,7 @@ int agent_main(int argc, char** argv) {
   ::signal(SIGPIPE, SIG_IGN);
   g_agent = new AgentState();
   int port = 0;
+  std::string bind_addr = "127.0.0.1";
   char hostbuf[256] = {0};
   ::gethostname(hostbuf, sizeof(hostbuf) - 1);
   g_agent->hostname = hostbuf;
@@ -361,6 +399,7 @@ int agent_main(int argc, char** argv) {
     else if (a == "--disk") g_agent->disk = std::atof(v);
     else if (a == "--hostname") g_agent->hostname = v;
     else if (a == "--workdir") g_agent->workdir = v;
+    else if (a == "--bind") bind_addr = v;
   }
   g_agent->workdir += "/" + g_agent->hostname;
   mkdir_p(g_agent->workdir);
@@ -373,7 +412,13 @@ int agent_main(int argc, char** argv) {
   ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  // default loopback for safety; --bind 0.0.0.0 (or an interface address)
+  // enables real multi-node deployment of the native transport
+  if (::inet_pton(AF_INET, bind_addr.c_str(), &addr.sin_addr) != 1) {
+    ::fprintf(stderr, "bad --bind address: %s\n", bind_addr.c_str());
+    return 1;
+  }
+  addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     ::perror("bind");
@@ -545,11 +590,14 @@ int ctd_poll(void* h, char* buf, int cap, int timeout_ms) {
                    [d] { return !d->events.empty() || d->closed.load(); });
   }
   if (d->events.empty()) return d->closed.load() ? -1 : 0;
+  // capacity check BEFORE popping: an oversized event stays queued and the
+  // caller gets a distinct "buffer too small" code (-2) instead of the
+  // connection-closed code (-1), which Python escalates to NODE_LOST
+  int n = static_cast<int>(d->events.front().size());
+  if (n + 1 > cap) return -2;
   std::string ev = std::move(d->events.front());
   d->events.pop_front();
   lk.unlock();
-  int n = static_cast<int>(ev.size());
-  if (n + 1 > cap) return -1;
   ::memcpy(buf, ev.data(), ev.size());
   buf[n] = '\0';
   return n;

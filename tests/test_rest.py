@@ -524,3 +524,32 @@ class TestAuthAndCors:
             assert resp.headers.get("Access-Control-Allow-Origin") is None
         finally:
             server.stop()
+
+
+class TestUnderInvestigation:
+    def test_two_step_placement_explainer(self, system):
+        """First ask flags the job under investigation; the next match cycle
+        records a per-host failure census; the following ask presents the
+        detailed counts (reference: unscheduled.clj check-fenzo-placement +
+        fenzo_utils.clj record-placement-failures!)."""
+        store, _c, sched, server = system
+        client = client_for(server)
+        # impossible resources: nothing in the fake cluster fits 512 cpus
+        uuid = client.submit_one("x", cpus=512.0, mem=64.0)
+        sched.step_rank()
+        sched.step_match()
+        [explained] = client.unscheduled_jobs([uuid])
+        reasons = [r["reason"] for r in explained["reasons"]]
+        assert any("under investigation" in r for r in reasons)
+        assert store.job(uuid).under_investigation
+        # the next cycle records the census and clears the flag
+        sched.step_rank()
+        sched.step_match()
+        job = store.job(uuid)
+        assert not job.under_investigation
+        assert job.last_placement_failure is not None
+        assert job.last_placement_failure["resources"].get("cpus")
+        [explained] = client.unscheduled_jobs([uuid])
+        detail = next(r for r in explained["reasons"]
+                      if "placed" in r["reason"])
+        assert any("cpus" in d["reason"] for d in detail["data"]["reasons"])
