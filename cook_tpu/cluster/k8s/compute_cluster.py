@@ -37,19 +37,26 @@ class KubernetesCluster(ComputeCluster):
                  store: Optional[Store] = None,
                  max_total_pods: int = 10_000,
                  max_pods_per_node: int = 32,
-                 synthetic_pod_ttl_ms: int = 120_000):
+                 synthetic_pod_ttl_ms: int = 120_000,
+                 stuck_pod_timeout_ms: int = 300_000,
+                 incremental=None):
         super().__init__(name)
         self.api = api or FakeKubernetesApi()
         self.store = store
         self.max_total_pods = max_total_pods
         self.max_pods_per_node = max_pods_per_node
+        self.stuck_pod_timeout_ms = stuck_pod_timeout_ms
+        self.incremental = incremental
         self._watch_registered = False
+        clock = (lambda: store.clock()) if store is not None else (lambda: 0)
         self.controller = PodController(
             self.api,
             on_pod_started=self._pod_started,
             on_pod_completed=self._pod_completed,
             on_pod_killed=self._pod_killed,
-            managed_filter=lambda pod: self._cook_managed(pod))
+            on_pod_preempted=self._pod_preempted,
+            managed_filter=lambda pod: self._cook_managed(pod),
+            clock=clock)
 
     # ------------------------------------------------------------- lifecycle
     def initialize(self, status_callback) -> None:
@@ -125,6 +132,16 @@ class KubernetesCluster(ComputeCluster):
             self._status_callback(pod_name, InstanceStatus.FAILED,
                                   reason_code, preempted=preempted)
 
+    def _pod_preempted(self, pod_name: str) -> None:
+        """Pod regressed running->waiting (node preemption): mea-culpa
+        failure so the retry is free (reference: handle-pod-preemption,
+        controller.clj)."""
+        if self._status_callback:
+            from ...state.schema import Reasons
+            self._status_callback(pod_name, InstanceStatus.FAILED,
+                                  Reasons.PREEMPTED_BY_POOL.code,
+                                  preempted=True)
+
     # --------------------------------------------------------------- offers
     def pending_offers(self, pool: str) -> List[Offer]:
         consumption: Dict[str, List[float]] = {}
@@ -162,13 +179,18 @@ class KubernetesCluster(ComputeCluster):
     # --------------------------------------------------------------- launch
     def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
         from ...state.schema import Reasons
+        from .pod_spec import build_pod_spec
         for spec in specs:
+            job = self.store.job(spec.job_uuid) if self.store else None
             pod = FakePod(
                 name=spec.task_id,
                 node_name=spec.hostname or None,  # direct mode: unscheduled
                 cpus=spec.resources.cpus, mem=spec.resources.mem,
                 gpus=spec.resources.gpus,
-                labels={"cook/job": spec.job_uuid, "cook/pool": pool})
+                creation_ms=(self.store.clock() if self.store else 0),
+                labels={"cook/job": spec.job_uuid, "cook/pool": pool},
+                spec=(build_pod_spec(job, pool, incremental=self.incremental)
+                      if job is not None else {}))
             if not self.controller.launch_pod(pod):
                 if self._status_callback:
                     self._status_callback(
@@ -221,6 +243,40 @@ class KubernetesCluster(ComputeCluster):
             except ValueError:
                 continue
         return created
+
+    def detect_stuck_pods(self, now_ms: Optional[int] = None) -> List[str]:
+        """Stuck/unschedulable pod detection (reference:
+        kubernetes/api.clj:1820-1846): a cook-managed pod Pending past the
+        timeout, or one the kube-scheduler marked unschedulable, is killed
+        with a mea-culpa POD_STUCK failure (free retry elsewhere)."""
+        from ...state.schema import Reasons
+        if now_ms is None:
+            now_ms = self.store.clock() if self.store else 0
+        stuck: List[str] = []
+        for pod in self.api.pods():
+            if not self._cook_managed(pod) or pod.deleted:
+                continue
+            if pod.phase != "Pending":
+                continue
+            unschedulable = bool(pod.unschedulable_reason)
+            timed_out = (now_ms - pod.creation_ms) > self.stuck_pod_timeout_ms
+            if not (unschedulable or timed_out):
+                continue
+            stuck.append(pod.name)
+            why = (f"unschedulable: {pod.unschedulable_reason}"
+                   if unschedulable else
+                   f"pending for {now_ms - pod.creation_ms}ms")
+            # writeback first, then the kubernetes delete (restart safety)
+            if self._status_callback:
+                self._status_callback(pod.name, InstanceStatus.FAILED,
+                                      Reasons.POD_STUCK.code)
+            self.controller.set_expected(pod.name, CookExpected.COMPLETED)
+            self.api.delete_pod(pod.name)
+            self.controller.pod_update(pod.name)
+            import logging
+            logging.getLogger(__name__).warning(
+                "reaped stuck pod %s (%s)", pod.name, why)
+        return stuck
 
     def reap_synthetic_pods(self, launched_job_uuids: List[str]) -> int:
         """Delete placeholders whose jobs launched for real."""
