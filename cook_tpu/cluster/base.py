@@ -40,13 +40,21 @@ class Offer:
 
 @dataclass
 class LaunchSpec:
-    """One matched task to launch."""
+    """One matched task to launch.
+
+    Carries the full task compilation the reference builds in
+    mesos/task.clj:114-294: command environment, requested host-port count,
+    and the container spec ({"image": ..., "volumes": ["host:cont", ...]}).
+    """
 
     task_id: str
     job_uuid: str
     hostname: str
     slave_id: str
     resources: Resources
+    env: Dict[str, str] = field(default_factory=dict)
+    port_count: int = 0
+    container: Optional[Dict] = None
 
 
 class ReadWriteLock:

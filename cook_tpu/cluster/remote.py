@@ -84,6 +84,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.ctd_launch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_char_p, ctypes.c_double,
                                ctypes.c_double]
+    lib.ctd_launch2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_double,
+                                ctypes.c_double, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_char_p]
     lib.ctd_kill.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.ctd_reconcile.argtypes = [ctypes.c_void_p]
     lib.ctd_ping.argtypes = [ctypes.c_void_p]
@@ -127,12 +132,18 @@ class AgentConnection:
         return self._buf.value.decode()
 
     def launch(self, task_id: str, command: str, cpus: float,
-               mem: float) -> bool:
+               mem: float, env: Optional[Dict[str, str]] = None,
+               port_count: int = 0, image: str = "",
+               volumes: Optional[List[str]] = None) -> bool:
+        env_s = "\x1e".join(f"{k}={v}" for k, v in (env or {}).items())
+        vol_s = "\x1e".join(volumes or [])
         with self._lock:
             if not self._handle:
                 return False
-            return self._lib.ctd_launch(self._handle, task_id.encode(),
-                                        command.encode(), cpus, mem) == 0
+            return self._lib.ctd_launch2(
+                self._handle, task_id.encode(), command.encode(), cpus, mem,
+                env_s.encode(), int(port_count), image.encode(),
+                vol_s.encode()) == 0
 
     def kill(self, task_id: str, grace_ms: int = 3000) -> bool:
         with self._lock:
@@ -189,17 +200,23 @@ class LocalAgentProcess:
 
     def __init__(self, hostname: str, cpus: float = 4.0, mem: float = 4096.0,
                  gpus: float = 0.0, disk: float = 0.0,
-                 workdir: str = "/tmp/cook-agentd"):
+                 workdir: str = "/tmp/cook-agentd",
+                 ports_begin: int = 0, ports_end: int = 0,
+                 container_runtime: str = ""):
         agentd = build_agentd()
         if agentd is None:
             raise RuntimeError("cook_agentd unavailable (no C++ toolchain?)")
         Path(workdir).mkdir(parents=True, exist_ok=True)
         self.hostname = hostname
+        argv = [str(agentd), "--port", "0", "--hostname", hostname,
+                "--cpus", str(cpus), "--mem", str(mem), "--gpus", str(gpus),
+                "--disk", str(disk), "--workdir", workdir,
+                "--ports-begin", str(ports_begin),
+                "--ports-end", str(ports_end)]
+        if container_runtime:
+            argv += ["--container-runtime", container_runtime]
         self.proc = subprocess.Popen(
-            [str(agentd), "--port", "0", "--hostname", hostname,
-             "--cpus", str(cpus), "--mem", str(mem), "--gpus", str(gpus),
-             "--disk", str(disk), "--workdir", workdir],
-            stdout=subprocess.PIPE, text=True)
+            argv, stdout=subprocess.PIPE, text=True)
         line = self.proc.stdout.readline()
         if not line.startswith("PORT "):
             self.proc.kill()
@@ -310,15 +327,26 @@ class RemoteComputeCluster(ComputeCluster):
             if ev is None or not ev:
                 continue
             if ev[0] == "STATUS" and len(ev) >= 5:
+                ports = ([int(p) for p in ev[5].split(",") if p]
+                         if len(ev) >= 6 and ev[5] else [])
                 self._on_status(conn, task_id=ev[1], state=ev[2],
-                                exit_code=int(ev[3] or 0), sandbox=ev[4])
+                                exit_code=int(ev[3] or 0), sandbox=ev[4],
+                                ports=ports)
 
     def _on_status(self, conn: AgentConnection, task_id: str, state: str,
-                   exit_code: int, sandbox: str) -> None:
+                   exit_code: int, sandbox: str,
+                   ports: Optional[List[int]] = None) -> None:
         if self.store is not None and sandbox:
             try:
                 self.store.update_instance_sandbox(
                     task_id, sandbox_directory=sandbox)
+            except Exception:
+                pass
+        if self.store is not None and ports:
+            # assigned host-port writeback (mesos/task.clj:209-237 ->
+            # :instance/ports)
+            try:
+                self.store.update_instance_ports(task_id, ports)
             except Exception:
                 pass
         cb = self._status_callback
@@ -423,10 +451,17 @@ class RemoteComputeCluster(ComputeCluster):
                        Reasons.CONTAINER_LAUNCH_FAILED.code,
                        hostname=spec.hostname)
                 continue
+            container = spec.container or {}
             with tracing.span("remote.launch", cluster=self.name,
                               hostname=spec.hostname):
-                ok = conn.launch(spec.task_id, command,
-                                 spec.resources.cpus, spec.resources.mem)
+                ok = conn.launch(
+                    spec.task_id, command,
+                    spec.resources.cpus, spec.resources.mem,
+                    env=spec.env, port_count=spec.port_count,
+                    image=container.get("image", ""),
+                    volumes=[v if isinstance(v, str)
+                             else f"{v['host-path']}:{v['container-path']}"
+                             for v in container.get("volumes", [])])
             if not ok:
                 with self._lock:
                     self._tasks.pop(spec.task_id, None)

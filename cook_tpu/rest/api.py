@@ -83,7 +83,9 @@ def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
         "gpus": job.resources.gpus, "disk": job.resources.disk,
         "max_retries": job.max_retries, "max_runtime": job.max_runtime_ms,
         "submit_time": job.submit_time_ms, "labels": job.labels,
-        "env": job.env, "groups": [job.group] if job.group else [],
+        "env": job.env, "ports": job.ports,
+        "container": job.container,
+        "groups": [job.group] if job.group else [],
         "constraints": [[c.attribute, c.operator, c.pattern]
                         for c in job.constraints],
         "disable_mea_culpa_retries": job.disable_mea_culpa_retries,
@@ -147,6 +149,7 @@ def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
             labels=dict(spec.get("labels", {})),
             env=dict(spec.get("env", {})),
             container=spec.get("container"),
+            ports=int(spec.get("ports", 0)),
             constraints=constraints,
             group=spec.get("group"),
             disable_mea_culpa_retries=bool(

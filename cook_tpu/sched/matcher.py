@@ -332,7 +332,8 @@ class Matcher:
             cluster_rl.spend(offer.cluster)
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
                 task_id=task_id, job_uuid=job.uuid, hostname=offer.hostname,
-                slave_id=offer.slave_id, resources=job.resources))
+                slave_id=offer.slave_id, resources=job.resources,
+                env=job.env, port_count=job.ports, container=job.container))
             result.launched_task_ids.append(task_id)
         # per-cluster launches fan out in parallel (reference: future per
         # cluster, scheduler.clj:1034-1048) — one slow backend must not

@@ -346,7 +346,8 @@ class Scheduler:
             try:
                 cluster.launch_tasks(pool_name, [LaunchSpec(
                     task_id=task_id, job_uuid=job.uuid, hostname="",
-                    slave_id="", resources=job.resources)])
+                    slave_id="", resources=job.resources, env=job.env,
+                    port_count=job.ports, container=job.container)])
             finally:
                 cluster.kill_lock.release_read()
             result.launched_task_ids.append(task_id)

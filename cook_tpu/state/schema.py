@@ -192,6 +192,10 @@ class Job:
     labels: Dict[str, str] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
     container: Optional[Dict[str, Any]] = None
+    # count of host ports to assign at launch (reference: :job/ports,
+    # assigned from the offer's ranges in mesos/task.clj:209-237 and
+    # exported as PORT0.. in the task environment)
+    ports: int = 0
     constraints: List[Constraint] = field(default_factory=list)
     group: Optional[str] = None  # group uuid
     application: Optional[Application] = None

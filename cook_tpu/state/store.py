@@ -438,6 +438,19 @@ class Store:
 
         return self.transact(_update)
 
+    def update_instance_ports(self, task_id: str, ports) -> bool:
+        """Assigned host-port writeback (reference: instance ports land in
+        Datomic from the task launch, schema.clj instance :instance/ports)."""
+
+        def _update(txn: _Txn) -> bool:
+            inst = txn.instance_w(task_id)
+            if inst is None:
+                return False
+            inst.ports = list(ports)
+            return True
+
+        return self.transact(_update)
+
     def update_instance_sandbox(self, task_id: str,
                                 sandbox_directory: Optional[str] = None,
                                 output_url: Optional[str] = None) -> bool:

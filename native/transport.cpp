@@ -18,11 +18,21 @@
 //
 // Wire protocol (both directions): frame = u32_be payload_len, payload =
 // repeated (u32_be field_len + field_bytes); field[0] is the message type.
-//   driver -> agent:  LAUNCH(task_id, command, cpus, mem)
+//   driver -> agent:  LAUNCH(task_id, command, cpus, mem[, env, n_ports,
+//                            image, volumes])
+//                       env     = K=V pairs joined by 0x1e
+//                       n_ports = count of host ports to assign from the
+//                                 agent's --ports-begin/--ports-end range
+//                                 (reference: port assignment from offered
+//                                 ranges, mesos/task.clj:209-237)
+//                       image/volumes = container spec; volumes are
+//                                 host:container pairs joined by 0x1e
+//                                 (reference: mesos/task.clj:114-160
+//                                 container compilation)
 //                     KILL(task_id, grace_ms)  RECONCILE()  PING()
 //   agent  -> driver: REGISTERED(agent_id, hostname, cpus, mem, gpus, disk,
 //                                running_task_ids_csv)
-//                     STATUS(task_id, state, exit_code, sandbox)
+//                     STATUS(task_id, state, exit_code, sandbox, ports_csv)
 //                       state in {running, finished, failed, killed}
 //                     RECONCILE_DONE()  PONG()
 
@@ -144,6 +154,8 @@ struct AgentTask {
   int exit_code = 0;
   bool kill_requested = false;
   std::string sandbox;
+  std::vector<int> ports;      // host ports assigned to this task
+  std::string ports_csv;       // same, pre-joined for STATUS frames
   // STATUS-ordering handshake between agent_launch and the reaper: the
   // terminal STATUS must never be broadcast before the "running" STATUS
   // for the same task (a late "running" would make the driver re-adopt a
@@ -160,6 +172,14 @@ struct AgentState {
   std::mutex write_mu;             // serializes all frame writes
   std::string agent_id, hostname, workdir;
   double cpus = 1, mem = 1024, gpus = 0, disk = 0;
+  // Host port range offered for task port assignment ([begin, end)); empty
+  // range = no port resources (reference: the mesos offer's port ranges).
+  int ports_begin = 0, ports_end = 0;
+  std::set<int> ports_in_use;
+  // When set and a LAUNCH carries a container image, the task command is
+  // wrapped in "<runtime> run ..." (reference: the docker containerizer
+  // path of mesos/task.clj:114-160). Empty = run commands directly.
+  std::string container_runtime;
 };
 
 // Terminal tasks are kept for driver reconciliation but bounded: the map
@@ -192,7 +212,41 @@ void agent_broadcast(const std::vector<std::string>& fields) {
 
 void agent_status(const std::string& task_id, const AgentTask& t) {
   agent_broadcast({"STATUS", task_id, t.state, std::to_string(t.exit_code),
-                   t.sandbox});
+                   t.sandbox, t.ports_csv});
+}
+
+// Split s on sep into non-empty parts.
+std::vector<std::string> split_on(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t end = s.find(sep, start);
+    if (end == std::string::npos) end = s.size();
+    if (end > start) out.push_back(s.substr(start, end - start));
+    start = end + 1;
+  }
+  return out;
+}
+
+// caller holds g_agent->mu; returns false when the range cannot supply n
+bool alloc_ports_locked(int n, std::vector<int>* out) {
+  out->clear();
+  for (int p = g_agent->ports_begin;
+       p < g_agent->ports_end && static_cast<int>(out->size()) < n; ++p) {
+    if (!g_agent->ports_in_use.count(p)) out->push_back(p);
+  }
+  if (static_cast<int>(out->size()) < n) {
+    out->clear();
+    return false;
+  }
+  for (int p : *out) g_agent->ports_in_use.insert(p);
+  return true;
+}
+
+// caller holds g_agent->mu
+void release_ports_locked(AgentTask* t) {
+  for (int p : t->ports) g_agent->ports_in_use.erase(p);
+  t->ports.clear();  // ports_csv stays for reconciliation replay
 }
 
 // Reap exited children, classify, broadcast. waitpid(-1) is safe here: the
@@ -225,6 +279,7 @@ void agent_reaper() {
           kv.second.state = kv.second.kill_requested
                                 ? "killed"
                                 : (code == 0 ? "finished" : "failed");
+          release_ports_locked(&kv.second);
           note_terminal_locked(kv.first);
           if (kv.second.running_sent) {
             task_id = kv.first;
@@ -242,11 +297,16 @@ void agent_reaper() {
   }
 }
 
-void agent_launch(const std::string& task_id, const std::string& command) {
+void agent_launch(const std::string& task_id, const std::string& command,
+                  const std::string& env_kv, int n_ports,
+                  const std::string& image, const std::string& volumes) {
   std::string sandbox = g_agent->workdir + "/" + task_id;
   ::mkdir(sandbox.c_str(), 0755);
   AgentTask t;
   t.sandbox = sandbox;
+  // env pairs (K=V joined by 0x1e) and container volumes (host:cont, 0x1e)
+  std::vector<std::string> env_pairs = split_on(env_kv, '\x1e');
+  std::vector<std::string> vols = split_on(volumes, '\x1e');
   pid_t pid;
   {
     // Hold mu across fork() -> map insert: the reaper also takes mu before
@@ -254,28 +314,94 @@ void agent_launch(const std::string& task_id, const std::string& command) {
     // reaped-and-dropped before its task entry exists (the round-1 lost
     // exit-status race). The child only execs, it never touches the lock.
     std::lock_guard<std::mutex> lk(g_agent->mu);
-    pid = ::fork();
-    if (pid == 0) {
-      ::setsid();  // own session/process group: kill(-pid) reaches the tree
-      if (::chdir(sandbox.c_str()) != 0) _exit(127);
-      int out = ::open("stdout", O_CREAT | O_WRONLY | O_TRUNC, 0644);
-      int err = ::open("stderr", O_CREAT | O_WRONLY | O_TRUNC, 0644);
-      if (out >= 0) ::dup2(out, 1);
-      if (err >= 0) ::dup2(err, 2);
-      ::setenv("COOK_TASK_ID", task_id.c_str(), 1);
-      ::setenv("COOK_SANDBOX", sandbox.c_str(), 1);
-      ::execl("/bin/sh", "sh", "-c", command.c_str(), nullptr);
-      _exit(127);
-    }
-    if (pid < 0) {
+    if (n_ports > 0 && !alloc_ports_locked(n_ports, &t.ports)) {
+      // port range exhausted: the launch fails like any other resource
+      // shortfall (the reference would never have offered the ports)
       t.state = "failed";
-      t.exit_code = 127;
+      t.exit_code = 125;
       g_agent->tasks[task_id] = t;
       note_terminal_locked(task_id);
+      pid = -1;
     } else {
-      t.pid = pid;
-      t.state = "running";
-      g_agent->tasks[task_id] = t;
+      for (size_t i = 0; i < t.ports.size(); ++i) {
+        if (i) t.ports_csv += ",";
+        t.ports_csv += std::to_string(t.ports[i]);
+      }
+      pid = ::fork();
+      if (pid == 0) {
+        ::setsid();  // own session/process group: kill(-pid) reaches the tree
+        if (::chdir(sandbox.c_str()) != 0) _exit(127);
+        int out = ::open("stdout", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+        int err = ::open("stderr", O_CREAT | O_WRONLY | O_TRUNC, 0644);
+        if (out >= 0) ::dup2(out, 1);
+        if (err >= 0) ::dup2(err, 2);
+        ::setenv("COOK_TASK_ID", task_id.c_str(), 1);
+        ::setenv("COOK_SANDBOX", sandbox.c_str(), 1);
+        std::vector<std::string> env_keys = {"COOK_TASK_ID", "COOK_SANDBOX"};
+        for (const auto& kv : env_pairs) {
+          size_t eq = kv.find('=');
+          if (eq == std::string::npos || eq == 0) continue;
+          ::setenv(kv.substr(0, eq).c_str(), kv.substr(eq + 1).c_str(), 1);
+          env_keys.push_back(kv.substr(0, eq));
+        }
+        // PORTn/COOK_PORTn mirror the reference executor's environment
+        // (mesos/task.clj:209-237 assigns from offered ranges into env)
+        for (size_t i = 0; i < t.ports.size(); ++i) {
+          std::string v = std::to_string(t.ports[i]);
+          for (const std::string& prefix : {"PORT", "COOK_PORT"}) {
+            std::string k = prefix + std::to_string(i);
+            ::setenv(k.c_str(), v.c_str(), 1);
+            env_keys.push_back(k);
+          }
+        }
+        if (!t.ports_csv.empty()) {
+          ::setenv("COOK_PORTS", t.ports_csv.c_str(), 1);
+          env_keys.push_back("COOK_PORTS");
+        }
+        if (!image.empty() && !g_agent->container_runtime.empty()) {
+          // containerized exec: <runtime> run --rm --name cook-<task>
+          //   -v sandbox:/mnt/sandbox -v <vols> -e KEY... -p p:p... <image>
+          //   /bin/sh -c <command>
+          std::vector<std::string> args = {
+              g_agent->container_runtime, "run", "--rm",
+              "--name", "cook-" + task_id,
+              "-v", sandbox + ":/mnt/sandbox"};
+          for (const auto& v : vols) {
+            args.push_back("-v");
+            args.push_back(v);
+          }
+          for (const auto& k : env_keys) {
+            args.push_back("-e");
+            args.push_back(k);  // bare key: value inherited from our env
+          }
+          for (int p : t.ports) {
+            args.push_back("-p");
+            args.push_back(std::to_string(p) + ":" + std::to_string(p));
+          }
+          args.push_back(image);
+          args.push_back("/bin/sh");
+          args.push_back("-c");
+          args.push_back(command);
+          std::vector<char*> argv;
+          for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+          argv.push_back(nullptr);
+          ::execvp(argv[0], argv.data());
+          _exit(127);
+        }
+        ::execl("/bin/sh", "sh", "-c", command.c_str(), nullptr);
+        _exit(127);
+      }
+      if (pid < 0) {
+        t.state = "failed";
+        t.exit_code = 127;
+        release_ports_locked(&t);
+        g_agent->tasks[task_id] = t;
+        note_terminal_locked(task_id);
+      } else {
+        t.pid = pid;
+        t.state = "running";
+        g_agent->tasks[task_id] = t;
+      }
     }
   }
   if (pid < 0) {
@@ -351,7 +477,11 @@ void agent_connection(int fd) {
     if (f.empty()) continue;
     const std::string& type = f[0];
     if (type == "LAUNCH" && f.size() >= 3) {
-      agent_launch(f[1], f[2]);
+      agent_launch(f[1], f[2],
+                   f.size() > 5 ? f[5] : "",
+                   f.size() > 6 ? std::atoi(f[6].c_str()) : 0,
+                   f.size() > 7 ? f[7] : "",
+                   f.size() > 8 ? f[8] : "");
     } else if (type == "KILL" && f.size() >= 3) {
       agent_kill(f[1], std::atoi(f[2].c_str()));
     } else if (type == "RECONCILE") {
@@ -364,7 +494,7 @@ void agent_connection(int fd) {
         std::lock_guard<std::mutex> lk(g_agent->write_mu);
         send_frame(fd, {"STATUS", kv.first, kv.second.state,
                         std::to_string(kv.second.exit_code),
-                        kv.second.sandbox});
+                        kv.second.sandbox, kv.second.ports_csv});
       }
       std::lock_guard<std::mutex> lk(g_agent->write_mu);
       send_frame(fd, {"RECONCILE_DONE"});
@@ -400,6 +530,9 @@ int agent_main(int argc, char** argv) {
     else if (a == "--hostname") g_agent->hostname = v;
     else if (a == "--workdir") g_agent->workdir = v;
     else if (a == "--bind") bind_addr = v;
+    else if (a == "--ports-begin") g_agent->ports_begin = std::atoi(v);
+    else if (a == "--ports-end") g_agent->ports_end = std::atoi(v);
+    else if (a == "--container-runtime") g_agent->container_runtime = v;
   }
   g_agent->workdir += "/" + g_agent->hostname;
   mkdir_p(g_agent->workdir);
@@ -570,6 +703,17 @@ int ctd_launch(void* h, const char* task_id, const char* command, double cpus,
                double mem) {
   return ctd_send(h, {"LAUNCH", task_id, command, std::to_string(cpus),
                       std::to_string(mem)});
+}
+
+// Full launch spec: env = K=V pairs joined by 0x1e, n_ports = host ports to
+// assign, image/volumes = container spec (volumes host:cont joined by 0x1e).
+int ctd_launch2(void* h, const char* task_id, const char* command, double cpus,
+                double mem, const char* env, int n_ports, const char* image,
+                const char* volumes) {
+  return ctd_send(h, {"LAUNCH", task_id, command, std::to_string(cpus),
+                      std::to_string(mem), env ? env : "",
+                      std::to_string(n_ports), image ? image : "",
+                      volumes ? volumes : ""});
 }
 
 int ctd_kill(void* h, const char* task_id, int grace_ms) {

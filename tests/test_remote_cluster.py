@@ -332,3 +332,159 @@ class TestSchedulerIntegration:
             return store.job(job.uuid).state is JobState.COMPLETED
         assert wait_for(dead, timeout=15)
         cluster.shutdown()
+
+
+class TestPortsAndContainers:
+    """Port assignment + container compilation at launch (reference:
+    mesos/task.clj:114-294 — port ranges into PORT0../env, container
+    image/volumes compiled into every task)."""
+
+    def test_ports_assigned_and_recorded(self, tmp_path):
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        agent = LocalAgentProcess("nodeP", workdir=str(tmp_path),
+                                  ports_begin=21000, ports_end=21010)
+        try:
+            store = Store()
+            cluster = RemoteComputeCluster(
+                "remote-1", [("127.0.0.1", agent.port)], store=store)
+            cfg = Config()
+            cfg.default_matcher.backend = "cpu"
+            sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+            out = tmp_path / "ports.txt"
+            job = Job(uuid=new_uuid(), user="alice",
+                      command=f'echo "$PORT0 $PORT1 $COOK_PORT0" > {out}',
+                      ports=2, env={"MY_VAR": "my-value"},
+                      pool="default", resources=Resources(cpus=1.0, mem=64.0))
+            probe = tmp_path / "env.txt"
+            envjob = Job(uuid=new_uuid(), user="alice",
+                         command=f'echo "$MY_VAR" > {probe}',
+                         env={"MY_VAR": "my-value"},
+                         pool="default", resources=Resources(cpus=1.0, mem=64.0))
+            store.create_jobs([job, envjob])
+            sched.step_rank()
+            sched.step_match()
+
+            def done():
+                sched.flush_status_updates()
+                return (store.job(job.uuid).state is JobState.COMPLETED
+                        and store.job(envjob.uuid).state is JobState.COMPLETED)
+            assert wait_for(done, timeout=15)
+            insts = [store.instance(t) for t in store.job(job.uuid).instances]
+            inst = next(i for i in insts
+                        if i.status is InstanceStatus.SUCCESS)
+            assert len(inst.ports) == 2
+            assert all(21000 <= p < 21010 for p in inst.ports)
+            assert len(set(inst.ports)) == 2
+            # task saw its assigned ports in the environment
+            p0, p1, c0 = out.read_text().split()
+            assert [int(p0), int(p1)] == inst.ports
+            assert int(c0) == inst.ports[0]
+            # plain env passthrough
+            assert probe.read_text().strip() == "my-value"
+            cluster.shutdown()
+        finally:
+            agent.stop()
+
+    def test_port_exhaustion_fails_launch(self, tmp_path):
+        agent = LocalAgentProcess("nodeQ", workdir=str(tmp_path),
+                                  ports_begin=22000, ports_end=22001)
+        try:
+            conn = AgentConnection("127.0.0.1", agent.port)
+            assert conn.launch("t-ports", "sleep 5", 1, 64, port_count=2)
+            ev = conn.poll(timeout_ms=2000)
+            assert ev is not None and ev[:3] == ["STATUS", "t-ports", "failed"]
+            conn.close()
+        finally:
+            agent.stop()
+
+    def test_ports_released_after_terminal(self, tmp_path):
+        agent = LocalAgentProcess("nodeR", workdir=str(tmp_path),
+                                  ports_begin=23000, ports_end=23001)
+        try:
+            conn = AgentConnection("127.0.0.1", agent.port)
+            assert conn.launch("t-a", "true", 1, 64, port_count=1)
+            seen = []
+            while not any(e[1] == "t-a" and e[2] in ("finished", "failed")
+                          for e in seen):
+                ev = conn.poll(timeout_ms=3000)
+                assert ev is not None
+                seen.append(ev)
+            # the single port in the range is free again
+            assert conn.launch("t-b", "true", 1, 64, port_count=1)
+            seen = []
+            while not any(e[1] == "t-b" and e[2] == "finished" for e in seen):
+                ev = conn.poll(timeout_ms=3000)
+                assert ev is not None
+                seen.append(ev)
+            running = [e for e in seen if e[1] == "t-b" and e[2] == "running"]
+            assert running and running[0][5] == "23000"
+            conn.close()
+        finally:
+            agent.stop()
+
+    def test_container_launch_uses_runtime(self, tmp_path):
+        """A job with a container image runs through the configured runtime
+        (a recording fake standing in for docker/podman)."""
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        record = tmp_path / "runtime-args.txt"
+        fake_rt = tmp_path / "fake-docker"
+        # records its argv, then execs the trailing `/bin/sh -c <cmd>`
+        fake_rt.write_text(
+            "#!/bin/sh\n"
+            f'echo "$@" > {record}\n'
+            'while [ "$1" != "/bin/sh" ] && [ $# -gt 0 ]; do shift; done\n'
+            'exec "$@"\n')
+        fake_rt.chmod(0o755)
+
+        agent = LocalAgentProcess("nodeC", workdir=str(tmp_path / "w"),
+                                  container_runtime=str(fake_rt))
+        try:
+            store = Store()
+            cluster = RemoteComputeCluster(
+                "remote-1", [("127.0.0.1", agent.port)], store=store)
+            cfg = Config()
+            cfg.default_matcher.backend = "cpu"
+            sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+            out = tmp_path / "cout.txt"
+            job = Job(uuid=new_uuid(), user="alice",
+                      command=f"echo from-container > {out}",
+                      container={"image": "busybox:1.36",
+                                 "volumes": ["/data:/mnt/data"]},
+                      pool="default", resources=Resources(cpus=1.0, mem=64.0))
+            store.create_jobs([job])
+            sched.step_rank()
+            sched.step_match()
+
+            def done():
+                sched.flush_status_updates()
+                return store.job(job.uuid).state is JobState.COMPLETED
+            assert wait_for(done, timeout=15)
+            assert out.read_text().strip() == "from-container"
+            args = record.read_text()
+            assert "run" in args and "busybox:1.36" in args
+            assert "/data:/mnt/data" in args  # volume compiled in
+            cluster.shutdown()
+        finally:
+            agent.stop()
+
+    def test_no_runtime_runs_command_directly(self, tmp_path):
+        """Without --container-runtime the image is ignored and the command
+        still runs (documented fallback, not a silent failure)."""
+        agent = LocalAgentProcess("nodeD", workdir=str(tmp_path))
+        try:
+            conn = AgentConnection("127.0.0.1", agent.port)
+            assert conn.launch("t-c", "true", 1, 64, image="busybox")
+            seen = []
+            while not any(e[1] == "t-c" and e[2] == "finished" for e in seen):
+                ev = conn.poll(timeout_ms=3000)
+                assert ev is not None
+                seen.append(ev)
+            conn.close()
+        finally:
+            agent.stop()
