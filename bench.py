@@ -14,10 +14,13 @@ probed in a subprocess with a timeout, retried with backoff, and falls back
 to CPU; any failure still emits the single JSON line with an "error" field
 rather than a traceback.
 
-Kernel selection: on TPU the headline match path is the Pallas-preference
-auction kernel (ops/pallas_match.py) — the blockwise formulation built for
-large J x H — with the XLA auction and bit-exact greedy-scan kernels
-measured alongside for parity; off-TPU the XLA auction kernel is used.
+Kernel selection: every match kernel (bit-exact greedy scan, refresh
+auction, Pallas-preference auction on TPU, prefix-packing waterfill) is
+measured; the HEADLINE kernel is the fastest one whose assignment parity
+with the CPU reference greedy is >=99.9% (BASELINE.md's parity bar), so a
+fast-but-divergent kernel can never flatter the headline.  The large-J
+block benches the waterfill kernel at 10k considerable jobs — the regime
+where the sequential-greedy formulations stop being usable.
 
 Timing methodology: on tunneled/proxied devices `block_until_ready` can
 return before the computation lands and every host sync pays the tunnel
@@ -221,14 +224,15 @@ def make_match_workload(J, H, seed=1):
 def bench_match(J=1000, H=50_000, platform="cpu"):
     """Bin-pack 1k considerable jobs against 50k host offers.
 
-    Headline kernel on TPU: Pallas-preference auction (VERDICT r1 #9);
-    greedy-scan and XLA-auction are measured alongside for parity/compare.
+    All kernels (greedy scan, refresh auction, waterfill, Pallas auction on
+    TPU) are measured; the headline is the fastest one passing the 99.9%
+    assignment-parity bar vs the CPU reference greedy.
     """
     import jax.numpy as jnp
 
     from cook_tpu.ops import (MatchInputs, auction_match_kernel,
                               greedy_match_kernel, host_prep, reference_impl)
-    from cook_tpu.ops.match import auction_match_pallas
+    from cook_tpu.ops.match import auction_match_pallas, waterfill_match_kernel
 
     job_res, cmask, avail, capacity = make_match_workload(J, H)
     arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
@@ -246,7 +250,8 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
     placed_golden = int((golden >= 0).sum())
 
     kernels = {"greedy": lambda: greedy_match_kernel(inp)[0],
-               "auction": lambda: auction_match_kernel(inp)[0]}
+               "auction": lambda: auction_match_kernel(inp)[0],
+               "waterfill": lambda: waterfill_match_kernel(inp)[0]}
     if platform == "tpu":
         kernels["auction_pallas"] = lambda: auction_match_pallas(inp)[0]
     results = {}
@@ -257,6 +262,8 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
                 "times": timed(fn),
                 "synced": timed_synced(fn),
                 "parity_vs_cpu_greedy": float((assign == golden).mean()),
+                "placed_parity": float(((assign >= 0)
+                                        == (golden >= 0)).mean()),
                 "placed": int((assign >= 0).sum()),
                 "assign": assign,
             }
@@ -264,10 +271,16 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
             results[name] = {"error": str(e)[:300]}
             print(f"match kernel {name} failed: {e}", file=sys.stderr)
 
-    priority = (["auction_pallas"] if platform == "tpu" else []) \
-        + ["auction", "greedy"]
-    headline = next((n for n in priority if "times" in results.get(n, {})),
-                    None)
+    # Headline = fastest kernel meeting the >=99.9% assignment-parity bar
+    # (BASELINE.md); if none does, fastest meeting placement-count parity;
+    # if none, fastest that ran.  A divergent kernel can't flatter the
+    # headline (VERDICT r1 weak #1c).
+    ran = [(n, r) for n, r in results.items() if "times" in r]
+    ran.sort(key=lambda nr: pctl(nr[1]["times"], 50))
+    headline = next(
+        (n for n, r in ran if r["parity_vs_cpu_greedy"] >= 0.999),
+        next((n for n, r in ran if r["placed_parity"] >= 0.999),
+             ran[0][0] if ran else None))
     if headline is None:  # every kernel failed: keep the rank/rebalance
         detail["match_error"] = "; ".join(
             f"{n}: {r.get('error', '?')}" for n, r in results.items())
@@ -290,7 +303,8 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
                   f"amortized_p50={pctl(r['times'],50):.2f}ms "
                   f"p99={pctl(r['times'],99):.2f}ms "
                   f"synced_p50={pctl(r['synced'],50):.1f}ms "
-                  f"placed={r['placed']} parity={r['parity_vs_cpu_greedy']:.4f}",
+                  f"placed={r['placed']} parity={r['parity_vs_cpu_greedy']:.4f} "
+                  f"placed_parity={r['placed_parity']:.4f}",
                   file=sys.stderr)
     print(f"match cpu={cpu_ms:.0f}ms placed={placed_golden} "
           f"headline={headline}", file=sys.stderr)
@@ -300,6 +314,7 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
                 "p99_ms": round(pctl(r["times"], 99), 3),
                 "synced_p50_ms": round(pctl(r["synced"], 50), 1),
                 "parity_vs_cpu_greedy": r["parity_vs_cpu_greedy"],
+                "placed_parity": r["placed_parity"],
                 "placed": r["placed"]} if "times" in r else r)
         for name, r in results.items()}
     # bit-exact parity belongs to the greedy kernel; the headline kernel's
@@ -308,6 +323,45 @@ def bench_match(J=1000, H=50_000, platform="cpu"):
         "greedy", {}).get("parity_vs_cpu_greedy")
     return (times, synced, cpu_ms, hl.get("parity_vs_cpu_greedy", 0.0),
             hl.get("placed", 0), detail)
+
+
+def bench_match_large(J=10_000, H=50_000):
+    """Large-J match: 10k considerable jobs x 50k hosts — the regime where
+    the J-step sequential formulations (Fenzo's loop, the greedy scan) stop
+    being usable.  Kernel: prefix-packing waterfill (no J x H work)."""
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import MatchInputs, host_prep, reference_impl
+    from cook_tpu.ops.match import waterfill_match_kernel
+
+    job_res, cmask, avail, capacity = make_match_workload(J, H, seed=3)
+    arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+    inp = MatchInputs(
+        job_res=jnp.asarray(arrays["job_res"]),
+        constraint_mask=jnp.asarray(arrays["constraint_mask"]),
+        avail=jnp.asarray(arrays["avail"]),
+        capacity=jnp.asarray(arrays["capacity"]),
+        valid=jnp.asarray(arrays["valid"]))
+
+    fn = lambda: waterfill_match_kernel(inp)[0]  # noqa: E731
+    assign = np.asarray(fn())[:J]
+    times = timed(fn)
+    t0 = time.perf_counter()
+    golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+    cpu_ms = (time.perf_counter() - t0) * 1000
+    out = {
+        "p50_ms": round(pctl(times, 50), 3),
+        "p99_ms": round(pctl(times, 99), 3),
+        "placed": int((assign >= 0).sum()),
+        "placed_parity": float(((assign >= 0) == (golden >= 0)).mean()),
+        "cpu_greedy_ms": round(cpu_ms, 1),
+    }
+    print(f"match_large[waterfill][{J//1000}k x {H//1000}k] "
+          f"amortized_p50={out['p50_ms']}ms p99={out['p99_ms']}ms "
+          f"placed={out['placed']}/{int((golden >= 0).sum())} "
+          f"placed_parity={out['placed_parity']:.4f} cpu={cpu_ms:.0f}ms",
+          file=sys.stderr)
+    return out
 
 
 def bench_rebalance(T=1_000_000, H=50_000):
@@ -351,20 +405,18 @@ def bench_rebalance(T=1_000_000, H=50_000):
     return times
 
 
-def bench_end2end(total=100_000, n_users=200, J=1000, H=5000, reps=5,
-                  platform="cpu"):
+def bench_end2end(total=100_000, n_users=200, J=1000, H=5000, reps=5):
     """Full-cycle wall time INCLUDING all host-side work (VERDICT r1 #3):
     entity lists -> pack -> device put -> rank kernel -> considerable prefix
-    -> constraint mask -> match kernel -> assignments back on host.
-    Uses the same headline match kernel as bench_match (pallas on TPU)."""
+    -> constraint mask -> match kernel -> assignments back on host."""
     import jax.numpy as jnp
 
     from cook_tpu.ops import MatchInputs, host_prep, rank_kernel
     from cook_tpu.ops.dru import RankInputs
-    from cook_tpu.ops.match import auction_match_kernel, auction_match_pallas
+    from cook_tpu.ops.match import greedy_match_kernel
 
-    match_fn = (auction_match_pallas if platform == "tpu"
-                else auction_match_kernel)
+    # the production "auto" backend at J=1000 considerable: bit-exact greedy
+    match_fn = greedy_match_kernel
 
     users, shares, quotas = make_rank_workload(n_users, total, seed=7)
     job_res, cmask, avail, capacity = make_match_workload(J, H, seed=8)
@@ -416,9 +468,15 @@ def main():
         (match_times, match_synced, match_cpu, parity, placed,
          match_detail) = bench_match(
             J=scaled(1000), H=scaled(50_000), platform=platform)
+        try:
+            match_large = bench_match_large(J=scaled(10_000),
+                                            H=scaled(50_000))
+        except Exception as e:  # the largest shape must not sink the bench
+            match_large = {"error": str(e)[:300]}
+            print(f"match_large failed: {e}", file=sys.stderr)
         reb_times = bench_rebalance(T=scaled(1_000_000), H=scaled(50_000))
         e2e = bench_end2end(total=scaled(100_000), n_users=scaled(200, lo=8),
-                            J=scaled(1000), H=scaled(5000), platform=platform)
+                            J=scaled(1000), H=scaled(5000))
         cycle = [r + m for r, m in zip(rank_times, match_times)]
         cycle_p50, cycle_p99 = pctl(cycle, 50), pctl(cycle, 99)
         cpu_total = rank_cpu + match_cpu
@@ -435,6 +493,7 @@ def main():
             "match_1k_jobs_50k_hosts_p50_ms": round(pctl(match_times, 50), 3),
             "match_p99_ms": round(pctl(match_times, 99), 3),
             "match_synced_p50_ms": round(pctl(match_synced, 50), 1),
+            "match_large_10k_jobs_50k_hosts": match_large,
             "rebalance_1M_tasks_p50_ms": round(pctl(reb_times, 50), 3),
             "rebalance_p99_ms": round(pctl(reb_times, 99), 3),
             "end2end_100k_cycle_p50_ms": round(pctl(e2e, 50), 1),

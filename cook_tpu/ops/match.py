@@ -10,12 +10,16 @@ cpuMemBinPacker per config.clj:108) with two TPU formulations:
   fallback (``reference_impl.greedy_match``); the sequential carry is only
   the H x R availability matrix.
 
-* :func:`multipass_match_kernel` — K rounds of "every unassigned job picks
-  its best host in parallel, then per-host prefix-sum conflict resolution in
-  rank order".  One round is O(J*H) fully-parallel work, so XLA tiles it onto
-  the MXU/VPU without a J-length dependency chain; a handful of rounds
-  converges to the greedy answer for real offer distributions (parity is
-  asserted statistically in tests, >=99.9% per BASELINE.md).
+* :func:`auction_match_kernel` — refresh passes of "rebuild every unassigned
+  job's top-K preferred hosts against current availability, then K rounds of
+  propose + per-host prefix-sum admission in rank order".  One refresh is
+  O(J*H) fully-parallel work, so XLA tiles it onto the MXU/VPU without a
+  J-length dependency chain; placement-count parity with greedy is asserted
+  statistically in tests (>=99.9% per BASELINE.md).
+
+* :func:`waterfill_match_kernel` — prefix-packing over hosts sorted
+  tightest-first; NO J x H work at all (O(H log H + J log J) per round), the
+  mode for very large considerable sets where even one J x H pass is heavy.
 
 Both kernels take a precompiled constraint mask (bool[J, H]) — the host-side
 constraint compiler (cook_tpu.sched.constraints) lowers the reference's
@@ -82,13 +86,31 @@ def greedy_match_kernel(inp: MatchInputs) -> Tuple[jax.Array, jax.Array]:
                          inp.avail, inp.capacity)
 
 
-@functools.partial(jax.jit, static_argnames=("num_prefs", "num_rounds"))
+def _build_prefs(inp: MatchInputs, assign: jax.Array, avail: jax.Array,
+                 K: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-K hosts per unassigned job by bin-packing fitness against the
+    CURRENT availability (one J x H pass, MXU/VPU-friendly)."""
+    feasible = (jnp.all(avail[None, :, :] >= inp.job_res[:, None, :], axis=2)
+                & inp.constraint_mask & inp.valid[:, None]
+                & (assign < 0)[:, None])
+    used = inp.capacity - avail
+    cap = jnp.maximum(inp.capacity, 1e-9)
+    fit = (used[None, :, 0] + inp.job_res[:, 0:1]) / cap[None, :, 0] \
+        + (used[None, :, 1] + inp.job_res[:, 1:2]) / cap[None, :, 1]
+    fit = jnp.where(feasible, fit * 0.5, NEG_INF)
+    return jax.lax.top_k(fit, K)                       # [J, K] each
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_prefs", "num_rounds", "num_refresh"))
 def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
-                         num_rounds: int = 24) -> Tuple[jax.Array, jax.Array]:
+                         num_rounds: int = 8, num_refresh: int = 8
+                         ) -> Tuple[jax.Array, jax.Array]:
     """Parallel top-K auction assignment for large J.
 
-    Every job precomputes its ``num_prefs`` best hosts by bin-packing fitness
-    (one J x H pass, MXU/VPU-friendly), then ``num_rounds`` rounds of:
+    ``num_refresh`` outer passes; each rebuilds every unassigned job's
+    ``num_prefs`` best hosts against the *current* availability, then runs
+    ``num_rounds`` rounds of:
 
       1. every unassigned job proposes to its current preference;
       2. proposals are grouped per host (one lexsort) and admitted in rank
@@ -98,48 +120,60 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
          advance their preference pointer (availability only shrinks within a
          cycle, so advancing is safe); contended-but-feasible jobs retry.
 
-    The first-ranked feasible proposer on a host always fits its own prefix,
-    so every contended host admits at least one job per round.  This trades
-    the greedy kernel's J-step dependency chain for ~num_rounds data-parallel
-    steps; placement decisions can deviate from greedy (fitness is computed
-    against the cycle-start availability), which the tests bound
-    statistically — the greedy kernel remains the bit-exact parity mode.
+    The refresh pass is what makes the kernel converge under bin-packing
+    fitness: all jobs rank the same tightest hosts, so a single static
+    preference list herds onto (and exhausts) K hosts; rebuilding against
+    post-admission availability moves the herd to the next-tightest hosts
+    exactly the way the sequential greedy's evolving fitness does.  Placement
+    decisions can still deviate from greedy (tests bound them statistically);
+    the greedy kernel remains the bit-exact parity mode.
     """
     J, H = inp.constraint_mask.shape
-    feasible0 = (jnp.all(inp.avail[None, :, :] >= inp.job_res[:, None, :], axis=2)
-                 & inp.constraint_mask & inp.valid[:, None])
-    used = inp.capacity - inp.avail
-    cap = jnp.maximum(inp.capacity, 1e-9)
-    fit = (used[None, :, 0] + inp.job_res[:, 0:1]) / cap[None, :, 0] \
-        + (used[None, :, 1] + inp.job_res[:, 1:2]) / cap[None, :, 1]
-    fit = jnp.where(feasible0, fit * 0.5, NEG_INF)
     K = min(num_prefs, H)
-    pref_fit, pref_host = jax.lax.top_k(fit, K)        # [J, K]
-    return _auction_rounds(inp, pref_fit, pref_host, num_rounds)
+
+    def refresh(state, _):
+        assign, avail = state
+        pref_fit, pref_host = _build_prefs(inp, assign, avail, K)
+        assign, avail = _auction_rounds(inp, pref_fit, pref_host, num_rounds,
+                                        assign=assign, avail=avail)
+        return (assign, avail), None
+
+    init = (jnp.full((J,), -1, dtype=jnp.int32), inp.avail)
+    (assign, avail), _ = jax.lax.scan(refresh, init, None, length=num_refresh)
+    return assign, avail
 
 
 def auction_match_pallas(inp: MatchInputs, *, num_prefs: int = 16,
-                         num_rounds: int = 24, interpret=None
-                         ) -> Tuple[jax.Array, jax.Array]:
+                         num_rounds: int = 8, num_refresh: int = 8,
+                         interpret=None) -> Tuple[jax.Array, jax.Array]:
     """Auction assignment whose preference build runs as a blockwise Pallas
-    kernel (ops/pallas_match.py) — same result as
+    kernel (ops/pallas_match.py) — same refresh structure as
     :func:`auction_match_kernel`, but the J x H score matrix never touches
-    HBM.  Preferred on TPU at large J x H."""
+    HBM.  The refresh loop is host-side (each pass = one Pallas dispatch +
+    one jitted round block), so the device shapes stay static."""
     from . import pallas_match
-    pref_fit, pref_host = pallas_match.topk_prefs(
-        inp.job_res, inp.constraint_mask, inp.valid, inp.avail, inp.capacity,
-        k=num_prefs, interpret=interpret)
-    return _auction_rounds_jit(inp, pref_fit, pref_host,
-                               num_rounds=num_rounds)
+    J = inp.constraint_mask.shape[0]
+    assign = jnp.full((J,), -1, dtype=jnp.int32)
+    avail = inp.avail
+    for _ in range(num_refresh):
+        pref_fit, pref_host = pallas_match.topk_prefs(
+            inp.job_res, inp.constraint_mask, inp.valid & (assign < 0),
+            avail, inp.capacity, k=num_prefs, interpret=interpret)
+        assign, avail = _auction_rounds_jit(inp, pref_fit, pref_host, assign,
+                                            avail, num_rounds=num_rounds)
+    return assign, avail
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds",))
-def _auction_rounds_jit(inp, pref_fit, pref_host, *, num_rounds):
-    return _auction_rounds(inp, pref_fit, pref_host, num_rounds)
+def _auction_rounds_jit(inp, pref_fit, pref_host, assign, avail, *,
+                        num_rounds):
+    return _auction_rounds(inp, pref_fit, pref_host, num_rounds,
+                           assign=assign, avail=avail)
 
 
 def _auction_rounds(inp: MatchInputs, pref_fit: jax.Array,
-                    pref_host: jax.Array, num_rounds: int
+                    pref_host: jax.Array, num_rounds: int,
+                    assign: jax.Array, avail: jax.Array
                     ) -> Tuple[jax.Array, jax.Array]:
     J, H = inp.constraint_mask.shape
     job_idx = jnp.arange(J, dtype=jnp.int32)
@@ -174,6 +208,98 @@ def _auction_rounds(inp: MatchInputs, pref_fit: jax.Array,
             num_segments=H)
         avail = avail - consumed
         return (assign, avail, ptr), None
+
+    init = (assign, avail, jnp.zeros((J,), dtype=jnp.int32))
+    (assign, avail, _), _ = jax.lax.scan(one_round, init, None,
+                                         length=num_rounds)
+    return assign, avail
+
+
+@functools.partial(jax.jit, static_argnames=("num_rounds",))
+def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 24
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Prefix-packing ("waterfill") assignment: the large-J kernel.
+
+    The sequential greedy under bin-packing fitness fills hosts one at a
+    time in tightness order — job j lands roughly where its cumulative
+    demand prefix falls across the cumulative spare capacity of hosts
+    sorted tightest-first.  This kernel computes that correspondence
+    directly each round:
+
+      1. sort hosts by current utilization (tightest first) -> sigma;
+      2. cum_cap = cumsum(avail[sigma]); cum_dem = cumsum over still-active
+         jobs in rank order; job j proposes to sigma[k_j] with
+         k_j = max over resources of searchsorted(cum_cap_r, cum_dem_jr)
+         (the binding resource decides), plus a per-job skip offset that
+         advances past hosts rejected by the constraint mask or an
+         individual-fit check;
+      3. per-host prefix admission in rank order (as in the auction
+         kernel); losers retry next round against updated availability.
+
+    One round is O(H log H + J log J) with NO J x H work at all, and jobs
+    spread across *many* hosts per round (the auction/greedy formulations
+    admit ~one host's worth per sequential step).  Decisions deviate from
+    greedy only at host boundaries and constraint holes; tests bound the
+    deviation statistically, and the greedy kernel remains the bit-exact
+    mode.  Reference being replaced: the Fenzo scheduleOnce loop,
+    scheduler.clj:617-687.
+
+    Constraint-mask scope: the mask is a SAFETY guarantee (a masked host is
+    never assigned — admission checks it), not a completeness one.  The
+    exponential probe can step over a sparse row's few allowed hosts, so a
+    job restricted to specific hosts may go unplaced even with capacity
+    free.  The production ``auto`` backend therefore routes sparse-mask
+    jobs to the exact greedy scan and only bulk dense-mask jobs here
+    (sched/matcher.py).
+    """
+    J, H = inp.constraint_mask.shape
+    R = inp.job_res.shape[1]
+    rank = jnp.arange(J, dtype=jnp.int32)
+    cap = jnp.maximum(inp.capacity, 1e-9)
+
+    def one_round(state, _):
+        assign, avail, skip = state
+        active = (assign < 0) & inp.valid & (skip < H)
+        util = ((cap[:, 0] - avail[:, 0]) / cap[:, 0]
+                + (cap[:, 1] - avail[:, 1]) / cap[:, 1]) * 0.5
+        sigma = jnp.argsort(-util)                     # tightest first
+        cum_cap = jnp.cumsum(avail[sigma], axis=0)     # [H, R]
+        dem = jnp.where(active[:, None], inp.job_res, 0.0)
+        cum_dem = jnp.cumsum(dem, axis=0)              # [J, R]
+        k = jnp.zeros((J,), dtype=jnp.int32)
+        for r in range(R):                             # R is static (4)
+            k = jnp.maximum(k, jnp.searchsorted(
+                cum_cap[:, r], cum_dem[:, r], side="left").astype(jnp.int32))
+        k = jnp.clip(k + skip, 0, H - 1)
+        cand = sigma[k]
+        fits = (jnp.all(avail[cand] >= inp.job_res, axis=1)
+                & inp.constraint_mask[rank, cand])
+        proposes = active & fits
+        # exponential probe on rejection: hosts later in sigma are emptier
+        # and more likely to fit, and a +1 crawl converges one host per
+        # round; doubling reaches a fitting host in O(log H) rounds
+        skip = jnp.where(active & ~fits, skip * 2 + 1, skip)
+        # a successful admission resets the probe for the next proposal
+        skip = jnp.where(proposes, 0, skip)
+
+        choice = jnp.where(proposes, cand, H)
+        order = jnp.lexsort((rank, choice))
+        sorted_choice = choice[order]
+        sorted_res = inp.job_res[order] * (sorted_choice < H)[:, None]
+        first = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool),
+             sorted_choice[1:] != sorted_choice[:-1]])
+        seg_cum = scanlib.segmented_cumsum(sorted_res, first)
+        host_avail = avail[jnp.minimum(sorted_choice, H - 1)]
+        fits_prefix = (jnp.all(seg_cum <= host_avail, axis=1)
+                       & (sorted_choice < H))
+        admitted = jnp.zeros((J,), dtype=bool).at[order].set(fits_prefix)
+        assign = jnp.where(admitted, choice, assign)
+        consumed = jax.ops.segment_sum(
+            inp.job_res * admitted[:, None], jnp.minimum(choice, H - 1),
+            num_segments=H)
+        avail = avail - consumed
+        return (assign, avail, skip), None
 
     init = (jnp.full((J,), -1, dtype=jnp.int32), inp.avail,
             jnp.zeros((J,), dtype=jnp.int32))

@@ -234,8 +234,8 @@ class Matcher:
                 o.capacity.disk] for o in offers]
 
         with tracing.span("match.schedule-once", pool=pool_name,
-                          backend=mc.backend, jobs=len(considerable),
-                          offers=len(offers)):
+                          backend=self.resolve_backend(mc, len(considerable)),
+                          jobs=len(considerable), offers=len(offers)):
             assign = self._dispatch(mc, job_res, cmask, avail, cap)
             assign = validate_group_placement(considerable, assign, offers, ctx)
         self.record_placement_failures(considerable, assign, offers, ctx)
@@ -270,12 +270,53 @@ class Matcher:
             self.store.set_placement_investigation(
                 job.uuid, under_investigation=False, failure=summary)
 
+    @staticmethod
+    def resolve_backend(mc: MatcherConfig, num_jobs: int) -> str:
+        """Concrete kernel for ``auto``: bit-exact greedy while the scan
+        length is affordable, the no-JxH waterfill kernel beyond
+        (VERDICT r1 #9 — large-J selection is automatic per pool size)."""
+        if mc.backend != "auto":
+            return mc.backend
+        return ("tpu-greedy" if num_jobs <= mc.auto_large_j_threshold
+                else "tpu-waterfill")
+
     def _dispatch(self, mc: MatcherConfig, job_res, cmask, avail, cap
                   ) -> np.ndarray:
         if mc.backend == "cpu":
             return reference_impl.greedy_match(
                 np.asarray(job_res, dtype=F32), cmask,
                 np.asarray(avail, dtype=F32), np.asarray(cap, dtype=F32))
+        backend = self.resolve_backend(mc, len(job_res))
+        if backend == "tpu-waterfill" and mc.backend == "auto" \
+                and len(job_res):
+            # The prefix-packing kernel's constraint-mask support is
+            # safety-only (ops/match.py): a sparse row's few allowed hosts
+            # can be probed over.  Bulk dense-mask jobs go through
+            # waterfill; the constrained minority is matched exactly by the
+            # greedy scan against the remaining availability.
+            sparse = np.asarray(cmask).mean(axis=1) < mc.sparse_cmask_density
+            if sparse.any():
+                J = len(job_res)
+                assign = np.full(J, -1, dtype=np.int32)
+                avail_left = avail
+                didx = np.flatnonzero(~sparse)
+                if didx.size:
+                    a, avail_left = self._run_kernel(
+                        "tpu-waterfill", mc, job_res[didx], cmask[didx],
+                        avail_left, cap)
+                    assign[didx] = a
+                sidx = np.flatnonzero(sparse)
+                a, _ = self._run_kernel(
+                    "tpu-greedy", mc, job_res[sidx], cmask[sidx],
+                    avail_left, cap)
+                assign[sidx] = a
+                return assign
+        return self._run_kernel(backend, mc, job_res, cmask, avail, cap)[0]
+
+    def _run_kernel(self, backend: str, mc: MatcherConfig, job_res, cmask,
+                    avail, cap):
+        """One kernel call; returns (assign over real jobs, remaining
+        host availability over real hosts)."""
         import jax.numpy as jnp
         from ..ops import MatchInputs, auction_match_kernel, greedy_match_kernel
         arrays = host_prep.pack_match_inputs(job_res, cmask, avail, cap)
@@ -285,19 +326,27 @@ class Matcher:
             avail=jnp.asarray(arrays["avail"]),
             capacity=jnp.asarray(arrays["capacity"]),
             valid=jnp.asarray(arrays["valid"]))
-        if mc.backend == "tpu-auction-pallas":
+        if backend == "tpu-auction-pallas":
             # blockwise-VMEM preference build; J x H never touches HBM
             from ..ops.match import auction_match_pallas
-            assign, _ = auction_match_pallas(
+            assign, left = auction_match_pallas(
                 inp, num_prefs=mc.auction_num_prefs,
-                num_rounds=mc.auction_num_rounds)
-        elif mc.backend == "tpu-auction":
-            assign, _ = auction_match_kernel(
+                num_rounds=mc.auction_num_rounds,
+                num_refresh=mc.auction_num_refresh)
+        elif backend == "tpu-auction":
+            assign, left = auction_match_kernel(
                 inp, num_prefs=mc.auction_num_prefs,
-                num_rounds=mc.auction_num_rounds)
+                num_rounds=mc.auction_num_rounds,
+                num_refresh=mc.auction_num_refresh)
+        elif backend == "tpu-waterfill":
+            from ..ops.match import waterfill_match_kernel
+            assign, left = waterfill_match_kernel(
+                inp, num_rounds=mc.waterfill_num_rounds)
         else:
-            assign, _ = greedy_match_kernel(inp)
-        return np.asarray(assign)[:arrays["num_jobs"]]
+            assign, left = greedy_match_kernel(inp)
+        n_hosts = len(avail)
+        return (np.asarray(assign)[:arrays["num_jobs"]],
+                np.asarray(left)[:n_hosts])
 
     # ---------------------------------------------------------------- launch
     def _launch(self, pool_name: str, result: MatchCycleResult,

@@ -144,3 +144,86 @@ class TestMultipass:
         # cycle-start availability.  The greedy kernel is the bit-exact mode.
         assert placed_multi >= 0.999 * placed_golden
         assert agree / total > 0.15  # sanity: choices correlate with greedy
+
+
+class TestWaterfill:
+    """Prefix-packing large-J kernel: safety (never oversubscribes, honors
+    the constraint mask) + statistical placement parity with greedy."""
+
+    def test_never_oversubscribes_and_respects_cmask(self):
+        from cook_tpu.ops.match import waterfill_match_kernel
+        for seed in range(4):
+            rng = np.random.default_rng(300 + seed)
+            J, H = 80, 20
+            job_res, cmask, avail, capacity = random_case(rng, J, H, tight=True)
+            arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+            assign, left = waterfill_match_kernel(to_inputs(arrays))
+            assign = np.asarray(assign)[:J]
+            assert (np.asarray(left)[:H] >= -1e-3).all()
+            used = np.zeros_like(avail)
+            for j, h in enumerate(assign):
+                if h >= 0:
+                    assert cmask[j, h]
+                    used[h] += job_res[j]
+            assert (used <= avail + 1e-3).all()
+
+    def test_placement_count_parity_with_greedy(self):
+        from cook_tpu.ops.match import waterfill_match_kernel
+        placed_golden = placed_wf = 0
+        for seed in range(8):
+            rng = np.random.default_rng(400 + seed)
+            J, H = 100, 30
+            job_res, cmask, avail, capacity = random_case(rng, J, H)
+            golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+            arrays = host_prep.pack_match_inputs(job_res, cmask, avail, capacity)
+            assign, _ = waterfill_match_kernel(to_inputs(arrays))
+            placed_golden += int((golden >= 0).sum())
+            placed_wf += int((np.asarray(assign)[:J] >= 0).sum())
+        assert placed_wf >= 0.99 * placed_golden
+
+    def test_matcher_auto_backend_selects_by_size(self):
+        """backend="auto" routes small considerable sets to the bit-exact
+        greedy scan and large ones to waterfill (VERDICT r1 #9)."""
+        from cook_tpu.config import MatcherConfig
+        from cook_tpu.sched.matcher import Matcher
+
+        rng = np.random.default_rng(7)
+        J, H = 12, 6
+        job_res, cmask, avail, capacity = random_case(rng, J, H)
+        m = Matcher.__new__(Matcher)  # dispatch only; no scheduler wiring
+        mc = MatcherConfig(backend="auto", auto_large_j_threshold=8)
+        a_large = m._dispatch(mc, job_res, cmask, avail, capacity)
+        mc_small = MatcherConfig(backend="auto", auto_large_j_threshold=1000)
+        a_small = m._dispatch(mc_small, job_res, cmask, avail, capacity)
+        golden = reference_impl.greedy_match(job_res, cmask, avail, capacity)
+        # small path is the bit-exact greedy kernel
+        assert (a_small == golden).all()
+        # large path still places a comparable count without oversubscribing
+        assert (a_large >= 0).sum() >= 0.9 * (golden >= 0).sum()
+
+    def test_auto_backend_places_constraint_restricted_job(self):
+        """A job whose cmask allows exactly one host must still be placed
+        when the auto backend routes the bulk through waterfill (the
+        exponential probe can step over sparse rows; the matcher routes
+        sparse-mask jobs to the exact greedy scan instead)."""
+        from cook_tpu.config import MatcherConfig
+        from cook_tpu.sched.matcher import Matcher
+
+        rng = np.random.default_rng(9)
+        J, H = 17, 16
+        job_res, cmask, avail, capacity = random_case(rng, J, H)
+        cmask[:] = True
+        cmask[0, :] = False
+        cmask[0, 2] = True            # job 0 may only run on host 2
+        avail[:] = capacity           # plenty of room everywhere
+        m = Matcher.__new__(Matcher)
+        mc = MatcherConfig(backend="auto", auto_large_j_threshold=4)
+        assign = m._dispatch(mc, job_res, cmask, avail, capacity)
+        assert assign[0] == 2
+        # dense bulk placed too, never on a masked host, never oversubscribed
+        used = np.zeros_like(avail)
+        for j, h in enumerate(assign):
+            if h >= 0:
+                assert cmask[j, h]
+                used[h] += job_res[j]
+        assert (used <= avail + 1e-3).all()
