@@ -89,6 +89,7 @@ class TaskExecutor:
                  env: Optional[Dict[str, str]] = None,
                  progress_regex: str = DEFAULT_PROGRESS_REGEX,
                  progress_publish: Optional[Callable] = None,
+                 progress_file: Optional[str] = None,
                  kill_grace_period_s: float = 2.0,
                  shell: str = "/bin/sh"):
         self.command = command
@@ -97,6 +98,11 @@ class TaskExecutor:
         self.kill_grace_period_s = kill_grace_period_s
         self.shell = shell
         self.watcher = ProgressWatcher(progress_regex, progress_publish)
+        # explicit progress file, tailed alongside stdout/stderr
+        # (reference: :job/progress-output-file; progress.py watches the
+        # EXECUTOR_PROGRESS_OUTPUT_FILE location)
+        self.progress_file = (self.sandbox / progress_file
+                              if progress_file else None)
         self.process: Optional[subprocess.Popen] = None
         self.exit_code: Optional[int] = None
         self._reader_threads = []
@@ -108,6 +114,9 @@ class TaskExecutor:
         env = dict(os.environ)
         env.update(self.env)
         env["COOK_WORKDIR"] = str(self.sandbox)
+        if self.progress_file is not None:
+            # advertised BEFORE the fork so the task can locate its file
+            env["EXECUTOR_PROGRESS_OUTPUT_FILE"] = str(self.progress_file)
         self.process = subprocess.Popen(
             [self.shell, "-c", self.command],
             cwd=str(self.sandbox), env=env,
@@ -119,6 +128,34 @@ class TaskExecutor:
                                  daemon=True)
             t.start()
             self._reader_threads.append(t)
+        if self.progress_file is not None:
+            t = threading.Thread(target=self._tail_progress_file,
+                                 daemon=True)
+            t.start()
+
+    def _tail_progress_file(self) -> None:
+        """Tail the job's explicit progress file while the task runs; the
+        file may not exist until the task writes it."""
+        pos = 0
+        while True:
+            alive = self.process is not None and self.process.poll() is None
+            try:
+                with open(self.progress_file, "rb") as f:
+                    f.seek(pos)
+                    for raw in iter(f.readline, b""):
+                        if not raw.endswith(b"\n") and alive:
+                            break  # partial line: re-read next pass
+                        pos += len(raw)
+                        try:
+                            self.watcher.observe_line(
+                                raw.decode("utf-8", errors="replace"))
+                        except Exception:
+                            pass
+            except OSError:
+                pass
+            if not alive:
+                return
+            time.sleep(0.1)
 
     def _pump(self, stream, name: str) -> None:
         """Stream output to the sandbox file, watching for progress

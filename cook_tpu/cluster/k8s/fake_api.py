@@ -28,6 +28,11 @@ class FakeNode:
     gpu_model: str = ""
 
 
+from .types import Lease
+
+FakeLease = Lease  # back-compat alias; the type itself is adapter-neutral
+
+
 @dataclass
 class FakePod:
     name: str
@@ -72,6 +77,7 @@ class FakeKubernetesApi:
         self._lock = threading.RLock()
         self._nodes: Dict[str, FakeNode] = {}
         self._pods: Dict[str, FakePod] = {}
+        self._leases: Dict[str, FakeLease] = {}
         self._rv = 0
         self._events: List[WatchEvent] = []
         self._watchers: List[Callable[[WatchEvent], None]] = []
@@ -80,6 +86,45 @@ class FakeKubernetesApi:
         # when True, graceful deletes linger in DELETING until
         # finish_deletion (exercises the controller's deleting arms)
         self.sticky_deletion = False
+
+    # -------------------------------------------------------------- leases
+    def get_lease(self, name: str) -> Optional[Lease]:
+        with self._lock:
+            lease = self._leases.get(name)
+            return Lease(**vars(lease)) if lease else None
+
+    def try_acquire_lease(self, name: str, identity: str, now_s: float,
+                          duration_s: float = 15.0,
+                          holder_url: str = "") -> Optional[Lease]:
+        """Acquire-or-renew with the apiserver's compare-and-swap
+        semantics: succeeds when the lease is unheld, expired, or already
+        held by ``identity``; returns the updated lease or None when a
+        live competitor holds it (the k8s leader-election recipe)."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                lease = Lease(name=name)
+                self._leases[name] = lease
+            expired = now_s - lease.renew_time_s > lease.duration_s
+            if lease.holder and lease.holder != identity and not expired:
+                return None
+            if lease.holder != identity:
+                lease.transitions += 1  # new holder: fencing epoch bump
+            lease.holder = identity
+            lease.holder_url = holder_url
+            lease.renew_time_s = now_s
+            lease.duration_s = duration_s
+            return Lease(**vars(lease))
+
+    def release_lease(self, name: str, identity: str) -> None:
+        """Explicit release on clean shutdown: clears the hold so a
+        standby can acquire immediately (no TTL wait)."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is not None and lease.holder == identity:
+                lease.holder = ""
+                lease.holder_url = ""
+                lease.renew_time_s = 0.0
 
     # ------------------------------------------------------------- plumbing
     def _emit(self, kind: str, type_: str, obj) -> None:

@@ -287,3 +287,106 @@ class RealKubernetesApi:
                     rv = None
                     continue
                 raise
+
+    # --------------------------------------------------------------- leases
+    # (coordination.k8s.io/v1; the lease surface LeaseLeaderElector drives.
+    # Same contract as FakeKubernetesApi.try_acquire_lease.)
+    def get_lease(self, name: str):  # pragma: no cover - live only
+        from .types import Lease
+        k8s = self._k8s
+        coord = k8s.client.CoordinationV1Api()
+        try:
+            lease = coord.read_namespaced_lease(name, self.namespace)
+        except k8s.client.exceptions.ApiException as e:
+            if e.status == 404:
+                return None
+            raise
+        spec = lease.spec
+        renew = spec.renew_time.timestamp() if spec.renew_time else 0.0
+        return Lease(
+            name=name, holder=spec.holder_identity or "",
+            holder_url=(lease.metadata.annotations or {}).get(
+                "cook/leader-url", ""),
+            renew_time_s=renew,
+            duration_s=float(spec.lease_duration_seconds or 15),
+            transitions=int(spec.lease_transitions or 0))
+
+    def try_acquire_lease(self, name: str, identity: str, now_s: float,
+                          duration_s: float = 15.0, holder_url: str = ""
+                          ):  # pragma: no cover - live only
+        """Apiserver-CAS acquire/renew: the object's resourceVersion makes
+        the replace conditional, so two contenders cannot both win."""
+        import datetime
+
+        from .types import Lease
+        k8s = self._k8s
+        coord = k8s.client.CoordinationV1Api()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        body = k8s.client.V1Lease(
+            metadata=k8s.client.V1ObjectMeta(
+                name=name, namespace=self.namespace,
+                annotations={"cook/leader-url": holder_url}),
+            spec=k8s.client.V1LeaseSpec(
+                holder_identity=identity, renew_time=now,
+                lease_duration_seconds=int(duration_s)))
+        try:
+            cur = coord.read_namespaced_lease(name, self.namespace)
+        except k8s.client.exceptions.ApiException as e:
+            if e.status != 404:
+                raise
+            try:
+                body.spec.lease_transitions = 1
+                coord.create_namespaced_lease(self.namespace, body)
+                return Lease(name=name, holder=identity,
+                                 holder_url=holder_url,
+                                 renew_time_s=now.timestamp(),
+                                 duration_s=duration_s, transitions=1)
+            except k8s.client.exceptions.ApiException as e2:
+                if e2.status == 409:  # lost the create race
+                    return None
+                raise
+        spec = cur.spec
+        renew = spec.renew_time.timestamp() if spec.renew_time else 0.0
+        expired = now.timestamp() - renew > float(
+            spec.lease_duration_seconds or duration_s)
+        if (spec.holder_identity and spec.holder_identity != identity
+                and not expired):
+            return None
+        transitions = int(spec.lease_transitions or 0)
+        if spec.holder_identity != identity:
+            transitions += 1
+        body.metadata.resource_version = cur.metadata.resource_version
+        body.spec.lease_transitions = transitions
+        try:
+            coord.replace_namespaced_lease(name, self.namespace, body)
+        except k8s.client.exceptions.ApiException as e:
+            if e.status == 409:  # CAS lost: someone renewed under us
+                return None
+            raise
+        return Lease(name=name, holder=identity, holder_url=holder_url,
+                         renew_time_s=now.timestamp(),
+                         duration_s=duration_s, transitions=transitions)
+
+    def release_lease(self, name: str, identity: str
+                      ) -> None:  # pragma: no cover - live only
+        """Explicit release on clean shutdown: clear holderIdentity so a
+        standby acquires immediately instead of waiting out the TTL."""
+        k8s = self._k8s
+        coord = k8s.client.CoordinationV1Api()
+        try:
+            cur = coord.read_namespaced_lease(name, self.namespace)
+        except k8s.client.exceptions.ApiException as e:
+            if e.status == 404:
+                return
+            raise
+        if (cur.spec.holder_identity or "") != identity:
+            return  # someone else holds it now; not ours to clear
+        cur.spec.holder_identity = ""
+        cur.spec.renew_time = None
+        if cur.metadata.annotations:
+            cur.metadata.annotations["cook/leader-url"] = ""
+        try:
+            coord.replace_namespaced_lease(name, self.namespace, cur)
+        except k8s.client.exceptions.ApiException as e:
+            if e.status != 409:  # CAS lost: a competitor already took it
+                raise

@@ -46,6 +46,33 @@ _SEP = "\x1f"
 _BUF_CAP = 1 << 20
 
 
+def compile_fetch_prelude(uris) -> str:
+    """Shell prelude fetching each job URI into the sandbox before the
+    command runs (reference: the mesos fetcher's copy/download + extract +
+    executable bits, driven from :job/uri at mesos/task.clj:114-160).
+    Local paths / file:// are copied; http(s) downloads via curl; a failed
+    fetch fails the task (exit before the user command)."""
+    import shlex
+    lines = []
+    for uri in uris or []:
+        value = (uri.get("value") or "").strip()
+        if not value:
+            continue
+        src = value[7:] if value.startswith("file://") else value
+        base = shlex.quote(src.rsplit("/", 1)[-1])
+        if value.startswith(("http://", "https://")):
+            lines.append(f"curl -sSfL -o {base} {shlex.quote(value)}")
+        else:
+            lines.append(f"cp {shlex.quote(src)} {base}")
+        if uri.get("executable"):
+            lines.append(f"chmod +x {base}")
+        if uri.get("extract"):
+            lines.append(f"tar -xf {base}")
+    if not lines:
+        return ""
+    return "set -e\n" + "\n".join(lines) + "\nset +e\n"
+
+
 def _build(target: Path, extra: List[str]) -> Optional[Path]:
     if target.exists() and target.stat().st_mtime >= _SRC.stat().st_mtime:
         return target
@@ -474,13 +501,18 @@ class RemoteComputeCluster(ComputeCluster):
     def _task_command(self, spec: LaunchSpec) -> Optional[str]:
         """The command to run, or None when it cannot be determined (which
         must fail the launch, not silently succeed). Without a store this
-        backend is a pure transport under test; 'true' keeps it driveable."""
+        backend is a pure transport under test; 'true' keeps it driveable.
+
+        URI artifacts are compiled into a fetch prelude ahead of the user
+        command — the task-compiler role of the reference's mesos fetcher
+        config (mesos/task.clj:114-160, :job/uri)."""
         if self.store is None:
             return "true"
         job = self.store.job(spec.job_uuid)
-        if job is not None and job.command:
-            return job.command
-        return None
+        if job is None or not job.command:
+            return None
+        prelude = compile_fetch_prelude(job.uris)
+        return prelude + job.command if prelude else job.command
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:

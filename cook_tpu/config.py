@@ -94,6 +94,10 @@ class Config:
     # match for all pools (sched/fused.py); "split": host-driven per-pool
     # step_rank/step_match (CPU fallback, deterministic tests)
     cycle_mode: str = "fused"
+    # rank straight off the incrementally-maintained columnar projection of
+    # the store (state/index.py) instead of materializing entities per
+    # cycle; the entity path remains the CPU-fallback/parity mode
+    columnar_index: bool = True
     default_pool: str = "default"
     # pool-regex -> matcher config, first match wins (config.clj:798)
     pool_matchers: List[tuple] = field(default_factory=list)

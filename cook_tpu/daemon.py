@@ -140,10 +140,27 @@ class CookDaemon:
         self.server.start()
         self.node_url = f"http://{self.host}:{self.server.port}"
 
-        election_dir = conf.get("election_dir") or self.data_dir or "."
-        self.elector = FileLeaderElector(
-            str(Path(election_dir) / "cook-leader.lock"), self.node_url,
-            on_leadership=self._on_leadership, on_loss=self._on_loss)
+        election = conf.get("election", {})
+        if election.get("mode") == "k8s-lease":
+            # distributed election over the cluster backend's Lease object
+            # (the ZK/Curator slot; no extra infrastructure needed)
+            from .cluster.k8s.real_api import RealKubernetesApi
+            from .sched.election import LeaseLeaderElector
+            api = RealKubernetesApi(
+                namespace=election.get("namespace", "cook"),
+                kubeconfig=election.get("kubeconfig"))
+            self.elector = LeaseLeaderElector(
+                api, identity=election.get("identity") or self.node_url,
+                node_url=self.node_url,
+                lease_name=election.get("lease_name",
+                                        "cook-scheduler-leader"),
+                duration_s=float(election.get("duration_seconds", 15.0)),
+                on_leadership=self._on_leadership, on_loss=self._on_loss)
+        else:
+            election_dir = conf.get("election_dir") or self.data_dir or "."
+            self.elector = FileLeaderElector(
+                str(Path(election_dir) / "cook-leader.lock"), self.node_url,
+                on_leadership=self._on_leadership, on_loss=self._on_loss)
         self.api.elector = self.elector
         self.api.node_url = self.node_url
         if not self.api_only:

@@ -72,16 +72,24 @@ def pack_rank_inputs(users: List[UserTasks],
             "valid": np.zeros(1, dtype=bool),
         }
     if pad:
-        size = bucket(arrays["usage"].shape[0])
-        arrays["usage"] = pad_to(arrays["usage"], size)
-        arrays["quota"] = pad_to(arrays["quota"], size, fill=np.inf)
-        arrays["shares"] = pad_to(arrays["shares"], size, fill=np.inf)
-        arrays["first_idx"] = pad_to(arrays["first_idx"], size)
-        arrays["user_rank"] = pad_to(arrays["user_rank"], size,
-                                     fill=np.int32(2**31 - 1))
-        arrays["pending"] = pad_to(arrays["pending"], size, fill=False)
-        arrays["valid"] = pad_to(arrays["valid"], size, fill=False)
+        arrays = pad_rank_arrays(arrays)
     return arrays, task_ids
+
+
+def pad_rank_arrays(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Pad unpadded RankInputs columns to the bucketed size (shared by the
+    entity packer above and the columnar-index fast path)."""
+    arrays = dict(arrays)
+    size = bucket(arrays["usage"].shape[0])
+    arrays["usage"] = pad_to(arrays["usage"], size)
+    arrays["quota"] = pad_to(arrays["quota"], size, fill=np.inf)
+    arrays["shares"] = pad_to(arrays["shares"], size, fill=np.inf)
+    arrays["first_idx"] = pad_to(arrays["first_idx"], size)
+    arrays["user_rank"] = pad_to(arrays["user_rank"], size,
+                                 fill=np.int32(2**31 - 1))
+    arrays["pending"] = pad_to(arrays["pending"], size, fill=False)
+    arrays["valid"] = pad_to(arrays["valid"], size, fill=False)
+    return arrays
 
 
 def pack_match_inputs(job_res: Sequence[Sequence[float]],

@@ -44,6 +44,7 @@ from ..policy import PluginRegistry, QueueLimits, RateLimits
 from ..sched.scheduler import Scheduler
 from ..sched.unscheduled import job_reasons
 from ..state.schema import (
+    Application,
     Constraint,
     Group,
     InstanceStatus,
@@ -89,6 +90,19 @@ def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
         "constraints": [[c.attribute, c.operator, c.pattern]
                         for c in job.constraints],
         "disable_mea_culpa_retries": job.disable_mea_culpa_retries,
+        "uris": job.uris,
+        "executor": job.executor,
+        "expected_runtime": job.expected_runtime_ms,
+        "progress_output_file": job.progress_output_file,
+        "progress_regex_string": job.progress_regex_string,
+        "datasets": job.datasets,
+        "application": ({"name": job.application.name,
+                         "version": job.application.version,
+                         "workload-class": job.application.workload_class,
+                         "workload-id": job.application.workload_id,
+                         "workload-details":
+                             job.application.workload_details}
+                        if job.application else None),
     }
     if include_instances:
         out["instances"] = []
@@ -150,6 +164,23 @@ def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
             env=dict(spec.get("env", {})),
             container=spec.get("container"),
             ports=int(spec.get("ports", 0)),
+            uris=[u if isinstance(u, dict) else {"value": u}
+                  for u in spec.get("uris", [])],
+            executor=spec.get("executor", ""),
+            expected_runtime_ms=(int(spec["expected_runtime"])
+                                 if spec.get("expected_runtime") is not None
+                                 else None),
+            progress_output_file=spec.get("progress_output_file", ""),
+            progress_regex_string=spec.get("progress_regex_string", ""),
+            datasets=list(spec.get("datasets", [])),
+            application=(Application(
+                name=spec["application"].get("name", ""),
+                version=spec["application"].get("version", ""),
+                workload_class=spec["application"].get("workload-class", ""),
+                workload_id=spec["application"].get("workload-id", ""),
+                workload_details=spec["application"].get(
+                    "workload-details", ""))
+                if isinstance(spec.get("application"), dict) else None),
             constraints=constraints,
             group=spec.get("group"),
             disable_mea_culpa_retries=bool(

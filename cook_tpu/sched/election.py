@@ -41,6 +41,125 @@ class LeaderElector:
         raise NotImplementedError
 
 
+class LeaseLeaderElector(LeaderElector):
+    """Distributed election over a renewable TTL lease — the k8s-native
+    leader-election recipe (coordination.k8s.io/v1 Lease: holderIdentity,
+    renewTime, leaseDurationSeconds; the ZooKeeper/Curator slot of the
+    reference, mesos.clj:153-328, re-based on the cluster backend's own
+    coordination object so no extra infrastructure is required).
+
+    ``api`` is any object with the lease surface of the kubernetes API
+    adapters (cluster/k8s/fake_api.py ``try_acquire_lease``/``get_lease``;
+    cluster/k8s/real_api.py implements the same against a live apiserver).
+    The lease's ``transitions`` counter is the fencing epoch: it bumps
+    every time holdership changes, so a deposed leader's stale writes can
+    be rejected exactly like the file elector's epoch fencing.
+
+    On losing the lease (renewal discovers another holder) ``on_loss``
+    fires — production wiring exits the process for a supervisor restart,
+    mirroring the reference's System/exit on leadership loss."""
+
+    def __init__(self, api, identity: str, node_url: str,
+                 lease_name: str = "cook-scheduler-leader",
+                 duration_s: float = 15.0,
+                 renew_interval_s: float = 2.0,
+                 on_leadership: Optional[Callable[[], None]] = None,
+                 on_loss: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.time):
+        # NOTE clock must share the lease's renew_time_s timebase: a real
+        # apiserver stamps wall-clock epoch seconds, hence time.time (NOT
+        # monotonic) — staleness checks compare the two directly.
+        self.api = api
+        self.identity = identity
+        self.node_url = node_url
+        self.lease_name = lease_name
+        self.duration_s = duration_s
+        self.renew_interval_s = renew_interval_s
+        self.on_leadership = on_leadership
+        self.on_loss = on_loss
+        self.clock = clock
+        self.epoch: Optional[int] = None  # fencing: lease transitions
+        self._leader = False
+        self._last_renew_ok: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def campaign(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lease-elector")
+        self._thread.start()
+
+    def try_once(self) -> bool:
+        """One acquire/renew attempt (exposed for deterministic tests and
+        for external pacing)."""
+        lease = self.api.try_acquire_lease(
+            self.lease_name, self.identity, self.clock(),
+            duration_s=self.duration_s, holder_url=self.node_url)
+        if lease is not None:
+            first = not self._leader
+            self._leader = True
+            self._last_renew_ok = self.clock()
+            self.epoch = lease.transitions
+            if first and self.on_leadership:
+                self.on_leadership()
+            return True
+        if self._leader:
+            # held it, lost it: a competitor acquired after our TTL lapsed
+            self._drop_leadership()
+        return False
+
+    def _drop_leadership(self) -> None:
+        self._leader = False
+        if self.on_loss:
+            self.on_loss()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.try_once()
+            except Exception:
+                # a transient apiserver error must NOT kill the renewal
+                # thread while this node believes it leads — that is how
+                # split brain happens: we'd stop renewing, keep scheduling,
+                # and a standby would acquire after the TTL.  Keep retrying;
+                # if renewals keep failing past our own TTL, assume the
+                # lease is lost and step down pre-emptively.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "lease renewal attempt failed", exc_info=True)
+                if self._leader and self._last_renew_ok is not None and \
+                        self.clock() - self._last_renew_ok > self.duration_s:
+                    self._drop_leadership()
+            self._stop.wait(self.renew_interval_s)
+
+    def resign(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._leader:
+            self._leader = False
+            try:
+                self.api.release_lease(self.lease_name, self.identity)
+            except Exception:
+                pass  # standby will still take over after the TTL
+            if self.on_loss:
+                self.on_loss()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def leader_url(self) -> Optional[str]:
+        lease = self.api.get_lease(self.lease_name)
+        if lease is None or not lease.holder:
+            return None
+        if self.clock() - lease.renew_time_s > lease.duration_s:
+            return None  # stale hold: no live leader to redirect to
+        return lease.holder_url or None
+
+
 class FileLeaderElector(LeaderElector):
     def __init__(self, lock_path: str, node_url: str,
                  on_leadership: Optional[Callable[[], None]] = None,

@@ -282,10 +282,14 @@ class Matcher:
 
     def _dispatch(self, mc: MatcherConfig, job_res, cmask, avail, cap
                   ) -> np.ndarray:
+        # callers pass plain lists; everything below (including the
+        # sparse/dense fancy-indexed split) needs arrays
+        job_res = np.asarray(job_res, dtype=F32).reshape(-1, 4)
+        avail = np.asarray(avail, dtype=F32).reshape(-1, 4)
+        cap = np.asarray(cap, dtype=F32).reshape(-1, 4)
+        cmask = np.asarray(cmask, dtype=bool)
         if mc.backend == "cpu":
-            return reference_impl.greedy_match(
-                np.asarray(job_res, dtype=F32), cmask,
-                np.asarray(avail, dtype=F32), np.asarray(cap, dtype=F32))
+            return reference_impl.greedy_match(job_res, cmask, avail, cap)
         backend = self.resolve_backend(mc, len(job_res))
         if backend == "tpu-waterfill" and mc.backend == "auto" \
                 and len(job_res):
@@ -294,7 +298,7 @@ class Matcher:
             # can be probed over.  Bulk dense-mask jobs go through
             # waterfill; the constrained minority is matched exactly by the
             # greedy scan against the remaining availability.
-            sparse = np.asarray(cmask).mean(axis=1) < mc.sparse_cmask_density
+            sparse = cmask.mean(axis=1) < mc.sparse_cmask_density
             if sparse.any():
                 J = len(job_res)
                 assign = np.full(J, -1, dtype=np.int32)

@@ -192,6 +192,19 @@ class Scheduler:
         if limits is None:
             return ranked
         max_mem_mb = limits.memory_gb * 1024.0
+        from .ranker import RankedQueue
+        if isinstance(ranked, RankedQueue):
+            # columnar path: vectorized over the resource columns, no
+            # full-queue entity materialization
+            import numpy as np
+            bad = ((ranked.resources[:, 1] > max_mem_mb)
+                   | (ranked.resources[:, 0] > limits.cpus))
+            if not bad.any():
+                return ranked
+            self._stifle_offensive(
+                [j for j in (self.store.job(u)
+                             for u in ranked.uuids[bad]) if j is not None])
+            return ranked.filtered(~bad)
         offensive = [j for j in ranked
                      if j.resources.mem > max_mem_mb
                      or j.resources.cpus > limits.cpus]

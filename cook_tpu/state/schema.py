@@ -168,9 +168,15 @@ class Checkpoint:
 
 @dataclass
 class Application:
+    """Submitting-application metadata (reference: schema.clj
+    :job.application/{name,version,workload-class,workload-id,
+    workload-details})."""
+
     name: str = ""
     version: str = ""
     workload_class: str = ""
+    workload_id: str = ""
+    workload_details: str = ""
 
 
 @dataclass
@@ -196,6 +202,21 @@ class Job:
     # assigned from the offer's ranges in mesos/task.clj:209-237 and
     # exported as PORT0.. in the task environment)
     ports: int = 0
+    # artifacts fetched into the sandbox before the command runs
+    # (reference: :job/uri, mesos fetcher semantics task.clj:114-160);
+    # each: {"value": path-or-url, "executable": bool, "extract": bool,
+    # "cache": bool}
+    uris: List[Dict[str, Any]] = field(default_factory=list)
+    # executor choice (reference: :job/executor "cook"|"mesos"): "cook"
+    # runs under the progress-tracking executor, "" = backend default
+    executor: str = ""
+    # per-job progress plumbing (reference: :job/progress-output-file,
+    # :job/progress-regex-string)
+    progress_output_file: str = ""
+    progress_regex_string: str = ""
+    # declared input datasets for locality-aware plugins (reference:
+    # :job/datasets, consumed by the data-locality fitness calculator)
+    datasets: List[Dict[str, Any]] = field(default_factory=list)
     constraints: List[Constraint] = field(default_factory=list)
     group: Optional[str] = None  # group uuid
     application: Optional[Application] = None

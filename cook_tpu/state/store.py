@@ -293,6 +293,15 @@ class Store:
         with self._lock:
             self._subscribers.append(fn)
 
+    def ensure_index(self):
+        """The columnar rank-path projection (state/index.py), attached on
+        first use and kept fresh off the tx feed."""
+        with self._lock:
+            if getattr(self, "_index", None) is None:
+                from .index import ColumnarIndex
+                self._index = ColumnarIndex(self)
+            return self._index
+
     # ----------------------------------------------------------- submission
     def create_jobs(self, jobs: Iterable[Job], groups: Iterable[Group] = (),
                     latch: Optional[str] = None) -> List[str]:

@@ -180,3 +180,25 @@ class TestSandboxFileServer:
         with pytest.raises(urllib.error.HTTPError) as e:
             self._get(f"{server.url}/files/read?path=%2Fetc%2Fpasswd")
         assert e.value.code == 404
+
+
+class TestProgressFile:
+    def test_explicit_progress_file_watched(self, tmp_path):
+        """Per-job progress file (reference: :job/progress-output-file,
+        progress.py watches the EXECUTOR_PROGRESS_OUTPUT_FILE location)."""
+        from cook_tpu.agent.executor import TaskExecutor
+
+        updates = []
+        ex = TaskExecutor(
+            'echo "progress: 25 quarter" > prog.txt; sleep 0.4; '
+            'echo "progress: 75 three-quarters" >> prog.txt; sleep 0.3',
+            sandbox=str(tmp_path / "sb"),
+            progress_file="prog.txt",
+            progress_publish=lambda seq, pct, msg: updates.append((pct, msg)))
+        ex.start()
+        assert ex.wait(timeout_s=10) == 0
+        deadline = time.time() + 3
+        while time.time() < deadline and len(updates) < 2:
+            time.sleep(0.05)
+        assert (25, "quarter") in updates
+        assert (75, "three-quarters") in updates

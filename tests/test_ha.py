@@ -184,3 +184,110 @@ class TestIncrementalConfig:
               {"sidecar-version": [{"value": "1.0", "portion": 1.0}]})
         got = _get(server.url, "/incremental-config")
         assert got["sidecar-version"][0]["value"] == "1.0"
+
+
+class TestLeaseElection:
+    """Distributed (k8s-Lease-style) election: TTL lease with CAS acquire,
+    fencing epochs via leaseTransitions, failover after expiry (the
+    reference's ZooKeeper slot, mesos.clj:153-328)."""
+
+    def _pair(self):
+        from cook_tpu.cluster.k8s.fake_api import FakeKubernetesApi
+        from cook_tpu.sched.election import LeaseLeaderElector
+
+        api = FakeKubernetesApi()
+        clock = {"t": 0.0}
+        mk = lambda ident, url, events: LeaseLeaderElector(  # noqa: E731
+            api, identity=ident, node_url=url, duration_s=10.0,
+            clock=lambda: clock["t"],
+            on_leadership=lambda: events.append("lead"),
+            on_loss=lambda: events.append("loss"))
+        return api, clock, mk
+
+    def test_single_winner_and_renewal(self):
+        api, clock, mk = self._pair()
+        ev_a, ev_b = [], []
+        a = mk("node-a", "http://a:1", ev_a)
+        b = mk("node-b", "http://b:2", ev_b)
+        assert a.try_once() and not b.try_once()
+        assert a.is_leader and not b.is_leader
+        assert a.leader_url() == "http://a:1" == b.leader_url()
+        assert ev_a == ["lead"] and ev_b == []
+        # renewal keeps the hold past the original TTL
+        for _ in range(5):
+            clock["t"] += 5.0
+            assert a.try_once() and not b.try_once()
+        assert a.epoch == 1
+
+    def test_failover_after_ttl_with_epoch_bump(self):
+        api, clock, mk = self._pair()
+        ev_a, ev_b = [], []
+        a = mk("node-a", "http://a:1", ev_a)
+        b = mk("node-b", "http://b:2", ev_b)
+        assert a.try_once()
+        # leader dies (stops renewing); follower can't take over early...
+        clock["t"] += 5.0
+        assert not b.try_once()
+        assert b.leader_url() == "http://a:1"
+        # ...but wins after the TTL lapses, with a fencing-epoch bump
+        clock["t"] += 6.0
+        assert b.try_once()
+        assert b.is_leader and b.epoch == 2
+        assert b.leader_url() == "http://b:2"
+        # the deposed leader's next renewal discovers the loss
+        assert not a.try_once()
+        assert not a.is_leader and ev_a == ["lead", "loss"]
+
+    def test_resign_releases_immediately(self):
+        api, clock, mk = self._pair()
+        ev_a, ev_b = [], []
+        a = mk("node-a", "http://a:1", ev_a)
+        b = mk("node-b", "http://b:2", ev_b)
+        assert a.try_once()
+        a.resign()
+        assert ev_a == ["lead", "loss"]
+        assert b.try_once() and b.is_leader
+        # stale-hold guard: no live leader -> no redirect target
+        b.resign()
+        assert b.leader_url() is None
+
+    def test_renewal_errors_do_not_split_brain(self):
+        """A flaky lease API must not kill the renewal loop while the node
+        still believes it leads; persistent failures past the TTL step the
+        leader down pre-emptively instead of double-leading."""
+        from cook_tpu.cluster.k8s.fake_api import FakeKubernetesApi
+        from cook_tpu.sched.election import LeaseLeaderElector
+
+        api = FakeKubernetesApi()
+        clock = {"t": 0.0}
+        fail = {"on": False}
+        real_try = api.try_acquire_lease
+
+        def flaky(*a, **kw):
+            if fail["on"]:
+                raise ConnectionError("apiserver 500")
+            return real_try(*a, **kw)
+        api.try_acquire_lease = flaky
+
+        events = []
+        a = LeaseLeaderElector(api, "node-a", "http://a:1", duration_s=10.0,
+                               renew_interval_s=0.01,
+                               clock=lambda: clock["t"],
+                               on_leadership=lambda: events.append("lead"),
+                               on_loss=lambda: events.append("loss"))
+        a.campaign()
+        deadline = time.time() + 5
+        while not a.is_leader and time.time() < deadline:
+            time.sleep(0.01)
+        assert a.is_leader
+        fail["on"] = True           # apiserver goes dark
+        clock["t"] += 5.0           # under the TTL: stays leader, retrying
+        time.sleep(0.1)
+        assert a.is_leader
+        clock["t"] += 6.0           # renewals failing past the TTL
+        deadline = time.time() + 5
+        while a.is_leader and time.time() < deadline:
+            time.sleep(0.01)
+        assert not a.is_leader      # stepped down, no split brain
+        assert events == ["lead", "loss"]
+        a.resign()

@@ -227,3 +227,24 @@ class TestWaterfill:
                 assert cmask[j, h]
                 used[h] += job_res[j]
         assert (used <= avail + 1e-3).all()
+
+    def test_dispatch_accepts_plain_lists(self):
+        """match_pool passes plain Python lists; the sparse/dense split
+        fancy-indexes them, so _dispatch must coerce to arrays first."""
+        from cook_tpu.config import MatcherConfig
+        from cook_tpu.sched.matcher import Matcher
+
+        rng = np.random.default_rng(11)
+        J, H = 12, 6
+        job_res, cmask, avail, capacity = random_case(rng, J, H)
+        cmask[:] = True
+        cmask[0, :] = False
+        cmask[0, 3] = True
+        avail[:] = capacity
+        m = Matcher.__new__(Matcher)
+        mc = MatcherConfig(backend="auto", auto_large_j_threshold=4)
+        assign = m._dispatch(mc, job_res.tolist(),
+                             cmask.tolist(), avail.tolist(),
+                             capacity.tolist())
+        assert assign[0] == 3
+        assert (assign >= 0).sum() >= J - 1

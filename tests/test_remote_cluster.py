@@ -488,3 +488,79 @@ class TestPortsAndContainers:
             conn.close()
         finally:
             agent.stop()
+
+
+class TestUriFetch:
+    def test_local_uri_copied_executable_and_archive_extracted(self, agent,
+                                                               tmp_path):
+        """URI artifacts land in the sandbox before the command runs
+        (reference: mesos fetcher semantics from :job/uri)."""
+        import subprocess as sp
+
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        tool = tmp_path / "tool.sh"
+        tool.write_text("#!/bin/sh\necho tool-ran\n")
+        archive = tmp_path / "data.tar"
+        datafile = tmp_path / "payload.txt"
+        datafile.write_text("payload\n")
+        sp.run(["tar", "-cf", str(archive), "-C", str(tmp_path),
+                "payload.txt"], check=True)
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        out = tmp_path / "uri-out.txt"
+        job = Job(uuid=new_uuid(), user="alice",
+                  command=f"./tool.sh > {out}; cat payload.txt >> {out}",
+                  uris=[{"value": str(tool), "executable": True},
+                        {"value": f"file://{archive}", "extract": True}],
+                  pool="default", resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        sched.step_match()
+
+        def done():
+            sched.flush_status_updates()
+            return store.job(job.uuid).state is JobState.COMPLETED
+        assert wait_for(done, timeout=15)
+        insts = [store.instance(t) for t in store.job(job.uuid).instances]
+        assert any(i.status is InstanceStatus.SUCCESS for i in insts), \
+            [(i.status, i.exit_code) for i in insts]
+        assert out.read_text() == "tool-ran\npayload\n"
+        cluster.shutdown()
+
+    def test_missing_uri_fails_task_before_command(self, agent, tmp_path):
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        marker = tmp_path / "never.txt"
+        job = Job(uuid=new_uuid(), user="alice",
+                  command=f"echo ran > {marker}",
+                  uris=[{"value": str(tmp_path / "does-not-exist.bin")}],
+                  max_retries=1,
+                  pool="default", resources=Resources(cpus=1.0, mem=64.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        sched.step_match()
+
+        def done():
+            sched.flush_status_updates()
+            return store.job(job.uuid).state is JobState.COMPLETED
+        assert wait_for(done, timeout=15)
+        insts = [store.instance(t) for t in store.job(job.uuid).instances]
+        assert all(i.status is InstanceStatus.FAILED for i in insts)
+        assert not marker.exists()  # user command never ran
+        cluster.shutdown()

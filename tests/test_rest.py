@@ -553,3 +553,34 @@ class TestUnderInvestigation:
         detail = next(r for r in explained["reasons"]
                       if "placed" in r["reason"])
         assert any("cpus" in d["reason"] for d in detail["data"]["reasons"])
+
+
+class TestExtendedJobAttrs:
+    def test_schema_attrs_round_trip(self, system):
+        """uris/application/executor/expected-runtime/progress/datasets
+        (reference: schema.clj job attributes) survive submit -> query."""
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one(
+            "echo hi", cpus=1, mem=100, ports=2,
+            uris=[{"value": "/data/tool.sh", "executable": True},
+                  "https://example.com/archive.tgz"],
+            executor="cook",
+            expected_runtime=120_000,
+            progress_output_file="progress.out",
+            progress_regex_string=r"pct (\d+) (.*)",
+            datasets=[{"dataset": {"bucket": "b", "path": "/p"}}],
+            application={"name": "spark", "version": "3.5",
+                         "workload-class": "etl", "workload-id": "w1"})
+        job = client.job(uuid)
+        assert job["ports"] == 2
+        assert job["uris"] == [
+            {"value": "/data/tool.sh", "executable": True},
+            {"value": "https://example.com/archive.tgz"}]
+        assert job["executor"] == "cook"
+        assert job["expected_runtime"] == 120_000
+        assert job["progress_output_file"] == "progress.out"
+        assert job["progress_regex_string"] == r"pct (\d+) (.*)"
+        assert job["datasets"] == [{"dataset": {"bucket": "b", "path": "/p"}}]
+        assert job["application"]["name"] == "spark"
+        assert job["application"]["workload-class"] == "etl"
