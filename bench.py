@@ -397,7 +397,7 @@ def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
         return q[:1000]  # the matcher's considerable prefix materializes
 
     head = cycle()
-    assert len(head) == 1000
+    assert len(head) == min(n_jobs, 1000)
     samples = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -510,87 +510,203 @@ def emit(payload):
     print(json.dumps(payload))
 
 
+# ---------------------------------------------------------------- sections
+# Each section runs in its OWN subprocess with a timeout (round 2 lost its
+# number to a backend-init hang; round 3 then saw a device read wedge
+# MID-RUN on the tunneled TPU — per-section isolation means one wedge
+# costs that section, not the round's artifact).
+
+SECTION_TIMEOUT_S = int(os.environ.get("BENCH_SECTION_TIMEOUT_S", "900"))
+
+
+def _child_platform():
+    """Backend bring-up inside a section child: no probe subprocess (the
+    parent's timeout covers hangs), honor a forced CPU decision, share
+    compiles across sections via the persistent compilation cache."""
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # share TPU compiles across section children (CPU skips it: the
+        # XLA:CPU AOT cache is machine-feature-pinned and warns/SIGILLs
+        # when features mismatch across processes)
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              "/tmp/jax_bench_cache")
+        except Exception:
+            pass
+    try:
+        return jax, jax.devices()[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        return jax, jax.devices()[0].platform
+
+
+def run_section(name: str) -> None:
+    """Child mode: run one section, print one JSON line {'data': ...}."""
+    _jax, platform = _child_platform()
+    print(f"bench[{name}]: platform={platform}", file=sys.stderr)
+    if name == "sync_floor":
+        data = {"sync_floor_ms": measure_sync_floor()}
+    elif name == "rank":
+        times, synced, cpu_ms, pack_ms = bench_rank(
+            n_users=scaled(2000, lo=8), total=scaled(1_000_000))
+        data = {"samples_ms": times, "synced_ms": synced,
+                "cpu_ms": cpu_ms, "pack_ms": pack_ms}
+    elif name == "match":
+        (times, synced, cpu_ms, parity, placed, detail) = bench_match(
+            J=scaled(1000), H=scaled(50_000), platform=platform)
+        data = {"samples_ms": times, "synced_ms": synced, "cpu_ms": cpu_ms,
+                "parity": parity, "placed": placed, "detail": detail}
+    elif name == "match_large":
+        data = bench_match_large(J=scaled(10_000), H=scaled(50_000))
+    elif name == "rebalance":
+        data = {"samples_ms": bench_rebalance(T=scaled(1_000_000),
+                                              H=scaled(50_000))}
+    elif name == "store_cycle":
+        data = bench_store_cycle(n_jobs=scaled(100_000),
+                                 n_users=scaled(200, lo=8))
+    elif name == "end2end":
+        data = {"samples_ms": bench_end2end(
+            total=scaled(100_000), n_users=scaled(200, lo=8),
+            J=scaled(1000), H=scaled(5000))}
+    else:
+        raise SystemExit(f"unknown section {name}")
+    print(json.dumps({"platform": platform, "data": data}))
+
+
+def _run_section_subproc(name: str):
+    """Parent side: run a section child, parse its JSON line. Returns
+    (data or None, platform or None, error or None)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            capture_output=True, text=True, timeout=SECTION_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None, None, f"section hung >{SECTION_TIMEOUT_S}s (killed)"
+    sys.stderr.write(p.stderr)
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+                return out.get("data"), out.get("platform"), None
+            except json.JSONDecodeError:
+                break
+    tail = (p.stderr or p.stdout).strip().splitlines()[-3:]
+    return None, None, (" | ".join(tail)[-400:]
+                        or f"section exited rc={p.returncode}")
+
+
 def main():
     t_start = time.time()
-    jax, platform, tpu_error = init_jax()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        run_section(sys.argv[2])
+        return
+
+    # one TPU-availability decision for every section (killable probe with
+    # retries); children inherit it via BENCH_FORCE_CPU
+    tpu_error = None
+    if os.environ.get("BENCH_FORCE_CPU") != "1":
+        for attempt in range(PROBE_ATTEMPTS):
+            ok, info = _probe_backend_subprocess(PROBE_TIMEOUT_S)
+            if ok:
+                break
+            tpu_error = info
+            print(f"bench: backend probe attempt {attempt + 1}/"
+                  f"{PROBE_ATTEMPTS} failed: {info}", file=sys.stderr)
+            if attempt + 1 < PROBE_ATTEMPTS:
+                time.sleep(min(10 * (2 ** attempt), 60))
+        else:
+            os.environ["BENCH_FORCE_CPU"] = "1"
+            print(f"bench: falling back to CPU ({tpu_error})",
+                  file=sys.stderr)
+        if tpu_error and os.environ.get("BENCH_FORCE_CPU") != "1":
+            tpu_error = None  # a later attempt succeeded
     if os.environ.get("BENCH_TPU_ERROR") and not tpu_error:
         tpu_error = os.environ["BENCH_TPU_ERROR"]
-    print(f"bench: platform={platform}"
-          + (f" (tpu unavailable: {tpu_error})" if tpu_error else ""),
-          file=sys.stderr)
-    try:
-        sync_floor = measure_sync_floor()
-        print(f"sync_floor={sync_floor:.1f}ms", file=sys.stderr)
-        rank_times, rank_synced, rank_cpu, rank_pack_ms = bench_rank(
-            n_users=scaled(2000, lo=8), total=scaled(1_000_000))
-        (match_times, match_synced, match_cpu, parity, placed,
-         match_detail) = bench_match(
-            J=scaled(1000), H=scaled(50_000), platform=platform)
-        try:
-            match_large = bench_match_large(J=scaled(10_000),
-                                            H=scaled(50_000))
-        except Exception as e:  # the largest shape must not sink the bench
-            match_large = {"error": str(e)[:300]}
-            print(f"match_large failed: {e}", file=sys.stderr)
-        reb_times = bench_rebalance(T=scaled(1_000_000), H=scaled(50_000))
-        try:
-            store_cycle = bench_store_cycle(n_jobs=scaled(100_000),
-                                            n_users=scaled(200, lo=8))
-        except Exception as e:
-            store_cycle = {"error": str(e)[:300]}
-            print(f"store_cycle failed: {e}", file=sys.stderr)
-        e2e = bench_end2end(total=scaled(100_000), n_users=scaled(200, lo=8),
-                            J=scaled(1000), H=scaled(5000))
-        cycle = [r + m for r, m in zip(rank_times, match_times)]
+
+    sections = ["sync_floor", "rank", "match", "match_large", "rebalance",
+                "store_cycle", "end2end"]
+    results, platforms, errors = {}, {}, {}
+    for name in sections:
+        data, platform, err = _run_section_subproc(name)
+        results[name] = data
+        if platform:
+            platforms[name] = platform
+        if err:
+            errors[name] = err
+            print(f"bench section {name} FAILED: {err}", file=sys.stderr)
+
+    platform = platforms.get("rank") or platforms.get("match") or \
+        next(iter(platforms.values()), "unknown")
+    detail = {
+        "platform": platform,
+        "target_p99_ms": 50.0,
+        "bench_wall_s": round(time.time() - t_start, 1),
+    }
+    if results.get("sync_floor"):
+        detail["sync_floor_ms"] = round(
+            results["sync_floor"]["sync_floor_ms"], 1)
+    rank, match = results.get("rank"), results.get("match")
+    value = vs_baseline = None
+    if rank:
+        detail.update({
+            "rank_1M_tasks_2000_users_p50_ms":
+                round(pctl(rank["samples_ms"], 50), 3),
+            "rank_p99_ms": round(pctl(rank["samples_ms"], 99), 3),
+            "rank_synced_p50_ms": round(pctl(rank["synced_ms"], 50), 1),
+            "rank_host_pack_ms": round(rank["pack_ms"], 1),
+            "cpu_fallback_rank_ms": round(rank["cpu_ms"], 1),
+        })
+    if match:
+        detail.update({
+            "match_1k_jobs_50k_hosts_p50_ms":
+                round(pctl(match["samples_ms"], 50), 3),
+            "match_p99_ms": round(pctl(match["samples_ms"], 99), 3),
+            "match_synced_p50_ms": round(pctl(match["synced_ms"], 50), 1),
+            "cpu_fallback_match_ms": round(match["cpu_ms"], 1),
+            "headline_parity_vs_cpu_greedy": match["parity"],
+        })
+        detail.update(match.get("detail", {}))
+    if rank and match:
+        cycle = [r + m for r, m in zip(rank["samples_ms"],
+                                       match["samples_ms"])]
         cycle_p50, cycle_p99 = pctl(cycle, 50), pctl(cycle, 99)
-        cpu_total = rank_cpu + match_cpu
-        detail = {
-            "platform": platform,
-            "target_p99_ms": 50.0,
-            "sync_floor_ms": round(sync_floor, 1),
-            "cycle_p50_ms": round(cycle_p50, 3),
-            "cycle_p99_ms": round(cycle_p99, 3),
-            "rank_1M_tasks_2000_users_p50_ms": round(pctl(rank_times, 50), 3),
-            "rank_p99_ms": round(pctl(rank_times, 99), 3),
-            "rank_synced_p50_ms": round(pctl(rank_synced, 50), 1),
-            "rank_host_pack_ms": round(rank_pack_ms, 1),
-            "match_1k_jobs_50k_hosts_p50_ms": round(pctl(match_times, 50), 3),
-            "match_p99_ms": round(pctl(match_times, 99), 3),
-            "match_synced_p50_ms": round(pctl(match_synced, 50), 1),
-            "match_large_10k_jobs_50k_hosts": match_large,
-            "store_cycle_100k_jobs": store_cycle,
-            "rebalance_1M_tasks_p50_ms": round(pctl(reb_times, 50), 3),
-            "rebalance_p99_ms": round(pctl(reb_times, 99), 3),
-            "end2end_100k_cycle_p50_ms": round(pctl(e2e, 50), 1),
-            "end2end_100k_cycle_p99_ms": round(pctl(e2e, 99), 1),
-            "placements_per_sec": round(placed / (cycle_p50 / 1000.0), 1),
-            "cpu_fallback_rank_ms": round(rank_cpu, 1),
-            "cpu_fallback_match_ms": round(match_cpu, 1),
-            "headline_parity_vs_cpu_greedy": parity,
-            "bench_wall_s": round(time.time() - t_start, 1),
-        }
-        detail.update(match_detail)
-        if tpu_error:
-            detail["tpu_error"] = tpu_error
-        emit({
-            "metric": "match_cycle_p99_ms_rank1M_match1kx50k",
-            "value": round(cycle_p99, 3),
-            "unit": "ms",
-            "vs_baseline": round(cpu_total / cycle_p50, 2),
-            "detail": detail,
-        })
-    except Exception as e:  # noqa: BLE001 - always emit the JSON line
-        import traceback
-        traceback.print_exc(file=sys.stderr)
-        emit({
-            "metric": "match_cycle_p99_ms_rank1M_match1kx50k",
-            "value": None,
-            "unit": "ms",
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}"[:500],
-            "detail": {"platform": platform, "tpu_error": tpu_error},
-        })
-        sys.exit(0)  # the JSON line, not the rc, carries the failure
+        detail["cycle_p50_ms"] = round(cycle_p50, 3)
+        detail["cycle_p99_ms"] = round(cycle_p99, 3)
+        detail["placements_per_sec"] = round(
+            match["placed"] / (cycle_p50 / 1000.0), 1)
+        value = round(cycle_p99, 3)
+        vs_baseline = round(
+            (rank["cpu_ms"] + match["cpu_ms"]) / cycle_p50, 2)
+    if results.get("match_large") is not None:
+        detail["match_large_10k_jobs_50k_hosts"] = results["match_large"]
+    if results.get("store_cycle") is not None:
+        detail["store_cycle_100k_jobs"] = results["store_cycle"]
+    if results.get("rebalance"):
+        reb = results["rebalance"]["samples_ms"]
+        detail["rebalance_1M_tasks_p50_ms"] = round(pctl(reb, 50), 3)
+        detail["rebalance_p99_ms"] = round(pctl(reb, 99), 3)
+    if results.get("end2end"):
+        e2e = results["end2end"]["samples_ms"]
+        detail["end2end_100k_cycle_p50_ms"] = round(pctl(e2e, 50), 1)
+        detail["end2end_100k_cycle_p99_ms"] = round(pctl(e2e, 99), 1)
+    if errors:
+        detail["section_errors"] = errors
+    if tpu_error:
+        detail["tpu_error"] = tpu_error
+    payload = {
+        "metric": "match_cycle_p99_ms_rank1M_match1kx50k",
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    if value is None:
+        payload["error"] = "; ".join(
+            f"{k}: {v}" for k, v in errors.items())[:500] or "no sections ran"
+    emit(payload)
 
 
 if __name__ == "__main__":

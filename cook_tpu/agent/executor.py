@@ -132,6 +132,9 @@ class TaskExecutor:
             t = threading.Thread(target=self._tail_progress_file,
                                  daemon=True)
             t.start()
+            # joined by wait() so the final pass (after process exit) can
+            # publish a progress line written just before the task exited
+            self._reader_threads.append(t)
 
     def _tail_progress_file(self) -> None:
         """Tail the job's explicit progress file while the task runs; the
@@ -210,3 +213,59 @@ class TaskExecutor:
     @property
     def running(self) -> bool:
         return self.process is not None and self.process.poll() is None
+
+
+def main(argv=None) -> int:
+    """``python -m cook_tpu.agent.executor`` — run one task command under
+    the progress-tracking executor (the reference's :job/executor "cook"
+    choice: the custom executor instead of the bare shell,
+    executor/cook/executor.py:421-510).
+
+    Configuration comes from the environment the launch path already
+    provides (COOK_SANDBOX, COOK_TASK_ID) plus:
+      COOK_PROGRESS_URL        scheduler base URL for POST /progress/:id
+      COOK_PROGRESS_REGEX      per-job regex (:job/progress-regex-string)
+      COOK_PROGRESS_FILE       per-job explicit progress file
+    The command is argv (joined), exit code is the task's exit code.
+    """
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print("usage: python -m cook_tpu.agent.executor <command...>",
+              file=sys.stderr)
+        return 2
+    command = " ".join(args)
+    sandbox = os.environ.get("COOK_SANDBOX", ".")
+    task_id = os.environ.get("COOK_TASK_ID", "")
+    publish = None
+    api_url = os.environ.get("COOK_PROGRESS_URL", "")
+    if api_url and task_id:
+        publish = rest_progress_publisher(api_url, task_id)
+    ex = TaskExecutor(
+        command, sandbox=sandbox,
+        progress_regex=os.environ.get("COOK_PROGRESS_REGEX",
+                                      DEFAULT_PROGRESS_REGEX),
+        progress_publish=publish,
+        progress_file=os.environ.get("COOK_PROGRESS_FILE") or None)
+
+    # The agent kills tasks by signalling the WRAPPER's process group, but
+    # TaskExecutor puts the user command in its own session — forward the
+    # kill (escalating SIGTERM -> grace -> SIGKILL on the child's tree,
+    # reference: executor.py graceful-kill) or the workload would survive
+    # its own task being killed.
+    def forward_kill(signum, _frame):
+        code = ex.kill()
+        raise SystemExit(128 + signum if code is None else code)
+
+    signal.signal(signal.SIGTERM, forward_kill)
+    signal.signal(signal.SIGINT, forward_kill)
+    ex.start()
+    code = None
+    while code is None:
+        code = ex.wait(timeout_s=1.0)
+    return code
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via agent tests
+    raise SystemExit(main())

@@ -71,7 +71,7 @@ def cmd_submit(args) -> int:
         value = getattr(args, field)
         if value:
             spec[field] = value
-    for field in ("cpus", "mem", "gpus", "priority", "max_retries"):
+    for field in ("cpus", "mem", "gpus", "priority", "max_retries", "ports"):
         value = getattr(args, field)
         if value is not None:
             spec[field] = value
@@ -81,6 +81,16 @@ def cmd_submit(args) -> int:
         spec["labels"] = dict(kv.split("=", 1) for kv in args.label)
     if args.constraint:
         spec["constraints"] = [c.split(":", 2) for c in args.constraint]
+    if args.docker_image:
+        spec["container"] = {"image": args.docker_image,
+                             "volumes": list(args.volume or [])}
+    if args.uri:
+        spec["uris"] = [{"value": u} for u in args.uri]
+    if args.executor:
+        spec["executor"] = args.executor
+    if args.application:
+        name, _, version = args.application.partition(":")
+        spec["application"] = {"name": name, "version": version or "0"}
     client = clients(args)[0]
     uuids = client.submit([spec])
     print(uuids[0])
@@ -297,6 +307,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--label", action="append")
     sp.add_argument("--constraint", action="append",
                     help="attr:EQUALS:value")
+    sp.add_argument("--ports", type=int,
+                    help="host ports to assign (PORT0.. in the task env)")
+    sp.add_argument("--docker-image", dest="docker_image",
+                    help="container image to run the command in")
+    sp.add_argument("--volume", action="append",
+                    help="host:container bind for --docker-image")
+    sp.add_argument("--uri", action="append",
+                    help="artifact fetched into the sandbox before the "
+                         "command runs")
+    sp.add_argument("--executor", choices=["cook", ""],
+                    help="'cook' wraps the command in the progress-"
+                         "tracking executor")
+    sp.add_argument("--application",
+                    help="submitting application, name[:version]")
     sp.add_argument("command", nargs="+")
     sp.set_defaults(fn=cmd_submit)
 

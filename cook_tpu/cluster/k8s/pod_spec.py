@@ -25,6 +25,7 @@ COOK_WORKDIR = "/mnt/sandbox"
 CHECKPOINT_VOLUME = "cook-checkpoint"
 CHECKPOINT_MOUNT = "/mnt/checkpoint"
 DEFAULT_CHECKPOINT_INIT_IMAGE = "cook/checkpoint-init:stable"
+DEFAULT_FETCH_INIT_IMAGE = "cook/fetch-init:stable"
 DEFAULT_SIDECAR_IMAGE = "cook/sidecar:stable"
 DEFAULT_SHM_MB = 64
 
@@ -116,6 +117,29 @@ def build_pod_spec(job: Job, pool: str,
             mounts.append({"name": CHECKPOINT_VOLUME, "mount_path": extra,
                            "sub_path": extra.strip("/")})
 
+    # URI artifacts: fetched into the shared workdir by an init container
+    # before the job container starts (the k8s analog of the mesos fetcher;
+    # reference: :job/uri handling in task metadata)
+    if job.uris:
+        init_containers.append({
+            "name": "cook-fetch",
+            "image": DEFAULT_FETCH_INIT_IMAGE,
+            "env": [{"name": "COOK_URIS",
+                     "value": ";".join(
+                         u.get("value", "") for u in job.uris)}],
+            "volume_mounts": [{"name": "cook-workdir",
+                               "mount_path": COOK_WORKDIR}],
+            "working_dir": COOK_WORKDIR,
+        })
+
+    # requested host-port count (mesos/task.clj:209-237's slot).  Dynamic
+    # host-port assignment is the native transport's feature; kubernetes
+    # has no offer-side port ranges, so the request is surfaced as
+    # COOK_PORT_COUNT + spec metadata for a runtime webhook/CNI to fulfill
+    # rather than fabricated containerPorts the apiserver would reject.
+    if job.ports:
+        env.append({"name": "COOK_PORT_COUNT", "value": str(job.ports)})
+
     containers = [{
         "name": "cook-job",
         "image": image,
@@ -151,6 +175,7 @@ def build_pod_spec(job: Job, pool: str,
     return {
         "containers": containers,
         "init_containers": init_containers,
+        "port_count": job.ports,
         "volumes": volumes,
         "tolerations": tolerations,
         "node_selector": node_selector,

@@ -263,11 +263,25 @@ class RemoteComputeCluster(ComputeCluster):
 
     def __init__(self, name: str, endpoints: List[Tuple[str, int]],
                  pool: str = "default", store=None,
-                 kill_grace_ms: int = 3000):
+                 kill_grace_ms: int = 3000,
+                 progress_url: str = "",
+                 executor_python: str = "",
+                 executor_pythonpath: str = ""):
         super().__init__(name)
         self.pool = pool
         self.store = store  # optional: sandbox writeback target
         self.kill_grace_ms = kill_grace_ms
+        # scheduler REST base URL; jobs running under the "cook" executor
+        # POST progress frames here (reference: progress plumbing)
+        self.progress_url = progress_url
+        # AGENT-side interpreter + cook_tpu location for the "cook"
+        # executor wrapper; the defaults (this process's interpreter and
+        # repo) are only right when agents share the scheduler's filesystem
+        # — multi-node deployments configure the agent-side paths here
+        # (the reference ships its executor to agents as a mesos URI).
+        import sys as _sys
+        self.executor_python = executor_python or _sys.executable
+        self.executor_pythonpath = executor_pythonpath or str(_REPO_ROOT)
         self._endpoints = endpoints
         self._agents: Dict[str, AgentConnection] = {}  # hostname -> conn
         self._lock = threading.RLock()
@@ -465,7 +479,7 @@ class RemoteComputeCluster(ComputeCluster):
                        Reasons.CONTAINER_LAUNCH_FAILED.code,
                        hostname=spec.hostname)
                 continue
-            command = self._task_command(spec)
+            command, extra_env = self._task_command(spec)
             if command is None:
                 # job vanished between match and launch, or has no command:
                 # running a placeholder would report SUCCESS for work that
@@ -484,7 +498,8 @@ class RemoteComputeCluster(ComputeCluster):
                 ok = conn.launch(
                     spec.task_id, command,
                     spec.resources.cpus, spec.resources.mem,
-                    env=spec.env, port_count=spec.port_count,
+                    env={**spec.env, **extra_env},
+                    port_count=spec.port_count,
                     image=container.get("image", ""),
                     volumes=[v if isinstance(v, str)
                              else f"{v['host-path']}:{v['container-path']}"
@@ -498,21 +513,41 @@ class RemoteComputeCluster(ComputeCluster):
                        Reasons.CONTAINER_LAUNCH_FAILED.code,
                        hostname=spec.hostname)
 
-    def _task_command(self, spec: LaunchSpec) -> Optional[str]:
-        """The command to run, or None when it cannot be determined (which
-        must fail the launch, not silently succeed). Without a store this
-        backend is a pure transport under test; 'true' keeps it driveable.
+    def _task_command(self, spec: LaunchSpec
+                      ) -> Tuple[Optional[str], Dict[str, str]]:
+        """(command, extra env), command None when it cannot be determined
+        (which must fail the launch, not silently succeed). Without a store
+        this backend is a pure transport under test; 'true' keeps it
+        driveable.
 
-        URI artifacts are compiled into a fetch prelude ahead of the user
-        command — the task-compiler role of the reference's mesos fetcher
-        config (mesos/task.clj:114-160, :job/uri)."""
+        Task compilation (the reference's mesos/task.clj:114-294 role):
+        URI artifacts become a fetch prelude ahead of the user command, and
+        :job/executor "cook" wraps the command in the progress-tracking
+        executor (python -m cook_tpu.agent.executor) with its configuration
+        in the environment."""
         if self.store is None:
-            return "true"
+            return "true", {}
         job = self.store.job(spec.job_uuid)
         if job is None or not job.command:
-            return None
+            return None, {}
         prelude = compile_fetch_prelude(job.uris)
-        return prelude + job.command if prelude else job.command
+        command = prelude + job.command if prelude else job.command
+        extra: Dict[str, str] = {}
+        if job.executor == "cook":
+            import shlex
+            # prepend (not clobber) any PYTHONPATH the job itself set
+            job_pp = job.env.get("PYTHONPATH", "")
+            extra["PYTHONPATH"] = (self.executor_pythonpath
+                                   + (":" + job_pp if job_pp else ""))
+            if self.progress_url:
+                extra["COOK_PROGRESS_URL"] = self.progress_url
+            if job.progress_regex_string:
+                extra["COOK_PROGRESS_REGEX"] = job.progress_regex_string
+            if job.progress_output_file:
+                extra["COOK_PROGRESS_FILE"] = job.progress_output_file
+            command = (f"exec {shlex.quote(self.executor_python)} -m "
+                       f"cook_tpu.agent.executor {shlex.quote(command)}")
+        return command, extra
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:
@@ -543,10 +578,11 @@ class RemoteComputeCluster(ComputeCluster):
 
 
 def factory(store=None, name: str = "native", endpoints=None,
-            pool: str = "default", kill_grace_ms: int = 3000
-            ) -> "RemoteComputeCluster":
+            pool: str = "default", kill_grace_ms: int = 3000,
+            progress_url: str = "") -> "RemoteComputeCluster":
     """Config-driven construction for the daemon: ``endpoints`` is a list of
     [host, port] pairs of running cook_agentd daemons."""
     eps = [(h, int(p)) for h, p in (endpoints or [])]
     return RemoteComputeCluster(name, eps, pool=pool, store=store,
-                                kill_grace_ms=kill_grace_ms)
+                                kill_grace_ms=kill_grace_ms,
+                                progress_url=progress_url)

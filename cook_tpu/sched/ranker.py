@@ -77,10 +77,13 @@ class RankedQueue:
     Duck-types the List[Job] surface the cycle consumers use: len, bool,
     iteration, indexing and slicing (a slice returns materialized Jobs)."""
 
-    def __init__(self, store: Store, uuids: np.ndarray, resources: np.ndarray):
+    def __init__(self, store: Store, uuids: np.ndarray,
+                 resources: np.ndarray, users: Optional[np.ndarray] = None):
         self.store = store
         self.uuids = uuids
         self.resources = resources  # f32[n, 4] in ranked order
+        self.users = (users if users is not None
+                      else np.zeros(len(uuids), dtype="<U64"))
 
     def __len__(self) -> int:
         return len(self.uuids)
@@ -101,7 +104,8 @@ class RankedQueue:
                 yield job
 
     def filtered(self, keep: np.ndarray) -> "RankedQueue":
-        return RankedQueue(self.store, self.uuids[keep], self.resources[keep])
+        return RankedQueue(self.store, self.uuids[keep],
+                           self.resources[keep], self.users[keep])
 
 
 class Ranker:
@@ -160,7 +164,7 @@ class Ranker:
         if got is None:
             return RankedQueue(self.store, np.zeros(0, dtype="<U36"),
                                np.zeros((0, 4), dtype=F32))
-        arrays, uuids_sorted, users = got
+        arrays, uuids_sorted, row_users, users = got
         counts = np.bincount(arrays["user_rank"],
                              minlength=len(users)).astype(np.int64)
         share_mat = np.stack([
@@ -179,7 +183,7 @@ class Ranker:
         n = int(res.num_ranked)
         order = np.asarray(res.order)[:n]
         queue = RankedQueue(self.store, uuids_sorted[order],
-                            arrays["usage"][order])
+                            arrays["usage"][order], row_users[order])
         return self._apply_pool_quota_columnar(pool_name, queue)
 
     def _apply_pool_quota_columnar(self, pool_name: str,

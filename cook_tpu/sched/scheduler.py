@@ -263,8 +263,16 @@ class Scheduler:
                 if inst is not None:
                     launched_uuids.add(inst.job_uuid)
         if launched_uuids:
-            queues = {p: [j for j in q if j.uuid not in launched_uuids]
-                      for p, q in queues.items()}
+            from .ranker import RankedQueue
+
+            def prune(q):
+                if isinstance(q, RankedQueue):
+                    # columnar: vectorized, no full-queue materialization
+                    import numpy as np
+                    return q.filtered(~np.isin(q.uuids,
+                                               list(launched_uuids)))
+                return [j for j in q if j.uuid not in launched_uuids]
+            queues = {p: prune(q) for p, q in queues.items()}
         self.pending_queues = queues
         for pool_name, result in results.items():
             self._autoscale(pool_name, result)

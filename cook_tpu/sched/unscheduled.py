@@ -154,11 +154,23 @@ def job_reasons(store: Store, job: Job,
         # returns up to 10 uuids of the USER'S OWN jobs ahead in line —
         # never another user's uuids)
         queue = scheduler.pending_queues.get(job.pool, [])
-        position = next((i for i, j in enumerate(queue)
-                         if j.uuid == job.uuid), None)
+        from .ranker import RankedQueue
+        if isinstance(queue, RankedQueue):
+            # columnar queue: pure numpy scans — no entity materialization
+            # regardless of queue depth or position
+            import numpy as np
+            hits = np.flatnonzero(queue.uuids == job.uuid)
+            position = int(hits[0]) if hits.size else None
+            own_ahead = (list(queue.uuids[:position][
+                queue.users[:position] == job.user])
+                if position is not None and position > 0 else [])
+        else:
+            position = next((i for i, j in enumerate(queue)
+                             if j.uuid == job.uuid), None)
+            own_ahead = ([j.uuid for j in queue[:position]
+                          if j.user == job.user]
+                         if position is not None and position > 0 else [])
         if position is not None and position > 0:
-            own_ahead = [j.uuid for j in queue[:position]
-                         if j.user == job.user]
             if own_ahead:
                 reasons.append({
                     "reason": f"You have {len(own_ahead)} other jobs ahead "

@@ -50,6 +50,15 @@ def _grow(arr: np.ndarray, n: int) -> np.ndarray:
     return new
 
 
+def _fit_str(arr: np.ndarray, value: str) -> np.ndarray:
+    """Widen a fixed-width string column when a value wouldn't fit —
+    numpy silently truncates on assignment, and a truncated pool/user name
+    would make its rows invisible to equality scans."""
+    if len(value) <= arr.dtype.itemsize // 4:  # U-dtype: 4 bytes per char
+        return arr
+    return arr.astype(f"<U{max(len(value), 2 * (arr.dtype.itemsize // 4))}")
+
+
 class ColumnarIndex:
     """Attach with ``ColumnarIndex(store)``; reads ``store`` internals once
     under its lock for the initial scan, then stays fresh off the tx feed."""
@@ -107,7 +116,9 @@ class ColumnarIndex:
             self._prio[row] = job.priority
             self._submit[row] = job.submit_time_ms
             self._uuid[row] = job.uuid
+            self._user = _fit_str(self._user, job.user)
             self._user[row] = job.user
+            self._pool = _fit_str(self._pool, job.pool)
             self._pool[row] = job.pool
         self._pending[row] = job.committed and job.state is JobState.WAITING
         done = job.state is JobState.COMPLETED
@@ -169,11 +180,11 @@ class ColumnarIndex:
     # ------------------------------------------------------------- queries
     def rank_arrays(self, pool: str,
                     ) -> Optional[Tuple[Dict[str, np.ndarray], np.ndarray,
-                                        List[str]]]:
+                                        np.ndarray, List[str]]]:
         """Unpadded RankInputs columns for one pool, plus the sorted-order
-        uuid array (kernel order positions -> job uuid) and the pool's
-        distinct users in segment order.  None when the pool has no pending
-        jobs (matching the entity path's early-out)."""
+        uuid and user arrays (kernel order positions -> job uuid/user) and
+        the pool's distinct users in segment order.  None when the pool has
+        no pending jobs (matching the entity path's early-out)."""
         with self._lock:
             self._maybe_compact()
             n = self._n
@@ -208,7 +219,8 @@ class ColumnarIndex:
                 "pending": pending[order],
                 "valid": np.ones(rows_s.size, dtype=bool),
             }
-            return arrays, self._uuid[rows_s], list(user_s[seg_start])
+            return (arrays, self._uuid[rows_s], user_s,
+                    list(user_s[seg_start]))
 
     def pool_usage_base(self, pool: str) -> np.ndarray:
         """Summed (cpus, mem, gpus, count) of the pool's live instances —

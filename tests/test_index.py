@@ -172,3 +172,19 @@ class TestRankedQueueSurface:
         store.kill_job(q.uuids[0])
         assert [j.uuid for j in q] == list(q.uuids)
         assert q[0].state is JobState.COMPLETED
+
+
+class TestLongNames:
+    def test_long_pool_and_user_names_not_truncated(self):
+        """Fixed-width string columns widen instead of silently truncating
+        (a truncated name would make its rows invisible to the pool scan)."""
+        long_pool = "pool-" + "x" * 60
+        long_user = "user-" + "y" * 90
+        store = Store()
+        store.put_pool(Pool(name=long_pool))
+        cfg = Config()
+        store.ensure_index()
+        j = make_job(long_user, pool=long_pool)
+        store.create_jobs([j])
+        assert ranked_uuids(store, cfg, long_pool) == [j.uuid]
+        assert_parity(store, cfg, long_pool)

@@ -230,3 +230,28 @@ class TestDirectModeBackpressure:
         assert cluster.max_launchable("default") == 5
         cluster.max_total_pods = 2
         assert cluster.max_launchable("default") == 1
+
+
+class TestPodSpecArtifacts:
+    def test_ports_and_uris_compiled_into_pod(self):
+        """job.ports -> containerPorts; job.uris -> cook-fetch init
+        container sharing the workdir (the mesos fetcher's k8s analog)."""
+        from cook_tpu.cluster.k8s.pod_spec import build_pod_spec
+        from cook_tpu.state import Job, Resources, new_uuid
+
+        job = Job(uuid=new_uuid(), user="alice", command="serve",
+                  ports=2,
+                  uris=[{"value": "/data/a.bin"},
+                        {"value": "https://x/b.tgz", "extract": True}],
+                  resources=Resources(cpus=1.0, mem=64.0))
+        spec = build_pod_spec(job, "default")
+        main = spec["containers"][0]
+        assert spec["port_count"] == 2
+        assert {"name": "COOK_PORT_COUNT", "value": "2"} in main["env"]
+        fetch = [c for c in spec["init_containers"]
+                 if c["name"] == "cook-fetch"]
+        assert len(fetch) == 1
+        assert "/data/a.bin" in fetch[0]["env"][0]["value"]
+        assert "https://x/b.tgz" in fetch[0]["env"][0]["value"]
+        # fetch lands in the same workdir volume the job mounts
+        assert fetch[0]["volume_mounts"][0]["name"] == "cook-workdir"

@@ -564,3 +564,95 @@ class TestUriFetch:
         assert all(i.status is InstanceStatus.FAILED for i in insts)
         assert not marker.exists()  # user command never ran
         cluster.shutdown()
+
+
+class TestCookExecutorChoice:
+    def test_executor_cook_tracks_progress_through_rest(self, agent,
+                                                        tmp_path):
+        """:job/executor "cook" wraps the command in the progress-tracking
+        executor; progress lines in stdout land on the instance through
+        POST /progress (reference: executor choice in task.clj:114-160 +
+        progress plumbing)."""
+        from cook_tpu.config import Config
+        from cook_tpu.rest.api import ApiServer, CookApi
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        srv = ApiServer(CookApi(store, scheduler=sched))
+        srv.start()
+        cluster.progress_url = f"http://127.0.0.1:{srv.port}"
+        try:
+            job = Job(uuid=new_uuid(), user="alice",
+                      command='echo "progress: 30 warming"; sleep 0.3; '
+                              'echo "progress: 80 almost"; sleep 0.2',
+                      executor="cook",
+                      pool="default",
+                      resources=Resources(cpus=1.0, mem=128.0))
+            store.create_jobs([job])
+            sched.step_rank()
+            sched.step_match()
+
+            def done():
+                sched.flush_status_updates()
+                return store.job(job.uuid).state is JobState.COMPLETED
+            assert wait_for(done, timeout=20)
+            insts = [store.instance(t)
+                     for t in store.job(job.uuid).instances]
+            inst = next(i for i in insts
+                        if i.status is InstanceStatus.SUCCESS)
+            assert inst.progress == 80
+            assert inst.progress_message == "almost"
+        finally:
+            srv.stop()
+            cluster.shutdown()
+
+    def test_kill_cook_executor_job_kills_workload(self, agent, tmp_path):
+        """Killing a cook-executor task must kill the USER COMMAND, not
+        just the wrapper (the wrapper forwards SIGTERM to the child's
+        session — otherwise the workload survives in its own pgid)."""
+        import subprocess as sp
+
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store,
+            kill_grace_ms=6000)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        pidfile = tmp_path / "workload.pid"
+        job = Job(uuid=new_uuid(), user="alice",
+                  command=f"echo $$ > {pidfile}; sleep 300",
+                  executor="cook", pool="default",
+                  resources=Resources(cpus=1.0, mem=128.0))
+        store.create_jobs([job])
+        sched.step_rank()
+        sched.step_match()
+        assert wait_for(pidfile.exists, timeout=10)
+        workload_pid = int(pidfile.read_text())
+        store.kill_job(job.uuid)
+
+        def done():
+            sched.flush_status_updates()
+            return store.job(job.uuid).state is JobState.COMPLETED
+        assert wait_for(done, timeout=20)
+
+        def workload_gone():
+            try:
+                import os
+                os.kill(workload_pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+        assert wait_for(workload_gone, timeout=10), \
+            "user command survived the kill"
+        cluster.shutdown()

@@ -584,3 +584,29 @@ class TestExtendedJobAttrs:
         assert job["datasets"] == [{"dataset": {"bucket": "b", "path": "/p"}}]
         assert job["application"]["name"] == "spark"
         assert job["application"]["workload-class"] == "etl"
+
+    def test_submit_extended_flags(self, system, capsys):
+        store, cluster, sched, server = system
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "--user", "cliuser",
+                     "submit", "--ports", "2",
+                     "--docker-image", "busybox:1.36",
+                     "--volume", "/data:/mnt/data",
+                     "--uri", "/tools/run.sh",
+                     "--executor", "cook",
+                     "--application", "etl:2.1",
+                     "echo", "hi"]) == 0
+        uuid = capsys.readouterr().out.strip()
+        job = json.loads(store_job_json(store, uuid))
+        assert job["ports"] == 2
+        assert job["container"]["image"] == "busybox:1.36"
+        assert job["container"]["volumes"] == ["/data:/mnt/data"]
+        assert job["uris"] == [{"value": "/tools/run.sh"}]
+        assert job["executor"] == "cook"
+        assert job["application"]["name"] == "etl"
+        assert job["application"]["version"] == "2.1"
+
+
+def store_job_json(store, uuid):
+    from cook_tpu.rest.api import job_to_json
+    return json.dumps(job_to_json(store, store.job(uuid)))
