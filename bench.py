@@ -422,6 +422,41 @@ def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
     return out
 
 
+def bench_fused_cycle(T=100_000, n_users=200, H=5000):
+    """The PRODUCTION cycle shape: rank + admission + match for a pool in
+    ONE device dispatch (parallel/sharded.single_pool_cycle, the kernel
+    behind Scheduler.step_cycle) — no host round trip between rank and
+    match."""
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import host_prep
+    from cook_tpu.parallel.sharded import single_pool_cycle
+
+    users, shares, quotas = make_rank_workload(n_users, T, seed=9)
+    arrays, _ = host_prep.pack_rank_inputs(users, shares, quotas)
+    TB = arrays["usage"].shape[0]
+    job_res, cmask, avail, capacity = make_match_workload(TB, H, seed=10)
+    inp = {k: jnp.asarray(v) for k, v in arrays.items()}
+    inp.update(job_res=jnp.asarray(job_res),
+               cmask=jnp.asarray(cmask),
+               avail=jnp.asarray(avail),
+               capacity=jnp.asarray(capacity))
+    import jax
+    fused = jax.jit(lambda d: single_pool_cycle(
+        d["usage"], d["quota"], d["shares"], d["first_idx"], d["user_rank"],
+        d["pending"], d["valid"], d["job_res"], d["cmask"], d["avail"],
+        d["capacity"], num_considerable=jnp.asarray(1000, dtype=jnp.int32)))
+    times = timed(lambda: fused(inp)[3], reps=5, inner=8)
+    placed = int((np.asarray(fused(inp)[3]) >= 0).sum())
+    out = {"p50_ms": round(pctl(times, 50), 3),
+           "p99_ms": round(pctl(times, 99), 3),
+           "placed": placed}
+    print(f"fused_cycle[{T//1000}k tasks x {H//1000}k hosts, 1k "
+          f"considerable] amortized_p50={out['p50_ms']}ms "
+          f"p99={out['p99_ms']}ms placed={placed}", file=sys.stderr)
+    return out
+
+
 def bench_rebalance(T=1_000_000, H=50_000):
     """Preemption victim scan over 1M running tasks on 50k hosts."""
     import jax.numpy as jnp
@@ -560,6 +595,9 @@ def run_section(name: str) -> None:
                 "parity": parity, "placed": placed, "detail": detail}
     elif name == "match_large":
         data = bench_match_large(J=scaled(10_000), H=scaled(50_000))
+    elif name == "fused_cycle":
+        data = bench_fused_cycle(T=scaled(100_000),
+                                 n_users=scaled(200, lo=8), H=scaled(5000))
     elif name == "rebalance":
         data = {"samples_ms": bench_rebalance(T=scaled(1_000_000),
                                               H=scaled(50_000))}
@@ -626,8 +664,8 @@ def main():
     if os.environ.get("BENCH_TPU_ERROR") and not tpu_error:
         tpu_error = os.environ["BENCH_TPU_ERROR"]
 
-    sections = ["sync_floor", "rank", "match", "match_large", "rebalance",
-                "store_cycle", "end2end"]
+    sections = ["sync_floor", "rank", "match", "match_large", "fused_cycle",
+                "rebalance", "store_cycle", "end2end"]
     results, platforms, errors = {}, {}, {}
     for name in sections:
         data, platform, err = _run_section_subproc(name)
@@ -682,6 +720,8 @@ def main():
             (rank["cpu_ms"] + match["cpu_ms"]) / cycle_p50, 2)
     if results.get("match_large") is not None:
         detail["match_large_10k_jobs_50k_hosts"] = results["match_large"]
+    if results.get("fused_cycle") is not None:
+        detail["fused_cycle_100k_tasks_5k_hosts"] = results["fused_cycle"]
     if results.get("store_cycle") is not None:
         detail["store_cycle_100k_jobs"] = results["store_cycle"]
     if results.get("rebalance"):
