@@ -39,6 +39,7 @@ class KubernetesCluster(ComputeCluster):
                  max_pods_per_node: int = 32,
                  synthetic_pod_ttl_ms: int = 120_000,
                  stuck_pod_timeout_ms: int = 300_000,
+                 node_blocklist_labels: Optional[List[str]] = None,
                  incremental=None):
         super().__init__(name)
         self.api = api or FakeKubernetesApi()
@@ -46,6 +47,10 @@ class KubernetesCluster(ComputeCluster):
         self.max_total_pods = max_total_pods
         self.max_pods_per_node = max_pods_per_node
         self.stuck_pod_timeout_ms = stuck_pod_timeout_ms
+        # nodes carrying any of these label KEYS take no cook work
+        # (reference: node-blocklist-labels in node-schedulable?,
+        # kubernetes/api.clj:782)
+        self.node_blocklist_labels = list(node_blocklist_labels or [])
         self.incremental = incremental
         self._watch_registered = False
         clock = (lambda: store.clock()) if store is not None else (lambda: 0)
@@ -157,6 +162,8 @@ class KubernetesCluster(ComputeCluster):
         for node in self.api.nodes():
             if node.pool != pool or node.unschedulable or node.taints:
                 continue
+            if any(k in node.labels for k in self.node_blocklist_labels):
+                continue
             used = consumption.get(node.name, [0.0, 0.0, 0.0])
             avail = Resources(cpus=max(0.0, node.cpus - used[0]),
                               mem=max(0.0, node.mem - used[1]),
@@ -212,9 +219,13 @@ class KubernetesCluster(ComputeCluster):
             if p.node_name:
                 per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
         for node in self.api.nodes():
-            if node.pool == pool and not node.unschedulable:
-                node_headroom += max(
-                    0, self.max_pods_per_node - per_node.get(node.name, 0))
+            if node.pool != pool or node.unschedulable:
+                continue
+            if any(k in node.labels for k in self.node_blocklist_labels):
+                continue  # consistent with pending_offers: no offers ->
+                # no launchable headroom either
+            node_headroom += max(
+                0, self.max_pods_per_node - per_node.get(node.name, 0))
         return max(0, min(total_headroom, node_headroom))
 
     # ------------------------------------------------------------ autoscaling

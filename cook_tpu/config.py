@@ -40,7 +40,7 @@ class MatcherConfig:
     auction_num_prefs: int = 16
     auction_num_rounds: int = 8
     auction_num_refresh: int = 8
-    waterfill_num_rounds: int = 24
+    waterfill_num_rounds: int = 32
 
 
 @dataclass

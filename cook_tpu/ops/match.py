@@ -216,7 +216,7 @@ def _auction_rounds(inp: MatchInputs, pref_fit: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("num_rounds",))
-def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 24
+def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 32
                            ) -> Tuple[jax.Array, jax.Array]:
     """Prefix-packing ("waterfill") assignment: the large-J kernel.
 
@@ -257,8 +257,9 @@ def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 24
     rank = jnp.arange(J, dtype=jnp.int32)
     cap = jnp.maximum(inp.capacity, 1e-9)
 
-    def one_round(state, _):
-        assign, avail, skip = state
+    def one_round(state):
+        assign, avail, skip, rnd, _changed = state
+        skip_before = skip
         active = (assign < 0) & inp.valid & (skip < H)
         util = ((cap[:, 0] - avail[:, 0]) / cap[:, 0]
                 + (cap[:, 1] - avail[:, 1]) / cap[:, 1]) * 0.5
@@ -299,12 +300,17 @@ def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 24
             inp.job_res * admitted[:, None], jnp.minimum(choice, H - 1),
             num_segments=H)
         avail = avail - consumed
-        return (assign, avail, skip), None
+        # fixed point: nothing admitted and no probe advanced means every
+        # later round would recompute the identical state — stop paying
+        # for it (exact-result-preserving early exit)
+        changed = admitted.any() | (skip != skip_before).any()
+        return assign, avail, skip, rnd + 1, changed
 
     init = (jnp.full((J,), -1, dtype=jnp.int32), inp.avail,
-            jnp.zeros((J,), dtype=jnp.int32))
-    (assign, avail, _), _ = jax.lax.scan(one_round, init, None,
-                                         length=num_rounds)
+            jnp.zeros((J,), dtype=jnp.int32), jnp.int32(0),
+            jnp.bool_(True))
+    assign, avail, _, _, _ = jax.lax.while_loop(
+        lambda s: (s[3] < num_rounds) & s[4], one_round, init)
     return assign, avail
 
 

@@ -255,3 +255,22 @@ class TestPodSpecArtifacts:
         assert "https://x/b.tgz" in fetch[0]["env"][0]["value"]
         # fetch lands in the same workdir volume the job mounts
         assert fetch[0]["volume_mounts"][0]["name"] == "cook-workdir"
+
+
+class TestNodeBlocklist:
+    def test_blocklisted_label_excludes_node_from_offers(self):
+        """node-blocklist-labels (reference: node-schedulable?
+        kubernetes/api.clj:782): a node carrying a blocklisted label key
+        contributes no offers even when otherwise schedulable."""
+        from cook_tpu.cluster.k8s.compute_cluster import KubernetesCluster
+        from cook_tpu.cluster.k8s.fake_api import FakeKubernetesApi, FakeNode
+
+        api = FakeKubernetesApi()
+        api.add_node(FakeNode(name="good", cpus=8, mem=8192))
+        api.add_node(FakeNode(name="cordoned", cpus=8, mem=8192,
+                              labels={"maintenance": "true"}))
+        cluster = KubernetesCluster(
+            "k1", api=api, node_blocklist_labels=["maintenance"])
+        cluster.initialize(lambda *a, **k: None)
+        hosts = {o.hostname for o in cluster.pending_offers("default")}
+        assert hosts == {"good"}
