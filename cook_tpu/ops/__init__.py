@@ -11,6 +11,7 @@ from .match import (  # noqa: F401
     auction_match_kernel,
     greedy_match_kernel,
     multipass_match_kernel,
+    waterfill_match_kernel,
 )
 from .padding import bucket, pad_to  # noqa: F401
 from .rebalance import (  # noqa: F401

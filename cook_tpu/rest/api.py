@@ -58,6 +58,52 @@ from ..state.schema import (
 from ..state.store import AbortTransaction, Store
 
 
+# (method, path, summary, leader_only) — the documented API surface served
+# by _Handler._dispatch; /swagger-docs and /swagger-ui render this table
+# (reference: the compojure-api Swagger surface, rest/api.clj:3640-4019).
+API_ROUTES = [
+    ("GET", "/jobs/{uuid}", "one job with instances", False),
+    ("GET", "/jobs", "batch job query by uuid params", False),
+    ("POST", "/jobs", "submit a batch of jobs (atomic)", False),
+    ("DELETE", "/jobs", "kill jobs by uuid", False),
+    ("GET", "/rawscheduler", "deprecated job CRUD (query)", False),
+    ("POST", "/rawscheduler", "deprecated job CRUD (submit)", False),
+    ("DELETE", "/rawscheduler", "deprecated job CRUD (kill)", False),
+    ("GET", "/instances/{task_id}", "one instance", False),
+    ("DELETE", "/instances", "kill instances by task id", False),
+    ("GET", "/share", "fair-share weights for a user", False),
+    ("POST", "/share", "set shares (admin)", False),
+    ("DELETE", "/share", "retract shares (admin)", False),
+    ("GET", "/quota", "hard caps for a user", False),
+    ("POST", "/quota", "set quotas (admin)", False),
+    ("DELETE", "/quota", "retract quotas (admin)", False),
+    ("GET", "/usage", "a user's running usage per pool", False),
+    ("POST", "/retry", "raise retries / requeue a job", False),
+    ("GET", "/group", "job group status", False),
+    ("DELETE", "/group", "kill a job group", False),
+    ("GET", "/list", "query jobs by user/state/time window", False),
+    ("GET", "/queue", "ranked pending queues (admin)", True),
+    ("GET", "/running", "running instances", False),
+    ("GET", "/unscheduled_jobs", "why-unscheduled explanations", True),
+    ("GET", "/failure_reasons", "failure reason table", False),
+    ("GET", "/stats/instances", "instance statistics", False),
+    ("GET", "/settings", "effective scheduler settings", False),
+    ("GET", "/pools", "pool listing", False),
+    ("GET", "/info", "version + leadership", False),
+    ("GET", "/debug", "health + recent tracing spans", False),
+    ("GET", "/metrics", "Prometheus metrics", False),
+    ("POST", "/progress/{task_id}", "sidecar progress frames", True),
+    ("POST", "/shutdown-leader", "resign leadership (admin)", True),
+    ("GET", "/compute-clusters", "dynamic cluster configs", False),
+    ("POST", "/compute-clusters/{name}", "create/update/drain a cluster",
+     True),
+    ("GET", "/incremental-config", "gradual-rollout config values", False),
+    ("POST", "/incremental-config", "set rollout portions (admin)", True),
+    ("GET", "/swagger-docs", "this API description (OpenAPI)", False),
+    ("GET", "/swagger-ui", "human-readable API listing", False),
+]
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str,
                  headers: Optional[Dict[str, str]] = None):
@@ -614,6 +660,54 @@ class CookApi:
                 "authentication-scheme": "open",
                 "start-up-time": 0}
 
+    def swagger_docs(self) -> Dict:
+        """Machine-readable API description (reference: the swagger-docs
+        endpoint compojure-api generates from the route table,
+        rest/api.clj:3640).  OpenAPI-3 shape, hand-maintained from the
+        same dispatch table do_* routes serve."""
+        from .. import __version__
+        paths: Dict[str, Dict] = {}
+        for method, path, summary, leader_only in API_ROUTES:
+            entry = paths.setdefault(path, {})
+            op = {
+                "summary": summary,
+                "x-leader-only": leader_only,
+                "responses": {"200": {"description": "success"}},
+            }
+            # declared path parameters, required by the OpenAPI spec for
+            # every templated segment
+            names = re.findall(r"{([^}]+)}", path)
+            if names:
+                op["parameters"] = [
+                    {"name": n, "in": "path", "required": True,
+                     "schema": {"type": "string"}} for n in names]
+            entry[method.lower()] = op
+        return {
+            "openapi": "3.0.0",
+            "info": {"title": "cook_tpu scheduler API",
+                     "version": __version__,
+                     "description": "TPU-native fair-share batch scheduler "
+                                    "(Cook-compatible REST surface)"},
+            "paths": paths,
+        }
+
+    def swagger_ui(self) -> str:
+        """Minimal self-contained HTML view of the API (no external
+        assets; the image is zero-egress)."""
+        rows = "".join(
+            f"<tr><td><code>{m}</code></td><td><code>{p}</code></td>"
+            f"<td>{s}</td><td>{'leader' if lo else ''}</td></tr>"
+            for m, p, s, lo in API_ROUTES)
+        return ("<!doctype html><html><head><title>cook_tpu API</title>"
+                "<style>body{font-family:sans-serif;margin:2em}"
+                "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+                "padding:4px 8px;text-align:left}</style></head><body>"
+                "<h1>cook_tpu scheduler API</h1>"
+                "<p>Machine-readable spec at <a href='/swagger-docs'>"
+                "/swagger-docs</a>.</p><table><tr><th>Method</th>"
+                f"<th>Path</th><th>Summary</th><th></th></tr>{rows}"
+                "</table></body></html>")
+
     def debug(self) -> Dict:
         from ..utils.tracing import tracer
         return {"healthy": True,
@@ -802,7 +896,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/metrics", "/failure_reasons",
-                    "/settings"}
+                    "/settings", "/swagger-docs", "/swagger-ui"}
 
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
@@ -850,6 +944,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.info()
             if path == "/debug":
                 return api.debug()
+            if path == "/swagger-docs":
+                return api.swagger_docs()
+            if path == "/swagger-ui":
+                return {"_html": api.swagger_ui()}
             if path == "/metrics":
                 return {"_raw": api.metrics()}
             if path == "/compute-clusters":
@@ -925,10 +1023,13 @@ class ApiServer:
         orig_respond = handler._respond
 
         def respond(self_h, status, payload, extra_headers=None):
-            if isinstance(payload, dict) and "_raw" in payload:
-                data = payload["_raw"].encode()
+            if isinstance(payload, dict) and ("_raw" in payload
+                                              or "_html" in payload):
+                html = "_html" in payload
+                data = payload.get("_raw", payload.get("_html")).encode()
                 self_h.send_response(status)
-                self_h.send_header("Content-Type", "text/plain")
+                self_h.send_header("Content-Type",
+                                   "text/html" if html else "text/plain")
                 self_h.send_header("Content-Length", str(len(data)))
                 self_h.end_headers()
                 self_h.wfile.write(data)

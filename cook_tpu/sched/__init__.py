@@ -5,7 +5,8 @@ from .constraints import (  # noqa: F401
 )
 from .matcher import MatchCycleResult, Matcher  # noqa: F401
 from .monitor import Monitor  # noqa: F401
-from .ranker import Ranker, build_user_tasks  # noqa: F401
+from .election import FileLeaderElector, LeaseLeaderElector  # noqa: F401
+from .ranker import RankedQueue, Ranker, build_user_tasks  # noqa: F401
 from .optimizer import (  # noqa: F401
     DummyHostFeed,
     DummyOptimizer,

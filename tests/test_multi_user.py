@@ -316,3 +316,54 @@ class TestStatisticalWorkloadAtScale:
         p50 = {k: float(np.percentile(np.asarray(v), 50))
                for k, v in waits.items()}
         assert p50["interactive"] <= p50["batch"] + 1e-9, p50
+
+
+class TestRebalancerChurn:
+    def test_preemption_churn_at_thousands_of_jobs(self):
+        """Tight capacity + an over-share user + periodic rebalancing at
+        a few thousand jobs: preemptions happen, preempted work is mea-culpa retried,
+        and every job still completes (the reference's multi-user
+        preemption scenarios, test_multi_user.py, at simulator scale)."""
+        from cook_tpu.config import Config, RebalancerConfig
+        from cook_tpu.sim.simulator import Simulator, load_hosts, load_trace
+        from cook_tpu.sim.workload import generate_hosts, generate_trace
+
+        spec = {
+            "seed": 23,
+            "horizon_ms": 300_000,
+            "user_classes": [
+                # one hog class front-loads the cluster
+                {"name": "hog", "users": 2, "arrival_rate_per_min": 120.0,
+                 "duration_ms": {"dist": "constant", "value": 120_000},
+                 "cpus": 4.0, "mem": 512.0,
+                 "priority": {"dist": "constant", "value": 50}},
+                {"name": "fair", "users": 20,
+                 "arrival_rate_per_min": 18.0,
+                 "duration_ms": {"dist": "exponential", "scale": 15_000},
+                 "cpus": 1.0, "mem": 128.0,
+                 "priority": {"dist": "constant", "value": 50}},
+            ],
+        }
+        trace_entries = generate_trace(spec)
+        assert len(trace_entries) >= 2_000
+        cfg = Config(rebalancer=RebalancerConfig(
+            enabled=True, safe_dru_threshold=0.0, min_dru_diff=0.0,
+            max_preemption=32))
+        sim = Simulator(load_trace(trace_entries),
+                        load_hosts(generate_hosts(40, cpus=16.0,
+                                                  mem=16384.0)),
+                        config=cfg, backend="tpu",
+                        rank_interval_ms=10_000, match_interval_ms=5_000,
+                        rebalance_interval_ms=30_000)
+        # finite default share so DRU comparisons are meaningful
+        sim.store.set_share("default", "default",
+                            {"cpus": 32.0, "mem": 32768.0})
+        res = sim.run()
+        s = res.summary()
+        assert s["jobs_completed"] == s["jobs_total"]
+        assert s["preemptions"] > 0, "churn scenario produced no preemptions"
+        # preempted instances are mea-culpa (never consume retries), so
+        # preempted jobs completed anyway — which jobs_completed proves;
+        # spot-check a preempted record exists and is marked
+        preempted = [r for r in res.task_records if r["preempted"]]
+        assert preempted
