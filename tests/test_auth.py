@@ -114,3 +114,111 @@ class TestRestIntegration:
             assert ei.value.status == 401
         finally:
             srv.stop()
+
+
+class TestGssapiAuthenticator:
+    """SPNEGO slot (reference: rest/spnego.clj) — the validator drives a
+    GSSAPI module; tests inject a fake (no KDC in this image). GSS tokens
+    are ASN.1-framed (first byte 0x60)."""
+
+    VALID = b"\x60" + b"valid-krb-token"
+
+    class FakeCtx:
+        def __init__(self, creds, usage):
+            assert usage == "accept"
+            self.creds = creds
+            self.complete = False
+            self.initiator_name = None
+
+        def step(self, token):
+            if token != TestGssapiAuthenticator.VALID:
+                raise ValueError("defective token")
+            self.complete = True
+            self.initiator_name = "alice@EXAMPLE.COM"
+
+    def _fake_module(self, recorded):
+        class NameType:
+            hostbased_service = "hostbased"
+
+        class Fake:
+            pass
+        fake = Fake()
+        fake.NameType = NameType
+        fake.Name = lambda service, name_type: recorded.setdefault(
+            "spn", (service, name_type)) and service or service
+        fake.Credentials = lambda name, usage: recorded.setdefault(
+            "creds", (name, usage)) or ("creds", name)
+        fake.SecurityContext = \
+            lambda creds, usage: self.FakeCtx(creds, usage)
+        return fake
+
+    def _auth(self, recorded=None):
+        from cook_tpu.rest.auth import GssapiAuthenticator
+        return GssapiAuthenticator(
+            gssapi_module=self._fake_module(
+                recorded if recorded is not None else {}))
+
+    def test_valid_ticket_maps_principal_to_user(self):
+        import base64
+        recorded = {}
+        a = self._auth(recorded)
+        tok = base64.b64encode(self.VALID).decode()
+        assert a.authenticate({"Authorization": f"Negotiate {tok}"}) == \
+            "alice"
+        # acceptance was constrained to the configured service principal
+        assert recorded["spn"] == ("HTTP", "hostbased")
+        assert recorded["creds"][1] == "accept"
+
+    def test_bad_gss_token_rejected_with_challenge(self):
+        import base64
+
+        import pytest
+
+        from cook_tpu.rest.auth import AuthError
+        a = self._auth()
+        tok = base64.b64encode(b"\x60forged").decode()
+        with pytest.raises(AuthError) as e:
+            a.authenticate({"Authorization": f"Negotiate {tok}"})
+        assert e.value.challenge == "Negotiate"
+
+    def test_non_negotiate_requests_pass_through(self):
+        a = self._auth()
+        assert a.authenticate({}) is None
+        assert a.authenticate({"Authorization": "Basic xyz"}) is None
+
+    def test_non_gss_negotiate_token_passes_to_later_schemes(self):
+        """An HMAC ticket under the same Negotiate header is NOT ASN.1
+        framed; the GSSAPI validator must pass it through so the chained
+        HmacTokenAuthenticator (the KDC-free stand-in) can accept it."""
+        from cook_tpu.rest.auth import AuthChain, HmacTokenAuthenticator
+        hmac_auth = HmacTokenAuthenticator("secret")
+        chain = AuthChain([self._auth(), hmac_auth])
+        ticket = hmac_auth.mint("carol")
+        assert chain.authenticate(
+            {"Authorization": f"Negotiate {ticket}"}) == "carol"
+
+    def test_chain_integration(self):
+        """GSSAPI first, basic fallback — the reference's composed
+        authorization middleware shape."""
+        import base64
+
+        from cook_tpu.rest.auth import AuthChain, BasicAuthenticator
+        chain = AuthChain([self._auth(),
+                           BasicAuthenticator({"bob": "pw"})])
+        tok = base64.b64encode(self.VALID).decode()
+        assert chain.authenticate(
+            {"Authorization": f"Negotiate {tok}"}) == "alice"
+        basic = base64.b64encode(b"bob:pw").decode()
+        assert chain.authenticate(
+            {"Authorization": f"Basic {basic}"}) == "bob"
+
+    def test_missing_gssapi_package_fails_construction(self, monkeypatch):
+        import sys
+
+        import pytest
+
+        from cook_tpu.rest.auth import GssapiAuthenticator
+        # force the import to fail even where python-gssapi is installed
+        monkeypatch.setitem(sys.modules, "gssapi", None)
+        with pytest.raises(RuntimeError, match="gssapi"):
+            GssapiAuthenticator()
