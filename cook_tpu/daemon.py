@@ -80,6 +80,30 @@ def build_scheduler_config(spec: Dict) -> Config:
     return cfg
 
 
+def build_authenticators(conf: Dict) -> Optional[List]:
+    """Authentication chain from config (reference: the auth middleware
+    selection, components.clj:266-284 + config :authorization).
+
+    Keys: ``gssapi_service`` ("HTTP") enables SPNEGO/Kerberos validation
+    (needs the gssapi package + a keytab; construction fails the boot
+    fast when they're absent), ``hmac_ticket_secret`` enables the KDC-free
+    signed-ticket scheme, ``basic_auth_users`` a password table.  Any of
+    them configured makes authentication mandatory; none = open
+    (trusted-header) mode handled by CookApi itself."""
+    from .rest.auth import (BasicAuthenticator, GssapiAuthenticator,
+                            HmacTokenAuthenticator)
+    chain: List = []
+    if conf.get("gssapi_service"):
+        chain.append(GssapiAuthenticator(service=conf["gssapi_service"]))
+    if conf.get("hmac_ticket_secret"):
+        chain.append(HmacTokenAuthenticator(conf["hmac_ticket_secret"]))
+    if conf.get("basic_auth_users") and chain:
+        # with a chain, basic joins it; alone, CookApi's own basic path
+        # (the basic_auth_users kwarg) keeps handling it
+        chain.append(BasicAuthenticator(conf["basic_auth_users"]))
+    return chain or None
+
+
 def build_clusters(specs: List[Dict], store: Store) -> List:
     """Dotted-path cluster factories, the analog of the reference's
     factory-fn template instantiation (compute_cluster.clj:483-497)."""
@@ -135,6 +159,7 @@ class CookDaemon:
             queue_limits=self.queue_limits,
             admins=conf.get("admins"), impersonators=conf.get("impersonators"),
             basic_auth_users=conf.get("basic_auth_users"),
+            authenticators=build_authenticators(conf),
             cors_origins=conf.get("cors_origins"))
         self.server = ApiServer(self.api, host=self.host, port=self.port)
         self.server.start()

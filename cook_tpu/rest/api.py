@@ -823,7 +823,11 @@ class _Handler(BaseHTTPRequestHandler):
         if self.api.auth_chain is not None:
             from .auth import AuthError
             try:
-                return self.api.auth_chain.authenticate(self.headers)
+                # schemes may fill response headers (e.g. the GSSAPI
+                # acceptor's mutual-auth token), sent with the 200
+                self._auth_respond_headers = {}
+                return self.api.auth_chain.authenticate(
+                    self.headers, self._auth_respond_headers)
             except AuthError as e:
                 headers = ({"WWW-Authenticate": e.challenge}
                            if e.challenge else None)
@@ -876,7 +880,9 @@ class _Handler(BaseHTTPRequestHandler):
             parsed = urllib.parse.urlparse(self.path)
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
-            self._respond(200, payload)
+            self._respond(200, payload,
+                          extra_headers=getattr(
+                              self, "_auth_respond_headers", None))
         except _Redirect as r:
             # 307 preserves the method+body, as the reference's
             # leader-redirect does. Drain any unread body first: leaving it

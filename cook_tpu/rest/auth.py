@@ -36,11 +36,17 @@ class AuthError(Exception):
 class Authenticator:
     """One authentication scheme. Returns the identity, or None when the
     request carries no credentials for this scheme (the chain moves on);
-    raises AuthError when credentials are present but invalid."""
+    raises AuthError when credentials are present but invalid.
+
+    ``respond``, when provided, is a dict the scheme may fill with
+    response headers to send on success (e.g. the GSSAPI acceptor's
+    mutual-authentication token in ``WWW-Authenticate``)."""
 
     challenge: Optional[str] = None
 
-    def authenticate(self, headers) -> Optional[str]:  # pragma: no cover
+    def authenticate(self, headers,
+                     respond: Optional[Dict[str, str]] = None
+                     ) -> Optional[str]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -51,7 +57,7 @@ class HeaderTrustAuthenticator(Authenticator):
     def __init__(self, header: str = "X-Cook-User"):
         self.header = header
 
-    def authenticate(self, headers) -> Optional[str]:
+    def authenticate(self, headers, respond=None) -> Optional[str]:
         return headers.get(self.header) or None
 
 
@@ -69,7 +75,7 @@ class BasicAuthenticator(Authenticator):
             self._check = lambda u, p: hmac.compare_digest(
                 users.get(u, ""), p)
 
-    def authenticate(self, headers) -> Optional[str]:
+    def authenticate(self, headers, respond=None) -> Optional[str]:
         auth = headers.get("Authorization", "")
         if not auth.startswith("Basic "):
             return None
@@ -107,7 +113,7 @@ class HmacTokenAuthenticator(Authenticator):
         raw = f"{user}:{expiry}:{self._mac(user, expiry)}"
         return base64.b64encode(raw.encode()).decode()
 
-    def authenticate(self, headers) -> Optional[str]:
+    def authenticate(self, headers, respond=None) -> Optional[str]:
         auth = headers.get("Authorization", "")
         scheme, _, token = auth.partition(" ")
         if scheme not in ("Bearer", "Negotiate") or not token:
@@ -132,9 +138,10 @@ class AuthChain:
     def __init__(self, authenticators):
         self.authenticators = list(authenticators)
 
-    def authenticate(self, headers) -> str:
+    def authenticate(self, headers,
+                     respond: Optional[Dict[str, str]] = None) -> str:
         for a in self.authenticators:
-            user = a.authenticate(headers)
+            user = a.authenticate(headers, respond)
             if user:
                 return user
         challenges = [a.challenge for a in self.authenticators if a.challenge]
@@ -168,8 +175,18 @@ class GssapiAuthenticator(Authenticator):
                     "exists") from e
         self.gssapi = gssapi_module
         self.service = service
+        # acceptor credentials once, at construction: a missing/unreadable
+        # keytab fails the daemon at boot (fail-fast), not per request,
+        # and the hot auth path skips the per-request keytab resolution
+        self._creds = None
+        if service:
+            # constrain acceptance to the configured service principal
+            # (HTTP/<host>), matching the reference's keytab identity
+            spn = self.gssapi.Name(
+                service, name_type=self.gssapi.NameType.hostbased_service)
+            self._creds = self.gssapi.Credentials(name=spn, usage="accept")
 
-    def authenticate(self, headers) -> Optional[str]:
+    def authenticate(self, headers, respond=None) -> Optional[str]:
         auth = headers.get("Authorization", "")
         scheme, _, token_b64 = auth.partition(" ")
         if scheme != "Negotiate" or not token_b64:
@@ -186,22 +203,25 @@ class GssapiAuthenticator(Authenticator):
         if not token or token[0] != 0x60:
             return None
         try:
-            creds = None
-            if self.service:
-                # constrain acceptance to the configured service principal
-                # (HTTP/<host>), matching the reference's keytab identity
-                spn = self.gssapi.Name(
-                    self.service,
-                    name_type=self.gssapi.NameType.hostbased_service)
-                creds = self.gssapi.Credentials(name=spn, usage="accept")
-            ctx = self.gssapi.SecurityContext(creds=creds, usage="accept")
-            ctx.step(token)
+            ctx = self.gssapi.SecurityContext(creds=self._creds,
+                                              usage="accept")
+            out_token = ctx.step(token)
             principal = str(ctx.initiator_name)
         except Exception as e:  # gssapi raises its own hierarchy
-            raise AuthError(f"GSSAPI rejected token: {e}", self.challenge)
+            # GSS status strings can reveal principal/keytab/clock-skew
+            # detail: log them, return a generic 401 to the caller
+            import logging
+            logging.getLogger(__name__).info(
+                "GSSAPI rejected a negotiate token: %s", e)
+            raise AuthError("GSSAPI rejected token", self.challenge)
         if not ctx.complete:
             # multi-round-trip negotiation is not supported over this
             # stateless seam (the reference also completes in one step
             # for standard krb5 service tickets)
             raise AuthError("GSSAPI negotiation incomplete", self.challenge)
+        if out_token and respond is not None:
+            # the acceptor's final token: clients requiring MUTUAL
+            # authentication verify the server with it
+            respond["WWW-Authenticate"] = \
+                "Negotiate " + base64.b64encode(out_token).decode()
         return principal.partition("@")[0] or None

@@ -135,6 +135,7 @@ class TestGssapiAuthenticator:
                 raise ValueError("defective token")
             self.complete = True
             self.initiator_name = "alice@EXAMPLE.COM"
+            return b"acceptor-final-token"
 
     def _fake_module(self, recorded):
         class NameType:
@@ -144,10 +145,16 @@ class TestGssapiAuthenticator:
             pass
         fake = Fake()
         fake.NameType = NameType
-        fake.Name = lambda service, name_type: recorded.setdefault(
-            "spn", (service, name_type)) and service or service
-        fake.Credentials = lambda name, usage: recorded.setdefault(
-            "creds", (name, usage)) or ("creds", name)
+
+        def name(service, name_type):
+            recorded["spn"] = (service, name_type)
+            return ("name", service)
+
+        def credentials(name, usage):
+            recorded["creds"] = (name, usage)
+            return ("creds", name)
+        fake.Name = name
+        fake.Credentials = credentials
         fake.SecurityContext = \
             lambda creds, usage: self.FakeCtx(creds, usage)
         return fake
@@ -162,14 +169,25 @@ class TestGssapiAuthenticator:
         import base64
         recorded = {}
         a = self._auth(recorded)
+        # acceptor creds acquired ONCE at construction, for the service SPN
+        assert recorded["spn"] == ("HTTP", "hostbased")
+        assert recorded["creds"][1] == "accept"
         tok = base64.b64encode(self.VALID).decode()
         assert a.authenticate({"Authorization": f"Negotiate {tok}"}) == \
             "alice"
-        # acceptance was constrained to the configured service principal
-        assert recorded["spn"] == ("HTTP", "hostbased")
-        assert recorded["creds"][1] == "accept"
 
-    def test_bad_gss_token_rejected_with_challenge(self):
+    def test_mutual_auth_token_surfaces_in_response_headers(self):
+        import base64
+        a = self._auth()
+        tok = base64.b64encode(self.VALID).decode()
+        respond = {}
+        assert a.authenticate({"Authorization": f"Negotiate {tok}"},
+                              respond) == "alice"
+        scheme, _, out = respond["WWW-Authenticate"].partition(" ")
+        assert scheme == "Negotiate"
+        assert base64.b64decode(out) == b"acceptor-final-token"
+
+    def test_bad_gss_token_rejected_generically(self):
         import base64
 
         import pytest
@@ -180,6 +198,8 @@ class TestGssapiAuthenticator:
         with pytest.raises(AuthError) as e:
             a.authenticate({"Authorization": f"Negotiate {tok}"})
         assert e.value.challenge == "Negotiate"
+        # GSS status detail is logged, not echoed to the caller
+        assert "defective" not in e.value.message
 
     def test_non_negotiate_requests_pass_through(self):
         a = self._auth()
@@ -222,3 +242,23 @@ class TestGssapiAuthenticator:
         monkeypatch.setitem(sys.modules, "gssapi", None)
         with pytest.raises(RuntimeError, match="gssapi"):
             GssapiAuthenticator()
+
+    def test_daemon_config_builds_the_chain(self, monkeypatch):
+        """The deployment path reaches the SPNEGO slot: gssapi_service in
+        the daemon config constructs the validator (fail-fast at boot when
+        the package/keytab are absent)."""
+        import sys
+
+        from cook_tpu.daemon import build_authenticators
+        from cook_tpu.rest.auth import (BasicAuthenticator,
+                                        GssapiAuthenticator,
+                                        HmacTokenAuthenticator)
+        fake = self._fake_module({})
+        monkeypatch.setitem(sys.modules, "gssapi", fake)
+        chain = build_authenticators({
+            "gssapi_service": "HTTP",
+            "hmac_ticket_secret": "s3cret",
+            "basic_auth_users": {"bob": "pw"}})
+        assert [type(a) for a in chain] == [
+            GssapiAuthenticator, HmacTokenAuthenticator, BasicAuthenticator]
+        assert build_authenticators({}) is None

@@ -187,3 +187,54 @@ class TestDaemon:
         with get(f"{url_b}/info") as r:
             assert json.load(r).get("leader") is False
         assert pb.poll() is None
+
+
+class TestCrashRecovery:
+    def test_kill9_mid_flight_restart_resumes(self, tmp_path, procs):
+        """SIGKILL the leader with submitted work in the journal; a fresh
+        daemon over the same data_dir replays the store and keeps
+        scheduling (the reference's exit-and-restart recovery contract:
+        all state re-read on takeover, mesos.clj:296-313)."""
+        election = tmp_path / "election"
+        election.mkdir()
+        cfg = write_config(tmp_path, "crash", election)
+        p1 = spawn(cfg)
+        procs.append(p1)
+        url = wait_serving(p1)
+        assert wait_leader(url)
+        # jobs that outlive the crash (fake-cluster tasks run "forever")
+        with post(f"{url}/jobs", {"jobs": [
+                {"command": "sleep 999", "cpus": 1, "mem": 64}
+                for _ in range(4)]}) as r:
+            uuids = json.load(r)["jobs"]
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with get(f"{url}/jobs/{uuids[0]}") as r:
+                if json.load(r)["state"] == "running":
+                    break
+            time.sleep(0.1)
+        os.kill(p1.pid, signal.SIGKILL)   # no clean shutdown, no snapshot
+        p1.wait(timeout=10)
+
+        p2 = spawn(cfg)
+        procs.append(p2)
+        url2 = wait_serving(p2)
+        assert wait_leader(url2)
+        # the journal replayed: all four jobs are back
+        for uuid in uuids:
+            with get(f"{url2}/jobs/{uuid}") as r:
+                job = json.load(r)
+            assert job["state"] in ("waiting", "running")
+        # and the scheduler still schedules new work after recovery
+        with post(f"{url2}/jobs", {"jobs": [
+                {"command": "sleep 999", "cpus": 1, "mem": 64}]}) as r:
+            [fresh] = json.load(r)["jobs"]
+        deadline = time.time() + 15
+        state = None
+        while time.time() < deadline:
+            with get(f"{url2}/jobs/{fresh}") as r:
+                state = json.load(r)["state"]
+            if state == "running":
+                break
+            time.sleep(0.1)
+        assert state == "running"
