@@ -88,6 +88,8 @@ API_ROUTES = [
     ("GET", "/failure_reasons", "failure reason table", False),
     ("GET", "/stats/instances", "instance statistics", False),
     ("GET", "/settings", "effective scheduler settings", False),
+    ("POST", "/settings/rebalancer",
+     "update rebalancer params, no restart (admin)", True),
     ("GET", "/pools", "pool listing", False),
     ("GET", "/info", "version + leadership", False),
     ("GET", "/debug", "health + recent tracing spans", False),
@@ -717,19 +719,56 @@ class CookApi:
                 "recent-spans": tracer.recent(limit=50)}
 
     def settings(self) -> Dict:
+        from ..sched.rebalancer import effective_rebalancer_params
         cfg = self.config
+        # resolved against the store's dynamic document so api-only nodes
+        # (no scheduler attached) report the same truth they accept
+        # updates against
+        reb = effective_rebalancer_params(cfg, self.store)
         return {
             "rank-interval-seconds": cfg.rank_interval_seconds,
             "match-interval-seconds": cfg.match_interval_seconds,
             "max-over-quota-jobs": cfg.max_over_quota_jobs,
             "default-pool": cfg.default_pool,
             "rebalancer": {
-                "enabled": cfg.rebalancer.enabled,
-                "safe-dru-threshold": cfg.rebalancer.safe_dru_threshold,
-                "min-dru-diff": cfg.rebalancer.min_dru_diff,
-                "max-preemption": cfg.rebalancer.max_preemption,
+                "enabled": reb.enabled,
+                "safe-dru-threshold": reb.safe_dru_threshold,
+                "min-dru-diff": reb.min_dru_diff,
+                "max-preemption": reb.max_preemption,
+                "interval-seconds": reb.interval_seconds,
             },
         }
+
+    # wire-name -> (field, coercion): values are validated/coerced so a
+    # mistyped document can never poison every later rebalance cycle
+    _REBALANCER_PARAMS = {
+        "enabled": ("enabled", bool),
+        "safe-dru-threshold": ("safe_dru_threshold", float),
+        "min-dru-diff": ("min_dru_diff", float),
+        "max-preemption": ("max_preemption", int),
+        "interval-seconds": ("interval_seconds", float),
+    }
+
+    def rebalancer_set(self, body: Dict, user: str) -> Dict:
+        """POST /settings/rebalancer — durable no-restart parameter update
+        (reference: the rebalancer's Datomic params, rebalancer.clj:535-557,
+        re-read every cycle; interval changes take effect on the next
+        tick)."""
+        self.require_admin(user)
+        unknown = set(body) - set(self._REBALANCER_PARAMS)
+        if unknown:
+            raise ApiError(400, f"unknown rebalancer params: {sorted(unknown)}")
+        updates = {}
+        for wire, value in body.items():
+            field_name, coerce = self._REBALANCER_PARAMS[wire]
+            try:
+                if coerce is bool and not isinstance(value, bool):
+                    raise ValueError("expected a boolean")
+                updates[field_name] = coerce(value)
+            except (TypeError, ValueError) as e:
+                raise ApiError(400, f"bad value for {wire}: {e}")
+        merged = self.store.update_dynamic_config("rebalancer", updates)
+        return {"rebalancer": merged}
 
     # --------------------------------------------- dynamic compute clusters
     def compute_clusters(self) -> List[Dict]:
@@ -974,6 +1013,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.share_set(self._body(), self._user())
             if path == "/quota":
                 return api.quota_set(self._body(), self._user())
+            if path == "/settings/rebalancer":
+                return api.rebalancer_set(self._body(), self._user())
             if len(parts) == 2 and parts[0] == "progress":
                 return api.progress(parts[1], self._body())
             if path == "/shutdown-leader":

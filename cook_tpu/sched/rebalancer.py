@@ -37,6 +37,21 @@ from .ranker import _job_feature_key
 F32 = np.float32
 
 
+def effective_rebalancer_params(config: Config, store: Store):
+    """Static file config overlaid with the store's dynamic document
+    (reference: rebalancer params re-read from the DB every cycle,
+    rebalancer.clj:539-544).  Module-level so API nodes without a
+    scheduler report the same truth they accept updates against."""
+    import dataclasses
+    params = config.rebalancer
+    override = store.dynamic_config("rebalancer")
+    if not override:
+        return params
+    known = {f.name for f in dataclasses.fields(params)}
+    return dataclasses.replace(
+        params, **{k: v for k, v in override.items() if k in known})
+
+
 @dataclass
 class PreemptionDecision:
     job_uuid: str
@@ -156,11 +171,18 @@ class Rebalancer:
         self.config = config
         self.backend = backend
 
+    def effective_params(self):
+        """Per-cycle parameter resolution: the store's dynamic config
+        document overrides the static file config, exactly the reference's
+        read-params-from-the-DB-every-cycle (rebalancer.clj:539-544) — a
+        no-restart tuning plane."""
+        return effective_rebalancer_params(self.config, self.store)
+
     def rebalance_pool(self, pool_name: str, dru_mode: DruMode,
                        pending_ranked: List[Job],
                        clusters: Dict[str, ComputeCluster]
                        ) -> List[PreemptionDecision]:
-        params = self.config.rebalancer
+        params = self.effective_params()
         if not pending_ranked:
             return []
         running = self.store.running_instances(pool_name)

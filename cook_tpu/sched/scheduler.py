@@ -376,7 +376,7 @@ class Scheduler:
 
     def step_rebalance(self) -> Dict[str, list]:
         """Preemption cycle (reference: start-rebalancer! rebalancer.clj:559)."""
-        if not self.config.rebalancer.enabled:
+        if not self.rebalancer.effective_params().enabled:
             return {}
         decisions: Dict[str, list] = {}
         for pool in self.store.pools():
@@ -500,8 +500,12 @@ class Scheduler:
         """Start background cycle threads (the chime equivalent)."""
         cfg = self.config
 
-        def loop(interval: float, fn) -> None:
-            while not self._stop.wait(interval):
+        def loop(interval, fn) -> None:
+            # interval may be a callable so dynamically-tunable cadences
+            # (the rebalancer's no-restart interval-seconds) take effect on
+            # the next tick instead of being frozen at startup
+            while not self._stop.wait(interval() if callable(interval)
+                                      else interval):
                 try:
                     fn()
                 except Exception:  # pragma: no cover - cycle errors are logged
@@ -515,7 +519,8 @@ class Scheduler:
             specs = [(cfg.rank_interval_seconds, self.step_rank),
                      (cfg.match_interval_seconds, self.step_match)]
         specs += [
-            (cfg.rebalancer.interval_seconds, self.step_rebalance),
+            (lambda: self.rebalancer.effective_params().interval_seconds,
+             self.step_rebalance),
             (cfg.lingering_task_interval_seconds, self.step_reapers),
             (cfg.monitor_interval_seconds, self.monitor.sweep),
         ]

@@ -167,6 +167,9 @@ class Store:
         self._pools: Dict[str, Pool] = {}
         self._shares: Dict[str, ShareEntry] = {}   # key: f"{user}/{pool}"
         self._quotas: Dict[str, QuotaEntry] = {}   # key: f"{user}/{pool}"
+        # dynamic config documents (reference: the DB-backed no-restart
+        # config planes — rebalancer params at rebalancer.clj:535-557)
+        self._configs: Dict[str, Dict[str, Any]] = {}
         self._latches: Dict[str, List[str]] = {}   # latch uuid -> job uuids
         self._tx_id = 0
         self._subscribers: List[Callable[[int, List[TxEvent]], None]] = []
@@ -447,6 +450,36 @@ class Store:
 
         return self.transact(_update)
 
+    def set_dynamic_config(self, key: str, value: Dict[str, Any]) -> None:
+        """Store a dynamic config document (reference: the DB-backed
+        no-restart config planes; rebalancer params at
+        rebalancer.clj:535-557 are re-read from the DB every cycle)."""
+
+        def _set(txn: _Txn) -> None:
+            txn.put("configs", key, dict(value))
+            txn.event("config-changed", key=key)
+
+        self.transact(_set)
+
+    def update_dynamic_config(self, key: str,
+                              updates: Dict[str, Any]) -> Dict[str, Any]:
+        """Atomic read-merge-write of a dynamic config document: concurrent
+        updaters of different parameters cannot clobber each other."""
+
+        def _update(txn: _Txn) -> Dict[str, Any]:
+            current = dict(txn._get("configs", key, for_write=False) or {})
+            current.update(updates)
+            txn.put("configs", key, current)
+            txn.event("config-changed", key=key)
+            return current
+
+        return self.transact(_update)
+
+    def dynamic_config(self, key: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            v = self._configs.get(key)
+            return dict(v) if v is not None else None
+
     def update_instance_ports(self, task_id: str, ports) -> bool:
         """Assigned host-port writeback (reference: instance ports land in
         Datomic from the task launch, schema.clj instance :instance/ports)."""
@@ -679,6 +712,7 @@ class Store:
                 "pools": {k: to_json(v) for k, v in self._pools.items()},
                 "shares": {k: to_json(v) for k, v in self._shares.items()},
                 "quotas": {k: to_json(v) for k, v in self._quotas.items()},
+                "configs": {k: to_json(v) for k, v in self._configs.items()},
                 "latches": dict(self._latches),
             }
         return json.dumps(state)
@@ -689,9 +723,9 @@ class Store:
         store = cls()
         store._tx_id = state["tx_id"]
         for table in ("jobs", "instances", "groups", "pools", "shares",
-                      "quotas"):
+                      "quotas", "configs"):
             target = getattr(store, "_" + table)
-            for k, v in state[table].items():
+            for k, v in state.get(table, {}).items():
                 target[k] = _entity_from_json(table, v)
         store._latches = {k: list(v) for k, v in state.get("latches", {}).items()}
         return store
@@ -807,6 +841,8 @@ def _entity_from_json(table: str, v: Dict[str, Any]) -> Any:
     if table == "quotas":
         v["count"] = float(v["count"]) if v["count"] is not None else float("inf")
         return QuotaEntry(**v)
+    if table == "configs":
+        return v  # plain dicts: dynamic config documents
     raise ValueError(f"unknown entity table {table}")
 
 

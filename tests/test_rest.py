@@ -636,3 +636,88 @@ class TestApiDocs:
         assert resp.headers["Content-Type"] == "text/html"
         body = resp.read().decode()
         assert "/swagger-docs" in body and "/jobs" in body
+
+
+class TestDynamicRebalancerConfig:
+    def test_params_update_without_restart_and_persist(self, system,
+                                                       tmp_path):
+        """POST /settings/rebalancer changes the params the next cycle
+        uses (reference: Datomic-backed rebalancer params re-read every
+        cycle, rebalancer.clj:535-557) and the document survives a store
+        reopen."""
+        import urllib.request
+        store, cluster, sched, server = system
+
+        def post_json(path, body, user="admin"):
+            req = urllib.request.Request(
+                server.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Cook-User": user}, method="POST")
+            return json.loads(urllib.request.urlopen(req).read())
+
+        before = sched.rebalancer.effective_params()
+        post_json("/settings/rebalancer",
+                  {"min-dru-diff": 0.05, "max-preemption": 7,
+                   "enabled": True})
+        after = sched.rebalancer.effective_params()
+        assert after.min_dru_diff == 0.05
+        assert after.max_preemption == 7
+        assert after.safe_dru_threshold == before.safe_dru_threshold
+        # /settings reflects the live values
+        req = urllib.request.Request(server.url + "/settings",
+                                     headers={"X-Cook-User": "admin"})
+        settings = json.loads(urllib.request.urlopen(req).read())
+        assert settings["rebalancer"]["min-dru-diff"] == 0.05
+        assert settings["rebalancer"]["max-preemption"] == 7
+        # durable: the document rides the snapshot/journal
+        from cook_tpu.state import Store
+        restored = Store.restore(store.snapshot())
+        assert restored.dynamic_config("rebalancer")["min_dru_diff"] == 0.05
+
+    def test_unknown_param_rejected_and_non_admin_forbidden(self, system):
+        import urllib.error
+        import urllib.request
+        store, cluster, sched, server = system
+
+        def post(path, body, user):
+            req = urllib.request.Request(
+                server.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Cook-User": user}, method="POST")
+            return urllib.request.urlopen(req)
+
+        try:
+            post("/settings/rebalancer", {"bogus": 1}, "admin")
+            raise AssertionError("unknown param accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            post("/settings/rebalancer", {"enabled": False}, "mallory")
+            raise AssertionError("non-admin accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+    def test_bad_value_types_rejected(self, system):
+        import urllib.error
+        import urllib.request
+        store, cluster, sched, server = system
+
+        def post(body):
+            req = urllib.request.Request(
+                server.url + "/settings/rebalancer",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Cook-User": "admin"}, method="POST")
+            return urllib.request.urlopen(req)
+
+        for bad in ({"min-dru-diff": "not-a-number"},
+                    {"enabled": "yes"},
+                    {"max-preemption": "many"}):
+            try:
+                post(bad)
+                raise AssertionError(f"accepted {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        # rebalancing still works after the rejected posts
+        assert sched.rebalancer.effective_params().min_dru_diff == \
+            sched.config.rebalancer.min_dru_diff
