@@ -174,6 +174,28 @@ def cmd_admin(args) -> int:
             out(client.get_quota(args.for_user or client.user))
     elif args.admin_cmd == "stats":
         out(client.stats())
+    elif args.admin_cmd == "rebalancer":
+        if args.set:
+            body = {}
+            for kv in args.set:
+                k, eq, v = kv.partition("=")
+                if not eq or not k:
+                    raise JobClientError(
+                        400, f"malformed --set {kv!r} (expected key=value)")
+                if v.lower() in ("true", "false"):
+                    body[k] = v.lower() == "true"
+                else:
+                    try:
+                        # integral stays int so the server validates
+                        # instead of silently truncating (max-preemption)
+                        body[k] = int(v) if v.lstrip("-").isdigit() \
+                            else float(v)
+                    except ValueError:
+                        raise JobClientError(
+                            400, f"malformed --set value {kv!r}")
+            out(client.set_rebalancer(body))
+        else:
+            out(client.settings().get("rebalancer", {}))
     return 0
 
 
@@ -352,7 +374,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("admin")
     sp.add_argument("admin_cmd",
-                    choices=["queue", "share", "quota", "stats"])
+                    choices=["queue", "share", "quota", "stats",
+                             "rebalancer"])
     sp.add_argument("--for-user", dest="for_user")
     sp.add_argument("--pool")
     sp.add_argument("--set", action="append",

@@ -210,6 +210,13 @@ class JobClient:
     def stats(self) -> Dict:
         return self._request("GET", "/stats/instances")
 
+    def settings(self) -> Dict:
+        return self._request("GET", "/settings")
+
+    def set_rebalancer(self, params: Dict) -> Dict:
+        """Live rebalancer tuning (admin): {"min-dru-diff": 0.2, ...}."""
+        return self._request("POST", "/settings/rebalancer", body=params)
+
     def info(self) -> Dict:
         return self._request("GET", "/info")
 

@@ -764,6 +764,8 @@ class CookApi:
             try:
                 if coerce is bool and not isinstance(value, bool):
                     raise ValueError("expected a boolean")
+                if coerce is int and float(value) != int(value):
+                    raise ValueError("expected an integer")
                 updates[field_name] = coerce(value)
             except (TypeError, ValueError) as e:
                 raise ApiError(400, f"bad value for {wire}: {e}")

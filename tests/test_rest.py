@@ -721,3 +721,30 @@ class TestDynamicRebalancerConfig:
         # rebalancing still works after the rejected posts
         assert sched.rebalancer.effective_params().min_dru_diff == \
             sched.config.rebalancer.min_dru_diff
+
+    def test_cli_admin_rebalancer(self, system, capsys):
+        store, cluster, sched, server = system
+        from cook_tpu.cli.main import main
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "rebalancer", "--set", "min-dru-diff=0.25",
+                     "--set", "enabled=true"]) == 0
+        capsys.readouterr()
+        assert sched.rebalancer.effective_params().min_dru_diff == 0.25
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "rebalancer"]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["min-dru-diff"] == 0.25
+
+    def test_cli_admin_rebalancer_bad_values(self, system, capsys):
+        store, cluster, sched, server = system
+        from cook_tpu.cli.main import main
+        # malformed values exit nonzero with a clean error, no traceback
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "rebalancer", "--set", "min-dru-diff=abc"]) != 0
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "rebalancer", "--set", "enabled"]) != 0
+        # integral values arrive as ints (no silent float truncation)
+        assert main(["--url", server.url, "--user", "admin", "admin",
+                     "rebalancer", "--set", "max-preemption=9"]) == 0
+        capsys.readouterr()
+        assert sched.rebalancer.effective_params().max_preemption == 9
