@@ -160,6 +160,19 @@ class PluginRegistry:
             job = a.adjust(job)
         return job
 
+    def launch_verdict_cached(self, uuid: str):
+        """Non-materializing probe of the launch-verdict cache: True/False
+        when a live cached verdict exists for the job uuid, None on miss
+        (callers then fetch the entity and call launch_allowed).  Lets the
+        columnar fused pack skip entity deep-copies in steady state."""
+        cached = self._launch_cache.get(uuid)
+        if cached is None:
+            return None
+        if (cached.cache_expires_at_s is not None
+                and cached.cache_expires_at_s <= time.time()):
+            return None
+        return cached.status == "accepted"
+
     def launch_allowed(self, job: Job) -> bool:
         """Cached accept/defer check used by considerable-job selection."""
         if not self.launch_filters:

@@ -34,10 +34,13 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from ..cluster.base import Offer
-from ..state.schema import GroupPlacementType, Job
+from ..state.schema import (
+    DISK_TYPE_LABEL,
+    GPU_MODEL_LABEL,
+    GroupPlacementType,
+    Job,
+)
 
-GPU_MODEL_LABEL = "gpu-model"
-DISK_TYPE_LABEL = "disk-type"
 LOCATION_ATTRIBUTE = "location"
 
 

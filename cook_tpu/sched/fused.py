@@ -55,6 +55,10 @@ class _PackedPool:
         self.pool = pool
         self.task_ids: List[int] = []
         self.id2job: Dict[int, Job] = {}
+        # columnar mode: kernel rows map to job uuids instead of entities
+        self.columnar = False
+        self.uuids: Optional[np.ndarray] = None        # U36[T] sorted order
+        self.users_sorted: Optional[np.ndarray] = None  # U[T]
         self.offers: List[Offer] = []
         self.ctx = None
         self.arrays: Dict[str, np.ndarray] = {}
@@ -106,8 +110,173 @@ class FusedCycleDriver:
         return fn
 
     # ------------------------------------------------------------------ pack
+    def _pack_pool_columnar(self, scheduler,
+                            pool: Pool) -> Optional[_PackedPool]:
+        """Pack one pool's cycle inputs straight off the columnar index
+        (state/index.py): no entity materialization for the plain-job
+        majority — entities are fetched only for rows the vectorized path
+        can't decide (user constraints, groups, checkpoint, prior
+        instances; see index._is_complex) and for the offensive minority.
+        This closes the 'fused cycle packs from entities' gap tracked in
+        docs/PARITY.md; decision parity with the entity pack is asserted by
+        tests/test_fused_cycle.py."""
+        store, cfg = self.store, self.config
+        idx = store.ensure_index()
+        got = idx.fused_arrays(pool.name)
+        if got is None:
+            return None
+        arrays, uuids_sorted, row_users, users, job_res, complex_rows = got
+        pp = _PackedPool(pool)
+        pp.columnar = True
+        pp.uuids, pp.users_sorted = uuids_sorted, row_users
+        T = arrays["usage"].shape[0]
+        pp.arrays, pp.n_tasks = arrays, T
+        pend = arrays["pending"]
+        pp.job_res = job_res * pend[:, None]
+
+        # per-user share/quota, repeated per row via the user segments
+        share_mat = np.stack([
+            np.array([store.get_share(u, pool.name).get(d, INF)
+                      for d in ("cpus", "mem", "gpus")], dtype=F32)
+            for u in users]) if users else np.zeros((0, 3), dtype=F32)
+        quota_mat = np.stack([
+            _quota_vec(store.get_quota(u, pool.name)) for u in users]) \
+            if users else np.zeros((0, 4), dtype=F32)
+        arrays["shares"] = share_mat[arrays["user_rank"]]
+        arrays["quota"] = quota_mat[arrays["user_rank"]]
+
+        # offers from every cluster serving this pool
+        offers: List[Offer] = []
+        for cluster in list(scheduler.clusters.values()):
+            if cluster.accepts_pool(pool.name):
+                offers.extend(cluster.pending_offers(pool.name))
+        pp.offers = offers
+        pp.n_hosts = len(offers)
+
+        if offers:
+            H = len(offers)
+            host_gpu = np.array([o.capacity.gpus > 0 for o in offers],
+                                dtype=bool)
+            host_tasks = np.array([o.task_count for o in offers],
+                                  dtype=np.int32)
+            host_index = {o.hostname: h for h, o in enumerate(offers)}
+            # vectorized base mask over every pending row: gpu-host
+            # bidirectional isolation + max-tasks-per-host + rebalancer
+            # reservations (constraints.clj:122,433,242) — no per-job Python
+            cmask = np.zeros((T, H), dtype=bool)
+            gpu_rows = pp.job_res[:, 2] > 0
+            cmask[pend] = np.where(gpu_rows[pend, None],
+                                   host_gpu[None, :], ~host_gpu[None, :])
+            if cfg.max_tasks_per_host is not None:
+                cmask[pend] &= host_tasks[None, :] < cfg.max_tasks_per_host
+            reserved = [(u, host_index[hn])
+                        for u, hn in scheduler.reserved_hosts.items()
+                        if hn in host_index]
+            if reserved:
+                # one np.isin pass locates every owner row (the naive
+                # per-reservation uuids_sorted == owner scan is O(R*T))
+                owner_set = np.array([u for u, _ in reserved])
+                owner_rows: Dict[str, List[int]] = {}
+                for i in np.flatnonzero(np.isin(uuids_sorted, owner_set)):
+                    owner_rows.setdefault(str(uuids_sorted[i]), []).append(i)
+                for owner_uuid, h in reserved:
+                    rows = owner_rows.get(owner_uuid, [])
+                    saved = cmask[rows, h]
+                    cmask[:, h] = False
+                    cmask[rows, h] = saved
+            # complex rows: the entity-level constraint compiler, applied to
+            # the minority that needs it
+            cjobs, keep = [], []
+            for i in np.flatnonzero(pend & complex_rows):
+                job = store.job(uuids_sorted[i])
+                if job is not None:
+                    cjobs.append(job)
+                    keep.append(i)
+            crow = np.array(keep, dtype=np.int64)
+            ctx = self.matcher._constraint_context(
+                cjobs, scheduler.reserved_hosts)
+            self.matcher._fill_cotask_host_attributes(
+                ctx, pool.name, offers, scheduler.clusters)
+            pp.ctx = ctx
+            if cjobs:
+                cmask[crow] = build_constraint_mask(cjobs, offers, ctx)
+            pp.cmask = cmask
+            pp.avail = np.array(
+                [[o.available.cpus, o.available.mem, o.available.gpus,
+                  o.available.disk] for o in offers], dtype=F32)
+            pp.capacity = np.array(
+                [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
+                  o.capacity.disk] for o in offers], dtype=F32)
+        else:
+            pp.cmask = np.zeros((T, 1), dtype=bool)
+            pp.avail = np.zeros((1, 4), dtype=F32)
+            pp.capacity = np.zeros((1, 4), dtype=F32)
+            pp.n_hosts = 0
+
+        # offensive-job filter, vectorized over the resource columns
+        enqueue_ok = np.ones(T, dtype=bool)
+        limits = cfg.offensive_job_limits
+        if limits is not None:
+            bad = pend & ((pp.job_res[:, 1] > limits.memory_gb * 1024.0)
+                          | (pp.job_res[:, 0] > limits.cpus))
+            if bad.any():
+                enqueue_ok[bad] = False
+                pp.offensive = [j for j in (store.job(u)
+                                            for u in uuids_sorted[bad])
+                                if j is not None]
+        pp.enqueue_ok = enqueue_ok
+
+        # plugin launch verdicts: only when a filter is configured, and the
+        # per-uuid verdict cache is consulted before materializing an
+        # entity (plugins/launch.clj caches accept/defer the same way), so
+        # steady state costs no deep copies even with filters on
+        launch_ok = np.ones(T, dtype=bool)
+        if self.plugins.launch_filters:
+            for i in np.flatnonzero(pend):
+                uuid = str(uuids_sorted[i])
+                cached = self.plugins.launch_verdict_cached(uuid)
+                if cached is None:
+                    job = store.job(uuid)
+                    cached = (job is None
+                              or self.plugins.launch_allowed(job))
+                if not cached:
+                    launch_ok[i] = False
+        pp.launch_ok = launch_ok
+
+        # launch-rate token budgets per user, broadcast via the segments
+        launch_rl = self.rate_limits.job_launch
+        if launch_rl.enforce:
+            from ..policy import pool_user_key
+            per_user = np.array(
+                [launch_rl.get_token_count(pool_user_key(pool.name, u))
+                 for u in users], dtype=F32)
+            pp.tokens = per_user[arrays["user_rank"]]
+        else:
+            pp.tokens = np.full(T, INF, dtype=F32)
+
+        self._pack_caps(pp, pool)
+        return pp
+
+    def _pack_caps(self, pp: _PackedPool, pool: Pool) -> None:
+        """Backoff cap + pool/quota-group caps (shared by both pack paths)."""
+        cfg = self.config
+        mc = cfg.matcher_for_pool(pool.name)
+        backoff = self.matcher._backoff.setdefault(
+            pool.name, _BackoffState(mc.max_jobs_considered))
+        pp.num_considerable = min(backoff.num_considerable,
+                                  mc.max_jobs_considered)
+        q = cfg.pool_quota(pool.name)
+        if q is not None:
+            pp.pool_quota = _pool_quota_vec(q)
+        gname = cfg.quota_groups.get(pool.name)
+        gq = cfg.quota_group_quotas.get(gname) if gname else None
+        if gq is not None:
+            pp.group_quota = _pool_quota_vec(gq)
+
     def _pack_pool(self, scheduler, pool: Pool) -> Optional[_PackedPool]:
         store, cfg = self.store, self.config
+        if cfg.columnar_index:
+            return self._pack_pool_columnar(scheduler, pool)
         pending = store.pending_jobs(pool.name)
         pp = _PackedPool(pool)
         if not pending:
@@ -199,21 +368,7 @@ class FusedCycleDriver:
             tok = np.full(T, INF, dtype=F32)
         pp.tokens = tok
 
-        # head-of-queue backoff cap
-        mc = cfg.matcher_for_pool(pool.name)
-        backoff = self.matcher._backoff.setdefault(
-            pool.name, _BackoffState(mc.max_jobs_considered))
-        pp.num_considerable = min(backoff.num_considerable,
-                                  mc.max_jobs_considered)
-
-        # pool + quota-group caps
-        q = cfg.pool_quota(pool.name)
-        if q is not None:
-            pp.pool_quota = _pool_quota_vec(q)
-        gname = cfg.quota_groups.get(pool.name)
-        gq = cfg.quota_group_quotas.get(gname) if gname else None
-        if gq is not None:
-            pp.group_quota = _pool_quota_vec(gq)
+        self._pack_caps(pp, pool)
         return pp
 
     # ------------------------------------------------------------------ step
@@ -257,8 +412,13 @@ class FusedCycleDriver:
                 m = missing_by_group.get(gname)
                 if m is None:
                     m = np.zeros(4, dtype=F32)
+                    idx = (self.store.ensure_index()
+                           if self.config.columnar_index else None)
                     for member, g in self.config.quota_groups.items():
                         if g != gname or member in in_dispatch:
+                            continue
+                        if idx is not None:
+                            m += idx.pool_usage_base(member)
                             continue
                         for job, _i in self.store.running_instances(member):
                             m += [job.resources.cpus, job.resources.mem,
@@ -349,13 +509,32 @@ class FusedCycleDriver:
         pool_name = pp.pool.name
         # ranked queue = queue-surviving rows in rank order
         ranked_rows = order[queue_ok]
-        queues[pool_name] = [pp.id2job[pp.task_ids[r]] for r in ranked_rows]
+        if pp.columnar:
+            # lazy queue over uuid/resource columns: consumers materialize
+            # only the prefix they touch (sched/ranker.RankedQueue)
+            from .ranker import RankedQueue
+            queues[pool_name] = RankedQueue(
+                self.store, pp.uuids[ranked_rows],
+                pp.arrays["usage"][ranked_rows],
+                pp.users_sorted[ranked_rows])
+        else:
+            queues[pool_name] = [pp.id2job[pp.task_ids[r]]
+                                 for r in ranked_rows]
         scheduler._stifle_offensive(pp.offensive)
 
         result = MatchCycleResult()
         cand_pos = np.flatnonzero(match_valid)
         result.considered = len(cand_pos)
-        cand_jobs = [pp.id2job[pp.task_ids[order[i]]] for i in cand_pos]
+        if pp.columnar:
+            cand_jobs, cand_keep = [], []
+            for i in cand_pos:
+                job = self.store.job(pp.uuids[order[i]])
+                if job is not None:
+                    cand_jobs.append(job)
+                    cand_keep.append(i)
+            cand_pos = np.array(cand_keep, dtype=np.int64)
+        else:
+            cand_jobs = [pp.id2job[pp.task_ids[order[i]]] for i in cand_pos]
         if len(cand_pos) == 0 or not pp.offers:
             # mirror Matcher.match_pool: an empty cycle returns the
             # considerable set unmatched and leaves backoff untouched

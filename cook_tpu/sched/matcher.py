@@ -28,6 +28,7 @@ from ..state.schema import InstanceStatus, Job, Reasons, new_uuid
 from ..state.store import AbortTransaction, Store
 from ..utils import tracing
 from .constraints import (
+    LOCATION_ATTRIBUTE,
     ConstraintContext,
     build_constraint_mask,
     validate_group_placement,
@@ -150,6 +151,17 @@ class Matcher:
                             inst.end_time_ms - inst.start_time_ms)
             if failed:
                 ctx.failed_hosts[job.uuid] = failed
+            # checkpoint locality: a restarted checkpointed job is pinned to
+            # the location its previous instance ran in (reference:
+            # constraints.clj:218-240); the location was snapshotted from the
+            # offer at launch time (Instance.node_location)
+            if full.checkpoint is not None:
+                for tid in reversed(full.instances):
+                    prior = self.store.instance(tid)
+                    if prior is not None and prior.node_location:
+                        ctx.checkpoint_locations[full.uuid] = \
+                            prior.node_location
+                        break
             # estimated-completion end time: max of scaled expected runtime
             # and prior node-lost runtimes, capped so a job that nearly fills
             # a host lifetime still accepts young hosts
@@ -377,7 +389,9 @@ class Matcher:
             try:
                 self.store.launch_instance(
                     job.uuid, task_id, offer.hostname,
-                    slave_id=offer.slave_id, compute_cluster=offer.cluster)
+                    slave_id=offer.slave_id, compute_cluster=offer.cluster,
+                    node_location=offer.attributes.get(
+                        LOCATION_ATTRIBUTE, ""))
             except AbortTransaction as e:
                 result.launch_failures.append((job.uuid, e.reason))
                 continue

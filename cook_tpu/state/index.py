@@ -31,7 +31,12 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .schema import InstanceStatus, JobState
+from .schema import (
+    DISK_TYPE_LABEL,
+    GPU_MODEL_LABEL,
+    InstanceStatus,
+    JobState,
+)
 
 F32 = np.float32
 # pending tasks sort after every running task (reference: pending tasks get
@@ -39,6 +44,21 @@ F32 = np.float32
 PENDING_START = np.int64(2**62)
 
 _LIVE = (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING)
+
+
+def _is_complex(job) -> bool:
+    """True when the job needs entity-level treatment in the fused cycle's
+    constraint build: user constraints, group placement, checkpoint
+    locality, estimated-completion, novel-host (any prior instance), or the
+    gpu-model / disk-type affinity labels (state/schema.py
+    GPU_MODEL_LABEL / DISK_TYPE_LABEL).  Plain jobs — the vast majority at
+    the 1M design point — get a fully vectorized mask instead."""
+    return bool(job.constraints or job.group is not None
+                or job.checkpoint is not None
+                or job.expected_runtime_ms
+                or job.instances
+                or GPU_MODEL_LABEL in job.labels
+                or DISK_TYPE_LABEL in job.labels)
 
 
 def _grow(arr: np.ndarray, n: int) -> np.ndarray:
@@ -69,6 +89,8 @@ class ColumnarIndex:
         self._n = 0
         self._row: Dict[str, int] = {}
         self._res = np.zeros((1024, 4), dtype=F32)
+        self._disk = np.zeros(1024, dtype=F32)
+        self._complex = np.zeros(1024, dtype=bool)
         self._prio = np.zeros(1024, dtype=np.int32)
         self._submit = np.zeros(1024, dtype=np.int64)
         self._uuid = np.zeros(1024, dtype="<U36")
@@ -103,6 +125,8 @@ class ColumnarIndex:
             row = self._n
             self._n += 1
             self._res = _grow(self._res, self._n)
+            self._disk = _grow(self._disk, self._n)
+            self._complex = _grow(self._complex, self._n)
             self._prio = _grow(self._prio, self._n)
             self._submit = _grow(self._submit, self._n)
             self._uuid = _grow(self._uuid, self._n)
@@ -113,6 +137,7 @@ class ColumnarIndex:
             self._row[job.uuid] = row
             r = job.resources
             self._res[row] = (r.cpus, r.mem, r.gpus, 1.0)
+            self._disk[row] = r.disk
             self._prio[row] = job.priority
             self._submit[row] = job.submit_time_ms
             self._uuid[row] = job.uuid
@@ -121,6 +146,7 @@ class ColumnarIndex:
             self._pool = _fit_str(self._pool, job.pool)
             self._pool[row] = job.pool
         self._pending[row] = job.committed and job.state is JobState.WAITING
+        self._complex[row] = _is_complex(job)
         done = job.state is JobState.COMPLETED
         if done != self._done[row]:
             self._dead += 1 if done else -1  # retry paths resurrect rows
@@ -168,6 +194,12 @@ class ColumnarIndex:
                     inst = self.store.instance(e.data.get("task_id"))
                     if inst is not None and inst.status in _LIVE:
                         self._add_instance_raw(inst)
+                    if inst is not None:
+                        # the job now has a prior instance: novel-host (and
+                        # checkpoint locality on restart) may apply
+                        row = self._row.get(inst.job_uuid)
+                        if row is not None:
+                            self._complex[row] = True
                 elif kind == "instance-status":
                     tid = e.data.get("task_id")
                     inst = self.store.instance(tid)
@@ -186,41 +218,68 @@ class ColumnarIndex:
         the pool's distinct users in segment order.  None when the pool has
         no pending jobs (matching the entity path's early-out)."""
         with self._lock:
-            self._maybe_compact()
-            n = self._n
-            pool_match = self._pool[:n] == pool
-            prow = np.flatnonzero(pool_match & self._pending[:n])
-            if prow.size == 0:
+            got = self._rank_rows_locked(pool)
+            if got is None:
                 return None
-            ijr = self._inst_job_row[:self._ninst]
-            ilive = np.flatnonzero(pool_match[ijr]) if self._ninst else \
-                np.zeros(0, dtype=np.int64)
-            irow = ijr[ilive]
-            rows = np.concatenate([prow, irow])
-            start = np.concatenate([
-                np.full(prow.size, PENDING_START, dtype=np.int64),
-                self._inst_start[:self._ninst][ilive]])
-            pending = np.zeros(rows.size, dtype=bool)
-            pending[:prow.size] = True
-
-            user = self._user[rows]
-            order = np.lexsort((self._uuid[rows], self._submit[rows], start,
-                                -self._prio[rows], user))
-            rows_s = rows[order]
-            user_s = user[order]
-            first = np.ones(rows_s.size, dtype=bool)
-            first[1:] = user_s[1:] != user_s[:-1]
-            seg_start = np.flatnonzero(first)
-            seg_id = np.cumsum(first) - 1
-            arrays = {
-                "usage": self._res[rows_s],
-                "first_idx": seg_start.astype(np.int32)[seg_id],
-                "user_rank": seg_id.astype(np.int32),
-                "pending": pending[order],
-                "valid": np.ones(rows_s.size, dtype=bool),
-            }
+            arrays, rows_s, user_s, seg_start = got
             return (arrays, self._uuid[rows_s], user_s,
                     list(user_s[seg_start]))
+
+    def _rank_rows_locked(self, pool: str):
+        """Shared body of rank_arrays/fused_arrays (caller holds _lock):
+        returns (arrays, sorted row indices, sorted users, segment starts)."""
+        self._maybe_compact()
+        n = self._n
+        pool_match = self._pool[:n] == pool
+        prow = np.flatnonzero(pool_match & self._pending[:n])
+        if prow.size == 0:
+            return None
+        ijr = self._inst_job_row[:self._ninst]
+        ilive = np.flatnonzero(pool_match[ijr]) if self._ninst else \
+            np.zeros(0, dtype=np.int64)
+        irow = ijr[ilive]
+        rows = np.concatenate([prow, irow])
+        start = np.concatenate([
+            np.full(prow.size, PENDING_START, dtype=np.int64),
+            self._inst_start[:self._ninst][ilive]])
+        pending = np.zeros(rows.size, dtype=bool)
+        pending[:prow.size] = True
+
+        user = self._user[rows]
+        order = np.lexsort((self._uuid[rows], self._submit[rows], start,
+                            -self._prio[rows], user))
+        rows_s = rows[order]
+        user_s = user[order]
+        first = np.ones(rows_s.size, dtype=bool)
+        first[1:] = user_s[1:] != user_s[:-1]
+        seg_start = np.flatnonzero(first)
+        seg_id = np.cumsum(first) - 1
+        arrays = {
+            "usage": self._res[rows_s],
+            "first_idx": seg_start.astype(np.int32)[seg_id],
+            "user_rank": seg_id.astype(np.int32),
+            "pending": pending[order],
+            "valid": np.ones(rows_s.size, dtype=bool),
+        }
+        return (arrays, rows_s, user_s, seg_start)
+
+    def fused_arrays(self, pool: str):
+        """rank_arrays plus the fused cycle's extra columns, all in the same
+        sorted row order: ``job_res`` f32[n,4] = (cpus, mem, gpus, disk) —
+        the match kernel's per-row resource demand — and ``complex`` bool[n]
+        marking rows whose job needs entity-level constraint handling
+        (see _is_complex).  None when the pool has no pending jobs."""
+        with self._lock:
+            got = self._rank_rows_locked(pool)
+            if got is None:
+                return None
+            arrays, rows_s, user_s, seg_start = got
+            job_res = np.concatenate(
+                [self._res[rows_s][:, :3], self._disk[rows_s][:, None]],
+                axis=1)
+            return (arrays, self._uuid[rows_s], user_s,
+                    list(user_s[seg_start]),
+                    job_res.astype(F32), self._complex[rows_s])
 
     def pool_usage_base(self, pool: str) -> np.ndarray:
         """Summed (cpus, mem, gpus, count) of the pool's live instances —
@@ -249,8 +308,8 @@ class ColumnarIndex:
         new_rows = np.flatnonzero(keep)
         remap = np.full(n, -1, dtype=np.int64)
         remap[new_rows] = np.arange(new_rows.size)
-        for arr_name in ("_res", "_prio", "_submit", "_uuid", "_user",
-                         "_pool", "_pending", "_done"):
+        for arr_name in ("_res", "_disk", "_complex", "_prio", "_submit",
+                         "_uuid", "_user", "_pool", "_pending", "_done"):
             arr = getattr(self, arr_name)
             setattr(self, arr_name, arr[:n][new_rows].copy())
         self._row = {u: int(remap[r]) for u, r in self._row.items()

@@ -26,6 +26,13 @@ DEFAULT_JOB_PRIORITY = 50  # reference: util/default-job-priority (tools.clj)
 MAX_JOB_PRIORITY = 100
 
 
+# Job-label keys with placement semantics (consumed by the constraint
+# compiler in sched/constraints.py and the columnar index's complex-job
+# classifier in state/index.py; reference: constraints.clj:122,164)
+GPU_MODEL_LABEL = "gpu-model"
+DISK_TYPE_LABEL = "disk-type"
+
+
 class JobState(enum.Enum):
     """Job lifecycle (reference: schema.clj job state machine, :job/update-state
     schema.clj:1202-1239): waiting <-> running -> completed."""
@@ -290,6 +297,11 @@ class Instance:
     ports: List[int] = field(default_factory=list)
     queue_time_ms: int = 0
     cancelled: bool = False
+    # "location" attribute of the host this instance ran on, recorded at
+    # launch so checkpoint-locality can pin the job's next instance to the
+    # same location (reference: constraints.clj:218-240 reads the prior
+    # instance's node; here the matcher snapshots the offer attribute)
+    node_location: str = ""
 
 
 class GroupPlacementType(enum.Enum):

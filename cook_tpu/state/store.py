@@ -357,7 +357,8 @@ class Store:
     # -------------------------------------------------------------- launches
     def launch_instance(self, job_uuid: str, task_id: str, hostname: str,
                         slave_id: str = "", compute_cluster: str = "",
-                        ports: Optional[List[int]] = None) -> Instance:
+                        ports: Optional[List[int]] = None,
+                        node_location: str = "") -> Instance:
         """Create an instance under the allowed-to-start guard; aborts (and
         therefore blocks the backend launch) if the job state moved
         (reference: scheduler.clj:987-1009 + schema.clj:1311-1325)."""
@@ -373,7 +374,7 @@ class Store:
             inst = Instance(task_id=task_id, job_uuid=job_uuid, hostname=hostname,
                             slave_id=slave_id or hostname, compute_cluster=compute_cluster,
                             status=InstanceStatus.UNKNOWN, start_time_ms=t,
-                            ports=ports or [],
+                            ports=ports or [], node_location=node_location,
                             queue_time_ms=max(0, t - job.last_waiting_start_ms))
             txn.put("instances", task_id, inst)
             job.instances.append(task_id)

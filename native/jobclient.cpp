@@ -1,0 +1,597 @@
+// libcookjobclient.so — native job client for the cook_tpu REST API.
+//
+// The reference ships a 9k-LoC Java jobclient (reference:
+// jobclient/java/src/main/java/com/twosigma/cook/jobclient/JobClient.java —
+// batched submit/query/abort, JobListener status callbacks driven by a
+// scheduled poll loop, impersonation, basic auth) for programs that embed a
+// Cook client without going through the CLI.  This build has no JVM, so the
+// native embedding surface is C/C++: a dependency-free HTTP/1.1 client over
+// POSIX sockets exposing the same operations through a ctypes-friendly
+// extern "C" API, plus a background listener thread that mirrors the Java
+// client's listener loop.  cook_tpu/native/jobclient.py wraps it for
+// Python; C/C++ programs can link it directly.
+//
+// Wire behavior matches cook_tpu/client/__init__.py (the Python jobclient):
+//   submit  POST   /jobs        {"jobs": [...], "pool": ..., "groups": [...]}
+//   query   GET    /jobs?uuid=a&uuid=b
+//   kill    DELETE /jobs?uuid=a&uuid=b
+//   retry   POST   /retry       {"job": uuid, "retries": n}
+//   wait    poll query until every job's state == "completed"
+// Headers: X-Cook-User (header-trust), X-Cook-Impersonate, Authorization
+// Basic/Bearer; 307 leader redirects are followed with method+body
+// preserved (reference: rest/api.clj leader redirect semantics).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cctype>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- base64
+std::string base64(const std::string& in) {
+    static const char* tbl =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    std::string out;
+    size_t i = 0;
+    while (i + 2 < in.size()) {
+        unsigned v = (unsigned char)in[i] << 16 |
+                     (unsigned char)in[i + 1] << 8 | (unsigned char)in[i + 2];
+        out += tbl[v >> 18]; out += tbl[(v >> 12) & 63];
+        out += tbl[(v >> 6) & 63]; out += tbl[v & 63];
+        i += 3;
+    }
+    if (i + 1 == in.size()) {
+        unsigned v = (unsigned char)in[i] << 16;
+        out += tbl[v >> 18]; out += tbl[(v >> 12) & 63]; out += "==";
+    } else if (i + 2 == in.size()) {
+        unsigned v = (unsigned char)in[i] << 16 |
+                     (unsigned char)in[i + 1] << 8;
+        out += tbl[v >> 18]; out += tbl[(v >> 12) & 63];
+        out += tbl[(v >> 6) & 63]; out += '=';
+    }
+    return out;
+}
+
+// ------------------------------------------------------------ tiny JSON
+// Minimal tolerant scanner used only to pull (uuid -> state) pairs out of a
+// jobs array for wait/listen; submit/query hand the raw body back to the
+// caller, so no general-purpose JSON layer is needed here.
+struct JsonScan {
+    const std::string& s;
+    size_t i = 0;
+    explicit JsonScan(const std::string& str) : s(str) {}
+
+    void ws() { while (i < s.size() && std::isspace((unsigned char)s[i])) i++; }
+
+    bool parse_string(std::string* out) {
+        ws();
+        if (i >= s.size() || s[i] != '"') return false;
+        i++;
+        std::string r;
+        while (i < s.size() && s[i] != '"') {
+            if (s[i] == '\\' && i + 1 < s.size()) {
+                i++;
+                switch (s[i]) {
+                    case 'n': r += '\n'; break;
+                    case 't': r += '\t'; break;
+                    case 'u': i += 4; r += '?'; break;  // keep scanning
+                    default: r += s[i];
+                }
+            } else {
+                r += s[i];
+            }
+            i++;
+        }
+        if (i >= s.size()) return false;
+        i++;  // closing quote
+        if (out) *out = r;
+        return true;
+    }
+
+    // skip any JSON value; record string fields of the CURRENT object depth
+    bool skip_value(std::map<std::string, std::string>* fields, int depth) {
+        ws();
+        if (i >= s.size()) return false;
+        char c = s[i];
+        if (c == '"') return parse_string(nullptr);
+        if (c == '{') return parse_object(fields, depth);
+        if (c == '[') {
+            i++;
+            ws();
+            if (i < s.size() && s[i] == ']') { i++; return true; }
+            while (i < s.size()) {
+                if (!skip_value(nullptr, depth + 1)) return false;
+                ws();
+                if (i < s.size() && s[i] == ',') { i++; continue; }
+                break;
+            }
+            if (i >= s.size() || s[i] != ']') return false;
+            i++;
+            return true;
+        }
+        // number / true / false / null
+        while (i < s.size() && !strchr(",}]", s[i])) i++;
+        return true;
+    }
+
+    // parse an object; when fields != nullptr collect its top-level
+    // string-valued fields into *fields
+    bool parse_object(std::map<std::string, std::string>* fields, int depth) {
+        ws();
+        if (i >= s.size() || s[i] != '{') return false;
+        i++;
+        ws();
+        if (i < s.size() && s[i] == '}') { i++; return true; }
+        while (i < s.size()) {
+            std::string key;
+            if (!parse_string(&key)) return false;
+            ws();
+            if (i >= s.size() || s[i] != ':') return false;
+            i++;
+            ws();
+            if (fields && i < s.size() && s[i] == '"') {
+                std::string val;
+                if (!parse_string(&val)) return false;
+                (*fields)[key] = val;
+            } else {
+                if (!skip_value(nullptr, depth + 1)) return false;
+            }
+            ws();
+            if (i < s.size() && s[i] == ',') { i++; ws(); continue; }
+            break;
+        }
+        if (i >= s.size() || s[i] != '}') return false;
+        i++;
+        return true;
+    }
+};
+
+// jobs array -> ordered (uuid, state) pairs
+std::vector<std::pair<std::string, std::string>>
+extract_job_states(const std::string& body) {
+    std::vector<std::pair<std::string, std::string>> out;
+    JsonScan sc(body);
+    sc.ws();
+    if (sc.i >= body.size() || body[sc.i] != '[') return out;
+    sc.i++;
+    sc.ws();
+    if (sc.i < body.size() && body[sc.i] == ']') return out;
+    while (sc.i < body.size()) {
+        std::map<std::string, std::string> fields;
+        if (!sc.parse_object(&fields, 0)) break;
+        out.emplace_back(fields["uuid"], fields["state"]);
+        sc.ws();
+        if (sc.i < body.size() && body[sc.i] == ',') { sc.i++; continue; }
+        break;
+    }
+    return out;
+}
+
+// ----------------------------------------------------------------- HTTP
+struct HttpResponse {
+    int status = 0;
+    std::string body;
+    std::map<std::string, std::string> headers;  // lower-cased keys
+};
+
+class Client {
+  public:
+    Client(std::string host, int port, std::string user)
+        : host_(std::move(host)), port_(port), user_(std::move(user)) {}
+
+    void set_basic(const std::string& u, const std::string& p) {
+        basic_b64_ = base64(u + ":" + p);
+    }
+    void set_bearer(const std::string& t) { bearer_ = t; }
+    void set_impersonate(const std::string& u) { impersonate_ = u; }
+
+    // Copies into a per-client fixed buffer under the lock so a concurrent
+    // set_error (e.g. from a Listener thread) can never free the storage a
+    // caller is reading; worst case is torn text, never a dangling pointer.
+    const char* last_error_cstr() {
+        std::lock_guard<std::mutex> g(err_mu_);
+        std::strncpy(err_buf_, last_error_.c_str(), sizeof(err_buf_) - 1);
+        err_buf_[sizeof(err_buf_) - 1] = '\0';
+        return err_buf_;
+    }
+
+    bool request(const std::string& method, const std::string& path,
+                 const std::string& body, HttpResponse* resp) {
+        std::string host = host_;
+        int port = port_;
+        std::string target = path;
+        for (int hop = 0; hop < 5; hop++) {
+            if (!one_request(host, port, method, target, body, resp))
+                return false;
+            if (resp->status != 307) return true;
+            // leader redirect: re-issue same method+body at Location
+            auto it = resp->headers.find("location");
+            if (it == resp->headers.end()) return true;
+            if (!parse_location(it->second, &host, &port, &target)) {
+                set_error("unparseable redirect: " + it->second);
+                return false;
+            }
+        }
+        set_error("redirect loop");
+        return false;
+    }
+
+  private:
+    void set_error(const std::string& e) {
+        std::lock_guard<std::mutex> g(err_mu_);
+        last_error_ = e;
+    }
+
+    static bool parse_location(const std::string& loc, std::string* host,
+                               int* port, std::string* path) {
+        // http://host:port/path
+        size_t p = loc.find("://");
+        if (p == std::string::npos) {  // relative path
+            *path = loc;
+            return true;
+        }
+        size_t hstart = p + 3;
+        size_t pathp = loc.find('/', hstart);
+        std::string hostport = loc.substr(
+            hstart, pathp == std::string::npos ? std::string::npos
+                                               : pathp - hstart);
+        *path = pathp == std::string::npos ? "/" : loc.substr(pathp);
+        size_t colon = hostport.rfind(':');
+        if (colon == std::string::npos) {
+            *host = hostport;
+            *port = 80;
+        } else {
+            *host = hostport.substr(0, colon);
+            *port = std::atoi(hostport.c_str() + colon + 1);
+        }
+        return !host->empty() && *port > 0;
+    }
+
+    int connect_to(const std::string& host, int port) {
+        struct addrinfo hints {};
+        hints.ai_family = AF_UNSPEC;
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        std::string ports = std::to_string(port);
+        if (getaddrinfo(host.c_str(), ports.c_str(), &hints, &res) != 0) {
+            set_error("getaddrinfo failed for " + host);
+            return -1;
+        }
+        int fd = -1;
+        for (auto* ai = res; ai; ai = ai->ai_next) {
+            fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+            if (fd < 0) continue;
+            if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+            close(fd);
+            fd = -1;
+        }
+        freeaddrinfo(res);
+        if (fd < 0) set_error("connect failed to " + host + ":" + ports);
+        else {
+            int one = 1;
+            setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        }
+        return fd;
+    }
+
+    bool one_request(const std::string& host, int port,
+                     const std::string& method, const std::string& path,
+                     const std::string& body, HttpResponse* resp) {
+        int fd = connect_to(host, port);
+        if (fd < 0) return false;
+        std::ostringstream req;
+        req << method << " " << path << " HTTP/1.1\r\n"
+            << "Host: " << host << ":" << port << "\r\n"
+            << "Connection: close\r\n"
+            << "Accept: application/json\r\n"
+            << "X-Cook-User: " << user_ << "\r\n";
+        if (!impersonate_.empty())
+            req << "X-Cook-Impersonate: " << impersonate_ << "\r\n";
+        if (!bearer_.empty())
+            req << "Authorization: Bearer " << bearer_ << "\r\n";
+        else if (!basic_b64_.empty())
+            req << "Authorization: Basic " << basic_b64_ << "\r\n";
+        if (!body.empty())
+            req << "Content-Type: application/json\r\n"
+                << "Content-Length: " << body.size() << "\r\n";
+        req << "\r\n" << body;
+        std::string data = req.str();
+        size_t off = 0;
+        while (off < data.size()) {
+            ssize_t n = send(fd, data.data() + off, data.size() - off, 0);
+            if (n <= 0) {
+                set_error("send failed");
+                close(fd);
+                return false;
+            }
+            off += (size_t)n;
+        }
+        // read to EOF (Connection: close)
+        std::string raw;
+        char buf[8192];
+        for (;;) {
+            ssize_t n = recv(fd, buf, sizeof(buf), 0);
+            if (n < 0) {
+                set_error("recv failed");
+                close(fd);
+                return false;
+            }
+            if (n == 0) break;
+            raw.append(buf, (size_t)n);
+        }
+        close(fd);
+        return parse_response(raw, resp);
+    }
+
+    bool parse_response(const std::string& raw, HttpResponse* resp) {
+        size_t hdr_end = raw.find("\r\n\r\n");
+        if (hdr_end == std::string::npos) {
+            set_error("truncated response");
+            return false;
+        }
+        std::istringstream hs(raw.substr(0, hdr_end));
+        std::string line;
+        if (!std::getline(hs, line)) {
+            set_error("empty response");
+            return false;
+        }
+        // HTTP/1.1 200 OK
+        size_t sp = line.find(' ');
+        resp->status = sp == std::string::npos
+                           ? 0 : std::atoi(line.c_str() + sp + 1);
+        resp->headers.clear();
+        while (std::getline(hs, line)) {
+            if (!line.empty() && line.back() == '\r') line.pop_back();
+            size_t c = line.find(':');
+            if (c == std::string::npos) continue;
+            std::string k = line.substr(0, c);
+            for (auto& ch : k) ch = (char)std::tolower((unsigned char)ch);
+            size_t v = c + 1;
+            while (v < line.size() && line[v] == ' ') v++;
+            resp->headers[k] = line.substr(v);
+        }
+        std::string body = raw.substr(hdr_end + 4);
+        auto te = resp->headers.find("transfer-encoding");
+        if (te != resp->headers.end() &&
+            te->second.find("chunked") != std::string::npos) {
+            // de-chunk (stdlib server may chunk when length is unknown)
+            std::string out;
+            size_t i = 0;
+            while (i < body.size()) {
+                size_t eol = body.find("\r\n", i);
+                if (eol == std::string::npos) break;
+                long len = strtol(body.c_str() + i, nullptr, 16);
+                if (len <= 0) break;
+                out.append(body, eol + 2, (size_t)len);
+                i = eol + 2 + (size_t)len + 2;
+            }
+            resp->body = out;
+        } else {
+            resp->body = body;
+        }
+        return true;
+    }
+
+    std::string host_;
+    int port_;
+    std::string user_, impersonate_, basic_b64_, bearer_;
+    std::mutex err_mu_;
+    std::string last_error_;
+    char err_buf_[512] = {0};
+};
+
+std::string urlencode_uuids(const std::string& csv, const char* key) {
+    // "a,b,c" -> "?key=a&key=b&key=c"  (uuids are URL-safe already)
+    std::string out;
+    size_t start = 0;
+    while (start <= csv.size()) {
+        size_t comma = csv.find(',', start);
+        std::string u = csv.substr(
+            start, comma == std::string::npos ? std::string::npos
+                                              : comma - start);
+        if (!u.empty()) {
+            out += out.empty() ? '?' : '&';
+            out += key;
+            out += '=';
+            out += u;
+        }
+        if (comma == std::string::npos) break;
+        start = comma + 1;
+    }
+    return out;
+}
+
+char* dup_cstr(const std::string& s) {
+    char* p = (char*)std::malloc(s.size() + 1);
+    if (p) std::memcpy(p, s.c_str(), s.size() + 1);
+    return p;
+}
+
+// ------------------------------------------------------------- listener
+// Mirrors the Java JobClient's listener loop: a scheduled poll of the
+// tracked uuids, invoking the callback whenever a job's state changes
+// (JobClient.java listen/scheduleWithFixedDelay semantics).
+typedef void (*cjc_status_cb_t)(const char* uuid, const char* state,
+                                void* arg);
+
+struct Listener {
+    Client* client;
+    std::string query_path;
+    long interval_ms;
+    cjc_status_cb_t cb;
+    void* arg;
+    std::atomic<bool> stop{false};
+    std::thread thread;
+    std::map<std::string, std::string> last_state;
+
+    void run() {
+        while (!stop.load()) {
+            HttpResponse resp;
+            if (client->request("GET", query_path, "", &resp) &&
+                resp.status == 200) {
+                for (auto& p : extract_job_states(resp.body)) {
+                    if (p.first.empty()) continue;
+                    auto it = last_state.find(p.first);
+                    if (it == last_state.end() || it->second != p.second) {
+                        last_state[p.first] = p.second;
+                        cb(p.first.c_str(), p.second.c_str(), arg);
+                    }
+                }
+            }
+            for (long waited = 0; waited < interval_ms && !stop.load();
+                 waited += 20)
+                std::this_thread::sleep_for(std::chrono::milliseconds(20));
+        }
+    }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------ C surface
+extern "C" {
+
+void* cjc_create(const char* host, int port, const char* user) {
+    return new Client(host ? host : "127.0.0.1", port,
+                      user ? user : "default");
+}
+
+void cjc_destroy(void* h) { delete (Client*)h; }
+
+void cjc_set_basic_auth(void* h, const char* user, const char* pass) {
+    ((Client*)h)->set_basic(user ? user : "", pass ? pass : "");
+}
+
+void cjc_set_bearer(void* h, const char* token) {
+    ((Client*)h)->set_bearer(token ? token : "");
+}
+
+void cjc_set_impersonate(void* h, const char* user) {
+    ((Client*)h)->set_impersonate(user ? user : "");
+}
+
+const char* cjc_last_error(void* h) {
+    return ((Client*)h)->last_error_cstr();
+}
+
+void cjc_free(char* p) { std::free(p); }
+
+// Generic round trip; returns HTTP status (or -1 on transport error) and
+// malloc's the response body into *out (caller frees with cjc_free).
+int cjc_request(void* h, const char* method, const char* path,
+                const char* body, char** out) {
+    HttpResponse resp;
+    if (!((Client*)h)->request(method ? method : "GET",
+                               path ? path : "/", body ? body : "", &resp)) {
+        if (out) *out = nullptr;
+        return -1;
+    }
+    if (out) *out = dup_cstr(resp.body);
+    return resp.status;
+}
+
+int cjc_submit(void* h, const char* jobs_json_array, const char* pool,
+               char** out) {
+    std::string body = "{\"jobs\": ";
+    body += jobs_json_array ? jobs_json_array : "[]";
+    if (pool && *pool) {
+        body += ", \"pool\": \"";
+        body += pool;
+        body += "\"";
+    }
+    body += "}";
+    return cjc_request(h, "POST", "/jobs", body.c_str(), out);
+}
+
+int cjc_query(void* h, const char* uuids_csv, char** out) {
+    std::string path = "/jobs" + urlencode_uuids(uuids_csv ? uuids_csv : "",
+                                                 "uuid");
+    return cjc_request(h, "GET", path.c_str(), "", out);
+}
+
+int cjc_kill(void* h, const char* uuids_csv, char** out) {
+    std::string path = "/jobs" + urlencode_uuids(uuids_csv ? uuids_csv : "",
+                                                 "uuid");
+    return cjc_request(h, "DELETE", path.c_str(), "", out);
+}
+
+int cjc_retry(void* h, const char* uuid, int retries, char** out) {
+    std::string body = "{\"job\": \"";
+    body += uuid ? uuid : "";
+    body += "\", \"retries\": " + std::to_string(retries) + "}";
+    return cjc_request(h, "POST", "/retry", body.c_str(), out);
+}
+
+// Poll until every queried job is completed (or timeout).  Returns the
+// final query status; *out gets the last response body; *done is set to 1
+// when all jobs completed, 0 on timeout.
+int cjc_wait(void* h, const char* uuids_csv, long timeout_ms, long poll_ms,
+             char** out, int* done) {
+    std::string path = "/jobs" + urlencode_uuids(uuids_csv ? uuids_csv : "",
+                                                 "uuid");
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    int status = -1;
+    std::string last_body;
+    for (;;) {
+        HttpResponse resp;
+        if (((Client*)h)->request("GET", path, "", &resp)) {
+            status = resp.status;
+            last_body = resp.body;
+            if (resp.status == 200) {
+                auto states = extract_job_states(resp.body);
+                bool all_done = !states.empty();
+                for (auto& p : states)
+                    if (p.second != "completed") all_done = false;
+                if (all_done) {
+                    if (done) *done = 1;
+                    if (out) *out = dup_cstr(last_body);
+                    return status;
+                }
+            }
+        }
+        if (std::chrono::steady_clock::now() >= deadline) {
+            if (done) *done = 0;
+            if (out) *out = dup_cstr(last_body);
+            return status;
+        }
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(poll_ms > 0 ? poll_ms : 200));
+    }
+}
+
+void* cjc_listen(void* h, const char* uuids_csv, long interval_ms,
+                 cjc_status_cb_t cb, void* arg) {
+    auto* l = new Listener();
+    l->client = (Client*)h;
+    l->query_path =
+        "/jobs" + urlencode_uuids(uuids_csv ? uuids_csv : "", "uuid");
+    l->interval_ms = interval_ms > 0 ? interval_ms : 1000;
+    l->cb = cb;
+    l->arg = arg;
+    l->thread = std::thread([l] { l->run(); });
+    return l;
+}
+
+void cjc_listen_stop(void* lh) {
+    auto* l = (Listener*)lh;
+    l->stop.store(true);
+    if (l->thread.joinable()) l->thread.join();
+    delete l;
+}
+
+}  // extern "C"

@@ -222,6 +222,126 @@ class TestFusedCycleParity:
         assert la == lb
 
 
+def build_complex_world(columnar=True, seed=7):
+    """World exercising every entity-level constraint arm of the columnar
+    fused pack (sched/fused._pack_pool_columnar): gpu hosts + gpu jobs,
+    user EQUALS constraints, and a job with a failed prior instance
+    (novel-host)."""
+    from cook_tpu.state.schema import Constraint, Reasons
+    cfg = Config()
+    cfg.columnar_index = columnar
+    rng = np.random.default_rng(seed)
+    store = Store()
+    store.put_pool(Pool(name="default"))
+    hosts = [FakeHost(hostname=f"h{i}",
+                      capacity=Resources(cpus=16.0, mem=16384.0,
+                                         gpus=4.0 if i >= 4 else 0.0),
+                      gpu_model="a100" if i >= 4 else "",
+                      attributes={"rack": f"r{i % 2}"})
+             for i in range(6)]
+    sched = Scheduler(store, cfg, [FakeCluster("fake-1", hosts)],
+                      rank_backend="tpu")
+    jobs = []
+    for i in range(18):
+        kw = {}
+        if i % 6 == 5:
+            kw["resources"] = Resources(cpus=1.0, mem=256.0, gpus=1.0)
+        else:
+            kw["resources"] = Resources(
+                cpus=float(rng.integers(1, 4)),
+                mem=float(rng.integers(128, 1024)))
+        if i % 5 == 4:
+            kw["constraints"] = [Constraint(attribute="rack",
+                                            operator="EQUALS", pattern="r1")]
+        j = Job(uuid=f"00000000-0000-0000-0003-{i:012d}",
+                user=f"user{i % 3}", command="true", pool="default",
+                priority=int(rng.integers(0, 100)),
+                submit_time_ms=1000 + i, max_retries=3, **kw)
+        jobs.append(j)
+        store.create_jobs([j])
+    # give job 0 a failed prior instance on h0 (novel-host must exclude h0)
+    store.launch_instance(jobs[0].uuid, "task-prior-0", "h0")
+    store.update_instance_status("task-prior-0", InstanceStatus.FAILED,
+                                 reason_code=Reasons.NON_ZERO_EXIT.code)
+    return store, sched, jobs
+
+
+class TestFusedColumnarPack:
+    def test_complex_jobs_parity(self):
+        """Columnar fused pack vs host path with gpu/constraint/novel-host
+        jobs in the mix."""
+        assert_same_world(lambda: build_complex_world(columnar=True))
+
+    def test_entity_pack_parity(self):
+        """The entity pack (columnar_index=False) stays correct too."""
+        assert_same_world(lambda: build_complex_world(columnar=False))
+
+    def test_columnar_vs_entity_fused(self):
+        """Both fused pack paths make identical decisions."""
+        store_a, sched_a, jobs = build_complex_world(columnar=True)
+        store_b, sched_b, _ = build_complex_world(columnar=False)
+        res_a = sched_a.step_cycle()
+        res_b = sched_b.step_cycle()
+        assert decisions(store_a, jobs) == decisions(store_b, jobs)
+        for pool in res_a:
+            assert ([j.uuid for j in res_a[pool].unmatched]
+                    == [j.uuid for j in res_b[pool].unmatched])
+
+    def test_columnar_pack_is_used(self):
+        """The columnar branch actually runs (pp.columnar set) and the
+        ranked queues are lazy RankedQueues, not entity lists."""
+        from cook_tpu.sched.ranker import RankedQueue
+        store, sched, jobs = build_world()
+        sched.step_cycle()
+        q = sched.pending_queues.get("default")
+        assert isinstance(q, RankedQueue)
+
+    def test_novel_host_excluded(self):
+        """The failed-prior-host is never reused for the retrying job."""
+        store, sched, jobs = build_complex_world(columnar=True)
+        sched.step_cycle()
+        job = store.job(jobs[0].uuid)
+        hosts = {store.instance(t).hostname for t in job.instances
+                 if store.instance(t) is not None
+                 and store.instance(t).status is not InstanceStatus.FAILED}
+        assert "h0" not in hosts
+
+
+class TestCheckpointLocality:
+    def test_retry_pinned_to_prior_location(self):
+        """A checkpointed job's retry lands in the same location attribute
+        as its first instance (constraints.clj:218-240; the producer
+        records Instance.node_location at launch)."""
+        from cook_tpu.state.schema import Checkpoint, Reasons
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        hosts = [FakeHost(hostname=f"h{i}",
+                          capacity=Resources(cpus=16.0, mem=16384.0),
+                          attributes={"location": "lA" if i < 2 else "lB"})
+                 for i in range(4)]
+        sched = Scheduler(store, Config(),
+                          [FakeCluster("fake-1", hosts)], rank_backend="tpu")
+        j = Job(uuid="00000000-0000-0000-0004-000000000000", user="u",
+                command="true", pool="default", max_retries=5,
+                resources=Resources(cpus=1.0, mem=128.0),
+                checkpoint=Checkpoint())
+        store.create_jobs([j])
+        sched.step_cycle()
+        job = store.job(j.uuid)
+        assert job.instances, "first attempt never launched"
+        first = store.instance(job.instances[-1])
+        assert first.node_location in ("lA", "lB")
+        want = first.node_location
+        # fail it (mea-culpa so the retry is free) and re-run several cycles
+        store.update_instance_status(first.task_id, InstanceStatus.FAILED,
+                                     reason_code=Reasons.NODE_LOST.code)
+        sched.step_cycle()
+        job = store.job(j.uuid)
+        second = store.instance(job.instances[-1])
+        assert second.task_id != first.task_id, "no retry launched"
+        assert second.node_location == want
+
+
 class TestFusedGroupPlacement:
     def test_unique_group_within_batch(self):
         def mk():
