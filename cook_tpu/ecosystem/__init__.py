@@ -1,0 +1,12 @@
+"""Ecosystem integrations: run third-party distributed frameworks on
+cook_tpu (reference: dask/docs/design.md — CookCluster API for Dask;
+spark/ — patches running Spark executors as Cook jobs).
+
+The building block is :class:`ServiceFarm` — a manager for N long-running
+service jobs (scale up/down, status, teardown) over the REST client —
+which both the Dask backend (:mod:`cook_tpu.ecosystem.dask_backend`) and a
+Spark standalone deployment (docs/ECOSYSTEM.md) drive.
+"""
+
+from .service_farm import ServiceFarm  # noqa: F401
+from .dask_backend import CookCluster  # noqa: F401
