@@ -107,6 +107,46 @@ class PoolCycleInputs(NamedTuple):
             job_res=job_res, cmask=cmask, avail=avail, capacity=capacity)
 
 
+class StructuredPoolCycleInputs(NamedTuple):
+    """PoolCycleInputs with the dense bool[P, T, H] constraint mask replaced
+    by its STRUCTURE — the insight that at the 1M x 50k design point almost
+    every row's mask is derivable from per-host vectors (gpu isolation,
+    max-tasks, reservations) plus a small exception set of complex jobs.
+    The dense mask costs O(T*H) host build + transfer per cycle (500 MB at
+    100k x 5k); the structured form transfers O(T + E*H + H):
+
+      host_gpu     bool[P, H]    host has gpu capacity
+      host_blocked bool[P, H]    max-tasks-per-host exceeded, or reserved
+                                 (owners punch through via exceptions)
+      exc_id       i32[P, T]     row -> exception index, -1 = derive base
+      exc_mask     bool[P, E, H] full mask rows for exception jobs
+
+    The per-row base is composed ON DEVICE after compaction, so only the
+    admitted C rows ever materialize a mask."""
+
+    usage: jax.Array
+    quota: jax.Array
+    shares: jax.Array
+    first_idx: jax.Array
+    user_rank: jax.Array
+    pending: jax.Array
+    valid: jax.Array
+    enqueue_ok: jax.Array
+    launch_ok: jax.Array
+    tokens: jax.Array
+    num_considerable: jax.Array
+    pool_quota: jax.Array
+    group_quota: jax.Array
+    group_id: jax.Array
+    job_res: jax.Array
+    host_gpu: jax.Array
+    host_blocked: jax.Array
+    exc_id: jax.Array
+    exc_mask: jax.Array
+    avail: jax.Array
+    capacity: jax.Array
+
+
 class PoolCycleResult(NamedTuple):
     order: jax.Array          # i32[P, T] rank order (pending first)
     num_ranked: jax.Array     # i32[P] rankable pending count
@@ -140,12 +180,35 @@ def _user_running_base(usage, pending, valid, first_idx) -> jax.Array:
     return _segment_totals(cum_run, first_idx)
 
 
-def _pool_cycle_one(usage, quota, shares, first_idx, user_rank, pending,
-                    valid, enqueue_ok, launch_ok, tokens, num_considerable,
-                    pool_quota, group_quota, pool_base, group_base,
-                    job_res, cmask, avail, capacity,
-                    gpu_mode: bool, max_over_quota_jobs: int):
-    """One pool's full rank -> considerable -> match, all on device."""
+def _compact_admitted(order: jax.Array, match_valid: jax.Array,
+                      cap: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compact the admitted rows (rank order) into a static-``cap`` prefix.
+
+    The greedy match is a sequential ``lax.scan`` over its job axis, so
+    running it over all T rank rows costs O(T) scan steps and a [T, H]
+    gather even though at most ``num_considerable`` (<= cap) rows are
+    admitted.  Compaction keeps the admitted rows' relative order (greedy
+    parity is order-dependent) while shrinking the match to O(cap x H).
+
+    Returns (sel i32[cap] rank positions with sentinel T for empty slots,
+    task_idx i32[cap] original task rows, valid bool[cap])."""
+    T = match_valid.shape[0]
+    k = jnp.cumsum(match_valid.astype(jnp.int32)) - 1
+    # each admitted row (within cap) writes its rank position into slot k;
+    # everything else lands in the discarded dump slot ``cap``
+    slot = jnp.where(match_valid & (k < cap), k, cap)
+    sel = jnp.full((cap + 1,), T, dtype=jnp.int32).at[slot].set(
+        jnp.arange(T, dtype=jnp.int32))[:cap]
+    valid = sel < T
+    task_idx = order[jnp.minimum(sel, T - 1)]
+    return sel, task_idx, valid
+
+
+def _rank_admit(usage, quota, shares, first_idx, user_rank, pending, valid,
+                enqueue_ok, launch_ok, tokens, num_considerable,
+                pool_quota, group_quota, pool_base, group_base,
+                gpu_mode: bool, max_over_quota_jobs: int):
+    """Shared rank + considerable stage of the fused cycle."""
     order, num_ranked, dru, _keep, rankable = dru_ops.rank_body(
         usage, quota, shares, first_idx, user_rank, pending, valid,
         gpu_mode, max_over_quota_jobs)
@@ -160,13 +223,79 @@ def _pool_cycle_one(usage, quota, shares, first_idx, user_rank, pending,
         pool_base=pool_base, pool_quota=pool_quota,
         group_base=group_base, group_quota=group_quota,
         num_considerable=num_considerable)
+    return order, num_ranked, dru, cr
 
-    sorted_res = jnp.take(job_res, order, axis=0)
-    sorted_mask = jnp.take(cmask, order, axis=0)
-    assign, _avail = match_ops.greedy_assign(
-        sorted_res, sorted_mask, cr.match_valid, avail, capacity)
-    matched = (assign >= 0)
-    matched_usage = jnp.sum(sorted_res * matched[:, None], axis=0)[:4]
+
+def _match_tail(order, cr, job_res, mask_of, avail, capacity,
+                cap: int, T: int):
+    """Compact -> gather/compose masks -> greedy match -> scatter back.
+    ``mask_of(task_idx)`` produces bool[C, H] for the compacted rows."""
+    sel, task_idx, valid_c = _compact_admitted(order, cr.match_valid, cap)
+    res_c = job_res[task_idx] * valid_c[:, None]
+    mask_c = mask_of(task_idx) & valid_c[:, None]
+    assign_c, _avail = match_ops.greedy_assign(
+        res_c, mask_c, valid_c, avail, capacity)
+    # scatter back to rank order; sentinel slots (sel == T) drop out
+    assign = jnp.full((T,), -1, dtype=jnp.int32).at[sel].set(
+        assign_c, mode="drop")
+    matched = (assign_c >= 0)
+    matched_usage = jnp.sum(res_c * matched[:, None], axis=0)[:4]
+    return assign, matched_usage
+
+
+def _pool_cycle_one(usage, quota, shares, first_idx, user_rank, pending,
+                    valid, enqueue_ok, launch_ok, tokens, num_considerable,
+                    pool_quota, group_quota, pool_base, group_base,
+                    job_res, cmask, avail, capacity,
+                    gpu_mode: bool, max_over_quota_jobs: int,
+                    considerable_cap: Optional[int] = None):
+    """One pool's full rank -> considerable -> match with a DENSE
+    bool[T, H] constraint mask.
+
+    ``considerable_cap`` (static) bounds the match problem size; it must be
+    >= the dynamic ``num_considerable`` or over-cap admitted rows are left
+    unmatched this cycle (the fused driver derives it from the pools'
+    max_jobs_considered configs)."""
+    T = pending.shape[0]
+    order, num_ranked, dru, cr = _rank_admit(
+        usage, quota, shares, first_idx, user_rank, pending, valid,
+        enqueue_ok, launch_ok, tokens, num_considerable, pool_quota,
+        group_quota, pool_base, group_base, gpu_mode, max_over_quota_jobs)
+    cap = T if considerable_cap is None else min(considerable_cap, T)
+    assign, matched_usage = _match_tail(
+        order, cr, job_res, lambda ti: cmask[ti], avail, capacity, cap, T)
+    return (order, num_ranked, dru, assign, cr.match_valid, cr.queue_ok,
+            cr.accepted, matched_usage)
+
+
+def _pool_cycle_structured(usage, quota, shares, first_idx, user_rank,
+                           pending, valid, enqueue_ok, launch_ok, tokens,
+                           num_considerable, pool_quota, group_quota,
+                           pool_base, group_base, job_res, host_gpu,
+                           host_blocked, exc_id, exc_mask, avail, capacity,
+                           gpu_mode: bool, max_over_quota_jobs: int,
+                           considerable_cap: Optional[int] = None):
+    """Fused cycle with the STRUCTURED mask (StructuredPoolCycleInputs):
+    per-row masks are composed on device for only the compacted rows —
+    gpu bidirectional isolation from job_res, host blocks, and full
+    exception rows for the complex-job minority."""
+    T = pending.shape[0]
+    order, num_ranked, dru, cr = _rank_admit(
+        usage, quota, shares, first_idx, user_rank, pending, valid,
+        enqueue_ok, launch_ok, tokens, num_considerable, pool_quota,
+        group_quota, pool_base, group_base, gpu_mode, max_over_quota_jobs)
+    cap = T if considerable_cap is None else min(considerable_cap, T)
+
+    def mask_of(task_idx):
+        gpu_rows = job_res[task_idx, 2] > 0
+        base = jnp.where(gpu_rows[:, None], host_gpu[None, :],
+                         ~host_gpu[None, :]) & ~host_blocked[None, :]
+        eid = exc_id[task_idx]
+        exc_rows = exc_mask[jnp.maximum(eid, 0)]
+        return jnp.where((eid >= 0)[:, None], exc_rows, base)
+
+    assign, matched_usage = _match_tail(
+        order, cr, job_res, mask_of, avail, capacity, cap, T)
     return (order, num_ranked, dru, assign, cr.match_valid, cr.queue_ok,
             cr.accepted, matched_usage)
 
@@ -176,7 +305,8 @@ def single_pool_cycle(usage, quota, shares, first_idx, user_rank, pending,
                       gpu_mode: bool = False, max_over_quota_jobs: int = 100,
                       enqueue_ok=None, launch_ok=None, tokens=None,
                       num_considerable=None, pool_quota=None,
-                      group_quota=None, group_base=None):
+                      group_quota=None, group_base=None,
+                      considerable_cap: Optional[int] = None):
     """Single-chip fused rank+considerable+match step (the framework's
     'forward pass').  Jittable as-is; admission inputs default to
     permissive."""
@@ -198,13 +328,17 @@ def single_pool_cycle(usage, quota, shares, first_idx, user_rank, pending,
         usage, quota, shares, first_idx, user_rank, pending, valid,
         enqueue_ok, launch_ok, tokens, num_considerable, pool_quota,
         group_quota, pool_base, group_base, job_res, cmask, avail, capacity,
-        gpu_mode, max_over_quota_jobs)
+        gpu_mode, max_over_quota_jobs, considerable_cap)
     return order, num_ranked, dru, assign
 
 
 def make_pool_cycle(mesh, *, gpu_mode: bool = False,
-                    max_over_quota_jobs: int = 100):
-    """Build the jitted pool-sharded cycle for a mesh."""
+                    max_over_quota_jobs: int = 100,
+                    considerable_cap: Optional[int] = None,
+                    structured: bool = False):
+    """Build the jitted pool-sharded cycle for a mesh.  With
+    ``structured=True`` the cycle takes StructuredPoolCycleInputs (no dense
+    cmask transfer; the production fused driver's columnar path)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -212,8 +346,9 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
     # ("dcn", "pool") with slice-independent pool blocks
     axes = tuple(mesh.axis_names)
     spec = P(axes)
+    in_type = StructuredPoolCycleInputs if structured else PoolCycleInputs
 
-    def cycle_body(inp: PoolCycleInputs) -> PoolCycleResult:
+    def cycle_body(inp) -> PoolCycleResult:
         # Pass 1 (cheap, vmapped): per-pool RUNNING usage for pool quota and
         # for the quota-group all_gather.
         pool_base = jax.vmap(
@@ -235,15 +370,26 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
         )(inp.group_id)
 
         # Pass 2: the full fused cycle per local pool.
-        per_pool = functools.partial(_pool_cycle_one, gpu_mode=gpu_mode,
-                                     max_over_quota_jobs=max_over_quota_jobs)
+        common = (inp.usage, inp.quota, inp.shares, inp.first_idx,
+                  inp.user_rank, inp.pending, inp.valid, inp.enqueue_ok,
+                  inp.launch_ok, inp.tokens, inp.num_considerable,
+                  inp.pool_quota, inp.group_quota, pool_base, group_base,
+                  inp.job_res)
+        if structured:
+            per_pool = functools.partial(
+                _pool_cycle_structured, gpu_mode=gpu_mode,
+                max_over_quota_jobs=max_over_quota_jobs,
+                considerable_cap=considerable_cap)
+            extra = (inp.host_gpu, inp.host_blocked, inp.exc_id,
+                     inp.exc_mask, inp.avail, inp.capacity)
+        else:
+            per_pool = functools.partial(
+                _pool_cycle_one, gpu_mode=gpu_mode,
+                max_over_quota_jobs=max_over_quota_jobs,
+                considerable_cap=considerable_cap)
+            extra = (inp.cmask, inp.avail, inp.capacity)
         (order, num_ranked, dru, assign, match_valid, queue_ok, accepted,
-         matched_usage) = jax.vmap(per_pool)(
-            inp.usage, inp.quota, inp.shares, inp.first_idx, inp.user_rank,
-            inp.pending, inp.valid, inp.enqueue_ok, inp.launch_ok,
-            inp.tokens, inp.num_considerable, inp.pool_quota,
-            inp.group_quota, pool_base, group_base, inp.job_res, inp.cmask,
-            inp.avail, inp.capacity)
+         matched_usage) = jax.vmap(per_pool)(*common, *extra)
 
         # Reconciliation collective #2: global matched usage + placement
         # count (cycle telemetry, scheduler.clj:1210-1280).
@@ -260,7 +406,7 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
 
     sharded = shard_map(
         cycle_body, mesh=mesh,
-        in_specs=(PoolCycleInputs(*(spec,) * len(PoolCycleInputs._fields)),),
+        in_specs=(in_type(*(spec,) * len(in_type._fields)),),
         out_specs=PoolCycleResult(
             order=spec, num_ranked=spec, dru=spec, assign=spec,
             match_valid=spec, queue_ok=spec, accepted=spec,

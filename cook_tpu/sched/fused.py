@@ -59,6 +59,12 @@ class _PackedPool:
         self.columnar = False
         self.uuids: Optional[np.ndarray] = None        # U36[T] sorted order
         self.users_sorted: Optional[np.ndarray] = None  # U[T]
+        # structured-mask form (columnar mode; parallel/sharded
+        # StructuredPoolCycleInputs): no dense [T, H] mask is ever built
+        self.host_gpu: Optional[np.ndarray] = None      # bool[H]
+        self.host_blocked: Optional[np.ndarray] = None  # bool[H]
+        self.exc_id: Optional[np.ndarray] = None        # i32[T]
+        self.exc_mask: Optional[np.ndarray] = None      # bool[E, H]
         self.offers: List[Offer] = []
         self.ctx = None
         self.arrays: Dict[str, np.ndarray] = {}
@@ -99,13 +105,17 @@ class FusedCycleDriver:
             self._mesh = Mesh(np.array(jax.devices()[:1]), (POOL_AXIS,))
         return self._mesh
 
-    def _cycle_fn(self, gpu_mode: bool):
-        key = (id(self.mesh()), gpu_mode, self.config.max_over_quota_jobs)
+    def _cycle_fn(self, gpu_mode: bool, considerable_cap: int,
+                  structured: bool = False):
+        key = (id(self.mesh()), gpu_mode, self.config.max_over_quota_jobs,
+               considerable_cap, structured)
         fn = self._cycles.get(key)
         if fn is None:
             from ..parallel.sharded import make_pool_cycle
-            fn = make_pool_cycle(self.mesh(), gpu_mode=gpu_mode,
-                                 max_over_quota_jobs=self.config.max_over_quota_jobs)
+            fn = make_pool_cycle(
+                self.mesh(), gpu_mode=gpu_mode,
+                max_over_quota_jobs=self.config.max_over_quota_jobs,
+                considerable_cap=considerable_cap, structured=structured)
             self._cycles[key] = fn
         return fn
 
@@ -160,34 +170,29 @@ class FusedCycleDriver:
             host_tasks = np.array([o.task_count for o in offers],
                                   dtype=np.int32)
             host_index = {o.hostname: h for h, o in enumerate(offers)}
-            # vectorized base mask over every pending row: gpu-host
-            # bidirectional isolation + max-tasks-per-host + rebalancer
-            # reservations (constraints.clj:122,433,242) — no per-job Python
-            cmask = np.zeros((T, H), dtype=bool)
-            gpu_rows = pp.job_res[:, 2] > 0
-            cmask[pend] = np.where(gpu_rows[pend, None],
-                                   host_gpu[None, :], ~host_gpu[None, :])
+            # STRUCTURED mask (no dense [T, H] build or transfer, see
+            # parallel/sharded.StructuredPoolCycleInputs): per-host base
+            # vectors express gpu isolation / max-tasks / reservations
+            # (constraints.clj:122,433,242) for the plain-job majority; the
+            # kernel composes per-row masks on device for only the
+            # compacted match candidates.
+            host_blocked = np.zeros(H, dtype=bool)
             if cfg.max_tasks_per_host is not None:
-                cmask[pend] &= host_tasks[None, :] < cfg.max_tasks_per_host
-            reserved = [(u, host_index[hn])
-                        for u, hn in scheduler.reserved_hosts.items()
-                        if hn in host_index]
-            if reserved:
-                # one np.isin pass locates every owner row (the naive
-                # per-reservation uuids_sorted == owner scan is O(R*T))
-                owner_set = np.array([u for u, _ in reserved])
-                owner_rows: Dict[str, List[int]] = {}
-                for i in np.flatnonzero(np.isin(uuids_sorted, owner_set)):
-                    owner_rows.setdefault(str(uuids_sorted[i]), []).append(i)
-                for owner_uuid, h in reserved:
-                    rows = owner_rows.get(owner_uuid, [])
-                    saved = cmask[rows, h]
-                    cmask[:, h] = False
-                    cmask[rows, h] = saved
-            # complex rows: the entity-level constraint compiler, applied to
-            # the minority that needs it
+                host_blocked |= host_tasks >= cfg.max_tasks_per_host
+            reserved_idx = [host_index[hn]
+                            for hn in scheduler.reserved_hosts.values()
+                            if hn in host_index]
+            host_blocked[reserved_idx] = True
+            # exception rows = complex jobs + reservation owners (owners
+            # must punch through the blanket reserved-host block; owners
+            # whose reserved host serves another pool need no exception)
+            is_exc = pend & complex_rows
+            local_owners = [u for u, hn in scheduler.reserved_hosts.items()
+                            if hn in host_index]
+            if local_owners:
+                is_exc |= pend & np.isin(uuids_sorted, local_owners)
             cjobs, keep = [], []
-            for i in np.flatnonzero(pend & complex_rows):
+            for i in np.flatnonzero(is_exc):
                 job = store.job(uuids_sorted[i])
                 if job is not None:
                     cjobs.append(job)
@@ -198,9 +203,18 @@ class FusedCycleDriver:
             self.matcher._fill_cotask_host_attributes(
                 ctx, pool.name, offers, scheduler.clusters)
             pp.ctx = ctx
+            exc_id = np.full(T, -1, dtype=np.int32)
             if cjobs:
-                cmask[crow] = build_constraint_mask(cjobs, offers, ctx)
-            pp.cmask = cmask
+                # the compiler emits COMPLETE rows (gpu isolation,
+                # max-tasks, reservations included), so an exception row
+                # fully replaces the base
+                pp.exc_mask = build_constraint_mask(cjobs, offers, ctx)
+                exc_id[crow] = np.arange(len(cjobs), dtype=np.int32)
+            else:
+                pp.exc_mask = np.zeros((1, H), dtype=bool)
+            pp.exc_id = exc_id
+            pp.host_gpu = host_gpu
+            pp.host_blocked = host_blocked
             pp.avail = np.array(
                 [[o.available.cpus, o.available.mem, o.available.gpus,
                   o.available.disk] for o in offers], dtype=F32)
@@ -208,7 +222,10 @@ class FusedCycleDriver:
                 [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
                   o.capacity.disk] for o in offers], dtype=F32)
         else:
-            pp.cmask = np.zeros((T, 1), dtype=bool)
+            pp.host_gpu = np.zeros(1, dtype=bool)
+            pp.host_blocked = np.ones(1, dtype=bool)
+            pp.exc_id = np.full(T, -1, dtype=np.int32)
+            pp.exc_mask = np.zeros((1, 1), dtype=bool)
             pp.avail = np.zeros((1, 4), dtype=F32)
             pp.capacity = np.zeros((1, 4), dtype=F32)
             pp.n_hosts = 0
@@ -447,16 +464,18 @@ class FusedCycleDriver:
             def padT(a, fill=0):
                 return pad_to(a, T, fill=fill)
 
-            from ..parallel.sharded import PoolCycleInputs
+            from ..parallel.sharded import (
+                PoolCycleInputs,
+                StructuredPoolCycleInputs,
+            )
             arr = lambda k, fill: stack(lambda pp: padT(pp.arrays[k], fill))
-            cmask_p = np.zeros((P, T, H), dtype=bool)
+            structured = group[0].columnar
             avail_p = np.zeros((P, H, 4), dtype=F32)
             cap_p = np.zeros((P, H, 4), dtype=F32)
             for i, pp in enumerate(group):
-                cmask_p[i, :pp.n_tasks, :pp.cmask.shape[1]] = pp.cmask
                 avail_p[i, :pp.avail.shape[0]] = pp.avail
                 cap_p[i, :pp.capacity.shape[0]] = pp.capacity
-            inp = PoolCycleInputs(
+            common = dict(
                 usage=jnp.asarray(arr("usage", 0)),
                 quota=jnp.asarray(arr("quota", INF)),
                 shares=jnp.asarray(arr("shares", INF)),
@@ -482,18 +501,56 @@ class FusedCycleDriver:
                     [pp.group_id for pp in group]
                     + [-1] * (P - len(group)), dtype=np.int32)),
                 job_res=jnp.asarray(
-                    stack(lambda pp: padT(pp.job_res, 0.0))),
-                cmask=jnp.asarray(cmask_p),
-                avail=jnp.asarray(avail_p),
-                capacity=jnp.asarray(cap_p))
+                    stack(lambda pp: padT(pp.job_res, 0.0))))
+            if structured:
+                # bucketed exception capacity: shapes recur across cycles
+                E = bucket(max(pp.exc_mask.shape[0] for pp in group),
+                           minimum=8)
+                exc_id_p = np.full((P, T), -1, dtype=np.int32)
+                exc_mask_p = np.zeros((P, E, H), dtype=bool)
+                host_gpu_p = np.zeros((P, H), dtype=bool)
+                # padding hosts stay blocked so zero-resource jobs can
+                # never land on them (the dense path's zero rows did this)
+                host_blocked_p = np.ones((P, H), dtype=bool)
+                for i, pp in enumerate(group):
+                    exc_id_p[i, :pp.n_tasks] = pp.exc_id
+                    e, h = pp.exc_mask.shape
+                    exc_mask_p[i, :e, :h] = pp.exc_mask
+                    host_gpu_p[i, :pp.host_gpu.shape[0]] = pp.host_gpu
+                    host_blocked_p[i, :pp.host_blocked.shape[0]] = \
+                        pp.host_blocked
+                inp = StructuredPoolCycleInputs(
+                    **common,
+                    host_gpu=jnp.asarray(host_gpu_p),
+                    host_blocked=jnp.asarray(host_blocked_p),
+                    exc_id=jnp.asarray(exc_id_p),
+                    exc_mask=jnp.asarray(exc_mask_p),
+                    avail=jnp.asarray(avail_p),
+                    capacity=jnp.asarray(cap_p))
+            else:
+                cmask_p = np.zeros((P, T, H), dtype=bool)
+                for i, pp in enumerate(group):
+                    cmask_p[i, :pp.n_tasks, :pp.cmask.shape[1]] = pp.cmask
+                inp = PoolCycleInputs(
+                    **common,
+                    cmask=jnp.asarray(cmask_p),
+                    avail=jnp.asarray(avail_p),
+                    capacity=jnp.asarray(cap_p))
 
+            # static match-problem cap: the configured max_jobs_considered
+            # (>= every pool's dynamic num_considerable), bucketed so the
+            # compiled cycle is reused across config tweaks
+            cap = bucket(max(
+                self.config.matcher_for_pool(pp.pool.name).max_jobs_considered
+                for pp in group))
             with tracing.span("fused.dispatch", pools=len(group),
                               tasks=T, hosts=H, gpu=gpu_mode):
-                res = self._cycle_fn(gpu_mode)(inp)
-            order = np.asarray(res.order)
-            queue_ok = np.asarray(res.queue_ok)
-            match_valid = np.asarray(res.match_valid)
-            assign = np.asarray(res.assign)
+                res = self._cycle_fn(gpu_mode, min(cap, T), structured)(inp)
+            # one batched fetch: each separate np.asarray pays a full
+            # device->host round trip (expensive on a tunneled chip)
+            import jax
+            order, queue_ok, match_valid, assign = jax.device_get(
+                (res.order, res.queue_ok, res.match_valid, res.assign))
 
             for i, pp in enumerate(group):
                 self._apply_pool(scheduler, pp, order[i], queue_ok[i],
@@ -507,19 +564,28 @@ class FusedCycleDriver:
         within-batch group validation, backoff bookkeeping, transactional
         launch."""
         pool_name = pp.pool.name
-        # ranked queue = queue-surviving rows in rank order
+        # ranked queue = queue-surviving rows in rank order (built AFTER
+        # the launch below so this cycle's launches can be dropped by exact
+        # queue position — a full-queue isin scan at 100k+ rows is not)
         ranked_rows = order[queue_ok]
-        if pp.columnar:
-            # lazy queue over uuid/resource columns: consumers materialize
-            # only the prefix they touch (sched/ranker.RankedQueue)
-            from .ranker import RankedQueue
-            queues[pool_name] = RankedQueue(
-                self.store, pp.uuids[ranked_rows],
-                pp.arrays["usage"][ranked_rows],
-                pp.users_sorted[ranked_rows])
-        else:
-            queues[pool_name] = [pp.id2job[pp.task_ids[r]]
-                                 for r in ranked_rows]
+
+        def publish_queue(drop_qpos=None):
+            keep = None
+            if drop_qpos is not None and len(drop_qpos):
+                keep = np.ones(len(ranked_rows), dtype=bool)
+                keep[drop_qpos] = False
+            rows = ranked_rows if keep is None else ranked_rows[keep]
+            if pp.columnar:
+                # lazy queue over uuid/resource columns: consumers
+                # materialize only the prefix they touch (RankedQueue)
+                from .ranker import RankedQueue
+                queues[pool_name] = RankedQueue(
+                    self.store, pp.uuids[rows],
+                    pp.arrays["usage"][rows], pp.users_sorted[rows])
+            else:
+                queues[pool_name] = [pp.id2job[pp.task_ids[r]]
+                                     for r in rows]
+
         scheduler._stifle_offensive(pp.offensive)
 
         result = MatchCycleResult()
@@ -539,6 +605,7 @@ class FusedCycleDriver:
             # mirror Matcher.match_pool: an empty cycle returns the
             # considerable set unmatched and leaves backoff untouched
             result.unmatched = cand_jobs
+            publish_queue()
             results[pool_name] = result
             return
 
@@ -564,4 +631,16 @@ class FusedCycleDriver:
         with tracing.span("fused.launch", pool=pool_name,
                           matched=len(result.matched)):
             self.matcher._launch(pool_name, result, scheduler.clusters)
+        # drop this cycle's launches from the queue by exact position:
+        # qpos[i] = queue index of rank position i (launched candidates are
+        # always queue members — match_valid implies queue_ok)
+        if result.launched_job_uuids:
+            qpos = np.cumsum(queue_ok) - 1
+            cand_uuids = np.array([j.uuid for j in cand_jobs])
+            launched_c = np.isin(cand_uuids,
+                                 np.array(result.launched_job_uuids))
+            publish_queue(qpos[cand_pos[launched_c]])
+            result.queue_pruned = True
+        else:
+            publish_queue()
         results[pool_name] = result

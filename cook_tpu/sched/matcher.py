@@ -25,7 +25,7 @@ from ..cluster.base import ComputeCluster, LaunchSpec, Offer
 from ..config import Config, MatcherConfig
 from ..ops import host_prep, reference_impl
 from ..state.schema import InstanceStatus, Job, Reasons, new_uuid
-from ..state.store import AbortTransaction, Store
+from ..state.store import Store
 from ..utils import tracing
 from .constraints import (
     LOCATION_ATTRIBUTE,
@@ -42,9 +42,14 @@ class MatchCycleResult:
     considered: int = 0
     matched: List[Tuple[Job, Offer]] = field(default_factory=list)
     launched_task_ids: List[str] = field(default_factory=list)
+    launched_job_uuids: List[str] = field(default_factory=list)
     unmatched: List[Job] = field(default_factory=list)
     head_matched: bool = True
     launch_failures: List[Tuple[str, str]] = field(default_factory=list)
+    # True when the producer already removed this cycle's launches from the
+    # pool's pending queue (the fused driver prunes by exact queue position;
+    # the scheduler's generic isin-based prune then skips the pool)
+    queue_pruned: bool = False
 
 
 class _BackoffState:
@@ -375,6 +380,8 @@ class Matcher:
         launch_rl = self.rate_limits.job_launch
         cluster_budget: Dict[str, float] = {}
         by_cluster: Dict[str, List[LaunchSpec]] = {}
+        entries: List[Dict] = []
+        by_task: Dict[str, Tuple[Job, Offer]] = {}
         for job, offer in result.matched:
             # per-compute-cluster launch rate limit (reference:
             # filter-matches-for-ratelimit scheduler.clj:887)
@@ -386,22 +393,28 @@ class Matcher:
                     continue
                 cluster_budget[offer.cluster] = budget - 1
             task_id = new_uuid()
-            try:
-                self.store.launch_instance(
-                    job.uuid, task_id, offer.hostname,
-                    slave_id=offer.slave_id, compute_cluster=offer.cluster,
-                    node_location=offer.attributes.get(
-                        LOCATION_ATTRIBUTE, ""))
-            except AbortTransaction as e:
-                result.launch_failures.append((job.uuid, e.reason))
-                continue
+            entries.append(dict(
+                job_uuid=job.uuid, task_id=task_id, hostname=offer.hostname,
+                slave_id=offer.slave_id, compute_cluster=offer.cluster,
+                node_location=offer.attributes.get(LOCATION_ATTRIBUTE, "")))
+            by_task[task_id] = (job, offer)
+        # ONE guard transaction for the whole cycle's launches (reference:
+        # launch-matched-tasks! transacts all task txns at once,
+        # scheduler.clj:810-1009); per-job guard failures are reported and
+        # those jobs never reach a backend
+        insts, failures = self.store.launch_instances(entries)
+        result.launch_failures.extend(failures)
+        for inst in insts:
+            job, offer = by_task[inst.task_id]
             launch_rl.spend(pool_user_key(pool_name, job.user))
             cluster_rl.spend(offer.cluster)
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
-                task_id=task_id, job_uuid=job.uuid, hostname=offer.hostname,
-                slave_id=offer.slave_id, resources=job.resources,
-                env=job.env, port_count=job.ports, container=job.container))
-            result.launched_task_ids.append(task_id)
+                task_id=inst.task_id, job_uuid=job.uuid,
+                hostname=offer.hostname, slave_id=offer.slave_id,
+                resources=job.resources, env=job.env, port_count=job.ports,
+                container=job.container))
+            result.launched_task_ids.append(inst.task_id)
+            result.launched_job_uuids.append(job.uuid)
         # per-cluster launches fan out in parallel (reference: future per
         # cluster, scheduler.clj:1034-1048) — one slow backend must not
         # serialize the others

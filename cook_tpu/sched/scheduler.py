@@ -254,14 +254,16 @@ class Scheduler:
                 self.ranker.rank_pool(pool.name, pool.dru_mode))
             queues[pool.name] = ranked
             results[pool.name] = self._match_direct(pool.name, ranked)
-        # queues were computed pre-launch; prune the jobs this cycle launched
-        # so consumers (rebalancer, /queue, direct pools) see current state
+        # queues were computed pre-launch; prune the jobs this cycle
+        # launched so consumers (rebalancer, /queue, direct pools) see
+        # current state.  Pools whose producer already dropped launches by
+        # exact queue position (fused _apply_pool) are skipped — the
+        # full-queue isin scan is O(T) string work at the 100k+ scale.
         launched_uuids = set()
-        for result in results.values():
-            for tid in result.launched_task_ids:
-                inst = self.store.instance(tid)
-                if inst is not None:
-                    launched_uuids.add(inst.job_uuid)
+        for pool_name, result in results.items():
+            if result.queue_pruned:
+                continue
+            launched_uuids.update(result.launched_job_uuids)
         if launched_uuids:
             from .ranker import RankedQueue
 
@@ -272,7 +274,9 @@ class Scheduler:
                     return q.filtered(~np.isin(q.uuids,
                                                list(launched_uuids)))
                 return [j for j in q if j.uuid not in launched_uuids]
-            queues = {p: prune(q) for p, q in queues.items()}
+            queues = {p: (q if results.get(p) is not None
+                          and results[p].queue_pruned else prune(q))
+                      for p, q in queues.items()}
         self.pending_queues = queues
         for pool_name, result in results.items():
             self._autoscale(pool_name, result)
@@ -311,9 +315,7 @@ class Scheduler:
         trigger-autoscaling! scheduler.clj:1178-1283)."""
         if not self.config.autoscaling_enabled:
             return
-        launched_jobs = [self.store.instance(t).job_uuid
-                         for t in result.launched_task_ids
-                         if self.store.instance(t) is not None]
+        launched_jobs = list(result.launched_job_uuids)
         for cluster in list(self.clusters.values()):
             autoscale = getattr(cluster, "autoscale", None)
             if autoscale is None or not cluster.accepts_pool(pool_name):
@@ -372,6 +374,7 @@ class Scheduler:
             finally:
                 cluster.kill_lock.release_read()
             result.launched_task_ids.append(task_id)
+            result.launched_job_uuids.append(job.uuid)
         return result
 
     def step_rebalance(self) -> Dict[str, list]:

@@ -361,29 +361,64 @@ class Store:
                         node_location: str = "") -> Instance:
         """Create an instance under the allowed-to-start guard; aborts (and
         therefore blocks the backend launch) if the job state moved
-        (reference: scheduler.clj:987-1009 + schema.clj:1311-1325)."""
+        (reference: scheduler.clj:987-1009 + schema.clj:1311-1325).
+        Single-entry form of :meth:`launch_instances` (one body, one
+        invariant)."""
+        insts, failures = self.launch_instances([dict(
+            job_uuid=job_uuid, task_id=task_id, hostname=hostname,
+            slave_id=slave_id, compute_cluster=compute_cluster,
+            ports=ports, node_location=node_location)])
+        if failures:
+            raise AbortTransaction(failures[0][1])
+        return insts[0]
 
-        def _launch(txn: _Txn) -> Instance:
-            job = txn.job_w(job_uuid)
-            if job is None:
-                txn.abort("no-such-job")
-            deny = machines.allowed_to_start(job, txn.instances_of(job))
-            if deny is not None:
-                txn.abort(deny)
-            t = self.clock()
-            inst = Instance(task_id=task_id, job_uuid=job_uuid, hostname=hostname,
-                            slave_id=slave_id or hostname, compute_cluster=compute_cluster,
-                            status=InstanceStatus.UNKNOWN, start_time_ms=t,
-                            ports=ports or [], node_location=node_location,
-                            queue_time_ms=max(0, t - job.last_waiting_start_ms))
-            txn.put("instances", task_id, inst)
-            job.instances.append(task_id)
-            job.state = JobState.RUNNING
-            txn.event("instance-created", task_id=task_id, job=job_uuid, hostname=hostname)
-            txn.event("job-state", uuid=job_uuid, old="waiting", new="running", reason=None)
-            return inst
+    def launch_instances(self, entries: List[Dict[str, Any]]
+                         ) -> Tuple[List[Instance], List[Tuple[str, str]]]:
+        """Batched launch guard: ONE transaction for a whole match cycle's
+        launches (reference: launch-matched-tasks! builds every task txn and
+        transacts once, scheduler.clj:810-1009), instead of a lock/journal/
+        event-drain round per task.  Jobs whose allowed-to-start guard fails
+        are skipped and reported — the transactional invariant (guard
+        failure blocks the backend launch) holds per job.
 
-        return self.transact(_launch)
+        ``entries``: dicts with job_uuid, task_id, hostname and optional
+        slave_id, compute_cluster, ports, node_location.  Returns
+        (created instances, [(job_uuid, deny-reason), ...])."""
+
+        def _launch_all(txn: _Txn):
+            out: List[Instance] = []
+            failures: List[Tuple[str, str]] = []
+            for e in entries:
+                job = txn.job_w(e["job_uuid"])
+                if job is None:
+                    failures.append((e["job_uuid"], "no-such-job"))
+                    continue
+                deny = machines.allowed_to_start(job, txn.instances_of(job))
+                if deny is not None:
+                    failures.append((e["job_uuid"], deny))
+                    continue
+                t = self.clock()
+                hostname = e["hostname"]
+                inst = Instance(
+                    task_id=e["task_id"], job_uuid=e["job_uuid"],
+                    hostname=hostname,
+                    slave_id=e.get("slave_id") or hostname,
+                    compute_cluster=e.get("compute_cluster", ""),
+                    status=InstanceStatus.UNKNOWN, start_time_ms=t,
+                    ports=e.get("ports") or [],
+                    node_location=e.get("node_location", ""),
+                    queue_time_ms=max(0, t - job.last_waiting_start_ms))
+                txn.put("instances", e["task_id"], inst)
+                job.instances.append(e["task_id"])
+                job.state = JobState.RUNNING
+                txn.event("instance-created", task_id=e["task_id"],
+                          job=e["job_uuid"], hostname=hostname)
+                txn.event("job-state", uuid=e["job_uuid"], old="waiting",
+                          new="running", reason=None)
+                out.append(inst)
+            return out, failures
+
+        return self.transact(_launch_all)
 
     def update_instance_status(self, task_id: str, new_status: InstanceStatus,
                                reason_code: Optional[int] = None,
@@ -575,6 +610,19 @@ class Store:
         with self._lock:
             job = self._jobs.get(uuid)
             return copy.deepcopy(job) if job is not None else None
+
+    # -- borrowed reads -----------------------------------------------------
+    # Commits install whole replacement objects (transact's write loop), so
+    # a borrowed reference is always a complete, never-again-mutated entity.
+    # Callers must treat it as FROZEN: read fields, never mutate or retain
+    # past their own critical section.  This is the no-deepcopy path for
+    # trusted high-frequency internals (the columnar index's tx-event
+    # handler runs for every event of every transaction).
+    def job_ref(self, uuid: str) -> Optional[Job]:
+        return self._jobs.get(uuid)
+
+    def instance_ref(self, task_id: str) -> Optional[Instance]:
+        return self._instances.get(task_id)
 
     def instance(self, task_id: str) -> Optional[Instance]:
         with self._lock:

@@ -245,3 +245,22 @@ class TestDirectMode:
         # backend reported placement via status update
         for tid in res.launched_task_ids:
             assert store.instance(tid).hostname != ""
+
+    def test_step_cycle_prunes_direct_launches_from_queue(self, backend):
+        """Direct-pool launches must disappear from pending_queues within
+        the same step_cycle (regression: _match_direct once skipped
+        launched_job_uuids, leaving launched jobs visible as pending)."""
+        store = Store()
+        hosts = [FakeHost(hostname=f"h{i}",
+                          capacity=Resources(cpus=8, mem=8192),
+                          pool="direct") for i in range(2)]
+        cluster = FakeCluster("fake-1", hosts)
+        cfg = Config()
+        if backend == "cpu":
+            cfg.default_matcher = MatcherConfig(backend="cpu")
+        store.put_pool(Pool(name="direct", scheduler=SchedulerKind.DIRECT))
+        sched = Scheduler(store, cfg, [cluster], rank_backend=backend)
+        store.create_jobs([make_job(pool="direct") for _ in range(2)])
+        results = sched.step_cycle()
+        assert len(results["direct"].launched_task_ids) == 2
+        assert len(sched.pending_queues.get("direct", [])) == 0
