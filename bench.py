@@ -505,7 +505,7 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
 
     sched.flush_status_updates()
     for _ in range(reps):
-        top_up(warm_launched if warm_launched else 0)
+        top_up(warm_launched)
         t0 = time.perf_counter()
         results = sched.step_cycle()
         samples.append((time.perf_counter() - t0) * 1000.0)

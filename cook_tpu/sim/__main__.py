@@ -56,7 +56,7 @@ def main(argv=None) -> int:
         with open(args.out, "w", newline="") as f:
             writer = csv.DictWriter(f, fieldnames=[
                 "job", "user", "task", "host", "status", "start", "end",
-                "preempted"])
+                "wait_ms", "preempted"])
             writer.writeheader()
             writer.writerows(result.task_records)
     return 0

@@ -209,6 +209,7 @@ class Simulator:
                     "host": inst.hostname,
                     "status": inst.status.value,
                     "start": inst.start_time_ms, "end": inst.end_time_ms,
+                    "wait_ms": inst.queue_time_ms,
                     "preempted": inst.preempted,
                 })
                 if inst.queue_time_ms is not None:

@@ -389,7 +389,11 @@ class Store:
             out: List[Instance] = []
             failures: List[Tuple[str, str]] = []
             for e in entries:
-                job = txn.job_w(e["job_uuid"])
+                # guard on a READ: taking write intent first would install
+                # (and journal) the unchanged entity even when the guard
+                # denies — a lingering denied job would then append a no-op
+                # record to the redo journal every match cycle
+                job = txn.job(e["job_uuid"])
                 if job is None:
                     failures.append((e["job_uuid"], "no-such-job"))
                     continue
@@ -397,6 +401,7 @@ class Store:
                 if deny is not None:
                     failures.append((e["job_uuid"], deny))
                     continue
+                job = txn.job_w(e["job_uuid"])
                 t = self.clock()
                 hostname = e["hostname"]
                 inst = Instance(

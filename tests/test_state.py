@@ -359,6 +359,23 @@ class TestDurableStore:
         third = Store.open(d)
         assert third.job(uuid).state is JobState.COMPLETED
 
+    def test_denied_launch_does_not_grow_journal(self, tmp_path):
+        """A guard-denied launch must journal NOTHING (regression: taking
+        write intent before the guard re-journaled the unchanged job on
+        every denial, growing the journal unboundedly for a job that keeps
+        getting matched while no longer startable)."""
+        import os
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [uuid] = store.create_jobs([make_job()])
+        store.kill_job(uuid)
+        size_before = os.path.getsize(store._journal_path)
+        for i in range(5):
+            insts, fails = store.launch_instances([
+                dict(job_uuid=uuid, task_id=f"t{i}", hostname="h")])
+            assert insts == [] and len(fails) == 1
+        assert os.path.getsize(store._journal_path) == size_before
+
     def test_checkpoint_compacts_journal(self, tmp_path):
         d = str(tmp_path / "state")
         store = Store.open(d)
