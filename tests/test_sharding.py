@@ -126,6 +126,78 @@ class TestPoolShardedCycle:
         assert bool(np.all(np.asarray(res.assign[3]) == -1))
 
 
+class TestStructuredMask:
+    def test_structured_equals_dense_on_8_pools(self):
+        """The production structured-mask cycle (per-host vectors +
+        exception rows composed on device) must produce bit-identical
+        decisions to the dense bool[T, H] mask on a NON-TRIVIAL mask:
+        random gpu hosts, gpu jobs, blocked hosts, and exception rows."""
+        from cook_tpu.parallel.sharded import StructuredPoolCycleInputs
+        mesh = pool_mesh()
+        rng = np.random.default_rng(13)
+        pools = [build_pool(rng) for _ in range(8)]
+        T = pools[0]["arrays"]["pending"].shape[0]
+        Hb = pools[0]["avail"].shape[0]
+        E = 4
+
+        host_gpu = np.zeros((8, Hb), dtype=bool)
+        host_blocked = np.zeros((8, Hb), dtype=bool)
+        exc_id = np.full((8, T), -1, dtype=np.int32)
+        exc_mask = np.zeros((8, E, Hb), dtype=bool)
+        dense = np.zeros((8, T, Hb), dtype=bool)
+        job_res = np.stack([p["job_res"] for p in pools])
+        for pi, pool in enumerate(pools):
+            H = pool["num_hosts"]
+            # random gpu hosts + gpu-demanding rows
+            host_gpu[pi, :H] = rng.random(H) < 0.3
+            gpu_rows = rng.random(T) < 0.2
+            job_res[pi, gpu_rows, 2] = 1.0
+            # padding hosts blocked, plus one random real block
+            host_blocked[pi, H:] = True
+            if H > 1:
+                host_blocked[pi, int(rng.integers(0, H))] = True
+            # a few exception rows with arbitrary masks
+            rows = rng.choice(T, size=E, replace=False)
+            exc_id[pi, rows] = np.arange(E, dtype=np.int32)
+            exc_mask[pi, :, :H] = rng.random((E, H)) < 0.5
+            # dense equivalent
+            base = np.where(job_res[pi, :, 2:3] > 0, host_gpu[pi][None, :],
+                            ~host_gpu[pi][None, :]) & ~host_blocked[pi][None, :]
+            dense[pi] = base
+            for k, r in enumerate(rows):
+                dense[pi, r] = exc_mask[pi, k]
+
+        stack = lambda key: jnp.asarray(np.stack(
+            [p["arrays"][key] for p in pools]))
+        common = dict(
+            usage=stack("usage"), quota=stack("quota"), shares=stack("shares"),
+            first_idx=stack("first_idx"), user_rank=stack("user_rank"),
+            pending=stack("pending"), valid=stack("valid"),
+            job_res=jnp.asarray(job_res))
+        dense_inp = PoolCycleInputs.build(
+            **common, cmask=jnp.asarray(dense),
+            avail=jnp.asarray(np.stack([p["avail"] for p in pools])),
+            capacity=jnp.asarray(np.stack([p["capacity"] for p in pools])))
+        res_d = make_pool_cycle(mesh, considerable_cap=32)(dense_inp)
+
+        sinp = StructuredPoolCycleInputs(
+            **{k: dense_inp._asdict()[k]
+               for k in StructuredPoolCycleInputs._fields
+               if k in PoolCycleInputs._fields and k != "cmask"},
+            host_gpu=jnp.asarray(host_gpu),
+            host_blocked=jnp.asarray(host_blocked),
+            exc_id=jnp.asarray(exc_id), exc_mask=jnp.asarray(exc_mask))
+        res_s = make_pool_cycle(mesh, considerable_cap=32,
+                                structured=True)(sinp)
+
+        np.testing.assert_array_equal(np.asarray(res_d.order),
+                                      np.asarray(res_s.order))
+        np.testing.assert_array_equal(np.asarray(res_d.assign),
+                                      np.asarray(res_s.assign))
+        assert int(res_d.total_matched) == int(res_s.total_matched)
+        assert int(res_d.total_matched) > 0, "trivial scenario"
+
+
 class TestMultisliceMesh:
     def test_dcn_pool_mesh_matches_1d(self):
         """2-D ("dcn", "pool") mesh produces identical placements to the 1-D
