@@ -458,6 +458,41 @@ def bench_fused_cycle(T=100_000, n_users=200, H=5000):
     return out
 
 
+def bench_pallas_scale(J=100_000, H=50_000, E=256, k=16):
+    """The Pallas structured-mask top-K preference build at a scale where
+    the dense formulation cannot run at all: a bool[J, H] mask at
+    100k x 50k is 5 GB (and the f32 score matrix 20 GB), past the chip's
+    HBM; the structured kernel's footprint is O(J*R + E*H + J*K)."""
+    import jax.numpy as jnp
+
+    from cook_tpu.ops.pallas_match import topk_prefs_structured
+
+    rng = np.random.default_rng(6)
+    E = min(E, J)  # smoke scales can shrink J below the exception count
+    job_res = np.stack([rng.integers(1, 8, J), rng.integers(64, 2048, J),
+                        np.zeros(J), np.zeros(J)], axis=1).astype(np.float32)
+    exc_id = np.full(J, -1, np.int32)
+    rows = rng.choice(J, size=E, replace=False)
+    exc_id[rows] = np.arange(E, dtype=np.int32)
+    cap = np.stack([rng.integers(16, 64, H), rng.integers(4096, 16384, H),
+                    np.zeros(H), np.full(H, 1e6)], axis=1).astype(np.float32)
+    args = (jnp.asarray(job_res), jnp.ones(J, dtype=bool),
+            jnp.zeros(H, dtype=bool),
+            jnp.asarray(rng.random(H) < 0.05),
+            jnp.asarray(exc_id), jnp.asarray(rng.random((E, H)) < 0.5),
+            jnp.asarray(cap * 0.8), jnp.asarray(cap))
+    times = timed(lambda: topk_prefs_structured(*args, k=k)[1],
+                  reps=3, inner=1)
+    out = {"p50_ms": round(pctl(times, 50), 1),
+           "p99_ms": round(pctl(times, 99), 1)}
+    print(f"pallas_scale[structured topk {J//1000}k x {H//1000}k, "
+          f"{E} exc] p50={out['p50_ms']}ms p99={out['p99_ms']}ms "
+          f"(dense mask would need "
+          f"{J * H / 1e9:.0f} GB + {J * H * 4 / 1e9:.0f} GB scores)",
+          file=sys.stderr)
+    return out
+
+
 def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     """The PRODUCTION control loop end-to-end at scale: Store + columnar
     index -> FusedCycleDriver.step (structured mask, on-device considerable
@@ -673,6 +708,11 @@ def run_section(name: str) -> None:
         data = bench_driver_cycle(n_jobs=scaled(100_000),
                                   n_users=scaled(200, lo=8),
                                   H=scaled(5000))
+    elif name == "pallas_scale":
+        if platform != "tpu":
+            data = {"skipped": "tpu only (interpret mode would take hours)"}
+        else:
+            data = bench_pallas_scale(J=scaled(100_000), H=scaled(50_000))
     elif name == "end2end":
         data = {"samples_ms": bench_end2end(
             total=scaled(100_000), n_users=scaled(200, lo=8),
@@ -734,7 +774,8 @@ def main():
         tpu_error = os.environ["BENCH_TPU_ERROR"]
 
     sections = ["sync_floor", "rank", "match", "match_large", "fused_cycle",
-                "rebalance", "store_cycle", "driver_cycle", "end2end"]
+                "rebalance", "store_cycle", "driver_cycle", "pallas_scale",
+                "end2end"]
     results, platforms, errors = {}, {}, {}
     for name in sections:
         data, platform, err = _run_section_subproc(name)
@@ -795,6 +836,8 @@ def main():
         detail["store_cycle_100k_jobs"] = results["store_cycle"]
     if results.get("driver_cycle") is not None:
         detail["driver_cycle_100k_jobs"] = results["driver_cycle"]
+    if results.get("pallas_scale") is not None:
+        detail["pallas_structured_topk_100k_x_50k"] = results["pallas_scale"]
     if results.get("rebalance"):
         reb = results["rebalance"]["samples_ms"]
         detail["rebalance_1M_tasks_p50_ms"] = round(pctl(reb, 50), 3)

@@ -47,41 +47,53 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
-            out_fit_ref, out_host_ref, run_fit, run_host, *, n_res: int,
-            k: int, tile_h: int):
-    """One (job-tile, host-tile) grid step: score the tile, merge top-K."""
-    h = pl.program_id(1)
-    tj = cmask_ref.shape[0]
+def _pad_hosts(avail, capacity, hp: int, n_res: int):
+    """Pad the host axis: padded hosts get avail=-1 (nothing fits) and
+    capacity=1 (no divide-by-zero in the fitness)."""
+    h = avail.shape[0]
+    avail_p = jnp.full((hp, n_res), -1.0, jnp.float32).at[:h].set(avail)
+    cap_p = jnp.ones((hp, n_res), jnp.float32).at[:h].set(capacity)
+    return avail_p, cap_p
+
+
+def _resource_feasible(feas, res_ref, avail_t_ref, n_res: int):
+    """AND resource fit into ``feas``, unrolled over the static resource
+    axis so every op stays a 2-D [TJ, TH] VPU op."""
+    for r in range(n_res):
+        feas &= avail_t_ref[r:r + 1, :] >= res_ref[:, r:r + 1]
+    return feas
+
+
+def _binpack_score(feas, res_ref, avail_t_ref, cap_t_ref):
+    """cpuMemBinPacker fitness on resources 0 (cpus) and 1 (mem)
+    (config.clj:108), NEG_INF where infeasible."""
+    fit = jnp.zeros(feas.shape, dtype=jnp.float32)
+    for r in (0, 1):
+        cap_row = jnp.maximum(cap_t_ref[r:r + 1, :], 1e-9)
+        used_row = cap_t_ref[r:r + 1, :] - avail_t_ref[r:r + 1, :]
+        fit += (used_row + res_ref[:, r:r + 1]) / cap_row
+    return jnp.where(feas, fit * 0.5, NEG_INF)
+
+
+def _merge_running_topk(score, h, tile_h, run_fit, run_host,
+                        out_fit_ref, out_host_ref, k: int):
+    """Init (first host tile) / merge / emit (last host tile) of the
+    running per-job top-K carried across the sequential host grid.
+    SHARED by the dense and structured kernels — the tie-breaking merge
+    must never drift between them (their parity is test-asserted).
+
+    Previous top-K entries sit at positions < TH entries, and run_fit is
+    sorted descending, so "first position achieving the max" reproduces
+    lax.top_k's lowest-host-index tie-breaking exactly."""
+    tj = score.shape[0]
 
     @pl.when(h == 0)
     def _init():
         run_fit[:] = jnp.full((tj, k), NEG_INF, dtype=jnp.float32)
         run_host[:] = jnp.zeros((tj, k), dtype=jnp.int32)
 
-    # --- score this [TJ, TH] tile; unrolled over the static resource axis.
-    # The mask travels through HBM as int8 (1 byte/element); upcast in VMEM
-    # before comparing — Mosaic lacks vector i8 compares on this target.
-    feas = cmask_ref[:].astype(jnp.int32) > 0
-    for r in range(n_res):
-        need_col = res_ref[:, r:r + 1]            # [TJ, 1]
-        avail_row = avail_t_ref[r:r + 1, :]       # [1, TH]
-        feas &= avail_row >= need_col
-    # cpuMemBinPacker fitness on resources 0 (cpus) and 1 (mem)
-    fit = jnp.zeros(feas.shape, dtype=jnp.float32)
-    for r in (0, 1):
-        cap_row = jnp.maximum(cap_t_ref[r:r + 1, :], 1e-9)
-        used_row = cap_t_ref[r:r + 1, :] - avail_t_ref[r:r + 1, :]
-        fit += (used_row + res_ref[:, r:r + 1]) / cap_row
-    score = jnp.where(feas, fit * 0.5, NEG_INF)   # [TJ, TH]
-
-    tile_iota = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
-    host_idx = tile_iota + h * tile_h
-
-    # --- merge running top-K with this tile's scores.  Previous top-K
-    # entries sit at positions < TH entries, and run_fit is sorted
-    # descending, so "first position achieving the max" reproduces
-    # lax.top_k's lowest-host-index tie-breaking exactly.
+    host_idx = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1) \
+        + h * tile_h
     combined = jnp.concatenate([run_fit[:], score], axis=1)       # [TJ, K+TH]
     combined_idx = jnp.concatenate([run_host[:], host_idx], axis=1)
     pos = jax.lax.broadcasted_iota(jnp.int32, combined.shape, 1)
@@ -99,6 +111,20 @@ def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
     def _emit():
         out_fit_ref[:] = run_fit[:]
         out_host_ref[:] = run_host[:]
+
+
+def _kernel(res_ref, cmask_ref, avail_t_ref, cap_t_ref,
+            out_fit_ref, out_host_ref, run_fit, run_host, *, n_res: int,
+            k: int, tile_h: int):
+    """One (job-tile, host-tile) grid step: score the tile, merge top-K.
+    The mask travels through HBM as int8 (1 byte/element); upcast in VMEM
+    before comparing — Mosaic lacks vector i8 compares on this target."""
+    h = pl.program_id(1)
+    feas = _resource_feasible(cmask_ref[:].astype(jnp.int32) > 0,
+                              res_ref, avail_t_ref, n_res)
+    score = _binpack_score(feas, res_ref, avail_t_ref, cap_t_ref)
+    _merge_running_topk(score, h, tile_h, run_fit, run_host,
+                        out_fit_ref, out_host_ref, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "tile_j", "tile_h",
@@ -133,6 +159,130 @@ def _topk_prefs_padded(job_res, cmask_i8, avail_t, cap_t, *, k: int,
     )(job_res, cmask_i8, avail_t, cap_t)
 
 
+def _structured_kernel(res_ref, valid_ref, eid_ref, hostgpu_ref, hostok_ref,
+                       exc_ref, avail_t_ref, cap_t_ref,
+                       out_fit_ref, out_host_ref, run_fit, run_host, *,
+                       n_res: int, k: int, tile_h: int):
+    """Like _kernel, but the constraint mask is COMPOSED IN VMEM from the
+    structured form (host vectors + exception rows) — no [J, H] array ever
+    exists, in HBM or anywhere: gpu bidirectional isolation from the job's
+    gpu demand column, host blocks from a [1, TH] vector, and exception
+    rows selected with a one-hot [TJ, E] x [E, TH] matmul (MXU-friendly;
+    per-row dynamic gathers are not)."""
+    h = pl.program_id(1)
+    tj = res_ref.shape[0]
+    n_exc = exc_ref.shape[0]
+
+    # mask algebra stays in i32 end-to-end: Mosaic rejects i1-vector
+    # selects ("unsupported target bitwidth for truncation"), so select is
+    # expressed as 0/1 arithmetic
+    gpu_i = (res_ref[:, 2:3] > 0.0).astype(jnp.int32)         # [TJ, 1]
+    hg_i = hostgpu_ref[:].astype(jnp.int32)                   # [1, TH]
+    base_i = (gpu_i * hg_i + (1 - gpu_i) * (1 - hg_i)) \
+        * hostok_ref[:].astype(jnp.int32)                     # [TJ, TH]
+    eid = eid_ref[:]                                          # [TJ, 1]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (tj, n_exc), 1)
+              == eid).astype(jnp.float32)
+    exc_i = (jnp.dot(onehot, exc_ref[:].astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+             > 0.5).astype(jnp.int32)
+    has_exc = (eid >= 0).astype(jnp.int32)                    # [TJ, 1]
+    feas_i = (has_exc * exc_i + (1 - has_exc) * base_i) \
+        * valid_ref[:].astype(jnp.int32)
+    feas = _resource_feasible(feas_i > 0, res_ref, avail_t_ref, n_res)
+    score = _binpack_score(feas, res_ref, avail_t_ref, cap_t_ref)
+    _merge_running_topk(score, h, tile_h, run_fit, run_host,
+                        out_fit_ref, out_host_ref, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile_j", "tile_h",
+                                             "interpret"))
+def _topk_structured_padded(job_res, valid_i8, exc_id, host_gpu_i8,
+                            host_ok_i8, exc_i8, avail_t, cap_t, *, k: int,
+                            tile_j: int, tile_h: int, interpret: bool):
+    jp, n_res = job_res.shape
+    hp = avail_t.shape[1]
+    n_exc = exc_i8.shape[0]
+    grid = (jp // tile_j, hp // tile_h)
+    kernel = functools.partial(_structured_kernel, n_res=n_res, k=k,
+                               tile_h=tile_h)
+    out_shape = (jax.ShapeDtypeStruct((jp, k), jnp.float32),
+                 jax.ShapeDtypeStruct((jp, k), jnp.int32))
+    mem = {"memory_space": pltpu.VMEM}
+    scratch = [pltpu.VMEM((tile_j, k), jnp.float32),
+               pltpu.VMEM((tile_j, k), jnp.int32)]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_j, n_res), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((tile_j, 1), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((tile_j, 1), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((1, tile_h), lambda j, h: (0, h), **mem),
+            pl.BlockSpec((1, tile_h), lambda j, h: (0, h), **mem),
+            pl.BlockSpec((n_exc, tile_h), lambda j, h: (0, h), **mem),
+            pl.BlockSpec((n_res, tile_h), lambda j, h: (0, h), **mem),
+            pl.BlockSpec((n_res, tile_h), lambda j, h: (0, h), **mem),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile_j, k), lambda j, h: (j, 0), **mem),
+            pl.BlockSpec((tile_j, k), lambda j, h: (j, 0), **mem),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(job_res, valid_i8, exc_id, host_gpu_i8, host_ok_i8, exc_i8,
+      avail_t, cap_t)
+
+
+def topk_prefs_structured(job_res: jax.Array, valid: jax.Array,
+                          host_gpu: jax.Array, host_blocked: jax.Array,
+                          exc_id: jax.Array, exc_mask: jax.Array,
+                          avail: jax.Array, capacity: jax.Array,
+                          k: int = 16, *, tile_j: int = 128,
+                          tile_h: int = 128,
+                          interpret: Optional[bool] = None
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Top-K host preferences from the STRUCTURED constraint-mask form
+    (parallel/sharded.StructuredPoolCycleInputs semantics: per-host gpu /
+    blocked vectors + full exception rows for the complex-job minority).
+
+    Unlike :func:`topk_prefs`, no [J, H] array exists anywhere — not even
+    as an input — so this is the preference build that actually runs at
+    the BASELINE scale (1M x 50k would need a 50 GB mask input otherwise).
+    Total HBM traffic: O(J*R + H + E*H + J*K).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    j, n_res = job_res.shape
+    h = avail.shape[0]
+    e = exc_mask.shape[0]
+    k = min(k, h)
+    jp, hp = _cdiv(j, tile_j) * tile_j, _cdiv(h, tile_h) * tile_h
+    # exceptions pad to a full 128 lane group: the one-hot selector's
+    # [TJ, E] shape needs a lane-aligned E for Mosaic, and the [E, TH]
+    # block rides the MXU as a matmul operand
+    ep = max(128, _cdiv(e, 128) * 128)
+
+    job_res_p = jnp.zeros((jp, n_res), jnp.float32).at[:j].set(job_res)
+    valid_p = jnp.zeros((jp, 1), jnp.int8).at[:j, 0].set(
+        valid.astype(jnp.int8))
+    eid_p = jnp.full((jp, 1), -1, jnp.int32).at[:j, 0].set(exc_id)
+    hg_p = jnp.zeros((1, hp), jnp.int8).at[0, :h].set(
+        host_gpu.astype(jnp.int8))
+    # padded hosts stay blocked (ok=0); real hosts ok unless blocked
+    hok_p = jnp.zeros((1, hp), jnp.int8).at[0, :h].set(
+        (~host_blocked).astype(jnp.int8))
+    exc_p = jnp.zeros((ep, hp), jnp.int8).at[:e, :h].set(
+        exc_mask.astype(jnp.int8))
+    avail_p, cap_p = _pad_hosts(avail, capacity, hp, n_res)
+
+    fit, host = _topk_structured_padded(
+        job_res_p, valid_p, eid_p, hg_p, hok_p, exc_p, avail_p.T, cap_p.T,
+        k=k, tile_j=tile_j, tile_h=tile_h, interpret=bool(interpret))
+    return fit[:j], host[:j]
+
+
 def topk_prefs(job_res: jax.Array, constraint_mask: jax.Array,
                valid: jax.Array, avail: jax.Array, capacity: jax.Array,
                k: int = 16, *, tile_j: int = 128, tile_h: int = 128,
@@ -159,9 +309,7 @@ def topk_prefs(job_res: jax.Array, constraint_mask: jax.Array,
     cmask_i8 = jnp.zeros((jp, hp), jnp.int8).at[:j, :h].set(
         cmask.astype(jnp.int8))
     job_res_p = jnp.zeros((jp, n_res), jnp.float32).at[:j].set(job_res)
-    # padded hosts: avail = -1 so nothing fits them, capacity = 1
-    avail_p = jnp.full((hp, n_res), -1.0, jnp.float32).at[:h].set(avail)
-    cap_p = jnp.ones((hp, n_res), jnp.float32).at[:h].set(capacity)
+    avail_p, cap_p = _pad_hosts(avail, capacity, hp, n_res)
 
     fit, host = _topk_prefs_padded(
         job_res_p, cmask_i8, avail_p.T, cap_p.T, k=k, tile_j=tile_j,

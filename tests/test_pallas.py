@@ -68,6 +68,49 @@ def test_topk_prefs_tie_breaking_lowest_host():
                                   np.asarray(ref_host)[finite])
 
 
+@pytest.mark.parametrize("J,H,E,k", [
+    (128, 128, 4, 8),     # one tile
+    (300, 520, 7, 16),    # ragged + multiple host tiles
+    (200, 130, 0, 8),     # no exceptions at all
+])
+def test_topk_prefs_structured_matches_dense(J, H, E, k):
+    """The structured-mask kernel (per-host vectors + exception rows
+    composed in VMEM) must equal the dense kernel on the equivalent dense
+    mask — gpu isolation, blocks, exceptions, validity, padding."""
+    rng = np.random.default_rng(J + H * 7 + E)
+    job_res = rng.uniform(0.1, 4.0, (J, 4)).astype(np.float32)
+    job_res[:, 2] = (rng.random(J) < 0.2).astype(np.float32)  # gpu demand
+    capacity = rng.uniform(8.0, 64.0, (H, 4)).astype(np.float32)
+    capacity[:, 2] = (rng.random(H) < 0.3) * 4.0              # gpu hosts
+    avail = (capacity * rng.uniform(0.0, 1.0, (H, 4))).astype(np.float32)
+    host_gpu = capacity[:, 2] > 0
+    host_blocked = rng.random(H) < 0.15
+    valid = rng.random(J) < 0.9
+    exc_id = np.full(J, -1, np.int32)
+    exc_mask = np.zeros((max(E, 1), H), dtype=bool)
+    if E:
+        rows = rng.choice(J, size=E, replace=False)
+        exc_id[rows] = np.arange(E, dtype=np.int32)
+        exc_mask = rng.random((E, H)) < 0.5
+    dense = np.where(job_res[:, 2:3] > 0, host_gpu[None, :],
+                     ~host_gpu[None, :]) & ~host_blocked[None, :]
+    for kk in range(E):
+        dense[np.flatnonzero(exc_id == kk)[0]] = exc_mask[kk]
+
+    ref_fit, ref_host = pallas_match.topk_prefs(
+        jnp.asarray(job_res), jnp.asarray(dense), jnp.asarray(valid),
+        jnp.asarray(avail), jnp.asarray(capacity), k=k, interpret=True)
+    fit, host = pallas_match.topk_prefs_structured(
+        jnp.asarray(job_res), jnp.asarray(valid), jnp.asarray(host_gpu),
+        jnp.asarray(host_blocked), jnp.asarray(exc_id),
+        jnp.asarray(exc_mask), jnp.asarray(avail), jnp.asarray(capacity),
+        k=k, interpret=True)
+    np.testing.assert_array_equal(np.asarray(fit), np.asarray(ref_fit))
+    finite = np.asarray(ref_fit) > -np.inf
+    np.testing.assert_array_equal(np.asarray(host)[finite],
+                                  np.asarray(ref_host)[finite])
+
+
 def test_auction_match_pallas_equals_xla_auction():
     rng = np.random.default_rng(11)
     job_res, cmask, valid, avail, capacity = _rand_problem(rng, 160, 140)
