@@ -592,9 +592,10 @@ class FusedCycleDriver:
         cand_pos = np.flatnonzero(match_valid)
         result.considered = len(cand_pos)
         if pp.columnar:
+            fetched = self.store.jobs_bulk(
+                [pp.uuids[order[i]] for i in cand_pos])
             cand_jobs, cand_keep = [], []
-            for i in cand_pos:
-                job = self.store.job(pp.uuids[order[i]])
+            for i, job in zip(cand_pos, fetched):
                 if job is not None:
                     cand_jobs.append(job)
                     cand_keep.append(i)

@@ -616,6 +616,14 @@ class Store:
             job = self._jobs.get(uuid)
             return copy.deepcopy(job) if job is not None else None
 
+    def jobs_bulk(self, uuids) -> List[Optional[Job]]:
+        """Deep-copied reads of many jobs under ONE lock acquisition (the
+        per-cycle considerable-prefix materialization does ~1000 reads;
+        per-call locking costs more than the copies)."""
+        with self._lock:
+            return [copy.deepcopy(j) if (j := self._jobs.get(u)) is not None
+                    else None for u in uuids]
+
     # -- borrowed reads -----------------------------------------------------
     # Commits install whole replacement objects (transact's write loop), so
     # a borrowed reference is always a complete, never-again-mutated entity.
