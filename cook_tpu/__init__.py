@@ -20,6 +20,11 @@ is implemented as jitted, batched JAX/XLA computations:
 
 The control plane (transactional store, state machines, cluster backends, REST,
 policy) stays host-side, mirroring the reference's layer map (SURVEY.md section 1).
+
+Clients and integrations: ``cook_tpu.client`` (Python JobClient),
+``cook_tpu.native.jobclient`` (the embeddable C++ client, ctypes-bound),
+``cook_tpu.cli`` (the ``cs`` command line), and ``cook_tpu.ecosystem``
+(ServiceFarm fleets + the dask CookCluster backend).
 """
 
 __version__ = "0.1.0"
