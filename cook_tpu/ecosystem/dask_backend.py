@@ -59,10 +59,16 @@ class CookCluster:
         self._scheduler_address: Optional[str] = None
         wspec = dict(worker_spec or {"cpus": 1.0, "mem": 2048.0})
         wspec.setdefault("name", f"{name}-worker")
+        # one host port per worker, bound as its dask listening port: the
+        # instance's recorded ports then equal the port in the address dask
+        # hands back to scale_down, so co-located workers are
+        # distinguishable (a hostname-only match would kill the wrong one)
+        wspec.setdefault("ports", 1)
         self._worker_cmd = worker_cmd
         self._workers = ServiceFarm(
             client, f"{name}-workers",
-            lambda i: f"{worker_cmd} {self._address_placeholder()}",
+            lambda i: (f"{worker_cmd} {self._address_placeholder()}"
+                       " --worker-port ${PORT0:-0}"),
             spec=wspec, pool=pool)
         self._adaptive = None
 
@@ -128,20 +134,54 @@ class CookCluster:
 
     def scale_down(self, workers):  # pragma: no cover - requires dask
         """Adaptive hands back dask worker ADDRESSES (tcp://host:port);
-        map them to farm job uuids via each job's latest instance host
-        before killing."""
-        hosts = set()
+        map them to farm job uuids via each job's latest instance before
+        killing.  Two workers can share one host, so a plain hostname
+        match would kill the whole host's fleet when one worker is
+        retired: prefer an exact (host, port) match against the
+        instance's assigned ports, and otherwise kill at most as many
+        co-located members as addresses were requested for that host
+        (newest first)."""
+        want = {}  # host -> list of requested ports (None = unknown)
         for w in workers:
             addr = str(w)
             if "://" in addr:
                 addr = addr.split("://", 1)[1]
-            hosts.add(addr.rsplit(":", 1)[0])
-        doomed = []
+            host, _, port = addr.rpartition(":")
+            if not host:
+                host, port = addr, ""
+            want.setdefault(host, []).append(
+                int(port) if port.isdigit() else None)
+        by_host = {}  # host -> [(farm_index, uuid, instance_ports)]
+        idx_of = dict(zip(self._workers.fleet(),
+                          range(len(self._workers.fleet()))))
         for j in self.client.query(self._workers.fleet()):
             insts = j.get("instances") or []
-            if insts and insts[-1].get("hostname") in hosts \
-                    and j.get("state") != "completed":
-                doomed.append(j["uuid"])
+            if not insts or j.get("state") == "completed":
+                continue
+            inst = insts[-1]
+            host = inst.get("hostname")
+            if host in want:
+                by_host.setdefault(host, []).append(
+                    (idx_of.get(j["uuid"], 0), j["uuid"],
+                     set(inst.get("ports") or [])))
+        doomed = []
+        for host, ports in want.items():
+            cands = sorted(by_host.get(host, []), reverse=True)  # newest 1st
+            # two passes: every exact port match claims its worker FIRST, so
+            # an unknown-port address's fallback can never steal (then
+            # cascade onto) a worker another address names exactly
+            unmatched = []
+            for port in ports:
+                hit = next((c for c in cands
+                            if port is not None and port in c[2]), None)
+                if hit is not None:
+                    cands.remove(hit)
+                    doomed.append(hit[1])
+                else:
+                    unmatched.append(port)
+            for _ in unmatched:
+                if cands:
+                    doomed.append(cands.pop(0)[1])  # newest co-resident
         self._workers.kill_members(doomed)
 
     def workers_status(self) -> Dict[str, str]:

@@ -41,15 +41,31 @@ class ServiceFarm:
     # ------------------------------------------------------------- adoption
     def _adopt(self) -> None:
         """Re-adopt jobs labeled for this farm that are still alive (a
-        client restart must not leak a running fleet)."""
-        try:
-            # filter by the submitting user: two users may run same-named
-            # farms, and one must never adopt (then kill) the other's fleet
-            jobs = self.client.jobs(
-                user=getattr(self.client, "user", None),
-                states=["waiting", "running"])
-        except Exception:
-            return
+        client restart must not leak a running fleet).
+
+        A transient listing failure here would silently skip adoption and
+        make the restarted client double-submit over a leaked fleet — the
+        exact bug adoption exists to prevent — so the listing is retried
+        and a persistent failure raises instead of returning quietly.
+        """
+        last_err = None
+        for attempt in range(5):
+            try:
+                # filter by the submitting user: two users may run
+                # same-named farms, and one must never adopt (then kill)
+                # the other's fleet
+                jobs = self.client.jobs(
+                    user=getattr(self.client, "user", None),
+                    states=["waiting", "running"])
+                break
+            except Exception as e:
+                last_err = e
+                time.sleep(min(0.25 * (2 ** attempt), 2.0))
+        else:
+            raise RuntimeError(
+                f"ServiceFarm {self.name!r}: could not list jobs to "
+                f"re-adopt the fleet ({last_err}); refusing to start "
+                "blind (would double-submit over a leaked fleet)")
         for j in jobs:
             labels = j.get("labels") or {}
             if labels.get(FARM_LABEL) == self.name:

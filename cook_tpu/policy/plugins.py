@@ -173,6 +173,15 @@ class PluginRegistry:
             return None
         return cached.status == "accepted"
 
+    def cache_launch_verdict(self, uuid: str, allowed: bool,
+                             ttl_s: float = 60.0) -> None:
+        """Record a verdict without materializing a Job (used for rows whose
+        entity has vanished from the store but not yet from the index)."""
+        r = (PluginResult.accepted() if allowed
+             else PluginResult.rejected("cached"))
+        r.cache_expires_at_s = time.time() + ttl_s
+        self._launch_cache[uuid] = r
+
     def launch_allowed(self, job: Job) -> bool:
         """Cached accept/defer check used by considerable-job selection."""
         if not self.launch_filters:

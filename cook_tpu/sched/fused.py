@@ -254,8 +254,17 @@ class FusedCycleDriver:
                 cached = self.plugins.launch_verdict_cached(uuid)
                 if cached is None:
                     job = store.job(uuid)
-                    cached = (job is None
-                              or self.plugins.launch_allowed(job))
+                    if job is None:
+                        # vanished-but-still-indexed uuid: cache a synthetic
+                        # accept so the next cycle stays copy-free instead
+                        # of re-missing and re-fetching forever.  Short TTL:
+                        # if the uuid re-materializes (store swap race) the
+                        # real filters re-run within seconds, not 60s
+                        self.plugins.cache_launch_verdict(uuid, True,
+                                                          ttl_s=5.0)
+                        cached = True
+                    else:
+                        cached = self.plugins.launch_allowed(job)
                 if not cached:
                     launch_ok[i] = False
         pp.launch_ok = launch_ok

@@ -78,6 +78,21 @@ struct JsonScan {
 
     void ws() { while (i < s.size() && std::isspace((unsigned char)s[i])) i++; }
 
+    // parse 4 hex digits at absolute offset p (bounds already checked)
+    bool hex4(size_t p, unsigned* out) {
+        unsigned cp = 0;
+        for (size_t k = 0; k < 4; k++) {
+            char h = s[p + k];
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= h - '0';
+            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            else return false;
+        }
+        *out = cp;
+        return true;
+    }
+
     bool parse_string(std::string* out) {
         ws();
         if (i >= s.size() || s[i] != '"') return false;
@@ -89,7 +104,55 @@ struct JsonScan {
                 switch (s[i]) {
                     case 'n': r += '\n'; break;
                     case 't': r += '\t'; break;
-                    case 'u': i += 4; r += '?'; break;  // keep scanning
+                    case 'u': {
+                        // a truncated \uXX escape at end-of-buffer must not
+                        // skip past the closing quote (that would fail the
+                        // whole object parse and drop trailing jobs)
+                        if (i + 4 >= s.size()) { i = s.size(); return false; }
+                        unsigned cp;
+                        if (!hex4(i + 1, &cp)) {
+                            // invalid hex: consume nothing beyond the 'u' so
+                            // a malformed escape mid-buffer cannot swallow
+                            // the closing quote and desynchronize the scan
+                            r += '?';
+                            break;
+                        }
+                        i += 4;
+                        if (cp >= 0xD800 && cp <= 0xDBFF) {
+                            // high surrogate: a compliant \uDC00-\uDFFF pair
+                            // follows for every non-BMP char (emoji etc.)
+                            unsigned lo;
+                            if (i + 6 < s.size() && s[i + 1] == '\\'
+                                && s[i + 2] == 'u' && hex4(i + 3, &lo)
+                                && lo >= 0xDC00 && lo <= 0xDFFF) {
+                                i += 6;
+                                cp = 0x10000 + ((cp - 0xD800) << 10)
+                                     + (lo - 0xDC00);
+                            } else {
+                                r += '?';  // unpaired high surrogate
+                                break;
+                            }
+                        } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+                            r += '?';  // unpaired low surrogate
+                            break;
+                        }
+                        if (cp < 0x80) {
+                            r += (char)cp;
+                        } else if (cp < 0x800) {
+                            r += (char)(0xC0 | (cp >> 6));
+                            r += (char)(0x80 | (cp & 0x3F));
+                        } else if (cp < 0x10000) {
+                            r += (char)(0xE0 | (cp >> 12));
+                            r += (char)(0x80 | ((cp >> 6) & 0x3F));
+                            r += (char)(0x80 | (cp & 0x3F));
+                        } else {
+                            r += (char)(0xF0 | (cp >> 18));
+                            r += (char)(0x80 | ((cp >> 12) & 0x3F));
+                            r += (char)(0x80 | ((cp >> 6) & 0x3F));
+                            r += (char)(0x80 | (cp & 0x3F));
+                        }
+                        break;
+                    }
                     default: r += s[i];
                 }
             } else {
