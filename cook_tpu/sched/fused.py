@@ -585,12 +585,14 @@ class FusedCycleDriver:
                 keep[drop_qpos] = False
             rows = ranked_rows if keep is None else ranked_rows[keep]
             if pp.columnar:
-                # lazy queue over uuid/resource columns: consumers
-                # materialize only the prefix they touch (RankedQueue)
+                # lazy queue over uuid/resource BASE columns + row
+                # selection: consumers materialize only the prefix they
+                # touch; full-column gathers happen only if someone reads
+                # .uuids/.resources/.users (RankedQueue)
                 from .ranker import RankedQueue
                 queues[pool_name] = RankedQueue(
-                    self.store, pp.uuids[rows],
-                    pp.arrays["usage"][rows], pp.users_sorted[rows])
+                    self.store, pp.uuids, pp.arrays["usage"],
+                    pp.users_sorted, rows=rows)
             else:
                 queues[pool_name] = [pp.id2job[pp.task_ids[r]]
                                      for r in rows]

@@ -78,24 +78,69 @@ class RankedQueue:
     iteration, indexing and slicing (a slice returns materialized Jobs)."""
 
     def __init__(self, store: Store, uuids: np.ndarray,
-                 resources: np.ndarray, users: Optional[np.ndarray] = None):
+                 resources: np.ndarray, users: Optional[np.ndarray] = None,
+                 rows: Optional[np.ndarray] = None):
+        """With ``rows`` given, ``uuids``/``resources``/``users`` are BASE
+        columns and the queue is their ``rows`` selection, gathered lazily:
+        the production cycle publishes a ~100k-row queue every cycle, and
+        consumers that only touch a prefix (matcher, /queue page) should
+        not pay three full-column gathers per cycle."""
         self.store = store
-        self.uuids = uuids
-        self.resources = resources  # f32[n, 4] in ranked order
-        self.users = (users if users is not None
-                      else np.zeros(len(uuids), dtype="<U64"))
+        self._rows = rows
+        self._uuids = uuids
+        self._resources = resources  # f32[n, 4] in ranked order
+        self._users = users
+        self._n = len(uuids) if rows is None else len(rows)
+        # materialization guard: the queue is read concurrently by the
+        # rebalancer thread and REST handlers; an unguarded lazy gather
+        # would let a reader observe half-swapped columns
+        self._mat_lock = __import__("threading").Lock()
+
+    @property
+    def uuids(self) -> np.ndarray:
+        with self._mat_lock:
+            if self._rows is not None:
+                rows = self._rows
+                uuids = self._uuids[rows]
+                users = (np.zeros(self._n, dtype="<U64")
+                         if self._users is None else self._users[rows])
+                resources = self._resources[rows]
+                # publish fully-formed columns, then drop rows last
+                self._uuids, self._users, self._resources = \
+                    uuids, users, resources
+                self._rows = None
+            return self._uuids
+
+    @property
+    def resources(self) -> np.ndarray:
+        self.uuids  # materialize
+        return self._resources
+
+    @property
+    def users(self) -> np.ndarray:
+        self.uuids  # materialize
+        return self._users if self._users is not None \
+            else np.zeros(self._n, dtype="<U64")
 
     def __len__(self) -> int:
-        return len(self.uuids)
+        return self._n
 
     def __bool__(self) -> bool:
-        return len(self.uuids) > 0
+        return self._n > 0
+
+    def _uuid_at(self, i):
+        """uuid(s) at queue position(s) without materializing the whole
+        selection (a prefix touch stays O(prefix))."""
+        with self._mat_lock:
+            if self._rows is not None:
+                return self._uuids[self._rows[i]]
+            return self._uuids[i]
 
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return [j for j in (self.store.job(u) for u in self.uuids[i])
+            return [j for j in (self.store.job(u) for u in self._uuid_at(i))
                     if j is not None]
-        return self.store.job(self.uuids[i])
+        return self.store.job(self._uuid_at(i))
 
     def __iter__(self):
         for u in self.uuids:

@@ -77,6 +77,17 @@ class Scheduler:
         self._threads: List[threading.Thread] = []
         # fused production cycle driver, created lazily on first step_cycle
         self._fused = None
+        # GC discipline for the production cycle: with 100k+ live entities
+        # the interpreter's automatic gen2 collections (full scans of a
+        # multi-million-object heap) land mid-cycle and double the p99.
+        # step_cycle pauses automatic collection for its duration and
+        # schedules a proactive collect + freeze OUTSIDE the cycle (in
+        # flush_status_updates / the next idle point).  Entities are
+        # acyclic, so ordinary refcounting frees them regardless; the
+        # cycle-collector is only needed for rare cyclic garbage.
+        self.gc_discipline = True
+        self._gc_cycles = 0
+        self._gc_collect_due = False
         # Side-effect worker: cluster kills requested from a thread that
         # already holds that cluster's kill-lock read side (e.g. a tx-event
         # delivered during a launch) must run elsewhere or they self-deadlock.
@@ -131,6 +142,19 @@ class Scheduler:
     def flush_status_updates(self) -> None:
         if self._status_queue is not None:
             self._status_queue.flush()
+        self.maintain_gc()
+
+    def maintain_gc(self) -> None:
+        """Proactive full collection at an idle point (see gc_discipline in
+        __init__): freeze afterwards so the stable entity heap is never
+        re-scanned — acyclic entities free by refcount anyway.  Called by
+        the production cycle loop after each step_cycle and by
+        flush_status_updates (tests/bench pacing)."""
+        if self._gc_collect_due:
+            self._gc_collect_due = False
+            import gc
+            gc.collect()
+            gc.freeze()
 
     def _on_tx_events(self, tx_id: int, events) -> None:
         """Kill live instances of jobs that reached completed — covers user
@@ -244,8 +268,21 @@ class Scheduler:
             self._fused = FusedCycleDriver(
                 self.store, self.config, self.matcher, self.plugins,
                 self.rate_limits)
-        with tracing.span("fused.cycle"):
-            queues, results = self._fused.step(self)
+        import gc
+        gc_paused = self.gc_discipline and gc.isenabled()
+        if gc_paused:
+            gc.disable()
+        try:
+            with tracing.span("fused.cycle"):
+                queues, results = self._fused.step(self)
+        finally:
+            if gc_paused:
+                gc.enable()
+                self._gc_cycles += 1
+                # collect after the FIRST cycle (freeze the heap the
+                # warm-up built) and then every 10th
+                if self._gc_cycles == 1 or self._gc_cycles % 10 == 0:
+                    self._gc_collect_due = True
         # direct pools: host rank + backpressure submission
         for pool in self.store.pools():
             if pool.state != "active" or pool.scheduler is not SchedulerKind.DIRECT:
@@ -516,8 +553,12 @@ class Scheduler:
                     logging.getLogger(__name__).exception("cycle failed")
 
         if cfg.cycle_mode == "fused" and self.ranker.backend != "cpu":
-            # production path: one fused rank+match dispatch per cycle
-            specs = [(cfg.match_interval_seconds, self.step_cycle)]
+            # production path: one fused rank+match dispatch per cycle,
+            # followed by the idle-point GC maintenance (gc_discipline)
+            def fused_tick():
+                self.step_cycle()
+                self.maintain_gc()
+            specs = [(cfg.match_interval_seconds, fused_tick)]
         else:
             specs = [(cfg.rank_interval_seconds, self.step_rank),
                      (cfg.match_interval_seconds, self.step_match)]

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from . import machines
 from .schema import (
     Application,
+    fast_clone,
     Checkpoint,
     CheckpointMode,
     Constraint,
@@ -50,6 +51,11 @@ from .schema import (
     now_ms,
     to_json,
 )
+
+
+class StaleEpochError(RuntimeError):
+    """A deposed leader attempted to touch a journal another leader has
+    fenced at a higher election epoch."""
 
 
 class AbortTransaction(Exception):
@@ -95,7 +101,7 @@ class _Txn:
         # Reads are deep-copied too: a transaction fn mutating a read-returned
         # entity must not leak into the store outside the write log (the
         # all-or-nothing guarantee would silently break on abort otherwise).
-        ent = copy.deepcopy(ent)
+        ent = fast_clone(ent)
         if for_write:
             self._writes[wk] = ent
         return ent
@@ -185,6 +191,14 @@ class Store:
         self._journal_dir: Optional[str] = None
         self._journal_fsync = False
         self._journal_poisoned = False
+        # election-epoch fencing for a SHARED journal directory (the
+        # reference's Datomic transactor is a networked store any new
+        # leader re-reads, mesos.clj:153-328; here the journal dir is the
+        # shared medium, so a deposed-but-alive leader must be prevented
+        # from appending records a successor would replay)
+        self._journal_epoch: Optional[int] = None
+        self._epoch_path: Optional[str] = None
+        self._epoch_stat: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
@@ -228,7 +242,11 @@ class Store:
         only repairs a torn TAIL, so writing anything after an unexcised
         fragment would silently discard it and everything later on replay.
         """
+        if self._journal_epoch is not None:
+            self._check_fence()
         rec: Dict[str, Any] = {"tx": self._tx_id}
+        if self._journal_epoch is not None:
+            rec["ep"] = self._journal_epoch
         if txn._writes:
             rec["w"] = {f"{table}/{key}": to_json(ent)
                         for (table, key), ent in txn._writes.items()}
@@ -249,6 +267,12 @@ class Store:
                 os.fsync(f.fileno())
         except Exception:
             try:
+                if self._journal_epoch is not None:
+                    # SHARED journal: our tell() may be stale (a successor
+                    # could have appended past it) — truncating would chop
+                    # its records.  Poison instead; replay's torn-tail and
+                    # stale-epoch handling repair the file on next open.
+                    raise OSError("fenced journal: no truncate")
                 f.seek(good_offset)
                 f.truncate(good_offset)
             except Exception:
@@ -319,11 +343,11 @@ class Store:
                     merged = txn.group_w(group.uuid)
                     merged.jobs.extend(j for j in group.jobs if j not in merged.jobs)
                 else:
-                    txn.put("groups", group.uuid, copy.deepcopy(group))
+                    txn.put("groups", group.uuid, fast_clone(group))
             for job in jobs:
                 if txn.job(job.uuid) is not None:
                     txn.abort(f"duplicate job uuid {job.uuid}")
-                job = copy.deepcopy(job)
+                job = fast_clone(job)
                 if not job.submit_time_ms:
                     job.submit_time_ms = self.clock()
                 job.last_waiting_start_ms = job.submit_time_ms
@@ -614,14 +638,14 @@ class Store:
     def job(self, uuid: str) -> Optional[Job]:
         with self._lock:
             job = self._jobs.get(uuid)
-            return copy.deepcopy(job) if job is not None else None
+            return fast_clone(job) if job is not None else None
 
     def jobs_bulk(self, uuids) -> List[Optional[Job]]:
         """Deep-copied reads of many jobs under ONE lock acquisition (the
         per-cycle considerable-prefix materialization does ~1000 reads;
         per-call locking costs more than the copies)."""
         with self._lock:
-            return [copy.deepcopy(j) if (j := self._jobs.get(u)) is not None
+            return [fast_clone(j) if (j := self._jobs.get(u)) is not None
                     else None for u in uuids]
 
     # -- borrowed reads -----------------------------------------------------
@@ -640,16 +664,16 @@ class Store:
     def instance(self, task_id: str) -> Optional[Instance]:
         with self._lock:
             inst = self._instances.get(task_id)
-            return copy.deepcopy(inst) if inst is not None else None
+            return fast_clone(inst) if inst is not None else None
 
     def group(self, uuid: str) -> Optional[Group]:
         with self._lock:
             g = self._groups.get(uuid)
-            return copy.deepcopy(g) if g is not None else None
+            return fast_clone(g) if g is not None else None
 
     def jobs_where(self, pred: Callable[[Job], bool]) -> List[Job]:
         with self._lock:
-            return [copy.deepcopy(j) for j in self._jobs.values()
+            return [fast_clone(j) for j in self._jobs.values()
                     if j.committed and pred(j)]
 
     def pending_jobs(self, pool: Optional[str] = None) -> List[Job]:
@@ -672,7 +696,7 @@ class Store:
                 job = self._jobs.get(inst.job_uuid)
                 if job is None or (pool is not None and job.pool != pool):
                     continue
-                out.append((copy.deepcopy(job), copy.deepcopy(inst)))
+                out.append((fast_clone(job), fast_clone(inst)))
             return out
 
     def user_usage(self, pool: Optional[str] = None) -> Dict[str, Dict[str, float]]:
@@ -693,12 +717,12 @@ class Store:
 
     def pools(self) -> List[Pool]:
         with self._lock:
-            return [copy.deepcopy(p) for p in self._pools.values()]
+            return [fast_clone(p) for p in self._pools.values()]
 
     def pool(self, name: str) -> Optional[Pool]:
         with self._lock:
             p = self._pools.get(name)
-            return copy.deepcopy(p) if p is not None else None
+            return fast_clone(p) if p is not None else None
 
     def set_share(self, user: str, pool: str, resources: Dict[str, float],
                   reason: str = "") -> None:
@@ -792,6 +816,64 @@ class Store:
         store._latches = {k: list(v) for k, v in state.get("latches", {}).items()}
         return store
 
+    # ------------------------------------------------------- epoch fencing
+    def _check_fence(self) -> None:
+        """Refuse the append when another leader has claimed a higher epoch
+        (caller holds the store lock).  One os.stat per append; the epoch
+        file is only re-read when its (mtime_ns, ino) changed."""
+        try:
+            st = os.stat(self._epoch_path)
+            sig = (st.st_mtime_ns, st.st_ino)
+        except FileNotFoundError:
+            return  # nobody has fenced (or fence file removed): allow
+        if sig == self._epoch_stat:
+            return
+        self._epoch_stat = sig
+        current = self._read_epoch_file()
+        if current is not None and current > self._journal_epoch:
+            # deposed: poison so no later append can slip through either
+            f, self._journal_file = self._journal_file, None
+            self._journal_poisoned = True
+            try:
+                if f is not None:
+                    f.close()
+            except Exception:
+                pass
+            raise StaleEpochError(
+                f"journal fenced at epoch {current}; this leader holds "
+                f"epoch {self._journal_epoch}")
+
+    def _read_epoch_file(self) -> Optional[int]:
+        try:
+            with open(self._epoch_path, encoding="utf-8") as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return None
+
+    def _claim_epoch(self, directory: str, epoch) -> int:
+        """Claim leadership of the journal dir at ``epoch`` ("auto" = one
+        above the current fence).  Raises StaleEpochError when a higher
+        epoch is already fenced."""
+        self._epoch_path = os.path.join(directory, "epoch")
+        current = self._read_epoch_file() or 0
+        if epoch == "auto":
+            epoch = current + 1
+        epoch = int(epoch)
+        if current > epoch:
+            raise StaleEpochError(
+                f"journal dir fenced at epoch {current} > claimed {epoch}")
+        if epoch > current:
+            tmp = self._epoch_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(epoch))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._epoch_path)
+        st = os.stat(self._epoch_path)
+        self._epoch_stat = (st.st_mtime_ns, st.st_ino)
+        self._journal_epoch = epoch
+        return epoch
+
     # ------------------------------------------------------- durable journal
     def attach_journal(self, path: str, fsync: bool = False) -> None:
         """Start appending every committed transaction to ``path`` as one
@@ -803,11 +885,20 @@ class Store:
             self._journal_file = open(path, "a", encoding="utf-8")
 
     @classmethod
-    def open(cls, directory: str, fsync: bool = False) -> "Store":
+    def open(cls, directory: str, fsync: bool = False,
+             epoch=None) -> "Store":
         """Open a durable store rooted at ``directory`` (snapshot.json +
         journal.jsonl): load the snapshot if present, replay the journal,
         resume appending. The equivalent of a new leader re-reading Datomic
-        (reference: mesos.clj:296-313 — replay nothing, just re-read)."""
+        (reference: mesos.clj:296-313 — replay nothing, just re-read).
+
+        With ``epoch`` (an election epoch int, or "auto" for one above the
+        current fence) the directory is treated as SHARED across leader
+        hosts: the claim is written to ``<dir>/epoch`` before replay,
+        stale-epoch records interleaved by a deposed leader are skipped
+        during replay, and every future append re-checks the fence — a
+        paused-then-woken old leader gets StaleEpochError instead of
+        corrupting the successor's journal."""
         os.makedirs(directory, exist_ok=True)
         snap_path = os.path.join(directory, "snapshot.json")
         journal_path = os.path.join(directory, "journal.jsonl")
@@ -816,31 +907,71 @@ class Store:
                 store = cls.restore(f.read())
         else:
             store = cls()
-        if os.path.exists(journal_path):
-            with open(journal_path, "rb") as f:
-                data = f.read()
-            # Every append ends with \n, so a line without one is a torn
-            # tail from a crash. Replay up to the last good record, then
-            # truncate the torn bytes — resuming appends after a fragment
-            # would merge into one unparseable line and silently drop every
-            # later record on the NEXT reopen.
-            good = 0
-            for line in data.splitlines(keepends=True):
-                if not line.endswith(b"\n"):
-                    break
-                text = line.strip()
-                if text:
-                    try:
-                        rec = json.loads(text)
-                    except json.JSONDecodeError:
-                        break
-                    store._apply_journal_record(rec)
-                good += len(line)
-            if good < len(data):
+        store._journal_dir = directory
+        if epoch is None:
+            records, good, size = _scan_journal(journal_path)
+            store._replay_records(records)
+            if good < size:
                 with open(journal_path, "r+b") as f:
                     f.truncate(good)
-        store._journal_dir = directory
+            store.attach_journal(journal_path, fsync=fsync)
+            return store
+        # SHARED-dir takeover. Order matters:
+        #   claim epoch -> repair torn tail -> append an epoch BARRIER ->
+        #   replay to EOF.
+        # The barrier (a no-op record at our epoch) makes any lower-epoch
+        # record that lands after it positionally follow a higher-ep
+        # record, so every future replay skips it; records that raced in
+        # BEFORE the barrier are replayed by us and by every successor
+        # alike, so all leaders agree on the committed prefix.
+        store._claim_epoch(directory, epoch)
+        _records, good, size = _scan_journal(journal_path)
+        if good < size:
+            # a torn fragment would merge with the barrier line and stop
+            # every future replay there — excise it first
+            with open(journal_path, "r+b") as f:
+                f.truncate(good)
         store.attach_journal(journal_path, fsync=fsync)
+        store._journal_file.write(json.dumps(
+            {"ep": store._journal_epoch, "barrier": True}) + "\n")
+        store._journal_file.flush()
+        if fsync:
+            os.fsync(store._journal_file.fileno())
+        records, _good, _size = _scan_journal(journal_path)
+        store._replay_records(records)
+        return store
+
+    def _replay_records(self, records: List[Dict[str, Any]]) -> None:
+        """Apply scanned journal records with epoch-fence skipping: a
+        record with a lower epoch than one already seen was appended by a
+        deposed leader after its successor fenced — never committed from
+        the cluster's point of view."""
+        max_ep = 0
+        for rec in records:
+            ep = rec.get("ep")
+            if ep is not None and ep < max_ep:
+                continue
+            if ep is not None:
+                max_ep = ep
+            if not rec.get("barrier"):
+                self._apply_journal_record(rec)
+
+    @classmethod
+    def replay_only(cls, directory: str) -> "Store":
+        """Load snapshot + journal WITHOUT attaching the journal: the
+        follower/read-replica view of a SHARED data dir.  A follower must
+        never append (its writes would interleave with the leader's), so
+        transactions on this store stay in memory only — leader-only
+        writes are 307-redirected at the REST layer anyway."""
+        snap_path = os.path.join(directory, "snapshot.json")
+        journal_path = os.path.join(directory, "journal.jsonl")
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                store = cls.restore(f.read())
+        else:
+            store = cls()
+        records, _good, _size = _scan_journal(journal_path)
+        store._replay_records(records)
         return store
 
     def _apply_journal_record(self, rec: Dict[str, Any]) -> None:
@@ -864,6 +995,11 @@ class Store:
             raise ValueError(
                 "checkpoint() requires an open store from Store.open")
         with self._lock:
+            if self._journal_epoch is not None:
+                # a deposed leader's graceful shutdown must not overwrite
+                # the shared snapshot with stale state / truncate the
+                # successor's journal
+                self._check_fence()
             snap_path = os.path.join(self._journal_dir, "snapshot.json")
             tmp = snap_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
@@ -880,6 +1016,30 @@ class Store:
             if self._journal_file is not None:
                 self._journal_file.close()
                 self._journal_file = None
+
+
+def _scan_journal(path: str) -> Tuple[List[Dict[str, Any]], int, int]:
+    """Parse a journal file into records.  Returns (records,
+    good_byte_offset, file_size): every append ends with newline, so a
+    line without one (or an unparseable line) is a torn tail from a crash
+    — records stop there and ``good`` marks the last clean byte."""
+    if not os.path.exists(path):
+        return [], 0, 0
+    with open(path, "rb") as f:
+        data = f.read()
+    records: List[Dict[str, Any]] = []
+    good = 0
+    for line in data.splitlines(keepends=True):
+        if not line.endswith(b"\n"):
+            break
+        text = line.strip()
+        if text:
+            try:
+                records.append(json.loads(text))
+            except json.JSONDecodeError:
+                break
+        good += len(line)
+    return records, good, len(data)
 
 
 def _entity_from_json(table: str, v: Dict[str, Any]) -> Any:

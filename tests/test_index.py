@@ -188,3 +188,81 @@ class TestLongNames:
         store.create_jobs([j])
         assert ranked_uuids(store, cfg, long_pool) == [j.uuid]
         assert_parity(store, cfg, long_pool)
+
+
+class TestIncrementalOrderCache:
+    """The per-pool sorted-order cache (index._ord) must stay bit-identical
+    to a cold full lexsort across arbitrary scheduling churn — launches,
+    completions, failures/requeues, kills, new users, latches."""
+
+    def _cold_order(self, store, pool="default"):
+        idx = store.ensure_index()
+        with idx._lock:
+            idx._ord.pop(pool, None)   # force the full-lexsort path
+            got = idx._rank_rows_locked(pool)
+        if got is None:
+            return None
+        arrays, rows_s, user_s, _ = got
+        return (list(idx._uuid[rows_s]), arrays["pending"].tolist(),
+                list(user_s))
+
+    def _cached_order(self, store, pool="default"):
+        idx = store.ensure_index()
+        with idx._lock:
+            got = idx._rank_rows_locked(pool)   # seeds or repairs the cache
+            if got is None:
+                return None  # no pending jobs: nothing to seed
+            assert pool in idx._ord
+            got2 = idx._rank_rows_locked(pool)  # pure cache hit
+        for a, b in zip(got[0].values(), got2[0].values()):
+            assert np.array_equal(a, b)
+        arrays, rows_s, user_s, _ = got
+        return (list(idx._uuid[rows_s]), arrays["pending"].tolist(),
+                list(user_s))
+
+    def test_random_churn_matches_cold_rebuild(self):
+        rng = np.random.default_rng(11)
+        store = Store()
+        store.ensure_index()
+        live_tids = []
+        jobs = []
+        for step in range(30):
+            # submit a few jobs (sometimes from a brand-new user: user-id
+            # shift must invalidate, not corrupt, the cache)
+            fresh = [make_job(f"u{rng.integers(0, 6 + step // 10)}",
+                              priority=int(rng.integers(0, 100)),
+                              submit=int(rng.integers(0, 10**6)))
+                     for _ in range(int(rng.integers(1, 5)))]
+            store.create_jobs(fresh)
+            jobs.extend(fresh)
+            # launch a pending job
+            pending = [j for j in jobs
+                       if store.job(j.uuid).state is JobState.WAITING]
+            if pending and rng.random() < 0.8:
+                j = pending[int(rng.integers(len(pending)))]
+                tid = new_uuid()
+                store.launch_instance(j.uuid, tid, "h1")
+                live_tids.append(tid)
+            # complete/fail a live instance
+            if live_tids and rng.random() < 0.6:
+                tid = live_tids.pop(int(rng.integers(len(live_tids))))
+                store.update_instance_status(tid, InstanceStatus.RUNNING)
+                store.update_instance_status(
+                    tid, InstanceStatus.SUCCESS if rng.random() < 0.5
+                    else InstanceStatus.FAILED, reason_code=6)
+            # kill something
+            if jobs and rng.random() < 0.2:
+                store.kill_job(jobs[int(rng.integers(len(jobs)))].uuid)
+            cached = self._cached_order(store)
+            cold = self._cold_order(store)
+            assert cached == cold, f"diverged at step {step}"
+            assert_parity(store, Config())
+
+    def test_latch_commit_repairs_cache(self):
+        store = Store()
+        store.ensure_index()
+        store.create_jobs([make_job("alice")])
+        assert self._cached_order(store) == self._cold_order(store)
+        store.create_jobs([make_job("bob") for _ in range(3)], latch="L")
+        store.commit_latch("L")
+        assert self._cached_order(store) == self._cold_order(store)
