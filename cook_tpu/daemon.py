@@ -143,7 +143,19 @@ class CookDaemon:
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
         conf = self.conf
-        self.store = (Store.open(self.data_dir) if self.data_dir else Store())
+        # shared_data_dir: the data dir is on shared storage reachable from
+        # every scheduler host (the Datomic-transactor slot).  Followers
+        # load a replay-only view (no journal attach — their appends would
+        # interleave with the leader's); the election winner re-opens
+        # FENCED at the next epoch in _on_leadership, which also replays
+        # everything the previous leader committed.
+        self.shared_data = bool(conf.get("shared_data_dir"))
+        if not self.data_dir:
+            self.store = Store()
+        elif self.shared_data:
+            self.store = Store.replay_only(self.data_dir)
+        else:
+            self.store = Store.open(self.data_dir)
         sched_spec = dict(conf.get("scheduler", {}))
         self.sched_config = build_scheduler_config(sched_spec)
         self.rank_backend = sched_spec.get("rank_backend", "tpu")
@@ -196,6 +208,14 @@ class CookDaemon:
         (reference: LeaderSelectorListener.takeLeadership mesos.clj:193)."""
         try:
             with self._lock:
+                if self.shared_data and self.data_dir:
+                    # take over the SHARED journal: claim the next epoch
+                    # (fencing out the previous leader's late appends) and
+                    # replay everything it committed, then serve queries
+                    # from the fenced store
+                    self.store = Store.open(self.data_dir, epoch="auto")
+                    self.api.store = self.store
+                    self.queue_limits.store = self.store
                 clusters = build_clusters(self.conf.get("clusters", []),
                                           self.store)
                 self.scheduler = Scheduler(

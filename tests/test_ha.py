@@ -291,3 +291,129 @@ class TestLeaseElection:
         assert not a.is_leader      # stepped down, no split brain
         assert events == ["lead", "loss"]
         a.resign()
+
+
+class TestJournalEpochFencing:
+    """Cross-host failover over a SHARED journal directory: appends carry
+    the election epoch, a successor's claim fences the directory, and a
+    deposed-but-alive leader's late writes are rejected instead of
+    corrupting the journal the successor replays (the Datomic-as-shared-
+    store semantics of the reference, datomic.clj:79, mesos.clj:153-328)."""
+
+    def _job(self, user="alice"):
+        from cook_tpu.state import Job, Resources, new_uuid
+        return Job(uuid=new_uuid(), user=user, command="x",
+                   resources=Resources(cpus=1.0, mem=64.0))
+
+    def test_contested_failover_rejects_stale_leader(self, tmp_path):
+        from cook_tpu.state import StaleEpochError, Store
+        d = str(tmp_path / "shared")
+        # leader A claims the dir and commits real work
+        a = Store.open(d, epoch="auto")
+        assert a._journal_epoch == 1
+        j1 = self._job()
+        a.create_jobs([j1])
+        # A pauses (NOT killed: its fd stays open, its lock is still held);
+        # B takes over from the shared dir at the next epoch
+        b = Store.open(d, epoch="auto")
+        assert b._journal_epoch == 2
+        assert b.job(j1.uuid) is not None  # replayed A's committed work
+        j2 = self._job("bob")
+        b.create_jobs([j2])
+        # A wakes and tries to write: rejected, nothing installed
+        import pytest as _pytest
+        with _pytest.raises(StaleEpochError):
+            a.create_jobs([self._job("late")])
+        assert a._journal_poisoned
+        with _pytest.raises(RuntimeError):  # poisoned: every later tx too
+            a.create_jobs([self._job("later")])
+        # B is unaffected and keeps committing
+        j3 = self._job("bob")
+        b.create_jobs([j3])
+        # a third leader replays everything A and B legitimately committed
+        c = Store.open(d, epoch="auto")
+        assert c._journal_epoch == 3
+        assert c.job(j1.uuid) is not None
+        assert c.job(j2.uuid) is not None
+        assert c.job(j3.uuid) is not None
+
+    def test_stale_interleaved_record_skipped_on_replay(self, tmp_path):
+        """The O_APPEND race: a deposed leader's record that lands in the
+        file AFTER the successor fenced must be dropped by replay."""
+        import json
+        from cook_tpu.state import Store
+        d = str(tmp_path / "shared")
+        a = Store.open(d, epoch="auto")
+        j1 = self._job()
+        a.create_jobs([j1])
+        b = Store.open(d, epoch="auto")
+        j2 = self._job("bob")
+        b.create_jobs([j2])
+        # simulate A's in-flight write landing after B's: an epoch-1 record
+        # appended at the tail of the shared journal
+        ghost = self._job("ghost")
+        with open(d + "/journal.jsonl", "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "tx": 999, "ep": 1,
+                "w": {f"jobs/{ghost.uuid}": {
+                    "uuid": ghost.uuid, "user": "ghost", "command": "x"}},
+            }) + "\n")
+        c = Store.open(d, epoch="auto")
+        assert c.job(j1.uuid) is not None
+        assert c.job(j2.uuid) is not None
+        assert c.job(ghost.uuid) is None  # stale write never committed
+
+    def test_stale_claim_refused_at_open(self, tmp_path):
+        from cook_tpu.state import StaleEpochError, Store
+        d = str(tmp_path / "shared")
+        Store.open(d, epoch=5)
+        import pytest as _pytest
+        with _pytest.raises(StaleEpochError):
+            Store.open(d, epoch=3)
+
+    def test_unfenced_open_still_works(self, tmp_path):
+        """epoch=None keeps the single-host behavior: no fence file, no
+        epoch stamps, reopen replays everything."""
+        from cook_tpu.state import Store
+        d = str(tmp_path / "solo")
+        a = Store.open(d)
+        j = self._job()
+        a.create_jobs([j])
+        a.close()
+        b = Store.open(d)
+        assert b.job(j.uuid) is not None
+        import os
+        assert not os.path.exists(d + "/epoch")
+
+    def test_deposed_leader_checkpoint_refused(self, tmp_path):
+        """A deposed leader's graceful-shutdown checkpoint must not
+        overwrite the shared snapshot/journal with stale state."""
+        from cook_tpu.state import StaleEpochError, Store
+        d = str(tmp_path / "shared")
+        a = Store.open(d, epoch="auto")
+        j1 = self._job()
+        a.create_jobs([j1])
+        b = Store.open(d, epoch="auto")
+        j2 = self._job("bob")
+        b.create_jobs([j2])
+        import pytest as _pytest
+        with _pytest.raises(StaleEpochError):
+            a.checkpoint()  # deposed: refused
+        # replay_only = a follower's read view (claims no epoch)
+        c = Store.replay_only(d)
+        assert c.job(j2.uuid) is not None  # successor's commit survived
+        b.checkpoint()  # the live leader may compact
+        c2 = Store.replay_only(d)
+        assert c2.job(j1.uuid) is not None
+        assert c2.job(j2.uuid) is not None
+
+    def test_takeover_writes_epoch_barrier(self, tmp_path):
+        import json
+        from cook_tpu.state import Store
+        d = str(tmp_path / "shared")
+        Store.open(d, epoch="auto")
+        Store.open(d, epoch="auto")
+        recs = [json.loads(x) for x in
+                open(d + "/journal.jsonl", encoding="utf-8")]
+        barriers = [r for r in recs if r.get("barrier")]
+        assert [b["ep"] for b in barriers] == [1, 2]
