@@ -1,229 +1,415 @@
-"""Real Kubernetes client adapter.
+"""Real Kubernetes client adapter over stdlib HTTP.
 
 Implements the same surface as :class:`fake_api.FakeKubernetesApi`
-(nodes/pods/pod/create_pod/delete_pod/watch/unwatch/resource_version) on
-top of the official ``kubernetes`` Python client, so
-:class:`compute_cluster.KubernetesCluster` and :class:`controller.PodController`
-run unchanged against a live cluster (reference: the okhttp watch +
-client-java layer, scheduler/src/cook/kubernetes/api.clj:372-734, with
-resourceVersion resume and watch-gap handling).
+(nodes/pods/pod/create_pod/delete_pod/watch/unwatch/resource_version +
+coordination/v1 leases) by speaking the Kubernetes REST API directly —
+list/create/delete as JSON requests, watches as chunked ``?watch=1``
+streams with resourceVersion resume and 410-Gone relist, leases with
+resourceVersion compare-and-swap (reference: the okhttp watch +
+client-java layer, scheduler/src/cook/kubernetes/api.clj:372-734; watch
+bootstrap/resume :372-475).
 
-The ``kubernetes`` package is not part of this image, so the import is
-gated: constructing the adapter without it raises a clear error, and
-``tests/test_k8s.py`` asserts interface parity with the fake via
-introspection instead of a live cluster.
+No ``kubernetes`` package dependency: the wire protocol is small and a
+stdlib client is exercisable in-repo against
+:class:`mock_apiserver.MockApiServer` over real sockets
+(tests/test_k8s_real_api.py), which is how every method here is tested.
 """
 
 from __future__ import annotations
 
+import datetime
+import json
+import socket
+import ssl
 import threading
-from typing import Callable, Dict, List, Optional
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .fake_api import FakeNode, FakePod, WatchEvent
+from .types import Lease
 
 COOK_NS = "cook"
 
 
-def _require_client():
+# --------------------------------------------------------------- quantities
+def parse_qty(v, default: float = 0.0, kind: str = "count") -> float:
+    """Kubernetes quantity -> float in cook units (cpus/gpus as counts,
+    memory as MiB via ``kind="mem"``).
+
+    "2" -> 2.0 cpus; "1500m" -> 1.5; "512Mi" -> 512; "1Gi" -> 1024;
+    "524288Ki" -> 512; "2G" -> ~1907Mi.  A suffixless or
+    exponent-form memory quantity ("16423059456", "16e9") is BYTES on
+    the wire (canonical k8s form) and converts to MiB; suffixless
+    cpu/gpu counts stay counts.
+    """
+    if v is None:
+        return default
+    s = str(v)
     try:
-        import kubernetes  # type: ignore
-        return kubernetes
-    except ImportError as e:  # pragma: no cover - package absent in image
-        raise RuntimeError(
-            "RealKubernetesApi needs the 'kubernetes' package; in this "
-            "image use FakeKubernetesApi (same interface)") from e
+        if s.endswith("Ki"):
+            return float(s[:-2]) / 1024.0
+        if s.endswith("Mi"):
+            return float(s[:-2])
+        if s.endswith("Gi"):
+            return float(s[:-2]) * 1024.0
+        if s.endswith("Ti"):
+            return float(s[:-2]) * 1024.0 * 1024.0
+        if s.endswith("k"):
+            return float(s[:-1]) * 1000.0 / (1024.0 * 1024.0)
+        if s.endswith("M"):
+            return float(s[:-1]) * 1e6 / (1024.0 * 1024.0)
+        if s.endswith("G"):
+            return float(s[:-1]) * 1e9 / (1024.0 * 1024.0)
+        if s.endswith("m"):
+            return float(s[:-1]) / 1000.0
+        n = float(s)
+        if kind == "mem":
+            return n / (1024.0 * 1024.0)  # bytes -> MiB
+        return n
+    except ValueError:
+        return default
+
+
+def _ts_ms(rfc3339: Optional[str]) -> Optional[int]:
+    if not rfc3339:
+        return None
+    try:
+        dt = datetime.datetime.fromisoformat(rfc3339.replace("Z", "+00:00"))
+        return int(dt.timestamp() * 1000)
+    except ValueError:
+        return None
+
+
+def rfc3339(ts_s: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts_s, tz=datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str = ""):
+        super().__init__(f"apiserver HTTP {status}: {body[:200]}")
+        self.status = status
 
 
 class RealKubernetesApi:
-    """Live-cluster twin of FakeKubernetesApi.
+    """Live-apiserver twin of FakeKubernetesApi over stdlib HTTP.
 
-    Pods/nodes are translated into the same Fake* dataclasses the
-    controller consumes; the rich ``spec`` dict produced by
-    pod_spec.build_pod_spec is translated 1:1 into V1Pod fields.
+    ``base_url`` points at the apiserver (e.g. ``http://127.0.0.1:6443``
+    or the MockApiServer's address); ``kubeconfig`` extracts server/token
+    from a kubeconfig file instead.  Objects are translated into the same
+    Fake* dataclasses the controller consumes, so
+    :class:`compute_cluster.KubernetesCluster` and
+    :class:`controller.PodController` run unchanged against a live
+    cluster.
     """
 
-    def __init__(self, namespace: str = COOK_NS, kubeconfig: Optional[str] = None):
-        k8s = _require_client()
-        if kubeconfig:
-            k8s.config.load_kube_config(config_file=kubeconfig)
-        else:  # pragma: no cover
-            k8s.config.load_incluster_config()
-        self._k8s = k8s
-        self._core = k8s.client.CoreV1Api()
+    def __init__(self, namespace: str = COOK_NS,
+                 kubeconfig: Optional[str] = None,
+                 base_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 verify_tls: bool = True,
+                 watch_timeout_s: float = 60.0):
+        ctx: Optional[ssl.SSLContext] = None
+        if kubeconfig and not base_url:
+            base_url, token, ctx = self._from_kubeconfig(kubeconfig)
+        if not base_url and token is None:
+            # in-cluster fallback: the pod's service account
+            sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+            import os
+            if os.path.exists(f"{sa}/token"):
+                with open(f"{sa}/token", encoding="utf-8") as f:
+                    token = f.read().strip()
+                host = os.environ.get("KUBERNETES_SERVICE_HOST")
+                port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+                if host:
+                    base_url = f"https://{host}:{port}"
+                if os.path.exists(f"{sa}/ca.crt"):
+                    ctx = ssl.create_default_context(
+                        cafile=f"{sa}/ca.crt")
+        if not base_url:
+            raise ValueError(
+                "RealKubernetesApi needs base_url, kubeconfig, or an "
+                "in-cluster service account")
+        self.base_url = base_url.rstrip("/")
         self.namespace = namespace
+        self.token = token
+        self.watch_timeout_s = watch_timeout_s
+        self._ctx = ctx
+        if self.base_url.startswith("https") and not verify_tls:
+            self._ctx = ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
         self._rv = 0
         self._watchers: List[Callable[[WatchEvent], None]] = []
         self._lock = threading.RLock()
+        # per-generation stop event: unwatch() must only stop the threads
+        # of ITS generation — a later watch() spawns fresh threads with a
+        # fresh event, so a slow old thread can never double-deliver
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # observability: watch reconnects / 410 relists (the reference
+        # tracks watch gaps as metrics, api.clj:440-470)
+        self.watch_reconnects = 0
+        self.watch_gap_relists = 0
+
+    @staticmethod
+    def _from_kubeconfig(path: str) -> Tuple[str, Optional[str],
+                                             Optional[ssl.SSLContext]]:
+        """Resolve server/credentials honoring current-context, bearer
+        tokens, client certificates, and CA bundles (inline *-data fields
+        are written to temp files for the ssl module)."""
+        import base64
+        import tempfile
+
+        import yaml
+        with open(path, encoding="utf-8") as f:
+            cfg = yaml.safe_load(f) or {}
+
+        def by_name(items, name):
+            for it in items or []:
+                if it.get("name") == name:
+                    return it
+            return (items or [{}])[0]
+
+        ctx_name = cfg.get("current-context")
+        context = (by_name(cfg.get("contexts"), ctx_name)
+                   .get("context") or {})
+        cluster = (by_name(cfg.get("clusters"),
+                           context.get("cluster")).get("cluster") or {})
+        user = (by_name(cfg.get("users"),
+                        context.get("user")).get("user") or {})
+        server = cluster.get("server")
+        if not server:
+            raise ValueError(f"kubeconfig {path}: no cluster server")
+
+        def materialize(data_key, file_key, src):
+            if src.get(file_key):
+                return src[file_key]
+            if src.get(data_key):
+                f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+                f.write(base64.b64decode(src[data_key]))
+                f.close()
+                return f.name
+            return None
+
+        cafile = materialize("certificate-authority-data",
+                             "certificate-authority", cluster)
+        certfile = materialize("client-certificate-data",
+                               "client-certificate", user)
+        keyfile = materialize("client-key-data", "client-key", user)
+        ctx = None
+        if server.startswith("https") and (cafile or certfile):
+            ctx = ssl.create_default_context(cafile=cafile)
+            if cluster.get("insecure-skip-tls-verify"):
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if certfile:
+                ctx.load_cert_chain(certfile, keyfile)
+        return server, user.get("token"), ctx
+
+    # ------------------------------------------------------------------ http
+    def _request(self, method: str, path: str, body=None,
+                 timeout: float = 10.0):
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=self._ctx) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode("utf-8", "replace")) \
+                from None
 
     # ------------------------------------------------------------ translate
     @staticmethod
-    def _node_from_v1(n) -> FakeNode:
-        alloc = n.status.allocatable or {}
-
-        def qty(key, default=0.0):
-            v = alloc.get(key)
-            if v is None:
-                return default
-            s = str(v)
-            if s.endswith("Ki"):
-                return float(s[:-2]) / 1024.0  # -> MiB
-            if s.endswith("Mi"):
-                return float(s[:-2])
-            if s.endswith("m"):
-                return float(s[:-1]) / 1000.0
-            return float(s)
-
-        labels = n.metadata.labels or {}
+    def _node_from_json(n: Dict) -> FakeNode:
+        meta = n.get("metadata") or {}
+        spec = n.get("spec") or {}
+        alloc = (n.get("status") or {}).get("allocatable") or {}
+        labels = meta.get("labels") or {}
         return FakeNode(
-            name=n.metadata.name,
-            cpus=qty("cpu"), mem=qty("memory"),
-            gpus=qty("nvidia.com/gpu"),
+            name=meta.get("name", ""),
+            cpus=parse_qty(alloc.get("cpu")),
+            mem=parse_qty(alloc.get("memory"), kind="mem"),
+            gpus=parse_qty(alloc.get("nvidia.com/gpu")),
             pool=labels.get("cook-pool", "default"),
             labels=dict(labels),
-            taints=[t.key for t in (n.spec.taints or [])],
-            unschedulable=bool(n.spec.unschedulable),
+            taints=[t.get("key", "") for t in (spec.get("taints") or [])],
+            unschedulable=bool(spec.get("unschedulable")),
             gpu_model=labels.get("gpu-model", ""))
 
     @staticmethod
-    def _pod_from_v1(p) -> FakePod:
-        labels = p.metadata.labels or {}
-        status = p.status
+    def _pod_from_json(p: Dict) -> FakePod:
+        meta = p.get("metadata") or {}
+        spec = p.get("spec") or {}
+        status = p.get("status") or {}
+        labels = meta.get("labels") or {}
         exit_code = None
-        reason = status.reason or ""
+        reason = status.get("reason") or ""
         unschedulable = ""
-        for cond in (status.conditions or []):
-            if cond.type == "PodScheduled" and cond.status == "False":
-                unschedulable = cond.message or cond.reason or "Unschedulable"
-        for cs in (status.container_statuses or []):
-            term = cs.state and cs.state.terminated
-            if term is not None and cs.name == "cook-job":
-                exit_code = term.exit_code
-                reason = reason or (term.reason or "")
+        for cond in (status.get("conditions") or []):
+            if cond.get("type") == "PodScheduled" \
+                    and cond.get("status") == "False":
+                unschedulable = (cond.get("message") or cond.get("reason")
+                                 or "Unschedulable")
+        for cs in (status.get("containerStatuses") or []):
+            term = (cs.get("state") or {}).get("terminated")
+            if term is not None and cs.get("name") == "cook-job":
+                exit_code = term.get("exitCode")
+                reason = reason or (term.get("reason") or "")
         req = {}
-        if p.spec.containers:
-            req = p.spec.containers[0].resources.requests or {}
-
-        def qty(key):
-            v = req.get(key)
-            if v is None:
-                return 0.0
-            s = str(v)
-            if s.endswith("Mi"):
-                return float(s[:-2])
-            if s.endswith("m"):
-                return float(s[:-1]) / 1000.0
-            return float(s)
-
-        created = p.metadata.creation_timestamp
-        deleted_at = p.metadata.deletion_timestamp
+        containers = spec.get("containers") or []
+        if containers:
+            req = (containers[0].get("resources") or {}).get("requests") or {}
+        deleted_at = _ts_ms(meta.get("deletionTimestamp"))
         return FakePod(
-            name=p.metadata.name,
-            node_name=p.spec.node_name,
-            phase=status.phase or "Pending",
-            cpus=qty("cpu"), mem=qty("memory"), gpus=qty("nvidia.com/gpu"),
+            name=meta.get("name", ""),
+            node_name=spec.get("nodeName"),
+            phase=status.get("phase") or "Pending",
+            cpus=parse_qty(req.get("cpu")),
+            mem=parse_qty(req.get("memory"), kind="mem"),
+            gpus=parse_qty(req.get("nvidia.com/gpu")),
             labels=dict(labels),
-            annotations=dict(p.metadata.annotations or {}),
+            annotations=dict(meta.get("annotations") or {}),
             deleted=deleted_at is not None,
-            deletion_ms=int(deleted_at.timestamp() * 1000) if deleted_at else None,
-            creation_ms=int(created.timestamp() * 1000) if created else 0,
+            deletion_ms=deleted_at,
+            creation_ms=_ts_ms(meta.get("creationTimestamp")) or 0,
             exit_code=exit_code,
             reason=reason,
             unschedulable_reason=unschedulable,
             synthetic=labels.get("cook/synthetic") == "true",
-            resource_version=int(p.metadata.resource_version or 0))
+            resource_version=int(meta.get("resourceVersion") or 0))
 
-    def _pod_to_v1(self, pod: FakePod):
-        k8s = self._k8s
+    def _pod_to_json(self, pod: FakePod) -> Dict:
         spec = pod.spec or {}
 
         def container(c):
-            return k8s.client.V1Container(
-                name=c["name"], image=c["image"],
-                command=c.get("command"),
-                env=[k8s.client.V1EnvVar(name=e["name"], value=e["value"])
-                     for e in c.get("env", [])],
-                working_dir=c.get("working_dir"),
-                volume_mounts=[k8s.client.V1VolumeMount(
-                    name=m["name"], mount_path=m["mount_path"],
-                    read_only=m.get("read_only", False),
-                    sub_path=m.get("sub_path"))
-                    for m in c.get("volume_mounts", [])],
-                resources=k8s.client.V1ResourceRequirements(
-                    requests={"cpu": str(pod.cpus),
-                              "memory": f"{int(pod.mem)}Mi",
-                              **({"nvidia.com/gpu": str(int(pod.gpus))}
-                                 if pod.gpus else {})}))
+            out = {"name": c["name"], "image": c["image"]}
+            if c.get("command"):
+                out["command"] = c["command"]
+            if c.get("env"):
+                out["env"] = [{"name": e["name"], "value": e["value"]}
+                              for e in c["env"]]
+            if c.get("working_dir"):
+                out["workingDir"] = c["working_dir"]
+            if c.get("volume_mounts"):
+                out["volumeMounts"] = [
+                    {"name": m["name"], "mountPath": m["mount_path"],
+                     **({"readOnly": True} if m.get("read_only") else {}),
+                     **({"subPath": m["sub_path"]}
+                        if m.get("sub_path") else {})}
+                    for m in c["volume_mounts"]]
+            if c.get("ports"):
+                out["ports"] = [{"containerPort": int(p)}
+                                for p in c["ports"]]
+            if c.get("liveness_probe"):
+                out["livenessProbe"] = c["liveness_probe"]
+            if c.get("readiness_probe"):
+                out["readinessProbe"] = c["readiness_probe"]
+            out["resources"] = {"requests": {
+                "cpu": str(pod.cpus), "memory": f"{int(pod.mem)}Mi",
+                **({"nvidia.com/gpu": str(int(pod.gpus))}
+                   if pod.gpus else {})}}
+            res = c.get("resources")
+            if res:  # per-container override (sidecar/init containers)
+                out["resources"] = res
+            return out
 
         def volume(v):
             if "host_path" in v:
-                return k8s.client.V1Volume(
-                    name=v["name"],
-                    host_path=k8s.client.V1HostPathVolumeSource(
-                        path=v["host_path"]))
+                return {"name": v["name"],
+                        "hostPath": {"path": v["host_path"]}}
             ed = v.get("empty_dir", {})
-            return k8s.client.V1Volume(
-                name=v["name"],
-                empty_dir=k8s.client.V1EmptyDirVolumeSource(
-                    medium=ed.get("medium"),
-                    size_limit=(f"{ed['size_limit_mb']}Mi"
-                                if "size_limit_mb" in ed else None)))
+            out = {}
+            if ed.get("medium"):
+                out["medium"] = ed["medium"]
+            if "size_limit_mb" in ed:
+                out["sizeLimit"] = f"{ed['size_limit_mb']}Mi"
+            return {"name": v["name"], "emptyDir": out}
 
-        return k8s.client.V1Pod(
-            metadata=k8s.client.V1ObjectMeta(
-                name=pod.name, namespace=self.namespace,
-                labels=pod.labels, annotations=pod.annotations),
-            spec=k8s.client.V1PodSpec(
-                restart_policy=spec.get("restart_policy", "Never"),
-                node_name=pod.node_name,
-                containers=[container(c)
-                            for c in spec.get("containers", [])] or
+        body = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod.name, "namespace": self.namespace,
+                         "labels": dict(pod.labels),
+                         "annotations": dict(pod.annotations)},
+            "spec": {
+                "restartPolicy": spec.get("restart_policy", "Never"),
+                "containers": [container(c)
+                               for c in spec.get("containers", [])] or
                 [container({"name": "cook-job",
                             "image": "cook/default-runtime:stable"})],
-                init_containers=[container(c)
-                                 for c in spec.get("init_containers", [])],
-                volumes=[volume(v) for v in spec.get("volumes", [])],
-                tolerations=[k8s.client.V1Toleration(**t)
-                             for t in spec.get("tolerations", [])],
-                node_selector=spec.get("node_selector") or None,
-                priority_class_name=spec.get("priority_class")))
+            },
+        }
+        ps = body["spec"]
+        if pod.node_name:
+            ps["nodeName"] = pod.node_name
+        if spec.get("init_containers"):
+            ps["initContainers"] = [container(c)
+                                    for c in spec["init_containers"]]
+        if spec.get("volumes"):
+            ps["volumes"] = [volume(v) for v in spec["volumes"]]
+        if spec.get("tolerations"):
+            ps["tolerations"] = [
+                {k.replace("_seconds", "Seconds"): v for k, v in t.items()}
+                for t in spec["tolerations"]]
+        if spec.get("node_selector"):
+            ps["nodeSelector"] = spec["node_selector"]
+        if spec.get("priority_class"):
+            ps["priorityClassName"] = spec["priority_class"]
+        if spec.get("shm_size_mb"):
+            body["metadata"]["annotations"]["cook/shm-size-mb"] = \
+                str(spec["shm_size_mb"])
+        return body
 
     # -------------------------------------------------------------- surface
     def nodes(self) -> List[FakeNode]:
-        return [self._node_from_v1(n)
-                for n in self._core.list_node().items]
+        out = self._request("GET", "/api/v1/nodes")
+        return [self._node_from_json(n) for n in out.get("items", [])]
 
     def pods(self) -> List[FakePod]:
-        return [self._pod_from_v1(p) for p in
-                self._core.list_namespaced_pod(self.namespace).items]
+        out = self._request(
+            "GET", f"/api/v1/namespaces/{self.namespace}/pods")
+        return [self._pod_from_json(p) for p in out.get("items", [])]
 
     def pod(self, name: str) -> Optional[FakePod]:
         try:
-            return self._pod_from_v1(
-                self._core.read_namespaced_pod(name, self.namespace))
-        except self._k8s.client.exceptions.ApiException as e:
+            out = self._request(
+                "GET", f"/api/v1/namespaces/{self.namespace}/pods/{name}")
+            return self._pod_from_json(out)
+        except ApiError as e:
             if e.status == 404:
                 return None
             raise
 
     def create_pod(self, pod: FakePod) -> None:
         try:
-            self._core.create_namespaced_pod(self.namespace,
-                                             self._pod_to_v1(pod))
-        except self._k8s.client.exceptions.ApiException as e:
+            self._request(
+                "POST", f"/api/v1/namespaces/{self.namespace}/pods",
+                body=self._pod_to_json(pod))
+        except ApiError as e:
             if e.status == 409:
                 raise ValueError(f"pod {pod.name} already exists") from e
             raise
 
     def delete_pod(self, name: str, grace_period_s: Optional[float] = None,
                    now_ms: int = 0) -> None:
+        q = ""
+        if grace_period_s is not None:
+            q = f"?gracePeriodSeconds={int(grace_period_s)}"
         try:
-            self._core.delete_namespaced_pod(
-                name, self.namespace,
-                grace_period_seconds=(int(grace_period_s)
-                                      if grace_period_s is not None else None))
-        except self._k8s.client.exceptions.ApiException as e:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{self.namespace}/pods/{name}{q}")
+        except ApiError as e:
             if e.status != 404:
                 raise
 
@@ -236,15 +422,19 @@ class RealKubernetesApi:
     def watch(self, callback: Callable[[WatchEvent], None],
               resource_version: int = 0) -> None:
         """Start pod+node watch threads with resourceVersion resume
-        (reference: the watch bootstrap + gap handling,
-        kubernetes/api.clj:372-475). 410 Gone restarts from a fresh list."""
+        (reference: watch bootstrap + gap handling, api.clj:372-475): a
+        dropped connection resumes from the last seen resourceVersion; a
+        410 Gone relists and emits the fresh objects before re-watching."""
         with self._lock:
             self._watchers.append(callback)
             if self._threads:
                 return
+            stop = self._stop = threading.Event()
             for kind in ("pod", "node"):
-                t = threading.Thread(target=self._watch_loop, args=(kind,),
-                                     daemon=True, name=f"k8s-watch-{kind}")
+                t = threading.Thread(
+                    target=self._watch_loop,
+                    args=(kind, resource_version, stop),
+                    daemon=True, name=f"k8s-watch-{kind}")
                 t.start()
                 self._threads.append(t)
 
@@ -253,140 +443,222 @@ class RealKubernetesApi:
             if callback in self._watchers:
                 self._watchers.remove(callback)
             if not self._watchers:
+                # stop THIS generation only; a later watch() gets a fresh
+                # event + threads, and lingering old threads are muted by
+                # their generation's stop flag in _emit
                 self._stop.set()
+                self._threads = []
 
-    def _watch_loop(self, kind: str) -> None:  # pragma: no cover - live only
-        k8s = self._k8s
-        w = k8s.watch.Watch()
-        rv = None
-        while not self._stop.is_set():
+    def _emit(self, kind: str, type_: str, obj, rv: int,
+              stop: Optional[threading.Event] = None) -> None:
+        if stop is not None and stop.is_set():
+            return  # a stale generation's thread must not double-deliver
+        with self._lock:
+            self._rv = max(self._rv, rv)
+            watchers = list(self._watchers)
+        event = WatchEvent(kind, type_, obj, rv)
+        for cb in watchers:
+            cb(event)
+
+    def _list_path(self, kind: str) -> str:
+        return (f"/api/v1/namespaces/{self.namespace}/pods"
+                if kind == "pod" else "/api/v1/nodes")
+
+    def _relist(self, kind: str, known: Dict[str, object],
+                stop: threading.Event) -> int:
+        """Watch-gap recovery: list everything, emit the live objects as
+        MODIFIED (the controller's handlers are reconciling, so replayed
+        state is safe) and synthesize DELETED for objects that vanished
+        during the gap — a pod garbage-collected while the watch was down
+        must not stay RUNNING in the store forever.  Returns the
+        collection resourceVersion to resume from."""
+        out = self._request("GET", self._list_path(kind))
+        rv = int((out.get("metadata") or {}).get("resourceVersion") or 0)
+        seen = set()
+        for item in out.get("items", []):
+            obj = (self._pod_from_json(item) if kind == "pod"
+                   else self._node_from_json(item))
+            seen.add(obj.name)
+            known[obj.name] = obj
+            orv = getattr(obj, "resource_version", rv) or rv
+            self._emit(kind, "MODIFIED", obj, int(orv), stop)
+        for name in list(known):
+            if name not in seen:
+                self._emit(kind, "DELETED", known.pop(name), rv, stop)
+        self.watch_gap_relists += 1
+        return rv
+
+    def _watch_loop(self, kind: str, start_rv: int,
+                    stop: threading.Event) -> None:
+        import logging
+        log = logging.getLogger(__name__)
+        rv: Optional[int] = start_rv
+        known: Dict[str, object] = {}  # name -> last obj (for gap deletes)
+        backoff = 0.0
+        while not stop.is_set():
             try:
-                if kind == "pod":
-                    stream = w.stream(self._core.list_namespaced_pod,
-                                      self.namespace, resource_version=rv,
-                                      timeout_seconds=60)
-                else:
-                    stream = w.stream(self._core.list_node,
-                                      resource_version=rv,
-                                      timeout_seconds=60)
-                for raw in stream:
-                    if self._stop.is_set():
-                        return
-                    obj = (self._pod_from_v1(raw["object"]) if kind == "pod"
-                           else self._node_from_v1(raw["object"]))
-                    rv = raw["object"].metadata.resource_version
-                    with self._lock:
-                        self._rv = max(self._rv, int(rv or 0))
-                        watchers = list(self._watchers)
-                    event = WatchEvent(kind, raw["type"], obj,
-                                       int(rv or 0))
-                    for cb in watchers:
-                        cb(event)
-            except k8s.client.exceptions.ApiException as e:
-                if e.status == 410:  # watch gap: resync from a fresh list
+                if rv is None:
+                    rv = self._relist(kind, known, stop)
+                q = urllib.parse.urlencode(
+                    {"watch": "1", "resourceVersion": str(rv),
+                     "timeoutSeconds": str(int(self.watch_timeout_s))})
+                url = f"{self.base_url}{self._list_path(kind)}?{q}"
+                req = urllib.request.Request(url)
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
+                with urllib.request.urlopen(
+                        req, timeout=self.watch_timeout_s + 5,
+                        context=self._ctx) as resp:
+                    for line in resp:
+                        if stop.is_set():
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        evt = json.loads(line)
+                        if evt.get("type") == "ERROR":
+                            code = (evt.get("object") or {}).get("code")
+                            if code == 410:  # watch gap: relist + resume
+                                rv = None
+                            else:
+                                log.warning(
+                                    "k8s %s watch ERROR event: %s",
+                                    kind, evt.get("object"))
+                                backoff = min(max(backoff * 2, 0.2), 5.0)
+                            break
+                        raw = evt.get("object") or {}
+                        obj = (self._pod_from_json(raw) if kind == "pod"
+                               else self._node_from_json(raw))
+                        orv = int((raw.get("metadata") or {})
+                                  .get("resourceVersion") or 0)
+                        rv = max(int(rv or 0), orv)
+                        if evt.get("type") == "DELETED":
+                            known.pop(obj.name, None)
+                        else:
+                            known[obj.name] = obj
+                        self._emit(kind, evt.get("type", "MODIFIED"),
+                                   obj, orv, stop)
+                        backoff = 0.0  # healthy stream
+                self.watch_reconnects += 1
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
                     rv = None
                     continue
-                raise
+                backoff = min(max(backoff * 2, 0.2), 5.0)
+                log.warning("k8s %s watch HTTP %s; retrying in %.1fs",
+                            kind, e.code, backoff)
+            except (urllib.error.URLError, socket.timeout,
+                    ConnectionError, OSError) as e:
+                # dropped stream: reconnect and resume from last seen rv
+                self.watch_reconnects += 1
+                backoff = min(max(backoff * 2, 0.1), 5.0)
+                log.debug("k8s %s watch dropped (%s); resuming rv=%s",
+                          kind, e, rv)
+            except json.JSONDecodeError:
+                backoff = min(max(backoff * 2, 0.1), 5.0)
+            if backoff:
+                stop.wait(backoff)
 
     # --------------------------------------------------------------- leases
-    # (coordination.k8s.io/v1; the lease surface LeaseLeaderElector drives.
-    # Same contract as FakeKubernetesApi.try_acquire_lease.)
-    def get_lease(self, name: str):  # pragma: no cover - live only
-        from .types import Lease
-        k8s = self._k8s
-        coord = k8s.client.CoordinationV1Api()
+    # (coordination.k8s.io/v1; the surface LeaseLeaderElector drives —
+    # same contract as FakeKubernetesApi.try_acquire_lease.)
+    def _lease_path(self, name: str = "") -> str:
+        base = (f"/apis/coordination.k8s.io/v1/namespaces/"
+                f"{self.namespace}/leases")
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _lease_from_json(name: str, obj: Dict) -> Lease:
+        spec = obj.get("spec") or {}
+        meta = obj.get("metadata") or {}
+        renew = spec.get("renewTime")
+        return Lease(
+            name=name, holder=spec.get("holderIdentity") or "",
+            holder_url=(meta.get("annotations") or {}).get(
+                "cook/leader-url", ""),
+            renew_time_s=(_ts_ms(renew) or 0) / 1000.0,
+            duration_s=float(spec.get("leaseDurationSeconds") or 15),
+            transitions=int(spec.get("leaseTransitions") or 0))
+
+    def get_lease(self, name: str) -> Optional[Lease]:
         try:
-            lease = coord.read_namespaced_lease(name, self.namespace)
-        except k8s.client.exceptions.ApiException as e:
+            obj = self._request("GET", self._lease_path(name))
+        except ApiError as e:
             if e.status == 404:
                 return None
             raise
-        spec = lease.spec
-        renew = spec.renew_time.timestamp() if spec.renew_time else 0.0
-        return Lease(
-            name=name, holder=spec.holder_identity or "",
-            holder_url=(lease.metadata.annotations or {}).get(
-                "cook/leader-url", ""),
-            renew_time_s=renew,
-            duration_s=float(spec.lease_duration_seconds or 15),
-            transitions=int(spec.lease_transitions or 0))
+        return self._lease_from_json(name, obj)
 
     def try_acquire_lease(self, name: str, identity: str, now_s: float,
-                          duration_s: float = 15.0, holder_url: str = ""
-                          ):  # pragma: no cover - live only
+                          duration_s: float = 15.0,
+                          holder_url: str = "") -> Optional[Lease]:
         """Apiserver-CAS acquire/renew: the object's resourceVersion makes
         the replace conditional, so two contenders cannot both win."""
-        import datetime
-
-        from .types import Lease
-        k8s = self._k8s
-        coord = k8s.client.CoordinationV1Api()
-        now = datetime.datetime.now(datetime.timezone.utc)
-        body = k8s.client.V1Lease(
-            metadata=k8s.client.V1ObjectMeta(
-                name=name, namespace=self.namespace,
-                annotations={"cook/leader-url": holder_url}),
-            spec=k8s.client.V1LeaseSpec(
-                holder_identity=identity, renew_time=now,
-                lease_duration_seconds=int(duration_s)))
+        body = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": name, "namespace": self.namespace,
+                         "annotations": {"cook/leader-url": holder_url}},
+            "spec": {"holderIdentity": identity,
+                     "renewTime": rfc3339(now_s),
+                     "leaseDurationSeconds": int(duration_s)},
+        }
         try:
-            cur = coord.read_namespaced_lease(name, self.namespace)
-        except k8s.client.exceptions.ApiException as e:
+            cur = self._request("GET", self._lease_path(name))
+        except ApiError as e:
             if e.status != 404:
                 raise
+            body["spec"]["leaseTransitions"] = 1
             try:
-                body.spec.lease_transitions = 1
-                coord.create_namespaced_lease(self.namespace, body)
-                return Lease(name=name, holder=identity,
-                                 holder_url=holder_url,
-                                 renew_time_s=now.timestamp(),
-                                 duration_s=duration_s, transitions=1)
-            except k8s.client.exceptions.ApiException as e2:
+                self._request("POST", self._lease_path(), body=body)
+            except ApiError as e2:
                 if e2.status == 409:  # lost the create race
                     return None
                 raise
-        spec = cur.spec
-        renew = spec.renew_time.timestamp() if spec.renew_time else 0.0
-        expired = now.timestamp() - renew > float(
-            spec.lease_duration_seconds or duration_s)
-        if (spec.holder_identity and spec.holder_identity != identity
-                and not expired):
+            return Lease(name=name, holder=identity, holder_url=holder_url,
+                         renew_time_s=now_s, duration_s=duration_s,
+                         transitions=1)
+        spec = cur.get("spec") or {}
+        renew_s = (_ts_ms(spec.get("renewTime")) or 0) / 1000.0
+        expired = now_s - renew_s > float(
+            spec.get("leaseDurationSeconds") or duration_s)
+        holder = spec.get("holderIdentity") or ""
+        if holder and holder != identity and not expired:
             return None
-        transitions = int(spec.lease_transitions or 0)
-        if spec.holder_identity != identity:
+        transitions = int(spec.get("leaseTransitions") or 0)
+        if holder != identity:
             transitions += 1
-        body.metadata.resource_version = cur.metadata.resource_version
-        body.spec.lease_transitions = transitions
+        body["metadata"]["resourceVersion"] = \
+            (cur.get("metadata") or {}).get("resourceVersion")
+        body["spec"]["leaseTransitions"] = transitions
         try:
-            coord.replace_namespaced_lease(name, self.namespace, body)
-        except k8s.client.exceptions.ApiException as e:
+            self._request("PUT", self._lease_path(name), body=body)
+        except ApiError as e:
             if e.status == 409:  # CAS lost: someone renewed under us
                 return None
             raise
         return Lease(name=name, holder=identity, holder_url=holder_url,
-                         renew_time_s=now.timestamp(),
-                         duration_s=duration_s, transitions=transitions)
+                     renew_time_s=now_s, duration_s=duration_s,
+                     transitions=transitions)
 
-    def release_lease(self, name: str, identity: str
-                      ) -> None:  # pragma: no cover - live only
+    def release_lease(self, name: str, identity: str) -> None:
         """Explicit release on clean shutdown: clear holderIdentity so a
         standby acquires immediately instead of waiting out the TTL."""
-        k8s = self._k8s
-        coord = k8s.client.CoordinationV1Api()
         try:
-            cur = coord.read_namespaced_lease(name, self.namespace)
-        except k8s.client.exceptions.ApiException as e:
+            cur = self._request("GET", self._lease_path(name))
+        except ApiError as e:
             if e.status == 404:
                 return
             raise
-        if (cur.spec.holder_identity or "") != identity:
+        spec = cur.get("spec") or {}
+        if (spec.get("holderIdentity") or "") != identity:
             return  # someone else holds it now; not ours to clear
-        cur.spec.holder_identity = ""
-        cur.spec.renew_time = None
-        if cur.metadata.annotations:
-            cur.metadata.annotations["cook/leader-url"] = ""
+        spec["holderIdentity"] = ""
+        spec["renewTime"] = None
+        meta = cur.setdefault("metadata", {})
+        if meta.get("annotations"):
+            meta["annotations"]["cook/leader-url"] = ""
         try:
-            coord.replace_namespaced_lease(name, self.namespace, cur)
-        except k8s.client.exceptions.ApiException as e:
+            self._request("PUT", self._lease_path(name), body=cur)
+        except ApiError as e:
             if e.status != 409:  # CAS lost: a competitor already took it
                 raise

@@ -149,7 +149,12 @@ class CookDaemon:
         # interleave with the leader's); the election winner re-opens
         # FENCED at the next epoch in _on_leadership, which also replays
         # everything the previous leader committed.
-        self.shared_data = bool(conf.get("shared_data_dir"))
+        sd = conf.get("shared_data_dir")
+        self.shared_data = bool(sd)
+        if isinstance(sd, str) and sd and not self.data_dir:
+            # shared_data_dir may BE the path (the name invites it);
+            # silently running in-memory instead would lose all state
+            self.data_dir = sd
         if not self.data_dir:
             self.store = Store()
         elif self.shared_data:
@@ -185,7 +190,10 @@ class CookDaemon:
             from .sched.election import LeaseLeaderElector
             api = RealKubernetesApi(
                 namespace=election.get("namespace", "cook"),
-                kubeconfig=election.get("kubeconfig"))
+                kubeconfig=election.get("kubeconfig"),
+                base_url=election.get("base_url"),
+                token=election.get("token"),
+                verify_tls=election.get("verify_tls", True))
             self.elector = LeaseLeaderElector(
                 api, identity=election.get("identity") or self.node_url,
                 node_url=self.node_url,
