@@ -43,7 +43,13 @@ class FakeCluster(ComputeCluster):
     """Deterministic fake backend for tests, the simulator, and benchmarks."""
 
     def __init__(self, name: str, hosts: List[FakeHost],
-                 default_task_duration_ms: Optional[int] = None):
+                 default_task_duration_ms: Optional[int] = None,
+                 auto_advance: bool = False):
+        """``auto_advance``: follow the wall clock on a background ticker
+        — for daemon deployments where no simulator drives advance_to, so
+        tasks with durations actually complete.  A ticker (not an
+        advance-on-offers hook) because a DRAINING cluster gets no offer
+        calls yet must still finish its tasks for drain-then-delete."""
         super().__init__(name)
         self._hosts: Dict[str, FakeHost] = {h.hostname: h for h in hosts}
         self._tasks: Dict[str, _RunningTask] = {}
@@ -63,6 +69,19 @@ class FakeCluster(ComputeCluster):
         # per cycle at the 5k-host bench point
         self._consumption: Dict[str, List[float]] = {}
         self._counts: Dict[str, int] = {}
+        self._auto_advance = auto_advance
+        self._ticker_stop = threading.Event()
+        if auto_advance:
+            import time as _time
+
+            def tick():
+                while not self._ticker_stop.wait(0.1):
+                    self.advance_to(int(_time.time() * 1000))
+            threading.Thread(target=tick, daemon=True,
+                             name=f"fake-clock-{name}").start()
+
+    def shutdown(self) -> None:
+        self._ticker_stop.set()
 
     def _consume(self, hostname: str, r: Resources, sign: float) -> None:
         c = self._consumption.get(hostname)
@@ -223,12 +242,17 @@ class FakeCluster(ComputeCluster):
 def factory(store=None, name: str = "fake", n_hosts: int = 4,
             cpus: float = 8.0, mem: float = 8192.0, gpus: float = 0.0,
             pool: str = "default", attributes=None,
-            default_task_duration_ms=None) -> "FakeCluster":
+            default_task_duration_ms=None,
+            auto_advance: bool = False) -> "FakeCluster":
     """Config-driven construction for the daemon (the analog of the
-    reference's compute-cluster factory-fn, compute_cluster.clj:483-497)."""
+    reference's compute-cluster factory-fn, compute_cluster.clj:483-497).
+    In a daemon there is no simulator calling advance_to, so pass
+    ``auto_advance`` (with a duration) when fake tasks should complete in
+    wall time."""
     hosts = [FakeHost(hostname=f"{name}-h{i}", pool=pool,
                       capacity=Resources(cpus=cpus, mem=mem, gpus=gpus),
                       attributes=dict(attributes or {}))
              for i in range(n_hosts)]
     return FakeCluster(name, hosts,
-                       default_task_duration_ms=default_task_duration_ms)
+                       default_task_duration_ms=default_task_duration_ms,
+                       auto_advance=auto_advance)

@@ -114,6 +114,15 @@ class Config:
     autoscaling_enabled: bool = False
     # reapers (scheduler.clj:1888-2016)
     lingering_task_interval_seconds: float = 30.0
+    # dotted factory paths POST /compute-clusters/{name} may instantiate
+    # (the daemon seeds this with its static cluster specs' factories);
+    # empty = dynamic cluster CREATION disabled
+    cluster_factory_allowlist: List[str] = field(default_factory=list)
+    # a running instance whose compute cluster is GONE (the previous
+    # leader's in-process backend, a deleted dynamic cluster) is failed
+    # NODE_LOST (mea-culpa) after this grace window — long enough for a
+    # dynamic re-add, short enough that failover retries promptly
+    orphaned_cluster_grace_seconds: float = 30.0
     straggler_interval_seconds: float = 30.0
     # user/pool gauge sweeper (monitor.clj:209)
     monitor_interval_seconds: float = 30.0

@@ -52,7 +52,7 @@ _SCALAR_CONFIG_FIELDS = (
     "cycle_mode", "default_pool", "autoscaling_enabled",
     "lingering_task_interval_seconds", "straggler_interval_seconds",
     "monitor_interval_seconds", "max_tasks_per_host", "heartbeat_enabled",
-    "heartbeat_timeout_ms",
+    "heartbeat_timeout_ms", "orphaned_cluster_grace_seconds",
 )
 
 
@@ -163,6 +163,11 @@ class CookDaemon:
             self.store = Store.open(self.data_dir)
         sched_spec = dict(conf.get("scheduler", {}))
         self.sched_config = build_scheduler_config(sched_spec)
+        # dynamic cluster creation may instantiate exactly the factories
+        # the operator already declared (plus an explicit allowlist)
+        self.sched_config.cluster_factory_allowlist = sorted(
+            {c["factory"] for c in conf.get("clusters", [])}
+            | set(conf.get("cluster_factory_allowlist", [])))
         self.rank_backend = sched_spec.get("rank_backend", "tpu")
         self.plugins = PluginRegistry.from_config(conf.get("plugins", {}))
         self.rate_limits = RateLimits()

@@ -782,21 +782,62 @@ class CookApi:
 
     def compute_cluster_update(self, name: str, body: Dict,
                                user: str) -> Dict:
-        """State machine running -> draining -> deleted (reference: dynamic
-        cluster config CRUD, compute_cluster.clj:450-594)."""
+        """Dynamic cluster CRUD (reference: compute_cluster.clj:450-594):
+        CREATE a new backend from a factory spec, or drive the state
+        machine running -> draining -> deleted.  Deletion is refused while
+        the cluster still runs tasks (the reference's integration flow
+        polls deleted until the drain empties the cluster,
+        integration/tests/cook/test_dynamic_clusters.py)."""
         self.require_admin(user)
         if self.scheduler is None:
             raise ApiError(503, "no scheduler attached")
         cluster = self.scheduler.clusters.get(name)
         if cluster is None:
-            raise ApiError(404, f"no such cluster {name}")
+            factory = body.get("factory")
+            if not factory:
+                raise ApiError(404, f"no such cluster {name} "
+                                    "(create needs a 'factory' spec)")
+            # an HTTP body must not become a code-loading surface: only
+            # factories the operator pre-declared (static cluster specs /
+            # explicit allowlist, the reference's factory-fn templates)
+            # may be instantiated dynamically
+            allowed = getattr(self.config, "cluster_factory_allowlist",
+                              None) or []
+            if factory not in allowed:
+                raise ApiError(
+                    403, f"factory {factory!r} not in the configured "
+                         "cluster_factory_allowlist")
+            from ..daemon import build_clusters
+            try:
+                [fresh] = build_clusters(
+                    [{"factory": factory,
+                      "kwargs": dict(body.get("kwargs") or {},
+                                     name=name)}], self.store)
+            except Exception as e:
+                raise ApiError(422, f"cluster factory failed: {e}")
+            self.scheduler.add_cluster(fresh)
+            return {"name": name, "state": fresh.state, "created": True}
         new_state = body.get("state")
         legal = {"running": {"draining"}, "draining": {"running", "deleted"}}
         if new_state not in legal.get(cluster.state, set()):
             raise ApiError(422, f"illegal transition {cluster.state} "
                                 f"-> {new_state}")
         if new_state == "deleted":
-            self.scheduler.clusters.pop(name)
+            # backend-agnostic liveness: the store is the source of truth
+            # (a backend-specific probe would silently no-op for adapters
+            # that don't expose one)
+            live = sum(1 for _j, inst in self.store.running_instances()
+                       if inst.compute_cluster == name)
+            if live:
+                raise ApiError(422, f"cluster {name} still runs "
+                                    f"{live} tasks; drain first")
+            gone = self.scheduler.clusters.pop(name)
+            shutdown = getattr(gone, "shutdown", None)
+            if shutdown:
+                try:
+                    shutdown()  # unhook watches/threads (daemon contract)
+                except Exception:
+                    pass
         else:
             cluster.state = new_state
         return {"name": name, "state": new_state}

@@ -88,6 +88,8 @@ class Scheduler:
         self.gc_discipline = True
         self._gc_cycles = 0
         self._gc_collect_due = False
+        # task_id -> first-seen-orphaned ms (reaper grace bookkeeping)
+        self._orphan_first_seen: Dict[str, int] = {}
         # Side-effect worker: cluster kills requested from a thread that
         # already holds that cluster's kill-lock read side (e.g. a tx-event
         # delivered during a launch) must run elsewhere or they self-deadlock.
@@ -445,6 +447,7 @@ class Scheduler:
                     current - inst.start_time_ms > job.max_runtime_ms:
                 self._kill_instance(inst.task_id, Reasons.MAX_RUNTIME_EXCEEDED.code)
                 killed.append(inst.task_id)
+        killed.extend(self._reap_orphaned_cluster_instances(current))
         killed.extend(self._reap_stragglers(current))
         if self.config.heartbeat_enabled:
             for task_id in self.heartbeats.expired(current):
@@ -452,6 +455,34 @@ class Scheduler:
                 self.heartbeats.forget(task_id)
                 killed.append(task_id)
         return killed
+
+    def _reap_orphaned_cluster_instances(self, current_ms: int) -> List[str]:
+        """Fail (NODE_LOST, mea-culpa) running instances whose compute
+        cluster this scheduler does not have — the previous leader's
+        in-process backend after a failover, or a dynamically deleted
+        cluster.  A grace window tolerates a cluster being re-added
+        (reference contract: a new leader re-reads all state and
+        reconciles what its backends can't account for,
+        mesos.clj:296-313 + scheduler.clj:1828-1878)."""
+        grace_ms = self.config.orphaned_cluster_grace_seconds * 1000.0
+        missing = self._orphan_first_seen
+        failed: List[str] = []
+        live = set()
+        for _job, inst in self.store.running_instances():
+            if inst.compute_cluster and \
+                    inst.compute_cluster not in self.clusters:
+                live.add(inst.task_id)
+                first = missing.setdefault(inst.task_id, current_ms)
+                if current_ms - first >= grace_ms:
+                    missing.pop(inst.task_id, None)
+                    self.store.update_instance_status(
+                        inst.task_id, InstanceStatus.FAILED,
+                        reason_code=Reasons.NODE_LOST.code)
+                    failed.append(inst.task_id)
+        for tid in list(missing):
+            if tid not in live:
+                missing.pop(tid)  # cluster came back (or task finished)
+        return failed
 
     def _reap_stragglers(self, current_ms: int) -> List[str]:
         killed: List[str] = []
