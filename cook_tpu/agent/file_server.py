@@ -52,6 +52,10 @@ class _FilesHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/readiness-probe":
+            # kubelet readiness for the sidecar container (the probe
+            # endpoint pod_spec wires into the pod's readinessProbe)
+            return self._respond_json(200, {"status": "ok"})
         params = urllib.parse.parse_qs(parsed.query)
         raw_path = (params.get("path") or [""])[0]
         target = self._resolve(raw_path)
@@ -130,3 +134,29 @@ class SandboxFileServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+
+def main(argv: Optional[list] = None) -> int:
+    """The ``cook-sidecar`` entrypoint pod_spec wires into the sidecar
+    container (``cook-sidecar <port>``; the sidecar image maps that name
+    to ``python -m cook_tpu.agent.file_server``).  Serves the sandbox
+    (``$COOK_SANDBOX``, default cwd) on 0.0.0.0:<port> until killed."""
+    import signal
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    port = int(args[0]) if args else 28101
+    root = os.environ.get("COOK_SANDBOX") or os.environ.get(
+        "COOK_WORKDIR") or "."
+    srv = SandboxFileServer(root, host="0.0.0.0", port=port)
+    srv.start()
+    print(f"cook-sidecar: serving {root} on :{srv.port}", flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - container entrypoint
+    raise SystemExit(main())

@@ -13,6 +13,7 @@ adapter translates it to V1Pod fields 1:1.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, Optional
 
 from ...state.schema import Checkpoint, Job
@@ -27,7 +28,26 @@ CHECKPOINT_MOUNT = "/mnt/checkpoint"
 DEFAULT_CHECKPOINT_INIT_IMAGE = "cook/checkpoint-init:stable"
 DEFAULT_FETCH_INIT_IMAGE = "cook/fetch-init:stable"
 DEFAULT_SIDECAR_IMAGE = "cook/sidecar:stable"
+SIDECAR_PORT = 28101
+SIDECAR_HEALTH_PATH = "/readiness-probe"
+SIDECAR_WORKDIR = "/mnt/sidecar"
+# the file server is infrastructure, not user workload: its requests ride
+# outside the job's resources (reference: sidecar resource-requirements
+# from config, api.clj:1666-1696)
+SIDECAR_CPUS = 0.1
+SIDECAR_MEM_MB = 32.0
 DEFAULT_SHM_MB = 64
+
+
+def _resolve_image(incremental: Optional[Any], key: str, default: str,
+                   job_uuid: str) -> str:
+    """Incremental-config image rollout (reference resolves images per
+    job-uuid hash portion, api.clj:1226 + config_incremental.clj)."""
+    if incremental is not None:
+        resolved = incremental.resolve(key, job_uuid)
+        if resolved:
+            return resolved
+    return default
 
 
 def build_pod_spec(job: Job, pool: str,
@@ -101,11 +121,8 @@ def build_pod_spec(job: Job, pool: str,
         if checkpoint.period_sec:
             env.append({"name": "COOK_CHECKPOINT_PERIOD_SEC",
                         "value": str(checkpoint.period_sec)})
-        init_image = DEFAULT_CHECKPOINT_INIT_IMAGE
-        if incremental is not None:
-            resolved = incremental.resolve("checkpoint-init-image", job.uuid)
-            if resolved:
-                init_image = resolved
+        init_image = _resolve_image(incremental, "checkpoint-init-image",
+                                    DEFAULT_CHECKPOINT_INIT_IMAGE, job.uuid)
         init_containers.append({
             "name": "checkpoint-init",
             "image": init_image,
@@ -118,15 +135,26 @@ def build_pod_spec(job: Job, pool: str,
                            "sub_path": extra.strip("/")})
 
     # URI artifacts: fetched into the shared workdir by an init container
-    # before the job container starts (the k8s analog of the mesos fetcher;
-    # reference: :job/uri handling in task metadata)
+    # before the job container starts — the k8s analog of the mesos
+    # fetcher, with its full per-uri mode set (executable/extract/cache;
+    # reference: :job/uri semantics, mesos fetcher task.clj:114-160)
     if job.uris:
+        fetch_spec = [{"value": u.get("value", ""),
+                       "executable": bool(u.get("executable", False)),
+                       "extract": bool(u.get("extract", False)),
+                       "cache": bool(u.get("cache", False))}
+                      for u in job.uris]
         init_containers.append({
             "name": "cook-fetch",
             "image": DEFAULT_FETCH_INIT_IMAGE,
-            "env": [{"name": "COOK_URIS",
-                     "value": ";".join(
-                         u.get("value", "") for u in job.uris)}],
+            "env": [
+                # structured fetch list: modes survive the wire
+                {"name": "COOK_URIS_JSON",
+                 "value": json.dumps(fetch_spec, sort_keys=True)},
+                # legacy flat form (paths only) kept for older fetchers
+                {"name": "COOK_URIS",
+                 "value": ";".join(u["value"] for u in fetch_spec)},
+            ],
             "volume_mounts": [{"name": "cook-workdir",
                                "mount_path": COOK_WORKDIR}],
             "working_dir": COOK_WORKDIR,
@@ -156,15 +184,37 @@ def build_pod_spec(job: Job, pool: str,
         "working_dir": COOK_WORKDIR,
     }]
     if sidecar:
-        # progress tracker + file server (the reference's sidecar container,
-        # api.clj sidecar handling; our agent/file_server.py is the server)
+        # progress tracker + sandbox file server (the reference's sidecar,
+        # api.clj:1664-1698; our agent/file_server.py is the server):
+        # fixed port + command wiring, HTTP readiness probe on the health
+        # endpoint, own (non-job) resource requests, read-only sandbox
+        # mount, and incremental-config image rollout
+        sidecar_image = _resolve_image(incremental, "sidecar-image",
+                                       DEFAULT_SIDECAR_IMAGE, job.uuid)
+        volumes.append({"name": "cook-sidecar-workdir", "empty_dir": {}})
         containers.append({
             "name": "cook-sidecar",
-            "image": DEFAULT_SIDECAR_IMAGE,
+            "image": sidecar_image,
+            "command": ["cook-sidecar", str(SIDECAR_PORT)],
+            "ports": [SIDECAR_PORT],
             "env": [{"name": "COOK_JOB_UUID", "value": job.uuid},
-                    {"name": "COOK_WORKDIR", "value": COOK_WORKDIR}],
+                    {"name": "COOK_SANDBOX", "value": COOK_WORKDIR},
+                    # DEPRECATED alias of COOK_SANDBOX (reference keeps it
+                    # one release for older sidecars, api.clj:1680)
+                    {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
+                    {"name": "COOK_FILE_SERVER_PORT",
+                     "value": str(SIDECAR_PORT)}],
+            "readiness_probe": {"http_get": {"port": SIDECAR_PORT,
+                                             "path": SIDECAR_HEALTH_PATH}},
+            "resources": {"requests": {"cpu": SIDECAR_CPUS,
+                                       "memory_mb": SIDECAR_MEM_MB},
+                          "limits": {"memory_mb": SIDECAR_MEM_MB}},
             "volume_mounts": [{"name": "cook-workdir",
-                               "mount_path": COOK_WORKDIR}],
+                               "mount_path": COOK_WORKDIR,
+                               "read_only": True},
+                              {"name": "cook-sidecar-workdir",
+                               "mount_path": SIDECAR_WORKDIR}],
+            "working_dir": SIDECAR_WORKDIR,
         })
 
     # priority class from the pool (synthetic pods ride a lower class so

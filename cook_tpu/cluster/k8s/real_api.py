@@ -87,6 +87,28 @@ def rfc3339(ts_s: float) -> str:
         ts_s, tz=datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
+def _resources_to_k8s(res: Dict) -> Dict:
+    """pod_spec's internal resource dicts ({"cpu": float, "memory_mb":
+    float, "gpu": float}) -> Kubernetes resource names/quantities (a real
+    apiserver rejects unknown names like memory_mb)."""
+    out: Dict = {}
+    for section in ("requests", "limits"):
+        vals = res.get(section)
+        if not vals:
+            continue
+        k8s_vals: Dict = {}
+        for k, v in vals.items():
+            if k in ("memory_mb", "mem", "memory"):
+                k8s_vals["memory"] = f"{int(float(v))}Mi"
+            elif k in ("gpu", "gpus", "nvidia.com/gpu"):
+                if float(v):
+                    k8s_vals["nvidia.com/gpu"] = str(int(float(v)))
+            else:
+                k8s_vals["cpu" if k == "cpu" else k] = str(v)
+        out[section] = k8s_vals
+    return out
+
+
 class ApiError(RuntimeError):
     def __init__(self, status: int, body: str = ""):
         super().__init__(f"apiserver HTTP {status}: {body[:200]}")
@@ -311,17 +333,26 @@ class RealKubernetesApi:
             if c.get("ports"):
                 out["ports"] = [{"containerPort": int(p)}
                                 for p in c["ports"]]
+
+            def probe(p):
+                # pod_spec carries {"http_get": {"port", "path"}}; the
+                # wire form is camelCase httpGet
+                if "http_get" in p:
+                    hg = p["http_get"]
+                    return {"httpGet": {"port": int(hg["port"]),
+                                        "path": hg.get("path", "/")}}
+                return p
             if c.get("liveness_probe"):
-                out["livenessProbe"] = c["liveness_probe"]
+                out["livenessProbe"] = probe(c["liveness_probe"])
             if c.get("readiness_probe"):
-                out["readinessProbe"] = c["readiness_probe"]
+                out["readinessProbe"] = probe(c["readiness_probe"])
             out["resources"] = {"requests": {
                 "cpu": str(pod.cpus), "memory": f"{int(pod.mem)}Mi",
                 **({"nvidia.com/gpu": str(int(pod.gpus))}
                    if pod.gpus else {})}}
             res = c.get("resources")
             if res:  # per-container override (sidecar/init containers)
-                out["resources"] = res
+                out["resources"] = _resources_to_k8s(res)
             return out
 
         def volume(v):

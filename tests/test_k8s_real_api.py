@@ -233,13 +233,16 @@ class TestFullBackendOverHttp:
     def test_launch_run_complete(self, mock):
         from cook_tpu.cluster.base import LaunchSpec
         from cook_tpu.cluster.k8s.compute_cluster import KubernetesCluster
-        from cook_tpu.state import InstanceStatus, Resources
+        from cook_tpu.state import (InstanceStatus, Job, Resources, Store)
 
         mock.fake.add_node(FakeNode(name="n1", cpus=8.0, mem=8192.0))
         api = RealKubernetesApi(base_url=mock.base_url,
                                 watch_timeout_s=5.0)
         updates = []
-        cluster = KubernetesCluster("k8s-real", api)
+        store = Store()
+        store.create_jobs([Job(uuid="j1", user="alice", command="echo hi",
+                               resources=Resources(cpus=1.0, mem=256.0))])
+        cluster = KubernetesCluster("k8s-real", api, store=store)
         cluster.initialize(lambda tid, status, reason, **kw:
                            updates.append((tid, status)))
         wait_for(lambda: len(cluster.pending_offers("default")) == 1,
@@ -252,6 +255,31 @@ class TestFullBackendOverHttp:
             env={"COOK_COMMAND": "echo hi"})])
         wait_for(lambda: mock.fake.pod("t1") is not None,
                  msg="pod created over HTTP")
+        # the compiled pod (job + sidecar file server) crossed the wire in
+        # k8s form: camelCase probe, containerPort, per-container resources
+        body = [b for b in mock.last_created_bodies
+                if b["metadata"]["name"] == "t1"][-1]
+        names = [c["name"] for c in body["spec"]["containers"]]
+        assert names == ["cook-job", "cook-sidecar"]
+        side = body["spec"]["containers"][1]
+        assert side["readinessProbe"]["httpGet"]["path"] \
+            == "/readiness-probe"
+        assert side["ports"][0]["containerPort"] == \
+            side["readinessProbe"]["httpGet"]["port"]
+        # internal resource dicts were translated to k8s names/quantities
+        # (a real apiserver rejects e.g. "memory_mb")
+        assert side["resources"]["requests"] == {"cpu": "0.1",
+                                                 "memory": "32Mi"}
+        assert side["resources"]["limits"] == {"memory": "32Mi"}
+        # and the probe endpoint is actually served by our sidecar server
+        import urllib.request as _ur
+        from cook_tpu.agent.file_server import SandboxFileServer
+        import tempfile
+        fs = SandboxFileServer(tempfile.mkdtemp())
+        fs.start()
+        with _ur.urlopen(f"{fs.url}/readiness-probe", timeout=5) as r:
+            assert r.status == 200
+        fs.stop()
         mock.fake.step()   # schedule
         mock.fake.step()   # run
         wait_for(lambda: any(s is InstanceStatus.RUNNING
