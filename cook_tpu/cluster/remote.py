@@ -421,6 +421,12 @@ class RemoteComputeCluster(ComputeCluster):
         elif state == "killed":
             cb(task_id, InstanceStatus.FAILED, Reasons.KILLED_BY_USER.code,
                exit_code=exit_code, hostname=conn.hostname)
+        elif state == "memlimit":
+            # the agent's memory watchdog hard-killed the task tree
+            # (reference: "Container memory limit exceeded")
+            cb(task_id, InstanceStatus.FAILED,
+               Reasons.MEMORY_LIMIT_EXCEEDED.code,
+               exit_code=exit_code, hostname=conn.hostname)
         else:  # failed
             cb(task_id, InstanceStatus.FAILED, Reasons.NON_ZERO_EXIT.code,
                exit_code=exit_code, hostname=conn.hostname)

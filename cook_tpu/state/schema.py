@@ -92,6 +92,10 @@ class Reasons:
     # stuck/unschedulable pod reaped by the detector
     # (reference: kubernetes/api.clj:1820-1846)
     POD_STUCK = Reason(15, "pod-stuck", mea_culpa=True, failure_limit=3)
+    # task exceeded its requested memory and was hard-killed by the agent
+    # (reference: "Container memory limit exceeded", reason 2002 in
+    # reason.clj — the user's fault, consumes a retry)
+    MEMORY_LIMIT_EXCEEDED = Reason(16, "memory-limit-exceeded")
 
     _by_code: Dict[int, Reason] = {}
     _by_name: Dict[str, Reason] = {}

@@ -37,6 +37,7 @@
 //                     RECONCILE_DONE()  PONG()
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -57,7 +58,9 @@
 #include <cstdio>
 #include <cstdlib>
 #include <deque>
+#include <fstream>
 #include <map>
+#include <sstream>
 #include <mutex>
 #include <set>
 #include <string>
@@ -150,9 +153,14 @@ void mkdir_p(const std::string& path) {
 
 struct AgentTask {
   pid_t pid = -1;
-  std::string state;  // running | finished | failed | killed
+  std::string state;  // running | finished | failed | killed | memlimit
   int exit_code = 0;
   bool kill_requested = false;
+  // memory-limit enforcement (the reference executor's "Container memory
+  // limit exceeded" semantics): LAUNCH's mem is the budget; the monitor
+  // sums the task session's RSS and hard-kills on breach
+  double mem_mb = 0;
+  bool oom_killed = false;
   std::string sandbox;
   std::vector<int> ports;      // host ports assigned to this task
   std::string ports_csv;       // same, pre-joined for STATUS frames
@@ -276,9 +284,11 @@ void agent_reaper() {
           int code = WIFEXITED(st) ? WEXITSTATUS(st)
                                    : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
           kv.second.exit_code = code;
-          kv.second.state = kv.second.kill_requested
-                                ? "killed"
-                                : (code == 0 ? "finished" : "failed");
+          kv.second.state = kv.second.oom_killed
+                                ? "memlimit"
+                                : kv.second.kill_requested
+                                      ? "killed"
+                                      : (code == 0 ? "finished" : "failed");
           release_ports_locked(&kv.second);
           note_terminal_locked(kv.first);
           if (kv.second.running_sent) {
@@ -297,13 +307,101 @@ void agent_reaper() {
   }
 }
 
+// One /proc walk: memory (MiB) per session id.  The task child setsid()s,
+// so its whole tree shares one session.  Prefer smaps_rollup's Pss
+// (proportional share — summed VmRSS would double-count CoW pages across
+// a forking workload's children); fall back to VmRSS where smaps_rollup
+// is unavailable.  stat's comm field may contain spaces/parens — parse
+// from the last ')'.
+std::map<pid_t, double> rss_by_session_mb() {
+  std::map<pid_t, double> out;
+  DIR* d = ::opendir("/proc");
+  if (!d) return out;
+  struct dirent* e;
+  while ((e = ::readdir(d)) != nullptr) {
+    if (e->d_name[0] < '0' || e->d_name[0] > '9') continue;
+    std::string base = std::string("/proc/") + e->d_name;
+    std::ifstream stat(base + "/stat");
+    std::string line;
+    if (!std::getline(stat, line)) continue;
+    size_t rp = line.rfind(')');
+    if (rp == std::string::npos) continue;
+    std::istringstream rest(line.substr(rp + 1));
+    std::string state_c, ppid, pgrp, session;
+    rest >> state_c >> ppid >> pgrp >> session;
+    pid_t sid = static_cast<pid_t>(std::atoi(session.c_str()));
+    if (sid <= 0) continue;
+    double kb = -1;
+    {
+      std::ifstream rollup(base + "/smaps_rollup");
+      while (std::getline(rollup, line)) {
+        if (line.compare(0, 4, "Pss:") == 0) {
+          kb = std::atof(line.c_str() + 4);
+          break;
+        }
+      }
+    }
+    if (kb < 0) {
+      std::ifstream status(base + "/status");
+      while (std::getline(status, line)) {
+        if (line.compare(0, 6, "VmRSS:") == 0) {
+          kb = std::atof(line.c_str() + 6);
+          break;
+        }
+      }
+    }
+    if (kb > 0) out[sid] += kb / 1024.0;
+  }
+  ::closedir(d);
+  return out;
+}
+
+// Memory-limit monitor (the reference executor's memory watchdog: a task
+// over its requested mem is hard-killed and reported distinctly).
+// Containerized tasks are NOT watched here — their budget travels as the
+// runtime's --memory flag (the session only contains the runtime client,
+// whose RSS says nothing about the workload inside the container).
+void agent_mem_monitor() {
+  for (;;) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(250));
+    std::vector<std::pair<std::string, std::pair<pid_t, double>>> watched;
+    {
+      std::lock_guard<std::mutex> lk(g_agent->mu);
+      for (const auto& kv : g_agent->tasks) {
+        if (kv.second.state == "running" && kv.second.mem_mb > 0)
+          watched.push_back({kv.first, {kv.second.pid, kv.second.mem_mb}});
+      }
+    }
+    if (watched.empty()) continue;
+    std::map<pid_t, double> rss = rss_by_session_mb();
+    for (const auto& w : watched) {
+      auto it_rss = rss.find(w.second.first);
+      if (it_rss == rss.end() || it_rss->second <= w.second.second)
+        continue;
+      std::lock_guard<std::mutex> lk(g_agent->mu);
+      auto it = g_agent->tasks.find(w.first);
+      if (it != g_agent->tasks.end() && it->second.state == "running" &&
+          it->second.pid == w.second.first && !it->second.oom_killed) {
+        it->second.oom_killed = true;
+        ::kill(-w.second.first, SIGKILL);
+      }
+    }
+  }
+}
+
 void agent_launch(const std::string& task_id, const std::string& command,
                   const std::string& env_kv, int n_ports,
-                  const std::string& image, const std::string& volumes) {
+                  const std::string& image, const std::string& volumes,
+                  double mem_mb = 0) {
   std::string sandbox = g_agent->workdir + "/" + task_id;
   ::mkdir(sandbox.c_str(), 0755);
   AgentTask t;
   t.sandbox = sandbox;
+  bool containerized =
+      !image.empty() && !g_agent->container_runtime.empty();
+  // containerized tasks get their budget as the runtime's --memory flag
+  // below; the RSS watchdog only covers direct-exec tasks
+  t.mem_mb = containerized ? 0 : mem_mb;
   // env pairs (K=V joined by 0x1e) and container volumes (host:cont, 0x1e)
   std::vector<std::string> env_pairs = split_on(env_kv, '\x1e');
   std::vector<std::string> vols = split_on(volumes, '\x1e');
@@ -366,6 +464,12 @@ void agent_launch(const std::string& task_id, const std::string& command,
               g_agent->container_runtime, "run", "--rm",
               "--name", "cook-" + task_id,
               "-v", sandbox + ":/mnt/sandbox"};
+          if (mem_mb > 0) {
+            // kernel-enforced budget (the cgroup does what the RSS
+            // watchdog does for direct-exec tasks)
+            args.push_back("--memory");
+            args.push_back(std::to_string(static_cast<long>(mem_mb)) + "m");
+          }
           for (const auto& v : vols) {
             args.push_back("-v");
             args.push_back(v);
@@ -481,7 +585,8 @@ void agent_connection(int fd) {
                    f.size() > 5 ? f[5] : "",
                    f.size() > 6 ? std::atoi(f[6].c_str()) : 0,
                    f.size() > 7 ? f[7] : "",
-                   f.size() > 8 ? f[8] : "");
+                   f.size() > 8 ? f[8] : "",
+                   f.size() > 4 ? std::atof(f[4].c_str()) : 0);
     } else if (type == "KILL" && f.size() >= 3) {
       agent_kill(f[1], std::atoi(f[2].c_str()));
     } else if (type == "RECONCILE") {
@@ -570,6 +675,7 @@ int agent_main(int argc, char** argv) {
   ::printf("PORT %d\n", ntohs(addr.sin_port));
   ::fflush(stdout);
   std::thread(agent_reaper).detach();
+  std::thread(agent_mem_monitor).detach();
   for (;;) {
     int cfd = ::accept4(lfd, nullptr, nullptr, SOCK_CLOEXEC);
     if (cfd < 0) {

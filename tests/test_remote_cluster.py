@@ -656,3 +656,71 @@ class TestCookExecutorChoice:
         assert wait_for(workload_gone, timeout=10), \
             "user command survived the kill"
         cluster.shutdown()
+
+
+class TestMemoryLimit:
+    """The agent's memory watchdog (reference integration tier:
+    test_basic.py memory-limit scenarios — 'Container memory limit
+    exceeded'): a task whose session RSS exceeds its requested mem is
+    hard-killed and reported with the distinct memlimit reason."""
+
+    def test_over_limit_killed_under_limit_survives(self, tmp_path):
+        import time as _time
+
+        from cook_tpu.cluster.remote import (LocalAgentProcess,
+                                             RemoteComputeCluster)
+        from cook_tpu.cluster.base import LaunchSpec
+        from cook_tpu.state import (InstanceStatus, Job, Reasons,
+                                    Resources, Store)
+
+        agent = LocalAgentProcess("memnode", cpus=4, mem=4096,
+                                  workdir=str(tmp_path))
+        store = Store()
+        # hog: a python process growing well past its 32 MiB budget;
+        # the task command comes from the store's Job (task compilation)
+        hog = ("python3 -c \"import time\nx=[]\n"
+               "for i in range(400): x.append(' '*1048576)\n"
+               "time.sleep(60)\"")
+        store.create_jobs([
+            Job(uuid="00000000-0000-0000-0000-00000000f00d", user="u",
+                command=hog, resources=Resources(cpus=1.0, mem=32.0)),
+            Job(uuid="00000000-0000-0000-0000-00000000beef", user="u",
+                command="sleep 2",
+                resources=Resources(cpus=1.0, mem=256.0))])
+        cluster = RemoteComputeCluster(
+            "mem-test", [("127.0.0.1", agent.port)], store=store)
+        updates = []
+        cluster.initialize(
+            lambda tid, status, reason, **kw:
+            updates.append((tid, status, reason)))
+        try:
+            cluster.launch_tasks("default", [LaunchSpec(
+                task_id="mem-hog",
+                job_uuid="00000000-0000-0000-0000-00000000f00d",
+                hostname="memnode", slave_id="memnode",
+                resources=Resources(cpus=1.0, mem=32.0), env={})])
+            # well-behaved neighbor under the same agent
+            cluster.launch_tasks("default", [LaunchSpec(
+                task_id="mem-ok",
+                job_uuid="00000000-0000-0000-0000-00000000beef",
+                hostname="memnode", slave_id="memnode",
+                resources=Resources(cpus=1.0, mem=256.0), env={})])
+            deadline = _time.time() + 30
+            while _time.time() < deadline:
+                if any(t == "mem-hog" and s is InstanceStatus.FAILED
+                       for t, s, _ in updates) and \
+                   any(t == "mem-ok" and s is InstanceStatus.SUCCESS
+                       for t, s, _ in updates):
+                    break
+                _time.sleep(0.2)
+            hog_final = [r for t, s, r in updates
+                         if t == "mem-hog" and s is InstanceStatus.FAILED]
+            assert hog_final, f"hog not killed: {updates}"
+            assert hog_final[0] == Reasons.MEMORY_LIMIT_EXCEEDED.code, \
+                updates
+            ok_final = [s for t, s, _ in updates if t == "mem-ok"
+                        and s is not InstanceStatus.RUNNING]
+            assert ok_final == [InstanceStatus.SUCCESS], updates
+        finally:
+            cluster.shutdown()
+            agent.stop()
