@@ -301,11 +301,19 @@ def cmd_ssh(args) -> int:
 
 
 def cmd_config(args) -> int:
-    cfg = {"clusters": [{"name": "default", "url": u}
-                        for u in load_urls(args)]}
+    # merge into the existing file: clobbering it would silently delete
+    # unrelated keys (the plugins mapping, custom settings)
+    try:
+        cfg = json.loads(CONFIG_PATH.read_text()) \
+            if CONFIG_PATH.exists() else {}
+    except (OSError, json.JSONDecodeError):
+        cfg = {}
     if args.set_url:
-        cfg = {"clusters": [{"name": "default", "url": args.set_url}]}
+        cfg["clusters"] = [{"name": "default", "url": args.set_url}]
         CONFIG_PATH.write_text(json.dumps(cfg, indent=2))
+    else:
+        cfg.setdefault("clusters", [{"name": "default", "url": u}
+                                    for u in load_urls(args)])
     out(cfg)
     return 0
 
@@ -409,7 +417,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("config")
     sp.add_argument("--set-url", dest="set_url")
     sp.set_defaults(fn=cmd_config)
+    _register_plugins(sub)
     return p
+
+
+def _register_plugins(subparsers) -> None:
+    """Subcommand plugins (reference: cli/cook/plugins.py + the
+    test_cli_subcommand_plugin integration tier): ~/.cs.json may carry
+    {"plugins": {"<name>": "dotted.module:register"}}; each register
+    callable gets the subparsers object and adds its own parser (with
+    set_defaults(fn=...)).  A broken plugin is reported and skipped — it
+    must not take the whole CLI down."""
+    import importlib
+    try:
+        cfg = json.loads(CONFIG_PATH.read_text()) \
+            if CONFIG_PATH.exists() else {}
+    except (OSError, json.JSONDecodeError):
+        return
+    for name, path in (cfg.get("plugins") or {}).items():
+        try:
+            module, _, attr = path.partition(":")
+            register = getattr(importlib.import_module(module),
+                               attr or "register")
+            register(subparsers)
+        except Exception as e:  # noqa: BLE001 - plugin faults are isolated
+            print(f"warning: cli plugin {name!r} ({path}) failed to "
+                  f"load: {e}", file=sys.stderr)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

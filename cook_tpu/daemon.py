@@ -182,7 +182,8 @@ class CookDaemon:
             admins=conf.get("admins"), impersonators=conf.get("impersonators"),
             basic_auth_users=conf.get("basic_auth_users"),
             authenticators=build_authenticators(conf),
-            cors_origins=conf.get("cors_origins"))
+            cors_origins=conf.get("cors_origins"),
+            ip_requests_per_minute=conf.get("ip_requests_per_minute"))
         self.server = ApiServer(self.api, host=self.host, port=self.port)
         self.server.start()
         self.node_url = f"http://{self.host}:{self.server.port}"

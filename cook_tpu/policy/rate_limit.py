@@ -65,6 +65,27 @@ class TokenBucketRateLimiter:
             return True
         return self.get_token_count(key) > 0
 
+    def try_spend(self, key: str, n: float = 1.0,
+                  max_keys: int = 65536) -> bool:
+        """Atomic check-and-spend: admit only when the key holds >= n full
+        tokens (a separate check-then-spend would let N concurrent callers
+        all pass on one token).  Also bounds the bucket map: keys are
+        caller-controlled for HTTP clients, so idle (fully refilled)
+        buckets are evicted once the map exceeds ``max_keys``."""
+        if not self.enforce:
+            return True
+        with self._lock:
+            if len(self._buckets) > max_keys:
+                full = [k for k, b in self._buckets.items()
+                        if b.tokens >= self.bucket_size and k != key]
+                for k in full:
+                    del self._buckets[k]
+            bucket = self._refresh(key)
+            if bucket.tokens < n:
+                return False
+            bucket.tokens -= n
+            return True
+
     def time_until_out_of_debt_s(self, key: str) -> float:
         with self._lock:
             tokens = self._refresh(key).tokens

@@ -251,7 +251,8 @@ class CookApi:
                  elector=None, node_url: str = "",
                  basic_auth_users: Optional[Dict[str, str]] = None,
                  cors_origins: Optional[List[str]] = None,
-                 authenticators: Optional[List] = None):
+                 authenticators: Optional[List] = None,
+                 ip_requests_per_minute: Optional[float] = None):
         from ..policy.incremental import IncrementalConfig
         self.store = store
         self.scheduler = scheduler
@@ -267,6 +268,15 @@ class CookApi:
         # elected leader (reference: leader-redirect, api-only? config.clj:692)
         self.elector = elector
         self.node_url = node_url
+        # HTTP-level per-client-IP throttle (reference: ip-rate-limit
+        # middleware wrapping the handler, components.clj:214-221);
+        # None = unlimited
+        self.ip_limiter = None
+        if ip_requests_per_minute:
+            from ..policy.rate_limit import TokenBucketRateLimiter
+            self.ip_limiter = TokenBucketRateLimiter(
+                tokens_per_minute=float(ip_requests_per_minute),
+                bucket_size=float(ip_requests_per_minute))
         self.incremental = IncrementalConfig()
         # HTTP-basic verification (reference: basic_auth.clj). None = "open"
         # mode: the username is taken from Basic/X-Cook-User unverified.
@@ -956,8 +966,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _check_ip_limit(self) -> bool:
+        """Admit or 429 this request per the client-IP bucket (covers
+        every verb incl. OPTIONS — the reference's middleware wraps the
+        whole handler).  try_spend is atomic: a full token per request,
+        so the fractional refill trickle never admits a burst."""
+        limiter = self.api.ip_limiter
+        if limiter is None or limiter.try_spend(self.client_address[0]):
+            return True
+        self._respond(429, {"error": "too many requests from this "
+                                     "address"})
+        return False
+
     def _route(self, method: str) -> None:
         try:
+            if not self._check_ip_limit():
+                return
             self._auth_user = self._authenticate()
             parsed = urllib.parse.urlparse(self.path)
             params = urllib.parse.parse_qs(parsed.query)
@@ -1078,6 +1102,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_OPTIONS(self):
         """CORS preflight (reference: cors.clj preflight handling): 200 with
         allow headers for an allowed origin, 403 otherwise."""
+        if not self._check_ip_limit():
+            return
         origin = self.headers.get("Origin", "")
         if not self.api.origin_allowed(origin):
             self._respond(403, {"error": f"Origin {origin} not allowed"})
