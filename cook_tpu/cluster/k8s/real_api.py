@@ -160,7 +160,10 @@ class RealKubernetesApi:
         self.watch_timeout_s = watch_timeout_s
         self._ctx = ctx
         if self.base_url.startswith("https") and not verify_tls:
-            self._ctx = ssl.create_default_context()
+            if self._ctx is None:
+                self._ctx = ssl.create_default_context()
+            # downgrade IN PLACE: rebuilding would drop a kubeconfig's
+            # client-certificate (mTLS) identity
             self._ctx.check_hostname = False
             self._ctx.verify_mode = ssl.CERT_NONE
         self._rv = 0

@@ -151,9 +151,15 @@ class CookDaemon:
         # everything the previous leader committed.
         sd = conf.get("shared_data_dir")
         self.shared_data = bool(sd)
-        if isinstance(sd, str) and sd and not self.data_dir:
-            # shared_data_dir may BE the path (the name invites it);
-            # silently running in-memory instead would lose all state
+        if isinstance(sd, str) and sd:
+            # shared_data_dir may BE the path (the name invites it).  It
+            # always wins over data_dir: fencing a node-local dir while
+            # the operator believes shared-journal failover is active
+            # would silently lose ALL state on the first real failover.
+            if self.data_dir and self.data_dir != sd:
+                print(f"cook_tpu: shared_data_dir={sd!r} overrides "
+                      f"data_dir={self.data_dir!r} (HA state must live "
+                      "on the shared path)", flush=True)
             self.data_dir = sd
         if not self.data_dir:
             self.store = Store()

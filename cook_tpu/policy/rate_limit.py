@@ -76,10 +76,18 @@ class TokenBucketRateLimiter:
             return True
         with self._lock:
             if len(self._buckets) > max_keys:
-                full = [k for k, b in self._buckets.items()
-                        if b.tokens >= self.bucket_size and k != key]
-                for k in full:
-                    del self._buckets[k]
+                # bound the map UNCONDITIONALLY: under a flood of unique
+                # keys nothing is fully refilled, so evicting only idle
+                # buckets would let the map (and this scan) grow forever.
+                # Drop the longest-untouched eighth — rare once it evicts
+                # enough, so the amortized cost is O(1) per call.
+                import heapq
+                drop = max(1024, len(self._buckets) - max_keys)
+                for k in heapq.nsmallest(
+                        drop, self._buckets,
+                        key=lambda k: self._buckets[k].last_update_s):
+                    if k != key:
+                        del self._buckets[k]
             bucket = self._refresh(key)
             if bucket.tokens < n:
                 return False

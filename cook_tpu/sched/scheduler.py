@@ -442,13 +442,18 @@ class Scheduler:
         rule (scheduler.clj:1955-1986)."""
         current = current_ms if current_ms is not None else self.clock()
         killed: List[str] = []
-        for job, inst in self.store.running_instances():
+        # ONE materializing scan shared by every reaper: each
+        # running_instances() call deep-clones the full live set under the
+        # store lock, so repeating it per-reaper at the 100k design point
+        # would stall concurrent transactions
+        running = self.store.running_instances()
+        for job, inst in running:
             if job.max_runtime_ms and inst.start_time_ms and \
                     current - inst.start_time_ms > job.max_runtime_ms:
                 self._kill_instance(inst.task_id, Reasons.MAX_RUNTIME_EXCEEDED.code)
                 killed.append(inst.task_id)
-        killed.extend(self._reap_orphaned_cluster_instances(current))
-        killed.extend(self._reap_stragglers(current))
+        killed.extend(self._reap_orphaned_cluster_instances(current, running))
+        killed.extend(self._reap_stragglers(current, running))
         if self.config.heartbeat_enabled:
             for task_id in self.heartbeats.expired(current):
                 self._kill_instance(task_id, Reasons.HEARTBEAT_LOST.code)
@@ -456,7 +461,8 @@ class Scheduler:
                 killed.append(task_id)
         return killed
 
-    def _reap_orphaned_cluster_instances(self, current_ms: int) -> List[str]:
+    def _reap_orphaned_cluster_instances(self, current_ms: int,
+                                         running=None) -> List[str]:
         """Fail (NODE_LOST, mea-culpa) running instances whose compute
         cluster this scheduler does not have — the previous leader's
         in-process backend after a failover, or a dynamically deleted
@@ -468,7 +474,9 @@ class Scheduler:
         missing = self._orphan_first_seen
         failed: List[str] = []
         live = set()
-        for _job, inst in self.store.running_instances():
+        if running is None:
+            running = self.store.running_instances()
+        for _job, inst in running:
             if inst.compute_cluster and \
                     inst.compute_cluster not in self.clusters:
                 live.add(inst.task_id)
@@ -484,10 +492,13 @@ class Scheduler:
                 missing.pop(tid)  # cluster came back (or task finished)
         return failed
 
-    def _reap_stragglers(self, current_ms: int) -> List[str]:
+    def _reap_stragglers(self, current_ms: int,
+                         running=None) -> List[str]:
         killed: List[str] = []
         groups: Dict[str, List] = {}
-        for job, inst in self.store.running_instances():
+        if running is None:
+            running = self.store.running_instances()
+        for job, inst in running:
             if job.group:
                 groups.setdefault(job.group, []).append((job, inst))
         for group_uuid, members in groups.items():
