@@ -69,24 +69,35 @@ class TokenBucketRateLimiter:
                   max_keys: int = 65536) -> bool:
         """Atomic check-and-spend: admit only when the key holds >= n full
         tokens (a separate check-then-spend would let N concurrent callers
-        all pass on one token).  Also bounds the bucket map: keys are
-        caller-controlled for HTTP clients, so idle (fully refilled)
-        buckets are evicted once the map exceeds ``max_keys``."""
+        all pass on one token).
+
+        The bucket map is bounded at ~``max_keys`` (keys are
+        caller-controlled for HTTP clients).  Eviction preference:
+        (1) fully-refilled buckets — lossless, an evicted key is
+        recreated in the same full state; (2) longest-untouched buckets
+        that are NOT in debt — evicting a throttled (in-debt) client
+        would recreate it full and forgive the throttle; (3) only when
+        everything is in debt (pathological flood), oldest-touched
+        regardless — bounded memory wins."""
         if not self.enforce:
             return True
         with self._lock:
             if len(self._buckets) > max_keys:
-                # bound the map UNCONDITIONALLY: under a flood of unique
-                # keys nothing is fully refilled, so evicting only idle
-                # buckets would let the map (and this scan) grow forever.
-                # Drop the longest-untouched eighth — rare once it evicts
-                # enough, so the amortized cost is O(1) per call.
                 import heapq
-                drop = max(1024, len(self._buckets) - max_keys)
-                for k in heapq.nsmallest(
-                        drop, self._buckets,
-                        key=lambda k: self._buckets[k].last_update_s):
-                    if k != key:
+                need = max(1024, len(self._buckets) - max_keys)
+                full = [k for k, b in self._buckets.items()
+                        if b.tokens >= self.bucket_size and k != key]
+                for k in full[:need]:
+                    del self._buckets[k]
+                need -= min(need, len(full))
+                if need > 0:
+                    solvent = [k for k, b in self._buckets.items()
+                               if b.tokens >= 0 and k != key]
+                    pool = solvent if len(solvent) >= need else [
+                        k for k in self._buckets if k != key]
+                    for k in heapq.nsmallest(
+                            need, pool,
+                            key=lambda k: self._buckets[k].last_update_s):
                         del self._buckets[k]
             bucket = self._refresh(key)
             if bucket.tokens < n:

@@ -452,8 +452,14 @@ class Scheduler:
                     current - inst.start_time_ms > job.max_runtime_ms:
                 self._kill_instance(inst.task_id, Reasons.MAX_RUNTIME_EXCEEDED.code)
                 killed.append(inst.task_id)
-        killed.extend(self._reap_orphaned_cluster_instances(current, running))
-        killed.extend(self._reap_stragglers(current, running))
+        # the snapshot is shared, so downstream reapers must skip tasks an
+        # earlier reaper already killed this tick (a stale entry would get
+        # a duplicate kill RPC and a duplicate task_id in the result)
+        done = set(killed)
+        killed.extend(self._reap_orphaned_cluster_instances(
+            current, running, skip=done))
+        done.update(killed)
+        killed.extend(self._reap_stragglers(current, running, skip=done))
         if self.config.heartbeat_enabled:
             for task_id in self.heartbeats.expired(current):
                 self._kill_instance(task_id, Reasons.HEARTBEAT_LOST.code)
@@ -462,7 +468,8 @@ class Scheduler:
         return killed
 
     def _reap_orphaned_cluster_instances(self, current_ms: int,
-                                         running=None) -> List[str]:
+                                         running=None,
+                                         skip=frozenset()) -> List[str]:
         """Fail (NODE_LOST, mea-culpa) running instances whose compute
         cluster this scheduler does not have — the previous leader's
         in-process backend after a failover, or a dynamically deleted
@@ -477,6 +484,8 @@ class Scheduler:
         if running is None:
             running = self.store.running_instances()
         for _job, inst in running:
+            if inst.task_id in skip:
+                continue
             if inst.compute_cluster and \
                     inst.compute_cluster not in self.clusters:
                 live.add(inst.task_id)
@@ -493,12 +502,14 @@ class Scheduler:
         return failed
 
     def _reap_stragglers(self, current_ms: int,
-                         running=None) -> List[str]:
+                         running=None, skip=frozenset()) -> List[str]:
         killed: List[str] = []
         groups: Dict[str, List] = {}
         if running is None:
             running = self.store.running_instances()
         for job, inst in running:
+            if inst.task_id in skip:
+                continue
             if job.group:
                 groups.setdefault(job.group, []).append((job, inst))
         for group_uuid, members in groups.items():

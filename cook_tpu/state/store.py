@@ -337,6 +337,7 @@ class Store:
         jobs = list(jobs)
 
         def _create(txn: _Txn) -> List[str]:
+            now = self.clock()  # one clock read per batch, not per job
             for group in groups:
                 existing = txn.group(group.uuid)
                 if existing is not None:
@@ -349,7 +350,7 @@ class Store:
                     txn.abort(f"duplicate job uuid {job.uuid}")
                 job = fast_clone(job)
                 if not job.submit_time_ms:
-                    job.submit_time_ms = self.clock()
+                    job.submit_time_ms = now
                 job.last_waiting_start_ms = job.submit_time_ms
                 job.committed = latch is None
                 txn.put("jobs", job.uuid, job)
