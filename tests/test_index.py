@@ -266,3 +266,30 @@ class TestIncrementalOrderCache:
         store.create_jobs([make_job("bob") for _ in range(3)], latch="L")
         store.commit_latch("L")
         assert self._cached_order(store) == self._cold_order(store)
+
+    def test_compaction_invalidates_and_reseeds_cache(self):
+        """Compaction remaps row indices; a live order cache must be
+        invalidated and reseeded, staying bit-identical to a cold
+        rebuild (compaction needs >4096 dead rows, beyond the churn
+        test's scale)."""
+        store = Store()
+        store.ensure_index()
+        jobs = [make_job(f"u{i % 5}", priority=int(i % 100), submit=i)
+                for i in range(9000)]
+        store.create_jobs(jobs)
+        assert self._cached_order(store) == self._cold_order(store)
+        # run most jobs to completion: their rows go dead
+        for j in jobs[:6500]:
+            tid = new_uuid()
+            store.launch_instance(j.uuid, tid, "h1")
+            store.update_instance_status(tid, InstanceStatus.RUNNING)
+            store.update_instance_status(tid, InstanceStatus.SUCCESS)
+        idx = store.ensure_index()
+        n_before = idx._n
+        cached = self._cached_order(store)   # triggers _maybe_compact
+        assert idx._n < n_before             # compaction actually ran
+        assert cached == self._cold_order(store)
+        # and the reseeded cache keeps repairing correctly
+        fresh = [make_job("u9", priority=77) for _ in range(10)]
+        store.create_jobs(fresh)
+        assert self._cached_order(store) == self._cold_order(store)
