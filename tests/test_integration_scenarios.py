@@ -264,3 +264,68 @@ class TestFederationFailover:
         fresh = r.stdout.strip().splitlines()[-1].split()[-1]
         r = cli("wait", fresh, "--timeout", "60")
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestMultiClusterFederation:
+    """Two INDEPENDENT cook clusters (own stores, own elections — the
+    reference's test_multi_cluster.py shape, distinct from
+    leader/follower): a federated CLI resolves jobs from whichever
+    cluster owns them and dedupes by uuid."""
+
+    def test_cli_resolves_across_independent_clusters(self, tmp_path,
+                                                      procs):
+        def conf(node):
+            d = tmp_path / node
+            d.mkdir()
+            return {
+                "host": "127.0.0.1", "port": 0,
+                "data_dir": str(d / "data"),
+                "election_dir": str(d),       # SEPARATE election: both lead
+                "admins": ["admin"],
+                "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                              "kwargs": {"name": f"fake-{node}",
+                                         "n_hosts": 2,
+                                         "default_task_duration_ms": 200,
+                                         "auto_advance": True}}],
+                "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                              "match_interval_seconds": 0.1,
+                              "rank_interval_seconds": 0.1},
+            }
+
+        pa = spawn(conf("a"), tmp_path, "a")
+        procs.append(pa)
+        url_a = wait_serving(pa)
+        pb = spawn(conf("b"), tmp_path, "b")
+        procs.append(pb)
+        url_b = wait_serving(pb)
+        assert wait_leader(url_a) and wait_leader(url_b)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   COOK_URL=f"{url_a},{url_b}", COOK_USER="admin",
+                   HOME=str(tmp_path))
+
+        def cli(*args, timeout=60):
+            return subprocess.run(
+                [sys.executable, "-m", "cook_tpu.cli.main", *args],
+                capture_output=True, text=True, cwd=REPO, env=env,
+                timeout=timeout)
+
+        # submit lands on cluster A (first federation url)
+        r = cli("submit", "--cpus", "1", "--mem", "64", "true")
+        assert r.returncode == 0, r.stdout + r.stderr
+        uuid = r.stdout.strip().splitlines()[-1]
+        # B has no such job; the federated show resolves it from A —
+        # exactly once (dedup by uuid)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("GET", f"{url_b}/jobs/{uuid}")
+        assert ei.value.code == 404
+        r = cli("show", uuid)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count(uuid) >= 1
+        shown = json.loads(r.stdout)
+        entries = shown if isinstance(shown, list) else [shown]
+        assert len([e for e in entries
+                    if e.get("uuid") == uuid]) == 1
+        # wait completes through the owning cluster
+        r = cli("wait", uuid, "--timeout", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
