@@ -972,10 +972,15 @@ class _Handler(BaseHTTPRequestHandler):
         whole handler).  try_spend is atomic: a full token per request,
         so the fractional refill trickle never admits a burst."""
         limiter = self.api.ip_limiter
-        if limiter is None or limiter.try_spend(self.client_address[0]):
+        ip = self.client_address[0]
+        if limiter is None or limiter.try_spend(ip):
             return True
+        # one token's worth of refill is when the next request can pass
+        retry_s = max(1, int(60.0 / max(limiter.tokens_per_minute, 1e-9))
+                      + int(limiter.time_until_out_of_debt_s(ip)))
         self._respond(429, {"error": "too many requests from this "
-                                     "address"})
+                                     "address"},
+                      extra_headers={"Retry-After": str(retry_s)})
         return False
 
     def _route(self, method: str) -> None:
