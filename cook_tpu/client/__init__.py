@@ -14,6 +14,11 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+# a completed job renders as success|failed (the server resolves the raw
+# completed state from instances, reference: tools.clj:310-321); "completed"
+# is kept for compatibility with older servers
+TERMINAL_STATES = frozenset({"completed", "success", "failed"})
+
 
 class JobClientError(Exception):
     def __init__(self, status: int, message: str):
@@ -118,9 +123,28 @@ class JobClient:
     def kill(self, uuids: Sequence[str]) -> Dict:
         return self._request("DELETE", "/jobs", params={"uuid": list(uuids)})
 
-    def retry(self, uuid: str, retries: int) -> Dict:
-        return self._request("POST", "/retry",
-                             body={"job": uuid, "retries": retries})
+    def retry(self, uuid: Optional[str] = None, retries: Optional[int] = None,
+              *, jobs: Optional[Sequence[str]] = None,
+              groups: Optional[Sequence[str]] = None,
+              increment: Optional[int] = None,
+              failed_only: Optional[bool] = None) -> Dict:
+        """PUT /retry (reference: UpdateRetriesRequest rest/api.clj:2480):
+        raise retries to ``retries`` or by ``increment`` on jobs and/or
+        groups; ``failed_only`` defaults server-side to True iff groups."""
+        body: Dict[str, Any] = {}
+        if uuid is not None:
+            body["job"] = uuid
+        if jobs is not None:
+            body["jobs"] = list(jobs)
+        if groups is not None:
+            body["groups"] = list(groups)
+        if retries is not None:
+            body["retries"] = retries
+        if increment is not None:
+            body["increment"] = increment
+        if failed_only is not None:
+            body["failed_only"] = failed_only
+        return self._request("PUT", "/retry", body=body)
 
     def wait(self, uuids: Sequence[str], timeout_s: float = 300.0,
              poll_s: float = 0.5) -> List[Dict]:
@@ -128,7 +152,7 @@ class JobClient:
         deadline = time.time() + timeout_s
         while True:
             jobs = self.query(uuids)
-            if all(j["state"] == "completed" for j in jobs):
+            if all(j["state"] in TERMINAL_STATES for j in jobs):
                 return jobs
             if time.time() > deadline:
                 raise TimeoutError(

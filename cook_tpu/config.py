@@ -76,6 +76,23 @@ class PoolQuota:
 
 
 @dataclass
+class TaskConstraints:
+    """Submission-time per-task limits (reference: config.clj:398-407
+    :task-constraints defaults + validate-and-munge-job rest/api.clj:1070-1096).
+    ``None`` disables a check; the reference's conservative defaults for the
+    resource caps are commented — operators opt in because the right cap is
+    deployment-specific."""
+
+    retry_limit: Optional[int] = 20          # config.clj:403
+    max_ports: Optional[int] = 5             # config.clj:405
+    cpus: Optional[float] = None             # reference default: 4
+    memory_gb: Optional[float] = None        # reference default: 12
+    command_length_limit: Optional[int] = None
+    # docker parameter allow-list; None = all allowed (api.clj:1098-1103)
+    docker_parameters_allowed: Optional[List[str]] = None
+
+
+@dataclass
 class EstimatedCompletionConfig:
     """estimated-completion constraint knobs (reference:
     config/estimated-completion-config, constraints.clj:408-432). Disabled
@@ -110,6 +127,7 @@ class Config:
     max_tasks_per_host: Optional[int] = None
     estimated_completion: EstimatedCompletionConfig = field(
         default_factory=EstimatedCompletionConfig)
+    task_constraints: TaskConstraints = field(default_factory=TaskConstraints)
     # synthetic-pod autoscaling after each match cycle (scheduler.clj:1178)
     autoscaling_enabled: bool = False
     # reapers (scheduler.clj:1888-2016)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from ..client import TERMINAL_STATES
 from .service_farm import ServiceFarm
 
 DEFAULT_SCHEDULER_PORT = 8786
@@ -91,7 +92,7 @@ class CookCluster:
                 port = ports[0] if ports else self.scheduler_port
                 self._scheduler_address = f"tcp://{host}:{port}"
                 return self._scheduler_address
-            if job["state"] == "completed":
+            if job["state"] in TERMINAL_STATES:
                 raise RuntimeError("dask scheduler job completed early")
             time.sleep(0.2)
         raise TimeoutError("dask scheduler not running within timeout")
@@ -156,7 +157,7 @@ class CookCluster:
                           range(len(self._workers.fleet()))))
         for j in self.client.query(self._workers.fleet()):
             insts = j.get("instances") or []
-            if not insts or j.get("state") == "completed":
+            if not insts or j.get("state") in TERMINAL_STATES:
                 continue
             inst = insts[-1]
             host = inst.get("hostname")

@@ -14,6 +14,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..client import TERMINAL_STATES
+
 FARM_LABEL = "cook-service-farm"
 
 
@@ -89,7 +91,7 @@ class ServiceFarm:
         if not self._workers:
             return
         for j in self.client.query(list(self._workers)):
-            if j.get("state") == "completed":
+            if j.get("state") in TERMINAL_STATES:
                 self._workers.pop(j["uuid"], None)
 
     def scale(self, n: int) -> List[str]:

@@ -47,6 +47,7 @@ from ..state.schema import (
     Application,
     Constraint,
     Group,
+    GroupPlacementType,
     InstanceStatus,
     Job,
     JobState,
@@ -121,11 +122,34 @@ class _Redirect(Exception):
         self.location = location
 
 
+def job_state_string(store: Store, job: Job,
+                     instances: Optional[List] = None) -> str:
+    """waiting | running | success | failed — the reference resolves a
+    completed job to success/failed from its instances (tools.clj:310-321
+    job-ent->state); ``status`` keeps the raw tri-state.  Pass already-
+    fetched ``instances`` to avoid re-reading them from the store."""
+    if job.state is not JobState.COMPLETED:
+        return job.state.value
+    if instances is None:
+        instances = [i for t in job.instances
+                     if (i := store.instance(t)) is not None]
+    for inst in instances:
+        if inst.status is InstanceStatus.SUCCESS:
+            return "success"
+    return "failed"
+
+
 def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
+    # fetched once, shared by the state resolution and the instances block;
+    # skipped entirely for waiting/running summaries (no reader needs them)
+    instances = ([i for t in job.instances
+                  if (i := store.instance(t)) is not None]
+                 if include_instances or job.state is JobState.COMPLETED
+                 else [])
     out = {
         "uuid": job.uuid, "name": job.name, "command": job.command,
         "user": job.user, "priority": job.priority, "pool": job.pool,
-        "state": job.state.value,
+        "state": job_state_string(store, job, instances),
         "status": {"waiting": "waiting", "running": "running",
                    "completed": "completed"}[job.state.value],
         "cpus": job.resources.cpus, "mem": job.resources.mem,
@@ -153,11 +177,7 @@ def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
                         if job.application else None),
     }
     if include_instances:
-        out["instances"] = []
-        for tid in job.instances:
-            inst = store.instance(tid)
-            if inst is not None:
-                out["instances"].append(instance_to_json(inst))
+        out["instances"] = [instance_to_json(i) for i in instances]
     return out
 
 
@@ -179,6 +199,39 @@ def instance_to_json(inst) -> Dict:
         "output_url": inst.output_url,
         "queue_time": inst.queue_time_ms,
     }
+
+
+def validate_task_constraints(job: Job, tc) -> None:
+    """Submission-time task-constraint checks, messages mirroring the
+    reference (rest/api.clj:1070-1103 validate-and-munge-job)."""
+    if tc is None:
+        return
+    if tc.cpus is not None and job.resources.cpus > tc.cpus:
+        raise ApiError(400, f"Requested {job.resources.cpus} cpus, but only "
+                            f"allowed to use {tc.cpus}")
+    if tc.memory_gb is not None and job.resources.mem > 1024 * tc.memory_gb:
+        raise ApiError(400, f"Requested {job.resources.mem}mb memory, but "
+                            f"only allowed to use {1024 * tc.memory_gb}")
+    if tc.max_ports is not None and job.ports > tc.max_ports:
+        raise ApiError(400, f"Requested {job.ports} ports, but only allowed "
+                            f"to use {tc.max_ports}")
+    if tc.retry_limit is not None and job.max_retries > tc.retry_limit:
+        raise ApiError(400, f"Requested {job.max_retries} exceeds the "
+                            f"maximum retry limit")
+    if tc.command_length_limit is not None \
+            and len(job.command) > tc.command_length_limit:
+        raise ApiError(400, f"Job command length of {len(job.command)} is "
+                            f"greater than the maximum command length "
+                            f"({tc.command_length_limit})")
+    if tc.docker_parameters_allowed is not None \
+            and isinstance(job.container, dict):
+        params = (job.container.get("docker") or {}).get("parameters") or []
+        allowed = set(tc.docker_parameters_allowed)
+        bad = [p.get("key") for p in params
+               if isinstance(p, dict) and p.get("key") not in allowed]
+        if bad:
+            raise ApiError(400, "The following parameters are not "
+                                f"supported: {bad}")
 
 
 def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
@@ -236,6 +289,52 @@ def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
         )
     except (TypeError, ValueError) as e:
         raise ApiError(400, f"malformed job spec: {e}")
+
+
+def parse_group_spec(gspec: Dict, job_uuids: List[str]) -> Group:
+    """Group submission schema -> Group, including host-placement and
+    straggler-handling (reference: rest/api.clj:489-514 HostPlacement/
+    StragglerHandling schemas + :925 make-group-txn)."""
+    try:
+        group = Group(uuid=gspec["uuid"],
+                      name=gspec.get("name", "defaultgroup"),
+                      jobs=job_uuids)
+        hp = gspec.get("host-placement") or gspec.get("host_placement")
+        if hp:
+            try:
+                group.placement_type = GroupPlacementType(
+                    hp.get("type", "all"))
+            except ValueError:
+                raise ApiError(
+                    400, f"unknown host-placement type {hp.get('type')}")
+            params = hp.get("parameters") or {}
+            group.placement_attribute = params.get("attribute")
+            if group.placement_type is GroupPlacementType.ATTRIBUTE_EQUALS \
+                    and not group.placement_attribute:
+                raise ApiError(400, "attribute-equals host-placement "
+                                    "requires parameters.attribute")
+            if params.get("minimum") is not None:
+                group.placement_minimum = int(params["minimum"])
+        sh = gspec.get("straggler-handling") or gspec.get("straggler_handling")
+        if sh:
+            if sh.get("type") not in (None, "none", "quantile-deviation"):
+                raise ApiError(
+                    400,
+                    f"unknown straggler-handling type {sh.get('type')}")
+            if sh.get("type") == "quantile-deviation":
+                params = sh.get("parameters") or {}
+                quantile = float(params.get("quantile", 0.5))
+                multiplier = float(params.get("multiplier", 2.0))
+                if not 0.0 < quantile < 1.0:
+                    raise ApiError(400,
+                                   "straggler quantile must be in (0, 1)")
+                if multiplier < 1.0:
+                    raise ApiError(400, "straggler multiplier must be >= 1")
+                group.straggler_quantile = quantile
+                group.straggler_multiplier = multiplier
+        return group
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        raise ApiError(400, f"malformed group spec: {e}")
 
 
 class CookApi:
@@ -314,9 +413,9 @@ class CookApi:
         return None
 
     # ------------------------------------------------------------------ auth
-    def require_admin(self, user: str) -> None:
+    def require_admin(self, user: str, message: Optional[str] = None) -> None:
         if self.admins and user not in self.admins:
-            raise ApiError(403, f"{user} is not authorized")
+            raise ApiError(403, message or f"{user} is not authorized")
 
     def resolve_user(self, auth_user: str, impersonate: Optional[str]) -> str:
         if impersonate:
@@ -339,6 +438,11 @@ class CookApi:
         jobs = []
         for spec in specs:
             job = parse_job_spec(spec, user, self.config.default_pool)
+            validate_task_constraints(job, self.config.task_constraints)
+            for uri in job.uris:
+                if uri.get("executable") and uri.get("extract"):
+                    raise ApiError(
+                        400, "Uri cannot set executable and extract")
             if pool_override:
                 job.pool = pool_override
             job.pool = self.plugins.pool_selector.select(
@@ -361,10 +465,8 @@ class CookApi:
             if not guuid:
                 raise ApiError(400, "groups must carry a uuid so jobs can "
                                     "reference them")
-            groups.append(Group(
-                uuid=guuid,
-                name=gspec.get("name", "defaultgroup"),
-                jobs=[j.uuid for j in jobs if j.group == guuid]))
+            groups.append(parse_group_spec(
+                gspec, [j.uuid for j in jobs if j.group == guuid]))
         # atomic batch visibility via commit latch (metatransaction)
         latch = new_uuid()
         try:
@@ -378,18 +480,26 @@ class CookApi:
     def get_jobs(self, params: Dict) -> List[Dict]:
         uuids = params.get("uuid", [])
         if uuids:
+            # partial=true: return the found subset as long as at least one
+            # uuid resolves, instead of 404ing the whole query (reference:
+            # rest/api.clj:1391-1415 retrieve-jobs allow-partial-results)
+            partial = first(params.get("partial"), "false") == "true"
             out = []
             for uuid in uuids:
                 job = self.store.job(uuid)
                 if job is None:
+                    if partial:
+                        continue
                     raise ApiError(404, f"no such job {uuid}")
                 out.append(job_to_json(self.store, job))
+            if not out:
+                raise ApiError(404, f"no such jobs {uuids}")
             return out
         user = first(params.get("user"))
         states = parse_states(params)
         jobs = self.store.jobs_where(
             lambda j: (user is None or j.user == user)
-            and (not states or j.state.value in states))
+            and job_matches_states(self.store, j, states))
         return [job_to_json(self.store, j, include_instances=False)
                 for j in jobs]
 
@@ -407,18 +517,100 @@ class CookApi:
             self.store.kill_job(uuid)
         return {"killed": uuids}
 
-    def retry(self, body: Dict, user: str) -> Dict:
-        uuid = body.get("job")
+    def retry(self, body: Dict, user: str, deprecated: bool = True) -> Dict:
+        """POST (deprecated: job/jobs + retries/increment only) and PUT
+        (adds groups + failed_only) /retry (reference: rest/api.clj:2470-2650
+        UpdateRetriesRequest + validate-retries + check-jobs-exist).
+
+        failed_only defaults to True when groups are given, False otherwise
+        (api.clj:2569-2573's backwards-compatible default)."""
+        if body.get("job") is not None and body.get("jobs") is not None:
+            raise ApiError(400, 'Can\'t specify both "job" and "jobs".')
+        uuids = body.get("jobs") or ([body["job"]] if body.get("job") else [])
+        if deprecated and body.get("groups"):
+            raise ApiError(400, 'POST /retry does not support "groups"; '
+                                "use PUT.")
+        groups = [] if deprecated else (body.get("groups") or [])
+        if not uuids and not groups:
+            raise ApiError(400, "Need to specify at least 1 job or group.")
         retries = body.get("retries")
-        if uuid is None or retries is None:
-            raise ApiError(400, "need job and retries")
-        job = self.store.job(uuid)
-        if job is None:
-            raise ApiError(404, f"no such job {uuid}")
-        if job.user != user:
-            self.require_admin(user)
-        self.store.retry_job(uuid, int(retries))
-        return {"job": uuid, "retries": retries}
+        increment = body.get("increment")
+        if retries is None and increment is None:
+            raise ApiError(400, "Need to specify either retries or increment.")
+        if retries is not None and increment is not None:
+            raise ApiError(400, "Can't specify both retries and increment.")
+        try:
+            retries = int(retries) if retries is not None else None
+            increment = int(increment) if increment is not None else None
+        except (TypeError, ValueError):
+            raise ApiError(400, "retries/increment must be integers")
+        tc = self.config.task_constraints
+        limit = tc.retry_limit if tc is not None else None
+        if retries is not None and limit is not None and retries > limit:
+            raise ApiError(400, f"'retries' exceeds the maximum retry limit "
+                                f"of {limit}")
+
+        failed_only = body.get("failed_only", body.get("failed-only"))
+        if failed_only is None:
+            failed_only = bool(groups)
+
+        # resolve + authorize every named job/group before touching any
+        all_jobs: List[Job] = []
+        for uuid in uuids:
+            job = self.store.job(uuid)
+            if job is None:
+                raise ApiError(404,
+                               f"UUID {uuid} does not correspond to a job.")
+            if job.user != user:
+                self.require_admin(
+                    user, f"You are not authorized to retry job {uuid}.")
+            all_jobs.append(job)
+        for guuid in groups:
+            group = self.store.group(guuid)
+            if group is None:
+                raise ApiError(404,
+                               f"UUID {guuid} does not correspond to a group.")
+            gjobs = [j for j in (self.store.job(u) for u in group.jobs)
+                     if j is not None]
+            if any(j.user != user for j in gjobs):
+                self.require_admin(
+                    user, "You are not authorized to retry jobs from "
+                          f"group {guuid}.")
+            all_jobs.extend(gjobs)
+
+        seen = set()
+        targets = []
+        for job in all_jobs:
+            if job.uuid in seen:
+                continue
+            seen.add(job.uuid)
+            if failed_only \
+                    and job_state_string(self.store, job) != "failed":
+                continue
+            targets.append(job)
+
+        if increment is not None:
+            if limit is not None and any(j.max_retries + increment > limit
+                                         for j in targets):
+                raise ApiError(400, "Increment would exceed the maximum "
+                                    f"retry limit of {limit}")
+        else:
+            for job in targets:
+                insts = {t: i for t in job.instances
+                         if (i := self.store.instance(t)) is not None}
+                if job.attempts_used(insts) > retries:
+                    raise ApiError(
+                        400, "Retries would be less than attempts-consumed")
+        for job in targets:
+            new_retries = (job.max_retries + increment
+                           if increment is not None else retries)
+            self.store.retry_job(job.uuid, new_retries)
+        out: Dict[str, Any] = {"jobs": [j.uuid for j in targets],
+                               "retries": retries, "increment": increment}
+        if body.get("job") is not None:
+            # the deprecated single-job POST contract returned {job, retries}
+            out["job"] = body["job"]
+        return out
 
     def kill_instances(self, params: Dict, user: str) -> Dict:
         """DELETE /instances?uuid=task-id — kill individual instances
@@ -454,13 +646,30 @@ class CookApi:
         if not uuids:
             raise ApiError(400, "no uuids given")
         detailed = first(params.get("detailed"), "false") == "true"
+        partial = first(params.get("partial"), "false") == "true"
         out = []
         for uuid in uuids:
             group = self.store.group(uuid)
             if group is None:
+                if partial:
+                    continue
                 raise ApiError(404, f"no such group {uuid}")
             entry: Dict[str, Any] = {
-                "uuid": group.uuid, "name": group.name, "jobs": group.jobs}
+                "uuid": group.uuid, "name": group.name, "jobs": group.jobs,
+                "host-placement": {
+                    "type": group.placement_type.value,
+                    "parameters": {
+                        **({"attribute": group.placement_attribute}
+                           if group.placement_attribute else {}),
+                        **({"minimum": group.placement_minimum}
+                           if group.placement_type is
+                           GroupPlacementType.BALANCED else {})}},
+                "straggler-handling": (
+                    {"type": "quantile-deviation",
+                     "parameters": {"quantile": group.straggler_quantile,
+                                    "multiplier": group.straggler_multiplier}}
+                    if group.straggler_quantile is not None
+                    else {"type": "none", "parameters": {}})}
             jobs = [j for j in (self.store.job(u) for u in group.jobs)
                     if j is not None]
             by_state = {"waiting": 0, "running": 0, "completed": 0}
@@ -472,6 +681,8 @@ class CookApi:
                     job_to_json(self.store, j, include_instances=False)
                     for j in jobs]
             out.append(entry)
+        if not out:
+            raise ApiError(404, f"no such groups {uuids}")
         return out
 
     def group_kill(self, params: Dict, user: str) -> Dict:
@@ -496,9 +707,10 @@ class CookApi:
         return {"killed": job_uuids}
 
     def list_jobs(self, params: Dict) -> List[Dict]:
-        """GET /list?user=&state=&start-ms=&end-ms=&limit= (reference:
-        rest/api.clj list-resource): jobs filtered by user, state set, and
-        submit-time window, newest first."""
+        """GET /list?user=&state=&start-ms=&end-ms=&limit=&name=&pool=
+        (reference: rest/api.clj:3038 list-resource): jobs filtered by user,
+        state set, submit-time window, name pattern (literal with ``*``
+        wildcards, api.clj:1670-1675), and pool; newest first."""
         user = first(params.get("user"))
         if user is None:
             raise ApiError(400, "user parameter required")
@@ -511,10 +723,20 @@ class CookApi:
             raise ApiError(400, f"malformed query parameter: {e}")
         if limit <= 0:
             raise ApiError(400, "limit must be positive")
+        name_filter = first(params.get("name"))
+        name_rx = None
+        if name_filter is not None:
+            if not re.fullmatch(r"[\w.*\-]*", name_filter):
+                raise ApiError(400, f"unsupported name filter {name_filter}")
+            name_rx = re.compile(
+                name_filter.replace(".", r"\.").replace("*", ".*") + "$")
+        pool = first(params.get("pool"))
         jobs = self.store.jobs_where(
             lambda j: j.user == user
-            and (not states or j.state.value in states)
-            and start_ms <= j.submit_time_ms < end_ms)
+            and job_matches_states(self.store, j, states)
+            and start_ms <= j.submit_time_ms < end_ms
+            and (name_rx is None or name_rx.match(j.name))
+            and (pool is None or j.pool == pool))
         jobs.sort(key=lambda j: j.submit_time_ms, reverse=True)
         return [job_to_json(self.store, j, include_instances=False)
                 for j in jobs[:limit]]
@@ -880,6 +1102,10 @@ class CookApi:
         return lines
 
 
+ALLOWED_LIST_STATES = frozenset(
+    {"waiting", "running", "completed", "success", "failed"})
+
+
 def parse_states(params: Dict) -> set:
     """State filter from query params. '+' is the documented separator, but
     standard URL decoding turns a literal '+' into a space, so accept
@@ -887,7 +1113,25 @@ def parse_states(params: Dict) -> set:
     states = set()
     for value in params.get("state", []):
         states.update(s for s in re.split(r"[+,\s]+", value) if s)
+    if states and not states <= ALLOWED_LIST_STATES:
+        raise ApiError(400, f"unsupported state in {sorted(states)}, must "
+                            f"be one of: {sorted(ALLOWED_LIST_STATES)}")
     return states
+
+
+def job_matches_states(store: Store, job: Job, states: set) -> bool:
+    """'completed' means both success and failed (reference:
+    rest/api.clj:1659-1668 normalize-list-states)."""
+    if not states:
+        return True
+    if job.state.value in states:
+        return True
+    # resolving success/failed reads the job's instances — skip it unless
+    # the filter can actually match a resolved state
+    if job.state is not JobState.COMPLETED \
+            or not states & {"success", "failed"}:
+        return False
+    return job_state_string(store, job) in states
 
 
 def first(values, default=None):
@@ -1091,6 +1335,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.progress(parts[1], self._body())
             if path == "/shutdown-leader":
                 return api.shutdown_leader(self._user())
+        elif method == "PUT":
+            if path == "/retry":
+                return api.retry(self._body(), self._user(),
+                                 deprecated=False)
         elif method == "DELETE":
             if path == "/jobs" or path == "/rawscheduler":
                 return api.kill_jobs(params, self._user())
@@ -1117,7 +1365,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Access-Control-Allow-Origin", origin)
         self.send_header("Access-Control-Allow-Credentials", "true")
         self.send_header("Access-Control-Allow-Methods",
-                         "GET, POST, DELETE, OPTIONS")
+                         "GET, POST, PUT, DELETE, OPTIONS")
         self.send_header(
             "Access-Control-Allow-Headers",
             self.headers.get("Access-Control-Request-Headers", "*"))
@@ -1133,6 +1381,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self._route("DELETE")
+
+    def do_PUT(self):
+        self._route("PUT")
 
 
 class ApiServer:

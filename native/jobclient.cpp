@@ -16,7 +16,8 @@
 //   query   GET    /jobs?uuid=a&uuid=b
 //   kill    DELETE /jobs?uuid=a&uuid=b
 //   retry   POST   /retry       {"job": uuid, "retries": n}
-//   wait    poll query until every job's state == "completed"
+//   wait    poll query until every job's state is terminal
+//           (success|failed|completed)
 // Headers: X-Cook-User (header-trust), X-Cook-Impersonate, Authorization
 // Basic/Bearer; 307 leader redirects are followed with method+body
 // preserved (reference: rest/api.clj leader redirect semantics).
@@ -618,8 +619,12 @@ int cjc_wait(void* h, const char* uuids_csv, long timeout_ms, long poll_ms,
             if (resp.status == 200) {
                 auto states = extract_job_states(resp.body);
                 bool all_done = !states.empty();
+                // completed jobs render as success|failed (plus the raw
+                // "completed" from older servers)
                 for (auto& p : states)
-                    if (p.second != "completed") all_done = false;
+                    if (p.second != "completed" && p.second != "success" &&
+                        p.second != "failed")
+                        all_done = false;
                 if (all_done) {
                     if (done) *done = 1;
                     if (out) *out = dup_cstr(last_body);

@@ -53,7 +53,7 @@ class TestServiceFarm:
         # the killed two are completed
         all_states = {j["uuid"]: j["state"] for j in client.query(fleet)}
         assert sorted(all_states.values()) == [
-            "completed", "completed", "running"]
+            "failed", "failed", "running"]
 
     def test_worker_commands_carry_index(self, system):
         _store, _c, _s, server = system
@@ -97,7 +97,7 @@ class TestServiceFarm:
             fleet = farm.scale(2)
             cycle(sched)
         states = {j["state"] for j in client.query(fleet)}
-        assert states == {"completed"}
+        assert states == {"failed"}
 
 
 class TestDaskCookCluster:
@@ -122,7 +122,7 @@ class TestDaskCookCluster:
             assert sorted(status.values()) == ["running"] * 3
         # context exit tears everything down
         all_jobs = fleet + workers
-        assert {j["state"] for j in client.query(all_jobs)} == {"completed"}
+        assert {j["state"] for j in client.query(all_jobs)} == {"failed"}
 
     def test_adapt_without_dask_applies_minimum(self, system):
         _store, _c, sched, server = system

@@ -257,7 +257,7 @@ class TestFederationFailover:
         r = cli("wait", uuid, "--timeout", "60")
         assert r.returncode == 0, r.stdout + r.stderr
         job = job_json(url_b, uuid)
-        assert job["state"] == "completed"
+        assert job["state"] == "success"
         # and the survivor keeps scheduling fresh federation submissions
         r = cli("submit", "--cpus", "1", "--mem", "64", "true")
         assert r.returncode == 0, r.stdout + r.stderr

@@ -246,10 +246,10 @@ class TestRealProcessesMultiUser:
                 sched.flush_status_updates()
                 states = {u: rq("GET", f"/jobs/{uid}", u)["state"]
                           for u, uid in uuids.items()}
-                if all(s == "completed" for s in states.values()):
+                if all(s == "success" for s in states.values()):
                     break
                 time.sleep(0.1)
-            assert all(s == "completed" for s in states.values()), states
+            assert all(s == "success" for s in states.values()), states
             for user, uid in uuids.items():
                 j = rq("GET", f"/jobs/{uid}", user)
                 assert any(i["status"] == "success" for i in j["instances"])

@@ -75,7 +75,7 @@ class TestNativeJobClient:
             [uuid] = c.submit([JOB])
             c.kill([uuid])
             [job] = c.query([uuid])
-            assert job["state"] == "completed"
+            assert job["state"] == "failed"
 
     def test_retry_resurrects_failed_job(self, system):
         store, cluster, sched, server = system
@@ -85,7 +85,7 @@ class TestNativeJobClient:
             [tid] = sched.step_match()["default"].launched_task_ids
             cluster.complete_task(tid, exit_code=3)
             [job] = c.query([uuid])
-            assert job["state"] == "completed"
+            assert job["state"] == "failed"
             c.retry(uuid, retries=5)
             [job] = c.query([uuid])
             assert job["state"] == "waiting"
@@ -109,7 +109,7 @@ class TestNativeJobClient:
             jobs = c.wait([uuid], timeout_s=10.0, poll_s=0.05)
             t.join()
             assert done.is_set()
-            assert jobs[0]["state"] == "completed"
+            assert jobs[0]["state"] == "success"
 
     def test_wait_timeout(self, system):
         _store, _c, _s, server = system
@@ -134,11 +134,11 @@ class TestNativeJobClient:
             cluster.complete_task(tid)
             deadline = time.time() + 5.0
             while time.time() < deadline:
-                if (uuid, "completed") in seen:
+                if (uuid, "success") in seen:
                     break
                 time.sleep(0.05)
             states = [s for u, s in seen if u == uuid]
-            assert states == ["waiting", "running", "completed"]
+            assert states == ["waiting", "running", "success"]
 
     def test_impersonation(self, system):
         _store, _c, _s, server = system

@@ -52,7 +52,8 @@ class TestJobsEndpoint:
         assert len(job["instances"]) == 1
         cluster.complete_task(job["instances"][0]["task_id"])
         job = client.job(uuid)
-        assert job["state"] == "completed"
+        assert job["state"] == "success"
+        assert job["status"] == "completed"
         assert job["instances"][0]["status"] == "success"
 
     def test_batch_submit_is_atomic(self, system):
@@ -100,7 +101,7 @@ class TestJobsEndpoint:
         sched.step_rank()
         [tid] = sched.step_match()["default"].launched_task_ids
         cluster.complete_task(tid, exit_code=3)
-        assert client.job(uuid)["state"] == "completed"
+        assert client.job(uuid)["state"] == "failed"
         client.retry(uuid, 5)
         assert client.job(uuid)["state"] == "waiting"
 
@@ -845,3 +846,339 @@ class TestCliSubcommandPlugins:
         rc = climod.main(["config"])
         assert rc == 0
         assert "failed to load" in capsys.readouterr().err
+
+
+class TestTaskConstraints:
+    """Submission-time task-constraint validation (reference:
+    rest/api.clj:1070-1103 validate-and-munge-job + config.clj:398-407)."""
+
+    def _system(self, **tc_kwargs):
+        from cook_tpu.config import TaskConstraints
+        store = Store()
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.task_constraints = TaskConstraints(**tc_kwargs)
+        api = CookApi(store, config=cfg)
+        server = ApiServer(api)
+        server.start()
+        return store, server
+
+    def test_max_ports_rejected(self):
+        _store, server = self._system(max_ports=5)
+        try:
+            client = client_for(server)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", ports=6)
+            assert e.value.status == 400 and "ports" in e.value.message
+            assert client.submit_one("x", ports=5)
+        finally:
+            server.stop()
+
+    def test_retry_limit_rejected(self):
+        _store, server = self._system(retry_limit=20)
+        try:
+            client = client_for(server)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", max_retries=21)
+            assert e.value.status == 400 and "retry limit" in e.value.message
+        finally:
+            server.stop()
+
+    def test_cpus_mem_caps(self):
+        _store, server = self._system(cpus=4.0, memory_gb=1.0)
+        try:
+            client = client_for(server)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", cpus=8.0)
+            assert "cpus" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", mem=2048.0)
+            assert "memory" in e.value.message
+            assert client.submit_one("x", cpus=4.0, mem=1024.0)
+        finally:
+            server.stop()
+
+    def test_command_length_limit(self):
+        _store, server = self._system(command_length_limit=10)
+        try:
+            client = client_for(server)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x" * 11)
+            assert "command length" in e.value.message
+        finally:
+            server.stop()
+
+    def test_docker_parameters_allowlist(self):
+        _store, server = self._system(docker_parameters_allowed=["user"])
+        try:
+            client = client_for(server)
+            container = {"type": "docker",
+                         "docker": {"image": "img", "parameters": [
+                             {"key": "privileged", "value": "true"}]}}
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container=container)
+            assert "not supported" in e.value.message
+            ok = {"type": "docker",
+                  "docker": {"image": "img", "parameters": [
+                      {"key": "user", "value": "nobody"}]}}
+            assert client.submit_one("x", container=ok)
+        finally:
+            server.stop()
+
+    def test_uri_executable_and_extract_conflict(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        with pytest.raises(JobClientError) as e:
+            client.submit_one("x", uris=[{"value": "http://a/b",
+                                          "executable": True,
+                                          "extract": True}])
+        assert "executable and extract" in e.value.message
+
+
+class TestRetrySemantics:
+    """PUT /retry with groups/failed_only/increment (reference:
+    rest/api.clj:2470-2650)."""
+
+    def _fail(self, system, **spec):
+        """Submit a job and drive it to a failed terminal state."""
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x", max_retries=1, **spec)
+        sched.step_rank()
+        launched = sched.step_match()["default"].launched_task_ids
+        cluster.complete_task(launched[-1], exit_code=1)
+        return client, uuid
+
+    def test_needs_jobs_or_groups(self, system):
+        client = client_for(system[3])
+        with pytest.raises(JobClientError) as e:
+            client.retry(retries=5)
+        assert "at least 1 job or group" in e.value.message
+
+    def test_retries_xor_increment(self, system):
+        client, uuid = self._fail(system)
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid)
+        assert "retries or increment" in e.value.message
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid, retries=5, increment=1)
+        assert "both retries and increment" in e.value.message
+
+    def test_job_and_jobs_conflict(self, system):
+        client, uuid = self._fail(system)
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid, jobs=[uuid], retries=5)
+        assert '"job" and "jobs"' in e.value.message
+
+    def test_exceeds_retry_limit(self, system):
+        client, uuid = self._fail(system)
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid, retries=21)
+        assert "maximum retry limit" in e.value.message
+
+    def test_increment(self, system):
+        client, uuid = self._fail(system)
+        client.retry(uuid, increment=2)
+        job = client.job(uuid)
+        assert job["max_retries"] == 3
+        assert job["state"] == "waiting"
+
+    def test_increment_over_limit(self, system):
+        client, uuid = self._fail(system)
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid, increment=100)
+        assert "Increment would exceed" in e.value.message
+
+    def test_retries_below_attempts_consumed(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x", max_retries=2)
+        for _ in range(2):
+            sched.step_rank()
+            launched = sched.step_match()["default"].launched_task_ids
+            cluster.complete_task(launched[-1], exit_code=1)
+        with pytest.raises(JobClientError) as e:
+            client.retry(uuid, retries=1)
+        assert "less than attempts-consumed" in e.value.message
+
+    def test_unknown_job_404(self, system):
+        client = client_for(system[3])
+        with pytest.raises(JobClientError) as e:
+            client.retry("00000000-0000-0000-0000-00000000dead", retries=5)
+        assert e.value.status == 404
+        assert "does not correspond to a job" in e.value.message
+
+    def test_group_retry_defaults_to_failed_only(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        g = "11111111-0000-0000-0000-000000000001"
+        uuids = client.submit(
+            [{"command": "x", "max_retries": 1, "group": g}
+             for _ in range(2)],
+            groups=[{"uuid": g}])
+        sched.step_rank()
+        launched = sched.step_match()["default"].launched_task_ids
+        assert len(launched) == 2
+        # one fails, one succeeds
+        cluster.complete_task(launched[0], exit_code=1)
+        cluster.complete_task(launched[1], exit_code=0)
+        states = {j["uuid"]: j["state"] for j in client.query(uuids)}
+        assert sorted(states.values()) == ["failed", "success"]
+        out = client.retry(groups=[g], retries=5)
+        # failed_only defaulted True: only the failed job was resurrected
+        assert len(out["jobs"]) == 1
+        states = {j["uuid"]: j["state"] for j in client.query(uuids)}
+        assert sorted(states.values()) == ["success", "waiting"]
+
+    def test_unknown_group_404(self, system):
+        client = client_for(system[3])
+        with pytest.raises(JobClientError) as e:
+            client.retry(groups=["00000000-0000-0000-0000-0000000000aa"],
+                         retries=5)
+        assert "does not correspond to a group" in e.value.message
+
+    def test_non_owner_forbidden(self, system):
+        client, uuid = self._fail(system)
+        other = client_for(system[3], user="mallory")
+        with pytest.raises(JobClientError) as e:
+            other.retry(uuid, retries=5)
+        assert e.value.status == 403
+        assert "not authorized to retry job" in e.value.message
+
+    def test_post_retry_still_supported(self, system):
+        client, uuid = self._fail(system)
+        out = client._request("POST", "/retry",
+                              body={"job": uuid, "retries": 5})
+        assert out["jobs"] == [uuid]
+        assert client.job(uuid)["state"] == "waiting"
+
+
+class TestPartialQueries:
+    def test_jobs_partial_flag(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        uuid = client.submit_one("x")
+        ghost = "00000000-0000-0000-0000-00000000beef"
+        with pytest.raises(JobClientError) as e:
+            client._request("GET", "/jobs", params={"uuid": [uuid, ghost]})
+        assert e.value.status == 404
+        out = client._request("GET", "/jobs",
+                              params={"uuid": [uuid, ghost],
+                                      "partial": "true"})
+        assert [j["uuid"] for j in out] == [uuid]
+        # all-unknown is still a 404 even with partial
+        with pytest.raises(JobClientError):
+            client._request("GET", "/jobs",
+                            params={"uuid": [ghost], "partial": "true"})
+
+    def test_groups_partial_flag(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        g = "11111111-0000-0000-0000-000000000002"
+        client.submit([{"command": "x", "group": g}], groups=[{"uuid": g}])
+        ghost = "00000000-0000-0000-0000-00000000cafe"
+        with pytest.raises(JobClientError):
+            client._request("GET", "/group", params={"uuid": [g, ghost]})
+        out = client._request("GET", "/group",
+                              params={"uuid": [g, ghost],
+                                      "partial": "true"})
+        assert [x["uuid"] for x in out] == [g]
+
+
+class TestGroupSubmissionSpec:
+    def test_host_placement_and_straggler_round_trip(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        g = "11111111-0000-0000-0000-000000000003"
+        client.submit(
+            [{"command": "x", "group": g}],
+            groups=[{"uuid": g, "name": "workers",
+                     "host-placement": {
+                         "type": "attribute-equals",
+                         "parameters": {"attribute": "rack"}},
+                     "straggler-handling": {
+                         "type": "quantile-deviation",
+                         "parameters": {"quantile": 0.6,
+                                        "multiplier": 2.5}}}])
+        [out] = client._request("GET", "/group", params={"uuid": [g]})
+        assert out["host-placement"]["type"] == "attribute-equals"
+        assert out["host-placement"]["parameters"]["attribute"] == "rack"
+        assert out["straggler-handling"]["type"] == "quantile-deviation"
+        assert out["straggler-handling"]["parameters"]["quantile"] == 0.6
+        assert out["straggler-handling"]["parameters"]["multiplier"] == 2.5
+
+    def test_attribute_equals_requires_attribute(self, system):
+        client = client_for(system[3])
+        g = "11111111-0000-0000-0000-000000000004"
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g}],
+                          groups=[{"uuid": g, "host-placement": {
+                              "type": "attribute-equals"}}])
+        assert "parameters.attribute" in e.value.message
+
+    def test_bad_placement_type_rejected(self, system):
+        client = client_for(system[3])
+        g = "11111111-0000-0000-0000-000000000005"
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g}],
+                          groups=[{"uuid": g,
+                                   "host-placement": {"type": "bogus"}}])
+        assert "unknown host-placement type" in e.value.message
+
+    def test_bad_straggler_params_rejected(self, system):
+        client = client_for(system[3])
+        g = "11111111-0000-0000-0000-000000000006"
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g}],
+                          groups=[{"uuid": g, "straggler-handling": {
+                              "type": "quantile-deviation",
+                              "parameters": {"quantile": 1.5}}}])
+        assert "quantile" in e.value.message
+
+
+class TestListFilters:
+    def test_name_wildcard_and_pool(self, system):
+        store, _c, _s, server = system
+        client = client_for(server)
+        a = client.submit_one("x", name="train.alpha")
+        b = client.submit_one("x", name="train.beta")
+        c = client.submit_one("x", name="serve")
+        out = client._request(
+            "GET", "/list", params={"user": "alice", "name": "train.*"})
+        assert {j["uuid"] for j in out} == {a, b}
+        out = client._request(
+            "GET", "/list", params={"user": "alice", "name": "serve"})
+        assert [j["uuid"] for j in out] == [c]
+        out = client._request(
+            "GET", "/list", params={"user": "alice", "pool": "default"})
+        assert len(out) == 3
+        out = client._request(
+            "GET", "/list", params={"user": "alice", "pool": "nope"})
+        assert out == []
+
+    def test_invalid_name_filter_rejected(self, system):
+        client = client_for(system[3])
+        with pytest.raises(JobClientError) as e:
+            client._request("GET", "/list",
+                            params={"user": "alice", "name": "bad(name"})
+        assert e.value.status == 400
+
+    def test_state_filter_normalization(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        ok = client.submit_one("x")
+        bad = client.submit_one("x", max_retries=1)
+        sched.step_rank()
+        launched = sched.step_match()["default"].launched_task_ids
+        assert len(launched) == 2
+        tid_of = {store.instance(t).job_uuid: t for t in launched}
+        cluster.complete_task(tid_of[ok], exit_code=0)
+        cluster.complete_task(tid_of[bad], exit_code=1)
+        got = lambda st: {j["uuid"] for j in client._request(
+            "GET", "/list", params={"user": "alice", "state": st})}
+        assert got("success") == {ok}
+        assert got("failed") == {bad}
+        assert got("completed") == {ok, bad}
+        with pytest.raises(JobClientError) as e:
+            got("bogus")
+        assert "unsupported state" in e.value.message
