@@ -769,11 +769,17 @@ class CookApi:
                 for _job, inst in self.store.running_instances()]
 
     def usage(self, params: Dict) -> Dict:
+        """GET /usage?user=&group_breakdown= (reference: rest/api.clj:2855-
+        2940 UsageResponse + user-usage): running usage totals per pool,
+        optionally broken down by job group (``grouped`` entries carry the
+        group's uuid/name/running_jobs; ``ungrouped`` the rest)."""
         user = first(params.get("user"))
         if user is None:
             raise ApiError(400, "user parameter required")
-        out = {"total_usage": {"cpus": 0.0, "mem": 0.0, "gpus": 0.0,
-                               "jobs": 0}, "pools": {}}
+        breakdown = first(params.get("group_breakdown"), "false") == "true"
+        out: Dict[str, Any] = {
+            "total_usage": {"cpus": 0.0, "mem": 0.0, "gpus": 0.0,
+                            "jobs": 0}, "pools": {}}
         for pool in self.store.pools():
             usage = self.store.user_usage(pool.name).get(user)
             if not usage:
@@ -785,6 +791,34 @@ class CookApi:
             out["total_usage"]["mem"] += usage["mem"]
             out["total_usage"]["gpus"] += usage["gpus"]
             out["total_usage"]["jobs"] += int(usage["count"])
+        if breakdown:
+            running = self.store.jobs_where(
+                lambda j: j.user == user and j.state is JobState.RUNNING)
+
+            def usage_of(jobs: List[Job]) -> Dict:
+                return {"cpus": sum(j.resources.cpus for j in jobs),
+                        "mem": sum(j.resources.mem for j in jobs),
+                        "gpus": sum(j.resources.gpus for j in jobs),
+                        "jobs": len(jobs)}
+
+            by_group: Dict[Optional[str], List[Job]] = {}
+            for j in running:
+                by_group.setdefault(j.group, []).append(j)
+            grouped = []
+            for guuid, jobs in sorted(by_group.items(),
+                                      key=lambda kv: kv[0] or ""):
+                if guuid is None:
+                    continue
+                group = self.store.group(guuid)
+                grouped.append({
+                    "group": {"uuid": guuid,
+                              "name": group.name if group else "",
+                              "running_jobs": [j.uuid for j in jobs]},
+                    "usage": usage_of(jobs)})
+            loose = by_group.get(None, [])
+            out["grouped"] = grouped
+            out["ungrouped"] = {"running_jobs": [j.uuid for j in loose],
+                                "usage": usage_of(loose)}
         return out
 
     def share_get(self, params: Dict) -> Dict:

@@ -1182,3 +1182,34 @@ class TestListFilters:
         with pytest.raises(JobClientError) as e:
             got("bogus")
         assert "unsupported state" in e.value.message
+
+
+class TestUsageGroupBreakdown:
+    def test_grouped_and_ungrouped_running_usage(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        g = "11111111-0000-0000-0000-00000000000a"
+        in_group = client.submit(
+            [{"command": "x", "cpus": 2.0, "mem": 256.0, "group": g}
+             for _ in range(2)],
+            groups=[{"uuid": g, "name": "workers"}])
+        loose = client.submit_one("x", cpus=1.0, mem=128.0)
+        sched.step_rank()
+        launched = sched.step_match()["default"].launched_task_ids
+        assert len(launched) == 3
+        out = client._request("GET", "/usage",
+                              params={"user": "alice",
+                                      "group_breakdown": "true"})
+        assert out["total_usage"]["cpus"] == 5.0
+        assert out["total_usage"]["jobs"] == 3
+        [entry] = out["grouped"]
+        assert entry["group"]["uuid"] == g
+        assert entry["group"]["name"] == "workers"
+        assert sorted(entry["group"]["running_jobs"]) == sorted(in_group)
+        assert entry["usage"] == {"cpus": 4.0, "mem": 512.0, "gpus": 0.0,
+                                  "jobs": 2}
+        assert out["ungrouped"]["running_jobs"] == [loose]
+        assert out["ungrouped"]["usage"]["cpus"] == 1.0
+        # without the flag the response keeps the flat shape
+        flat = client._request("GET", "/usage", params={"user": "alice"})
+        assert "grouped" not in flat and "ungrouped" not in flat
