@@ -688,23 +688,50 @@ def bench_pipeline(T=100_000, n_users=200, H=5000, depth=10):
     fused, inp = _fused_cycle_setup(T, n_users, H)
     _sync(fused(inp)[3])  # compile
 
-    # fully-synced per-cycle baseline: dispatch -> read assignments back
-    synced = timed_synced(lambda: fused(inp)[3], reps=depth)
+    # fully-synced per-cycle baseline reads back the SAME four outputs the
+    # pipelined leg (and production _apply_pool) consumes — else the
+    # comparison times different transfer work
+    def one_synced_cycle():
+        res = fused(inp)
+        jax.device_get((res[0], res[1], res[2], res[3]))
+        return None
 
-    # pipelined: dispatch k+1, then read back k (jax dispatch is async,
-    # so the k readback rides out while the device computes k+1)
+    synced = []
+    for _ in range(depth):
+        t0 = time.perf_counter()
+        one_synced_cycle()
+        synced.append((time.perf_counter() - t0) * 1000.0)
+
+    # pipelined: dispatch k, IMMEDIATELY start its async device->host
+    # copies, and consume cycle k-2 — with a lag of 2 the transfer of k
+    # fully overlaps the compute of k+1/k+2, so the tunnel RTT amortizes
+    # out (measured: blocking device_get after dispatch gains nothing —
+    # the proxied backend serializes compute with a blocking transfer,
+    # but async copies ride alongside).  All four production outputs are
+    # read back, exactly what FusedCycleDriver._apply_pool consumes.
+    lag = 2
     samples = []
     for _ in range(3):
         t0 = time.perf_counter()
-        prev = fused(inp)[3]
-        for _k in range(depth - 1):
-            nxt = fused(inp)[3]
-            jax.device_get(prev.ravel()[-1:])  # consume cycle k
-            prev = nxt
-        jax.device_get(prev.ravel()[-1:])
+        q = []
+        for _k in range(depth):
+            res = fused(inp)
+            outs = (res[0], res[1], res[2], res[3])
+            for o in outs:
+                copy_async = getattr(o, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+            q.append(outs)
+            if len(q) > lag:
+                for o in q.pop(0):
+                    np.asarray(o)  # consume cycle k-lag
+        while q:
+            for o in q.pop(0):
+                np.asarray(o)
         samples.append((time.perf_counter() - t0) * 1000.0 / depth)
     out = {
         "depth": depth,
+        "pipeline_lag_cycles": lag,
         "synced_per_cycle_p50_ms": round(pctl(synced, 50), 1),
         "pipelined_amortized_p50_ms": round(pctl(samples, 50), 1),
         "pipelined_amortized_best_ms": round(min(samples), 1),
@@ -1066,6 +1093,11 @@ def main():
     sections = ["sync_floor", "rank", "match", "driver_cycle", "fused_cycle",
                 "store_cycle", "match_large", "rebalance", "end2end",
                 "pallas_scale", "pipeline", "placement_quality"]
+    if os.environ.get("BENCH_SECTIONS"):
+        # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
+        # to re-run just the headline after a transient tunnel failure
+        keep = {s.strip() for s in os.environ["BENCH_SECTIONS"].split(",")}
+        sections = [s for s in sections if s in keep]
     results, platforms, errors = {}, {}, {}
 
     # FIRST LINE, before any probe: the committed on-chip capture (if any)

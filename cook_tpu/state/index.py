@@ -296,6 +296,8 @@ class ColumnarIndex:
             if got is None:
                 return None
             arrays, rows_s, user_s, seg_start = got
+            if user_s is None:  # order-cache path skips the full gather
+                user_s = self._user[rows_s]
             return (arrays, self._uuid[rows_s], user_s,
                     list(user_s[seg_start]))
 
@@ -419,8 +421,13 @@ class ColumnarIndex:
         (``pending_s`` in sorted order); shared by the lexsort path and the
         incremental order-cache path.  Segment boundaries come from
         ``uid_s`` (int compare) when given — an order-preserving id change
-        is exactly a user change — else from the user strings."""
-        if user_s is None:
+        is exactly a user change — else from the user strings.
+
+        The full sorted user-string column is NOT materialized here: a
+        U64 gather is ~25 MB of unicode copying at the 100k design point
+        and segment boundaries only need the int ids.  Callers that want
+        user strings gather the slice they need from ``self._user``."""
+        if user_s is None and uid_s is None:
             user_s = self._user[rows_s]
         first = np.ones(rows_s.size, dtype=bool)
         if uid_s is not None:
@@ -443,18 +450,38 @@ class ColumnarIndex:
         sorted row order: ``job_res`` f32[n,4] = (cpus, mem, gpus, disk) —
         the match kernel's per-row resource demand — and ``complex`` bool[n]
         marking rows whose job needs entity-level constraint handling
-        (see _is_complex).  None when the pool has no pending jobs."""
+        (see _is_complex).  None when the pool has no pending jobs.
+
+        uuid/user columns are returned as BASE-array snapshots plus
+        ``rows_s`` instead of materialized sorted gathers: unicode gathers
+        cost ~40 MB of copying per cycle at 100k rows, while the cycle
+        reads ~1k prefix uuids.  The snapshots stay valid forever: row
+        values for uuid/user/res never mutate, and growth/compaction
+        REPLACE the buffers (``_grow``, ``_maybe_compact``) rather than
+        moving rows in place."""
         with self._lock:
             got = self._rank_rows_locked(pool)
             if got is None:
                 return None
-            arrays, rows_s, user_s, seg_start = got
+            arrays, rows_s, _user_s, seg_start = got
             job_res = np.concatenate(
                 [self._res[rows_s][:, :3], self._disk[rows_s][:, None]],
                 axis=1)
-            return (arrays, self._uuid[rows_s], user_s,
-                    list(user_s[seg_start]),
+            return (arrays, rows_s,
+                    self._uuid[:self._n], self._user[:self._n],
+                    self._res[:self._n],
+                    list(self._user[rows_s[seg_start]]),
                     job_res.astype(F32), self._complex[rows_s])
+
+    def rows_for(self, uuids) -> np.ndarray:
+        """Base-row indices for the given job uuids (unknown uuids are
+        skipped).  Lets hot-path membership tests run on int64 rows instead
+        of gathering string columns (e.g. reservation owners in the fused
+        pack)."""
+        with self._lock:
+            return np.array([r for u in uuids
+                             if (r := self._row.get(u)) is not None],
+                            dtype=np.int64)
 
     def pool_usage_base(self, pool: str) -> np.ndarray:
         """Summed (cpus, mem, gpus, count) of the pool's live instances —

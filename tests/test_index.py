@@ -203,6 +203,8 @@ class TestIncrementalOrderCache:
         if got is None:
             return None
         arrays, rows_s, user_s, _ = got
+        if user_s is None:  # order-cache path: user strings stay lazy
+            user_s = idx._user[rows_s]
         return (list(idx._uuid[rows_s]), arrays["pending"].tolist(),
                 list(user_s))
 
@@ -217,6 +219,8 @@ class TestIncrementalOrderCache:
         for a, b in zip(got[0].values(), got2[0].values()):
             assert np.array_equal(a, b)
         arrays, rows_s, user_s, _ = got
+        if user_s is None:  # order-cache path: user strings stay lazy
+            user_s = idx._user[rows_s]
         return (list(idx._uuid[rows_s]), arrays["pending"].tolist(),
                 list(user_s))
 
