@@ -433,29 +433,67 @@ def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
 
 
 def _fused_cycle_setup(T, n_users, H, seed_rank=9, seed_match=10):
-    """Shared workload + jitted single_pool_cycle for the fused_cycle and
-    pipeline sections — one place to keep the cycle shape identical."""
+    """Shared workload + the PRODUCTION compact cycle for the fused_cycle
+    and pipeline sections — make_pool_cycle(compact=True) over
+    CompactPoolCycleInputs, the exact kernel + wire form behind
+    Scheduler.step_cycle (the bench's transfer profile must match what a
+    deployment moves per cycle).  The workload's all-ones cmask is the
+    structured base mask with nothing blocked, so placements are
+    unchanged vs the dense form."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import Mesh
 
     from cook_tpu.ops import host_prep
-    from cook_tpu.parallel.sharded import single_pool_cycle
+    from cook_tpu.parallel.sharded import (
+        FLAG_ENQUEUE_OK,
+        FLAG_LAUNCH_OK,
+        FLAG_PENDING,
+        FLAG_VALID,
+        CompactPoolCycleInputs,
+        make_pool_cycle,
+    )
 
     users, shares, quotas = make_rank_workload(n_users, T, seed=seed_rank)
     arrays, _ = host_prep.pack_rank_inputs(users, shares, quotas)
     TB = arrays["usage"].shape[0]
-    job_res, cmask, avail, capacity = make_match_workload(
+    job_res, _cmask, avail, capacity = make_match_workload(
         TB, H, seed=seed_match)
-    inp = {k: jnp.asarray(v) for k, v in arrays.items()}
-    inp.update(job_res=jnp.asarray(job_res),
-               cmask=jnp.asarray(cmask),
-               avail=jnp.asarray(avail),
-               capacity=jnp.asarray(capacity))
-    fused = jax.jit(lambda d: single_pool_cycle(
-        d["usage"], d["quota"], d["shares"], d["first_idx"], d["user_rank"],
-        d["pending"], d["valid"], d["job_res"], d["cmask"], d["avail"],
-        d["capacity"], num_considerable=jnp.asarray(1000, dtype=jnp.int32),
-        considerable_cap=1024))
+    INFF = np.float32(np.inf)
+    # per-user tables recovered from the packed per-task columns (segment
+    # starts carry each user's values)
+    vrows = np.flatnonzero(arrays["valid"])
+    fs = np.unique(arrays["first_idx"][vrows])
+    ur = arrays["user_rank"][fs]
+    U = int(ur.max()) + 1 if len(ur) else 1
+    shares_u = np.full((U, 3), INFF, dtype=np.float32)
+    quota_u = np.full((U, 4), INFF, dtype=np.float32)
+    shares_u[ur] = arrays["shares"][fs]
+    quota_u[ur] = arrays["quota"][fs]
+    flags = (arrays["pending"].astype(np.uint8) * FLAG_PENDING
+             + arrays["valid"].astype(np.uint8) * FLAG_VALID
+             + np.uint8(FLAG_ENQUEUE_OK) + np.uint8(FLAG_LAUNCH_OK))
+    at = lambda a, dtype=None: jnp.asarray(
+        a[None] if dtype is None else a[None].astype(dtype))
+    inp = CompactPoolCycleInputs(
+        res=at(job_res),
+        user_rank=at(arrays["user_rank"]),
+        flags=at(flags),
+        tokens_u=at(np.full(U, INFF, dtype=np.float32)),
+        shares_u=at(shares_u),
+        quota_u=at(quota_u),
+        num_considerable=jnp.asarray([1000], dtype=jnp.int32),
+        pool_quota=at(np.full(4, INFF, dtype=np.float32)),
+        group_quota=at(np.full(4, INFF, dtype=np.float32)),
+        group_id=jnp.asarray([-1], dtype=jnp.int32),
+        host_gpu=at(np.zeros(H, dtype=bool)),
+        host_blocked=at(np.zeros(H, dtype=bool)),
+        exc_id=at(np.full(TB, -1, dtype=np.int32)),
+        exc_mask=at(np.zeros((8, H), dtype=bool)),
+        avail=at(avail),
+        capacity=at(capacity))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("pool",))
+    fused = make_pool_cycle(mesh, considerable_cap=1024, compact=True)
     return fused, inp
 
 
@@ -465,8 +503,8 @@ def bench_fused_cycle(T=100_000, n_users=200, H=5000):
     behind Scheduler.step_cycle) — no host round trip between rank and
     match."""
     fused, inp = _fused_cycle_setup(T, n_users, H)
-    times = timed(lambda: fused(inp)[3], reps=5, inner=8)
-    placed = int((np.asarray(fused(inp)[3]) >= 0).sum())
+    times = timed(lambda: fused(inp).cand_assign, reps=5, inner=8)
+    placed = int((np.asarray(fused(inp).cand_assign) >= 0).sum())
     out = {"p50_ms": round(pctl(times, 50), 3),
            "p99_ms": round(pctl(times, 99), 3),
            "placed": placed}
@@ -686,14 +724,18 @@ def bench_pipeline(T=100_000, n_users=200, H=5000, depth=10):
     import jax
 
     fused, inp = _fused_cycle_setup(T, n_users, H)
-    _sync(fused(inp)[3])  # compile
+    _sync(fused(inp).cand_assign)  # compile
 
-    # fully-synced per-cycle baseline reads back the SAME four outputs the
-    # pipelined leg (and production _apply_pool) consumes — else the
-    # comparison times different transfer work
+    # fully-synced per-cycle baseline reads back the SAME compact outputs
+    # the pipelined leg (and production _apply_pool) consumes — the [C]
+    # candidate triples + queue count; the [T] arrays stay device-resident
+    # in production (lazy RankedQueue), so fetching them here would time
+    # transfer work a deployment never does
+    def prod_outs(res):
+        return (res.cand_row, res.cand_assign, res.cand_qpos, res.n_queue)
+
     def one_synced_cycle():
-        res = fused(inp)
-        jax.device_get((res[0], res[1], res[2], res[3]))
+        jax.device_get(prod_outs(fused(inp)))
         return None
 
     synced = []
@@ -707,16 +749,15 @@ def bench_pipeline(T=100_000, n_users=200, H=5000, depth=10):
     # fully overlaps the compute of k+1/k+2, so the tunnel RTT amortizes
     # out (measured: blocking device_get after dispatch gains nothing —
     # the proxied backend serializes compute with a blocking transfer,
-    # but async copies ride alongside).  All four production outputs are
-    # read back, exactly what FusedCycleDriver._apply_pool consumes.
+    # but async copies ride alongside).  The compact production outputs
+    # are read back, exactly what FusedCycleDriver._apply_pool consumes.
     lag = 2
     samples = []
     for _ in range(3):
         t0 = time.perf_counter()
         q = []
         for _k in range(depth):
-            res = fused(inp)
-            outs = (res[0], res[1], res[2], res[3])
+            outs = prod_outs(fused(inp))
             for o in outs:
                 copy_async = getattr(o, "copy_to_host_async", None)
                 if copy_async is not None:

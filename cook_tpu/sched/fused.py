@@ -29,6 +29,7 @@ recur and XLA reuses the compiled cycle.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -80,6 +81,13 @@ class _PackedPool:
         self.enqueue_ok = None
         self.launch_ok = None
         self.tokens = None
+        # compact wire form (CompactPoolCycleInputs): per-user tables +
+        # packed admission flags; the device expands them (expand_compact)
+        self.compact = False
+        self.shares_u: Optional[np.ndarray] = None      # f32[U, 3]
+        self.quota_u: Optional[np.ndarray] = None       # f32[U, 4]
+        self.tokens_u: Optional[np.ndarray] = None      # f32[U]
+        self.flags: Optional[np.ndarray] = None         # u8[T]
         self.num_considerable = 0
         self.pool_quota = np.full(4, INF, dtype=F32)
         self.group_quota = np.full(4, INF, dtype=F32)
@@ -111,16 +119,17 @@ class FusedCycleDriver:
         return self._mesh
 
     def _cycle_fn(self, gpu_mode: bool, considerable_cap: int,
-                  structured: bool = False):
+                  structured: bool = False, compact: bool = False):
         key = (id(self.mesh()), gpu_mode, self.config.max_over_quota_jobs,
-               considerable_cap, structured)
+               considerable_cap, structured, compact)
         fn = self._cycles.get(key)
         if fn is None:
             from ..parallel.sharded import make_pool_cycle
             fn = make_pool_cycle(
                 self.mesh(), gpu_mode=gpu_mode,
                 max_over_quota_jobs=self.config.max_over_quota_jobs,
-                considerable_cap=considerable_cap, structured=structured)
+                considerable_cap=considerable_cap, structured=structured,
+                compact=compact)
             self._cycles[key] = fn
         return fn
 
@@ -137,11 +146,15 @@ class FusedCycleDriver:
         tests/test_fused_cycle.py."""
         store, cfg = self.store, self.config
         idx = store.ensure_index()
-        got = idx.fused_arrays(pool.name)
+        # ONE snapshot of the reservations: the rebalancer thread mutates
+        # reserved_hosts concurrently, and every later read in this pack
+        # (owner rows, host blocks, local owners) must see the same set
+        resv = dict(scheduler.reserved_hosts)
+        got = idx.fused_arrays(pool.name, owner_uuids=list(resv))
         if got is None:
             return None
         (arrays, rows_s, uuid_base, user_base, res_base, users, job_res,
-         complex_rows) = got
+         complex_rows, owner_rows) = got
         pp = _PackedPool(pool)
         pp.columnar = True
         pp.rows_s = rows_s
@@ -152,18 +165,23 @@ class FusedCycleDriver:
         T = arrays["usage"].shape[0]
         pp.arrays, pp.n_tasks = arrays, T
         pend = arrays["pending"]
-        pp.job_res = job_res * pend[:, None]
+        # raw (cpus, mem, gpus, disk); the device masks by the pending flag
+        # (expand_compact), so no [T, 4] multiply or copy happens here
+        pp.job_res = job_res
+        pp.compact = True
 
-        # per-user share/quota, repeated per row via the user segments
+        # per-user share/quota TABLES: the kernel gathers them on device via
+        # user_rank (CompactPoolCycleInputs), so the host never broadcasts
+        # ~32 B/task of user data into [T]-sized columns
         share_mat = np.stack([
             np.array([store.get_share(u, pool.name).get(d, INF)
                       for d in ("cpus", "mem", "gpus")], dtype=F32)
-            for u in users]) if users else np.zeros((0, 3), dtype=F32)
+            for u in users]) if users else np.full((1, 3), INF, dtype=F32)
         quota_mat = np.stack([
             _quota_vec(store.get_quota(u, pool.name)) for u in users]) \
-            if users else np.zeros((0, 4), dtype=F32)
-        arrays["shares"] = share_mat[arrays["user_rank"]]
-        arrays["quota"] = quota_mat[arrays["user_rank"]]
+            if users else np.full((1, 4), INF, dtype=F32)
+        pp.shares_u = share_mat
+        pp.quota_u = quota_mat
 
         # offers from every cluster serving this pool
         offers: List[Offer] = []
@@ -190,19 +208,24 @@ class FusedCycleDriver:
             if cfg.max_tasks_per_host is not None:
                 host_blocked |= host_tasks >= cfg.max_tasks_per_host
             reserved_idx = [host_index[hn]
-                            for hn in scheduler.reserved_hosts.values()
+                            for hn in resv.values()
                             if hn in host_index]
             host_blocked[reserved_idx] = True
             # exception rows = complex jobs + reservation owners (owners
             # must punch through the blanket reserved-host block; owners
             # whose reserved host serves another pool need no exception)
             is_exc = pend & complex_rows
-            local_owners = [u for u, hn in scheduler.reserved_hosts.items()
+            local_owners = [u for u, hn in resv.items()
                             if hn in host_index]
             if local_owners:
-                # int row-membership test — a string isin would re-gather
-                # the full uuid column this pack is built to avoid
-                is_exc |= pend & np.isin(rows_s, idx.rows_for(local_owners))
+                # int row-membership test against rows resolved under the
+                # SAME index lock hold as rows_s (a post-snapshot rows_for
+                # could race a compaction's row remap); a string isin would
+                # re-gather the full uuid column this pack is built to avoid
+                local_rows = np.array(
+                    [owner_rows[u] for u in local_owners
+                     if u in owner_rows], dtype=np.int64)
+                is_exc |= pend & np.isin(rows_s, local_rows)
             cjobs, keep = [], []
             for i in np.flatnonzero(is_exc):
                 job = store.job(str(uuid_at(i)))
@@ -211,7 +234,7 @@ class FusedCycleDriver:
                     keep.append(i)
             crow = np.array(keep, dtype=np.int64)
             ctx = self.matcher._constraint_context(
-                cjobs, scheduler.reserved_hosts)
+                cjobs, resv)
             self.matcher._fill_cotask_host_attributes(
                 ctx, pool.name, offers, scheduler.clusters)
             pp.ctx = ctx
@@ -281,16 +304,28 @@ class FusedCycleDriver:
                     launch_ok[i] = False
         pp.launch_ok = launch_ok
 
-        # launch-rate token budgets per user, broadcast via the segments
+        # launch-rate token budgets per USER (device gathers via user_rank)
         launch_rl = self.rate_limits.job_launch
         if launch_rl.enforce:
             from ..policy import pool_user_key
-            per_user = np.array(
+            pp.tokens_u = np.array(
                 [launch_rl.get_token_count(pool_user_key(pool.name, u))
                  for u in users], dtype=F32)
-            pp.tokens = per_user[arrays["user_rank"]]
         else:
-            pp.tokens = np.full(T, INF, dtype=F32)
+            pp.tokens_u = np.full(max(len(users), 1), INF, dtype=F32)
+
+        # the four admission bools, packed into one wire byte per task
+        from ..parallel.sharded import (
+            FLAG_ENQUEUE_OK,
+            FLAG_LAUNCH_OK,
+            FLAG_PENDING,
+            FLAG_VALID,
+        )
+        pp.flags = (
+            pend.astype(np.uint8) * FLAG_PENDING
+            + arrays["valid"].astype(np.uint8) * FLAG_VALID
+            + enqueue_ok.astype(np.uint8) * FLAG_ENQUEUE_OK
+            + launch_ok.astype(np.uint8) * FLAG_LAUNCH_OK)
 
         self._pack_caps(pp, pool)
         return pp
@@ -486,29 +521,18 @@ class FusedCycleDriver:
                 return pad_to(a, T, fill=fill)
 
             from ..parallel.sharded import (
+                CompactPoolCycleInputs,
                 PoolCycleInputs,
-                StructuredPoolCycleInputs,
             )
             arr = lambda k, fill: stack(lambda pp: padT(pp.arrays[k], fill))
             structured = group[0].columnar
+            stage_t0 = time.perf_counter()
             avail_p = np.zeros((P, H, 4), dtype=F32)
             cap_p = np.zeros((P, H, 4), dtype=F32)
             for i, pp in enumerate(group):
                 avail_p[i, :pp.avail.shape[0]] = pp.avail
                 cap_p[i, :pp.capacity.shape[0]] = pp.capacity
-            common = dict(
-                usage=jnp.asarray(arr("usage", 0)),
-                quota=jnp.asarray(arr("quota", INF)),
-                shares=jnp.asarray(arr("shares", INF)),
-                first_idx=jnp.asarray(arr("first_idx", 0)),
-                user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
-                pending=jnp.asarray(arr("pending", False)),
-                valid=jnp.asarray(arr("valid", False)),
-                enqueue_ok=jnp.asarray(
-                    stack(lambda pp: padT(pp.enqueue_ok, False))),
-                launch_ok=jnp.asarray(
-                    stack(lambda pp: padT(pp.launch_ok, False))),
-                tokens=jnp.asarray(stack(lambda pp: padT(pp.tokens, 0.0))),
+            scalars = dict(
                 num_considerable=jnp.asarray(np.array(
                     [pp.num_considerable for pp in group]
                     + [0] * (P - len(group)), dtype=np.int32)),
@@ -520,12 +544,14 @@ class FusedCycleDriver:
                     + [np.full(4, INF, dtype=F32)] * (P - len(group)))),
                 group_id=jnp.asarray(np.array(
                     [pp.group_id for pp in group]
-                    + [-1] * (P - len(group)), dtype=np.int32)),
-                job_res=jnp.asarray(
-                    stack(lambda pp: padT(pp.job_res, 0.0))))
+                    + [-1] * (P - len(group)), dtype=np.int32)))
             if structured:
-                # bucketed exception capacity: shapes recur across cycles
+                # COMPACT wire form: one resource column + flags byte +
+                # per-user tables; everything else is derived on device
+                # (expand_compact).  ~25 B/task on the wire vs ~76.
                 E = bucket(max(pp.exc_mask.shape[0] for pp in group),
+                           minimum=8)
+                U = bucket(max(pp.shares_u.shape[0] for pp in group),
                            minimum=8)
                 exc_id_p = np.full((P, T), -1, dtype=np.int32)
                 exc_mask_p = np.zeros((P, E, H), dtype=bool)
@@ -533,6 +559,9 @@ class FusedCycleDriver:
                 # padding hosts stay blocked so zero-resource jobs can
                 # never land on them (the dense path's zero rows did this)
                 host_blocked_p = np.ones((P, H), dtype=bool)
+                shares_u_p = np.full((P, U, 3), INF, dtype=F32)
+                quota_u_p = np.full((P, U, 4), INF, dtype=F32)
+                tokens_u_p = np.full((P, U), INF, dtype=F32)
                 for i, pp in enumerate(group):
                     exc_id_p[i, :pp.n_tasks] = pp.exc_id
                     e, h = pp.exc_mask.shape
@@ -540,8 +569,17 @@ class FusedCycleDriver:
                     host_gpu_p[i, :pp.host_gpu.shape[0]] = pp.host_gpu
                     host_blocked_p[i, :pp.host_blocked.shape[0]] = \
                         pp.host_blocked
-                inp = StructuredPoolCycleInputs(
-                    **common,
+                    shares_u_p[i, :pp.shares_u.shape[0]] = pp.shares_u
+                    quota_u_p[i, :pp.quota_u.shape[0]] = pp.quota_u
+                    tokens_u_p[i, :pp.tokens_u.shape[0]] = pp.tokens_u
+                inp = CompactPoolCycleInputs(
+                    res=jnp.asarray(stack(lambda pp: padT(pp.job_res, 0.0))),
+                    user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
+                    flags=jnp.asarray(stack(lambda pp: padT(pp.flags, 0))),
+                    tokens_u=jnp.asarray(tokens_u_p),
+                    shares_u=jnp.asarray(shares_u_p),
+                    quota_u=jnp.asarray(quota_u_p),
+                    **scalars,
                     host_gpu=jnp.asarray(host_gpu_p),
                     host_blocked=jnp.asarray(host_blocked_p),
                     exc_id=jnp.asarray(exc_id_p),
@@ -553,7 +591,22 @@ class FusedCycleDriver:
                 for i, pp in enumerate(group):
                     cmask_p[i, :pp.n_tasks, :pp.cmask.shape[1]] = pp.cmask
                 inp = PoolCycleInputs(
-                    **common,
+                    usage=jnp.asarray(arr("usage", 0)),
+                    quota=jnp.asarray(arr("quota", INF)),
+                    shares=jnp.asarray(arr("shares", INF)),
+                    first_idx=jnp.asarray(arr("first_idx", 0)),
+                    user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
+                    pending=jnp.asarray(arr("pending", False)),
+                    valid=jnp.asarray(arr("valid", False)),
+                    enqueue_ok=jnp.asarray(
+                        stack(lambda pp: padT(pp.enqueue_ok, False))),
+                    launch_ok=jnp.asarray(
+                        stack(lambda pp: padT(pp.launch_ok, False))),
+                    tokens=jnp.asarray(
+                        stack(lambda pp: padT(pp.tokens, 0.0))),
+                    **scalars,
+                    job_res=jnp.asarray(
+                        stack(lambda pp: padT(pp.job_res, 0.0))),
                     cmask=jnp.asarray(cmask_p),
                     avail=jnp.asarray(avail_p),
                     capacity=jnp.asarray(cap_p))
@@ -564,76 +617,123 @@ class FusedCycleDriver:
             cap = bucket(max(
                 self.config.matcher_for_pool(pp.pool.name).max_jobs_considered
                 for pp in group))
+            stage_ms = round((time.perf_counter() - stage_t0) * 1000.0, 1)
+            import os
+            if os.environ.get("COOK_PROFILE_UPLOAD"):
+                import jax as _jax
+                _t = time.perf_counter()
+                _jax.block_until_ready(list(inp))
+                import sys as _sys
+                nbytes = sum(getattr(a, "nbytes", 0) for a in inp)
+                print(f"[profile] stage={stage_ms}ms upload="
+                      f"{(time.perf_counter()-_t)*1e3:.0f}ms "
+                      f"({nbytes/1e6:.1f}MB)", file=_sys.stderr)
             with tracing.span("fused.dispatch", pools=len(group),
-                              tasks=T, hosts=H, gpu=gpu_mode):
-                res = self._cycle_fn(gpu_mode, min(cap, T), structured)(inp)
-            # start the device->host copies the moment each output
-            # materializes: on a tunneled/proxied chip the four transfers
-            # then ride concurrently instead of serially at device_get
-            # (measured ~128ms -> ~100ms per cycle at 100k x 5k)
-            outs = (res.order, res.queue_ok, res.match_valid, res.assign)
-            for arr in outs:
-                copy_async = getattr(arr, "copy_to_host_async", None)
+                              tasks=T, hosts=H, gpu=gpu_mode,
+                              stage_ms=stage_ms):
+                res = self._cycle_fn(gpu_mode, min(cap, T), structured,
+                                     compact=structured)(inp)
+            # fetch ONLY the compact outputs: [C]-sized candidate triples +
+            # the queue count.  The full [T] arrays (order/queue_ok/assign)
+            # and the rank-ordered queue_rows stay device-resident; the
+            # published RankedQueue fetches queue_rows lazily when a
+            # consumer actually touches the queue.  Device->host bandwidth
+            # is the cycle's scarcest resource on a tunneled chip (~10 MB/s
+            # observed): the old four-[T]-array fetch cost 2.1 MB /
+            # 210-250 ms per cycle at T=131k; this fetches ~50 KB.
+            outs = (res.cand_row, res.cand_assign, res.cand_qpos,
+                    res.n_queue)
+            for out_arr in outs:
+                copy_async = getattr(out_arr, "copy_to_host_async", None)
                 if copy_async is not None:
                     copy_async()
             # one batched fetch: each separate np.asarray pays a full
             # device->host round trip (expensive on a tunneled chip)
             import jax
-            order, queue_ok, match_valid, assign = jax.device_get(outs)
+            with tracing.span("fused.fetch"):
+                cand_row, cand_assign, cand_qpos, n_queue = \
+                    jax.device_get(outs)
 
             for i, pp in enumerate(group):
-                self._apply_pool(scheduler, pp, order[i], queue_ok[i],
-                                 match_valid[i], assign[i], queues, results)
+                self._apply_pool(scheduler, pp, cand_row[i], cand_assign[i],
+                                 cand_qpos[i], int(n_queue[i]),
+                                 res.queue_rows, i, queues, results)
         return queues, results
 
     # ----------------------------------------------------------------- apply
-    def _apply_pool(self, scheduler, pp: _PackedPool, order, queue_ok,
-                    match_valid, assign, queues, results) -> None:
-        """Map one pool's kernel outputs back to entities: queue refresh,
-        within-batch group validation, backoff bookkeeping, transactional
-        launch."""
+    def _apply_pool(self, scheduler, pp: _PackedPool, cand_row, cand_assign,
+                    cand_qpos, n_queue: int, queue_rows_dev, pool_slot: int,
+                    queues, results) -> None:
+        """Map one pool's COMPACT kernel outputs back to entities: queue
+        refresh, within-batch group validation, backoff bookkeeping,
+        transactional launch.
+
+        ``cand_row``/``cand_assign``/``cand_qpos`` are the [C] admitted-slot
+        arrays (-1 = empty slot); the rank-ordered queue rows stay on device
+        in ``queue_rows_dev[pool_slot]`` and are fetched only when a queue
+        consumer materializes them."""
         pool_name = pp.pool.name
-        # ranked queue = queue-surviving rows in rank order (built AFTER
-        # the launch below so this cycle's launches can be dropped by exact
-        # queue position — a full-queue isin scan at 100k+ rows is not)
-        ranked_rows = order[queue_ok]
+        # slice this pool's row off the [P, T] output eagerly (an async
+        # device op): the published queue's closure must NOT keep the whole
+        # P-wide buffer — or the rest of pp — alive for its lifetime
+        dev_rows = queue_rows_dev[pool_slot]
+        rows_s = pp.rows_s
+        fetched_rows: List[Optional[np.ndarray]] = [None]
+
+        def fetch_local_rows() -> np.ndarray:
+            # one device->host transfer of exactly n_queue i32 rows, paid
+            # only when some consumer (rebalancer, /queue page, direct-pool
+            # logic) actually touches the published queue
+            if fetched_rows[0] is None:
+                import jax
+                fetched_rows[0] = np.asarray(jax.device_get(
+                    dev_rows[:n_queue]))
+            return fetched_rows[0]
+
+        def local_rows_with_drops(drop_qpos) -> np.ndarray:
+            rows = fetch_local_rows()
+            if drop_qpos is not None and len(drop_qpos):
+                keep = np.ones(len(rows), dtype=bool)
+                keep[drop_qpos] = False
+                rows = rows[keep]
+            return rows
 
         def publish_queue(drop_qpos=None):
-            keep = None
-            if drop_qpos is not None and len(drop_qpos):
-                keep = np.ones(len(ranked_rows), dtype=bool)
-                keep[drop_qpos] = False
-            rows = ranked_rows if keep is None else ranked_rows[keep]
             if pp.columnar:
-                # lazy queue straight over the index BASE snapshots + the
-                # absolute-row selection: consumers materialize only the
-                # prefix they touch; full-column gathers happen only if
-                # someone reads .uuids/.resources/.users (RankedQueue)
+                # lazy queue straight over the index BASE snapshots; the
+                # row selection itself is DEFERRED (device fetch + drop
+                # filter run on first touch), and full-column gathers
+                # happen only if someone reads .uuids/.resources/.users
                 from .ranker import RankedQueue
+                n = n_queue - (len(drop_qpos) if drop_qpos is not None
+                               else 0)
                 queues[pool_name] = RankedQueue(
-                    self.store, pp.uuid_base, pp.res_base,
-                    pp.user_base, rows=pp.rows_s[rows])
+                    self.store, pp.uuid_base, pp.res_base, pp.user_base,
+                    rows_fn=lambda drop=drop_qpos:
+                        rows_s[local_rows_with_drops(drop)],
+                    n=n)
             else:
-                queues[pool_name] = [pp.id2job[pp.task_ids[r]]
-                                     for r in rows]
+                queues[pool_name] = [
+                    pp.id2job[pp.task_ids[r]]
+                    for r in local_rows_with_drops(drop_qpos)]
 
         scheduler._stifle_offensive(pp.offensive)
 
         result = MatchCycleResult()
-        cand_pos = np.flatnonzero(match_valid)
-        result.considered = len(cand_pos)
+        slots = np.flatnonzero(cand_row >= 0)
+        result.considered = len(slots)
         if pp.columnar:
-            uuid_prefix = pp.uuid_base[pp.rows_s[order[cand_pos]]]
+            uuid_prefix = pp.uuid_base[pp.rows_s[cand_row[slots]]]
             fetched = self.store.jobs_bulk([str(u) for u in uuid_prefix])
             cand_jobs, cand_keep = [], []
-            for i, job in zip(cand_pos, fetched):
+            for s, job in zip(slots, fetched):
                 if job is not None:
                     cand_jobs.append(job)
-                    cand_keep.append(i)
-            cand_pos = np.array(cand_keep, dtype=np.int64)
+                    cand_keep.append(s)
+            slots = np.array(cand_keep, dtype=np.int64)
         else:
-            cand_jobs = [pp.id2job[pp.task_ids[order[i]]] for i in cand_pos]
-        if len(cand_pos) == 0 or not pp.offers:
+            cand_jobs = [pp.id2job[pp.task_ids[r]] for r in cand_row[slots]]
+        if len(slots) == 0 or not pp.offers:
             # mirror Matcher.match_pool: an empty cycle returns the
             # considerable set unmatched and leaves backoff untouched
             result.unmatched = cand_jobs
@@ -641,21 +741,21 @@ class FusedCycleDriver:
             results[pool_name] = result
             return
 
-        cand_assign = assign[cand_pos].astype(np.int64)
+        cand_host = cand_assign[slots].astype(np.int64)
         # clip padding-host assignments (can't happen: padding hosts have
         # zero capacity and all-False masks, but stay defensive)
-        cand_assign[cand_assign >= len(pp.offers)] = -1
-        cand_assign = validate_group_placement(
-            cand_jobs, cand_assign, pp.offers, pp.ctx)
+        cand_host[cand_host >= len(pp.offers)] = -1
+        cand_host = validate_group_placement(
+            cand_jobs, cand_host, pp.offers, pp.ctx)
         self.matcher.record_placement_failures(
-            cand_jobs, cand_assign, pp.offers, pp.ctx)
+            cand_jobs, cand_host, pp.offers, pp.ctx)
 
-        result.head_matched = bool(cand_assign[0] >= 0)
+        result.head_matched = bool(cand_host[0] >= 0)
         mc = self.config.matcher_for_pool(pool_name)
         self.matcher._backoff[pool_name].update(mc, result.head_matched)
 
         for j, job in enumerate(cand_jobs):
-            h = int(cand_assign[j])
+            h = int(cand_host[j])
             if h < 0:
                 result.unmatched.append(job)
             else:
@@ -663,15 +763,14 @@ class FusedCycleDriver:
         with tracing.span("fused.launch", pool=pool_name,
                           matched=len(result.matched)):
             self.matcher._launch(pool_name, result, scheduler.clusters)
-        # drop this cycle's launches from the queue by exact position:
-        # qpos[i] = queue index of rank position i (launched candidates are
-        # always queue members — match_valid implies queue_ok)
+        # drop this cycle's launches from the queue by exact position
+        # (launched candidates are always queue members — match_valid
+        # implies queue_ok, so cand_qpos is valid for every launched slot)
         if result.launched_job_uuids:
-            qpos = np.cumsum(queue_ok) - 1
             cand_uuids = np.array([j.uuid for j in cand_jobs])
             launched_c = np.isin(cand_uuids,
                                  np.array(result.launched_job_uuids))
-            publish_queue(qpos[cand_pos[launched_c]])
+            publish_queue(cand_qpos[slots[launched_c]])
             result.queue_pruned = True
         else:
             publish_queue()

@@ -79,26 +79,47 @@ class RankedQueue:
 
     def __init__(self, store: Store, uuids: np.ndarray,
                  resources: np.ndarray, users: Optional[np.ndarray] = None,
-                 rows: Optional[np.ndarray] = None):
+                 rows: Optional[np.ndarray] = None, rows_fn=None,
+                 n: Optional[int] = None):
         """With ``rows`` given, ``uuids``/``resources``/``users`` are BASE
         columns and the queue is their ``rows`` selection, gathered lazily:
         the production cycle publishes a ~100k-row queue every cycle, and
         consumers that only touch a prefix (matcher, /queue page) should
-        not pay three full-column gathers per cycle."""
+        not pay three full-column gathers per cycle.
+
+        ``rows_fn`` defers the row selection itself: the fused cycle keeps
+        the rank-ordered queue rows DEVICE-resident and fetches them only
+        when a consumer touches the queue (the device->host link is the
+        production cycle's scarcest resource over a tunneled chip).  The
+        callable returns the absolute base rows; ``n`` (required with
+        ``rows_fn``) is the queue length, known without fetching."""
         self.store = store
         self._rows = rows
+        self._rows_fn = rows_fn
         self._uuids = uuids
         self._resources = resources  # f32[n, 4] in ranked order
         self._users = users
-        self._n = len(uuids) if rows is None else len(rows)
+        if rows_fn is not None:
+            if n is None:
+                raise ValueError("rows_fn requires an explicit n")
+            self._n = int(n)
+        else:
+            self._n = len(uuids) if rows is None else len(rows)
         # materialization guard: the queue is read concurrently by the
         # rebalancer thread and REST handlers; an unguarded lazy gather
         # would let a reader observe half-swapped columns
         self._mat_lock = __import__("threading").Lock()
 
+    def _resolve_rows(self) -> None:
+        """Run the deferred device fetch (caller holds _mat_lock)."""
+        if self._rows_fn is not None:
+            self._rows = self._rows_fn()
+            self._rows_fn = None
+
     @property
     def uuids(self) -> np.ndarray:
         with self._mat_lock:
+            self._resolve_rows()
             if self._rows is not None:
                 rows = self._rows
                 uuids = self._uuids[rows]
@@ -132,6 +153,7 @@ class RankedQueue:
         """uuid(s) at queue position(s) without materializing the whole
         selection (a prefix touch stays O(prefix))."""
         with self._mat_lock:
+            self._resolve_rows()
             if self._rows is not None:
                 return self._uuids[self._rows[i]]
             return self._uuids[i]

@@ -445,7 +445,7 @@ class ColumnarIndex:
         }
         return (arrays, rows_s, user_s, seg_start)
 
-    def fused_arrays(self, pool: str):
+    def fused_arrays(self, pool: str, owner_uuids=None):
         """rank_arrays plus the fused cycle's extra columns, all in the same
         sorted row order: ``job_res`` f32[n,4] = (cpus, mem, gpus, disk) —
         the match kernel's per-row resource demand — and ``complex`` bool[n]
@@ -458,20 +458,29 @@ class ColumnarIndex:
         reads ~1k prefix uuids.  The snapshots stay valid forever: row
         values for uuid/user/res never mutate, and growth/compaction
         REPLACE the buffers (``_grow``, ``_maybe_compact``) rather than
-        moving rows in place."""
+        moving rows in place.
+
+        ``owner_uuids`` (reservation owners) are resolved to base rows
+        UNDER THE SAME LOCK HOLD as the snapshot: a later ``rows_for``
+        call could race a compaction and compare remapped row ids against
+        the pre-compaction ``rows_s``."""
         with self._lock:
             got = self._rank_rows_locked(pool)
             if got is None:
                 return None
             arrays, rows_s, _user_s, seg_start = got
+            # reuse the usage gather (same _res rows) instead of a second
+            # full-column fancy-index
             job_res = np.concatenate(
-                [self._res[rows_s][:, :3], self._disk[rows_s][:, None]],
+                [arrays["usage"][:, :3], self._disk[rows_s][:, None]],
                 axis=1)
+            owner_rows = {u: r for u in (owner_uuids or ())
+                          if (r := self._row.get(u)) is not None}
             return (arrays, rows_s,
                     self._uuid[:self._n], self._user[:self._n],
                     self._res[:self._n],
                     list(self._user[rows_s[seg_start]]),
-                    job_res.astype(F32), self._complex[rows_s])
+                    job_res.astype(F32), self._complex[rows_s], owner_rows)
 
     def rows_for(self, uuids) -> np.ndarray:
         """Base-row indices for the given job uuids (unknown uuids are
