@@ -449,6 +449,7 @@ def _fused_cycle_setup(T, n_users, H, seed_rank=9, seed_match=10):
         FLAG_ENQUEUE_OK,
         FLAG_LAUNCH_OK,
         FLAG_PENDING,
+        FLAG_USER_FIRST,
         FLAG_VALID,
         CompactPoolCycleInputs,
         make_pool_cycle,
@@ -470,15 +471,22 @@ def _fused_cycle_setup(T, n_users, H, seed_rank=9, seed_match=10):
     quota_u = np.full((U, 4), INFF, dtype=np.float32)
     shares_u[ur] = arrays["shares"][fs]
     quota_u[ur] = arrays["quota"][fs]
+    is_first = arrays["first_idx"] == np.arange(TB, dtype=np.int32)
     flags = (arrays["pending"].astype(np.uint8) * FLAG_PENDING
              + arrays["valid"].astype(np.uint8) * FLAG_VALID
-             + np.uint8(FLAG_ENQUEUE_OK) + np.uint8(FLAG_LAUNCH_OK))
+             + np.uint8(FLAG_ENQUEUE_OK) + np.uint8(FLAG_LAUNCH_OK)
+             + is_first.astype(np.uint8) * FLAG_USER_FIRST)
+    # device-resident base mirror: rows already arrive sorted here, so the
+    # permutation is the identity and the base columns are the sorted ones
+    res_base = np.concatenate(
+        [job_res[:, :3], np.ones((TB, 1), dtype=np.float32)], axis=1)
     at = lambda a, dtype=None: jnp.asarray(
         a[None] if dtype is None else a[None].astype(dtype))
     inp = CompactPoolCycleInputs(
-        res=at(job_res),
-        user_rank=at(arrays["user_rank"]),
+        rows=at(np.arange(TB, dtype=np.int32)),
         flags=at(flags),
+        res_base=jnp.asarray(res_base),
+        disk_base=jnp.asarray(job_res[:, 3].copy()),
         tokens_u=at(np.full(U, INFF, dtype=np.float32)),
         shares_u=at(shares_u),
         quota_u=at(quota_u),
@@ -488,7 +496,7 @@ def _fused_cycle_setup(T, n_users, H, seed_rank=9, seed_match=10):
         group_id=jnp.asarray([-1], dtype=jnp.int32),
         host_gpu=at(np.zeros(H, dtype=bool)),
         host_blocked=at(np.zeros(H, dtype=bool)),
-        exc_id=at(np.full(TB, -1, dtype=np.int32)),
+        exc_rows=at(np.full(8, -1, dtype=np.int32)),
         exc_mask=at(np.zeros((8, H), dtype=bool)),
         avail=at(avail),
         capacity=at(capacity))

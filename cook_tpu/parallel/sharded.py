@@ -152,30 +152,37 @@ FLAG_PENDING = 1
 FLAG_VALID = 2
 FLAG_ENQUEUE_OK = 4
 FLAG_LAUNCH_OK = 8
+FLAG_USER_FIRST = 16   # first row of a user segment
 
 
 class CompactPoolCycleInputs(NamedTuple):
     """The minimum-transfer form of StructuredPoolCycleInputs: what the
     host must genuinely SEND each cycle, with everything derivable moved
-    onto the device.  Host->device bytes drop from ~76 B/task to ~25 B/task
-    (10.8 MB -> 3.5 MB per cycle at the 100k x 5k design point — decisive
-    over a tunneled chip, and still the right shape over PCIe):
+    onto the device — ~5 B/task on the wire vs the naive ~76 (10.8 MB ->
+    ~1 MB per cycle at the 100k x 5k design point; decisive over a
+    tunneled chip and still the right shape over PCIe):
 
-      - one resource column  f32[T, 4] = (cpus, mem, gpus, disk); the DRU
-        usage column is its (cpus, mem, gpus, 1) view and the match demand
-        is its pending-masked (cpus, mem, gpus, disk) view, both composed
-        on device,
+      - the immutable per-job resource columns live in a DEVICE-RESIDENT
+        base mirror (res_base/disk_base, replicated across the mesh; the
+        driver appends new rows incrementally and fully resyncs only on
+        an index compaction), so the per-cycle per-task upload is just
+        the sorted row permutation ``rows`` + one ``flags`` byte,
+      - usage (cpus, mem, gpus, 1) and match demand (cpus, mem, gpus,
+        disk)*pending are device-side gathers/views of the base,
       - per-USER share/quota/token tables [U, ...] gathered on device via
-        user_rank (the host was broadcasting ~32 B/task of user data),
-      - the four admission bools packed into one flags byte,
-      - first_idx re-derived on device from user_rank's segment boundaries.
+        user_rank, which is itself re-derived from the FLAG_USER_FIRST
+        segment boundaries (as is first_idx),
+      - exception rows arrive as a position list ``exc_rows`` (-1 padded)
+        and scatter into the [T] exc_id map on device.
 
     Expanded to StructuredPoolCycleInputs by ``expand_compact`` inside the
     sharded cycle body (so expansion happens post-scatter, per shard)."""
 
-    res: jax.Array         # f32[P, T, 4] (cpus, mem, gpus, disk)
-    user_rank: jax.Array   # i32[P, T] dense user index (segment id)
+    rows: jax.Array        # i32[P, T] absolute base row per sorted
+    #                        position (0 for padding rows; flags=0 there)
     flags: jax.Array       # u8[P, T] FLAG_* bits
+    res_base: jax.Array    # f32[N, 4] (cpus, mem, gpus, 1) — REPLICATED
+    disk_base: jax.Array   # f32[N] — REPLICATED
     tokens_u: jax.Array    # f32[P, U] per-user launch-rate budget
     shares_u: jax.Array    # f32[P, U, 3]
     quota_u: jax.Array     # f32[P, U, 4]
@@ -185,7 +192,7 @@ class CompactPoolCycleInputs(NamedTuple):
     group_id: jax.Array    # i32[P]
     host_gpu: jax.Array    # bool[P, H]
     host_blocked: jax.Array  # bool[P, H]
-    exc_id: jax.Array      # i32[P, T]
+    exc_rows: jax.Array    # i32[P, E] task positions of exception jobs, -1 pad
     exc_mask: jax.Array    # bool[P, E, H]
     avail: jax.Array       # f32[P, H, 4]
     capacity: jax.Array    # f32[P, H, 4]
@@ -194,37 +201,42 @@ class CompactPoolCycleInputs(NamedTuple):
 def expand_compact(inp: CompactPoolCycleInputs) -> StructuredPoolCycleInputs:
     """Device-side expansion of the compact wire form (leading pool axis
     preserved; runs inside the shard so every op stays local)."""
-    res = inp.res
-    P, T = inp.user_rank.shape
-    ones = jnp.ones((P, T, 1), dtype=res.dtype)
-    usage = jnp.concatenate([res[..., :3], ones], axis=-1)
+    P, T = inp.rows.shape
+    usage = jax.vmap(lambda r: inp.res_base[r])(inp.rows)    # [P, T, 4]
+    disk = jax.vmap(lambda r: inp.disk_base[r])(inp.rows)    # [P, T]
     flags = inp.flags
     pending = (flags & FLAG_PENDING) != 0
     valid = (flags & FLAG_VALID) != 0
     enqueue_ok = (flags & FLAG_ENQUEUE_OK) != 0
     launch_ok = (flags & FLAG_LAUNCH_OK) != 0
-    job_res = res * pending[..., None]
-    ur = jnp.minimum(inp.user_rank, inp.tokens_u.shape[1] - 1)
+    is_first = (flags & FLAG_USER_FIRST) != 0
+    job_res = jnp.concatenate(
+        [usage[..., :3], disk[..., None]], axis=-1) * pending[..., None]
+    # user_rank / first_idx from the segment boundaries (rows arrive
+    # user-sorted; padding rows have flags=0 and inherit the last segment,
+    # inert because valid=False there)
+    user_rank = jnp.cumsum(is_first.astype(jnp.int32), axis=1) - 1
+    iota = jnp.arange(T, dtype=jnp.int32)[None, :]
+    first_idx = jax.lax.cummax(jnp.where(is_first, iota, 0), axis=1)
+    ur = jnp.clip(user_rank, 0, inp.tokens_u.shape[1] - 1)
     tokens = jnp.take_along_axis(inp.tokens_u, ur, axis=1)
     shares = jax.vmap(lambda s, u: s[u])(inp.shares_u, ur)
     quota = jax.vmap(lambda q, u: q[u])(inp.quota_u, ur)
-    # first_idx: first row of each contiguous user segment (rows arrive
-    # user-sorted; padding rows share the sentinel user_rank and are
-    # valid=False, so their segment values are inert)
-    iota = jnp.arange(T, dtype=jnp.int32)[None, :]
-    is_first = jnp.concatenate(
-        [jnp.ones((P, 1), dtype=bool),
-         inp.user_rank[:, 1:] != inp.user_rank[:, :-1]], axis=1)
-    first_idx = jax.lax.cummax(
-        jnp.where(is_first, iota, 0), axis=1)
+    # exception-position list -> [T] exc_id map (slot T is the dump row)
+    E = inp.exc_rows.shape[1]
+    eids = jnp.arange(E, dtype=jnp.int32)[None, :]
+    slot = jnp.where(inp.exc_rows >= 0, inp.exc_rows, T)
+    exc_id = jax.vmap(
+        lambda s, e: jnp.full((T + 1,), -1, dtype=jnp.int32)
+        .at[s].set(e, mode="drop")[:T])(slot, jnp.broadcast_to(eids, (P, E)))
     return StructuredPoolCycleInputs(
         usage=usage, quota=quota, shares=shares, first_idx=first_idx,
-        user_rank=inp.user_rank, pending=pending, valid=valid,
+        user_rank=user_rank, pending=pending, valid=valid,
         enqueue_ok=enqueue_ok, launch_ok=launch_ok, tokens=tokens,
         num_considerable=inp.num_considerable, pool_quota=inp.pool_quota,
         group_quota=inp.group_quota, group_id=inp.group_id,
         job_res=job_res, host_gpu=inp.host_gpu,
-        host_blocked=inp.host_blocked, exc_id=inp.exc_id,
+        host_blocked=inp.host_blocked, exc_id=exc_id,
         exc_mask=inp.exc_mask, avail=inp.avail, capacity=inp.capacity)
 
 
@@ -529,9 +541,14 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
                                n_queue=n_queue, cand_row=cand_row,
                                cand_assign=cand_assign, cand_qpos=cand_qpos)
 
+    # pool-sharded on every field except the device-resident base mirrors,
+    # which are replicated (every shard gathers its own pools' rows)
+    replicated = {"res_base", "disk_base"}
+    in_spec = in_type(*(P() if f in replicated else spec
+                        for f in in_type._fields))
     sharded = shard_map(
         cycle_body, mesh=mesh,
-        in_specs=(in_type(*(spec,) * len(in_type._fields)),),
+        in_specs=(in_spec,),
         out_specs=PoolCycleResult(
             order=spec, num_ranked=spec, dru=spec, assign=spec,
             match_valid=spec, queue_ok=spec, accepted=spec,

@@ -88,6 +88,9 @@ class _PackedPool:
         self.quota_u: Optional[np.ndarray] = None       # f32[U, 4]
         self.tokens_u: Optional[np.ndarray] = None      # f32[U]
         self.flags: Optional[np.ndarray] = None         # u8[T]
+        self.disk_base: Optional[np.ndarray] = None     # f32[n] by row
+        self.base_compactions = -1   # index compaction epoch at pack
+        self.exc_rows: Optional[np.ndarray] = None      # i32[n_exc]
         self.num_considerable = 0
         self.pool_quota = np.full(4, INF, dtype=F32)
         self.group_quota = np.full(4, INF, dtype=F32)
@@ -107,6 +110,15 @@ class FusedCycleDriver:
         self.rate_limits = rate_limits
         self._mesh = mesh
         self._cycles: Dict[Tuple, object] = {}
+        # device-resident mirror of the columnar index's immutable res/disk
+        # base columns: rows append-only while the compaction epoch is
+        # unchanged, so steady-state cycles upload only the NEW rows
+        self._mir_key: Optional[int] = None   # compaction epoch mirrored
+        self._mir_n = 0                       # rows synced
+        self._mir_cap = 0                     # device buffer capacity
+        self._mir_res = None                  # f32[cap, 4] on device
+        self._mir_disk = None                 # f32[cap] on device
+        self._append_fn = None                # shared jitted chunk append
 
     # ------------------------------------------------------------------ mesh
     def mesh(self):
@@ -133,6 +145,54 @@ class FusedCycleDriver:
             self._cycles[key] = fn
         return fn
 
+    # ---------------------------------------------------------- base mirror
+    def _append(self, base, chunk, off):
+        """Donating chunk append (jit caches one executable per shape)."""
+        if self._append_fn is None:
+            import jax
+            from jax import lax
+            self._append_fn = jax.jit(
+                lambda b, c, o: lax.dynamic_update_slice(
+                    b, c, (o,) + (0,) * (c.ndim - 1)),
+                donate_argnums=0)
+        return self._append_fn(base, chunk, off)
+
+    def _sync_base_mirror(self, res_base: np.ndarray, disk_base: np.ndarray,
+                          compactions: int):
+        """Bring the device mirror up to the snapshot: full (re)upload on a
+        compaction epoch change or capacity overflow, else one bucketed
+        chunk append of the rows added since the last cycle.  Returns the
+        (res, disk) device arrays (capacity-padded)."""
+        import jax.numpy as jnp
+        n = res_base.shape[0]
+        full = (self._mir_key != compactions or n > self._mir_cap)
+        if not full and n > self._mir_n:
+            k = n - self._mir_n
+            kb = bucket(k, minimum=1024)
+            if self._mir_n + kb > self._mir_cap:
+                full = True  # dynamic_update_slice would clamp, not grow
+            else:
+                chunk = np.zeros((kb, 4), dtype=F32)
+                chunk[:k] = res_base[self._mir_n:n]
+                dchunk = np.zeros(kb, dtype=F32)
+                dchunk[:k] = disk_base[self._mir_n:n]
+                off = jnp.asarray(self._mir_n, dtype=jnp.int32)
+                self._mir_res = self._append(
+                    self._mir_res, jnp.asarray(chunk), off)
+                self._mir_disk = self._append(
+                    self._mir_disk, jnp.asarray(dchunk), off)
+                self._mir_n = n
+        if full:
+            cap = bucket(n, minimum=1024)
+            res_p = np.zeros((cap, 4), dtype=F32)
+            res_p[:n] = res_base
+            disk_p = np.zeros(cap, dtype=F32)
+            disk_p[:n] = disk_base
+            self._mir_res = jnp.asarray(res_p)
+            self._mir_disk = jnp.asarray(disk_p)
+            self._mir_key, self._mir_n, self._mir_cap = compactions, n, cap
+        return self._mir_res, self._mir_disk
+
     # ------------------------------------------------------------------ pack
     def _pack_pool_columnar(self, scheduler,
                             pool: Pool) -> Optional[_PackedPool]:
@@ -150,24 +210,29 @@ class FusedCycleDriver:
         # reserved_hosts concurrently, and every later read in this pack
         # (owner rows, host blocks, local owners) must see the same set
         resv = dict(scheduler.reserved_hosts)
-        got = idx.fused_arrays(pool.name, owner_uuids=list(resv))
-        if got is None:
+        snap = idx.fused_arrays(pool.name, owner_uuids=list(resv),
+                                compact=True)
+        if snap is None:
             return None
-        (arrays, rows_s, uuid_base, user_base, res_base, users, job_res,
-         complex_rows, owner_rows) = got
+        arrays, rows_s = snap.arrays, snap.rows_s
+        uuid_base, complex_rows, owner_rows = \
+            snap.uuid_base, snap.complex_s, snap.owner_rows
+        users = snap.users
         pp = _PackedPool(pool)
         pp.columnar = True
         pp.rows_s = rows_s
         pp.uuid_base, pp.user_base, pp.res_base = \
-            uuid_base, user_base, res_base
+            uuid_base, snap.user_base, snap.res_base
+        # device-resident base mirror inputs: NO per-task resource columns
+        # are gathered on the host at all (expand_compact gathers the
+        # res/disk base by rows on device)
+        pp.disk_base = snap.disk_base
+        pp.base_compactions = snap.compactions
         # sorted-position -> uuid, via the base snapshot (no full gather)
         uuid_at = lambda sel: uuid_base[rows_s[sel]]
-        T = arrays["usage"].shape[0]
+        T = rows_s.size
         pp.arrays, pp.n_tasks = arrays, T
         pend = arrays["pending"]
-        # raw (cpus, mem, gpus, disk); the device masks by the pending flag
-        # (expand_compact), so no [T, 4] multiply or copy happens here
-        pp.job_res = job_res
         pp.compact = True
 
         # per-user share/quota TABLES: the kernel gathers them on device via
@@ -238,16 +303,15 @@ class FusedCycleDriver:
             self.matcher._fill_cotask_host_attributes(
                 ctx, pool.name, offers, scheduler.clusters)
             pp.ctx = ctx
-            exc_id = np.full(T, -1, dtype=np.int32)
             if cjobs:
                 # the compiler emits COMPLETE rows (gpu isolation,
                 # max-tasks, reservations included), so an exception row
                 # fully replaces the base
                 pp.exc_mask = build_constraint_mask(cjobs, offers, ctx)
-                exc_id[crow] = np.arange(len(cjobs), dtype=np.int32)
+                pp.exc_rows = crow.astype(np.int32)
             else:
                 pp.exc_mask = np.zeros((1, H), dtype=bool)
-            pp.exc_id = exc_id
+                pp.exc_rows = np.zeros(0, dtype=np.int32)
             pp.host_gpu = host_gpu
             pp.host_blocked = host_blocked
             pp.avail = np.array(
@@ -259,18 +323,22 @@ class FusedCycleDriver:
         else:
             pp.host_gpu = np.zeros(1, dtype=bool)
             pp.host_blocked = np.ones(1, dtype=bool)
-            pp.exc_id = np.full(T, -1, dtype=np.int32)
+            pp.exc_rows = np.zeros(0, dtype=np.int32)
             pp.exc_mask = np.zeros((1, 1), dtype=bool)
             pp.avail = np.zeros((1, 4), dtype=F32)
             pp.capacity = np.zeros((1, 4), dtype=F32)
             pp.n_hosts = 0
 
-        # offensive-job filter, vectorized over the resource columns
+        # offensive-job filter: vectorized over the BASE columns (the
+        # compact pack gathers no per-task resource columns), then one
+        # [T] bool gather by rows
         enqueue_ok = np.ones(T, dtype=bool)
         limits = cfg.offensive_job_limits
         if limits is not None:
-            bad = pend & ((pp.job_res[:, 1] > limits.memory_gb * 1024.0)
-                          | (pp.job_res[:, 0] > limits.cpus))
+            res_b = snap.res_base
+            bad_base = ((res_b[:, 1] > limits.memory_gb * 1024.0)
+                        | (res_b[:, 0] > limits.cpus))
+            bad = pend & bad_base[rows_s]
             if bad.any():
                 enqueue_ok[bad] = False
                 pp.offensive = [j for j in (store.job(str(u))
@@ -314,18 +382,22 @@ class FusedCycleDriver:
         else:
             pp.tokens_u = np.full(max(len(users), 1), INF, dtype=F32)
 
-        # the four admission bools, packed into one wire byte per task
+        # the admission bools + user-segment boundaries, packed into one
+        # wire byte per task (user_rank/first_idx re-derive on device)
         from ..parallel.sharded import (
             FLAG_ENQUEUE_OK,
             FLAG_LAUNCH_OK,
             FLAG_PENDING,
+            FLAG_USER_FIRST,
             FLAG_VALID,
         )
+        is_first = arrays["first_idx"] == np.arange(T, dtype=np.int32)
         pp.flags = (
             pend.astype(np.uint8) * FLAG_PENDING
             + arrays["valid"].astype(np.uint8) * FLAG_VALID
             + enqueue_ok.astype(np.uint8) * FLAG_ENQUEUE_OK
-            + launch_ok.astype(np.uint8) * FLAG_LAUNCH_OK)
+            + launch_ok.astype(np.uint8) * FLAG_LAUNCH_OK
+            + is_first.astype(np.uint8) * FLAG_USER_FIRST)
 
         self._pack_caps(pp, pool)
         return pp
@@ -460,6 +532,27 @@ class FusedCycleDriver:
                 pp = self._pack_pool(scheduler, pool)
                 if pp is not None:
                     packed.append(pp)
+            # compact packs must share ONE index compaction epoch: the
+            # device base mirror holds one buffer generation, and a pool
+            # packed before a mid-cycle compaction carries remapped row
+            # ids.  Re-pack stragglers (rare: the dead-row threshold means
+            # compaction fires at most once between two packs).
+            epochs = {pp.base_compactions for pp in packed if pp.compact}
+            if len(epochs) > 1:
+                latest = max(epochs)
+                refreshed = []
+                for pp in packed:
+                    if pp.compact and pp.base_compactions != latest:
+                        # a stale pack must NEVER be dispatched: its rows_s
+                        # are pre-compaction row ids.  A re-pack returning
+                        # None (pool's pending drained by the same churn)
+                        # just drops the pool from this cycle.
+                        pp = self._pack_pool(scheduler, pp.pool)
+                        if pp is None or (pp.compact and
+                                          pp.base_compactions != latest):
+                            continue
+                    refreshed.append(pp)
+                packed = refreshed
         queues: Dict[str, List[Job]] = {p.name: [] for p in pools}
         results: Dict[str, MatchCycleResult] = {}
         if not packed:
@@ -546,14 +639,27 @@ class FusedCycleDriver:
                     [pp.group_id for pp in group]
                     + [-1] * (P - len(group)), dtype=np.int32)))
             if structured:
-                # COMPACT wire form: one resource column + flags byte +
-                # per-user tables; everything else is derived on device
-                # (expand_compact).  ~25 B/task on the wire vs ~76.
-                E = bucket(max(pp.exc_mask.shape[0] for pp in group),
-                           minimum=8)
+                # COMPACT wire form: the per-task upload is the sorted row
+                # permutation + one flags byte (~5 B/task); resource
+                # columns live in the device-resident base mirror and
+                # everything else is derived on device (expand_compact).
+                # every pp in the group shares one compaction epoch (step
+                # re-packs or drops stale pools right after the pack loop),
+                # so the mirror's row indices are valid for all of them —
+                # assert rather than silently uploading mixed-epoch content
+                # under one mirror key
+                epoch = max(pp.base_compactions for pp in group)
+                assert all(pp.base_compactions == epoch for pp in group), \
+                    [pp.base_compactions for pp in group]
+                base_pp = max(group, key=lambda pp: pp.res_base.shape[0])
+                mir_res, mir_disk = self._sync_base_mirror(
+                    base_pp.res_base, base_pp.disk_base, epoch)
+                E = bucket(max(max(len(pp.exc_rows), pp.exc_mask.shape[0])
+                               for pp in group), minimum=8)
                 U = bucket(max(pp.shares_u.shape[0] for pp in group),
                            minimum=8)
-                exc_id_p = np.full((P, T), -1, dtype=np.int32)
+                rows_p = np.zeros((P, T), dtype=np.int32)
+                exc_rows_p = np.full((P, E), -1, dtype=np.int32)
                 exc_mask_p = np.zeros((P, E, H), dtype=bool)
                 host_gpu_p = np.zeros((P, H), dtype=bool)
                 # padding hosts stay blocked so zero-resource jobs can
@@ -563,7 +669,8 @@ class FusedCycleDriver:
                 quota_u_p = np.full((P, U, 4), INF, dtype=F32)
                 tokens_u_p = np.full((P, U), INF, dtype=F32)
                 for i, pp in enumerate(group):
-                    exc_id_p[i, :pp.n_tasks] = pp.exc_id
+                    rows_p[i, :pp.n_tasks] = pp.rows_s
+                    exc_rows_p[i, :len(pp.exc_rows)] = pp.exc_rows
                     e, h = pp.exc_mask.shape
                     exc_mask_p[i, :e, :h] = pp.exc_mask
                     host_gpu_p[i, :pp.host_gpu.shape[0]] = pp.host_gpu
@@ -573,16 +680,17 @@ class FusedCycleDriver:
                     quota_u_p[i, :pp.quota_u.shape[0]] = pp.quota_u
                     tokens_u_p[i, :pp.tokens_u.shape[0]] = pp.tokens_u
                 inp = CompactPoolCycleInputs(
-                    res=jnp.asarray(stack(lambda pp: padT(pp.job_res, 0.0))),
-                    user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
+                    rows=jnp.asarray(rows_p),
                     flags=jnp.asarray(stack(lambda pp: padT(pp.flags, 0))),
+                    res_base=mir_res,
+                    disk_base=mir_disk,
                     tokens_u=jnp.asarray(tokens_u_p),
                     shares_u=jnp.asarray(shares_u_p),
                     quota_u=jnp.asarray(quota_u_p),
                     **scalars,
                     host_gpu=jnp.asarray(host_gpu_p),
                     host_blocked=jnp.asarray(host_blocked_p),
-                    exc_id=jnp.asarray(exc_id_p),
+                    exc_rows=jnp.asarray(exc_rows_p),
                     exc_mask=jnp.asarray(exc_mask_p),
                     avail=jnp.asarray(avail_p),
                     capacity=jnp.asarray(cap_p))

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import re
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -57,6 +57,28 @@ _KEY_DT = np.dtype([("uid", "i4"), ("nprio", "i4"), ("st", "i8"),
 # signed forms whose string order differs — those force the string sort)
 _CANON_UUID = re.compile(
     r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
+
+
+class FusedSnapshot(NamedTuple):
+    """One pool's fused-cycle pack snapshot, taken under a single index
+    lock hold (every field is mutually consistent).  Base arrays are
+    views of the live buffers: row values never mutate, and growth/
+    compaction REPLACE buffers rather than moving rows in place, so the
+    views stay valid; ``compactions`` keys device-side mirrors of the
+    res/disk base columns (unchanged counter = row indices stable)."""
+
+    arrays: Dict[str, np.ndarray]   # first_idx/user_rank/pending/valid
+    #                                 (+ usage unless compact)
+    rows_s: np.ndarray              # i64[T] sorted absolute base rows
+    uuid_base: np.ndarray           # U36[n] by row
+    user_base: np.ndarray           # U64[n] by row
+    res_base: np.ndarray            # f32[n, 4] (cpus, mem, gpus, 1) by row
+    disk_base: np.ndarray           # f32[n] by row
+    users: List[str]                # distinct users in segment order
+    job_res: Optional[np.ndarray]   # f32[T, 4] demand; None when compact
+    complex_s: np.ndarray           # bool[T] entity-constraint rows
+    owner_rows: Dict[str, int]      # reservation owner uuid -> base row
+    compactions: int                # index compaction epoch at snapshot
 
 
 def _is_complex(job) -> bool:
@@ -100,6 +122,10 @@ class ColumnarIndex:
         self.store = store
         self._lock = threading.Lock()
         self._n = 0
+        # bumped ONLY by _maybe_compact (row remap); consumers holding a
+        # (compactions, rows_s) snapshot know base rows < their snapshot's
+        # n are content-stable while the counter is unchanged
+        self.compactions = 0
         self._row: Dict[str, int] = {}
         self._res = np.zeros((1024, 4), dtype=F32)
         self._disk = np.zeros(1024, dtype=F32)
@@ -363,7 +389,7 @@ class ColumnarIndex:
             rows = np.insert(rows, pos, arows)
         e["keys"], e["rows"] = keys, rows
 
-    def _rank_rows_locked(self, pool: str):
+    def _rank_rows_locked(self, pool: str, skip_usage: bool = False):
         """Shared body of rank_arrays/fused_arrays (caller holds _lock):
         returns (arrays, sorted row indices, sorted users, segment starts)."""
         if self._maybe_compact():
@@ -378,7 +404,8 @@ class ColumnarIndex:
                 if not pending.any():
                     return None  # no pending jobs (entity-path early-out)
                 return self._rank_arrays_tail(rows_s, pending,
-                                              uid_s=e["keys"]["uid"])
+                                              uid_s=e["keys"]["uid"],
+                                              skip_usage=skip_usage)
         pool_match = self._pool[:n] == pool
         prow = np.flatnonzero(pool_match & self._pending[:n])
         if prow.size == 0:
@@ -412,11 +439,13 @@ class ColumnarIndex:
                 "keys": self._keys_for(rows_s, start[order]),
                 "rows": rows_s.copy(), "log": []}
         user_s = self._user[rows_s]
-        return self._rank_arrays_tail(rows_s, pending[order], user_s=user_s)
+        return self._rank_arrays_tail(rows_s, pending[order], user_s=user_s,
+                                      skip_usage=skip_usage)
 
     def _rank_arrays_tail(self, rows_s: np.ndarray, pending_s: np.ndarray,
                           user_s: Optional[np.ndarray] = None,
-                          uid_s: Optional[np.ndarray] = None):
+                          uid_s: Optional[np.ndarray] = None,
+                          skip_usage: bool = False):
         """Segment bookkeeping + column gathers for already-sorted rows
         (``pending_s`` in sorted order); shared by the lexsort path and the
         incremental order-cache path.  Segment boundaries come from
@@ -437,15 +466,19 @@ class ColumnarIndex:
         seg_start = np.flatnonzero(first)
         seg_id = np.cumsum(first) - 1
         arrays = {
-            "usage": self._res[rows_s],
             "first_idx": seg_start.astype(np.int32)[seg_id],
             "user_rank": seg_id.astype(np.int32),
             "pending": pending_s,
             "valid": np.ones(rows_s.size, dtype=bool),
         }
+        if not skip_usage:
+            # the compact device path gathers res on device via the base
+            # mirror; only the legacy/rank paths pay this [T, 4] gather
+            arrays["usage"] = self._res[rows_s]
         return (arrays, rows_s, user_s, seg_start)
 
-    def fused_arrays(self, pool: str, owner_uuids=None):
+    def fused_arrays(self, pool: str, owner_uuids=None,
+                     compact: bool = False):
         """rank_arrays plus the fused cycle's extra columns, all in the same
         sorted row order: ``job_res`` f32[n,4] = (cpus, mem, gpus, disk) —
         the match kernel's per-row resource demand — and ``complex`` bool[n]
@@ -463,24 +496,37 @@ class ColumnarIndex:
         ``owner_uuids`` (reservation owners) are resolved to base rows
         UNDER THE SAME LOCK HOLD as the snapshot: a later ``rows_for``
         call could race a compaction and compare remapped row ids against
-        the pre-compaction ``rows_s``."""
+        the pre-compaction ``rows_s``.
+
+        With ``compact=True`` (the production device path) the [T]-sized
+        usage/job_res gathers are SKIPPED entirely: the driver mirrors the
+        immutable res/disk base columns on device (keyed on
+        ``compactions``) and gathers by ``rows_s`` there, so the host
+        never builds per-task resource columns at all."""
         with self._lock:
-            got = self._rank_rows_locked(pool)
+            got = self._rank_rows_locked(pool, skip_usage=compact)
             if got is None:
                 return None
             arrays, rows_s, _user_s, seg_start = got
-            # reuse the usage gather (same _res rows) instead of a second
-            # full-column fancy-index
-            job_res = np.concatenate(
-                [arrays["usage"][:, :3], self._disk[rows_s][:, None]],
-                axis=1)
+            if compact:
+                job_res = None
+            else:
+                # reuse the usage gather (same _res rows) instead of a
+                # second full-column fancy-index
+                job_res = np.concatenate(
+                    [arrays["usage"][:, :3], self._disk[rows_s][:, None]],
+                    axis=1).astype(F32)
             owner_rows = {u: r for u in (owner_uuids or ())
                           if (r := self._row.get(u)) is not None}
-            return (arrays, rows_s,
-                    self._uuid[:self._n], self._user[:self._n],
-                    self._res[:self._n],
-                    list(self._user[rows_s[seg_start]]),
-                    job_res.astype(F32), self._complex[rows_s], owner_rows)
+            return FusedSnapshot(
+                arrays=arrays, rows_s=rows_s,
+                uuid_base=self._uuid[:self._n],
+                user_base=self._user[:self._n],
+                res_base=self._res[:self._n],
+                disk_base=self._disk[:self._n],
+                users=list(self._user[rows_s[seg_start]]),
+                job_res=job_res, complex_s=self._complex[rows_s],
+                owner_rows=owner_rows, compactions=self.compactions)
 
     def rows_for(self, uuids) -> np.ndarray:
         """Base-row indices for the given job uuids (unknown uuids are
@@ -531,4 +577,8 @@ class ColumnarIndex:
             self._inst_job_row[:self._ninst]]
         self._n = new_rows.size
         self._dead = int(self._done[:self._n].sum())
+        # row indices were remapped: device-resident base mirrors keyed on
+        # this counter must fully resync (growth, by contrast, preserves
+        # row indices and never bumps it)
+        self.compactions += 1
         return True
