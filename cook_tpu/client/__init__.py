@@ -231,8 +231,19 @@ class JobClient:
     def failure_reasons(self) -> List[Dict]:
         return self._request("GET", "/failure_reasons")
 
-    def stats(self) -> Dict:
-        return self._request("GET", "/stats/instances")
+    def stats(self, status: Optional[str] = None,
+              start: Optional[str] = None, end: Optional[str] = None,
+              name: Optional[str] = None) -> Dict:
+        """GET /stats/instances.  With a status/start/end window, returns
+        the reference-shaped histogram report (task_stats.clj); with no
+        arguments, the quick by-status/by-reason aggregate."""
+        if status is None and start is None and end is None and name is None:
+            return self._request("GET", "/stats/instances")
+        return self._request(
+            "GET", "/stats/instances",
+            params={k: v for k, v in (("status", status), ("start", start),
+                                      ("end", end), ("name", name))
+                    if v is not None})
 
     def settings(self) -> Dict:
         return self._request("GET", "/settings")

@@ -40,7 +40,8 @@ class KubernetesCluster(ComputeCluster):
                  synthetic_pod_ttl_ms: int = 120_000,
                  stuck_pod_timeout_ms: int = 300_000,
                  node_blocklist_labels: Optional[List[str]] = None,
-                 incremental=None):
+                 incremental=None,
+                 rest_url: str = ""):
         super().__init__(name)
         self.api = api or FakeKubernetesApi()
         self.store = store
@@ -52,6 +53,9 @@ class KubernetesCluster(ComputeCluster):
         # kubernetes/api.clj:782)
         self.node_blocklist_labels = list(node_blocklist_labels or [])
         self.incremental = incremental
+        # advertised to tasks as COOK_SCHEDULER_REST_URL
+        # (reference: kubernetes/api.clj:1440)
+        self.rest_url = rest_url
         self._watch_registered = False
         clock = (lambda: store.clock()) if store is not None else (lambda: 0)
         self.controller = PodController(
@@ -196,7 +200,9 @@ class KubernetesCluster(ComputeCluster):
                 gpus=spec.resources.gpus,
                 creation_ms=(self.store.clock() if self.store else 0),
                 labels={"cook/job": spec.job_uuid, "cook/pool": pool},
-                spec=(build_pod_spec(job, pool, incremental=self.incremental)
+                spec=(build_pod_spec(job, pool, incremental=self.incremental,
+                                     task_id=spec.task_id,
+                                     rest_url=self.rest_url)
                       if job is not None else {}))
             if not self.controller.launch_pod(pod):
                 if self._status_callback:

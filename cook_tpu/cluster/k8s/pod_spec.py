@@ -52,12 +52,17 @@ def _resolve_image(incremental: Optional[Any], key: str, default: str,
 
 def build_pod_spec(job: Job, pool: str,
                    incremental: Optional[Any] = None,
-                   sidecar: bool = True) -> Dict[str, Any]:
+                   sidecar: bool = True,
+                   task_id: Optional[str] = None,
+                   rest_url: str = "") -> Dict[str, Any]:
     """Compile one job's pod specification.
 
     ``incremental`` is a policy.incremental.IncrementalConfig used for
     gradual image rollouts (the reference resolves the checkpoint init
     image per job-uuid hash, api.clj:1226 + config_incremental.clj).
+    ``task_id``/``rest_url`` feed the task-identity metadata environment
+    (reference: mesos/task.clj:114-135 + kubernetes/api.clj:1440
+    COOK_SCHEDULER_REST_URL).
     """
     container = job.container or {}
     image = container.get("image", "cook/default-runtime:stable")
@@ -65,7 +70,22 @@ def build_pod_spec(job: Job, pool: str,
     env = [{"name": "COOK_JOB_UUID", "value": job.uuid},
            {"name": "COOK_JOB_USER", "value": job.user},
            {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
-           {"name": "COOK_POOL", "value": pool}]
+           {"name": "COOK_POOL", "value": pool},
+           {"name": "COOK_JOB_CPUS", "value": str(job.resources.cpus)},
+           {"name": "COOK_JOB_MEM_MB", "value": str(job.resources.mem)}]
+    if task_id:
+        env.append({"name": "COOK_INSTANCE_UUID", "value": task_id})
+        # count of PRIOR attempts (the launching task is already recorded
+        # on the job; the reference counts the pre-transaction snapshot)
+        env.append({"name": "COOK_INSTANCE_NUM",
+                    "value": str(max(0, len(job.instances) - 1))})
+    if job.resources.gpus:
+        env.append({"name": "COOK_JOB_GPUS",
+                    "value": str(job.resources.gpus)})
+    if job.group:
+        env.append({"name": "COOK_JOB_GROUP_UUID", "value": job.group})
+    if rest_url:
+        env.append({"name": "COOK_SCHEDULER_REST_URL", "value": rest_url})
     env.extend({"name": k, "value": v} for k, v in sorted(job.env.items()))
 
     volumes = [{"name": "cook-workdir", "empty_dir": {}}]

@@ -538,7 +538,22 @@ class RemoteComputeCluster(ComputeCluster):
             return None, {}
         prelude = compile_fetch_prelude(job.uris)
         command = prelude + job.command if prelude else job.command
-        extra: Dict[str, str] = {}
+        # the reference's task environment (mesos/task.clj:114-135): every
+        # task learns its own identity and resource grant from COOK_* vars
+        extra: Dict[str, str] = {
+            "COOK_JOB_UUID": job.uuid,
+            "COOK_INSTANCE_UUID": spec.task_id,
+            # count of PRIOR attempts (the launching task is already in
+            # job.instances here; the reference counts from the
+            # pre-transaction snapshot, so attempt 1 sees 0)
+            "COOK_INSTANCE_NUM": str(max(0, len(job.instances) - 1)),
+            "COOK_JOB_CPUS": str(job.resources.cpus),
+            "COOK_JOB_MEM_MB": str(job.resources.mem),
+        }
+        if job.resources.gpus:
+            extra["COOK_JOB_GPUS"] = str(job.resources.gpus)
+        if job.group:
+            extra["COOK_JOB_GROUP_UUID"] = job.group
         if job.executor == "cook":
             import shlex
             # prepend (not clobber) any PYTHONPATH the job itself set

@@ -57,6 +57,7 @@ from ..state.schema import (
     to_json,
 )
 from ..state.store import AbortTransaction, Store
+from . import task_stats
 
 
 # (method, path, summary, leader_only) — the documented API surface served
@@ -897,17 +898,35 @@ class CookApi:
                  "failure_limit": r.failure_limit}
                 for r in Reasons.all()]
 
-    def stats_instances(self) -> Dict:
-        by_status: Dict[str, int] = {}
-        by_reason: Dict[str, int] = {}
-        with self.store._lock:
-            for inst in self.store._instances.values():
-                by_status[inst.status.value] = \
-                    by_status.get(inst.status.value, 0) + 1
-                if inst.reason_code is not None:
-                    name = Reasons.by_code(inst.reason_code).name
-                    by_reason[name] = by_reason.get(name, 0) + 1
-        return {"by_status": by_status, "by_reason": by_reason}
+    def stats_instances(self, params: Dict, user: str) -> Dict:
+        """GET /stats/instances?status=&start=&end=&name= — histogram
+        statistics (percentiles + totals of run-time/cpu/mem-seconds)
+        overall, by reason, by user-and-reason, plus per-user leaders,
+        for instances started inside the window (reference:
+        rest/api.clj:3185-3232 task-stats-handler + task_stats.clj).
+
+        Without parameters, serves the legacy quick aggregate (instance
+        counts by status and by reason) — a cook_tpu extension kept for
+        dashboards; any parameter engages full reference validation."""
+        if not params:
+            by_status: Dict[str, int] = {}
+            by_reason: Dict[str, int] = {}
+            with self.store._lock:
+                for inst in self.store._instances.values():
+                    by_status[inst.status.value] = \
+                        by_status.get(inst.status.value, 0) + 1
+                    if inst.reason_code is not None:
+                        name = Reasons.by_code(inst.reason_code).name
+                        by_reason[name] = by_reason.get(name, 0) + 1
+            return {"by_status": by_status, "by_reason": by_reason}
+        self.require_admin(user)
+        try:
+            v = task_stats.validate_params(params)
+        except task_stats.StatsParamError as e:
+            raise ApiError(400, str(e))
+        return task_stats.get_stats(
+            self.store, v["status"], v["start_ms"], v["end_ms"],
+            v["name_fn"], now_ms=self.store.clock())
 
     def progress(self, task_id: str, body: Dict) -> Dict:
         ok = self.store.update_instance_progress(
@@ -1332,7 +1351,7 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/failure_reasons":
                 return api.failure_reasons()
             if path == "/stats/instances":
-                return api.stats_instances()
+                return api.stats_instances(params, self._user())
             if path == "/settings":
                 return api.settings()
             if path == "/info":

@@ -19,7 +19,14 @@ def base_env(job, pool="default", extra=()):
     env = [{"name": "COOK_JOB_UUID", "value": job.uuid},
            {"name": "COOK_JOB_USER", "value": job.user},
            {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
-           {"name": "COOK_POOL", "value": pool}]
+           {"name": "COOK_POOL", "value": pool},
+           {"name": "COOK_JOB_CPUS", "value": str(job.resources.cpus)},
+           {"name": "COOK_JOB_MEM_MB", "value": str(job.resources.mem)}]
+    if job.resources.gpus:
+        env.append({"name": "COOK_JOB_GPUS",
+                    "value": str(job.resources.gpus)})
+    if job.group:
+        env.append({"name": "COOK_JOB_GROUP_UUID", "value": job.group})
     env.extend({"name": k, "value": v} for k, v in sorted(job.env.items()))
     env.extend(extra)
     return env
@@ -215,3 +222,22 @@ class TestGoldenSpecs:
                 "read_only": True} in c["volume_mounts"]
         assert {"name": "uservol-2", "mount_path": "/scratch",
                 "read_only": False} in c["volume_mounts"]
+
+
+def test_launch_path_env_carries_instance_identity():
+    """build_pod_spec with task_id/rest_url (the KubernetesCluster launch
+    call shape) injects the instance identity + scheduler URL vars
+    (reference: mesos/task.clj:114-135, kubernetes/api.clj:1440)."""
+    job = Job(uuid=U, user="alice", command="true",
+              resources=Resources(cpus=1.0, mem=128.0))
+    job.instances = ["task-1"]  # the launching task, already recorded
+    spec = build_pod_spec(job, "default", task_id="task-1",
+                          rest_url="http://cook.example:12321")
+    env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+    assert env["COOK_INSTANCE_UUID"] == "task-1"
+    assert env["COOK_INSTANCE_NUM"] == "0"  # zero PRIOR attempts
+    assert env["COOK_SCHEDULER_REST_URL"] == "http://cook.example:12321"
+    # the no-task_id compile (goldens) stays free of instance identity
+    bare = build_pod_spec(job, "default")
+    bare_env = {e["name"] for e in bare["containers"][0]["env"]}
+    assert "COOK_INSTANCE_UUID" not in bare_env

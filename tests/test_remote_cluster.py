@@ -724,3 +724,64 @@ class TestMemoryLimit:
         finally:
             cluster.shutdown()
             agent.stop()
+
+
+class TestTaskEnvironment:
+    """The COOK_* task identity environment (reference: mesos/task.clj:
+    114-135; integration test_job_environment_cook_job_and_instance_uuid_
+    only / _and_group_uuid): every task sees its job/instance uuids and
+    resource grant; the group uuid appears only for grouped jobs."""
+
+    def test_cook_env_vars_visible_to_task(self, agent, tmp_path):
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import (Group, Job, Resources, Store, new_uuid)
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        out_plain = tmp_path / "plain.env"
+        out_grp = tmp_path / "grouped.env"
+        dump = ("env | grep ^COOK_ | sort > {out}")
+        plain = Job(uuid=new_uuid(), user="alice",
+                    command=dump.format(out=out_plain),
+                    pool="default", resources=Resources(cpus=1.0, mem=128.0))
+        guuid = new_uuid()
+        grouped = Job(uuid=new_uuid(), user="alice", group=guuid,
+                      command=dump.format(out=out_grp),
+                      pool="default",
+                      resources=Resources(cpus=2.0, mem=256.0))
+        store.create_jobs([plain, grouped],
+                          groups=[Group(uuid=guuid, name="g1")])
+        try:
+            sched.step_rank()
+            assert len(sched.step_match()["default"].launched_task_ids) == 2
+
+            def settled():
+                sched.flush_status_updates()
+                return all(store.job(u).state is JobState.COMPLETED
+                           for u in (plain.uuid, grouped.uuid))
+            assert wait_for(settled, timeout=15)
+
+            def env_of(path):
+                return dict(line.split("=", 1) for line in
+                            path.read_text().strip().splitlines())
+            e1 = env_of(out_plain)
+            assert e1["COOK_JOB_UUID"] == plain.uuid
+            assert e1["COOK_INSTANCE_UUID"] == \
+                store.job(plain.uuid).instances[-1]
+            # first attempt: zero PRIOR instances (mesos/task.clj counts
+            # the pre-transaction snapshot)
+            assert e1["COOK_INSTANCE_NUM"] == "0"
+            assert e1["COOK_JOB_CPUS"] == "1.0"
+            assert e1["COOK_JOB_MEM_MB"] == "128.0"
+            assert "COOK_JOB_GROUP_UUID" not in e1  # ungrouped: no group var
+            assert "COOK_JOB_GPUS" not in e1
+            e2 = env_of(out_grp)
+            assert e2["COOK_JOB_GROUP_UUID"] == guuid
+            assert e2["COOK_JOB_CPUS"] == "2.0"
+        finally:
+            cluster.shutdown()

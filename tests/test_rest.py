@@ -1213,3 +1213,92 @@ class TestUsageGroupBreakdown:
         # without the flag the response keeps the flat shape
         flat = client._request("GET", "/usage", params={"user": "alice"})
         assert "grouped" not in flat and "ungrouped" not in flat
+
+
+class TestInstanceStats:
+    """GET /stats/instances with the required status/start/end window
+    (reference: integration test_instance_stats_running/failed/success/
+    supports_epoch_time_params/rejects_invalid_params; semantics from
+    task_stats.clj via rest/api.clj:3185-3232)."""
+
+    def _run_jobs(self, system):
+        store, cluster, sched, server = system
+        alice = client_for(server)
+        bob = client_for(server, "bob")
+        u1 = alice.submit_one("a", cpus=2, mem=256, name="train-1")
+        u2 = alice.submit_one("b", cpus=1, mem=128, name="train-2")
+        u3 = bob.submit_one("c", cpus=4, mem=512, name="serve")
+        sched.step_rank(); sched.step_match()
+        jobs = {u: client_for(server, "admin").job(u) for u in (u1, u2, u3)}
+        cluster.complete_task(jobs[u1]["instances"][0]["task_id"])
+        cluster.fail_task(jobs[u2]["instances"][0]["task_id"], 1)
+        return store, server, (u1, u2, u3)
+
+    def test_success_failed_running_windows(self, system):
+        store, server, _ = self._run_jobs(system)
+        admin = client_for(server, "admin")
+        now = store.clock()
+        start, end = str(now - 3_600_000), str(now + 3_600_000)
+        out = admin.stats(status="success", start=start, end=end)
+        assert out["overall"]["count"] == 1
+        assert set(out["by-user-and-reason"]) == {"alice"}
+        h = out["overall"]["cpu-seconds"]
+        assert set(h["percentiles"]) == {"50", "75", "95", "99", "100"}
+        failed = admin.stats(status="failed", start=start, end=end)
+        assert failed["overall"]["count"] == 1
+        # the failure reason buckets the task
+        assert list(failed["by-reason"]) != [""]
+        running = admin.stats(status="running", start=start, end=end)
+        assert running["overall"]["count"] == 1
+        assert list(running["by-user-and-reason"]) == ["bob"]
+        assert set(running["leaders"]["cpu-seconds"]) == {"bob"}
+        # a window in the past matches nothing
+        empty = admin.stats(status="success",
+                            start=str(now - 7_200_000),
+                            end=str(now - 3_600_000))
+        assert empty["overall"] == {}
+
+    def test_name_filter_wildcard(self, system):
+        store, server, _ = self._run_jobs(system)
+        admin = client_for(server, "admin")
+        now = store.clock()
+        out = admin.stats(status="success", start=str(now - 3_600_000),
+                          end=str(now + 3_600_000), name="train-*")
+        assert out["overall"]["count"] == 1
+        out = admin.stats(status="success", start=str(now - 3_600_000),
+                          end=str(now + 3_600_000), name="serve")
+        assert out["overall"] == {}
+
+    def test_iso_times_accepted(self, system):
+        store, server, _ = self._run_jobs(system)
+        import datetime
+        admin = client_for(server, "admin")
+        now_s = store.clock() / 1000.0
+        iso = lambda t: datetime.datetime.fromtimestamp(
+            t, datetime.timezone.utc).isoformat()
+        out = admin.stats(status="success", start=iso(now_s - 3600),
+                          end=iso(now_s + 3600))
+        assert out["overall"]["count"] == 1
+
+    def test_rejects_invalid_params(self, system):
+        store, _c, _s, server = system
+        admin = client_for(server, "admin")
+        now = store.clock()
+        cases = [
+            dict(status="bogus", start=str(now - 1000), end=str(now)),
+            dict(status="running", start=str(now), end=str(now - 1000)),
+            dict(status="running", start=str(now - 40 * 86_400_000),
+                 end=str(now)),
+            dict(status="running", start=str(now - 1000), end=str(now),
+                 name="bad name!"),
+            dict(status="running", start="yesterday", end=str(now)),
+        ]
+        for kw in cases:
+            with pytest.raises(JobClientError) as e:
+                admin.stats(**kw)
+            assert e.value.status == 400, kw
+        # non-admin is refused the windowed report
+        with pytest.raises(JobClientError) as e:
+            client_for(server).stats(status="running",
+                                     start=str(now - 1000), end=str(now))
+        assert e.value.status == 403
