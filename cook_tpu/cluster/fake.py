@@ -154,6 +154,24 @@ class FakeCluster(ComputeCluster):
                     spec.task_id,
                     self.job_durations_ms.get(spec.job_uuid,
                                               self._default_duration_ms))
+                # out-of-process drivers (daemon integration tests) can't
+                # reach the dicts above; a job env hint carries the same
+                # override through the REST surface
+                env_hint = (spec.env or {}).get("COOK_FAKE_DURATION_MS")
+                if env_hint is not None and \
+                        spec.task_id not in self.task_durations_ms and \
+                        spec.job_uuid not in self.job_durations_ms:
+                    try:
+                        duration = int(env_hint)
+                    except ValueError:
+                        pass
+                exit_hint = (spec.env or {}).get("COOK_FAKE_EXIT_CODE")
+                if exit_hint is not None and \
+                        spec.task_id not in self.task_exit_codes:
+                    try:
+                        self.task_exit_codes[spec.task_id] = int(exit_hint)
+                    except ValueError:
+                        pass
                 # relaunch of a live task_id (retry/replay): release the
                 # overwritten entry's consumption or the host stays
                 # permanently inflated

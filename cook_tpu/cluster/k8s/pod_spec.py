@@ -86,7 +86,12 @@ def build_pod_spec(job: Job, pool: str,
         env.append({"name": "COOK_JOB_GROUP_UUID", "value": job.group})
     if rest_url:
         env.append({"name": "COOK_SCHEDULER_REST_URL", "value": rest_url})
-    env.extend({"name": k, "value": v} for k, v in sorted(job.env.items()))
+    # scheduler-owned identity vars win over user env (the reference assocs
+    # them ON TOP of job-ent->env, mesos/task.clj:127-131; k8s env lists are
+    # last-entry-wins, so drop user collisions instead)
+    reserved = {e["name"] for e in env}
+    env.extend({"name": k, "value": v} for k, v in sorted(job.env.items())
+               if k not in reserved)
 
     volumes = [{"name": "cook-workdir", "empty_dir": {}}]
     mounts = [{"name": "cook-workdir", "mount_path": COOK_WORKDIR}]

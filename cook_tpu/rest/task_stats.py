@@ -135,14 +135,17 @@ def get_stats(store, status: str, start_ms: int, end_ms: int,
     reasons: List[str] = []
     cpu, mem, run = [], [], []
     with store._lock:
-        instances = list(store._instances.values())
-    for inst in instances:
-        if inst.status is not want:
-            continue
+        matched = [inst for inst in store._instances.values()
+                   if inst.status is want and inst.start_time_ms
+                   and start_ms <= inst.start_time_ms < end_ms]
+    # one batched read, one clone per JOB (not per attempt) — per-call
+    # store.job() would re-lock and re-clone for every instance
+    uuids = list({inst.job_uuid for inst in matched})
+    jobs = {u: j for u, j in zip(uuids, store.jobs_bulk(uuids))
+            if j is not None}
+    for inst in matched:
         st = inst.start_time_ms
-        if not st or not (start_ms <= st < end_ms):
-            continue
-        job = store.job(inst.job_uuid)
+        job = jobs.get(inst.job_uuid)
         if job is None:
             continue
         if name_fn is not None and not name_fn(job.name):

@@ -1,0 +1,315 @@
+"""Integration surface tier: REST/CLI conformance scenarios against a real
+``python -m cook_tpu`` daemon process (reference: the scenario families of
+integration/tests/cook/test_basic.py + test_multi_user.py run against a
+live cluster — scheduler info, submit field round-trips, priority, listing
+filters, retry conflicts, group kill, max-runtime enforcement, CORS,
+windowed stats, usage breakdown, unscheduled reasons, partial queries).
+
+One module-scoped daemon serves every scenario (the reference tier does
+the same against one cluster); each test uses its own jobs/uuids so they
+compose.  Exec-dependent scenarios (task env, sandbox files) live in
+test_remote_cluster.py against a real agent; these run the FakeCluster
+backend with auto-advance so terminal states arrive without manual ticks.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from test_integration_scenarios import (req, spawn, wait_leader,
+                                        wait_serving, wait_state)
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("surface")
+    conf = {
+        "host": "127.0.0.1", "port": 0,
+        "data_dir": str(tmp / "data"),
+        "election_dir": str(tmp),
+        "admins": ["admin"],
+        "cors_origins": ["http://cors\\.example\\.com"],
+        "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                      "kwargs": {"name": "alpha", "n_hosts": 3,
+                                 "cpus": 4.0, "mem": 4096.0,
+                                 "default_task_duration_ms": 400,
+                                 "auto_advance": True}}],
+        "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                      "match_interval_seconds": 0.1,
+                      "rank_interval_seconds": 0.1,
+                      "lingering_task_interval_seconds": 0.3},
+    }
+    procs = []
+    p = spawn(conf, tmp, "surface")
+    procs.append(p)
+    url = wait_serving(p)
+    assert wait_leader(url)
+    yield url
+    for pr in procs:
+        if pr.poll() is None:
+            pr.kill()
+        pr.wait(timeout=10)
+
+
+def submit(url, specs, user="alice", **kw):
+    payload = {"jobs": specs, **kw}
+    r = urllib.request.Request(
+        f"{url}/jobs", data=json.dumps(payload).encode(), method="POST",
+        headers={"X-Cook-User": user, "Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=10) as resp:
+        return json.load(resp)["jobs"]
+
+
+def get(url, path):
+    # req() issues every request as the admin user
+    with req("GET", f"{url}{path}") as r:
+        return json.load(r)
+
+
+class TestSchedulerInfo:
+    def test_info_fields(self, daemon):
+        info = get(daemon, "/info")
+        assert info["leader"] is True
+        assert "version" in info and "authentication-scheme" in info
+
+
+class TestSubmitFields:
+    def test_defaults_and_round_trip(self, daemon):
+        [u] = submit(daemon, [{
+            "command": "true", "cpus": 1, "mem": 64,
+            "labels": {"team": "infra"}, "priority": 75,
+            "expected_runtime": 1234,
+            "application": {"name": "cli", "version": "9",
+                            "workload-class": "batch"}}])
+        job = get(daemon, f"/jobs/{u}")
+        assert job["name"] == "cookjob"          # reference default name
+        assert job["labels"] == {"team": "infra"}
+        assert job["priority"] == 75
+        assert job["application"]["name"] == "cli"
+        assert job["application"]["version"] == "9"
+
+    def test_priority_out_of_range_rejected(self, daemon):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            submit(daemon, [{"command": "x", "priority": 101}])
+        assert ei.value.code == 400
+
+    def test_priority_orders_same_user_queue(self, daemon):
+        # saturate the cluster so fresh submissions stay queued, then
+        # assert the ranked /queue puts the high-priority job first
+        hogs = submit(daemon, [{"command": "sleep 999", "cpus": 4,
+                                "mem": 64,
+                                "env": {"COOK_FAKE_DURATION_MS": "999999"}}
+                               for _ in range(3)],
+                      user="hog")
+        for h in hogs:
+            wait_state(daemon, h, "running")
+        lo, hi = submit(daemon, [
+            {"command": "true", "cpus": 1, "mem": 64, "priority": 10},
+            {"command": "true", "cpus": 1, "mem": 64, "priority": 90}],
+            user="prio-user")
+        deadline = time.time() + 10
+        order = None
+        while time.time() < deadline:
+            q = get(daemon, "/queue").get("default", [])
+            order = [j["uuid"] for j in q if j["uuid"] in (lo, hi)]
+            if len(order) == 2:
+                break
+            time.sleep(0.1)
+        assert order == [hi, lo], order
+        for h in hogs:
+            tid = get(daemon, f"/jobs/{h}")["instances"][-1]["task_id"]
+            req("DELETE", f"{daemon}/instances?uuid={tid}")
+
+
+class TestMaxRuntime:
+    def test_max_runtime_exceeded_fails_with_reason(self, daemon):
+        """reference: test_max_runtime_exceeded — a job over its
+        max_runtime is killed with the max-runtime-exceeded reason."""
+        [u] = submit(daemon, [{"command": "sleep 999", "cpus": 1,
+                               "mem": 64, "max_runtime": 500,
+                               "max_retries": 1,
+                               "env": {"COOK_FAKE_DURATION_MS":
+                                       "999999"}}])
+        job = wait_state(daemon, u, "failed", timeout=30)
+        inst = job["instances"][-1]
+        assert inst["reason_string"] == "max-runtime-exceeded", inst
+
+
+class TestListing:
+    def test_list_filters(self, daemon):
+        tag = "lst"
+        a, b = submit(daemon, [
+            {"command": "true", "cpus": 1, "mem": 64, "name": f"{tag}-one"},
+            {"command": "exit 1", "cpus": 1, "mem": 64, "max_retries": 1,
+             "name": f"{tag}-two",
+             "env": {"COOK_FAKE_EXIT_CODE": "1"}}], user="lister")
+        wait_state(daemon, a, "success", timeout=30)
+        wait_state(daemon, b, "failed", timeout=30)
+        by_name = get(daemon, f"/list?user=lister&name={tag}-*"
+                              "&state=completed")
+        assert {j["uuid"] for j in by_name} == {a, b}
+        failed = get(daemon, "/list?user=lister&state=failed")
+        assert {j["uuid"] for j in failed} == {b}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(daemon, "/list?user=lister&name=bad%20name!")
+        assert ei.value.code == 400
+        # time window below every submit matches nothing
+        assert get(daemon, "/list?user=lister&state=completed"
+                           "&end-ms=1000") == []
+
+    def test_partial_jobs_query(self, daemon):
+        [u] = submit(daemon, [{"command": "true", "cpus": 1, "mem": 64}])
+        bogus = "00000000-0000-0000-0000-000000000000"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(daemon, f"/jobs?uuid={u}&uuid={bogus}")
+        assert ei.value.code == 404
+        found = get(daemon, f"/jobs?uuid={u}&uuid={bogus}&partial=true")
+        assert [j["uuid"] for j in found] == [u]
+
+
+class TestRetrySemantics:
+    def test_decrease_below_attempts_conflict(self, daemon):
+        [u] = submit(daemon, [{"command": "exit 1", "cpus": 1, "mem": 64,
+                               "max_retries": 2,
+                               "env": {"COOK_FAKE_EXIT_CODE": "1"}}])
+        wait_state(daemon, u, "failed", timeout=30)
+        assert len(get(daemon, f"/jobs/{u}")["instances"]) == 2
+        body = json.dumps({"job": u, "retries": 1}).encode()
+        r = urllib.request.Request(
+            f"{daemon}/retry", data=body, method="POST",
+            headers={"X-Cook-User": "alice",
+                     "Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=10)
+        assert ei.value.code in (400, 409)
+
+    def test_retry_resurrects_failed_job(self, daemon):
+        [u] = submit(daemon, [{"command": "exit 1", "cpus": 1, "mem": 64,
+                               "max_retries": 1,
+                               "env": {"COOK_FAKE_EXIT_CODE": "1"}}])
+        wait_state(daemon, u, "failed", timeout=30)
+        with req("POST", f"{daemon}/retry",
+                 {"job": u, "retries": 3}) as r:
+            assert r.status == 200
+        job = get(daemon, f"/jobs/{u}")
+        assert job["state"] in ("waiting", "running", "failed")
+        assert job["max_retries"] == 3
+
+
+class TestGroups:
+    def test_group_kill_via_rest(self, daemon):
+        g = "99999999-1111-2222-3333-444444444444"
+        uuids = submit(daemon, [{"command": "sleep 999", "cpus": 1,
+                                 "mem": 64, "group": g,
+                                 "env": {"COOK_FAKE_DURATION_MS": "999999"}}
+                                for _ in range(2)],
+                       groups=[{"uuid": g, "name": "killme"}])
+        for u in uuids:
+            wait_state(daemon, u, "running")
+        with req("DELETE", f"{daemon}/group?uuid={g}") as r:
+            assert r.status == 200
+        for u in uuids:
+            job = wait_state(daemon, u, "failed", timeout=20)
+            assert job["state"] == "failed"
+
+    def test_group_query_without_uuid_400(self, daemon):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(daemon, "/group")
+        assert ei.value.code == 400
+
+
+class TestCors:
+    def test_preflight_allowed_and_denied(self, daemon):
+        r = urllib.request.Request(f"{daemon}/jobs", method="OPTIONS",
+                                   headers={"Origin":
+                                            "http://cors.example.com"})
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Access-Control-Allow-Origin"] == \
+                "http://cors.example.com"
+        r = urllib.request.Request(f"{daemon}/jobs", method="OPTIONS",
+                                   headers={"Origin": "http://evil.com"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=5)
+        assert ei.value.code == 403
+
+    def test_cors_request_carries_allow_origin(self, daemon):
+        r = urllib.request.Request(
+            f"{daemon}/info",
+            headers={"Origin": "http://cors.example.com",
+                     "X-Cook-User": "alice"})
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            assert resp.headers["Access-Control-Allow-Origin"] == \
+                "http://cors.example.com"
+
+
+class TestWindowedStats:
+    def test_stats_through_daemon(self, daemon):
+        [u] = submit(daemon, [{"command": "true", "cpus": 1, "mem": 64,
+                               "name": "statjob"}], user="statuser")
+        wait_state(daemon, u, "success", timeout=30)
+        now_ms = int(time.time() * 1000)
+        out = get(daemon, "/stats/instances?status=success"
+                          f"&start={now_ms - 3_600_000}"
+                          f"&end={now_ms + 3_600_000}&name=statjob")
+        assert out["overall"]["count"] >= 1
+        assert "statuser" in out["by-user-and-reason"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(daemon, "/stats/instances?status=nope"
+                        f"&start={now_ms - 1000}&end={now_ms}")
+        assert ei.value.code == 400
+
+
+class TestUsageAndUnscheduled:
+    def test_usage_group_breakdown(self, daemon):
+        g = "99999999-aaaa-bbbb-cccc-dddddddddddd"
+        grouped = submit(daemon, [{"command": "sleep 999", "cpus": 1,
+                                   "mem": 64, "group": g,
+                                   "env": {"COOK_FAKE_DURATION_MS":
+                                           "999999"}}],
+                         user="usage-user",
+                         groups=[{"uuid": g, "name": "grp"}])
+        loose = submit(daemon, [{"command": "sleep 999", "cpus": 1,
+                                 "mem": 64,
+                                 "env": {"COOK_FAKE_DURATION_MS":
+                                         "999999"}}], user="usage-user")
+        for u in grouped + loose:
+            wait_state(daemon, u, "running")
+        out = get(daemon, "/usage?user=usage-user&group_breakdown=true")
+        assert out["total_usage"]["jobs"] == 2
+        [entry] = out["grouped"]
+        assert entry["group"]["uuid"] == g
+        assert out["ungrouped"]["running_jobs"] == loose
+        for u in grouped + loose:
+            tid = get(daemon, f"/jobs/{u}")["instances"][-1]["task_id"]
+            req("DELETE", f"{daemon}/instances?uuid={tid}")
+
+    def test_unscheduled_reasons_for_too_big_job(self, daemon):
+        [u] = submit(daemon, [{"command": "x", "cpus": 64, "mem": 64}])
+        # two-step workflow: the first query marks the job under
+        # investigation; a later match cycle records the placement verdict
+        deadline = time.time() + 15
+        reasons = []
+        while time.time() < deadline:
+            out = get(daemon, f"/unscheduled_jobs?job={u}")
+            reasons = [r["reason"] for r in out[0]["reasons"]]
+            if any("placed" in r or "match" in r or "hosts" in r
+                   for r in reasons):
+                break
+            time.sleep(0.2)
+        assert any("placed" in r or "match" in r or "hosts" in r
+                   for r in reasons), reasons
+        req("DELETE", f"{daemon}/jobs?uuid={u}")
+
+
+class TestQueueAccess:
+    def test_queue_admin_gated(self, daemon):
+        r = urllib.request.Request(f"{daemon}/queue",
+                                   headers={"X-Cook-User": "alice"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=5)
+        assert ei.value.code == 403
+        assert isinstance(get(daemon, "/queue"), dict)
