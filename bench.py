@@ -1084,8 +1084,21 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["end2end_100k_cycle_p99_ms"] = round(pctl(e2e, 99), 1)
     if os.environ.get("BENCH_SCALE") not in (None, "", "1.0"):
         # every emitted line must carry the scale: a mid-run kill must not
-        # leave 0.1-scale numbers that read as full-scale results
-        detail["scale"] = float(os.environ["BENCH_SCALE"])
+        # leave 0.1-scale numbers that read as full-scale results.  When
+        # the scale was engaged MID-RUN (backend wedged after full-scale
+        # on-chip sections completed), it applies only to the later
+        # CPU-platform sections — record it under a distinct key so the
+        # completed full-scale numbers aren't discounted by the global
+        # scale rule.
+        if os.environ.get("BENCH_MIDRUN_FALLBACK") == "1":
+            detail["late_cpu_fallback_scale"] = \
+                float(os.environ["BENCH_SCALE"])
+        else:
+            detail["scale"] = float(os.environ["BENCH_SCALE"])
+    if len(set(platforms.values())) > 1:
+        # mixed run (mid-run CPU fallback): make per-section provenance
+        # explicit so no number is misread as on-chip
+        detail["section_platforms"] = dict(platforms)
     if errors:
         detail["section_errors"] = errors
     if pending:
@@ -1106,9 +1119,12 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         }
     if value is not None and detail.get("scale") not in (None, 1.0) \
             and capture is not None:
-        # a down-scaled run (CPU fallback) must not publish its numbers
-        # under the full-scale metric name: demote them to detail and let
-        # the committed full-scale on-chip capture carry the headline
+        # a down-scaled run (CPU fallback or preset BENCH_SCALE) must not
+        # publish its numbers under the full-scale metric name: demote
+        # them to detail and let the committed full-scale on-chip capture
+        # carry the headline.  (A mid-run fallback after full-scale
+        # on-chip rank/match sets late_cpu_fallback_scale instead of
+        # scale, so that headline stands.)
         detail["scaled_run_value_p99_ms"] = value
         detail["scaled_run_vs_baseline"] = vs_baseline
         detail["value_source"] = ("prior_tpu_capture:" + (capture_src or "?"))
@@ -1137,6 +1153,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--section":
         run_section(sys.argv[2])
         return
+    # set only by the mid-run wedge fallback below; a stale value from the
+    # surrounding environment would mislabel this run's scale provenance
+    os.environ.pop("BENCH_MIDRUN_FALLBACK", None)
 
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle", "fused_cycle",
@@ -1203,6 +1222,27 @@ def main():
         if err:
             errors[name] = err
             print(f"bench section {name} FAILED: {err}", file=sys.stderr)
+        # a HUNG section (vs a fast failure) on the TPU path usually means
+        # the tunneled backend wedged mid-run (observed r2-r4: even a
+        # trivial jit then blocks forever).  Re-probe once; if the probe
+        # can't come back either, finish the remaining sections on CPU at
+        # fallback scale instead of burning the deadline on more hangs.
+        if err and "hung" in err and \
+                os.environ.get("BENCH_FORCE_CPU") != "1":
+            ok, info = _probe_backend_subprocess(min(60, PROBE_TIMEOUT_S))
+            if not ok:
+                tpu_error = f"backend wedged mid-run at {name}: {info}"
+                os.environ["BENCH_FORCE_CPU"] = "1"
+                # BENCH_MIDRUN_FALLBACK marks that the scale below applies
+                # only to the sections still to run, NOT to the completed
+                # full-scale on-chip sections (build_payload keys on it)
+                os.environ["BENCH_MIDRUN_FALLBACK"] = "1"
+                if "BENCH_SCALE" not in os.environ:
+                    os.environ["BENCH_SCALE"] = str(CPU_FALLBACK_SCALE)
+                section_timeout = min(section_timeout, 150.0)
+                deadline = min(deadline, time.time() + 600.0)
+                print(f"bench: {tpu_error}; remaining sections fall back "
+                      "to CPU", file=sys.stderr)
         # re-emit the full payload after EVERY section: last line wins, so
         # a driver timeout mid-run keeps everything completed so far
         emit(build_payload(results, platforms, errors, tpu_error, t_start,

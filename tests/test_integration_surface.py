@@ -106,22 +106,25 @@ class TestSubmitFields:
                       user="hog")
         for h in hogs:
             wait_state(daemon, h, "running")
-        lo, hi = submit(daemon, [
-            {"command": "true", "cpus": 1, "mem": 64, "priority": 10},
-            {"command": "true", "cpus": 1, "mem": 64, "priority": 90}],
-            user="prio-user")
-        deadline = time.time() + 10
-        order = None
-        while time.time() < deadline:
-            q = get(daemon, "/queue").get("default", [])
-            order = [j["uuid"] for j in q if j["uuid"] in (lo, hi)]
-            if len(order) == 2:
-                break
-            time.sleep(0.1)
-        assert order == [hi, lo], order
-        for h in hogs:
-            tid = get(daemon, f"/jobs/{h}")["instances"][-1]["task_id"]
-            req("DELETE", f"{daemon}/instances?uuid={tid}")
+        try:
+            lo, hi = submit(daemon, [
+                {"command": "true", "cpus": 1, "mem": 64, "priority": 10},
+                {"command": "true", "cpus": 1, "mem": 64, "priority": 90}],
+                user="prio-user")
+            deadline = time.time() + 10
+            order = None
+            while time.time() < deadline:
+                q = get(daemon, "/queue").get("default", [])
+                order = [j["uuid"] for j in q if j["uuid"] in (lo, hi)]
+                if len(order) == 2:
+                    break
+                time.sleep(0.1)
+            assert order == [hi, lo], order
+        finally:
+            # a failure must not leave the module-scoped cluster saturated
+            for h in hogs:
+                tid = get(daemon, f"/jobs/{h}")["instances"][-1]["task_id"]
+                req("DELETE", f"{daemon}/instances?uuid={tid}")
 
 
 class TestMaxRuntime:
@@ -276,16 +279,20 @@ class TestUsageAndUnscheduled:
                                  "mem": 64,
                                  "env": {"COOK_FAKE_DURATION_MS":
                                          "999999"}}], user="usage-user")
-        for u in grouped + loose:
-            wait_state(daemon, u, "running")
-        out = get(daemon, "/usage?user=usage-user&group_breakdown=true")
-        assert out["total_usage"]["jobs"] == 2
-        [entry] = out["grouped"]
-        assert entry["group"]["uuid"] == g
-        assert out["ungrouped"]["running_jobs"] == loose
-        for u in grouped + loose:
-            tid = get(daemon, f"/jobs/{u}")["instances"][-1]["task_id"]
-            req("DELETE", f"{daemon}/instances?uuid={tid}")
+        try:
+            for u in grouped + loose:
+                wait_state(daemon, u, "running")
+            out = get(daemon, "/usage?user=usage-user&group_breakdown=true")
+            assert out["total_usage"]["jobs"] == 2
+            [entry] = out["grouped"]
+            assert entry["group"]["uuid"] == g
+            assert out["ungrouped"]["running_jobs"] == loose
+        finally:
+            for u in grouped + loose:
+                insts = get(daemon, f"/jobs/{u}")["instances"]
+                if insts:
+                    req("DELETE",
+                        f"{daemon}/instances?uuid={insts[-1]['task_id']}")
 
     def test_unscheduled_reasons_for_too_big_job(self, daemon):
         [u] = submit(daemon, [{"command": "x", "cpus": 64, "mem": 64}])
