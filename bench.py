@@ -1234,9 +1234,17 @@ def main():
                 tpu_error = f"backend wedged mid-run at {name}: {info}"
                 os.environ["BENCH_FORCE_CPU"] = "1"
                 # BENCH_MIDRUN_FALLBACK marks that the scale below applies
-                # only to the sections still to run, NOT to the completed
-                # full-scale on-chip sections (build_payload keys on it)
-                os.environ["BENCH_MIDRUN_FALLBACK"] = "1"
+                # only to the sections still to run, NOT to completed
+                # full-scale on-chip sections (build_payload keys on it).
+                # It is only legitimate when the headline rank/match
+                # sections DID complete on-chip at full scale — a wedge
+                # before that (or a preset BENCH_SCALE) means every number
+                # is scaled and the normal demotion rule must apply.
+                if platforms.get("rank") == "tpu" \
+                        and platforms.get("match") == "tpu" \
+                        and os.environ.get("BENCH_SCALE") in (None, "",
+                                                              "1.0"):
+                    os.environ["BENCH_MIDRUN_FALLBACK"] = "1"
                 if "BENCH_SCALE" not in os.environ:
                     os.environ["BENCH_SCALE"] = str(CPU_FALLBACK_SCALE)
                 section_timeout = min(section_timeout, 150.0)

@@ -16,6 +16,7 @@ import argparse
 import json
 import os
 import sys
+import time
 import urllib.parse
 import urllib.request
 from pathlib import Path
@@ -27,21 +28,96 @@ CONFIG_PATH = Path.home() / ".cs.json"
 
 
 def load_urls(args) -> List[str]:
+    # clusters named by entity refs on this invocation come first
+    refs = list(getattr(args, "ref_urls", []) or [])
     if args.url:
-        return [args.url]
+        return refs + [args.url]
     env = os.environ.get("COOK_URL")
     if env:
-        return env.split(",")
+        return refs + env.split(",")
     if CONFIG_PATH.exists():
         cfg = json.loads(CONFIG_PATH.read_text())
-        return [c["url"] for c in cfg.get("clusters", [])]
-    return ["http://127.0.0.1:12321"]
+        return refs + [c["url"] for c in cfg.get("clusters", [])]
+    return refs or ["http://127.0.0.1:12321"]
 
 
 def clients(args) -> List[JobClient]:
     user = args.user or os.environ.get("COOK_USER") \
         or os.environ.get("USER", "anonymous")
     return [JobClient(url, user=user) for url in load_urls(args)]
+
+
+def resolve_refs(args, tokens: List[str],
+                 allow_stdin: bool = True) -> Optional[List[str]]:
+    """Entity refs -> uuids (reference: cli/cook/querying.py
+    parse_entity_refs + the test_entity_refs_* integration scenarios).
+
+    Accepts bare uuids, ``https://cluster/jobs/<uuid>`` refs (case-
+    insensitive, optional trailing slash on the cluster part), and
+    ``...?job=<uuid>`` query-string refs; a ref's cluster URL is added to
+    this invocation's federation list.  With no tokens, refs are read
+    from stdin (one per whitespace-separated word) so ``cs jobs | cs
+    kill`` pipes compose.  Duplicate uuids are an error (the reference
+    refuses them for show/wait/kill alike) -> None."""
+    if not tokens and allow_stdin and not sys.stdin.isatty():
+        tokens = sys.stdin.read().split()
+    uuids: List[str] = []
+    extra_urls: List[str] = []
+    for tok in tokens:
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.lower().startswith(("http://", "https://")):
+            parsed = urllib.parse.urlparse(tok)
+            qs = urllib.parse.parse_qs(parsed.query)
+            if qs.get("job"):
+                uuid = qs["job"][0]
+            else:
+                parts = [p for p in parsed.path.split("/") if p]
+                uuid = parts[-1] if parts else ""
+                if uuid in ("jobs", "rawscheduler", "instances", "group"):
+                    uuid = ""  # a bare endpoint path carries no uuid
+            if not uuid:
+                print(f"error: malformed entity ref {tok}", file=sys.stderr)
+                return None
+            extra_urls.append(f"{parsed.scheme}://{parsed.netloc}")
+            uuids.append(uuid.lower())
+        else:
+            uuids.append(tok.lower())
+    if len(set(uuids)) != len(uuids):
+        dupes = sorted({u for u in uuids if uuids.count(u) > 1})
+        print(f"error: duplicate uuids {', '.join(dupes)}", file=sys.stderr)
+        return None
+    if not uuids:
+        print("error: at least one uuid or entity ref is required",
+              file=sys.stderr)
+        return None
+    if extra_urls:
+        args.ref_urls = list(dict.fromkeys(extra_urls))
+    return uuids
+
+
+def federated_owners(args, uuids: List[str]
+                     ) -> Tuple[List[Tuple[JobClient, List[str]]],
+                                List[str]]:
+    """Partition uuids by the federation cluster that owns them
+    (reference: querying.py routes each entity to its cluster before
+    acting on it).  Returns ([(client, owned_uuids)...], missing)."""
+    unclaimed = list(uuids)
+    owned: List[Tuple[JobClient, List[str]]] = []
+    for client in clients(args):
+        if not unclaimed:
+            break
+        try:
+            found = {j["uuid"] for j in client.query(unclaimed,
+                                                     partial=True)}
+        except (JobClientError, OSError):
+            continue
+        mine = [u for u in unclaimed if u in found]
+        if mine:
+            owned.append((client, mine))
+            unclaimed = [u for u in unclaimed if u not in found]
+    return owned, unclaimed
 
 
 def federated_query(args, uuids: List[str]) -> List[Dict]:
@@ -51,7 +127,9 @@ def federated_query(args, uuids: List[str]) -> List[Dict]:
     errors = []
     for client in clients(args):
         try:
-            for job in client.query(uuids):
+            # partial: a cluster that owns only SOME of the uuids must
+            # return that subset, not 404 the whole query
+            for job in client.query(uuids, partial=True):
                 seen.setdefault(job["uuid"], job)
         except (JobClientError, OSError) as e:
             errors.append(f"{client.url}: {e}")
@@ -66,39 +144,76 @@ def out(payload) -> None:
 
 
 def cmd_submit(args) -> int:
-    spec: Dict = {"command": " ".join(args.command)}
-    for field in ("name", "pool"):
-        value = getattr(args, field)
-        if value:
-            spec[field] = value
-    for field in ("cpus", "mem", "gpus", "priority", "max_retries", "ports"):
-        value = getattr(args, field)
-        if value is not None:
-            spec[field] = value
-    if args.env:
-        spec["env"] = dict(kv.split("=", 1) for kv in args.env)
-    if args.label:
-        spec["labels"] = dict(kv.split("=", 1) for kv in args.label)
-    if args.constraint:
-        spec["constraints"] = [c.split(":", 2) for c in args.constraint]
-    if args.docker_image:
-        spec["container"] = {"image": args.docker_image,
-                             "volumes": list(args.volume or [])}
-    if args.uri:
-        spec["uris"] = [{"value": u} for u in args.uri]
-    if args.executor:
-        spec["executor"] = args.executor
-    if args.application:
-        name, _, version = args.application.partition(":")
-        spec["application"] = {"name": name, "version": version or "0"}
+    """Submit job(s) (reference: cli/cook/subcommands/submit.py): the
+    command comes from argv, or — when absent — from stdin, one job per
+    non-empty line; ``--raw`` instead reads full JSON spec(s) (an object
+    or a list) from stdin and refuses argv commands."""
+    if args.raw:
+        if args.command:
+            print("error: --raw reads specs from stdin; it cannot be "
+                  "combined with a command argument", file=sys.stderr)
+            return 1
+        if sys.stdin.isatty():
+            print("error: --raw expects JSON spec(s) on stdin",
+                  file=sys.stderr)
+            return 1
+        try:
+            raw = json.loads(sys.stdin.read())
+        except json.JSONDecodeError as e:
+            print(f"error: malformed --raw JSON: {e}", file=sys.stderr)
+            return 1
+        specs = raw if isinstance(raw, list) else [raw]
+    else:
+        if args.command:
+            commands = [" ".join(args.command)]
+        elif sys.stdin.isatty():
+            commands = []  # interactive with no command: error, not a hang
+        else:
+            commands = [line.strip() for line in sys.stdin.read().splitlines()
+                        if line.strip()]
+        if not commands:
+            print("error: no command given (argv or stdin)",
+                  file=sys.stderr)
+            return 1
+        base: Dict = {}
+        for field in ("name", "pool"):
+            value = getattr(args, field)
+            if value:
+                base[field] = value
+        for field in ("cpus", "mem", "gpus", "priority", "max_retries",
+                      "ports"):
+            value = getattr(args, field)
+            if value is not None:
+                base[field] = value
+        if args.env:
+            base["env"] = dict(kv.split("=", 1) for kv in args.env)
+        if args.label:
+            base["labels"] = dict(kv.split("=", 1) for kv in args.label)
+        if args.constraint:
+            base["constraints"] = [c.split(":", 2) for c in args.constraint]
+        if args.docker_image:
+            base["container"] = {"image": args.docker_image,
+                                 "volumes": list(args.volume or [])}
+        if args.uri:
+            base["uris"] = [{"value": u} for u in args.uri]
+        if args.executor:
+            base["executor"] = args.executor
+        if args.application:
+            name, _, version = args.application.partition(":")
+            base["application"] = {"name": name, "version": version or "0"}
+        specs = [{**base, "command": c} for c in commands]
     client = clients(args)[0]
-    uuids = client.submit([spec])
-    print(uuids[0])
+    uuids = client.submit(specs)
+    for u in uuids:
+        print(u)
     return 0
 
 
 def cmd_show(args) -> int:
-    jobs = federated_query(args, args.uuid)
+    uuids = resolve_refs(args, args.uuid)
+    if uuids is None:
+        return 1
+    jobs = federated_query(args, uuids)
     if not jobs:
         print("no matching jobs", file=sys.stderr)
         return 1
@@ -109,13 +224,37 @@ def cmd_show(args) -> int:
 def cmd_jobs(args) -> int:
     client = clients(args)[0]
     states = args.state.split("+") if args.state else None
-    out(client.jobs(user=args.for_user or client.user, states=states))
+    jobs = client.jobs(user=args.for_user or client.user, states=states)
+    if args.one_per_line:
+        # uuid-per-line output feeds `cs show/wait/kill` pipes (reference:
+        # subcommands/jobs.py --one-per-line + the piping scenarios)
+        for j in jobs:
+            print(j["uuid"])
+    else:
+        out(jobs)
     return 0
 
 
 def cmd_wait(args) -> int:
-    client = clients(args)[0]
-    jobs = client.wait(args.uuid, timeout_s=args.timeout)
+    uuids = resolve_refs(args, args.uuid)
+    if uuids is None:
+        return 1
+    owned, missing = federated_owners(args, uuids)
+    if missing:
+        print(f"error: no cluster knows {', '.join(missing)}",
+              file=sys.stderr)
+        return 1
+    jobs: List[Dict] = []
+    deadline = time.monotonic() + args.timeout
+    for client, mine in owned:
+        # one SHARED deadline across clusters — N owners must not
+        # multiply the user's --timeout by N
+        try:
+            jobs.extend(client.wait(
+                mine, timeout_s=max(0.0, deadline - time.monotonic())))
+        except TimeoutError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
     out(jobs)
     failed = [j for j in jobs
               if not any(i["status"] == "success"
@@ -124,8 +263,17 @@ def cmd_wait(args) -> int:
 
 
 def cmd_kill(args) -> int:
-    client = clients(args)[0]
-    out(client.kill(args.uuid))
+    uuids = resolve_refs(args, args.uuid)
+    if uuids is None:
+        return 1
+    owned, missing = federated_owners(args, uuids)
+    if missing:
+        print(f"error: no cluster knows {', '.join(missing)}",
+              file=sys.stderr)
+        return 1
+    # always a list, one entry per owning cluster — a stable shape no
+    # matter how the uuids were distributed
+    out([client.kill(mine) for client, mine in owned])
     return 0
 
 
@@ -142,8 +290,18 @@ def cmd_usage(args) -> int:
 
 
 def cmd_unscheduled(args) -> int:
-    client = clients(args)[0]
-    out(client.unscheduled_jobs(args.uuid))
+    uuids = resolve_refs(args, args.uuid)
+    if uuids is None:
+        return 1
+    owned, missing = federated_owners(args, uuids)
+    if missing:
+        print(f"error: no cluster knows {', '.join(missing)}",
+              file=sys.stderr)
+        return 1
+    merged: List[Dict] = []
+    for client, mine in owned:
+        merged.extend(client.unscheduled_jobs(mine))
+    out(merged)
     return 0
 
 
@@ -351,14 +509,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "tracking executor")
     sp.add_argument("--application",
                     help="submitting application, name[:version]")
-    sp.add_argument("command", nargs="+")
+    sp.add_argument("--raw", action="store_true",
+                    help="read full JSON job spec(s) from stdin")
+    sp.add_argument("command", nargs="*",
+                    help="command to run; read from stdin when omitted "
+                         "(one job per line)")
     sp.set_defaults(fn=cmd_submit)
 
-    for name, fn, multi in (("show", cmd_show, True), ("wait", cmd_wait, True),
-                            ("kill", cmd_kill, True),
-                            ("unscheduled", cmd_unscheduled, True)):
+    for name, fn in (("show", cmd_show), ("wait", cmd_wait),
+                     ("kill", cmd_kill), ("unscheduled", cmd_unscheduled)):
         sp = sub.add_parser(name)
-        sp.add_argument("uuid", nargs="+" if multi else 1)
+        # zero positional refs -> read uuids/entity-refs from stdin, so
+        # `cs jobs --json | cs kill` pipes compose (reference:
+        # test_piping_from_jobs_to_kill_show_wait)
+        sp.add_argument("uuid", nargs="*",
+                        help="job uuid or https://cluster/jobs/<uuid> "
+                             "entity ref; stdin when omitted")
         if name == "wait":
             sp.add_argument("--timeout", type=float, default=300.0)
         sp.set_defaults(fn=fn)
@@ -371,6 +537,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("jobs", help="list your jobs")
     sp.add_argument("--for-user", dest="for_user")
     sp.add_argument("--state", help="waiting+running+completed")
+    sp.add_argument("-1", "--one-per-line", dest="one_per_line",
+                    action="store_true",
+                    help="print bare uuids, one per line (for piping)")
     sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser("usage")

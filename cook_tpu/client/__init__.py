@@ -105,8 +105,12 @@ class JobClient:
         spec["command"] = command
         return self.submit([spec])[0]
 
-    def query(self, uuids: Sequence[str]) -> List[Dict]:
-        return self._request("GET", "/jobs", params={"uuid": list(uuids)})
+    def query(self, uuids: Sequence[str],
+              partial: bool = False) -> List[Dict]:
+        params: Dict[str, Any] = {"uuid": list(uuids)}
+        if partial:
+            params["partial"] = "true"
+        return self._request("GET", "/jobs", params=params)
 
     def job(self, uuid: str) -> Dict:
         return self._request("GET", f"/jobs/{uuid}")

@@ -185,6 +185,9 @@ class FileLeaderElector(LeaderElector):
 
     def _try_acquire(self) -> bool:
         import fcntl
+        # first boot on a fresh host: the shared election dir may not
+        # exist yet; a missing dir must not kill the campaign loop
+        os.makedirs(os.path.dirname(self.lock_path) or ".", exist_ok=True)
         fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
             # flock, not lockf: flock is per open-file-description, so two

@@ -1,0 +1,261 @@
+"""CLI conformance tier against a live daemon (reference: the scenario
+families of integration/tests/cook/test_cli.py — stdin submit, raw JSON
+submit, multi-command submit, uuid piping, entity refs, duplicate-uuid
+refusal, wait over multiple jobs, kill errors)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from test_integration_scenarios import spawn, wait_leader, wait_serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli-surface")
+    conf = {
+        "host": "127.0.0.1", "port": 0,
+        "data_dir": str(tmp / "data"),
+        "election_dir": str(tmp),
+        "admins": ["admin"],
+        "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                      "kwargs": {"name": "alpha", "n_hosts": 3,
+                                 "cpus": 4.0, "mem": 4096.0,
+                                 "default_task_duration_ms": 300,
+                                 "auto_advance": True}}],
+        "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                      "match_interval_seconds": 0.1,
+                      "rank_interval_seconds": 0.1},
+    }
+    p = spawn(conf, tmp, "cli")
+    url = wait_serving(p)
+    assert wait_leader(url)
+    yield url, str(tmp)
+    if p.poll() is None:
+        p.kill()
+    p.wait(timeout=10)
+
+
+def cli(daemon, *args, stdin=None, user="alice", timeout=60):
+    url, home = daemon
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               COOK_URL=url, COOK_USER=user, HOME=home)
+    return subprocess.run(
+        [sys.executable, "-m", "cook_tpu.cli.main", *args],
+        input=stdin, capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=timeout)
+
+
+class TestStdinSubmit:
+    def test_single_command_from_stdin(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                stdin="echo from-stdin\n")
+        assert r.returncode == 0, r.stderr
+        [uuid] = r.stdout.split()
+        r = cli(daemon, "wait", uuid, "--timeout", "30")
+        assert r.returncode == 0, r.stderr
+
+    def test_multiple_commands_submit_multiple_jobs(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                stdin="echo one\necho two\necho three\n")
+        assert r.returncode == 0, r.stderr
+        uuids = r.stdout.split()
+        assert len(uuids) == 3 and len(set(uuids)) == 3
+        # wait accepts multiple uuids (reference: test_wait_for_multiple)
+        r = cli(daemon, "wait", *uuids, "--timeout", "30")
+        assert r.returncode == 0, r.stderr
+
+    def test_empty_stdin_is_an_error(self, daemon):
+        r = cli(daemon, "submit", stdin="")
+        assert r.returncode == 1
+        assert "no command" in r.stderr
+
+
+class TestRawSubmit:
+    def test_raw_object_and_list(self, daemon):
+        spec = {"command": "true", "cpus": 1, "mem": 64, "name": "rawjob"}
+        r = cli(daemon, "submit", "--raw", stdin=json.dumps(spec))
+        assert r.returncode == 0, r.stderr
+        [u1] = r.stdout.split()
+        r = cli(daemon, "submit", "--raw",
+                stdin=json.dumps([spec, dict(spec, name="rawjob2")]))
+        assert r.returncode == 0, r.stderr
+        assert len(r.stdout.split()) == 2
+        r = cli(daemon, "show", u1)
+        assert r.returncode == 0
+        shown = json.loads(r.stdout)
+        assert shown[0]["name"] == "rawjob"
+
+    def test_raw_invalid_json(self, daemon):
+        r = cli(daemon, "submit", "--raw", stdin="{not json")
+        assert r.returncode == 1
+        assert "malformed" in r.stderr
+
+    def test_raw_refuses_command_argument(self, daemon):
+        r = cli(daemon, "submit", "--raw", "echo", "hi", stdin="{}")
+        assert r.returncode == 1
+        assert "cannot be combined" in r.stderr
+
+
+class TestPiping:
+    def test_jobs_one_per_line_pipes_into_show_and_kill(self, daemon):
+        user = "piper"
+        subs = [cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                    "--env", "COOK_FAKE_DURATION_MS=999999",
+                    "sleep", "999", user=user) for _ in range(2)]
+        uuids = {r.stdout.strip() for r in subs}
+        assert all(r.returncode == 0 for r in subs)
+        r = cli(daemon, "jobs", "-1", "--state", "waiting+running",
+                user=user)
+        assert r.returncode == 0, r.stderr
+        listed = set(r.stdout.split())
+        assert uuids <= listed
+        # pipe the uuid list into show (no positional args -> stdin)
+        r = cli(daemon, "show", stdin=r.stdout, user=user)
+        assert r.returncode == 0, r.stderr
+        shown = {j["uuid"] for j in json.loads(r.stdout)}
+        assert uuids <= shown
+        # and into kill
+        r = cli(daemon, "kill", stdin="\n".join(uuids), user=user)
+        assert r.returncode == 0, r.stderr
+
+    def test_show_empty_stdin_errors(self, daemon):
+        r = cli(daemon, "show", stdin="")
+        assert r.returncode == 1
+        assert "at least one uuid" in r.stderr
+
+
+class TestEntityRefs:
+    def _submit(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64", "true")
+        assert r.returncode == 0, r.stderr
+        return r.stdout.strip()
+
+    def test_jobs_path_ref(self, daemon):
+        url, _ = daemon
+        u = self._submit(daemon)
+        r = cli(daemon, "show", f"{url}/jobs/{u}")
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)[0]["uuid"] == u
+
+    def test_query_string_ref_and_case(self, daemon):
+        url, _ = daemon
+        u = self._submit(daemon)
+        ref = f"{url}/rawscheduler?job={u}".replace("http://", "HTTP://")
+        r = cli(daemon, "show", ref)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)[0]["uuid"] == u
+
+    def test_ref_cluster_is_queried_without_cook_url(self, daemon):
+        url, home = daemon
+        u = self._submit(daemon)
+        # COOK_URL deliberately points at a dead port; the ref's own
+        # cluster URL must carry the query
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   COOK_URL="http://127.0.0.1:1", COOK_USER="alice",
+                   HOME=home)
+        r = subprocess.run(
+            [sys.executable, "-m", "cook_tpu.cli.main", "show",
+             f"{url}/jobs/{u}"],
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)[0]["uuid"] == u
+
+    def test_duplicate_uuids_refused(self, daemon):
+        u = self._submit(daemon)
+        for cmd in ("show", "wait", "kill"):
+            r = cli(daemon, cmd, u, u)
+            assert r.returncode == 1, (cmd, r.stdout)
+            assert "duplicate" in r.stderr.lower()
+
+    def test_malformed_ref_refused(self, daemon):
+        r = cli(daemon, "show", "http://")
+        assert r.returncode == 1
+        assert "malformed" in r.stderr or "error" in r.stderr
+
+
+class TestKillErrors:
+    def test_kill_bogus_uuid(self, daemon):
+        r = cli(daemon, "kill", "00000000-0000-0000-0000-00000000dead")
+        assert r.returncode == 1
+        assert "error" in r.stderr
+
+
+class TestDoubleDash:
+    def test_double_dash_ends_options(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64", "--",
+                "echo", "--not-a-flag")
+        assert r.returncode == 0, r.stderr
+        uuid = r.stdout.strip()
+        r = cli(daemon, "show", uuid)
+        assert json.loads(r.stdout)[0]["command"] == "echo --not-a-flag"
+
+
+class TestFederatedFanout:
+    """kill/wait route each uuid to the cluster that OWNS it (reference:
+    querying.py per-cluster routing; distinct from the dedupe-only show
+    path)."""
+
+    def test_kill_and_wait_across_two_clusters(self, daemon,
+                                               tmp_path_factory):
+        url_a, _home = daemon
+        tmp = tmp_path_factory.mktemp("cli-b")
+        conf = {
+            "host": "127.0.0.1", "port": 0,
+            "data_dir": str(tmp / "data"),
+            "election_dir": str(tmp),
+            "admins": ["admin"],
+            "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                          "kwargs": {"name": "beta", "n_hosts": 2,
+                                     "cpus": 4.0, "mem": 4096.0,
+                                     "default_task_duration_ms": 300,
+                                     "auto_advance": True}}],
+            "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                          "match_interval_seconds": 0.1,
+                          "rank_interval_seconds": 0.1},
+        }
+        pb = spawn(conf, tmp, "b")
+        try:
+            url_b = wait_serving(pb)
+            assert wait_leader(url_b)
+
+            def fed(*args, stdin=None):
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           PYTHONPATH=REPO, COOK_URL=f"{url_a},{url_b}",
+                           COOK_USER="alice", HOME=str(tmp))
+                return subprocess.run(
+                    [sys.executable, "-m", "cook_tpu.cli.main", *args],
+                    input=stdin, capture_output=True, text=True, cwd=REPO,
+                    env=env, timeout=60)
+
+            # one job on each cluster (submit goes to the FIRST url, so
+            # target B explicitly for the second)
+            ua = fed("--url", url_a, "submit", "--cpus", "1", "--mem",
+                     "64", "--env", "COOK_FAKE_DURATION_MS=999999",
+                     "sleep", "999").stdout.strip()
+            ub = fed("--url", url_b, "submit", "--cpus", "1", "--mem",
+                     "64", "--env", "COOK_FAKE_DURATION_MS=999999",
+                     "sleep", "999").stdout.strip()
+            assert ua and ub and ua != ub
+            # federated kill must reach BOTH owners
+            r = fed("kill", ua, ub)
+            assert r.returncode == 0, r.stderr
+            # wait resolves each from its own cluster (kill -> completed)
+            r = fed("wait", ua, ub, "--timeout", "30")
+            assert r.returncode in (0, 1), r.stderr  # killed != success
+            shown = {j["uuid"] for j in json.loads(r.stdout)}
+            assert shown == {ua, ub}
+            # a uuid no cluster knows is an error
+            r = fed("kill", "00000000-0000-0000-0000-0000000000ff")
+            assert r.returncode == 1
+            assert "no cluster knows" in r.stderr
+        finally:
+            if pb.poll() is None:
+                pb.kill()
+            pb.wait(timeout=10)
