@@ -117,6 +117,18 @@ class ApiError(Exception):
         self.headers = headers or {}
 
 
+class RequestUser(str):
+    """A resolved request identity: a plain str plus the fact that it was
+    reached via X-Cook-Impersonate (admin gating refuses those)."""
+
+    impersonated: bool
+
+    def __new__(cls, name: str, impersonated: bool = False):
+        self = super().__new__(cls, name)
+        self.impersonated = impersonated
+        return self
+
+
 class _Redirect(Exception):
     def __init__(self, location: str):
         super().__init__(location)
@@ -415,15 +427,26 @@ class CookApi:
 
     # ------------------------------------------------------------------ auth
     def require_admin(self, user: str, message: Optional[str] = None) -> None:
+        # an impersonator acting AS an admin may not reach admin endpoints
+        # (reference: impersonation.clj object-type->verb table admits no
+        # admin verbs; integration test_cannot_impersonate_admin_endpoints)
+        if getattr(user, "impersonated", False):
+            raise ApiError(403, "impersonated requests may not use "
+                                "admin endpoints")
         if self.admins and user not in self.admins:
             raise ApiError(403, message or f"{user} is not authorized")
 
     def resolve_user(self, auth_user: str, impersonate: Optional[str]) -> str:
-        if impersonate:
-            if auth_user not in self.impersonators \
-                    and auth_user not in self.admins:
+        """The effective request identity (reference: impersonation.clj).
+
+        Only configured impersonators may impersonate — being an admin
+        grants nothing here (test_admin_cannot_impersonate), and
+        self-impersonation is treated as a plain non-impersonated request
+        (test_self_impersonate)."""
+        if impersonate and impersonate != auth_user:
+            if auth_user not in self.impersonators:
                 raise ApiError(403, f"{auth_user} may not impersonate")
-            return impersonate
+            return RequestUser(impersonate, impersonated=True)
         return auth_user
 
     # ---------------------------------------------------------------- routes

@@ -31,6 +31,7 @@ def daemon(tmp_path_factory):
         "data_dir": str(tmp / "data"),
         "election_dir": str(tmp),
         "admins": ["admin"],
+        "impersonators": ["poser"],
         "cors_origins": ["http://cors\\.example\\.com"],
         "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
                       "kwargs": {"name": "alpha", "n_hosts": 3,
@@ -104,9 +105,9 @@ class TestSubmitFields:
                                 "env": {"COOK_FAKE_DURATION_MS": "999999"}}
                                for _ in range(3)],
                       user="hog")
-        for h in hogs:
-            wait_state(daemon, h, "running")
         try:
+            for h in hogs:
+                wait_state(daemon, h, "running")
             lo, hi = submit(daemon, [
                 {"command": "true", "cpus": 1, "mem": 64, "priority": 10},
                 {"command": "true", "cpus": 1, "mem": 64, "priority": 90}],
@@ -123,8 +124,10 @@ class TestSubmitFields:
         finally:
             # a failure must not leave the module-scoped cluster saturated
             for h in hogs:
-                tid = get(daemon, f"/jobs/{h}")["instances"][-1]["task_id"]
-                req("DELETE", f"{daemon}/instances?uuid={tid}")
+                insts = get(daemon, f"/jobs/{h}")["instances"]
+                if insts:
+                    req("DELETE",
+                        f"{daemon}/instances?uuid={insts[-1]['task_id']}")
 
 
 class TestMaxRuntime:
@@ -320,3 +323,82 @@ class TestQueueAccess:
             urllib.request.urlopen(r, timeout=5)
         assert ei.value.code == 403
         assert isinstance(get(daemon, "/queue"), dict)
+
+
+def req_as(method, url, user, payload=None, impersonate=None, timeout=5):
+    headers = {"X-Cook-User": user, "Content-Type": "application/json"}
+    if impersonate:
+        headers["X-Cook-Impersonate"] = impersonate
+    data = json.dumps(payload).encode() if payload is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers)
+    return urllib.request.urlopen(r, timeout=timeout)
+
+
+class TestImpersonation:
+    """reference: integration test_impersonation.py — only configured
+    impersonators may impersonate (admins get nothing implicitly),
+    authorization is evaluated as the impersonated user, impersonated
+    identities may not reach admin endpoints, and self-impersonation is
+    a plain request."""
+
+    def _owned_job(self, daemon, owner="vic"):
+        [u] = submit(daemon, [{"command": "sleep 999", "cpus": 1,
+                               "mem": 64,
+                               "env": {"COOK_FAKE_DURATION_MS": "999999"}}],
+                     user=owner)
+        wait_state(daemon, u, "running")
+        return u
+
+    def test_impersonated_job_delete(self, daemon):
+        u = self._owned_job(daemon)
+        # the impersonator as themselves: not the owner -> 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req_as("DELETE", f"{daemon}/jobs?uuid={u}", "poser")
+        assert ei.value.code == 403
+        # impersonating the WRONG user: still 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req_as("DELETE", f"{daemon}/jobs?uuid={u}", "poser",
+                   impersonate="mallory")
+        assert ei.value.code == 403
+        # a non-impersonator impersonating the owner: 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req_as("DELETE", f"{daemon}/jobs?uuid={u}", "mallory",
+                   impersonate="vic")
+        assert ei.value.code == 403
+        # the impersonator impersonating the owner: allowed
+        with req_as("DELETE", f"{daemon}/jobs?uuid={u}", "poser",
+                    impersonate="vic") as r:
+            assert r.status == 200
+
+    def test_admin_cannot_impersonate(self, daemon):
+        u = self._owned_job(daemon, owner="vic2")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                req_as("DELETE", f"{daemon}/jobs?uuid={u}", "admin",
+                       impersonate="vic2")
+            assert ei.value.code == 403
+        finally:  # admin kills it directly (no impersonation)
+            req_as("DELETE", f"{daemon}/jobs?uuid={u}", "admin")
+
+    def test_cannot_impersonate_admin_endpoints(self, daemon):
+        # impersonating an ADMIN must not unlock admin endpoints
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req_as("GET", f"{daemon}/queue", "poser", impersonate="admin")
+        assert ei.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req_as("POST", f"{daemon}/quota", "poser",
+                   payload={"user": "x", "pools": {}}, impersonate="admin")
+        assert ei.value.code == 403
+
+    def test_self_impersonation_is_plain_request(self, daemon):
+        # admin self-impersonating keeps admin rights
+        with req_as("GET", f"{daemon}/queue", "admin",
+                    impersonate="admin") as r:
+            assert r.status == 200
+        # a normal user self-impersonating can submit
+        with req_as("POST", f"{daemon}/jobs", "selfy",
+                    payload={"jobs": [{"command": "true", "cpus": 1,
+                                       "mem": 64}]},
+                    impersonate="selfy") as resp:
+            assert resp.status == 200
