@@ -792,23 +792,43 @@ class CookApi:
         return [instance_to_json(inst)
                 for _job, inst in self.store.running_instances()]
 
-    def usage(self, params: Dict) -> Dict:
-        """GET /usage?user=&group_breakdown= (reference: rest/api.clj:2855-
-        2940 UsageResponse + user-usage): running usage totals per pool,
-        optionally broken down by job group (``grouped`` entries carry the
-        group's uuid/name/running_jobs; ``ungrouped`` the rest)."""
+    def usage(self, params: Dict, auth_user: str = "") -> Dict:
+        """GET /usage?user=&pool=&group_breakdown= (reference:
+        rest/api.clj:2855-2968 UsageResponse + get-user-usage): running
+        usage totals per pool, optionally broken down by job group
+        (``grouped`` entries carry the group's uuid/name/running_jobs;
+        ``ungrouped`` the rest).  Without ``user``, returns the
+        cluster-wide per-user breakdown ``{"users": {user: usage}}``
+        (admin-only here); ``pool`` restricts either form to one pool."""
         user = first(params.get("user"))
+        pool_filter = first(params.get("pool")) or None  # "" = unfiltered
+        # ONE usage scan per pool, shared by every user in the response
+        # (the all-users form would otherwise rescan per user x pool)
+        pool_usages = {p.name: self.store.user_usage(p.name)
+                       for p in self.store.pools()
+                       if pool_filter is None or p.name == pool_filter}
         if user is None:
-            raise ApiError(400, "user parameter required")
+            self.require_admin(
+                auth_user, "the all-users usage report is admin-only")
+            users: set = set()
+            for usages in pool_usages.values():
+                users.update(usages)
+            return {"users": {u: self._user_usage(u, pool_filter, params,
+                                                  pool_usages)
+                              for u in sorted(users)}}
+        return self._user_usage(user, pool_filter, params, pool_usages)
+
+    def _user_usage(self, user: str, pool_filter: Optional[str],
+                    params: Dict, pool_usages: Dict[str, Dict]) -> Dict:
         breakdown = first(params.get("group_breakdown"), "false") == "true"
         out: Dict[str, Any] = {
             "total_usage": {"cpus": 0.0, "mem": 0.0, "gpus": 0.0,
                             "jobs": 0}, "pools": {}}
-        for pool in self.store.pools():
-            usage = self.store.user_usage(pool.name).get(user)
+        for pool_name, usages in pool_usages.items():
+            usage = usages.get(user)
             if not usage:
                 continue
-            out["pools"][pool.name] = {
+            out["pools"][pool_name] = {
                 "cpus": usage["cpus"], "mem": usage["mem"],
                 "gpus": usage["gpus"], "jobs": int(usage["count"])}
             out["total_usage"]["cpus"] += usage["cpus"]
@@ -817,7 +837,8 @@ class CookApi:
             out["total_usage"]["jobs"] += int(usage["count"])
         if breakdown:
             running = self.store.jobs_where(
-                lambda j: j.user == user and j.state is JobState.RUNNING)
+                lambda j: j.user == user and j.state is JobState.RUNNING
+                and (pool_filter is None or j.pool == pool_filter))
 
             def usage_of(jobs: List[Job]) -> Dict:
                 return {"cpus": sum(j.resources.cpus for j in jobs),
@@ -1362,7 +1383,7 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/running":
                 return api.running()
             if path == "/usage":
-                return api.usage(params)
+                return api.usage(params, self._user())
             if path == "/share":
                 return api.share_get(params)
             if path == "/quota":

@@ -1302,3 +1302,35 @@ class TestInstanceStats:
             client_for(server).stats(status="running",
                                      start=str(now - 1000), end=str(now))
         assert e.value.status == 403
+
+
+class TestUsageAllUsersAndPool:
+    """GET /usage without user -> cluster-wide {"users": {...}} (admin
+    only), and the pool filter on both forms (reference:
+    rest/api.clj:2946-2968 get-user-usage; integration
+    test_multi_user_usage / test_usage_pool_filter)."""
+
+    def test_all_users_breakdown_admin_only(self, system):
+        _store, _c, sched, server = system
+        client_for(server, "alice").submit_one("a", cpus=2, mem=128)
+        client_for(server, "bob").submit_one("b", cpus=1, mem=64)
+        sched.step_rank(); sched.step_match()
+        with pytest.raises(JobClientError) as e:
+            client_for(server)._request("GET", "/usage")
+        assert e.value.status == 403
+        out = client_for(server, "admin")._request("GET", "/usage")
+        assert set(out["users"]) == {"alice", "bob"}
+        assert out["users"]["alice"]["total_usage"]["cpus"] == 2.0
+        assert out["users"]["bob"]["total_usage"]["jobs"] == 1
+
+    def test_pool_filter(self, system):
+        _store, _c, sched, server = system
+        client = client_for(server)
+        client.submit_one("a", cpus=2, mem=128)
+        sched.step_rank(); sched.step_match()
+        out = client._request("GET", "/usage",
+                              params={"user": "alice", "pool": "default"})
+        assert out["total_usage"]["cpus"] == 2.0
+        out = client._request("GET", "/usage",
+                              params={"user": "alice", "pool": "nope"})
+        assert out["total_usage"]["jobs"] == 0 and out["pools"] == {}
