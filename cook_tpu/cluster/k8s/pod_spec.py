@@ -96,7 +96,15 @@ def build_pod_spec(job: Job, pool: str,
     volumes = [{"name": "cook-workdir", "empty_dir": {}}]
     mounts = [{"name": "cook-workdir", "mount_path": COOK_WORKDIR}]
     for vol in container.get("volumes", []):
-        # user volumes: {"host-path": ..., "container-path": ..., "mode": ...}
+        # user volumes: {"host-path": ..., "container-path": ..., "mode":
+        # ...} or the compact "host:container" string form
+        if isinstance(vol, str):
+            bits = vol.split(":")  # host[:container[:mode]]
+            vol = {"host-path": bits[0],
+                   "container-path": bits[1] if len(bits) > 1 and bits[1]
+                   else bits[0],
+                   "mode": ("RO" if len(bits) > 2
+                            and bits[2].lower() == "ro" else "RW")}
         name = f"uservol-{len(volumes)}"
         volumes.append({"name": name,
                         "host_path": vol.get("host-path", "")})
@@ -193,6 +201,18 @@ def build_pod_spec(job: Job, pool: str,
     if job.ports:
         env.append({"name": "COOK_PORT_COUNT", "value": str(job.ports)})
 
+    # docker parameters that translate to pod fields (reference: the k8s
+    # path honors workdir/env parameters, kubernetes/api.clj:1370-1813;
+    # the rest are docker-runtime flags with no pod equivalent)
+    workdir = COOK_WORKDIR
+    for p in container.get("parameters", []) or []:
+        key, value = p.get("key"), p.get("value", "")
+        if key == "workdir" and value:
+            workdir = value
+        elif key == "env" and "=" in value:
+            name, _, val = value.partition("=")
+            env.append({"name": name, "value": val})
+
     containers = [{
         "name": "cook-job",
         "image": image,
@@ -206,7 +226,7 @@ def build_pod_spec(job: Job, pool: str,
             "limits": {"memory_mb": job.resources.mem,
                        "gpu": job.resources.gpus},
         },
-        "working_dir": COOK_WORKDIR,
+        "working_dir": workdir,
     }]
     if sidecar:
         # progress tracker + sandbox file server (the reference's sidecar,

@@ -116,6 +116,11 @@ def _load() -> Optional[ctypes.CDLL]:
                                 ctypes.c_double, ctypes.c_char_p,
                                 ctypes.c_int, ctypes.c_char_p,
                                 ctypes.c_char_p]
+    lib.ctd_launch3.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_double,
+                                ctypes.c_double, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_char_p]
     lib.ctd_kill.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.ctd_reconcile.argtypes = [ctypes.c_void_p]
     lib.ctd_ping.argtypes = [ctypes.c_void_p]
@@ -161,16 +166,22 @@ class AgentConnection:
     def launch(self, task_id: str, command: str, cpus: float,
                mem: float, env: Optional[Dict[str, str]] = None,
                port_count: int = 0, image: str = "",
-               volumes: Optional[List[str]] = None) -> bool:
+               volumes: Optional[List[str]] = None,
+               params: Optional[List[Dict[str, str]]] = None) -> bool:
         env_s = "\x1e".join(f"{k}={v}" for k, v in (env or {}).items())
         vol_s = "\x1e".join(volumes or [])
+        # docker parameters [{"key": k, "value": v}] -> "--k v" runtime
+        # flags agent-side (reference: mesos/task.clj docker parameters)
+        par_s = "\x1e".join(
+            f"{p['key']}={p.get('value', '')}" for p in (params or [])
+            if isinstance(p, dict) and p.get("key"))
         with self._lock:
             if not self._handle:
                 return False
-            return self._lib.ctd_launch2(
+            return self._lib.ctd_launch3(
                 self._handle, task_id.encode(), command.encode(), cpus, mem,
                 env_s.encode(), int(port_count), image.encode(),
-                vol_s.encode()) == 0
+                vol_s.encode(), par_s.encode()) == 0
 
     def kill(self, task_id: str, grace_ms: int = 3000) -> bool:
         with self._lock:
@@ -509,7 +520,8 @@ class RemoteComputeCluster(ComputeCluster):
                     image=container.get("image", ""),
                     volumes=[v if isinstance(v, str)
                              else f"{v['host-path']}:{v['container-path']}"
-                             for v in container.get("volumes", [])])
+                             for v in container.get("volumes", [])],
+                    params=container.get("parameters") or [])
             if not ok:
                 with self._lock:
                     self._tasks.pop(spec.task_id, None)

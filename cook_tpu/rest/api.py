@@ -214,9 +214,49 @@ def instance_to_json(inst) -> Dict:
     }
 
 
+# docker parameters forwarded to the container runtime without an operator
+# allowlist configured: benign task-shape flags only.  Anything else
+# (privileged, volume, cap-add, device, ...) reaches the runtime argv and
+# is host-privilege-bearing, so it is DENIED unless explicitly allowlisted
+# via TaskConstraints.docker_parameters_allowed.
+DEFAULT_DOCKER_PARAMETERS_ALLOWED = (
+    "env", "workdir", "label", "user", "entrypoint", "name")
+
+
+def validate_docker_parameters(job: Job, tc) -> None:
+    """Docker parameters are validated for EVERY submission (unlike the
+    other task constraints, which an operator opts into): they compile to
+    container-runtime flags on the agent, so an unvalidated key like
+    ``privileged`` would be a privilege escalation.  The operator's
+    allowlist (tc.docker_parameters_allowed) replaces the conservative
+    default when configured (reference: :docker-parameters-allowed,
+    rest/api.clj + integration test_disallowed_docker_parameters)."""
+    if not isinstance(job.container, dict):
+        return
+    params = (job.container.get("parameters")
+              or (job.container.get("docker") or {}).get("parameters")
+              or [])
+    allowed = set(tc.docker_parameters_allowed
+                  if tc is not None and tc.docker_parameters_allowed
+                  is not None else DEFAULT_DOCKER_PARAMETERS_ALLOWED)
+    bad = [p.get("key") for p in params
+           if isinstance(p, dict) and p.get("key") not in allowed]
+    if bad:
+        raise ApiError(400, "The following parameters are not "
+                            f"supported: {bad}")
+    unvalued = [p.get("key") for p in params
+                if isinstance(p, dict) and p.get("key")
+                and not p.get("value")]
+    if unvalued:
+        # a bare "--key" would make the runtime consume the IMAGE as the
+        # flag's value — reject instead of launching the wrong container
+        raise ApiError(400, f"docker parameters {unvalued} require a value")
+
+
 def validate_task_constraints(job: Job, tc) -> None:
     """Submission-time task-constraint checks, messages mirroring the
     reference (rest/api.clj:1070-1103 validate-and-munge-job)."""
+    validate_docker_parameters(job, tc)
     if tc is None:
         return
     if tc.cpus is not None and job.resources.cpus > tc.cpus:
@@ -236,15 +276,28 @@ def validate_task_constraints(job: Job, tc) -> None:
         raise ApiError(400, f"Job command length of {len(job.command)} is "
                             f"greater than the maximum command length "
                             f"({tc.command_length_limit})")
-    if tc.docker_parameters_allowed is not None \
-            and isinstance(job.container, dict):
-        params = (job.container.get("docker") or {}).get("parameters") or []
-        allowed = set(tc.docker_parameters_allowed)
-        bad = [p.get("key") for p in params
-               if isinstance(p, dict) and p.get("key") not in allowed]
-        if bad:
-            raise ApiError(400, "The following parameters are not "
-                                f"supported: {bad}")
+
+
+def normalize_container(raw) -> Optional[Dict]:
+    """Container spec -> the canonical flat form backends consume.
+
+    Accepts both the flat form ({"image", "volumes", "parameters"}) and
+    the reference's nested Mesos form ({"type": "docker", "docker":
+    {"image", "network", "force-pull-image", "parameters"}, "volumes"},
+    rest/api.clj Container/DockerInfo schemas).  The nested ``docker``
+    subdict is preserved so validators and clients see what was
+    submitted."""
+    if not isinstance(raw, dict):
+        return raw
+    docker = raw.get("docker")
+    if not isinstance(docker, dict):
+        return raw
+    norm = dict(raw)
+    norm.setdefault("image", docker.get("image", ""))
+    norm.setdefault("parameters", docker.get("parameters", []))
+    if docker.get("network") is not None:
+        norm.setdefault("network", docker.get("network"))
+    return norm
 
 
 def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
@@ -276,7 +329,7 @@ def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
             pool=spec.get("pool", default_pool),
             labels=dict(spec.get("labels", {})),
             env=dict(spec.get("env", {})),
-            container=spec.get("container"),
+            container=normalize_container(spec.get("container")),
             ports=int(spec.get("ports", 0)),
             uris=[u if isinstance(u, dict) else {"value": u}
                   for u in spec.get("uris", [])],
@@ -802,24 +855,39 @@ class CookApi:
         (admin-only here); ``pool`` restricts either form to one pool."""
         user = first(params.get("user"))
         pool_filter = first(params.get("pool")) or None  # "" = unfiltered
+        if user is None:
+            # admin check FIRST: no store scans for unauthorized callers
+            self.require_admin(
+                auth_user, "the all-users usage report is admin-only")
         # ONE usage scan per pool, shared by every user in the response
         # (the all-users form would otherwise rescan per user x pool)
         pool_usages = {p.name: self.store.user_usage(p.name)
                        for p in self.store.pools()
                        if pool_filter is None or p.name == pool_filter}
+        breakdown = first(params.get("group_breakdown"), "false") == "true"
         if user is None:
-            self.require_admin(
-                auth_user, "the all-users usage report is admin-only")
             users: set = set()
             for usages in pool_usages.values():
                 users.update(usages)
-            return {"users": {u: self._user_usage(u, pool_filter, params,
-                                                  pool_usages)
-                              for u in sorted(users)}}
+            running_by_user: Optional[Dict[str, List[Job]]] = None
+            if breakdown:
+                # ONE running-jobs scan bucketed by user (not one per user)
+                running_by_user = {}
+                for j in self.store.jobs_where(
+                        lambda j: j.state is JobState.RUNNING
+                        and (pool_filter is None or j.pool == pool_filter)):
+                    running_by_user.setdefault(j.user, []).append(j)
+            return {"users": {
+                u: self._user_usage(
+                    u, pool_filter, params, pool_usages,
+                    running=(running_by_user.get(u, [])
+                             if running_by_user is not None else None))
+                for u in sorted(users)}}
         return self._user_usage(user, pool_filter, params, pool_usages)
 
     def _user_usage(self, user: str, pool_filter: Optional[str],
-                    params: Dict, pool_usages: Dict[str, Dict]) -> Dict:
+                    params: Dict, pool_usages: Dict[str, Dict],
+                    running: Optional[List[Job]] = None) -> Dict:
         breakdown = first(params.get("group_breakdown"), "false") == "true"
         out: Dict[str, Any] = {
             "total_usage": {"cpus": 0.0, "mem": 0.0, "gpus": 0.0,
@@ -836,9 +904,11 @@ class CookApi:
             out["total_usage"]["gpus"] += usage["gpus"]
             out["total_usage"]["jobs"] += int(usage["count"])
         if breakdown:
-            running = self.store.jobs_where(
-                lambda j: j.user == user and j.state is JobState.RUNNING
-                and (pool_filter is None or j.pool == pool_filter))
+            if running is None:
+                running = self.store.jobs_where(
+                    lambda j: j.user == user
+                    and j.state is JobState.RUNNING
+                    and (pool_filter is None or j.pool == pool_filter))
 
             def usage_of(jobs: List[Job]) -> Dict:
                 return {"cpus": sum(j.resources.cpus for j in jobs),

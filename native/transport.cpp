@@ -19,7 +19,7 @@
 // Wire protocol (both directions): frame = u32_be payload_len, payload =
 // repeated (u32_be field_len + field_bytes); field[0] is the message type.
 //   driver -> agent:  LAUNCH(task_id, command, cpus, mem[, env, n_ports,
-//                            image, volumes])
+//                            image, volumes, params])
 //                       env     = K=V pairs joined by 0x1e
 //                       n_ports = count of host ports to assign from the
 //                                 agent's --ports-begin/--ports-end range
@@ -392,7 +392,7 @@ void agent_mem_monitor() {
 void agent_launch(const std::string& task_id, const std::string& command,
                   const std::string& env_kv, int n_ports,
                   const std::string& image, const std::string& volumes,
-                  double mem_mb = 0) {
+                  double mem_mb = 0, const std::string& params_kv = "") {
   std::string sandbox = g_agent->workdir + "/" + task_id;
   ::mkdir(sandbox.c_str(), 0755);
   AgentTask t;
@@ -405,6 +405,10 @@ void agent_launch(const std::string& task_id, const std::string& command,
   // env pairs (K=V joined by 0x1e) and container volumes (host:cont, 0x1e)
   std::vector<std::string> env_pairs = split_on(env_kv, '\x1e');
   std::vector<std::string> vols = split_on(volumes, '\x1e');
+  // docker parameters (key=value joined by 0x1e) compile to "--key value"
+  // runtime flags (reference: docker parameter passthrough,
+  // mesos/task.clj:168-186 + test_docker_env_param/test_docker_workdir)
+  std::vector<std::string> params = split_on(params_kv, '\x1e');
   pid_t pid;
   {
     // Hold mu across fork() -> map insert: the reaper also takes mu before
@@ -481,6 +485,13 @@ void agent_launch(const std::string& task_id, const std::string& command,
           for (int p : t.ports) {
             args.push_back("-p");
             args.push_back(std::to_string(p) + ":" + std::to_string(p));
+          }
+          for (const auto& kv : params) {
+            size_t eq = kv.find('=');
+            if (eq == std::string::npos || eq == 0) continue;
+            args.push_back("--" + kv.substr(0, eq));
+            std::string val = kv.substr(eq + 1);
+            if (!val.empty()) args.push_back(val);
           }
           args.push_back(image);
           args.push_back("/bin/sh");
@@ -586,7 +597,8 @@ void agent_connection(int fd) {
                    f.size() > 6 ? std::atoi(f[6].c_str()) : 0,
                    f.size() > 7 ? f[7] : "",
                    f.size() > 8 ? f[8] : "",
-                   f.size() > 4 ? std::atof(f[4].c_str()) : 0);
+                   f.size() > 4 ? std::atof(f[4].c_str()) : 0,
+                   f.size() > 9 ? f[9] : "");
     } else if (type == "KILL" && f.size() >= 3) {
       agent_kill(f[1], std::atoi(f[2].c_str()));
     } else if (type == "RECONCILE") {
@@ -820,6 +832,17 @@ int ctd_launch2(void* h, const char* task_id, const char* command, double cpus,
                       std::to_string(mem), env ? env : "",
                       std::to_string(n_ports), image ? image : "",
                       volumes ? volumes : ""});
+}
+
+// launch2 + docker parameters (key=value pairs joined by 0x1e, compiled by
+// the agent to "--key value" container-runtime flags).
+int ctd_launch3(void* h, const char* task_id, const char* command,
+                double cpus, double mem, const char* env, int n_ports,
+                const char* image, const char* volumes, const char* params) {
+  return ctd_send(h, {"LAUNCH", task_id, command, std::to_string(cpus),
+                      std::to_string(mem), env ? env : "",
+                      std::to_string(n_ports), image ? image : "",
+                      volumes ? volumes : "", params ? params : ""});
 }
 
 int ctd_kill(void* h, const char* task_id, int grace_ms) {

@@ -241,3 +241,21 @@ def test_launch_path_env_carries_instance_identity():
     bare = build_pod_spec(job, "default")
     bare_env = {e["name"] for e in bare["containers"][0]["env"]}
     assert "COOK_INSTANCE_UUID" not in bare_env
+
+
+def test_docker_parameters_map_to_pod_fields():
+    """workdir/env docker parameters translate to pod working_dir and env
+    entries (reference: kubernetes/api.clj:1370-1813 honors them; other
+    parameters are docker-runtime flags with no pod equivalent)."""
+    job = Job(uuid=U, user="alice", command="x",
+              resources=Resources(cpus=1.0, mem=64.0),
+              container={"image": "img:1",
+                         "parameters": [
+                             {"key": "workdir", "value": "/srv/app"},
+                             {"key": "env", "value": "MODE=fast"},
+                             {"key": "label", "value": "ignored=true"}]})
+    spec = build_pod_spec(job, "default", sidecar=False)
+    [c] = spec["containers"]
+    assert c["working_dir"] == "/srv/app"
+    assert {"name": "MODE", "value": "fast"} in c["env"]
+    assert not any(e["name"] == "label" for e in c["env"])

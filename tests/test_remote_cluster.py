@@ -785,3 +785,93 @@ class TestTaskEnvironment:
             assert e2["COOK_JOB_CPUS"] == "2.0"
         finally:
             cluster.shutdown()
+
+
+class TestDockerParameters:
+    """Docker parameters compile to --key value container-runtime flags
+    (reference: mesos/task.clj docker parameter passthrough; integration
+    test_docker_env_param / test_docker_workdir), and the reference's
+    NESTED container form ({"type": "docker", "docker": {...}}) launches
+    with the right image after REST normalization."""
+
+    def test_parameters_reach_runtime_argv(self, tmp_path):
+        from cook_tpu.config import Config
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Job, Resources, Store, new_uuid
+
+        record = tmp_path / "runtime-args.txt"
+        fake_rt = tmp_path / "fake-docker"
+        fake_rt.write_text(
+            "#!/bin/sh\n"
+            f'echo "$@" > {record}\n'
+            'while [ "$1" != "/bin/sh" ] && [ $# -gt 0 ]; do shift; done\n'
+            'exec "$@"\n')
+        fake_rt.chmod(0o755)
+        agent = LocalAgentProcess("nodeP", workdir=str(tmp_path / "w"),
+                                  container_runtime=str(fake_rt))
+        try:
+            store = Store()
+            cluster = RemoteComputeCluster(
+                "remote-1", [("127.0.0.1", agent.port)], store=store)
+            cfg = Config()
+            cfg.default_matcher.backend = "cpu"
+            sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+            job = Job(uuid=new_uuid(), user="alice", command="true",
+                      container={"image": "busybox:1.36",
+                                 "parameters": [
+                                     {"key": "workdir", "value": "/tmp"},
+                                     {"key": "env", "value": "FOO=bar"}]},
+                      pool="default",
+                      resources=Resources(cpus=1.0, mem=64.0))
+            store.create_jobs([job])
+            sched.step_rank(); sched.step_match()
+
+            def done():
+                sched.flush_status_updates()
+                return store.job(job.uuid).state is JobState.COMPLETED
+            assert wait_for(done, timeout=15)
+            args = record.read_text()
+            assert "--workdir /tmp" in args, args
+            assert "--env FOO=bar" in args, args
+            # parameters precede the image (docker flag ordering)
+            assert args.index("--workdir") < args.index("busybox:1.36")
+            cluster.shutdown()
+        finally:
+            agent.stop()
+
+    def test_nested_container_form_over_rest(self, tmp_path, agent):
+        from cook_tpu.config import Config
+        from cook_tpu.rest import ApiServer, CookApi
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.state import Store
+        from cook_tpu.client import JobClient
+
+        store = Store()
+        cluster = RemoteComputeCluster(
+            "remote-1", [("127.0.0.1", agent.port)], store=store)
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        srv = ApiServer(CookApi(store, scheduler=sched))
+        srv.start()
+        try:
+            client = JobClient(srv.url, user="alice")
+            uuid = client.submit([{
+                "command": "true", "cpus": 1, "mem": 64,
+                "container": {"type": "docker",
+                              "docker": {"image": "busybox:nested",
+                                         "parameters": [
+                                             {"key": "workdir",
+                                              "value": "/x"}]}}}])[0]
+            job = store.job(uuid)
+            # normalized flat fields alongside the preserved nested form
+            assert job.container["image"] == "busybox:nested"
+            assert job.container["parameters"] == [
+                {"key": "workdir", "value": "/x"}]
+            assert job.container["docker"]["image"] == "busybox:nested"
+            # and the REST echo keeps what was submitted
+            shown = client.job(uuid)
+            assert shown["container"]["docker"]["image"] == "busybox:nested"
+        finally:
+            srv.stop()
+            cluster.shutdown()

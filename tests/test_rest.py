@@ -1334,3 +1334,34 @@ class TestUsageAllUsersAndPool:
         out = client._request("GET", "/usage",
                               params={"user": "alice", "pool": "nope"})
         assert out["total_usage"]["jobs"] == 0 and out["pools"] == {}
+
+
+class TestDockerParameterDefaults:
+    """Docker parameters are validated on EVERY submission: without an
+    operator allowlist, only benign task-shape keys pass (they compile to
+    container-runtime flags on the agent — an unvalidated `privileged`
+    would be privilege escalation), and every parameter needs a value (a
+    bare --key would make the runtime consume the image as its value)."""
+
+    def test_default_denies_privilege_bearing_keys(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        for bad in ("privileged", "volume", "cap-add", "device"):
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container={
+                    "image": "img",
+                    "parameters": [{"key": bad, "value": "v"}]})
+            assert "not supported" in e.value.message, bad
+        # benign defaults pass
+        assert client.submit_one("x", container={
+            "image": "img",
+            "parameters": [{"key": "workdir", "value": "/tmp"},
+                           {"key": "env", "value": "A=b"}]})
+
+    def test_empty_value_rejected(self, system):
+        _store, _c, _s, server = system
+        client = client_for(server)
+        with pytest.raises(JobClientError) as e:
+            client.submit_one("x", container={
+                "image": "img", "parameters": [{"key": "label"}]})
+        assert "require a value" in e.value.message
