@@ -152,7 +152,31 @@ class Config:
     # None disables the filter
     offensive_job_limits: Optional[OffensiveJobLimits] = None
 
+    # pool-regex planes (reference: config.clj pools
+    # {:default-containers [{:pool-regex :container}], :default-env,
+    # :valid-gpu-models}); first match wins, None/missing = not configured
+    default_containers: List[tuple] = field(default_factory=list)
+    default_envs: List[tuple] = field(default_factory=list)
+    valid_gpu_models: List[tuple] = field(default_factory=list)
+
     _compiled: List[tuple] = field(default_factory=list, repr=False)
+
+    def _pool_match(self, table: List[tuple], pool_name: str):
+        for rx, val in table:
+            if re.search(rx, pool_name):
+                return val
+        return None
+
+    def default_container_for_pool(self, pool_name: str) -> Optional[Dict]:
+        """reference: get-default-container-for-pool, rest/api.clj:719"""
+        return self._pool_match(self.default_containers, pool_name)
+
+    def default_env_for_pool(self, pool_name: str) -> Dict[str, str]:
+        return self._pool_match(self.default_envs, pool_name) or {}
+
+    def gpu_models_for_pool(self, pool_name: str) -> Optional[List[str]]:
+        """reference: get-gpu-models-on-pool, rest/api.clj:724"""
+        return self._pool_match(self.valid_gpu_models, pool_name)
 
     def matcher_for_pool(self, pool_name: str) -> MatcherConfig:
         if not self._compiled and self.pool_matchers:

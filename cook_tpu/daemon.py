@@ -77,6 +77,22 @@ def build_scheduler_config(spec: Dict) -> Config:
         for k, v in spec["rebalancer"].items():
             if hasattr(cfg.rebalancer, k):
                 setattr(cfg.rebalancer, k, v)
+    # pool-regex planes (reference config shape: [{"pool-regex": ...,
+    # "container"/"env"/"valid-models": ...}])
+    for conf_key, attr, value_key in (
+            ("default_containers", "default_containers", "container"),
+            ("default_envs", "default_envs", "env"),
+            ("valid_gpu_models", "valid_gpu_models", "valid-models")):
+        table = []
+        for e in spec.get(conf_key) or []:
+            rx, val = e.get("pool-regex"), e.get(value_key)
+            if rx is None or val is None:
+                print(f"cook_tpu: ignoring malformed {conf_key} entry "
+                      f"{e!r} (needs pool-regex + {value_key})",
+                      file=sys.stderr)
+                continue
+            table.append((rx, val))
+        setattr(cfg, attr, table)
     return cfg
 
 

@@ -524,6 +524,31 @@ class CookApi:
                 job.pool = pool_override
             job.pool = self.plugins.pool_selector.select(
                 job, self.config.default_pool)
+            # pool-regex planes, applied with the EFFECTIVE pool known
+            # (reference: rest/api.clj:719-738 default container / gpu
+            # model / default env resolution per pool)
+            if job.container is None:
+                default = self.config.default_container_for_pool(job.pool)
+                if default:
+                    import copy
+                    job.container = normalize_container(
+                        copy.deepcopy(default))
+                    # the default was attached AFTER the per-spec
+                    # validation pass — its parameters must clear the
+                    # same allowlist a direct submission would
+                    validate_docker_parameters(
+                        job, self.config.task_constraints)
+            default_env = self.config.default_env_for_pool(job.pool)
+            if default_env:
+                job.env = {**default_env, **job.env}  # job's values win
+            if job.resources.gpus:
+                models = self.config.gpu_models_for_pool(job.pool)
+                if models is not None:
+                    model = job.labels.get("gpu-model", "")
+                    if model not in models:
+                        raise ApiError(
+                            400, f"The following GPU model is not supported "
+                                 f"in pool {job.pool}: {model or '(none)'}")
             deny = self.plugins.validate_submission(job)
             if deny:
                 raise ApiError(400, f"job {job.uuid}: {deny}")

@@ -1365,3 +1365,86 @@ class TestDockerParameterDefaults:
             client.submit_one("x", container={
                 "image": "img", "parameters": [{"key": "label"}]})
         assert "require a value" in e.value.message
+
+
+class TestPoolRegexPlanes:
+    """Per-pool default container / default env / valid gpu models
+    (reference: config.clj pools planes + rest/api.clj:719-738;
+    integration test_default_container_for_pool /
+    test_request_gpu_models)."""
+
+    def _system(self, **cfg_kw):
+        store = Store()
+        cluster = FakeCluster(
+            "fake-1", [FakeHost("h0", Resources(cpus=8, mem=8192, gpus=4))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        for k, v in cfg_kw.items():
+            setattr(cfg, k, v)
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        api = CookApi(store, scheduler=sched, config=cfg, admins=["admin"])
+        server = ApiServer(api)
+        server.start()
+        return store, server
+
+    def test_default_container_applied_per_pool(self):
+        store, server = self._system(default_containers=[
+            (r"^default$", {"type": "docker",
+                            "docker": {"image": "pool-default:1"}})])
+        try:
+            client = client_for(server)
+            u = client.submit_one("x")
+            job = store.job(u)
+            assert job.container["image"] == "pool-default:1"
+            # an explicit container is NOT overridden
+            u2 = client.submit_one("x", container={"image": "mine:2"})
+            assert store.job(u2).container["image"] == "mine:2"
+        finally:
+            server.stop()
+
+    def test_default_env_merged_under_job_env(self):
+        store, server = self._system(default_envs=[
+            (r".*", {"REGION": "us-east", "TIER": "batch"})])
+        try:
+            client = client_for(server)
+            u = client.submit_one("x", env={"TIER": "mine"})
+            job = store.job(u)
+            assert job.env["REGION"] == "us-east"
+            assert job.env["TIER"] == "mine"  # job's value wins
+        finally:
+            server.stop()
+
+    def test_gpu_model_validation(self):
+        _store, server = self._system(valid_gpu_models=[
+            (r"^default$", ["a100", "h100"])])
+        try:
+            client = client_for(server)
+            # unsupported model rejected
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", gpus=1,
+                                  labels={"gpu-model": "k80"})
+            assert "not supported" in e.value.message
+            # no model named: also rejected when the pool declares models
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", gpus=1)
+            assert "not supported" in e.value.message
+            # supported model passes; non-gpu jobs unaffected
+            assert client.submit_one("x", gpus=1,
+                                     labels={"gpu-model": "a100"})
+            assert client.submit_one("x")
+        finally:
+            server.stop()
+
+    def test_default_container_parameters_also_validated(self):
+        # a pool default carrying a disallowed parameter must fail the
+        # submission the same way a direct container submission would
+        _store, server = self._system(default_containers=[
+            (r".*", {"image": "img",
+                     "parameters": [{"key": "privileged",
+                                     "value": "true"}]})])
+        try:
+            with pytest.raises(JobClientError) as e:
+                client_for(server).submit_one("x")
+            assert "not supported" in e.value.message
+        finally:
+            server.stop()
