@@ -34,6 +34,7 @@ from __future__ import annotations
 import importlib
 import json
 import signal
+import re
 import sys
 import threading
 from pathlib import Path
@@ -77,6 +78,11 @@ def build_scheduler_config(spec: Dict) -> Config:
         for k, v in spec["rebalancer"].items():
             if hasattr(cfg.rebalancer, k):
                 setattr(cfg.rebalancer, k, v)
+    if "task_constraints" in spec:
+        # submission-time limits (reference: config.clj :task-constraints)
+        for k, v in spec["task_constraints"].items():
+            if hasattr(cfg.task_constraints, k):
+                setattr(cfg.task_constraints, k, v)
     # pool-regex planes (reference config shape: [{"pool-regex": ...,
     # "container"/"env"/"valid-models": ...}])
     for conf_key, attr, value_key in (
@@ -91,6 +97,12 @@ def build_scheduler_config(spec: Dict) -> Config:
                       f"{e!r} (needs pool-regex + {value_key})",
                       file=sys.stderr)
                 continue
+            try:
+                # fail the BOOT on a bad pattern, not every submission
+                re.compile(rx)
+            except re.error as exc:
+                raise ValueError(
+                    f"invalid pool-regex {rx!r} in {conf_key}: {exc}")
             table.append((rx, val))
         setattr(cfg, attr, table)
     return cfg

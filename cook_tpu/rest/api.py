@@ -31,6 +31,7 @@ X-Cook-Impersonate (reference: rest/authorization.clj, impersonation.clj).
 from __future__ import annotations
 
 import base64
+import copy
 import hmac
 import json
 import re
@@ -530,7 +531,6 @@ class CookApi:
             if job.container is None:
                 default = self.config.default_container_for_pool(job.pool)
                 if default:
-                    import copy
                     job.container = normalize_container(
                         copy.deepcopy(default))
                     # the default was attached AFTER the per-spec

@@ -238,3 +238,24 @@ class TestCrashRecovery:
                 break
             time.sleep(0.1)
         assert state == "running"
+
+
+def test_build_scheduler_config_task_constraints_and_planes():
+    """Daemon JSON -> Config: nested task_constraints and pool-regex
+    planes (reference: config.clj :task-constraints + pools planes)."""
+    from cook_tpu.daemon import build_scheduler_config
+    cfg = build_scheduler_config({
+        "task_constraints": {"docker_parameters_allowed": ["env"],
+                             "max_ports": 4,
+                             "unknown_key_ignored": True},
+        "default_containers": [
+            {"pool-regex": "^p$", "container": {"image": "i:1"}},
+            {"pool-regex": ".*"}],  # malformed: skipped, not fatal
+        "valid_gpu_models": [
+            {"pool-regex": "^gpu", "valid-models": ["a100"]}],
+    })
+    assert cfg.task_constraints.docker_parameters_allowed == ["env"]
+    assert cfg.task_constraints.max_ports == 4
+    assert cfg.default_container_for_pool("p") == {"image": "i:1"}
+    assert cfg.default_container_for_pool("other") is None
+    assert cfg.gpu_models_for_pool("gpu-a") == ["a100"]
