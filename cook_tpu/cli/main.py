@@ -20,11 +20,23 @@ import time
 import urllib.parse
 import urllib.request
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..client import JobClient, JobClientError
 
 CONFIG_PATH = Path.home() / ".cs.json"
+
+
+def load_cs_config() -> Optional[Dict]:
+    """Parsed ~/.cs.json; {} when absent, None when present but CORRUPT
+    (callers that WRITE must refuse on None — falling back to {} and
+    rewriting would destroy the user's whole config)."""
+    if not CONFIG_PATH.exists():
+        return {}
+    try:
+        return json.loads(CONFIG_PATH.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def load_urls(args) -> List[str]:
@@ -35,8 +47,8 @@ def load_urls(args) -> List[str]:
     env = os.environ.get("COOK_URL")
     if env:
         return refs + env.split(",")
-    if CONFIG_PATH.exists():
-        cfg = json.loads(CONFIG_PATH.read_text())
+    cfg = load_cs_config()
+    if cfg:
         return refs + [c["url"] for c in cfg.get("clusters", [])]
     return refs or ["http://127.0.0.1:12321"]
 
@@ -153,6 +165,10 @@ def cmd_submit(args) -> int:
             print("error: --raw reads specs from stdin; it cannot be "
                   "combined with a command argument", file=sys.stderr)
             return 1
+        if args.command_prefix is not None:
+            print("error: --command-prefix does not apply to --raw "
+                  "specs", file=sys.stderr)
+            return 1
         if sys.stdin.isatty():
             print("error: --raw expects JSON spec(s) on stdin",
                   file=sys.stderr)
@@ -175,6 +191,16 @@ def cmd_submit(args) -> int:
             print("error: no command given (argv or stdin)",
                   file=sys.stderr)
             return 1
+        # --command-prefix flag, falling back to the config file's
+        # defaults.submit.command-prefix (reference: subcommands/submit.py
+        # job-template command-prefix + test_submit_with_command_prefix)
+        prefix = args.command_prefix
+        if prefix is None:
+            cfg = load_cs_config() or {}
+            prefix = (cfg.get("defaults", {}).get("submit", {})
+                      .get("command-prefix", ""))
+        if prefix:
+            commands = [prefix + c for c in commands]
         base: Dict = {}
         for field in ("name", "pool"):
             value = getattr(args, field)
@@ -459,20 +485,57 @@ def cmd_ssh(args) -> int:
 
 
 def cmd_config(args) -> int:
-    # merge into the existing file: clobbering it would silently delete
-    # unrelated keys (the plugins mapping, custom settings)
-    try:
-        cfg = json.loads(CONFIG_PATH.read_text()) \
-            if CONFIG_PATH.exists() else {}
-    except (OSError, json.JSONDecodeError):
-        cfg = {}
+    """Get/set dotted config keys in ~/.cs.json (reference:
+    subcommands/config.py — ``cs config defaults.submit.command-prefix
+    'time '`` writes, ``cs config KEY`` reads).  Merges into the existing
+    file: clobbering it would silently delete unrelated keys (the
+    plugins mapping, custom settings)."""
+    cfg = load_cs_config()
+    if cfg is None:
+        # a corrupt file must never be silently replaced: a write from
+        # here would destroy every unrelated setting
+        print(f"error: {CONFIG_PATH} exists but is not valid JSON; "
+              "fix or remove it first", file=sys.stderr)
+        return 1
     if args.set_url:
         cfg["clusters"] = [{"name": "default", "url": args.set_url}]
         CONFIG_PATH.write_text(json.dumps(cfg, indent=2))
-    else:
+        out(cfg)
+        return 0
+    if args.key is None:
         cfg.setdefault("clusters", [{"name": "default", "url": u}
                                     for u in load_urls(args)])
-    out(cfg)
+        out(cfg)
+        return 0
+    path = args.key.split(".")
+    if args.value is None:  # read
+        node = cfg
+        for part in path:
+            if not isinstance(node, dict) or part not in node:
+                print(f"configuration key '{args.key}' not found",
+                      file=sys.stderr)
+                return 1
+            node = node[part]
+        out(node)
+        return 0
+    node = cfg  # write: create intermediate tables as needed
+    for i, part in enumerate(path[:-1]):
+        if part not in node:
+            node[part] = {}
+        node = node[part]
+        if not isinstance(node, dict):
+            # a typo'd path through an existing scalar/list must not
+            # silently clobber it
+            print(f"error: '{'.'.join(path[:i + 1])}' exists and is not "
+                  "a table; refusing to overwrite it", file=sys.stderr)
+            return 1
+    try:
+        value: Any = json.loads(args.value)  # "5" -> 5, "true" -> True
+    except ValueError:
+        value = args.value                   # plain string
+    node[path[-1]] = value
+    CONFIG_PATH.write_text(json.dumps(cfg, indent=2))
+    out({args.key: value})
     return 0
 
 
@@ -511,6 +574,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="submitting application, name[:version]")
     sp.add_argument("--raw", action="store_true",
                     help="read full JSON job spec(s) from stdin")
+    sp.add_argument("--command-prefix", dest="command_prefix",
+                    help="string prepended to every submitted command "
+                         "(default: config defaults.submit.command-prefix)")
     sp.add_argument("command", nargs="*",
                     help="command to run; read from stdin when omitted "
                          "(one job per line)")
@@ -585,6 +651,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("config")
     sp.add_argument("--set-url", dest="set_url")
+    sp.add_argument("key", nargs="?", help="dotted config key to get/set")
+    sp.add_argument("value", nargs="?", help="value to set (JSON or str)")
     sp.set_defaults(fn=cmd_config)
     _register_plugins(sub)
     return p
@@ -598,10 +666,8 @@ def _register_plugins(subparsers) -> None:
     set_defaults(fn=...)).  A broken plugin is reported and skipped — it
     must not take the whole CLI down."""
     import importlib
-    try:
-        cfg = json.loads(CONFIG_PATH.read_text()) \
-            if CONFIG_PATH.exists() else {}
-    except (OSError, json.JSONDecodeError):
+    cfg = load_cs_config()
+    if not cfg:
         return
     for name, path in (cfg.get("plugins") or {}).items():
         try:

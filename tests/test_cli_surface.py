@@ -259,3 +259,83 @@ class TestFederatedFanout:
             if pb.poll() is None:
                 pb.kill()
             pb.wait(timeout=10)
+
+
+class TestConfigCommand:
+    """cs config dotted-key get/set + submit command-prefix (reference:
+    test_config_command_basics/advanced, test_base_config_file,
+    test_submit_with_command_prefix)."""
+
+    def test_set_get_roundtrip_and_types(self, daemon):
+        r = cli(daemon, "config", "defaults.submit.command-prefix",
+                "echo pre; ")
+        assert r.returncode == 0, r.stderr
+        r = cli(daemon, "config", "defaults.submit.command-prefix")
+        assert r.returncode == 0
+        assert json.loads(r.stdout) == "echo pre; "
+        # JSON typing: numbers and booleans parse
+        cli(daemon, "config", "defaults.submit.mem", "256")
+        r = cli(daemon, "config", "defaults.submit.mem")
+        assert json.loads(r.stdout) == 256
+        # unknown key read errors
+        r = cli(daemon, "config", "no.such.key")
+        assert r.returncode == 1
+        assert "not found" in r.stderr
+        # unrelated keys survive merging
+        r = cli(daemon, "config")
+        cfg = json.loads(r.stdout)
+        assert cfg["defaults"]["submit"]["mem"] == 256
+
+    def test_command_prefix_applies_to_submissions(self, daemon):
+        url, home = daemon
+        cli(daemon, "config", "defaults.submit.command-prefix", "true && ")
+        try:
+            r = cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                    "echo", "hi")
+            assert r.returncode == 0, r.stderr
+            uuid = r.stdout.strip()
+            r = cli(daemon, "show", uuid)
+            assert json.loads(r.stdout)[0]["command"] == "true && echo hi"
+            # the flag overrides the config value
+            r = cli(daemon, "submit", "--command-prefix", "", "--cpus",
+                    "1", "--mem", "64", "echo", "bare")
+            uuid2 = r.stdout.strip()
+            r = cli(daemon, "show", uuid2)
+            assert json.loads(r.stdout)[0]["command"] == "echo bare"
+        finally:
+            cli(daemon, "config", "defaults.submit.command-prefix", '""')
+
+    def test_corrupt_config_refused_not_clobbered(self, daemon):
+        _url, home = daemon
+        cs_path = os.path.join(home, ".cs.json")
+        original = None
+        if os.path.exists(cs_path):
+            original = open(cs_path).read()
+        try:
+            with open(cs_path, "w") as f:
+                f.write('{"clusters": [,]}')  # corrupt
+            r = cli(daemon, "config", "defaults.submit.mem", "64")
+            assert r.returncode == 1
+            assert "not valid JSON" in r.stderr
+            assert open(cs_path).read() == '{"clusters": [,]}'  # untouched
+        finally:
+            if original is None:
+                os.remove(cs_path)
+            else:
+                with open(cs_path, "w") as f:
+                    f.write(original)
+
+    def test_non_dict_intermediate_refused(self, daemon):
+        cli(daemon, "config", "--set-url", "http://example:1")
+        r = cli(daemon, "config", "clusters.default", "oops")
+        assert r.returncode == 1
+        assert "not a table" in r.stderr
+        # the clusters list survived
+        r = cli(daemon, "config", "clusters")
+        assert json.loads(r.stdout)[0]["url"] == "http://example:1"
+
+    def test_raw_refuses_command_prefix(self, daemon):
+        r = cli(daemon, "submit", "--raw", "--command-prefix", "t ",
+                stdin="{}")
+        assert r.returncode == 1
+        assert "does not apply" in r.stderr
