@@ -48,6 +48,10 @@ def load_urls(args) -> List[str]:
     if env:
         return refs + env.split(",")
     cfg = load_cs_config()
+    if cfg is None:
+        # a corrupt config must not silently reroute work to localhost
+        raise OSError(f"{CONFIG_PATH} exists but is not valid JSON; "
+                      "fix or remove it (or pass --url)")
     if cfg:
         return refs + [c["url"] for c in cfg.get("clusters", [])]
     return refs or ["http://127.0.0.1:12321"]
@@ -199,6 +203,10 @@ def cmd_submit(args) -> int:
             cfg = load_cs_config() or {}
             prefix = (cfg.get("defaults", {}).get("submit", {})
                       .get("command-prefix", ""))
+        if prefix and not isinstance(prefix, str):
+            print("error: defaults.submit.command-prefix must be a "
+                  f"string, got {prefix!r}", file=sys.stderr)
+            return 1
         if prefix:
             commands = [prefix + c for c in commands]
         base: Dict = {}
@@ -357,7 +365,14 @@ def cmd_admin(args) -> int:
         else:
             out(client.get_quota(args.for_user or client.user))
     elif args.admin_cmd == "stats":
-        out(client.stats())
+        if any(v is not None for v in (args.status, args.start, args.end,
+                                       args.name)):
+            # forward everything given: the server's validation explains
+            # what's missing rather than silently serving the wrong report
+            out(client.stats(status=args.status, start=args.start,
+                             end=args.end, name=args.name))
+        else:
+            out(client.stats())
     elif args.admin_cmd == "rebalancer":
         if args.set:
             body = {}
@@ -623,6 +638,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--pool")
     sp.add_argument("--set", action="append",
                     help="resource=value (cpus=10)")
+    # windowed instance-stats args (stats subcommand)
+    sp.add_argument("--status", help="unknown|running|success|failed")
+    sp.add_argument("--start", help="epoch-ms or ISO-8601")
+    sp.add_argument("--end", help="epoch-ms or ISO-8601")
+    sp.add_argument("--name", help="job-name filter (* wildcard)")
     sp.set_defaults(fn=cmd_admin)
 
     sp = sub.add_parser("cat", help="print a sandbox file")

@@ -1020,16 +1020,24 @@ class CookApi:
                 for p in self.store.pools()]
 
     def unscheduled(self, params: Dict) -> List[Dict]:
+        """GET /unscheduled_jobs?job=...&partial= (reference:
+        UnscheduledJobParams rest/api.clj:3112-3117: ``partial`` allows a
+        mix of valid and unknown uuids to return the valid subset)."""
         uuids = params.get("job", [])
+        partial = first(params.get("partial"), "false") == "true"
         out = []
         for uuid in uuids:
             job = self.store.job(uuid)
             if job is None:
+                if partial:
+                    continue
                 raise ApiError(404, f"no such job {uuid}")
             out.append({"uuid": uuid,
                         "reasons": job_reasons(self.store, job,
                                                scheduler=self.scheduler,
                                                queue_limits=self.queue_limits)})
+        if not out and uuids and partial:
+            raise ApiError(404, "none of the requested jobs exist")
         return out
 
     def failure_reasons(self) -> List[Dict]:
@@ -1093,6 +1101,36 @@ class CookApi:
         same dispatch table do_* routes serve."""
         from .. import __version__
         paths: Dict[str, Dict] = {}
+        # declared query parameters for the read endpoints whose contracts
+        # carry validation (the reference's compojure-api schemas)
+        query_params = {
+            # status/start/end are required TOGETHER for the windowed
+            # report; omitting all of them serves the legacy quick
+            # aggregate, so none is individually required:true
+            ("GET", "/stats/instances"): [
+                ("status", False, "unknown|running|success|failed "
+                                  "(required for the windowed report)"),
+                ("start", False, "epoch-ms or ISO-8601 "
+                                 "(required for the windowed report)"),
+                ("end", False, "epoch-ms or ISO-8601, window <= 31 days "
+                               "(required for the windowed report)"),
+                ("name", False, "job-name filter, * wildcard")],
+            ("GET", "/list"): [
+                ("user", True, ""), ("state", False, ""),
+                ("start-ms", False, ""), ("end-ms", False, ""),
+                ("limit", False, ""), ("name", False, "* wildcard"),
+                ("pool", False, "")],
+            ("GET", "/usage"): [
+                ("user", False, "omit for the all-users report (admin)"),
+                ("pool", False, ""),
+                ("group_breakdown", False, "true|false")],
+            ("GET", "/jobs"): [
+                ("uuid", True, "repeatable"),
+                ("partial", False, "true returns the found subset")],
+            ("GET", "/unscheduled_jobs"): [
+                ("job", True, "repeatable"),
+                ("partial", False, "true returns the found subset")],
+        }
         for method, path, summary, leader_only in API_ROUTES:
             entry = paths.setdefault(path, {})
             op = {
@@ -1103,10 +1141,17 @@ class CookApi:
             # declared path parameters, required by the OpenAPI spec for
             # every templated segment
             names = re.findall(r"{([^}]+)}", path)
-            if names:
-                op["parameters"] = [
-                    {"name": n, "in": "path", "required": True,
-                     "schema": {"type": "string"}} for n in names]
+            params = [{"name": n, "in": "path", "required": True,
+                       "schema": {"type": "string"}} for n in names]
+            for qname, required, desc in query_params.get((method, path),
+                                                          []):
+                q = {"name": qname, "in": "query", "required": required,
+                     "schema": {"type": "string"}}
+                if desc:
+                    q["description"] = desc
+                params.append(q)
+            if params:
+                op["parameters"] = params
             entry[method.lower()] = op
         return {
             "openapi": "3.0.0",

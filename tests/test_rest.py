@@ -1448,3 +1448,42 @@ class TestPoolRegexPlanes:
             assert "not supported" in e.value.message
         finally:
             server.stop()
+
+
+class TestUnscheduledPartial:
+    def test_partial_returns_found_subset(self, system):
+        _store, _c, sched, server = system
+        client = client_for(server)
+        u = client.submit_one("x", cpus=100)  # can't fit: stays pending
+        sched.step_rank()
+        bogus = "00000000-0000-0000-0000-00000000beef"
+        with pytest.raises(JobClientError) as e:
+            client._request("GET", "/unscheduled_jobs",
+                            params={"job": [u, bogus]})
+        assert e.value.status == 404
+        found = client._request("GET", "/unscheduled_jobs",
+                                params={"job": [u, bogus],
+                                        "partial": "true"})
+        assert [o["uuid"] for o in found] == [u]
+        with pytest.raises(JobClientError) as e:
+            client._request("GET", "/unscheduled_jobs",
+                            params={"job": [bogus], "partial": "true"})
+        assert e.value.status == 404
+
+
+class TestSwaggerQueryParams:
+    def test_declared_for_validated_endpoints(self, system):
+        _store, _c, _s, server = system
+        docs = client_for(server)._request("GET", "/swagger-docs")
+        stats = docs["paths"]["/stats/instances"]["get"]
+        by_name = {p["name"]: p for p in stats["parameters"]}
+        # none individually required (the parameterless quick aggregate
+        # is legal); the windowed-report contract rides the descriptions
+        assert by_name["status"]["required"] is False
+        assert "windowed report" in by_name["status"]["description"]
+        assert by_name["name"]["required"] is False
+        lst = docs["paths"]["/list"]["get"]
+        assert any(p["name"] == "user" and p["required"]
+                   for p in lst["parameters"])
+        jobs = docs["paths"]["/jobs"]["get"]
+        assert any(p["name"] == "partial" for p in jobs["parameters"])
