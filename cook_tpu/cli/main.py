@@ -200,7 +200,14 @@ def cmd_submit(args) -> int:
         # job-template command-prefix + test_submit_with_command_prefix)
         prefix = args.command_prefix
         if prefix is None:
-            cfg = load_cs_config() or {}
+            cfg = load_cs_config()
+            if cfg is None:
+                # a corrupt config must not silently drop the user's
+                # configured command-prefix
+                print(f"error: {CONFIG_PATH} exists but is not valid "
+                      "JSON; fix or remove it (or pass "
+                      "--command-prefix)", file=sys.stderr)
+                return 1
             prefix = (cfg.get("defaults", {}).get("submit", {})
                       .get("command-prefix", ""))
         if prefix and not isinstance(prefix, str):

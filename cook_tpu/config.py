@@ -88,7 +88,9 @@ class TaskConstraints:
     cpus: Optional[float] = None             # reference default: 4
     memory_gb: Optional[float] = None        # reference default: 12
     command_length_limit: Optional[int] = None
-    # docker parameter allow-list; None = all allowed (api.clj:1098-1103)
+    # docker parameter allow-list; None = the conservative built-in
+    # default (rest/api.py DEFAULT_DOCKER_PARAMETERS_ALLOWED — benign
+    # task-shape keys only, privilege-bearing flags denied)
     docker_parameters_allowed: Optional[List[str]] = None
 
 

@@ -1125,7 +1125,9 @@ class CookApi:
                 ("pool", False, ""),
                 ("group_breakdown", False, "true|false")],
             ("GET", "/jobs"): [
-                ("uuid", True, "repeatable"),
+                ("uuid", False, "repeatable; omit to query by user/state"),
+                ("user", False, "with state: the listing form"),
+                ("state", False, "waiting|running|completed (+-joined)"),
                 ("partial", False, "true returns the found subset")],
             ("GET", "/unscheduled_jobs"): [
                 ("job", True, "repeatable"),
@@ -1205,6 +1207,31 @@ class CookApi:
                 "min-dru-diff": reb.min_dru_diff,
                 "max-preemption": reb.max_preemption,
                 "interval-seconds": reb.interval_seconds,
+            },
+            # clients derive their submission expectations from this block
+            # (reference: settings -> :task-constraints, read by the
+            # integration tier's limit probes)
+            "task-constraints": {
+                "cpus": cfg.task_constraints.cpus,
+                "memory-gb": cfg.task_constraints.memory_gb,
+                "max-ports": cfg.task_constraints.max_ports,
+                "retry-limit": cfg.task_constraints.retry_limit,
+                "command-length-limit":
+                    cfg.task_constraints.command_length_limit,
+                "docker-parameters-allowed": (
+                    cfg.task_constraints.docker_parameters_allowed
+                    if cfg.task_constraints.docker_parameters_allowed
+                    is not None
+                    else sorted(DEFAULT_DOCKER_PARAMETERS_ALLOWED)),
+            },
+            "pools": {
+                "default-containers": [
+                    {"pool-regex": rx, "container": c}
+                    for rx, c in cfg.default_containers],
+                "default-envs": [{"pool-regex": rx, "env": e}
+                                 for rx, e in cfg.default_envs],
+                "valid-gpu-models": [{"pool-regex": rx, "valid-models": m}
+                                     for rx, m in cfg.valid_gpu_models],
             },
         }
 

@@ -1487,3 +1487,17 @@ class TestSwaggerQueryParams:
                    for p in lst["parameters"])
         jobs = docs["paths"]["/jobs"]["get"]
         assert any(p["name"] == "partial" for p in jobs["parameters"])
+
+
+class TestSettingsDepth:
+    def test_task_constraints_and_pools_in_settings(self, system):
+        _store, _c, _s, server = system
+        s = client_for(server).settings()
+        tc = s["task-constraints"]
+        assert "retry-limit" in tc and "command-length-limit" in tc
+        # default-deny docker allowlist surfaces so clients can predict
+        # submission outcomes
+        assert "env" in tc["docker-parameters-allowed"]
+        assert "privileged" not in tc["docker-parameters-allowed"]
+        assert set(s["pools"]) == {"default-containers", "default-envs",
+                                   "valid-gpu-models"}
