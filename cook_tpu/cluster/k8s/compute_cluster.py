@@ -41,7 +41,9 @@ class KubernetesCluster(ComputeCluster):
                  stuck_pod_timeout_ms: int = 300_000,
                  node_blocklist_labels: Optional[List[str]] = None,
                  incremental=None,
-                 rest_url: str = ""):
+                 rest_url: str = "",
+                 disallowed_container_paths: Optional[List[str]] = None,
+                 disallowed_var_names: Optional[List[str]] = None):
         super().__init__(name)
         self.api = api or FakeKubernetesApi()
         self.store = store
@@ -56,6 +58,12 @@ class KubernetesCluster(ComputeCluster):
         # advertised to tasks as COOK_SCHEDULER_REST_URL
         # (reference: kubernetes/api.clj:1440)
         self.rest_url = rest_url
+        # volumes/env another cluster component owns, dropped at pod
+        # compile (reference: config :kubernetes
+        # :disallowed-container-paths / :disallowed-var-names)
+        self.disallowed_container_paths = set(
+            disallowed_container_paths or [])
+        self.disallowed_var_names = set(disallowed_var_names or [])
         self._watch_registered = False
         clock = (lambda: store.clock()) if store is not None else (lambda: 0)
         self.controller = PodController(
@@ -200,9 +208,12 @@ class KubernetesCluster(ComputeCluster):
                 gpus=spec.resources.gpus,
                 creation_ms=(self.store.clock() if self.store else 0),
                 labels={"cook/job": spec.job_uuid, "cook/pool": pool},
-                spec=(build_pod_spec(job, pool, incremental=self.incremental,
-                                     task_id=spec.task_id,
-                                     rest_url=self.rest_url)
+                spec=(build_pod_spec(
+                    job, pool, incremental=self.incremental,
+                    task_id=spec.task_id, rest_url=self.rest_url,
+                    disallowed_container_paths=(
+                        self.disallowed_container_paths),
+                    disallowed_var_names=self.disallowed_var_names)
                       if job is not None else {}))
             if not self.controller.launch_pod(pod):
                 if self._status_callback:

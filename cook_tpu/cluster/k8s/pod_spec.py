@@ -54,7 +54,10 @@ def build_pod_spec(job: Job, pool: str,
                    incremental: Optional[Any] = None,
                    sidecar: bool = True,
                    task_id: Optional[str] = None,
-                   rest_url: str = "") -> Dict[str, Any]:
+                   rest_url: str = "",
+                   disallowed_container_paths: Optional[set] = None,
+                   disallowed_var_names: Optional[set] = None
+                   ) -> Dict[str, Any]:
     """Compile one job's pod specification.
 
     ``incremental`` is a policy.incremental.IncrementalConfig used for
@@ -90,11 +93,16 @@ def build_pod_spec(job: Job, pool: str,
     # them ON TOP of job-ent->env, mesos/task.clj:127-131; k8s env lists are
     # last-entry-wins, so drop user collisions instead)
     reserved = {e["name"] for e in env}
+    # operator-filtered var names (reference: make-filtered-env-vars,
+    # kubernetes/api.clj:1117-1126 — REMOVED, not rejected: another
+    # cluster component owns those names)
+    blocked_vars = disallowed_var_names or set()
     env.extend({"name": k, "value": v} for k, v in sorted(job.env.items())
-               if k not in reserved)
+               if k not in reserved and k not in blocked_vars)
 
     volumes = [{"name": "cook-workdir", "empty_dir": {}}]
     mounts = [{"name": "cook-workdir", "mount_path": COOK_WORKDIR}]
+    blocked_paths = disallowed_container_paths or set()
     for vol in container.get("volumes", []):
         # user volumes: {"host-path": ..., "container-path": ..., "mode":
         # ...} or the compact "host:container" string form
@@ -105,6 +113,11 @@ def build_pod_spec(job: Job, pool: str,
                    else bits[0],
                    "mode": ("RO" if len(bits) > 2
                             and bits[2].lower() == "ro" else "RW")}
+        # paths another cluster component mounts (admission controller)
+        # are dropped, not rejected (make-volumes, kubernetes/api.clj:995)
+        if (vol.get("container-path") or vol.get("host-path")) \
+                in blocked_paths:
+            continue
         name = f"uservol-{len(volumes)}"
         volumes.append({"name": name,
                         "host_path": vol.get("host-path", "")})
@@ -211,7 +224,11 @@ def build_pod_spec(job: Job, pool: str,
             workdir = value
         elif key == "env" and "=" in value:
             name, _, val = value.partition("=")
-            env.append({"name": name, "value": val})
+            # the SAME filters as job.env: scheduler-owned identity vars
+            # and operator-owned names must not be injectable through a
+            # docker parameter either (k8s env is last-entry-wins)
+            if name not in reserved and name not in blocked_vars:
+                env.append({"name": name, "value": val})
 
     containers = [{
         "name": "cook-job",

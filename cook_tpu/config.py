@@ -160,6 +160,13 @@ class Config:
     default_containers: List[tuple] = field(default_factory=list)
     default_envs: List[tuple] = field(default_factory=list)
     valid_gpu_models: List[tuple] = field(default_factory=list)
+    # operator k8s policy mirrored into /settings on EVERY node (api-only
+    # followers included); the k8s backends receive the same values as
+    # constructor kwargs (reference: config :kubernetes
+    # :disallowed-container-paths / :disallowed-var-names)
+    kubernetes_disallowed_container_paths: List[str] = \
+        field(default_factory=list)
+    kubernetes_disallowed_var_names: List[str] = field(default_factory=list)
 
     _compiled: List[tuple] = field(default_factory=list, repr=False)
 

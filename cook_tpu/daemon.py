@@ -83,6 +83,11 @@ def build_scheduler_config(spec: Dict) -> Config:
         for k, v in spec["task_constraints"].items():
             if hasattr(cfg.task_constraints, k):
                 setattr(cfg.task_constraints, k, v)
+    k8s = spec.get("kubernetes") or {}
+    cfg.kubernetes_disallowed_container_paths = list(
+        k8s.get("disallowed_container_paths", []))
+    cfg.kubernetes_disallowed_var_names = list(
+        k8s.get("disallowed_var_names", []))
     # pool-regex planes (reference config shape: [{"pool-regex": ...,
     # "container"/"env"/"valid-models": ...}])
     for conf_key, attr, value_key in (

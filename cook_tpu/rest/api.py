@@ -1233,7 +1233,32 @@ class CookApi:
                 "valid-gpu-models": [{"pool-regex": rx, "valid-models": m}
                                      for rx, m in cfg.valid_gpu_models],
             },
+            **self._k8s_settings(),
         }
+
+    def _k8s_settings(self) -> Dict:
+        """The kubernetes config block (reference: settings ->
+        :kubernetes, read by the integration tier's disallowed-volume/
+        var probes).  A leader reports the live backend's values; an
+        api-only node (no scheduler attached) reports the same truth
+        from its Config so every node serves one settings document."""
+        for cluster in (self.scheduler.clusters.values()
+                        if self.scheduler else []):
+            if hasattr(cluster, "disallowed_container_paths"):
+                return {"kubernetes": {
+                    "disallowed-container-paths":
+                        sorted(cluster.disallowed_container_paths),
+                    "disallowed-var-names":
+                        sorted(cluster.disallowed_var_names)}}
+        cfg = self.config
+        if cfg.kubernetes_disallowed_container_paths \
+                or cfg.kubernetes_disallowed_var_names:
+            return {"kubernetes": {
+                "disallowed-container-paths":
+                    sorted(cfg.kubernetes_disallowed_container_paths),
+                "disallowed-var-names":
+                    sorted(cfg.kubernetes_disallowed_var_names)}}
+        return {}
 
     # wire-name -> (field, coercion): values are validated/coerced so a
     # mistyped document can never poison every later rebalance cycle

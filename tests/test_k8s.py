@@ -274,3 +274,80 @@ class TestNodeBlocklist:
         cluster.initialize(lambda *a, **k: None)
         hosts = {o.hostname for o in cluster.pending_offers("default")}
         assert hosts == {"good"}
+
+
+class TestDisallowedVolumesAndVars:
+    """Operator-owned container paths and env var names are DROPPED at
+    pod compile, not rejected (reference: make-volumes
+    kubernetes/api.clj:990-1003 + make-filtered-env-vars :1117-1126;
+    integration test_kubernetes_disallowed_volumes /
+    _disallowed_var_names)."""
+
+    def test_filtered_out_of_pod_spec(self):
+        from cook_tpu.cluster.k8s.pod_spec import build_pod_spec
+        from cook_tpu.state import Job, Resources
+        job = Job(uuid="u-1", user="alice", command="x",
+                  resources=Resources(cpus=1.0, mem=64.0),
+                  env={"OK_VAR": "1", "INJECTED": "nope"},
+                  container={"image": "img", "volumes": [
+                      {"host-path": "/data", "container-path": "/data"},
+                      {"host-path": "/tmp", "container-path": "/managed"},
+                      "/scratch:/scratch"]})
+        spec = build_pod_spec(
+            job, "default", sidecar=False,
+            disallowed_container_paths={"/managed", "/scratch"},
+            disallowed_var_names={"INJECTED"})
+        [c] = spec["containers"]
+        mounts = {m["mount_path"] for m in c["volume_mounts"]}
+        assert "/data" in mounts
+        assert "/managed" not in mounts and "/scratch" not in mounts
+        names = {e["name"] for e in c["env"]}
+        assert "OK_VAR" in names and "INJECTED" not in names
+
+    def test_cluster_threads_config_and_settings_reports_it(self):
+        from cook_tpu.cluster.k8s.compute_cluster import KubernetesCluster
+        from cook_tpu.rest import CookApi
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.config import Config
+        from cook_tpu.state import Store
+        store = Store()
+        cluster = KubernetesCluster(
+            "k8s", store=store,
+            disallowed_container_paths=["/managed"],
+            disallowed_var_names=["INJECTED"])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        api = CookApi(store, scheduler=sched, config=cfg)
+        s = api.settings()
+        assert s["kubernetes"]["disallowed-container-paths"] == ["/managed"]
+        assert s["kubernetes"]["disallowed-var-names"] == ["INJECTED"]
+
+    def test_env_parameter_cannot_bypass_filters(self):
+        from cook_tpu.cluster.k8s.pod_spec import build_pod_spec
+        from cook_tpu.state import Job, Resources
+        job = Job(uuid="u-2", user="alice", command="x",
+                  resources=Resources(cpus=1.0, mem=64.0),
+                  container={"image": "img", "parameters": [
+                      {"key": "env", "value": "INJECTED=evil"},
+                      {"key": "env", "value": "COOK_JOB_UUID=forged"},
+                      {"key": "env", "value": "FINE=yes"}]})
+        spec = build_pod_spec(job, "default", sidecar=False,
+                              disallowed_var_names={"INJECTED"})
+        [c] = spec["containers"]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["FINE"] == "yes"
+        assert "INJECTED" not in env           # operator-owned name
+        assert env["COOK_JOB_UUID"] == "u-2"   # identity var unforgeable
+
+    def test_api_only_node_reports_kubernetes_settings_from_config(self):
+        from cook_tpu.rest import CookApi
+        from cook_tpu.config import Config
+        from cook_tpu.state import Store
+        cfg = Config()
+        cfg.kubernetes_disallowed_container_paths = ["/managed"]
+        cfg.kubernetes_disallowed_var_names = ["INJECTED"]
+        api = CookApi(Store(), scheduler=None, config=cfg)  # api-only
+        s = api.settings()
+        assert s["kubernetes"]["disallowed-container-paths"] == ["/managed"]
+        assert s["kubernetes"]["disallowed-var-names"] == ["INJECTED"]
