@@ -315,3 +315,17 @@ class KubernetesCluster(ComputeCluster):
                 self.api.delete_pod(pod.name)
                 reaped += 1
         return reaped
+
+
+def factory(store=None, name: str = "k8s", api_url: str = "",
+            **kwargs) -> KubernetesCluster:
+    """Config-file / dynamic-creation entry point (the analog of
+    fake.factory / remote.factory; reference: the factory-fn template,
+    compute_cluster.clj:483-497).  ``api_url`` selects the stdlib-HTTP
+    RealKubernetesApi; empty keeps the in-process fake (tests,
+    simulation)."""
+    api = None
+    if api_url:
+        from .real_api import RealKubernetesApi
+        api = RealKubernetesApi(base_url=api_url)
+    return KubernetesCluster(name, api, store=store, **kwargs)

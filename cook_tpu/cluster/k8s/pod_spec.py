@@ -70,7 +70,10 @@ def build_pod_spec(job: Job, pool: str,
     container = job.container or {}
     image = container.get("image", "cook/default-runtime:stable")
 
-    env = [{"name": "COOK_JOB_UUID", "value": job.uuid},
+    env = [{"name": "HOST_IP",  # fieldRef, resolved by the kubelet
+            # (reference: hostIpEnvVar kubernetes/api.clj:1102-1114)
+            "value_from": {"field_ref": {"field_path": "status.hostIP"}}},
+           {"name": "COOK_JOB_UUID", "value": job.uuid},
            {"name": "COOK_JOB_USER", "value": job.user},
            {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
            {"name": "COOK_POOL", "value": pool},

@@ -322,8 +322,14 @@ class RealKubernetesApi:
             if c.get("command"):
                 out["command"] = c["command"]
             if c.get("env"):
-                out["env"] = [{"name": e["name"], "value": e["value"]}
-                              for e in c["env"]]
+                def env_entry(e):
+                    if "value_from" in e:  # fieldRef vars (HOST_IP)
+                        fr = e["value_from"]["field_ref"]
+                        return {"name": e["name"],
+                                "valueFrom": {"fieldRef": {
+                                    "fieldPath": fr["field_path"]}}}
+                    return {"name": e["name"], "value": e["value"]}
+                out["env"] = [env_entry(e) for e in c["env"]]
             if c.get("working_dir"):
                 out["workingDir"] = c["working_dir"]
             if c.get("volume_mounts"):

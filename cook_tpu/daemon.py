@@ -137,16 +137,31 @@ def build_authenticators(conf: Dict) -> Optional[List]:
     return chain or None
 
 
-def build_clusters(specs: List[Dict], store: Store) -> List:
+def build_clusters(specs: List[Dict], store: Store,
+                   config: Optional[Config] = None) -> List:
     """Dotted-path cluster factories, the analog of the reference's
-    factory-fn template instantiation (compute_cluster.clj:483-497)."""
+    factory-fn template instantiation (compute_cluster.clj:483-497).
+
+    ``config`` threads the operator's scheduler-level k8s policy
+    (disallowed container paths / var names) into any k8s backend that
+    didn't receive its own explicit kwargs — config is the cross-node
+    source of truth (/settings reports it on every node)."""
     clusters = []
     for spec in specs or []:
         path = spec["factory"]
         module, _, attr = path.rpartition(".")
         factory = getattr(importlib.import_module(module), attr)
         kwargs = dict(spec.get("kwargs", {}))
-        clusters.append(factory(store=store, **kwargs))
+        cluster = factory(store=store, **kwargs)
+        if config is not None \
+                and hasattr(cluster, "disallowed_container_paths"):
+            if not cluster.disallowed_container_paths:
+                cluster.disallowed_container_paths = set(
+                    config.kubernetes_disallowed_container_paths)
+            if not cluster.disallowed_var_names:
+                cluster.disallowed_var_names = set(
+                    config.kubernetes_disallowed_var_names)
+        clusters.append(cluster)
     return clusters
 
 
@@ -270,7 +285,8 @@ class CookDaemon:
                     self.api.store = self.store
                     self.queue_limits.store = self.store
                 clusters = build_clusters(self.conf.get("clusters", []),
-                                          self.store)
+                                          self.store,
+                                          config=self.sched_config)
                 self.scheduler = Scheduler(
                     self.store, self.sched_config, clusters,
                     rank_backend=self.rank_backend, plugins=self.plugins,

@@ -1239,26 +1239,19 @@ class CookApi:
     def _k8s_settings(self) -> Dict:
         """The kubernetes config block (reference: settings ->
         :kubernetes, read by the integration tier's disallowed-volume/
-        var probes).  A leader reports the live backend's values; an
-        api-only node (no scheduler attached) reports the same truth
-        from its Config so every node serves one settings document."""
+        var probes).  Config is the cross-node source of truth; any live
+        backend's values are unioned in, so leaders and api-only
+        followers serve one consistent settings document."""
+        paths = set(self.config.kubernetes_disallowed_container_paths)
+        names = set(self.config.kubernetes_disallowed_var_names)
         for cluster in (self.scheduler.clusters.values()
                         if self.scheduler else []):
             if hasattr(cluster, "disallowed_container_paths"):
-                return {"kubernetes": {
-                    "disallowed-container-paths":
-                        sorted(cluster.disallowed_container_paths),
-                    "disallowed-var-names":
-                        sorted(cluster.disallowed_var_names)}}
-        cfg = self.config
-        if cfg.kubernetes_disallowed_container_paths \
-                or cfg.kubernetes_disallowed_var_names:
-            return {"kubernetes": {
-                "disallowed-container-paths":
-                    sorted(cfg.kubernetes_disallowed_container_paths),
-                "disallowed-var-names":
-                    sorted(cfg.kubernetes_disallowed_var_names)}}
-        return {}
+                paths |= cluster.disallowed_container_paths
+                names |= cluster.disallowed_var_names
+        return {"kubernetes": {
+            "disallowed-container-paths": sorted(paths),
+            "disallowed-var-names": sorted(names)}}
 
     # wire-name -> (field, coercion): values are validated/coerced so a
     # mistyped document can never poison every later rebalance cycle
@@ -1333,7 +1326,8 @@ class CookApi:
                 [fresh] = build_clusters(
                     [{"factory": factory,
                       "kwargs": dict(body.get("kwargs") or {},
-                                     name=name)}], self.store)
+                                     name=name)}], self.store,
+                    config=self.config)
             except Exception as e:
                 raise ApiError(422, f"cluster factory failed: {e}")
             self.scheduler.add_cluster(fresh)

@@ -335,7 +335,7 @@ class TestDisallowedVolumesAndVars:
         spec = build_pod_spec(job, "default", sidecar=False,
                               disallowed_var_names={"INJECTED"})
         [c] = spec["containers"]
-        env = {e["name"]: e["value"] for e in c["env"]}
+        env = {e["name"]: e.get("value") for e in c["env"]}
         assert env["FINE"] == "yes"
         assert "INJECTED" not in env           # operator-owned name
         assert env["COOK_JOB_UUID"] == "u-2"   # identity var unforgeable
@@ -351,3 +351,22 @@ class TestDisallowedVolumesAndVars:
         s = api.settings()
         assert s["kubernetes"]["disallowed-container-paths"] == ["/managed"]
         assert s["kubernetes"]["disallowed-var-names"] == ["INJECTED"]
+
+    def test_scheduler_config_threads_into_built_clusters(self):
+        from cook_tpu.daemon import build_clusters, build_scheduler_config
+        cfg = build_scheduler_config({
+            "kubernetes": {"disallowed_container_paths": ["/managed"],
+                           "disallowed_var_names": ["INJECTED"]}})
+        from cook_tpu.state import Store
+        [cluster] = build_clusters(
+            [{"factory": "cook_tpu.cluster.k8s.compute_cluster.factory",
+              "kwargs": {"name": "k8s-a"}}], Store(), config=cfg)
+        assert cluster.disallowed_container_paths == {"/managed"}
+        assert cluster.disallowed_var_names == {"INJECTED"}
+        # explicit kwargs still win over the config defaults
+        [cluster2] = build_clusters(
+            [{"factory": "cook_tpu.cluster.k8s.compute_cluster.factory",
+              "kwargs": {"name": "k8s-b",
+                         "disallowed_var_names": ["OTHER"]}}],
+            Store(), config=cfg)
+        assert cluster2.disallowed_var_names == {"OTHER"}

@@ -16,7 +16,9 @@ U = "11111111-2222-3333-4444-555555555555"
 
 
 def base_env(job, pool="default", extra=()):
-    env = [{"name": "COOK_JOB_UUID", "value": job.uuid},
+    env = [{"name": "HOST_IP",
+            "value_from": {"field_ref": {"field_path": "status.hostIP"}}},
+           {"name": "COOK_JOB_UUID", "value": job.uuid},
            {"name": "COOK_JOB_USER", "value": job.user},
            {"name": "COOK_WORKDIR", "value": COOK_WORKDIR},
            {"name": "COOK_POOL", "value": pool},
@@ -233,7 +235,8 @@ def test_launch_path_env_carries_instance_identity():
     job.instances = ["task-1"]  # the launching task, already recorded
     spec = build_pod_spec(job, "default", task_id="task-1",
                           rest_url="http://cook.example:12321")
-    env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+    env = {e["name"]: e.get("value")
+           for e in spec["containers"][0]["env"]}
     assert env["COOK_INSTANCE_UUID"] == "task-1"
     assert env["COOK_INSTANCE_NUM"] == "0"  # zero PRIOR attempts
     assert env["COOK_SCHEDULER_REST_URL"] == "http://cook.example:12321"
