@@ -118,8 +118,8 @@ def build_pod_spec(job: Job, pool: str,
                             and bits[2].lower() == "ro" else "RW")}
         # paths another cluster component mounts (admission controller)
         # are dropped, not rejected (make-volumes, kubernetes/api.clj:995)
-        if (vol.get("container-path") or vol.get("host-path")) \
-                in blocked_paths:
+        target = vol.get("container-path") or vol.get("host-path")
+        if target in blocked_paths:
             continue
         name = f"uservol-{len(volumes)}"
         volumes.append({"name": name,
@@ -232,6 +232,23 @@ def build_pod_spec(job: Job, pool: str,
             # docker parameter either (k8s env is last-entry-wins)
             if name not in reserved and name not in blocked_vars:
                 env.append({"name": name, "value": val})
+
+    # duplicate mountPaths are rejected by the apiserver; when a USER
+    # volume collides with any system mount (sandbox, /dev/shm,
+    # checkpoint) or an earlier user volume, the user one is dropped so
+    # the job still runs (reference: test_workdir_volume_overlap)
+    claimed: Dict[str, Dict] = {}
+    for m in mounts:
+        if not m["name"].startswith("uservol-"):
+            claimed.setdefault(m["mount_path"], m)
+    for m in mounts:
+        if m["name"].startswith("uservol-"):
+            claimed.setdefault(m["mount_path"], m)
+    dropped_user = {m["name"] for m in mounts
+                    if claimed.get(m["mount_path"]) is not m
+                    and m["name"].startswith("uservol-")}
+    mounts = [m for m in mounts if claimed.get(m["mount_path"]) is m]
+    volumes = [v for v in volumes if v["name"] not in dropped_user]
 
     containers = [{
         "name": "cook-job",

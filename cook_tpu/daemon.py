@@ -155,12 +155,13 @@ def build_clusters(specs: List[Dict], store: Store,
         cluster = factory(store=store, **kwargs)
         if config is not None \
                 and hasattr(cluster, "disallowed_container_paths"):
-            if not cluster.disallowed_container_paths:
-                cluster.disallowed_container_paths = set(
-                    config.kubernetes_disallowed_container_paths)
-            if not cluster.disallowed_var_names:
-                cluster.disallowed_var_names = set(
-                    config.kubernetes_disallowed_var_names)
+            # the scheduler-level policy is a GLOBAL FLOOR: every k8s
+            # backend enforces it in addition to its own kwargs, so the
+            # /settings union reports exactly what is enforced
+            cluster.disallowed_container_paths |= set(
+                config.kubernetes_disallowed_container_paths)
+            cluster.disallowed_var_names |= set(
+                config.kubernetes_disallowed_var_names)
         clusters.append(cluster)
     return clusters
 

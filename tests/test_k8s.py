@@ -363,10 +363,51 @@ class TestDisallowedVolumesAndVars:
               "kwargs": {"name": "k8s-a"}}], Store(), config=cfg)
         assert cluster.disallowed_container_paths == {"/managed"}
         assert cluster.disallowed_var_names == {"INJECTED"}
-        # explicit kwargs still win over the config defaults
+        # the config policy is a GLOBAL FLOOR: explicit kwargs ADD to it
+        # (so /settings' union reports exactly what is enforced)
         [cluster2] = build_clusters(
             [{"factory": "cook_tpu.cluster.k8s.compute_cluster.factory",
               "kwargs": {"name": "k8s-b",
                          "disallowed_var_names": ["OTHER"]}}],
             Store(), config=cfg)
-        assert cluster2.disallowed_var_names == {"OTHER"}
+        assert cluster2.disallowed_var_names == {"OTHER", "INJECTED"}
+        assert cluster2.disallowed_container_paths == {"/managed"}
+
+    def test_workdir_overlap_volume_dropped(self):
+        # reference: test_workdir_volume_overlap — a user volume at the
+        # sandbox path would be a duplicate mountPath; the job still runs
+        from cook_tpu.cluster.k8s.pod_spec import (COOK_WORKDIR,
+                                                   build_pod_spec)
+        from cook_tpu.state import Job, Resources
+        job = Job(uuid="u-3", user="alice", command="x",
+                  resources=Resources(cpus=1.0, mem=64.0),
+                  container={"image": "img", "volumes": [
+                      {"host-path": "/x", "container-path": COOK_WORKDIR},
+                      {"host-path": "/y", "container-path": "/y"}]})
+        spec = build_pod_spec(job, "default", sidecar=False)
+        [c] = spec["containers"]
+        paths = [m["mount_path"] for m in c["volume_mounts"]]
+        assert paths.count(COOK_WORKDIR) == 1  # only the sandbox mount
+        assert "/y" in paths
+
+    def test_user_volume_colliding_with_system_mounts_dropped(self):
+        from cook_tpu.cluster.k8s.pod_spec import build_pod_spec
+        from cook_tpu.state import Job, Resources
+        job = Job(uuid="u-4", user="alice", command="x",
+                  resources=Resources(cpus=1.0, mem=64.0),
+                  labels={"shm-size-mb": "64"},
+                  container={"image": "img", "volumes": [
+                      {"host-path": "/a", "container-path": "/dev/shm"},
+                      {"host-path": "/b", "container-path": "/data"},
+                      {"host-path": "/c", "container-path": "/data"}]})
+        spec = build_pod_spec(job, "default", sidecar=False)
+        [c] = spec["containers"]
+        paths = [m["mount_path"] for m in c["volume_mounts"]]
+        assert paths.count("/dev/shm") == 1  # system shm wins
+        assert paths.count("/data") == 1     # first user volume wins
+        shm = [m for m in c["volume_mounts"]
+               if m["mount_path"] == "/dev/shm"][0]
+        assert shm["name"] == "shm"
+        # dropped uservols take their volume entries with them
+        assert len([v for v in spec["volumes"]
+                    if v["name"].startswith("uservol-")]) == 1
