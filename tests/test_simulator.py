@@ -71,3 +71,66 @@ class TestSimulator:
         summary = json.loads(capsys.readouterr().out)
         assert summary["jobs_completed"] == 20
         assert out_csv.exists()
+
+
+class TestSystemSimulator:
+    """The system-simulator CLI (reference: simulator/ subproject —
+    generate a workload, replay it against a LIVE daemon, report wait/
+    turnaround/overhead), distinct from the faster-than-real-time
+    scheduler simulator above."""
+
+    def test_generate_simulate_report_roundtrip(self, tmp_path):
+        import json
+        from test_integration_scenarios import (spawn, wait_leader,
+                                                wait_serving)
+        from cook_tpu.sim.system import build_report, main
+
+        sched_file = tmp_path / "sched.json"
+        out_file = tmp_path / "results.json"
+        assert main(["generate", "-f", str(sched_file), "--users", "2",
+                     "--jobs-per-user", "4", "--duration-s", "4",
+                     "--mean-job-duration-ms", "600", "--seed", "3"]) == 0
+        schedule = json.loads(sched_file.read_text())
+        assert len(schedule["users"]) == 2
+        assert all(len(u["jobs"]) == 4 for u in schedule["users"])
+
+        conf = {
+            "host": "127.0.0.1", "port": 0,
+            "data_dir": str(tmp_path / "data"),
+            "election_dir": str(tmp_path),
+            "admins": ["admin"],
+            "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                          "kwargs": {"name": "a", "n_hosts": 3,
+                                     "cpus": 8.0, "mem": 8192.0,
+                                     "auto_advance": True}}],
+            "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                          "match_interval_seconds": 0.1,
+                          "rank_interval_seconds": 0.1},
+        }
+        proc = spawn(conf, tmp_path, "sim")
+        try:
+            url = wait_serving(proc)
+            assert wait_leader(url)
+            assert main(["simulate", "-f", str(sched_file), "--url", url,
+                         "--out", str(out_file), "--time-scale", "4",
+                         "--settle-timeout-s", "60"]) == 0
+            results = json.loads(out_file.read_text())
+            assert len(results["jobs"]) == 8
+            assert results["errors"] == []
+            report = build_report(results)
+            assert report["finished"] == 8
+            assert report["never_scheduled"] == []
+            assert report["overall"]["wait"]["count"] == 8
+            # overhead = turnaround - intended duration; a broken
+            # time_scale division would blow this far past a cycle time
+            overhead = report["overall"]["overhead"]
+            assert overhead["count"] == 8
+            turnaround = report["overall"]["turnaround"]
+            assert 0 < overhead["mean_ms"] < turnaround["mean_ms"]
+            assert set(report["by_user"]) == {"sim000", "sim001"}
+            # the CLI report command renders the same JSON
+            assert main(["report", "-f", str(out_file)]) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
