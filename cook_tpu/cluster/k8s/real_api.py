@@ -22,6 +22,7 @@ import json
 import socket
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -136,6 +137,8 @@ class RealKubernetesApi:
         ctx: Optional[ssl.SSLContext] = None
         if kubeconfig and not base_url:
             base_url, token, ctx = self._from_kubeconfig(kubeconfig)
+        self._token_path: Optional[str] = None
+        self._token_checked = 0.0
         if not base_url and token is None:
             # in-cluster fallback: the pod's service account
             sa = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -143,6 +146,12 @@ class RealKubernetesApi:
             if os.path.exists(f"{sa}/token"):
                 with open(f"{sa}/token", encoding="utf-8") as f:
                     token = f.read().strip()
+                # bound service-account tokens ROTATE (the kubelet
+                # refreshes the projected file); remember the path so
+                # long-lived schedulers keep authenticating (reference:
+                # TokenRefreshingAuthenticator.java + the bearer-token
+                # refresh thread, kubernetes/compute_cluster.clj:756-792)
+                self._token_path = f"{sa}/token"
                 host = os.environ.get("KUBERNETES_SERVICE_HOST")
                 port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
                 if host:
@@ -235,6 +244,23 @@ class RealKubernetesApi:
         return server, user.get("token"), ctx
 
     # ------------------------------------------------------------------ http
+    def _bearer(self) -> Optional[str]:
+        """The current bearer token, re-read from the projected
+        service-account file at most once per minute (bound tokens
+        rotate; a stale one starts getting 401s after expiry)."""
+        if self._token_path is not None:
+            now = time.time()
+            if now - self._token_checked > 60.0:
+                self._token_checked = now
+                try:
+                    with open(self._token_path, encoding="utf-8") as f:
+                        fresh = f.read().strip()
+                    if fresh:
+                        self.token = fresh
+                except OSError:
+                    pass  # keep the last good token
+        return self.token
+
     def _request(self, method: str, path: str, body=None,
                  timeout: float = 10.0):
         url = self.base_url + path
@@ -243,8 +269,9 @@ class RealKubernetesApi:
         req.add_header("Accept", "application/json")
         if data is not None:
             req.add_header("Content-Type", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._bearer()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
             with urllib.request.urlopen(req, timeout=timeout,
                                         context=self._ctx) as resp:
@@ -544,8 +571,9 @@ class RealKubernetesApi:
                      "timeoutSeconds": str(int(self.watch_timeout_s))})
                 url = f"{self.base_url}{self._list_path(kind)}?{q}"
                 req = urllib.request.Request(url)
-                if self.token:
-                    req.add_header("Authorization", f"Bearer {self.token}")
+                token = self._bearer()
+                if token:
+                    req.add_header("Authorization", f"Bearer {token}")
                 with urllib.request.urlopen(
                         req, timeout=self.watch_timeout_s + 5,
                         context=self._ctx) as resp:

@@ -123,18 +123,25 @@ def run_simulation(schedule: Dict, url: str, time_scale: float = 1.0,
     for t in threads:
         t.join()
 
-    # settle: wait for every submitted job to reach a terminal state
+    # settle: wait for every submitted job to reach a terminal state.
+    # Transient query failures (leader failover, brief 503) must not
+    # discard a possibly hour-long replay — retry until the deadline.
+    from ..client import TERMINAL_STATES
     client = JobClient(url, user="sim-reporter")
     deadline = time.time() + settle_timeout_s
     uuids = [s["uuid"] for s in submitted]
     jobs_by_uuid: Dict[str, Dict] = {}
     while time.time() < deadline:
         done = 0
-        for i in range(0, len(uuids), 100):
-            for j in client.query(uuids[i:i + 100], partial=True):
-                jobs_by_uuid[j["uuid"]] = j
-                if j["state"] in ("success", "failed", "completed"):
-                    done += 1
+        try:
+            for i in range(0, len(uuids), 100):
+                for j in client.query(uuids[i:i + 100], partial=True):
+                    jobs_by_uuid[j["uuid"]] = j
+                    if j["state"] in TERMINAL_STATES:
+                        done += 1
+        except Exception as e:  # noqa: BLE001 - transient; keep settling
+            with lock:
+                errors.append(f"settle query: {e}")
         if done == len(uuids):
             break
         time.sleep(0.5)
@@ -152,6 +159,10 @@ def run_simulation(schedule: Dict, url: str, time_scale: float = 1.0,
             "state": job.get("state", "unknown"),
             "instance_count": len(insts),
             "preempted": sum(1 for i in insts if i.get("preempted")),
+            # the DAEMON's clock for submit too: mixing the simulator
+            # host's clock with server-side start/end timestamps would
+            # skew wait/overhead by clock offset + POST round trip
+            "submit_ms": job.get("submit_time") or s["submit_ms"],
             "start_ms": start, "finish_ms": finish,
         })
     return {"label": schedule.get("label", ""),

@@ -288,3 +288,31 @@ class TestFullBackendOverHttp:
         wait_for(lambda: any(s is InstanceStatus.SUCCESS
                              for _, s in updates), msg="SUCCESS update")
         cluster.shutdown()
+
+
+class TestTokenRefresh:
+    """Bound service-account tokens rotate; the client re-reads the
+    projected file so long-lived schedulers keep authenticating
+    (reference: TokenRefreshingAuthenticator.java + the refresh thread,
+    kubernetes/compute_cluster.clj:756-792)."""
+
+    def test_token_file_rotation_picked_up(self, mock, tmp_path):
+        from cook_tpu.cluster.k8s.real_api import RealKubernetesApi
+        token_file = tmp_path / "token"
+        token_file.write_text("tok-1")
+        api = RealKubernetesApi(base_url=mock.base_url, token="tok-1",
+                                watch_timeout_s=5)
+        api._token_path = str(token_file)
+        api._token_checked = 0.0
+        assert api._bearer() == "tok-1"
+        # rotate the file; the refresh window must pick it up
+        token_file.write_text("tok-2")
+        api._token_checked = 0.0  # force the next check
+        assert api._bearer() == "tok-2"
+        # a vanished file keeps the last good token
+        token_file.unlink()
+        api._token_checked = 0.0
+        assert api._bearer() == "tok-2"
+        # inside the 60s window no re-read happens
+        token_file.write_text("tok-3")
+        assert api._bearer() == "tok-2"
