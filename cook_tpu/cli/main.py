@@ -326,7 +326,8 @@ def cmd_retry(args) -> int:
 
 def cmd_usage(args) -> int:
     client = clients(args)[0]
-    out(client.usage(args.for_user or client.user))
+    out(client.usage(args.for_user or client.user, pool=args.pool,
+                     group_breakdown=args.group_breakdown))
     return 0
 
 
@@ -632,6 +633,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("usage")
     sp.add_argument("--for-user", dest="for_user")
+    sp.add_argument("--pool", help="restrict the report to one pool")
+    sp.add_argument("--group-breakdown", dest="group_breakdown",
+                    action="store_true",
+                    help="split running usage by job group")
     sp.set_defaults(fn=cmd_usage)
 
     sp = sub.add_parser("pools")

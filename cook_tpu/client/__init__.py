@@ -201,8 +201,20 @@ class JobClient:
         return self._request("POST", "/shutdown-leader", body={})
 
     # ---------------------------------------------------------------- admin
-    def usage(self, user: str) -> Dict:
-        return self._request("GET", "/usage", params={"user": user})
+    def usage(self, user: Optional[str] = None,
+              pool: Optional[str] = None,
+              group_breakdown: bool = False) -> Dict:
+        """GET /usage.  No user = the all-users report (admin-only);
+        ``pool`` restricts either form; ``group_breakdown`` adds the
+        per-group running-jobs split."""
+        params: Dict[str, str] = {}
+        if user is not None:
+            params["user"] = user
+        if pool is not None:
+            params["pool"] = pool
+        if group_breakdown:
+            params["group_breakdown"] = "true"
+        return self._request("GET", "/usage", params=params)
 
     def queue(self) -> Dict:
         return self._request("GET", "/queue")

@@ -339,3 +339,34 @@ class TestConfigCommand:
                 stdin="{}")
         assert r.returncode == 1
         assert "does not apply" in r.stderr
+
+
+class TestUsageCommand:
+    def test_usage_pool_filter_and_breakdown(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                "--env", "COOK_FAKE_DURATION_MS=999999",
+                "sleep", "999", user="usg")
+        uuid = r.stdout.strip()
+        assert r.returncode == 0, r.stderr
+        # wait for it to run so usage is non-zero
+        deadline = time.time() + 20
+        running = False
+        while time.time() < deadline:
+            r = cli(daemon, "show", uuid, user="usg")
+            if '"state": "running"' in r.stdout:
+                running = True
+                break
+            time.sleep(0.3)
+        try:
+            assert running, "job never reached running"
+            r = cli(daemon, "usage", "--pool", "default",
+                    "--group-breakdown", user="usg")
+            assert r.returncode == 0, r.stderr
+            rep = json.loads(r.stdout)
+            assert rep["total_usage"]["jobs"] == 1
+            assert "ungrouped" in rep
+            r = cli(daemon, "usage", "--pool", "ghost", user="usg")
+            assert r.returncode == 0, r.stderr
+            assert json.loads(r.stdout)["total_usage"]["jobs"] == 0
+        finally:
+            cli(daemon, "kill", uuid, user="usg")
