@@ -77,7 +77,15 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.cjc_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_void_p)]
-    for fn in ("cjc_query", "cjc_kill"):
+    lib.cjc_submit2.restype = ctypes.c_int
+    lib.cjc_submit2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_void_p)]
+    lib.cjc_group_query.restype = ctypes.c_int
+    lib.cjc_group_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    for fn in ("cjc_query", "cjc_kill", "cjc_group_kill"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.POINTER(ctypes.c_void_p)]
@@ -170,14 +178,40 @@ class NativeJobClient:
         return status, self._take(out)
 
     # ---------------------------------------------------------------- api
-    def submit(self, jobs: List[Dict], pool: Optional[str] = None) -> List[str]:
+    def submit(self, jobs: List[Dict], pool: Optional[str] = None,
+               groups: Optional[List[Dict]] = None) -> List[str]:
         out = ctypes.c_void_p()
-        status = self._lib.cjc_submit(self._h, json.dumps(jobs).encode(),
-                                      (pool or "").encode(),
-                                      ctypes.byref(out))
+        if groups:
+            status = self._lib.cjc_submit2(
+                self._h, json.dumps(jobs).encode(),
+                json.dumps(groups).encode(), (pool or "").encode(),
+                ctypes.byref(out))
+        else:
+            status = self._lib.cjc_submit(
+                self._h, json.dumps(jobs).encode(), (pool or "").encode(),
+                ctypes.byref(out))
         body = self._take(out)
         self._check(status, body)
         return json.loads(body)["jobs"]
+
+    def group(self, uuids: Sequence[str],
+              detailed: bool = False) -> List[Dict]:
+        """Group query (reference: the Java client's Group support)."""
+        out = ctypes.c_void_p()
+        status = self._lib.cjc_group_query(
+            self._h, ",".join(uuids).encode(), 1 if detailed else 0,
+            ctypes.byref(out))
+        body = self._take(out)
+        self._check(status, body)
+        return json.loads(body)
+
+    def kill_groups(self, uuids: Sequence[str]) -> Dict:
+        out = ctypes.c_void_p()
+        status = self._lib.cjc_group_kill(
+            self._h, ",".join(uuids).encode(), ctypes.byref(out))
+        body = self._take(out)
+        self._check(status, body)
+        return json.loads(body) if body else {}
 
     def query(self, uuids: Sequence[str]) -> List[Dict]:
         out = ctypes.c_void_p()

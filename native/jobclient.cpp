@@ -32,6 +32,7 @@
 #include <atomic>
 #include <cctype>
 #include <chrono>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <map>
@@ -459,6 +460,31 @@ class Client {
     char err_buf_[512] = {0};
 };
 
+// Minimal JSON string escaping for values we interpolate into
+// hand-built bodies (quotes, backslashes, control chars).
+std::string json_escape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (unsigned char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if (c < 0x20) {
+                    char buf[8];
+                    std::snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out += static_cast<char>(c);
+                }
+        }
+    }
+    return out;
+}
+
 std::string urlencode_uuids(const std::string& csv, const char* key) {
     // "a,b,c" -> "?key=a&key=b&key=c"  (uuids are URL-safe already)
     std::string out;
@@ -568,17 +594,46 @@ int cjc_request(void* h, const char* method, const char* path,
     return resp.status;
 }
 
-int cjc_submit(void* h, const char* jobs_json_array, const char* pool,
-               char** out) {
+// Batched submit with job groups (the Java client's Group support,
+// jobclient/java Group.java): groups_json_array is the raw "groups"
+// payload ([{"uuid": ..., "name": ..., "host-placement": ...}, ...]).
+int cjc_submit2(void* h, const char* jobs_json_array,
+                const char* groups_json_array, const char* pool,
+                char** out) {
     std::string body = "{\"jobs\": ";
     body += jobs_json_array ? jobs_json_array : "[]";
+    if (groups_json_array && *groups_json_array) {
+        body += ", \"groups\": ";
+        body += groups_json_array;
+    }
     if (pool && *pool) {
         body += ", \"pool\": \"";
-        body += pool;
+        body += json_escape(pool);
         body += "\"";
     }
     body += "}";
     return cjc_request(h, "POST", "/jobs", body.c_str(), out);
+}
+
+int cjc_submit(void* h, const char* jobs_json_array, const char* pool,
+               char** out) {
+    return cjc_submit2(h, jobs_json_array, nullptr, pool, out);
+}
+
+int cjc_group_query(void* h, const char* uuids_csv, int detailed,
+                    char** out) {
+    std::string path = "/group" + urlencode_uuids(
+        uuids_csv ? uuids_csv : "", "uuid");
+    if (detailed)
+        path += (path.find('?') == std::string::npos ? "?" : "&");
+    if (detailed) path += "detailed=true";
+    return cjc_request(h, "GET", path.c_str(), "", out);
+}
+
+int cjc_group_kill(void* h, const char* uuids_csv, char** out) {
+    std::string path = "/group" + urlencode_uuids(
+        uuids_csv ? uuids_csv : "", "uuid");
+    return cjc_request(h, "DELETE", path.c_str(), "", out);
 }
 
 int cjc_query(void* h, const char* uuids_csv, char** out) {

@@ -161,3 +161,26 @@ class TestNativeJobClient:
             status, body = c.request("GET", "/info")
             assert status == 200
             assert "cook" in body.lower() or "version" in body.lower()
+
+
+class TestGroups:
+    """Group submit/query/kill through the C++ client (the Java
+    jobclient's Group support, jobclient/java Group.java)."""
+
+    def test_group_submit_query_kill(self, system):
+        store, _cluster, sched, srv = system
+        g = "99999999-aaaa-bbbb-cccc-eeeeeeeeeeee"
+        with native_client(srv) as c:
+            uuids = c.submit(
+                [{"command": "sleep 999", "cpus": 1, "mem": 64, "group": g}
+                 for _ in range(2)],
+                groups=[{"uuid": g, "name": "native-grp"}])
+            assert len(uuids) == 2
+            sched.step_rank(); sched.step_match()
+            [grp] = c.group([g], detailed=True)
+            assert grp["uuid"] == g and grp["name"] == "native-grp"
+            assert sorted(grp["jobs"]) == sorted(uuids)
+            c.kill_groups([g])
+            jobs = c.query(uuids)
+            assert all(j["state"] in ("failed", "completed", "waiting")
+                       for j in jobs)
