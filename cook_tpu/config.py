@@ -36,10 +36,13 @@ class MatcherConfig:
     scaleback: float = 0.95
     floor_iterations_before_warn: int = 10
     floor_iterations_before_reset: int = 1000
-    # auction-kernel shape knobs
+    # auction-kernel shape knobs.  num_refresh is an UPPER BOUND: the
+    # kernel's refresh loop is adaptive (exits when a pass admits no new
+    # job), so a generous bound costs nothing on easy workloads and is
+    # what lets contended ones converge (docs/PLACEMENT_QUALITY.md)
     auction_num_prefs: int = 16
     auction_num_rounds: int = 8
-    auction_num_refresh: int = 8
+    auction_num_refresh: int = 64
     waterfill_num_rounds: int = 32
 
 
