@@ -44,6 +44,9 @@ class MatcherConfig:
     auction_num_rounds: int = 8
     auction_num_refresh: int = 64
     waterfill_num_rounds: int = 32
+    # tightness-improving migration rounds after waterfill converges
+    # (upper bound; exits when no move lands)
+    waterfill_num_compaction: int = 16
 
 
 @dataclass

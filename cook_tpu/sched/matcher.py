@@ -362,7 +362,8 @@ class Matcher:
         elif backend == "tpu-waterfill":
             from ..ops.match import waterfill_match_kernel
             assign, left = waterfill_match_kernel(
-                inp, num_rounds=mc.waterfill_num_rounds)
+                inp, num_rounds=mc.waterfill_num_rounds,
+                num_compaction=mc.waterfill_num_compaction)
         else:
             assign, left = greedy_match_kernel(inp)
         n_hosts = len(avail)

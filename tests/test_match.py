@@ -248,3 +248,53 @@ class TestWaterfill:
                              capacity.tolist())
         assert assign[0] == 3
         assert (assign >= 0).sum() >= J - 1
+
+
+class TestWaterfillCompaction:
+    """Compaction rounds migrate placements onto strictly tighter hosts:
+    never lose a placement, never loosen packing (docs/
+    PLACEMENT_QUALITY.md: 0.783 -> 0.822 mean util at 10k x 50k)."""
+
+    def test_compaction_preserves_count_and_tightens(self):
+        from cook_tpu.ops.match import waterfill_match_kernel
+        rng = np.random.default_rng(11)
+        J, H = 600, 400
+        job_res = np.stack([rng.integers(1, 8, J), rng.integers(64, 2048, J),
+                            np.zeros(J), np.zeros(J)], axis=1).astype(np.float32)
+        avail = np.stack([np.full(H, 16.0), np.full(H, 16384.0),
+                          np.zeros(H), np.full(H, 10**6)], axis=1).astype(np.float32)
+        # hosts at varied initial fill so tightness ordering matters
+        frac = rng.uniform(0.3, 1.0, H).astype(np.float32)
+        avail[:, :2] *= frac[:, None]
+        capacity = avail.copy()
+        arrays = host_prep.pack_match_inputs(
+            job_res, np.ones((J, H), dtype=bool), avail, capacity)
+        inp = MatchInputs(job_res=jnp.asarray(arrays["job_res"]),
+                          constraint_mask=jnp.asarray(arrays["constraint_mask"]),
+                          avail=jnp.asarray(arrays["avail"]),
+                          capacity=jnp.asarray(arrays["capacity"]),
+                          valid=jnp.asarray(arrays["valid"]))
+        base = np.asarray(waterfill_match_kernel(inp, num_compaction=0)[0])[:J]
+        comp = np.asarray(waterfill_match_kernel(inp, num_compaction=16)[0])[:J]
+        assert (comp >= 0).sum() == (base >= 0).sum()  # no lost placements
+
+        def mean_util(assign):
+            placed = assign >= 0
+            used = np.zeros((H, 2))
+            np.add.at(used, assign[placed], job_res[placed][:, :2])
+            host_used = used.sum(axis=1) > 0
+            f = used / np.maximum(avail[:, :2], 1e-9)
+            return f.max(axis=1)[host_used].mean(), int(host_used.sum())
+        u0, h0 = mean_util(base)
+        u1, h1 = mean_util(comp)
+        # every accepted move is individually tightness-improving (the
+        # source/destination sets are disjoint per round); the MEAN-util
+        # metric could in principle dip when a multi-job source drains,
+        # so these aggregate assertions are a fixed-seed regression pin,
+        # not a universal invariant
+        assert u1 >= u0 - 1e-6
+        assert h1 <= h0
+        # availability accounting stayed consistent: no host oversubscribed
+        used = np.zeros((H, 4))
+        np.add.at(used, comp[comp >= 0], job_res[comp >= 0])
+        assert (used <= avail + 1e-3).all()
