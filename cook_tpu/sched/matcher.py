@@ -340,6 +340,7 @@ class Matcher:
         host availability over real hosts)."""
         import jax.numpy as jnp
         from ..ops import MatchInputs, auction_match_kernel, greedy_match_kernel
+        from ..ops.match import waterfill_match_kernel
         arrays = host_prep.pack_match_inputs(job_res, cmask, avail, cap)
         inp = MatchInputs(
             job_res=jnp.asarray(arrays["job_res"]),
@@ -360,12 +361,29 @@ class Matcher:
                 num_rounds=mc.auction_num_rounds,
                 num_refresh=mc.auction_num_refresh)
         elif backend == "tpu-waterfill":
-            from ..ops.match import waterfill_match_kernel
             assign, left = waterfill_match_kernel(
                 inp, num_rounds=mc.waterfill_num_rounds,
                 num_compaction=mc.waterfill_num_compaction)
         else:
             assign, left = greedy_match_kernel(inp)
+        if backend in ("tpu-auction", "tpu-auction-pallas"):
+            # finish leftovers with the waterfill formulation: the
+            # auction's residual under contention is preference-structure
+            # exhaustion (every job's K tightest hosts taken in rank
+            # order, docs/PLACEMENT_QUALITY.md), which the prefix mapping
+            # doesn't suffer; placements strictly increase (jobs already
+            # assigned keep their host, waterfill only sees the rest)
+            leftover_valid = inp.valid & (assign < 0)
+            tail_inp = MatchInputs(
+                job_res=inp.job_res, constraint_mask=inp.constraint_mask,
+                avail=left, capacity=inp.capacity, valid=leftover_valid)
+            # compaction is safe here: settled auction placements are
+            # baked into the availability the tail sees, and only tail
+            # jobs can move
+            tail_assign, left = waterfill_match_kernel(
+                tail_inp, num_rounds=mc.waterfill_num_rounds,
+                num_compaction=mc.waterfill_num_compaction)
+            assign = jnp.where(assign < 0, tail_assign, assign)
         n_hosts = len(avail)
         return (np.asarray(assign)[:arrays["num_jobs"]],
                 np.asarray(left)[:n_hosts])

@@ -298,3 +298,39 @@ class TestWaterfillCompaction:
         used = np.zeros((H, 4))
         np.add.at(used, comp[comp >= 0], job_res[comp >= 0])
         assert (used <= avail + 1e-3).all()
+
+
+class TestAuctionWaterfillTail:
+    """The production tpu-auction backend finishes auction leftovers with
+    waterfill (matcher._run_kernel): full placement at tighter-than-
+    waterfill packing (docs/PLACEMENT_QUALITY.md: 10000/10000 at 0.923
+    mean util vs waterfill-alone 0.822 at 10k x 50k)."""
+
+    def test_tail_places_leftovers_without_oversubscription(self):
+        from cook_tpu.config import MatcherConfig
+        from cook_tpu.sched.matcher import Matcher
+        rng = np.random.default_rng(7)
+        J, H = 1200, 500   # contended: auction alone leaves a residual
+        job_res = np.stack([rng.integers(1, 8, J),
+                            rng.integers(64, 2048, J),
+                            np.zeros(J), np.zeros(J)],
+                           axis=1).astype(np.float32)
+        avail = np.stack([np.full(H, 24.0), np.full(H, 24576.0),
+                          np.zeros(H), np.full(H, 10**6)],
+                         axis=1).astype(np.float32)
+        capacity = avail.copy()
+        cmask = np.ones((J, H), dtype=bool)
+        mc = MatcherConfig(backend="tpu-auction")
+        matcher = Matcher.__new__(Matcher)  # _run_kernel needs no state
+        assign, left = matcher._run_kernel(
+            "tpu-auction", mc, job_res, cmask, avail, capacity)
+        placed = assign >= 0
+        # auction+tail must match the greedy placement count
+        g_assign, _ = matcher._run_kernel(
+            "tpu-greedy", mc, job_res, cmask, avail, capacity)
+        assert placed.sum() == (g_assign >= 0).sum()
+        used = np.zeros((H, 4))
+        np.add.at(used, assign[placed], job_res[placed])
+        assert (used <= avail + 1e-2).all()
+        # remaining availability accounting is consistent
+        assert np.allclose(np.asarray(left), avail - used, atol=1e-2)
