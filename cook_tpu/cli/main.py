@@ -319,8 +319,57 @@ def cmd_kill(args) -> int:
 
 
 def cmd_retry(args) -> int:
-    client = clients(args)[0]
-    out(client.retry(args.uuid[0], args.retries))
+    """Raise retries on jobs and/or groups (reference: subcommands/
+    retry.py over PUT /retry — multiple uuids, groups, retries or
+    increment, failed-only)."""
+    if (args.retries is None) == (args.increment is None):
+        print("error: specify exactly one of --retries or --increment",
+              file=sys.stderr)
+        return 1
+    uuids: List[str] = []
+    if args.uuid:
+        # entity refs, exactly like show/wait/kill
+        resolved = resolve_refs(args, args.uuid, allow_stdin=False)
+        if resolved is None:
+            return 1
+        uuids = resolved
+    elif not args.group:
+        # no positional refs and no groups: read uuids from a pipe
+        # (`cs jobs -1 | cs retry --retries 3`)
+        resolved = resolve_refs(args, [])
+        if resolved is None:
+            return 1
+        uuids = resolved
+    results = []
+    if uuids:
+        # route each uuid to its OWNING cluster (same federation
+        # semantics as kill/wait)
+        owned, missing = federated_owners(args, uuids)
+        if missing:
+            print(f"error: no cluster knows {', '.join(missing)}",
+                  file=sys.stderr)
+            return 1
+        for client, mine in owned:
+            results.append(client.retry(
+                jobs=mine, retries=args.retries,
+                increment=args.increment, failed_only=args.failed_only))
+    for guuid in args.group or []:
+        # group ownership isn't resolvable through the jobs query; try
+        # each federation cluster, keeping the first that knows it
+        last_err: Optional[Exception] = None
+        for client in clients(args):
+            try:
+                results.append(client.retry(
+                    groups=[guuid], retries=args.retries,
+                    increment=args.increment,
+                    failed_only=args.failed_only))
+                break
+            except (JobClientError, OSError) as e:
+                last_err = e
+        else:
+            print(f"error: group {guuid}: {last_err}", file=sys.stderr)
+            return 1
+    out(results if len(results) != 1 else results[0])
     return 0
 
 
@@ -619,8 +668,17 @@ def build_parser() -> argparse.ArgumentParser:
         sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("retry")
-    sp.add_argument("uuid", nargs=1)
-    sp.add_argument("--retries", type=int, required=True)
+    sp.add_argument("uuid", nargs="*", help="job uuid(s)")
+    sp.add_argument("--retries", type=int)
+    sp.add_argument("--increment", type=int,
+                    help="raise retries BY this much instead of setting")
+    sp.add_argument("--group", action="append",
+                    help="retry a whole group (repeatable)")
+    sp.add_argument("--failed-only", dest="failed_only",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="only resurrect failed members; --no-failed-only "
+                         "raises retries on everything (server default: "
+                         "failed-only iff groups given)")
     sp.set_defaults(fn=cmd_retry)
 
     sp = sub.add_parser("jobs", help="list your jobs")

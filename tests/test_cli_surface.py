@@ -370,3 +370,44 @@ class TestUsageCommand:
             assert json.loads(r.stdout)["total_usage"]["jobs"] == 0
         finally:
             cli(daemon, "kill", uuid, user="usg")
+
+
+class TestRetryCommand:
+    """cs retry over PUT /retry: multiple jobs, groups, increment,
+    failed-only (reference: subcommands/retry.py + UpdateRetriesRequest)."""
+
+    def test_retry_multiple_and_flags(self, daemon):
+        subs = [cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                    "--max-retries", "1",
+                    "--env", "COOK_FAKE_EXIT_CODE=1", "exit", "1")
+                for _ in range(2)]
+        uuids = [r.stdout.strip() for r in subs]
+        assert all(r.returncode == 0 for r in subs)
+        for u in uuids:
+            deadline = time.time() + 20
+            reached = False
+            while time.time() < deadline:
+                if '"state": "failed"' in cli(daemon, "show", u).stdout:
+                    reached = True
+                    break
+                time.sleep(0.3)
+            assert reached, f"{u} never failed"
+        r = cli(daemon, "retry", *uuids, "--retries", "3")
+        assert r.returncode == 0, r.stderr
+        for u in uuids:
+            shown = json.loads(cli(daemon, "show", u).stdout)[0]
+            assert shown["max_retries"] == 3
+            # resurrection: failed jobs leave the failed state
+            assert shown["state"] != "failed" or shown["status"] != \
+                "completed"
+        # increment raises BY n
+        r = cli(daemon, "retry", uuids[0], "--increment", "2")
+        assert r.returncode == 0, r.stderr
+        shown = json.loads(cli(daemon, "show", uuids[0]).stdout)[0]
+        assert shown["max_retries"] == 5
+        # validation: both/neither of retries/increment refused
+        assert cli(daemon, "retry", uuids[0]).returncode == 1
+        assert cli(daemon, "retry", uuids[0], "--retries", "4",
+                   "--increment", "1").returncode == 1
+        assert cli(daemon, "retry", "--retries", "4",
+                   stdin="").returncode == 1  # no uuids and no groups
