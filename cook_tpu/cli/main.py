@@ -421,6 +421,10 @@ def cmd_admin(args) -> int:
             out(client.set_quota(args.for_user, pools))
         else:
             out(client.get_quota(args.for_user or client.user))
+    elif args.admin_cmd == "usage":
+        # all-users report by default (admin-only server side);
+        # --for-user scopes it like the other admin subcommands
+        out(client.usage(args.for_user, pool=args.pool))
     elif args.admin_cmd == "stats":
         if any(v is not None for v in (args.status, args.start, args.end,
                                        args.name)):
@@ -703,7 +707,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("admin")
     sp.add_argument("admin_cmd",
                     choices=["queue", "share", "quota", "stats",
-                             "rebalancer"])
+                             "usage", "rebalancer"])
     sp.add_argument("--for-user", dest="for_user")
     sp.add_argument("--pool")
     sp.add_argument("--set", action="append",

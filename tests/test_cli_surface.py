@@ -411,3 +411,27 @@ class TestRetryCommand:
                    "--increment", "1").returncode == 1
         assert cli(daemon, "retry", "--retries", "4",
                    stdin="").returncode == 1  # no uuids and no groups
+
+
+class TestAdminUsage:
+    def test_all_users_report_via_cli(self, daemon):
+        r = cli(daemon, "submit", "--cpus", "1", "--mem", "64",
+                "--env", "COOK_FAKE_DURATION_MS=999999",
+                "sleep", "999", user="au1")
+        uuid = r.stdout.strip()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if '"state": "running"' in cli(daemon, "show", uuid,
+                                           user="au1").stdout:
+                break
+            time.sleep(0.3)
+        try:
+            r = cli(daemon, "admin", "usage", user="admin")
+            assert r.returncode == 0, r.stderr
+            rep = json.loads(r.stdout)
+            assert "au1" in rep["users"]
+            # non-admin refused
+            r = cli(daemon, "admin", "usage", user="au1")
+            assert r.returncode == 1
+        finally:
+            cli(daemon, "kill", uuid, user="au1")
