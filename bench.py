@@ -876,10 +876,69 @@ def bench_end2end(total=100_000, n_users=200, J=1000, H=5000, reps=5):
     return samples
 
 
+COMPACT_MAX_BYTES = 1024
+
+
+def compact_payload(payload):
+    """The driver keeps only a bounded tail of stdout, so the LAST line must
+    be small enough that its head can never be truncated away (round 4 lost
+    its number to a ~10 KB single-line payload).  This strips the payload to
+    the headline fields and hard-caps the encoded size at 1 KB."""
+    detail = payload.get("detail", {})
+    out = {
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "unit": payload.get("unit"),
+        "vs_baseline": payload.get("vs_baseline"),
+        "platform": detail.get("platform"),
+        "scale": detail.get("scale", 1.0),
+        "sections_done": detail.get("sections_done", []),
+    }
+    if detail.get("value_source"):
+        out["value_source"] = detail["value_source"]
+    err = payload.get("error")
+    if err:
+        out["error"] = err if isinstance(err, str) else str(err)
+    # hard ≤1 KB guarantee: shrink the variable-length fields until it fits
+    for trim in (300, 120, 40, 0):
+        if len(json.dumps(out)) <= COMPACT_MAX_BYTES:
+            return out
+        if "error" in out:
+            out["error"] = out["error"][:trim] if trim else None
+            if not out["error"]:
+                del out["error"]
+        if len(json.dumps(out)) > COMPACT_MAX_BYTES:
+            out["sections_done"] = len(detail.get("sections_done", []))
+    if len(json.dumps(out)) > COMPACT_MAX_BYTES:
+        # terminal fallback: some field outside the trim set is oversize
+        # (e.g. a corrupt prior capture leaking a structure into "value") —
+        # the last line must still parse, so keep only the headline triple
+        out = {"metric": str(out.get("metric"))[:80],
+               "value": out["value"] if isinstance(
+                   out.get("value"), (int, float)) else None,
+               "unit": "ms", "truncated": True}
+    return out
+
+
 def emit(payload):
+    # Two lines per emission, full payload FIRST and the compact summary
+    # LAST: the driver parses the last line it retained, and only the
+    # compact line is guaranteed to survive its bounded tail intact.
+    # Both lines are serialized BEFORE either write so a driver kill can
+    # only land between two back-to-back flushed writes (a microsecond
+    # window, vs. the deterministic truncation of a 10 KB last line).
     # flush: the incremental-emit design only survives a driver SIGKILL if
     # every line actually reaches the pipe (stdout is block-buffered there)
-    print(json.dumps(payload), flush=True)
+    full_line = json.dumps(payload)
+    try:
+        last_line = json.dumps(compact_payload(payload))
+    except Exception as e:  # the last line must exist no matter what
+        last_line = json.dumps(
+            {"metric": "match_cycle_p99_ms_rank1M_match1kx50k",
+             "value": None, "unit": "ms",
+             "error": f"compact_payload failed: {e}"[:300]})
+    print(full_line, flush=True)
+    print(last_line, flush=True)
 
 
 # ---------------------------------------------------------------- sections
@@ -1024,6 +1083,7 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         "platform": platform,
         "target_p99_ms": 50.0,
         "bench_wall_s": round(time.time() - t_start, 1),
+        "sections_done": [s for s, d in results.items() if d is not None],
     }
     if results.get("sync_floor"):
         detail["sync_floor_ms"] = round(
