@@ -118,21 +118,32 @@ def federated_owners(args, uuids: List[str]
                                 List[str]]:
     """Partition uuids by the federation cluster that owns them
     (reference: querying.py routes each entity to its cluster before
-    acting on it).  Returns ([(client, owned_uuids)...], missing)."""
+    acting on it).  Returns ([(client, owned_uuids)...], missing).
+
+    A cluster that cannot be queried is reported on stderr when any uuid
+    ends up unclaimed: an OUTAGE of the owning cluster must be
+    distinguishable from a uuid no cluster has ever seen (the caller's
+    "no cluster knows" message alone would misreport the former)."""
     unclaimed = list(uuids)
     owned: List[Tuple[JobClient, List[str]]] = []
+    errors = []
     for client in clients(args):
         if not unclaimed:
             break
         try:
             found = {j["uuid"] for j in client.query(unclaimed,
                                                      partial=True)}
-        except (JobClientError, OSError):
+        except (JobClientError, OSError) as e:
+            errors.append(f"{client.url}: {e}")
             continue
         mine = [u for u in unclaimed if u in found]
         if mine:
             owned.append((client, mine))
             unclaimed = [u for u in unclaimed if u not in found]
+    if unclaimed and errors:
+        print("warning: some clusters could not be queried (the uuids "
+              "reported missing may live there):", file=sys.stderr)
+        print("\n".join(errors), file=sys.stderr)
     return owned, unclaimed
 
 

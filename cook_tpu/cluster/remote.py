@@ -168,13 +168,30 @@ class AgentConnection:
                port_count: int = 0, image: str = "",
                volumes: Optional[List[str]] = None,
                params: Optional[List[Dict[str, str]]] = None) -> bool:
-        env_s = "\x1e".join(f"{k}={v}" for k, v in (env or {}).items())
-        vol_s = "\x1e".join(volumes or [])
+        env_pairs = [f"{k}={v}" for k, v in (env or {}).items()]
+        vol_items = list(volumes or [])
         # docker parameters [{"key": k, "value": v}] -> "--k v" runtime
         # flags agent-side (reference: mesos/task.clj docker parameters)
-        par_s = "\x1e".join(
-            f"{p['key']}={p.get('value', '')}" for p in (params or [])
-            if isinstance(p, dict) and p.get("key"))
+        par_items = [f"{p['key']}={p.get('value', '')}"
+                     for p in (params or [])
+                     if isinstance(p, dict) and p.get("key")]
+        # The agent splits each of these channels on \x1e (an embedded one
+        # in any untrusted value injects extra entries — e.g. a runtime
+        # flag like ``--privileged`` past the REST allowlist), and every
+        # channel crosses ctypes as a C string, which a NUL byte silently
+        # truncates (dropping e.g. the executor env merged after user
+        # env).  REST validation rejects both bytes at submission; this
+        # layer refuses regardless of the caller, failing the launch.
+        wire_fields = (env_pairs + vol_items + par_items
+                       + [task_id, command, image])
+        if any("\x1e" in s or "\x00" in s for s in wire_fields):
+            logging.getLogger(__name__).warning(
+                "refusing launch of %s: field embeds a NUL or the \\x1e "
+                "wire delimiter", task_id)
+            return False
+        env_s = "\x1e".join(env_pairs)
+        vol_s = "\x1e".join(vol_items)
+        par_s = "\x1e".join(par_items)
         with self._lock:
             if not self._handle:
                 return False

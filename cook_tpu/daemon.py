@@ -42,7 +42,8 @@ from typing import Dict, List, Optional
 
 from .config import Config
 from .policy import PluginRegistry, QueueLimits, RateLimits
-from .rest.api import ApiServer, CookApi
+from .rest.api import (ApiError, ApiServer, CookApi,
+                       check_container_wire_bytes, check_env_wire_bytes)
 from .sched import Scheduler
 from .sched.election import FileLeaderElector
 from .state.store import Store
@@ -108,9 +109,24 @@ def build_scheduler_config(spec: Dict) -> Config:
             except re.error as exc:
                 raise ValueError(
                     f"invalid pool-regex {rx!r} in {conf_key}: {exc}")
+            _check_plane_wire_bytes(conf_key, value_key, val)
             table.append((rx, val))
         setattr(cfg, attr, table)
     return cfg
+
+
+def _check_plane_wire_bytes(conf_key: str, value_key: str, val) -> None:
+    """Fail the BOOT when a pool-default container/env embeds NUL or the
+    \\x1e wire separator — otherwise every job in the pool would be
+    refused at the transport guard (or 500 at submission), an opaque
+    failure for a purely operator-side mistake."""
+    try:
+        if value_key == "env":
+            check_env_wire_bytes(val)
+        elif value_key == "container":
+            check_container_wire_bytes(val)
+    except ApiError as exc:
+        raise ValueError(f"{conf_key}: {exc.message}") from exc
 
 
 def build_authenticators(conf: Dict) -> Optional[List]:

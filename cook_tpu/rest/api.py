@@ -224,27 +224,52 @@ DEFAULT_DOCKER_PARAMETERS_ALLOWED = (
     "env", "workdir", "label", "user", "entrypoint", "name")
 
 
+# C0 control characters (plus DEL) in docker parameter keys/values: the
+# agent wire format joins key=value pairs with \x1e and the agent splits on
+# it, so an embedded \x1e in an ALLOWLISTED parameter's value would inject
+# arbitrary extra runtime flags (e.g. ``privileged=``) past the allowlist.
+# No legitimate docker flag or value contains control characters.
+_CTRL_CHARS = re.compile(r"[\x00-\x1f\x7f]")
+
+
 def validate_docker_parameters(job: Job, tc) -> None:
     """Docker parameters are validated for EVERY submission (unlike the
     other task constraints, which an operator opts into): they compile to
     container-runtime flags on the agent, so an unvalidated key like
-    ``privileged`` would be a privilege escalation.  The operator's
+    ``privileged`` would be a privilege escalation.  Both the flat
+    ``container.parameters`` and nested ``container.docker.parameters``
+    forms are validated (backends read the flat form today, but an
+    unvalidated nested list must never sit in the store).  The operator's
     allowlist (tc.docker_parameters_allowed) replaces the conservative
     default when configured (reference: :docker-parameters-allowed,
     rest/api.clj + integration test_disallowed_docker_parameters)."""
     if not isinstance(job.container, dict):
         return
-    params = (job.container.get("parameters")
-              or (job.container.get("docker") or {}).get("parameters")
-              or [])
+    # the control-character rule (keys reject ALL control chars, values
+    # the wire-breaking bytes) has ONE home, check_container_wire_bytes —
+    # delegated here so a direct caller of this validator still gets it
+    check_container_wire_bytes(job.container)
+    flat = job.container.get("parameters") or []
+    docker = job.container.get("docker")
+    nested = (docker.get("parameters") or []) \
+        if isinstance(docker, dict) else []
+    # normalize_container aliases the nested list into the flat slot when
+    # only the nested form was submitted — skip the alias, validate both
+    # lists when they really are distinct
+    params = list(flat) + ([] if nested is flat else list(nested))
     allowed = set(tc.docker_parameters_allowed
                   if tc is not None and tc.docker_parameters_allowed
                   is not None else DEFAULT_DOCKER_PARAMETERS_ALLOWED)
-    bad = [p.get("key") for p in params
-           if isinstance(p, dict) and p.get("key") not in allowed]
-    if bad:
-        raise ApiError(400, "The following parameters are not "
-                            f"supported: {bad}")
+    if "*" not in allowed:
+        # ["*"] is the explicit allow-all opt-out restoring the reference's
+        # unconfigured behavior (rest/api.clj:1097 allows everything when
+        # no allowlist is set; here unset means the conservative default —
+        # see docs/DEPLOY.md).  Control characters stay rejected above.
+        bad = [p.get("key") for p in params
+               if isinstance(p, dict) and p.get("key") not in allowed]
+        if bad:
+            raise ApiError(400, "The following parameters are not "
+                                f"supported: {bad}")
     unvalued = [p.get("key") for p in params
                 if isinstance(p, dict) and p.get("key")
                 and not p.get("value")]
@@ -301,10 +326,93 @@ def normalize_container(raw) -> Optional[Dict]:
     return norm
 
 
+# NUL truncates at the native transport's C-string boundary (everything
+# after it in the marshaled channel is silently dropped) and \x1e is that
+# transport's intra-channel delimiter (an embedded one injects extra
+# env/volume entries).  Neither byte has a legitimate use in a job spec,
+# so they are rejected at submission with a 400 instead of surfacing as
+# an opaque launch failure per attempt.
+_WIRE_BREAKING = re.compile(r"[\x00\x1e]")
+
+
+def check_env_wire_bytes(env, what: str = "env variable") -> None:
+    """Shared by submitted env, operator pool-default env (at boot and at
+    merge), and any other KEY=VALUE channel that reaches the wire."""
+    for k, v in (env.items() if isinstance(env, dict) else ()):
+        if _WIRE_BREAKING.search(str(k)) or _WIRE_BREAKING.search(str(v)):
+            raise ApiError(400, f"{what} {k!r} contains NUL or "
+                                "field-separator control characters")
+
+
+def check_container_wire_bytes(container) -> None:
+    """Volumes, image, and docker parameters reach the \\x1e/NUL-sensitive
+    wire; used for both submitted containers and operator pool-default
+    containers (the latter attach after the per-spec pass).  Malformed
+    shapes are skipped here — the parse path's own type errors surface as
+    400 malformed-spec."""
+    if not isinstance(container, dict):
+        return
+    params = [*(container.get("parameters") or []),
+              *((container.get("docker") or {}).get("parameters") or []
+                if isinstance(container.get("docker"), dict) else [])]
+    for p in params:
+        # same rule validate_docker_parameters applies: keys reject ALL
+        # control characters (they compile to --key flags), values the
+        # wire-breaking bytes — so an operator default that would 400 a
+        # submitter is caught here (at boot / as a 500) first
+        if isinstance(p, dict) and (
+                _CTRL_CHARS.search(str(p.get("key") or ""))
+                or _WIRE_BREAKING.search(str(p.get("value") or ""))):
+            raise ApiError(400, "docker parameters must not contain "
+                                "control characters")
+    vols = container.get("volumes", [])
+    for v in (vols if isinstance(vols, (list, tuple)) else []):
+        # dict form ({"host-path", "container-path"}) is checked value by
+        # value — serializing it would escape the raw bytes out of reach
+        parts = [v] if isinstance(v, str) else \
+            [str(x) for x in v.values()] if isinstance(v, dict) else \
+            [str(v)]
+        if any(_WIRE_BREAKING.search(p) for p in parts):
+            raise ApiError(400, "container volumes must not contain NUL "
+                                "or field-separator control characters")
+    images = [container.get("image", ""),
+              (container.get("docker") or {}).get("image", "")
+              if isinstance(container.get("docker"), dict) else ""]
+    if any(_WIRE_BREAKING.search(str(i)) for i in images if i):
+        raise ApiError(400, "container image must not contain NUL or "
+                            "field-separator control characters")
+
+
+def _reject_wire_breaking_bytes(spec: Dict) -> None:
+    check_env_wire_bytes(spec.get("env"))
+    check_container_wire_bytes(spec.get("container"))
+    if _WIRE_BREAKING.search(str(spec.get("command", ""))):
+        raise ApiError(400, "command must not contain NUL or "
+                            "field-separator control characters")
+    for fld in ("uuid", "group", "name"):
+        # exported into the wire env (COOK_JOB_UUID/COOK_JOB_GROUP_UUID)
+        if _WIRE_BREAKING.search(str(spec.get(fld) or "")):
+            raise ApiError(400, f"{fld} must not contain NUL or "
+                                "field-separator control characters")
+    uris = spec.get("uris")
+    for u in (uris if isinstance(uris, (list, tuple)) else []):
+        # uri values splice into the wire command as the fetch prelude
+        val = u.get("value", "") if isinstance(u, dict) else u
+        if _WIRE_BREAKING.search(str(val)):
+            raise ApiError(400, "uri values must not contain NUL or "
+                                "field-separator control characters")
+    for fld in ("progress_output_file", "progress_regex_string"):
+        # exported into the wire env for the progress-tracking executor
+        if _WIRE_BREAKING.search(str(spec.get(fld) or "")):
+            raise ApiError(400, f"{fld} must not contain NUL or "
+                                "field-separator control characters")
+
+
 def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
     """Submission schema -> Job (reference: make-job-txn rest/api.clj:750)."""
     if "command" not in spec:
         raise ApiError(400, "job is missing command")
+    _reject_wire_breaking_bytes(spec)
     priority = int(spec.get("priority", 50))
     if not 0 <= priority <= 100:
         raise ApiError(400, "priority must be in [0, 100]")
@@ -535,11 +643,30 @@ class CookApi:
                         copy.deepcopy(default))
                     # the default was attached AFTER the per-spec
                     # validation pass — its parameters must clear the
-                    # same allowlist a direct submission would
+                    # same allowlist, and its image/volumes/parameters
+                    # the same wire-byte check, a direct submission
+                    # would.  Wire bytes FIRST so an operator typo reads
+                    # as the server error it is, not a submitter 400
+                    try:
+                        check_container_wire_bytes(job.container)
+                    except ApiError as exc:
+                        raise ApiError(
+                            500, "pool default container is "
+                                 f"misconfigured: {exc.message}")
                     validate_docker_parameters(
                         job, self.config.task_constraints)
             default_env = self.config.default_env_for_pool(job.pool)
             if default_env:
+                # same wire-byte rule the submitted env already cleared.
+                # Daemon boot refuses such config (_check_plane_wire_bytes);
+                # this guards programmatic Config mutation, and it is a
+                # SERVER error — the submitter's spec is clean
+                try:
+                    check_env_wire_bytes(default_env,
+                                         what="pool default env variable")
+                except ApiError as exc:
+                    raise ApiError(
+                        500, f"misconfigured: {exc.message}")
                 job.env = {**default_env, **job.env}  # job's values win
             if job.resources.gpus:
                 models = self.config.gpu_models_for_pool(job.pool)

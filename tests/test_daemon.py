@@ -259,3 +259,22 @@ def test_build_scheduler_config_task_constraints_and_planes():
     assert cfg.default_container_for_pool("p") == {"image": "i:1"}
     assert cfg.default_container_for_pool("other") is None
     assert cfg.gpu_models_for_pool("gpu-a") == ["a100"]
+
+
+def test_build_scheduler_config_refuses_wire_bytes_in_planes():
+    """A pool-default env/container embedding NUL or the \\x1e wire
+    separator fails the BOOT (like a bad pool-regex) — otherwise every
+    job in the pool would fail opaquely at launch time."""
+    import pytest
+    from cook_tpu.daemon import build_scheduler_config
+    with pytest.raises(ValueError, match="control characters"):
+        build_scheduler_config({"default_envs": [
+            {"pool-regex": ".*", "env": {"A": "x\x1eB=y"}}]})
+    with pytest.raises(ValueError, match="misconfigured|control"):
+        build_scheduler_config({"default_containers": [
+            {"pool-regex": ".*",
+             "container": {"image": "img\x00"}}]})
+    # clean planes still load
+    cfg = build_scheduler_config({"default_envs": [
+        {"pool-regex": ".*", "env": {"A": "line1\nline2"}}]})
+    assert cfg.default_env_for_pool("x") == {"A": "line1\nline2"}

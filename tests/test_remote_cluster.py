@@ -59,6 +59,35 @@ class TestAgentConnection:
         assert open(sandbox + "/stderr").read() == "err\n"
         conn.close()
 
+    def test_launch_refuses_wire_delimiter_in_fields(self, agent):
+        # the env/volume/docker-parameter channels are \x1e-joined on the
+        # wire and split agent-side: an embedded \x1e in any value would
+        # inject extra entries (e.g. a --privileged runtime flag) past
+        # REST validation, so the transport refuses the launch outright
+        conn = AgentConnection("127.0.0.1", agent.port)
+        try:
+            assert not conn.launch(
+                "t-evil-param", "true", 1, 64, image="img",
+                params=[{"key": "env", "value": "A=B\x1eprivileged="}])
+            assert not conn.launch(
+                "t-evil-env", "true", 1, 64,
+                env={"GOOD": "x\x1eBAD=y"})
+            assert not conn.launch(
+                "t-evil-vol", "true", 1, 64, image="img",
+                volumes=["/a:/b\x1e/etc:/host-etc"])
+            # NUL would truncate the C-string at the ctypes boundary,
+            # silently dropping everything marshaled after it
+            assert not conn.launch(
+                "t-evil-nul", "true", 1, 64,
+                env={"A": "x\x00"})
+            assert not conn.launch(
+                "t-evil-cmd", "echo hi\x00", 1, 64)
+            # clean launch still goes through on the same connection
+            assert conn.launch("t-clean", "true", 1, 64,
+                               env={"GOOD": "val"})
+        finally:
+            conn.close()
+
     def test_nonzero_exit_is_failed(self, agent):
         conn = AgentConnection("127.0.0.1", agent.port)
         conn.launch("t-bad", "exit 3", 1, 64)

@@ -925,6 +925,127 @@ class TestTaskConstraints:
         finally:
             server.stop()
 
+    def test_docker_parameters_reject_wire_delimiter_in_value(self):
+        # \x1e is the agent wire delimiter (launch joins key=value pairs
+        # on it; the agent splits and emits each as a --key value runtime
+        # flag).  An ALLOWLISTED key whose value embeds \x1e would inject
+        # arbitrary extra flags (--privileged) past the allowlist, so
+        # control characters are rejected unconditionally.
+        _store, server = self._system(docker_parameters_allowed=["env"])
+        try:
+            client = client_for(server)
+            evil = {"type": "docker",
+                    "docker": {"image": "img", "parameters": [
+                        {"key": "env", "value": "A=B\x1eprivileged="}]}}
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container=evil)
+            assert "control characters" in e.value.message
+            nl = {"type": "docker",
+                  "docker": {"image": "img", "parameters": [
+                      {"key": "env\n--privileged", "value": "x"}]}}
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container=nl)
+            assert "control characters" in e.value.message
+            # a multi-line VALUE on an allowlisted key is legitimate
+            # (keys stay strict; values only reject wire-breaking bytes)
+            ok = {"type": "docker",
+                  "docker": {"image": "img", "parameters": [
+                      {"key": "env", "value": "MSG=line1\nline2"}]}}
+            assert client.submit_one("x", container=ok)
+        finally:
+            server.stop()
+
+    def test_docker_parameters_both_forms_validated(self):
+        # flat container.parameters AND nested docker.parameters are both
+        # validated: a clean flat list must not shadow a disallowed key
+        # smuggled in the nested form
+        _store, server = self._system(docker_parameters_allowed=["user"])
+        try:
+            client = client_for(server)
+            both = {"type": "docker",
+                    "parameters": [{"key": "user", "value": "nobody"}],
+                    "docker": {"image": "img", "parameters": [
+                        {"key": "privileged", "value": "true"}]}}
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container=both)
+            assert "not supported" in e.value.message
+        finally:
+            server.stop()
+
+    def test_env_volumes_command_reject_wire_breaking_bytes(self):
+        # NUL truncates C-string marshaling on the native transport and
+        # \x1e is its channel delimiter: both get a 400 at submission
+        # instead of an opaque per-attempt launch failure
+        _store, server = self._system()
+        try:
+            client = client_for(server)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", env={"A": "v\x1eB=y"})
+            assert "env variable" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", env={"A\x00B": "v"})
+            assert "env variable" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container={
+                    "type": "docker",
+                    "docker": {"image": "img"},
+                    "volumes": ["/a:/b\x1e/etc:/host"]})
+            assert "volumes" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("echo hi\x00; rm -rf /")
+            assert "command" in e.value.message
+            # dict-form volumes are checked value by value (serializing
+            # would escape the raw bytes out of the regex's reach)
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container={
+                    "type": "docker", "docker": {"image": "img"},
+                    "volumes": [{"host-path": "/a\x1e/etc",
+                                 "container-path": "/b"}]})
+            assert "volumes" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", container={
+                    "type": "docker",
+                    "docker": {"image": "img\x1eevil"}})
+            assert "image" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x", uris=[{"value": "http://h/a\x1eb"}])
+            assert "uri values" in e.value.message
+            with pytest.raises(JobClientError) as e:
+                client.submit_one("x",
+                                  progress_regex_string="p\x1eEVIL=1")
+            assert "progress_regex_string" in e.value.message
+            # malformed shapes still get the parse path's 400, not a 500
+            with pytest.raises(JobClientError) as e:
+                client.submit([{"command": "x", "env": ["A=B"]}])
+            assert e.value.status == 400
+            # client-supplied uuid reaches the wire env as COOK_JOB_UUID
+            with pytest.raises(JobClientError) as e:
+                client.submit([{"command": "x",
+                                "uuid": "u\x1eEVIL=1"}])
+            assert "uuid" in e.value.message
+            # plain newlines/tabs in env stay legal (multi-line values)
+            assert client.submit_one("x", env={"A": "line1\nline2"})
+        finally:
+            server.stop()
+
+    def test_docker_parameters_star_allowlist_opt_out(self):
+        # ["*"] restores the reference's allow-all (rest/api.clj:1097
+        # behavior when unconfigured) — but control characters stay denied
+        _store, server = self._system(docker_parameters_allowed=["*"])
+        try:
+            client = client_for(server)
+            anyk = {"type": "docker",
+                    "docker": {"image": "img", "parameters": [
+                        {"key": "shm-size", "value": "1g"}]}}
+            assert client.submit_one("x", container=anyk)
+            evil = {"type": "docker",
+                    "docker": {"image": "img", "parameters": [
+                        {"key": "env", "value": "A\x1eprivileged="}]}}
+            with pytest.raises(JobClientError):
+                client.submit_one("x", container=evil)
+        finally:
+            server.stop()
+
     def test_uri_executable_and_extract_conflict(self, system):
         _store, _c, _s, server = system
         client = client_for(server)
