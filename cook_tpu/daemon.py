@@ -122,6 +122,10 @@ def _check_plane_wire_bytes(conf_key: str, value_key: str, val) -> None:
     failure for a purely operator-side mistake."""
     try:
         if value_key == "env":
+            if not isinstance(val, dict):
+                # check_env_wire_bytes skips non-dicts, but a list here
+                # would TypeError every submission to the pool — fail boot
+                raise ApiError(400, "env must be a map of VAR to value")
             check_env_wire_bytes(val)
         elif value_key == "container":
             check_container_wire_bytes(val)

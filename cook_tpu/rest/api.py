@@ -642,19 +642,18 @@ class CookApi:
                     job.container = normalize_container(
                         copy.deepcopy(default))
                     # the default was attached AFTER the per-spec
-                    # validation pass — its parameters must clear the
-                    # same allowlist, and its image/volumes/parameters
-                    # the same wire-byte check, a direct submission
-                    # would.  Wire bytes FIRST so an operator typo reads
-                    # as the server error it is, not a submitter 400
+                    # validation pass — it must clear the same wire-byte
+                    # and allowlist checks a direct submission would, but
+                    # ANY violation here is the operator's plane, not the
+                    # submitter's (clean) spec: surface every one as 500
                     try:
                         check_container_wire_bytes(job.container)
+                        validate_docker_parameters(
+                            job, self.config.task_constraints)
                     except ApiError as exc:
                         raise ApiError(
                             500, "pool default container is "
                                  f"misconfigured: {exc.message}")
-                    validate_docker_parameters(
-                        job, self.config.task_constraints)
             default_env = self.config.default_env_for_pool(job.pool)
             if default_env:
                 # same wire-byte rule the submitted env already cleared.
