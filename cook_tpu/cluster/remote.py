@@ -74,17 +74,8 @@ def compile_fetch_prelude(uris) -> str:
 
 
 def _build(target: Path, extra: List[str]) -> Optional[Path]:
-    if target.exists() and target.stat().st_mtime >= _SRC.stat().st_mtime:
-        return target
-    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-pthread", "-std=c++17", *extra, str(_SRC),
-             "-o", str(target)],
-            check=True, capture_output=True, timeout=180)
-        return target
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return None
+    from ..native.build import build_if_stale
+    return build_if_stale([_SRC, _SRC.parent / "framing.h"], target, extra)
 
 
 def build_agentd() -> Optional[Path]:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import os
 import signal
 import re
 import sys
@@ -208,6 +209,12 @@ class CookDaemon:
         self.api: Optional[CookApi] = None
         self.server: Optional[ApiServer] = None
         self.elector: Optional[FileLeaderElector] = None
+        # socket journal replication (state/replication.py): leader serves
+        # its local journal; standbys mirror it into THEIR local data_dir
+        self.repl_server = None
+        self.repl_follower = None
+        self._repl_stop = threading.Event()
+        self._repl_thread: Optional[threading.Thread] = None
 
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
@@ -230,9 +237,30 @@ class CookDaemon:
                       f"data_dir={self.data_dir!r} (HA state must live "
                       "on the shared path)", flush=True)
             self.data_dir = sd
+        # "replication": {...} — HA over SEPARATE node-local data dirs:
+        # the leader streams its journal to standbys over the native
+        # framed-TCP carrier (no shared filesystem; the Datomic
+        # networked-store slot, datomic.clj:79).  Mutually exclusive with
+        # shared_data_dir, which wins (both configured would double-apply).
+        self.repl_conf = dict(conf.get("replication") or {})
+        self.replication = bool(self.repl_conf) and not self.shared_data \
+            and bool(self.data_dir)
+        if self.repl_conf and self.shared_data:
+            print("cook_tpu: replication ignored (shared_data_dir wins)",
+                  flush=True)
+        if self.repl_conf and not self.shared_data and not self.data_dir:
+            # silently running a pure in-memory store while the operator
+            # believes sync replication protects the state would lose
+            # everything on the first restart
+            raise ValueError("replication requires a data_dir (the "
+                             "local journal to replicate)")
         if not self.data_dir:
             self.store = Store()
-        elif self.shared_data:
+        elif self.shared_data or self.replication:
+            # follower view until elected (replication: the native
+            # follower mirrors the leader's bytes into this same local
+            # dir; the election winner re-opens fenced in _on_leadership)
+            os.makedirs(self.data_dir, exist_ok=True)
             self.store = Store.replay_only(self.data_dir)
         else:
             self.store = Store.open(self.data_dir)
@@ -289,6 +317,37 @@ class CookDaemon:
                 on_leadership=self._on_leadership, on_loss=self._on_loss)
         self.api.elector = self.elector
         self.api.node_url = self.node_url
+        if self.replication:
+            if not conf.get("election_dir"):
+                # without an explicit SHARED election dir the elector
+                # falls back to the node-local data_dir: every node wins
+                # its own private election and promotes — split brain
+                # with zero mirroring, silently
+                raise ValueError(
+                    "replication requires an explicit election_dir "
+                    "(a path shared by every scheduler host — the "
+                    "election authority)")
+            if not hasattr(self.elector, "lock_path"):
+                # the replication address is published through the file
+                # elector's directory; proceeding would mean standbys
+                # never mirror while sync commits pass vacuously — the
+                # operator believes in durability that does not exist
+                raise ValueError(
+                    "replication requires the file-based elector "
+                    "(election_dir); the k8s-lease elector does not "
+                    "publish a replication address")
+            # build the native library NOW, outside any lock: the first
+            # ReplicationFollower/Server construction otherwise triggers
+            # a g++ compile (up to ~3 min) inside _lock, stalling a
+            # concurrent _on_leadership promotion for the whole build
+            from .state.replication import replication_available
+            if not replication_available():
+                raise ValueError(
+                    "replication requires the native toolchain "
+                    "(libcookrepl failed to build — see stderr)")
+            self._repl_thread = threading.Thread(
+                target=self._follow_leader_loop, daemon=True)
+            self._repl_thread.start()
         if not self.api_only:
             self.elector.campaign()
 
@@ -297,7 +356,9 @@ class CookDaemon:
         (reference: LeaderSelectorListener.takeLeadership mesos.clj:193)."""
         try:
             with self._lock:
-                if self.shared_data and self.data_dir:
+                if self.replication:
+                    self._promote_replicated()
+                elif self.shared_data and self.data_dir:
                     # take over the SHARED journal: claim the next epoch
                     # (fencing out the previous leader's late appends) and
                     # replay everything it committed, then serve queries
@@ -323,6 +384,90 @@ class CookDaemon:
             traceback.print_exc()
             self.exit_code = 1
             self._done.set()
+
+    def _promote_replicated(self) -> None:
+        """Become the leader of a socket-replicated deployment: stop
+        mirroring, re-open the LOCAL mirror fenced at the election epoch
+        (replaying every transaction the dead leader committed — sync
+        replication means the mirror has them all), then serve this
+        journal to the next generation of standbys.  The reference
+        equivalent is the new leader re-reading the networked store
+        (mesos.clj:153-328)."""
+        from .state.replication import ReplicationServer
+        if self.repl_follower is not None:
+            self.repl_follower.stop()
+            self.repl_follower = None
+        # Promotion gate (see assert_promotable): refusing raises into
+        # _on_leadership's failed-takeover path — exit nonzero, lock
+        # released, a synced peer wins instead.
+        from .state.replication import assert_promotable
+        assert_promotable(self.data_dir)
+        epoch = self.elector.epoch if self.elector is not None else None
+        self.store = Store.open(self.data_dir,
+                                epoch=epoch if epoch is not None
+                                else "auto", shared=False)
+        self.api.store = self.store
+        self.queue_limits.store = self.store
+        self.repl_server = ReplicationServer(
+            self.data_dir, int(self.repl_conf.get("listen_port", 0)))
+        self.store.attach_replication(
+            self.repl_server,
+            sync=bool(self.repl_conf.get("sync", True)),
+            timeout_s=float(self.repl_conf.get("ack_timeout_seconds", 5.0)),
+            min_followers=int(self.repl_conf.get("min_sync_followers", 0)))
+        self.api.repl_server = self.repl_server  # surfaced in GET /info
+        host = self.repl_conf.get("advertise_host") or self.host
+        self._publish_repl_addr(f"{host}:{self.repl_server.port}")
+        print(f"cook_tpu: replication leader serving "
+              f"{host}:{self.repl_server.port} "
+              f"(epoch {self.store._journal_epoch})", flush=True)
+
+    def _repl_addr_path(self) -> Optional[Path]:
+        lock = getattr(self.elector, "lock_path", None)
+        return Path(str(lock) + ".repl") if lock is not None else None
+
+    def _publish_repl_addr(self, addr: str) -> None:
+        path = self._repl_addr_path()
+        if path is None:
+            return
+        from .utils.fsatomic import write_atomic_text
+        write_atomic_text(str(path), addr)
+
+    def _follow_leader_loop(self) -> None:
+        """Standby side: keep a native follower mirroring whichever node
+        currently publishes the replication address (re-pointing on
+        failover), until this node is elected itself."""
+        from .state.replication import ReplicationFollower
+        current = None
+        while not self._repl_stop.is_set():
+            if self.elector is not None and self.elector.is_leader:
+                return  # _on_leadership owns (and stopped) the follower
+            path = self._repl_addr_path()
+            try:
+                addr = path.read_text().strip() if path else None
+            except OSError:
+                addr = None
+            if addr and addr != current:
+                try:
+                    with self._lock:
+                        if self.elector is not None \
+                                and self.elector.is_leader:
+                            return
+                        if self.repl_follower is not None:
+                            self.repl_follower.stop()
+                        host, _, port = addr.rpartition(":")
+                        self.repl_follower = ReplicationFollower(
+                            host, int(port), self.data_dir)
+                        current = addr
+                except Exception as e:
+                    # a transient native-build failure or malformed
+                    # address must not kill the standby's only mirror
+                    # thread for the life of the process (sync commits
+                    # would pass vacuously with zero mirrors) — log and
+                    # retry on the next tick
+                    print(f"cook_tpu: replication follower for {addr!r} "
+                          f"failed ({e}); retrying", file=sys.stderr)
+            self._repl_stop.wait(0.5)
 
     def _on_loss(self) -> None:
         """Leadership lost -> exit nonzero; the supervisor restarts us
@@ -357,12 +502,24 @@ class CookDaemon:
                             shutdown()
                         except Exception:
                             pass
+        self._repl_stop.set()
+        if self._repl_thread is not None:
+            self._repl_thread.join(timeout=2.0)
+        if self.repl_follower is not None:
+            self.repl_follower.stop()
+            self.repl_follower = None
         if self.elector is not None:
             # resign AFTER scheduler stop; suppress on_loss (clean exit)
             self.elector.on_loss = None
             self.elector.resign()
         if self.server is not None:
             self.server.stop()
+        if self.repl_server is not None:
+            # after the final checkpoint would be better still, but
+            # followers full-resync on reconnect anyway; stop last so
+            # late acks don't block scheduler shutdown above
+            self.repl_server.stop()
+            self.repl_server = None
         if self.store is not None and self.data_dir:
             try:
                 self.store.checkpoint()

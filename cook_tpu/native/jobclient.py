@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import ctypes
 import json
-import subprocess
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -31,17 +30,9 @@ _STATUS_CB_T = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_char_p,
 
 
 def _build_library() -> Optional[Path]:
-    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _LIB
-    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
-             str(_SRC), "-o", str(_LIB)],
-            check=True, capture_output=True, timeout=120)
-        return _LIB
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return None
+    from .build import build_if_stale
+    return build_if_stale([_SRC], _LIB, ["-shared", "-fPIC"],
+                          timeout_s=120)
 
 
 _lib_handle = None

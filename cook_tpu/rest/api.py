@@ -1216,9 +1216,20 @@ class CookApi:
 
     def info(self) -> Dict:
         from .. import __version__
-        return {"version": __version__, "leader": self.scheduler is not None,
-                "authentication-scheme": "open",
-                "start-up-time": 0}
+        out = {"version": __version__,
+               "leader": self.scheduler is not None,
+               "authentication-scheme": "open",
+               "start-up-time": 0}
+        rs = getattr(self, "repl_server", None)
+        if rs is not None:
+            # socket-replication leader: operators (and failover tests)
+            # need to see when a standby's mirror is actually synced —
+            # the no-loss guarantee only covers commits made after that
+            out["replication"] = {"port": rs.port,
+                                  "followers": rs.follower_count,
+                                  "synced_followers":
+                                      rs.synced_follower_count}
+        return out
 
     def swagger_docs(self) -> Dict:
         """Machine-readable API description (reference: the swagger-docs

@@ -27,6 +27,10 @@ from typing import Callable, Optional
 class LeaderElector:
     """Interface: campaign, observe, resign."""
 
+    #: monotonic election epoch minted at acquisition when the elector
+    #: supports it (None otherwise; the store falls back to "auto")
+    epoch = None
+
     def campaign(self) -> None:
         raise NotImplementedError
 
@@ -167,6 +171,7 @@ class FileLeaderElector(LeaderElector):
                  poll_interval_s: float = 0.2):
         self.lock_path = Path(lock_path)
         self.url_path = Path(str(lock_path) + ".leader")
+        self.epoch_path = Path(str(lock_path) + ".epoch")
         self.node_url = node_url
         self.on_leadership = on_leadership
         self.on_loss = on_loss
@@ -175,6 +180,11 @@ class FileLeaderElector(LeaderElector):
         self._leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # monotonic ELECTION EPOCH, minted under the exclusive lock on
+        # every acquisition: the fencing authority for journal records in
+        # the separate-directory (socket replication) topology, where a
+        # node-local epoch file cannot order two hosts' claims.
+        self.epoch: Optional[int] = None
 
     # ------------------------------------------------------------- campaign
     def campaign(self) -> None:
@@ -198,6 +208,12 @@ class FileLeaderElector(LeaderElector):
             os.close(fd)
             return False
         self._fd = fd
+        # durable counter (fsync before rename): a power loss must not
+        # regress it, or two leaderships would mint the SAME fencing
+        # epoch and stale-record skipping could no longer order them
+        from ..utils.fsatomic import read_int_file, write_atomic_int
+        self.epoch = (read_int_file(str(self.epoch_path), 0) or 0) + 1
+        write_atomic_int(str(self.epoch_path), self.epoch)
         self.url_path.write_text(self.node_url)
         return True
 

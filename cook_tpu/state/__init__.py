@@ -25,6 +25,7 @@ from .schema import (  # noqa: F401
     now_ms,
     to_json,
 )
-from .store import AbortTransaction, StaleEpochError, Store, TxEvent  # noqa: F401
+from .store import (AbortTransaction, ReplicationTimeout,  # noqa: F401
+                    StaleEpochError, Store, TxEvent)
 from .index import ColumnarIndex  # noqa: F401
 from . import machines  # noqa: F401

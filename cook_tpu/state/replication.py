@@ -1,0 +1,218 @@
+"""Socket journal replication: ctypes surface over ``native/repl.cpp``.
+
+The reference framework's durable state is an out-of-process NETWORKED
+store (Datomic — ``/root/reference/scheduler/src/cook/datomic.clj:79``), so
+its leader failover works from any host: the new leader just re-reads
+(``/root/reference/scheduler/src/cook/mesos.clj:153-328``).  cook_tpu's
+:class:`~cook_tpu.state.store.Store` journals to a local directory; this
+module streams that journal (and its compaction snapshots) to follower
+processes over framed TCP so a follower holds a byte-identical mirror in
+its OWN directory — no shared filesystem — and can promote with zero lost
+committed transactions.
+
+Roles:
+
+- :class:`ReplicationServer` — runs in the leader next to an open store;
+  tails ``<dir>/journal.jsonl``.  ``wait_acked(offset)`` blocks until every
+  connected follower has fsynced through ``offset`` (sync replication: the
+  store calls it per commit via ``Store.attach_replication``).
+- :class:`ReplicationFollower` — runs in a standby; mirrors the leader's
+  snapshot + journal bytes into a separate local directory.  Promotion is
+  ``Store.open(local_dir, epoch=...)`` on that mirror; the journal records
+  carry their election epochs, so the store's existing stale-epoch replay
+  skipping applies unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+_NATIVE = Path(__file__).resolve().parent.parent.parent / "native"
+_SRC = _NATIVE / "repl.cpp"
+_LIB = _NATIVE / "build" / "libcookrepl.so"
+
+_lib_handle = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib_handle, _lib_tried
+    if _lib_tried:
+        return _lib_handle
+    _lib_tried = True
+    from ..native.build import build_if_stale
+    if build_if_stale([_SRC, _NATIVE / "framing.h"], _LIB,
+                      ["-shared", "-fPIC"]) is None:
+        return None
+    lib = ctypes.CDLL(str(_LIB))
+    lib.crp_serve.restype = ctypes.c_void_p
+    lib.crp_serve.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.crp_port.argtypes = [ctypes.c_void_p]
+    lib.crp_follower_count.argtypes = [ctypes.c_void_p]
+    lib.crp_synced_count.argtypes = [ctypes.c_void_p]
+    lib.crp_poke.argtypes = [ctypes.c_void_p]
+    lib.crp_wait_acked.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                   ctypes.c_int]
+    lib.crp_min_acked.restype = ctypes.c_longlong
+    lib.crp_min_acked.argtypes = [ctypes.c_void_p]
+    lib.crp_stop.argtypes = [ctypes.c_void_p]
+    lib.crf_follow.restype = ctypes.c_void_p
+    lib.crf_follow.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                               ctypes.c_char_p]
+    lib.crf_connected.argtypes = [ctypes.c_void_p]
+    lib.crf_offset.restype = ctypes.c_longlong
+    lib.crf_offset.argtypes = [ctypes.c_void_p]
+    lib.crf_stop.argtypes = [ctypes.c_void_p]
+    _lib_handle = lib
+    return lib
+
+
+def replication_available() -> bool:
+    return _load() is not None
+
+
+def assert_promotable(directory: str) -> None:
+    """Refuse to promote a mirror that BEGAN following (``repl_token``)
+    but never reached the leader's head (no ``repl_synced`` marker —
+    fresh catch-up or mid-resync): opening it as the new authority would
+    discard commits the dead leader confirmed on its synced peers' acks.
+    A never-followed directory (no token) is cluster genesis and allowed.
+
+    Residual (documented in DEPLOY.md): a mirror that synced ONCE and
+    then lagged offline keeps its marker — ordering two once-synced
+    candidates by log position needs quorum election (Raft's vote
+    comparison), which the file elector cannot express.  Operators
+    needing strict no-loss run ``min_sync_followers >= 1``."""
+    d = Path(directory)
+    began_following = (d / "repl_token").exists() \
+        or (d / "repl_following").exists()
+    if began_following and not (d / "repl_synced").exists():
+        raise RuntimeError(
+            "refusing promotion: this node's mirror never reached the "
+            "previous leader's head (mid-catch-up); a synced peer must "
+            "take over")
+
+
+class ReplicationServer:
+    """Leader side: serve ``directory``'s journal to followers.
+
+    Every native call holds ``_mu``: ``stop()`` frees the C++ object, and
+    freeing it while another thread sits inside ``crp_wait_acked`` (a
+    committer blocked up to the ack timeout) would destroy the mutex and
+    condvar under a waiter — the lock makes stop() wait them out."""
+
+    def __init__(self, directory: str, port: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native replication library unavailable "
+                               "(g++ missing or build failed — see "
+                               "stderr)")
+        self._lib = lib
+        self._mu = threading.Lock()
+        self._handle = lib.crp_serve(str(directory).encode(), int(port))
+        if not self._handle:
+            raise RuntimeError(f"could not serve replication on port "
+                               f"{port}")
+        self.directory = str(directory)
+        self.port = lib.crp_port(self._handle)
+
+    @property
+    def follower_count(self) -> int:
+        with self._mu:
+            return self._lib.crp_follower_count(self._handle) \
+                if self._handle else 0
+
+    @property
+    def synced_follower_count(self) -> int:
+        """Followers whose mirror has reached the journal head at least
+        once — the set that participates in sync-commit acks.  The
+        no-loss guarantee covers commits made after this is ≥ 1."""
+        with self._mu:
+            return self._lib.crp_synced_count(self._handle) \
+                if self._handle else 0
+
+    def poke(self) -> None:
+        """Wake follower streams after a journal append."""
+        with self._mu:
+            if self._handle:
+                self._lib.crp_poke(self._handle)
+
+    def wait_acked(self, offset: int, timeout_s: float = 5.0) -> bool:
+        """True once every synced follower fsynced through ``offset``
+        (vacuously true with none), False on timeout."""
+        with self._mu:
+            if not self._handle:  # stopped server: nothing to wait for
+                return True
+            return bool(self._lib.crp_wait_acked(
+                self._handle, int(offset), int(timeout_s * 1000)))
+
+    def min_acked(self) -> int:
+        """Lowest synced-follower ack offset, -1 when none."""
+        with self._mu:
+            return int(self._lib.crp_min_acked(self._handle)) \
+                if self._handle else -1
+
+    def stop(self) -> None:
+        with self._mu:
+            if self._handle:
+                self._lib.crp_stop(self._handle)
+                self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ReplicationFollower:
+    """Standby side: mirror a leader's journal into ``directory``."""
+
+    def __init__(self, host: str, port: int, directory: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native replication library unavailable "
+                               "(g++ missing or build failed — see "
+                               "stderr)")
+        self._lib = lib
+        self._mu = threading.Lock()
+        self._handle = lib.crf_follow(host.encode(), int(port),
+                                      str(directory).encode())
+        self.directory = str(directory)
+
+    @property
+    def connected(self) -> bool:
+        with self._mu:
+            return bool(self._handle
+                        and self._lib.crf_connected(self._handle))
+
+    @property
+    def offset(self) -> int:
+        with self._mu:
+            return int(self._lib.crf_offset(self._handle)) \
+                if self._handle else -1
+
+    def wait_offset(self, offset: int, timeout_s: float = 10.0) -> bool:
+        """Wait until the local mirror reaches ``offset`` journal bytes."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.offset >= offset:
+                return True
+            time.sleep(0.002)
+        return self.offset >= offset
+
+    def stop(self) -> None:
+        with self._mu:
+            if self._handle:
+                self._lib.crf_stop(self._handle)
+                self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

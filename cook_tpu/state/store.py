@@ -58,6 +58,12 @@ class StaleEpochError(RuntimeError):
     fenced at a higher election epoch."""
 
 
+class ReplicationTimeout(RuntimeError):
+    """Sync replication could not confirm the transaction on the
+    follower(s) in time; the local journal record is excised and the
+    transaction aborted — "committed" always implies "on the mirror"."""
+
+
 class AbortTransaction(Exception):
     """Raised inside a transaction to roll back all of its writes."""
 
@@ -199,6 +205,19 @@ class Store:
         self._journal_epoch: Optional[int] = None
         self._epoch_path: Optional[str] = None
         self._epoch_stat: Optional[Tuple[int, int]] = None
+        # socket journal replication (state/replication.py): when attached
+        # with sync=True, a transaction only commits once every connected
+        # follower fsynced its journal record (networked-durability slot,
+        # reference: datomic.clj:79 out-of-process store)
+        self._repl_server = None
+        self._repl_sync = False
+        self._repl_timeout_s = 5.0
+        self._repl_min_followers = 0
+        # True when the journal DIRECTORY is shared between leader hosts
+        # (r4 topology: fencing protects concurrent appenders).  False for
+        # a local fenced journal in the replication topology, where a
+        # failed append may safely truncate (no concurrent appender).
+        self._journal_shared = True
 
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
@@ -265,16 +284,54 @@ class Store:
             f.flush()
             if self._journal_fsync:
                 os.fsync(f.fileno())
+            if self._repl_server is not None:
+                # sync replication: commit = fsynced on every connected
+                # follower.  Raising here (inside the try) excises the
+                # local record and aborts the transaction, so a client
+                # never sees "committed" for a record the mirror lacks.
+                # A truncated record a follower DID receive diverges its
+                # tail — the server detects pos > journal size on its
+                # next pass and full-resyncs that follower.
+                self._repl_server.poke()
+                if self._repl_sync:
+                    if (self._repl_min_followers > 0 and
+                            self._repl_server.synced_follower_count
+                            < self._repl_min_followers):
+                        # SYNCED followers: one mid-catch-up neither acks
+                        # nor counts, else the CP gate would pass while
+                        # wait_acked ignores it (vacuous durability)
+                        raise ReplicationTimeout(
+                            f"{self._repl_server.synced_follower_count} "
+                            "synced follower(s) < required "
+                            f"{self._repl_min_followers}")
+                    if not self._repl_server.wait_acked(
+                            f.tell(), self._repl_timeout_s):
+                        raise ReplicationTimeout(
+                            "followers did not ack within "
+                            f"{self._repl_timeout_s}s")
+                    if (self._repl_min_followers > 0 and
+                            self._repl_server.synced_follower_count
+                            < self._repl_min_followers):
+                        # re-check AFTER the wait: a follower dying
+                        # between the gate and the ack makes wait_acked
+                        # pass vacuously (empty quorum) — that must not
+                        # count as a confirmed CP commit
+                        raise ReplicationTimeout(
+                            "follower lost during ack wait; quorum "
+                            f"below {self._repl_min_followers}")
         except Exception:
             try:
-                if self._journal_epoch is not None:
+                if self._journal_epoch is not None and self._journal_shared:
                     # SHARED journal: our tell() may be stale (a successor
                     # could have appended past it) — truncating would chop
                     # its records.  Poison instead; replay's torn-tail and
                     # stale-epoch handling repair the file on next open.
+                    # (A LOCAL fenced journal — the replication topology —
+                    # has no concurrent appender, so truncation is safe.)
                     raise OSError("fenced journal: no truncate")
                 f.seek(good_offset)
                 f.truncate(good_offset)
+                self._bump_journal_gen()
             except Exception:
                 # can't excise the torn fragment: poison the journal so no
                 # later record can be appended after it
@@ -285,6 +342,19 @@ class Store:
                 except Exception:
                     pass
             raise
+
+    def _bump_journal_gen(self) -> None:
+        """Advance ``<dir>/journal_gen`` after ANY journal truncation.
+        The replication server folds this counter into its mirror-base
+        token, so a truncate-then-reappend (an excised aborted record
+        replaced by a later commit of equal byte length) forces followers
+        to full-resync instead of silently accepting diverged bytes at
+        the same offset."""
+        if not self._journal_dir:
+            return
+        from ..utils.fsatomic import read_int_file, write_atomic_int
+        path = os.path.join(self._journal_dir, "journal_gen")
+        write_atomic_int(path, (read_int_file(path, 0) or 0) + 1)
 
     def _drain_events(self) -> None:
         """Deliver queued events in commit order. Whoever holds _notify_lock
@@ -864,12 +934,8 @@ class Store:
             raise StaleEpochError(
                 f"journal dir fenced at epoch {current} > claimed {epoch}")
         if epoch > current:
-            tmp = self._epoch_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(str(epoch))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._epoch_path)
+            from ..utils.fsatomic import write_atomic_int
+            write_atomic_int(self._epoch_path, epoch)
         st = os.stat(self._epoch_path)
         self._epoch_stat = (st.st_mtime_ns, st.st_ino)
         self._journal_epoch = epoch
@@ -885,9 +951,26 @@ class Store:
             self._journal_fsync = fsync
             self._journal_file = open(path, "a", encoding="utf-8")
 
+    def attach_replication(self, server, sync: bool = True,
+                           timeout_s: float = 5.0,
+                           min_followers: int = 0) -> None:
+        """Stream this store's journal to followers via a running
+        :class:`~cook_tpu.state.replication.ReplicationServer` over the
+        native framed-TCP carrier.  With ``sync`` (the default), a
+        transaction only commits after every connected follower fsynced
+        its record — :class:`ReplicationTimeout` aborts it otherwise.
+        ``min_followers`` > 0 additionally refuses commits when fewer
+        followers are connected (CP mode; the default 0 keeps a lone
+        leader available, like the reference's single transactor)."""
+        with self._lock:
+            self._repl_server = server
+            self._repl_sync = sync
+            self._repl_timeout_s = timeout_s
+            self._repl_min_followers = min_followers
+
     @classmethod
     def open(cls, directory: str, fsync: bool = False,
-             epoch=None) -> "Store":
+             epoch=None, shared: bool = True) -> "Store":
         """Open a durable store rooted at ``directory`` (snapshot.json +
         journal.jsonl): load the snapshot if present, replay the journal,
         resume appending. The equivalent of a new leader re-reading Datomic
@@ -899,7 +982,12 @@ class Store:
         stale-epoch records interleaved by a deposed leader are skipped
         during replay, and every future append re-checks the fence — a
         paused-then-woken old leader gets StaleEpochError instead of
-        corrupting the successor's journal."""
+        corrupting the successor's journal.
+
+        ``shared=False`` marks a fenced journal whose DIRECTORY is
+        node-local (the socket-replication topology, where epochs come
+        from the shared election authority instead): failed appends may
+        then safely truncate, since no other process appends to it."""
         os.makedirs(directory, exist_ok=True)
         snap_path = os.path.join(directory, "snapshot.json")
         journal_path = os.path.join(directory, "journal.jsonl")
@@ -915,6 +1003,7 @@ class Store:
             if good < size:
                 with open(journal_path, "r+b") as f:
                     f.truncate(good)
+                store._bump_journal_gen()
             store.attach_journal(journal_path, fsync=fsync)
             return store
         # SHARED-dir takeover. Order matters:
@@ -925,6 +1014,7 @@ class Store:
         # record, so every future replay skips it; records that raced in
         # BEFORE the barrier are replayed by us and by every successor
         # alike, so all leaders agree on the committed prefix.
+        store._journal_shared = shared
         store._claim_epoch(directory, epoch)
         _records, good, size = _scan_journal(journal_path)
         if good < size:
@@ -932,6 +1022,7 @@ class Store:
             # every future replay there — excise it first
             with open(journal_path, "r+b") as f:
                 f.truncate(good)
+            store._bump_journal_gen()
         store.attach_journal(journal_path, fsync=fsync)
         store._journal_file.write(json.dumps(
             {"ep": store._journal_epoch, "barrier": True}) + "\n")

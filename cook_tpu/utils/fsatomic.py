@@ -1,0 +1,51 @@
+"""Atomic durable small-file writes shared by the fencing machinery.
+
+Three components persist a monotonic counter with identical durability
+needs — the store's shared-dir epoch claim, the elector's election-epoch
+mint, and the journal write-generation bump.  One implementation keeps
+the ordering rule (write temp → flush → fsync → rename) in one place.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def read_int_file(path: str, default: Optional[int] = None
+                  ) -> Optional[int]:
+    """The integer in ``path``, or ``default`` when missing/corrupt."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return default
+
+
+def write_atomic_text(path: str, text: str) -> None:
+    """Durably replace ``path`` with ``text``: temp file, fsync, rename,
+    fsync of the containing directory.  A power loss leaves either the
+    old or the new content, never a torn or REGRESSED one — POSIX does
+    not guarantee the rename itself survives power loss without the
+    directory fsync."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; best effort
+
+
+def write_atomic_int(path: str, value: int) -> None:
+    """:func:`write_atomic_text` for the monotonic counters (election
+    epochs, journal generations) that must never regress across
+    crashes."""
+    write_atomic_text(path, str(value))

@@ -1,0 +1,90 @@
+// Shared wire framing for the native runtime components (scheduler<->agent
+// transport in transport.cpp, leader->follower journal replication in
+// repl.cpp): frame = u32_be payload_len, payload = repeated (u32_be
+// field_len + field_bytes).  Length-prefixed fields mean field CONTENT is
+// never interpreted by the framing layer — no delimiter can be injected
+// through it.  (Reference analog: the libmesos protobuf codec the
+// scheduler driver rode on, mesos_compute_cluster.clj:206-238.)
+#ifndef COOK_NATIVE_FRAMING_H_
+#define COOK_NATIVE_FRAMING_H_
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace cook_framing {
+
+constexpr uint32_t kMaxFrame = 16u * 1024 * 1024;
+
+inline bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return false;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline void put_u32(std::string* out, uint32_t v) {
+  uint32_t be = htonl(v);
+  out->append(reinterpret_cast<const char*>(&be), 4);
+}
+
+inline bool send_frame(int fd, const std::vector<std::string>& fields) {
+  std::string payload;
+  for (const auto& f : fields) {
+    put_u32(&payload, static_cast<uint32_t>(f.size()));
+    payload += f;
+  }
+  std::string frame;
+  put_u32(&frame, static_cast<uint32_t>(payload.size()));
+  frame += payload;
+  return write_exact(fd, frame.data(), frame.size());
+}
+
+inline bool recv_frame(int fd, std::vector<std::string>* fields) {
+  uint32_t len_be = 0;
+  if (!read_exact(fd, &len_be, 4)) return false;
+  uint32_t len = ntohl(len_be);
+  if (len > kMaxFrame) return false;
+  std::string payload(len, '\0');
+  if (len > 0 && !read_exact(fd, &payload[0], len)) return false;
+  fields->clear();
+  size_t off = 0;
+  while (off + 4 <= payload.size()) {
+    uint32_t flen = ntohl(*reinterpret_cast<const uint32_t*>(&payload[off]));
+    off += 4;
+    if (off + flen > payload.size()) return false;
+    fields->emplace_back(payload.substr(off, flen));
+    off += flen;
+  }
+  return off == payload.size();
+}
+
+}  // namespace cook_framing
+
+#endif  // COOK_NATIVE_FRAMING_H_

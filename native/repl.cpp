@@ -1,0 +1,679 @@
+// Native journal replication: leader->follower log shipping over framed
+// TCP, the framework's networked-state slot.  The reference gets durable
+// cross-host state for free from an out-of-process networked store
+// (Datomic, scheduler/src/cook/datomic.clj:79) so a standby leader on any
+// host re-reads everything after failover (mesos.clj:153-328).  cook_tpu's
+// store journals to a LOCAL directory; this component streams that journal
+// (and its compaction snapshots) to follower processes on other hosts so a
+// follower can promote with zero lost committed transactions and NO shared
+// filesystem.
+//
+// One source file, one artifact:
+//   libcookrepl.so  (g++ -shared -fPIC ...)  — ctypes C API, both roles:
+//     leader:   crp_serve(dir, port) tails <dir>/journal.jsonl and serves
+//               every connected follower; crp_wait_acked() lets the store
+//               block a commit until all connected followers fsynced it
+//               (sync replication: "committed" implies "on the follower").
+//     follower: crf_follow(host, port, dir) mirrors the leader's snapshot
+//               + journal bytes into a SEPARATE local directory, fsyncing
+//               before each ack; Store.open/replay_only of that directory
+//               is then the promoted/replica view.
+//
+// Wire protocol (framing.h frames; field[0] = type):
+//   follower -> leader: HELLO(token, offset)   token = leader snapshot
+//                         identity the follower last mirrored ("none" when
+//                         it has no snapshot); offset = bytes of journal
+//                         already mirrored (truncated to a record
+//                         boundary).
+//                       ACK(offset)            journal bytes through
+//                         `offset` are fsynced on the follower.
+//   leader -> follower: RESET(token, snapshot) full resync: replace the
+//                         local snapshot (empty = delete), truncate the
+//                         local journal, remember `token`.  Sent when the
+//                         tokens differ (leader checkpointed) or the
+//                         follower is ahead (diverged tail).
+//                       JDATA(chunk)           raw journal bytes appended
+//                         at the follower's current offset.
+//
+// Epoch fencing composes with the store's journal records ("ep" field):
+// the bytes are mirrored verbatim, so a follower that promotes replays
+// with the same stale-epoch skipping the shared-dir path uses
+// (state/store.py _replay_records).
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "framing.h"
+
+namespace {
+
+using cook_framing::recv_frame;
+using cook_framing::send_frame;
+
+constexpr size_t kChunk = 1u << 20;  // 1 MiB per JDATA frame
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return "";
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int64_t file_size(const std::string& path) {
+  struct stat st;
+  if (::stat(path.c_str(), &st) != 0) return -1;
+  return static_cast<int64_t>(st.st_size);
+}
+
+// Mirror-base identity: the leader's snapshot.json (mtime_ns:size — the
+// compaction generation) PLUS the journal write-generation counter the
+// store bumps on every leader-side truncation (journal_gen).  A follower
+// whose mirrored token differs must full-resync: its byte offset is
+// meaningless against a new snapshot, and after a truncate-then-reappend
+// the same offset can hold DIFFERENT bytes (an excised aborted record
+// replaced by a later commit of equal length), which a position-only
+// check would silently accept.
+std::string snapshot_token(const std::string& dir) {
+  struct stat st;
+  std::string path = dir + "/snapshot.json";
+  std::ostringstream ss;
+  if (::stat(path.c_str(), &st) != 0) {
+    ss << "none";
+  } else {
+    ss << static_cast<long long>(st.st_mtim.tv_sec) << "."
+       << st.st_mtim.tv_nsec << ":" << static_cast<long long>(st.st_size);
+  }
+  std::string gen = read_file(dir + "/journal_gen");
+  ss << "/g" << (gen.empty() ? "0" : gen);
+  return ss.str();
+}
+
+bool write_file_sync(const std::string& path, const std::string& data) {
+  std::string tmp = path + ".tmp";
+  int fd = ::open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  bool ok = cook_framing::write_exact(fd, data.data(), data.size());
+  if (ok) ok = (::fsync(fd) == 0);
+  ::close(fd);
+  if (!ok) return false;
+  return ::rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+// ------------------------------------------------------------------ leader
+
+struct ReplServer {
+  std::string dir;
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::condition_variable cv;       // signaled on poke + follower acks
+  int64_t next_conn_id = 1;
+  struct Conn {
+    int fd = -1;
+    int64_t acked = 0;
+    // a follower only participates in sync-commit acks once its mirror
+    // has caught up to the journal head — otherwise bringing up a fresh
+    // standby (minutes of catch-up) would time out every live commit
+    bool synced = false;
+  };
+  std::map<int64_t, Conn> conns;
+  std::atomic<int> active_workers{0};  // crp_stop waits for these
+
+  std::string journal_path() const { return dir + "/journal.jsonl"; }
+};
+
+// Stream the snapshot file in bounded SDATA frames (a single frame would
+// hit recv_frame's kMaxFrame cap once state outgrows 16 MiB):
+//   RESET(token, total_size | "-1" for no-snapshot), SDATA*, SDONE.
+bool send_reset(ReplServer* s, int fd, std::string* token, int64_t* pos) {
+  *token = snapshot_token(s->dir);
+  std::string snap_path = s->dir + "/snapshot.json";
+  int64_t size = file_size(snap_path);
+  {
+    std::ostringstream ss;
+    ss << size;
+    if (!send_frame(fd, {"RESET", *token, ss.str()})) return false;
+  }
+  if (size > 0) {
+    int sfd = ::open(snap_path.c_str(), O_RDONLY);
+    if (sfd < 0) return false;
+    int64_t at = 0;
+    std::string chunk;
+    while (at < size) {
+      size_t want = static_cast<size_t>(
+          std::min<int64_t>(size - at, kChunk));
+      chunk.resize(want);
+      ssize_t got = ::pread(sfd, &chunk[0], want, static_cast<off_t>(at));
+      if (got <= 0) {
+        ::close(sfd);
+        return false;
+      }
+      chunk.resize(static_cast<size_t>(got));
+      if (!send_frame(fd, {"SDATA", chunk})) {
+        ::close(sfd);
+        return false;
+      }
+      at += got;
+    }
+    ::close(sfd);
+  }
+  if (!send_frame(fd, {"SDONE"})) return false;
+  std::vector<std::string> fields;
+  if (!recv_frame(fd, &fields) || fields.empty() || fields[0] != "ACK")
+    return false;
+  *pos = 0;
+  return true;
+}
+
+void serve_follower_inner(ReplServer* s, int fd, int64_t id) {
+  std::vector<std::string> fields;
+  int64_t pos = 0;
+  std::string token = snapshot_token(s->dir);
+  bool need_reset = true;
+  if (!recv_frame(fd, &fields) || fields.size() < 3 ||
+      fields[0] != "HELLO")
+    return;
+  {
+    int64_t offs = ::atoll(fields[2].c_str());
+    int64_t jsize = file_size(s->journal_path());
+    if (jsize < 0) jsize = 0;
+    if (fields[1] == token && offs <= jsize) {
+      pos = offs;            // incremental catch-up from where it left off
+      need_reset = false;
+      bool at_head = (pos == jsize);
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->conns.find(id);
+        if (it != s->conns.end()) {
+          // bytes through `pos` are already fsynced over there; a fully
+          // caught-up reconnector participates in sync acks immediately
+          it->second.acked = pos;
+          it->second.synced = at_head;
+        }
+      }
+      s->cv.notify_all();
+      if (at_head) {
+        // re-send HEAD: the previous connection may have dropped after
+        // this follower synced but before its marker write landed — a
+        // synced-but-unmarked mirror would refuse promotion forever
+        if (!send_frame(fd, {"HEAD"})) return;
+      }
+    }
+  }
+  while (!s->stopping.load()) {
+    if (need_reset) {
+      if (!send_reset(s, fd, &token, &pos)) return;
+      need_reset = false;
+      continue;
+    }
+    int64_t jsize = file_size(s->journal_path());
+    if (jsize < 0) jsize = 0;
+    if (jsize < pos || snapshot_token(s->dir) != token) {
+      // the journal shrank (checkpoint truncation / excised record), or
+      // the snapshot or write-generation moved: this follower's base is
+      // stale — full resync.  Its synced/acked state must be
+      // invalidated IMMEDIATELY: a stale acked offset would let
+      // crp_wait_acked confirm a commit "on the mirror" while that
+      // mirror is being wiped, and a stale synced flag would make every
+      // commit during a long resync time out (abort -> gen bump ->
+      // resync restart livelock).
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->conns.find(id);
+        if (it != s->conns.end()) {
+          it->second.synced = false;
+          it->second.acked = 0;
+        }
+      }
+      s->cv.notify_all();
+      need_reset = true;
+      continue;
+    }
+    if (jsize == pos) {
+      bool newly_synced = false;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->conns.find(id);
+        if (it != s->conns.end() && !it->second.synced) {
+          it->second.synced = true;  // caught up: joins the ack quorum
+          it->second.acked = pos;
+          newly_synced = true;
+        }
+      }
+      s->cv.notify_all();
+      if (newly_synced) {
+        // tell the follower its mirror reached the head: it records a
+        // durable "synced" marker that gates PROMOTION — a standby
+        // whose mirror never caught up must not become the authority
+        if (!send_frame(fd, {"HEAD"})) return;
+      }
+      // wait for a poke (leader append) or poll the file — the condvar
+      // bounds sync-commit latency, the timeout catches writers that
+      // never poke (external appends)
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait_for(lk, std::chrono::milliseconds(20));
+      continue;
+    }
+    size_t want = static_cast<size_t>(
+        std::min<int64_t>(jsize - pos, kChunk));
+    std::string chunk(want, '\0');
+    int jfd = ::open(s->journal_path().c_str(), O_RDONLY);
+    if (jfd < 0) return;
+    ssize_t got = ::pread(jfd, &chunk[0], want,
+                          static_cast<off_t>(pos));
+    ::close(jfd);
+    if (got <= 0) continue;
+    chunk.resize(static_cast<size_t>(got));
+    if (!send_frame(fd, {"JDATA", chunk})) return;
+    if (!recv_frame(fd, &fields) || fields.size() < 2 ||
+        fields[0] != "ACK")
+      return;
+    pos = ::atoll(fields[1].c_str());
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      auto it = s->conns.find(id);
+      if (it != s->conns.end()) it->second.acked = pos;
+    }
+    s->cv.notify_all();
+  }
+}
+
+void serve_follower(ReplServer* s, int fd, int64_t id) {
+  serve_follower_inner(s, fd, id);
+  // single exit: EVERY path (handshake failure included) must drop the
+  // conn entry, or a ghost follower wedges crp_wait_acked forever
+  ::close(fd);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->conns.erase(id);
+  }
+  s->cv.notify_all();  // waiters must re-evaluate "all followers acked"
+  s->active_workers.fetch_sub(1);
+}
+
+void accept_loop(ReplServer* s) {
+  while (!s->stopping.load()) {
+    struct pollfd pfd;
+    pfd.fd = s->listen_fd;
+    pfd.events = POLLIN;
+    int pr = ::poll(&pfd, 1, 100);
+    if (s->stopping.load()) return;
+    if (pr <= 0) continue;
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping.load()) return;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // receive timeout = the lag kick: a follower whose fsync or network
+    // stalls stops acking; without this its worker blocks in recv
+    // forever and EVERY commit eats the full ack timeout indefinitely.
+    // One kick converts a sick standby into degraded (async) mode.
+    struct timeval tv;
+    tv.tv_sec = 15;
+    tv.tv_usec = 0;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    int64_t id;
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      id = s->next_conn_id++;
+      s->conns[id].fd = fd;
+    }
+    // detached: serve_follower's single-exit cleanup decrements
+    // active_workers, which crp_stop waits on (a joinable-thread vector
+    // would grow without bound under follower reconnect churn)
+    s->active_workers.fetch_add(1);
+    std::thread(serve_follower, s, fd, id).detach();
+  }
+}
+
+// ---------------------------------------------------------------- follower
+
+struct ReplFollower {
+  std::string host;
+  int port;
+  std::string dir;
+  std::thread thread;
+  std::atomic<bool> stopping{false};
+  std::atomic<bool> connected{false};
+  std::atomic<int64_t> offset{-1};
+  std::atomic<int> live_fd{-1};  // for crf_stop to shutdown a blocked recv
+
+  std::string journal_path() const { return dir + "/journal.jsonl"; }
+  std::string token_path() const { return dir + "/repl_token"; }
+  // exists while the mirror is known-complete (reached the leader's head
+  // at least once on the current base); removed the moment a full resync
+  // begins.  Promotion refuses a mirror without it (an unsynced standby
+  // winning the election would lose every commit acked by its peers).
+  std::string synced_marker_path() const { return dir + "/repl_synced"; }
+  // written durably the moment this directory BECOMES a mirror (before
+  // any transfer): a fresh standby killed mid-initial-snapshot has no
+  // repl_token yet, and without this marker the promotion gate would
+  // mistake its near-empty dir for cluster genesis and serve an empty
+  // store as the new authority.
+  std::string following_marker_path() const {
+    return dir + "/repl_following";
+  }
+};
+
+int dial(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  if (::getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (auto* p = res; p; p = p->ai_next) {
+    fd = ::socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+// The mirrored journal must only ever be acked at a record boundary: a
+// chunk ending mid-line is fine on disk (the next chunk completes it),
+// but after a follower crash the HELLO offset must not point into a torn
+// line — trim to the last '\n' first.
+int64_t trimmed_journal_size(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDWR);
+  if (fd < 0) return 0;
+  int64_t size = static_cast<int64_t>(::lseek(fd, 0, SEEK_END));
+  int64_t good = 0;
+  const int64_t kScan = 1 << 16;
+  int64_t at = size;
+  std::string buf;
+  while (at > 0 && good == 0) {
+    int64_t from = std::max<int64_t>(0, at - kScan);
+    buf.resize(static_cast<size_t>(at - from));
+    if (::pread(fd, &buf[0], buf.size(), static_cast<off_t>(from)) !=
+        static_cast<ssize_t>(buf.size()))
+      break;
+    size_t nl = buf.rfind('\n');
+    if (nl != std::string::npos) good = from + static_cast<int64_t>(nl) + 1;
+    at = from;
+  }
+  if (good < size) {
+    if (::ftruncate(fd, static_cast<off_t>(good)) != 0) good = size;
+  }
+  ::close(fd);
+  return good;
+}
+
+void follow_loop(ReplFollower* f) {
+  write_file_sync(f->following_marker_path(), "1");
+  while (!f->stopping.load()) {
+    int fd = dial(f->host, f->port);
+    if (fd < 0) {
+      for (int i = 0; i < 25 && !f->stopping.load(); ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(20));
+      continue;
+    }
+    f->live_fd.store(fd);
+    if (f->stopping.load()) {  // raced crf_stop's shutdown sweep
+      ::close(fd);
+      return;
+    }
+    std::string token = read_file(f->token_path());
+    if (token.empty()) token = "none";
+    int64_t offset = trimmed_journal_size(f->journal_path());
+    {
+      std::ostringstream ss;
+      ss << offset;
+      if (!send_frame(fd, {"HELLO", token, ss.str()})) {
+        ::close(fd);
+        continue;
+      }
+    }
+    f->offset.store(offset);
+    f->connected.store(true);
+    std::vector<std::string> fields;
+    int jfd = ::open(f->journal_path().c_str(),
+                     O_CREAT | O_WRONLY | O_APPEND, 0644);
+    while (jfd >= 0 && !f->stopping.load() && recv_frame(fd, &fields) &&
+           !fields.empty()) {
+      if (fields[0] == "RESET" && fields.size() >= 3) {
+        // full resync: RESET(token, size) + SDATA* + SDONE, snapshot
+        // chunked so it never hits the kMaxFrame receive cap.  The
+        // synced marker comes off FIRST: from here until the next HEAD
+        // this mirror is incomplete and must not be promoted.
+        ::unlink(f->synced_marker_path().c_str());
+        ::close(jfd);
+        jfd = -1;
+        std::string new_token = fields[1];
+        int64_t snap_size = ::atoll(fields[2].c_str());
+        std::string tmp = f->dir + "/snapshot.json.tmp";
+        int sfd = snap_size >= 0
+            ? ::open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644)
+            : -1;
+        bool ok = (snap_size < 0 || sfd >= 0);
+        while (ok && recv_frame(fd, &fields) && !fields.empty() &&
+               fields[0] == "SDATA" && fields.size() >= 2) {
+          if (sfd < 0 ||
+              !cook_framing::write_exact(sfd, fields[1].data(),
+                                         fields[1].size()))
+            ok = false;
+        }
+        ok = ok && !fields.empty() && fields[0] == "SDONE";
+        if (sfd >= 0) {
+          ok = ok && ::fsync(sfd) == 0;
+          ::close(sfd);
+        }
+        if (!ok) break;
+        if (snap_size < 0) {
+          ::unlink((f->dir + "/snapshot.json").c_str());
+        } else if (::rename(tmp.c_str(),
+                            (f->dir + "/snapshot.json").c_str()) != 0) {
+          break;
+        }
+        // order matters: journal truncated and token durable BEFORE the
+        // ack — the ack claims "mirror is at offset 0 of this base"
+        jfd = ::open(f->journal_path().c_str(),
+                     O_CREAT | O_WRONLY | O_TRUNC, 0644);
+        if (jfd < 0) break;
+        if (!write_file_sync(f->token_path(), new_token)) break;
+        offset = 0;
+        f->offset.store(0);
+        if (!send_frame(fd, {"ACK", "0"})) break;
+      } else if (fields[0] == "JDATA" && fields.size() >= 2) {
+        const std::string& chunk = fields[1];
+        if (!cook_framing::write_exact(jfd, chunk.data(), chunk.size()))
+          break;
+        if (::fsync(jfd) != 0) break;
+        offset += static_cast<int64_t>(chunk.size());
+        f->offset.store(offset);
+        std::ostringstream ss;
+        ss << offset;
+        if (!send_frame(fd, {"ACK", ss.str()})) break;
+      } else if (fields[0] == "HEAD") {
+        // mirror reached the leader's head: durably record that this
+        // directory is promotable
+        if (!write_file_sync(f->synced_marker_path(), "1")) break;
+      } else {
+        break;
+      }
+    }
+    if (jfd >= 0) ::close(jfd);
+    f->live_fd.store(-1);
+    ::close(fd);
+    f->connected.store(false);
+  }
+}
+
+}  // namespace
+
+// -------------------------------------------------------------- ctypes API
+
+extern "C" {
+
+void* crp_serve(const char* dir, int port) {
+  auto* s = new ReplServer;
+  s->dir = dir;
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 16) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<struct sockaddr*>(&addr),
+                &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int crp_port(void* h) { return static_cast<ReplServer*>(h)->port; }
+
+int crp_follower_count(void* h) {
+  auto* s = static_cast<ReplServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int>(s->conns.size());
+}
+
+// Followers whose mirror has caught up to the journal head at least once
+// — the set that participates in sync-commit acks.
+int crp_synced_count(void* h) {
+  auto* s = static_cast<ReplServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  int n = 0;
+  for (const auto& kv : s->conns)
+    if (kv.second.synced) ++n;
+  return n;
+}
+
+// Wake every follower worker (call after a journal append: bounds the
+// sync-replication latency to the socket round-trip instead of the poll).
+void crp_poke(void* h) { static_cast<ReplServer*>(h)->cv.notify_all(); }
+
+// Block until every SYNCED follower has fsynced the journal through
+// `target` bytes, a synced count of zero included (nothing to wait for —
+// a standby mid-catch-up must not abort live commits).  Returns 1 on
+// success, 0 on timeout.  Sync-commit semantics: the store calls this
+// after each append before reporting the transaction durable.
+int crp_wait_acked(void* h, long long target, int timeout_ms) {
+  auto* s = static_cast<ReplServer*>(h);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  std::unique_lock<std::mutex> lk(s->mu);
+  for (;;) {
+    bool all = true;
+    for (const auto& kv : s->conns)
+      if (kv.second.synced && kv.second.acked < target) all = false;
+    if (all) return 1;
+    if (s->cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+      for (const auto& kv : s->conns)
+        if (kv.second.synced && kv.second.acked < target) return 0;
+      return 1;
+    }
+  }
+}
+
+long long crp_min_acked(void* h) {
+  auto* s = static_cast<ReplServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  long long m = -1;
+  for (const auto& kv : s->conns)
+    if (kv.second.synced && (m < 0 || kv.second.acked < m))
+      m = kv.second.acked;
+  return m;
+}
+
+void crp_stop(void* h) {
+  auto* s = static_cast<ReplServer*>(h);
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (const auto& kv : s->conns)
+      if (kv.second.fd >= 0) ::shutdown(kv.second.fd, SHUT_RDWR);
+  }
+  s->cv.notify_all();
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // workers are detached; wait for their single-exit cleanups to run
+  // (bounded: their sockets are shut down, every recv fails fast)
+  for (int i = 0; i < 1000 && s->active_workers.load() > 0; ++i)
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  if (s->active_workers.load() == 0) {
+    delete s;
+  }
+  // else: leak deliberately — a wedged worker still references *s, and a
+  // use-after-free is strictly worse than one leaked handle at shutdown
+}
+
+void* crf_follow(const char* host, int port, const char* dir) {
+  auto* f = new ReplFollower;
+  f->host = host;
+  f->port = port;
+  f->dir = dir;
+  ::mkdir(dir, 0755);
+  f->thread = std::thread(follow_loop, f);
+  return f;
+}
+
+int crf_connected(void* h) {
+  return static_cast<ReplFollower*>(h)->connected.load() ? 1 : 0;
+}
+
+long long crf_offset(void* h) {
+  return static_cast<ReplFollower*>(h)->offset.load();
+}
+
+void crf_stop(void* h) {
+  auto* f = static_cast<ReplFollower*>(h);
+  f->stopping.store(true);
+  int fd = f->live_fd.load();
+  if (fd >= 0) ::shutdown(fd, SHUT_RDWR);  // wake a blocked recv
+  if (f->thread.joinable()) f->thread.join();
+  delete f;
+}
+
+}  // extern "C"

@@ -67,77 +67,20 @@
 #include <thread>
 #include <vector>
 
+#include "framing.h"
+
 namespace {
 
-constexpr uint32_t kMaxFrame = 16u * 1024 * 1024;
 constexpr char kSep = '\x1f';  // unit separator for flattened driver events
 
 // ---------------------------------------------------------------- framing
+// (shared with repl.cpp — see framing.h)
 
-bool read_exact(int fd, void* buf, size_t n) {
-  char* p = static_cast<char*>(buf);
-  while (n > 0) {
-    ssize_t r = ::read(fd, p, n);
-    if (r == 0) return false;
-    if (r < 0) {
-      if (errno == EINTR) continue;
-      return false;
-    }
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
-
-bool write_exact(int fd, const void* buf, size_t n) {
-  const char* p = static_cast<const char*>(buf);
-  while (n > 0) {
-    ssize_t r = ::write(fd, p, n);
-    if (r < 0) {
-      if (errno == EINTR) continue;
-      return false;
-    }
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
-
-void put_u32(std::string* out, uint32_t v) {
-  uint32_t be = htonl(v);
-  out->append(reinterpret_cast<const char*>(&be), 4);
-}
-
-bool send_frame(int fd, const std::vector<std::string>& fields) {
-  std::string payload;
-  for (const auto& f : fields) {
-    put_u32(&payload, static_cast<uint32_t>(f.size()));
-    payload += f;
-  }
-  std::string frame;
-  put_u32(&frame, static_cast<uint32_t>(payload.size()));
-  frame += payload;
-  return write_exact(fd, frame.data(), frame.size());
-}
-
-bool recv_frame(int fd, std::vector<std::string>* fields) {
-  uint32_t len_be = 0;
-  if (!read_exact(fd, &len_be, 4)) return false;
-  uint32_t len = ntohl(len_be);
-  if (len > kMaxFrame) return false;
-  std::string payload(len, '\0');
-  if (len > 0 && !read_exact(fd, &payload[0], len)) return false;
-  fields->clear();
-  size_t off = 0;
-  while (off + 4 <= payload.size()) {
-    uint32_t flen = ntohl(*reinterpret_cast<const uint32_t*>(&payload[off]));
-    off += 4;
-    if (off + flen > payload.size()) return false;
-    fields->emplace_back(payload.substr(off, flen));
-    off += flen;
-  }
-  return off == payload.size();
-}
+using cook_framing::kMaxFrame;
+using cook_framing::read_exact;
+using cook_framing::recv_frame;
+using cook_framing::send_frame;
+using cook_framing::write_exact;
 
 // ------------------------------------------------------------------ agent
 

