@@ -92,12 +92,29 @@ def _status(code: int, reason: str, message: str = "") -> Dict:
 
 
 class MockApiServer:
-    """HTTP front-end over a FakeKubernetesApi.  ``base_url`` is what a
-    RealKubernetesApi should be pointed at."""
+    """HTTP(S) front-end over a FakeKubernetesApi.  ``base_url`` is what
+    a RealKubernetesApi should be pointed at.
+
+    TLS (the reference's client stack is TLS everywhere —
+    kubernetes/api.clj:372-475, project.clj:152-156): pass
+    ``tls_cert``/``tls_key`` to serve https.  ``client_ca`` additionally
+    REQUIRES a client certificate signed by that CA (mTLS) at the
+    handshake.  ``bearer_token`` rejects any request without the
+    matching ``Authorization: Bearer`` header with a k8s-shaped 401."""
 
     def __init__(self, fake: Optional[FakeKubernetesApi] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 client_ca: Optional[str] = None,
+                 bearer_token: Optional[str] = None):
         self.fake = fake or FakeKubernetesApi()
+        self._tls = bool(tls_cert)
+        if (client_ca or tls_key) and not tls_cert:
+            # a test passing client_ca alone would otherwise serve plain
+            # HTTP and "pass" with zero mTLS enforcement
+            raise ValueError("client_ca/tls_key require tls_cert")
+        self.bearer_token = bearer_token
         self._lock = threading.Lock()
         self._leases: Dict[str, Dict] = {}   # name -> lease JSON
         self._lease_rv = 0
@@ -125,8 +142,27 @@ class MockApiServer:
                 n = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _authorized(self) -> bool:
+                """Bearer-token check (TLS client-cert identity is
+                enforced earlier, at the handshake)."""
+                if mock.bearer_token is None:
+                    return True
+                got = self.headers.get("Authorization") or ""
+                if got == f"Bearer {mock.bearer_token}":
+                    return True
+                # drain the body first: an unread body left in a
+                # keep-alive stream would be parsed as the next request
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                self._json(401, _status(401, "Unauthorized",
+                                        "invalid bearer token"))
+                return False
+
             def do_GET(self):
                 mock.requests.append(f"GET {self.path}")
+                if not self._authorized():
+                    return
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 parts = [p for p in u.path.split("/") if p]
@@ -165,6 +201,8 @@ class MockApiServer:
 
             def do_POST(self):
                 mock.requests.append(f"POST {self.path}")
+                if not self._authorized():
+                    return
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
@@ -196,6 +234,8 @@ class MockApiServer:
 
             def do_PUT(self):
                 mock.requests.append(f"PUT {self.path}")
+                if not self._authorized():
+                    return
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
@@ -222,6 +262,8 @@ class MockApiServer:
 
             def do_DELETE(self):
                 mock.requests.append(f"DELETE {self.path}")
+                if not self._authorized():
+                    return
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 parts = [p for p in u.path.split("/") if p]
@@ -238,6 +280,17 @@ class MockApiServer:
                 return self._json(404, _status(404, "NotFound", u.path))
 
         self._httpd = ThreadingHTTPServer((host, 0), Handler)
+        if self._tls:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            if client_ca:
+                # mTLS: the handshake itself rejects clients without a
+                # certificate signed by this CA
+                ctx.load_verify_locations(cafile=client_ca)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="mock-apiserver")
@@ -250,7 +303,8 @@ class MockApiServer:
     @property
     def base_url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def close(self) -> None:
         self._httpd.shutdown()

@@ -140,9 +140,13 @@ class RealKubernetesApi:
         self._token_path: Optional[str] = None
         self._token_checked = 0.0
         if not base_url and token is None:
-            # in-cluster fallback: the pod's service account
-            sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+            # in-cluster fallback: the pod's service account (the env
+            # override exists so tests can execute this branch against a
+            # mock apiserver — in a pod the default path is projected)
             import os
+            sa = os.environ.get(
+                "COOK_K8S_SA_DIR",
+                "/var/run/secrets/kubernetes.io/serviceaccount")
             if os.path.exists(f"{sa}/token"):
                 with open(f"{sa}/token", encoding="utf-8") as f:
                     token = f.read().strip()
@@ -234,7 +238,12 @@ class RealKubernetesApi:
                                "client-certificate", user)
         keyfile = materialize("client-key-data", "client-key", user)
         ctx = None
-        if server.startswith("https") and (cafile or certfile):
+        if server.startswith("https") and (
+                cafile or certfile
+                or cluster.get("insecure-skip-tls-verify")):
+            # skip-verify alone still needs a context: the default one
+            # would reject the very self-signed server the operator just
+            # told us to trust
             ctx = ssl.create_default_context(cafile=cafile)
             if cluster.get("insecure-skip-tls-verify"):
                 ctx.check_hostname = False

@@ -1,0 +1,312 @@
+"""Real TLS handshakes on the k8s wire (VERDICT r4 #4).
+
+The reference's apiserver client is TLS everywhere
+(scheduler/project.clj:152-156 pins an okhttp TLS stack;
+kubernetes/api.clj:372-475 builds it from kubeconfig / service-account
+material).  These tests put an ssl-wrapped MockApiServer behind
+RealKubernetesApi and execute every cert path for real: CA verification
+(file and inline base64 data), wrong-CA rejection, mTLS client
+certificates required at the handshake, insecure-skip-tls-verify,
+bearer-token 401s, token rotation over TLS, and the full
+cluster-launches-a-pod flow over https.
+"""
+
+import base64
+import json
+import ssl
+import time
+import urllib.error
+
+import pytest
+import yaml
+
+from cook_tpu.cluster.k8s.fake_api import FakeNode
+from cook_tpu.cluster.k8s.mock_apiserver import MockApiServer
+from cook_tpu.cluster.k8s.real_api import RealKubernetesApi
+from cook_tpu.cluster.k8s.testcerts import generate_pki
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    return generate_pki(str(tmp_path_factory.mktemp("pki")))
+
+
+def wait_for(pred, timeout=15.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def write_kubeconfig(path, server, ca=None, ca_data=None, token=None,
+                     client_cert=None, client_key=None, cert_data=None,
+                     key_data=None, skip_verify=False):
+    cluster = {"server": server}
+    if ca:
+        cluster["certificate-authority"] = ca
+    if ca_data:
+        cluster["certificate-authority-data"] = ca_data
+    if skip_verify:
+        cluster["insecure-skip-tls-verify"] = True
+    user = {}
+    if token:
+        user["token"] = token
+    if client_cert:
+        user["client-certificate"] = client_cert
+        user["client-key"] = client_key
+    if cert_data:
+        user["client-certificate-data"] = cert_data
+        user["client-key-data"] = key_data
+    cfg = {"apiVersion": "v1", "kind": "Config",
+           "current-context": "test",
+           "contexts": [{"name": "test",
+                         "context": {"cluster": "c1", "user": "u1"}}],
+           "clusters": [{"name": "c1", "cluster": cluster}],
+           "users": [{"name": "u1", "user": user}]}
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def b64file(path):
+    with open(path, "rb") as f:
+        return base64.b64encode(f.read()).decode()
+
+
+class TestServerVerification:
+    def test_kubeconfig_ca_file_roundtrip(self, pki, tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key).start()
+        try:
+            mock.fake.add_node(FakeNode(name="n1", cpus=4.0, mem=4096.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.ca_cert)
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            nodes = api.nodes()
+            assert [n.name for n in nodes] == ["n1"]
+            assert mock.base_url.startswith("https://")
+        finally:
+            mock.close()
+
+    def test_kubeconfig_inline_ca_data(self, pki, tmp_path):
+        # base64 *-data fields exercise the materialize() temp-file path
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key).start()
+        try:
+            mock.fake.add_node(FakeNode(name="n2", cpus=1.0, mem=512.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca_data=b64file(pki.ca_cert))
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["n2"]
+        finally:
+            mock.close()
+
+    def test_wrong_ca_rejected(self, pki, tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key).start()
+        try:
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.wrong_ca_cert)
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            with pytest.raises((ssl.SSLError, urllib.error.URLError)):
+                api.nodes()
+        finally:
+            mock.close()
+
+    def test_insecure_skip_tls_verify(self, pki, tmp_path):
+        # no CA at all, skip-verify set: the handshake must proceed
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key).start()
+        try:
+            mock.fake.add_node(FakeNode(name="n3", cpus=1.0, mem=512.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  skip_verify=True)
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["n3"]
+        finally:
+            mock.close()
+
+    def test_base_url_verify_tls_false(self, pki):
+        # the base_url + verify_tls=False constructor path (no kubeconfig)
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key).start()
+        try:
+            mock.fake.add_node(FakeNode(name="n4", cpus=1.0, mem=512.0))
+            api = RealKubernetesApi(base_url=mock.base_url,
+                                    verify_tls=False, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["n4"]
+        finally:
+            mock.close()
+
+
+class TestClientIdentity:
+    def test_mtls_client_certificate_accepted(self, pki, tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             client_ca=pki.ca_cert).start()
+        try:
+            mock.fake.add_node(FakeNode(name="m1", cpus=1.0, mem=512.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.ca_cert,
+                                  client_cert=pki.client_cert,
+                                  client_key=pki.client_key)
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["m1"]
+        finally:
+            mock.close()
+
+    def test_mtls_inline_cert_data(self, pki, tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             client_ca=pki.ca_cert).start()
+        try:
+            mock.fake.add_node(FakeNode(name="m2", cpus=1.0, mem=512.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca_data=b64file(pki.ca_cert),
+                                  cert_data=b64file(pki.client_cert),
+                                  key_data=b64file(pki.client_key))
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["m2"]
+        finally:
+            mock.close()
+
+    def test_missing_client_certificate_rejected_at_handshake(self, pki,
+                                                              tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             client_ca=pki.ca_cert).start()
+        try:
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.ca_cert)  # CA only, no identity
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            with pytest.raises((ssl.SSLError, urllib.error.URLError,
+                                ConnectionError, OSError)):
+                api.nodes()
+        finally:
+            mock.close()
+
+
+class TestBearerAuth:
+    def test_token_enforced_over_tls(self, pki, tmp_path):
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             bearer_token="sekrit").start()
+        try:
+            mock.fake.add_node(FakeNode(name="b1", cpus=1.0, mem=512.0))
+            good = write_kubeconfig(tmp_path / "good.yaml", mock.base_url,
+                                    ca=pki.ca_cert, token="sekrit")
+            api = RealKubernetesApi(kubeconfig=good, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["b1"]
+            bad = write_kubeconfig(tmp_path / "bad.yaml", mock.base_url,
+                                   ca=pki.ca_cert, token="wrong")
+            api2 = RealKubernetesApi(kubeconfig=bad, watch_timeout_s=5.0)
+            from cook_tpu.cluster.k8s.real_api import ApiError
+            with pytest.raises(ApiError) as e:
+                api2.nodes()
+            assert "401" in str(e.value)
+        finally:
+            mock.close()
+
+    def test_in_cluster_service_account_over_tls(self, pki, tmp_path,
+                                                 monkeypatch):
+        """The in-cluster constructor branch: projected service-account
+        dir (token + ca.crt) + KUBERNETES_SERVICE_* env — through a real
+        handshake, with the rotating-token path armed."""
+        from urllib.parse import urlparse
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             bearer_token="sa-tok").start()
+        try:
+            mock.fake.add_node(FakeNode(name="s1", cpus=1.0, mem=512.0))
+            sa = tmp_path / "sa"
+            sa.mkdir()
+            (sa / "token").write_text("sa-tok")
+            import shutil
+            shutil.copy(pki.ca_cert, sa / "ca.crt")
+            u = urlparse(mock.base_url)
+            monkeypatch.setenv("COOK_K8S_SA_DIR", str(sa))
+            monkeypatch.setenv("KUBERNETES_SERVICE_HOST", u.hostname)
+            monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(u.port))
+            api = RealKubernetesApi(watch_timeout_s=5.0)
+            assert api._token_path == str(sa / "token")
+            assert [n.name for n in api.nodes()] == ["s1"]
+            # the projected token rotates; the client re-reads it
+            (sa / "token").write_text("sa-tok-2")
+            mock.bearer_token = "sa-tok-2"
+            api._token_checked = 0.0
+            assert [n.name for n in api.nodes()] == ["s1"]
+        finally:
+            mock.close()
+
+    def test_token_rotation_over_tls(self, pki, tmp_path):
+        """Bound service-account tokens rotate (the kubelet refreshes the
+        projected file); the client must pick up the fresh token and keep
+        authenticating through REAL handshakes."""
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                            tls_key=pki.server_key,
+                            bearer_token="tok-1").start()
+        try:
+            mock.fake.add_node(FakeNode(name="r1", cpus=1.0, mem=512.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.ca_cert, token="tok-1")
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            assert [n.name for n in api.nodes()] == ["r1"]
+            token_file = tmp_path / "token"
+            token_file.write_text("tok-2")
+            api._token_path = str(token_file)
+            mock.bearer_token = "tok-2"  # server-side rotation
+            api._token_checked = 0.0     # force the re-read
+            assert [n.name for n in api.nodes()] == ["r1"]
+        finally:
+            mock.close()
+
+
+class TestFullBackendOverTls:
+    def test_cluster_launches_pod_over_https(self, pki, tmp_path):
+        """The complete store -> cluster -> POST pod -> watch -> status
+        flow, over a verified mTLS connection."""
+        from cook_tpu.cluster.base import LaunchSpec
+        from cook_tpu.cluster.k8s.compute_cluster import KubernetesCluster
+        from cook_tpu.state import InstanceStatus, Job, Resources, Store
+
+        mock = MockApiServer(tls_cert=pki.server_cert,
+                             tls_key=pki.server_key,
+                             client_ca=pki.ca_cert,
+                             bearer_token="sekrit").start()
+        try:
+            mock.fake.add_node(FakeNode(name="n1", cpus=8.0, mem=8192.0))
+            kc = write_kubeconfig(tmp_path / "kc.yaml", mock.base_url,
+                                  ca=pki.ca_cert, token="sekrit",
+                                  client_cert=pki.client_cert,
+                                  client_key=pki.client_key)
+            api = RealKubernetesApi(kubeconfig=kc, watch_timeout_s=5.0)
+            updates = []
+            store = Store()
+            store.create_jobs([Job(uuid="j1", user="alice",
+                                   command="echo hi",
+                                   resources=Resources(cpus=1.0,
+                                                       mem=256.0))])
+            cluster = KubernetesCluster("k8s-tls", api, store=store)
+            cluster.initialize(lambda tid, status, reason, **kw:
+                               updates.append((tid, status)))
+            wait_for(lambda: len(cluster.pending_offers("default")) == 1,
+                     msg="offer from watched node over TLS")
+            cluster.launch_tasks("default", [LaunchSpec(
+                task_id="t1", job_uuid="j1", hostname="", slave_id="",
+                resources=Resources(cpus=1.0, mem=256.0),
+                env={"COOK_COMMAND": "echo hi"})])
+            wait_for(lambda: mock.fake.pod("t1") is not None,
+                     msg="pod created over https")
+            mock.fake.step()
+            mock.fake.step()
+            wait_for(lambda: any(s is InstanceStatus.RUNNING
+                                 for _, s in updates),
+                     msg="RUNNING update over TLS watch")
+            mock.fake.finish_pod("t1", exit_code=0)
+            wait_for(lambda: any(s is InstanceStatus.SUCCESS
+                                 for _, s in updates),
+                     msg="SUCCESS update over TLS watch")
+            cluster.shutdown()
+        finally:
+            mock.close()
