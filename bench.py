@@ -374,31 +374,30 @@ def bench_match_large(J=10_000, H=50_000):
     return out
 
 
-def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
-    """Store -> columnar index -> pack -> rank kernel -> considerable
-    prefix materialization: the FULL production rank path from live
-    entities (VERDICT r1 weak #4: 'no bench covers store->pack end to
-    end').  Also times the entity path once for comparison."""
+def _store_bench_setup(n_jobs, n_users, batch=10_000, seed=4):
+    """Shared store-population + index-attach + rank-cycle harness for
+    the 100k (store_cycle) and 1M (store_scale) sections — ONE workload
+    definition so the two scales stay comparable."""
     from cook_tpu.config import Config
     from cook_tpu.sched.ranker import Ranker
     from cook_tpu.state import Job, Resources, Store, new_uuid
 
-    rng = np.random.default_rng(4)
+    rng = np.random.default_rng(seed)
     store = Store()
-    jobs = [Job(uuid=new_uuid(), user=f"user{i % n_users:04d}", command="x",
+    jobs = [Job(uuid=new_uuid(), user=f"user{i % n_users:05d}", command="x",
                 priority=int(rng.integers(0, 100)),
                 submit_time_ms=int(rng.integers(0, 10**6)),
                 resources=Resources(cpus=float(rng.integers(1, 16)),
                                     mem=float(rng.integers(64, 4096))))
             for i in range(n_jobs)]
     t0 = time.perf_counter()
-    for i in range(0, n_jobs, 10_000):
-        store.create_jobs(jobs[i:i + 10_000])
+    for i in range(0, n_jobs, batch):
+        store.create_jobs(jobs[i:i + batch])
     create_ms = (time.perf_counter() - t0) * 1000
+    del jobs  # the store owns its clones; drop the submit copies
     t0 = time.perf_counter()
     store.ensure_index()
     attach_ms = (time.perf_counter() - t0) * 1000
-
     cfg = Config()
     ranker = Ranker(store, cfg, backend="tpu")
 
@@ -406,6 +405,16 @@ def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
         q = ranker.rank_pool("default")
         return q[:1000]  # the matcher's considerable prefix materializes
 
+    return store, cfg, ranker, cycle, create_ms, attach_ms
+
+
+def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
+    """Store -> columnar index -> pack -> rank kernel -> considerable
+    prefix materialization: the FULL production rank path from live
+    entities (VERDICT r1 weak #4: 'no bench covers store->pack end to
+    end').  Also times the entity path once for comparison."""
+    store, cfg, ranker, cycle, create_ms, attach_ms = _store_bench_setup(
+        n_jobs, n_users)
     head = cycle()
     assert len(head) == min(n_jobs, 1000)
     samples = []
@@ -429,6 +438,37 @@ def bench_store_cycle(n_jobs=100_000, n_users=200, reps=5):
           f"p99={out['p99_ms']}ms entity_path={entity_ms:.0f}ms "
           f"(create={create_ms:.0f}ms attach={attach_ms:.0f}ms, "
           f"entity_ranked={len(entity_ranked)})", file=sys.stderr)
+    return out
+
+
+def bench_store_scale(n_jobs=1_000_000, n_users=2000, reps=2):
+    """The store at the 1M-task BASELINE design point (config 5;
+    reference: test/cook/test/benchmark.clj:37-77 goes to 1M):
+    create -> columnar index attach (vectorized bulk scan) -> full
+    production rank cycles.  The ENTITY path is deliberately not run at
+    this scale: it deep-clones every entity through Python (~30 s at 1M)
+    and exists for correctness-checking and small deployments — the
+    columnar index is the production path (see store_cycle's 100k
+    entity_path_ms for the maintained comparison)."""
+    _store, _cfg, _ranker, cycle, create_ms, attach_ms = \
+        _store_bench_setup(n_jobs, n_users, batch=50_000, seed=11)
+    assert len(cycle()) == min(n_jobs, 1000)
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        cycle()
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    out = {
+        "n_jobs": n_jobs,
+        "create_ms": round(create_ms, 1),
+        "index_attach_ms": round(attach_ms, 1),
+        "rank_cycle_p50_ms": round(pctl(samples, 50), 1),
+        "entity_path": "not run at 1M (deliberate slow path; see "
+                       "store_cycle_100k_jobs.entity_path_ms)",
+    }
+    print(f"store_scale[{n_jobs//1000}k jobs] create={create_ms:.0f}ms "
+          f"attach={attach_ms:.0f}ms cycle_p50={out['rank_cycle_p50_ms']}ms",
+          file=sys.stderr)
     return out
 
 
@@ -1003,6 +1043,9 @@ def run_section(name: str) -> None:
     elif name == "store_cycle":
         data = bench_store_cycle(n_jobs=scaled(100_000),
                                  n_users=scaled(200, lo=8))
+    elif name == "store_scale":
+        data = bench_store_scale(n_jobs=scaled(1_000_000),
+                                 n_users=scaled(2000, lo=8))
     elif name == "driver_cycle":
         data = bench_driver_cycle(n_jobs=scaled(100_000),
                                   n_users=scaled(200, lo=8),
@@ -1129,6 +1172,8 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["fused_cycle_100k_tasks_5k_hosts"] = results["fused_cycle"]
     if results.get("store_cycle") is not None:
         detail["store_cycle_100k_jobs"] = results["store_cycle"]
+    if results.get("store_scale") is not None:
+        detail["store_scale_1M_jobs"] = results["store_scale"]
     if results.get("driver_cycle") is not None:
         detail["driver_cycle_100k_jobs"] = results["driver_cycle"]
     if results.get("pipeline") is not None:
@@ -1225,8 +1270,8 @@ def main():
 
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle", "fused_cycle",
-                "store_cycle", "match_large", "rebalance", "end2end",
-                "pallas_scale", "pipeline", "placement_quality"]
+                "store_cycle", "store_scale", "match_large", "rebalance",
+                "end2end", "pallas_scale", "pipeline", "placement_quality"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

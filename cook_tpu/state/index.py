@@ -168,12 +168,80 @@ class ColumnarIndex:
     # ------------------------------------------------------------ lifecycle
     def _attach(self) -> None:
         with self.store._lock:
-            for job in self.store._jobs.values():
-                self._sync_job_raw(job)
+            self._bulk_attach_jobs(list(self.store._jobs.values()))
             for inst in self.store._instances.values():
                 if inst.status in _LIVE:
                     self._add_instance_raw(inst)
             self.store.subscribe(self._on_events)
+
+    def _bulk_attach_jobs(self, jobs) -> None:
+        """Vectorized initial scan: one array build per COLUMN instead of
+        one `_sync_job_raw` call per row (the per-row path stays for the
+        incremental tx feed, where it is the right shape).  At the 1M-job
+        design point (BASELINE config 5) this is the difference between
+        ~18 s and a few seconds of index attach."""
+        if not jobs or self._n:
+            for job in jobs:  # non-empty index: incremental semantics
+                self._sync_job_raw(job)
+            return
+        n = len(jobs)
+        # 25% headroom: sizing to exactly n would guarantee a full
+        # 13-column reallocation (hundreds of MB at 1M rows) on the very
+        # first job submitted after attach
+        cap = max(1024, n + n // 4)
+        self._row = {j.uuid: i for i, j in enumerate(jobs)}
+        self._n = n
+        res = np.zeros((cap, 4), dtype=F32)
+        res[:n, 0] = [j.resources.cpus for j in jobs]
+        res[:n, 1] = [j.resources.mem for j in jobs]
+        res[:n, 2] = [j.resources.gpus for j in jobs]
+        res[:n, 3] = 1.0
+        self._res = res
+        self._disk = np.zeros(cap, dtype=F32)
+        self._disk[:n] = [j.resources.disk for j in jobs]
+        self._prio = np.zeros(cap, dtype=np.int32)
+        self._prio[:n] = [j.priority for j in jobs]
+        self._submit = np.zeros(cap, dtype=np.int64)
+        self._submit[:n] = [j.submit_time_ms for j in jobs]
+        uuids = [j.uuid for j in jobs]
+        self._uuid = np.zeros(cap, dtype="<U36")
+        self._uuid[:n] = uuids
+        users = [j.user for j in jobs]
+        # dtype fitted up front (the per-row path uses _fit_str): a name
+        # longer than the column width would silently truncate
+        ulen = max(64, max((len(u) for u in users), default=1))
+        self._user = np.zeros(cap, dtype=f"<U{ulen}")
+        self._user[:n] = users
+        pools = [j.pool for j in jobs]
+        plen = max(32, max((len(p) for p in pools), default=1))
+        self._pool = np.zeros(cap, dtype=f"<U{plen}")
+        self._pool[:n] = pools
+        self._pending = np.zeros(cap, dtype=bool)
+        self._pending[:n] = [j.committed and j.state is JobState.WAITING
+                             for j in jobs]
+        self._done = np.zeros(cap, dtype=bool)
+        self._done[:n] = [j.state is JobState.COMPLETED for j in jobs]
+        self._dead = int(self._done[:n].sum())
+        self._complex = np.zeros(cap, dtype=bool)
+        self._complex[:n] = [_is_complex(j) for j in jobs]
+        # order-preserving user ids in ONE pass (vs per-row bisect+shift)
+        self._user_names = sorted(set(users))
+        name_pos = {u: i for i, u in enumerate(self._user_names)}
+        self._uid = np.zeros(cap, dtype=np.int32)
+        self._uid[:n] = [name_pos[u] for u in users]
+        # canonical-uuid sort keys, per row exactly as _sync_job_raw: a
+        # canonical row gets its key even when a non-canonical neighbor
+        # disables sorted mode (consumers gate on _sortable)
+        self._uhi = np.zeros(cap, dtype=np.uint64)
+        self._ulo = np.zeros(cap, dtype=np.uint64)
+        hi, lo = self._uhi, self._ulo
+        for i, u in enumerate(uuids):
+            if _CANON_UUID.match(u):
+                h = u.replace("-", "")
+                hi[i] = int(h[:16], 16)
+                lo[i] = int(h[16:], 16)
+            else:
+                self._sortable = False
 
     def _sync_job_raw(self, job) -> None:
         """Insert-or-update one job row (caller holds self._lock or is the

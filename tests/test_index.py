@@ -297,3 +297,45 @@ class TestIncrementalOrderCache:
         fresh = [make_job("u9", priority=77) for _ in range(10)]
         store.create_jobs(fresh)
         assert self._cached_order(store) == self._cold_order(store)
+
+
+class TestBulkAttach:
+    def test_bulk_attach_matches_per_row_golden(self):
+        """The vectorized initial scan (_bulk_attach_jobs) must build
+        byte-identical columns to the per-row path it replaces, on a
+        store with awkward shapes: mixed states, live instances, a
+        non-canonical (UPPERCASE) uuid, a >64-char user, and a
+        latch-uncommitted job."""
+        from cook_tpu.state import Store, new_uuid
+        from cook_tpu.state.index import ColumnarIndex
+
+        store = Store()
+        jobs = [make_job(f"u{i % 11}", priority=i % 100, cpus=1 + i % 4)
+                for i in range(800)]
+        store.create_jobs(jobs)
+        store.create_jobs([make_job("x" * 80)])
+        up = make_job("shouty")
+        up.uuid = "DEADBEEF-0000-4000-8000-00000000CAFE"
+        store.create_jobs([up])
+        store.create_jobs([make_job("latched")], latch="L")
+        for j in jobs[:25]:
+            store.launch_instance(j.uuid, new_uuid(), "h0")
+
+        idx_bulk = ColumnarIndex(store)
+        orig = ColumnarIndex._bulk_attach_jobs
+        ColumnarIndex._bulk_attach_jobs = \
+            lambda self, js: [self._sync_job_raw(j) for j in js]
+        try:
+            idx_row = ColumnarIndex(Store.restore(store.snapshot()))
+        finally:
+            ColumnarIndex._bulk_attach_jobs = orig
+        n = idx_bulk._n
+        assert n == idx_row._n
+        for col in ("_res", "_disk", "_prio", "_submit", "_uuid",
+                    "_user", "_pool", "_pending", "_done", "_uid",
+                    "_uhi", "_ulo", "_complex"):
+            assert np.array_equal(getattr(idx_bulk, col)[:n],
+                                  getattr(idx_row, col)[:n]), col
+        assert idx_bulk._sortable is idx_row._sortable is False
+        assert idx_bulk._user_names == idx_row._user_names
+        assert idx_bulk._dead == idx_row._dead
