@@ -118,7 +118,21 @@ def _prefix_admit(proposes: jax.Array, cand: jax.Array, job_res: jax.Array,
 def _build_prefs(inp: MatchInputs, assign: jax.Array, avail: jax.Array,
                  K: int) -> Tuple[jax.Array, jax.Array]:
     """Top-K hosts per unassigned job by bin-packing fitness against the
-    CURRENT availability (one J x H pass, MXU/VPU-friendly)."""
+    CURRENT availability (one J x H pass, MXU/VPU-friendly).
+
+    Equal fitness scores are broken by a DETERMINISTIC per-(job, host)
+    tie-break: on a perfectly uniform fleet every host ties, and without
+    it all jobs rank the same K hosts (the herding caveat,
+    docs/PLACEMENT_QUALITY.md) — each refresh pass then admits only ~K
+    jobs.  The ranking key is INTEGER-packed (fitness quantized to 22
+    bits, 8 per-(job, host) hash bits below it) rather than a float
+    epsilon: an additive float32 jitter small enough to sit below real
+    fitness differences falls below one ulp once fit >= 0.5 and
+    collapses to a handful of values, silently resurrecting the herd.
+    The 2^-22 fitness quantization (~2.4e-7 of the [0, 1] score) is far
+    below any meaningful tightness difference (host resource
+    granularity puts those at ~1e-4)."""
+    J, H = inp.constraint_mask.shape
     feasible = (jnp.all(avail[None, :, :] >= inp.job_res[:, None, :], axis=2)
                 & inp.constraint_mask & inp.valid[:, None]
                 & (assign < 0)[:, None])
@@ -126,14 +140,30 @@ def _build_prefs(inp: MatchInputs, assign: jax.Array, avail: jax.Array,
     cap = jnp.maximum(inp.capacity, 1e-9)
     fit = (used[None, :, 0] + inp.job_res[:, 0:1]) / cap[None, :, 0] \
         + (used[None, :, 1] + inp.job_res[:, 1:2]) / cap[None, :, 1]
-    fit = jnp.where(feasible, fit * 0.5, NEG_INF)
-    return jax.lax.top_k(fit, K)                       # [J, K] each
+    jj = jnp.arange(J, dtype=jnp.uint32)[:, None]
+    hh = jnp.arange(H, dtype=jnp.uint32)[None, :]
+    mix = (jj * jnp.uint32(2654435761)) ^ (hh * jnp.uint32(0x9E3779B9))
+    q = (jnp.clip(fit * 0.5, 0.0, 1.0)
+         * jnp.float32(1 << 22)).astype(jnp.int32) << 8
+    key_int = q | (mix & jnp.uint32(0xFF)).astype(jnp.int32)
+    # bitcast, don't convert: float32 can only represent 24 bits of the
+    # 30-bit key, so astype would drop exactly the jitter bits — but for
+    # POSITIVE floats the IEEE bit-pattern order equals the value order,
+    # so the bitcast view preserves the full integer ranking while
+    # keeping top_k on the fast float path (int top_k measured ~80x
+    # slower in XLA CPU).
+    key = jnp.where(feasible,
+                    jax.lax.bitcast_convert_type(key_int, jnp.float32),
+                    NEG_INF)
+    return jax.lax.top_k(key, K)                       # [J, K] each
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_prefs", "num_rounds", "num_refresh"))
+                   static_argnames=("num_prefs", "num_rounds",
+                                    "num_refresh", "min_refresh_gain"))
 def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
-                         num_rounds: int = 8, num_refresh: int = 64
+                         num_rounds: int = 8, num_refresh: int = 64,
+                         min_refresh_gain: int = 16
                          ) -> Tuple[jax.Array, jax.Array]:
     """Parallel top-K auction assignment for large J.
 
@@ -155,12 +185,14 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
     post-admission availability moves the herd to the next-tightest hosts
     exactly the way the sequential greedy's evolving fitness does.
 
-    The refresh loop is ADAPTIVE (a ``lax.while_loop``): it exits as soon
-    as a full pass admits no new job — measured placement grows by a
-    roughly constant ~350-400 jobs/pass under contention (see
-    docs/PLACEMENT_QUALITY.md), so a fixed small budget under-places
-    exactly when the workload is hardest, while easy workloads now stop
-    after two or three passes instead of burning the old fixed eight.
+    The refresh loop is ADAPTIVE (a ``lax.while_loop``): it exits once a
+    full pass admits fewer than ``min_refresh_gain`` new jobs — a fixed
+    small budget under-places exactly when the workload is hardest
+    (placement grows per pass under contention, docs/PLACEMENT_QUALITY),
+    while a strict no-progress exit would crawl through tail placements
+    one pass at a time now that the tie-break keeps every pass finding a
+    few; the production path's waterfill tail places those leftovers at
+    no J x H cost.
     Placement decisions can still deviate from greedy (tests bound them
     statistically); the greedy kernel remains the bit-exact parity mode.
     """
@@ -172,8 +204,16 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
 
     def cond(state):
         assign, _avail, prev_placed, passes = state
-        # the -1 sentinel in init guarantees the first pass runs
-        return (placed(assign) > prev_placed) & (passes < num_refresh)
+        # the (passes == 0) term is what guarantees the first pass runs:
+        # the -1 sentinel alone yields gain=1, below min_refresh_gain.
+        # min_refresh_gain: with the r5 per-job tie-break, a contended
+        # pass almost always admits SOMETHING, so an exact no-progress
+        # exit would burn the whole num_refresh budget crawling through
+        # tail placements the production waterfill tail covers anyway —
+        # stop once a full pass stops paying for its J x H rebuild.
+        gain = placed(assign) - prev_placed
+        return ((passes == 0) | (gain >= min_refresh_gain)) \
+            & (passes < num_refresh)
 
     def body(state):
         assign, avail, _prev, passes = state
@@ -189,40 +229,14 @@ def auction_match_kernel(inp: MatchInputs, *, num_prefs: int = 16,
     return assign, avail
 
 
-def auction_match_pallas(inp: MatchInputs, *, num_prefs: int = 16,
-                         num_rounds: int = 8, num_refresh: int = 64,
-                         interpret=None) -> Tuple[jax.Array, jax.Array]:
-    """Auction assignment whose preference build runs as a blockwise Pallas
-    kernel (ops/pallas_match.py) — same refresh structure as
-    :func:`auction_match_kernel`, but the J x H score matrix never touches
-    HBM.  The refresh loop is host-side (each pass = one Pallas dispatch +
-    one jitted round block), so the device shapes stay static; like the
-    XLA kernel it exits as soon as a pass admits no new job (one scalar
-    readback per pass), bounded by ``num_refresh``."""
-    from . import pallas_match
-    import numpy as np
-    J = inp.constraint_mask.shape[0]
-    assign = jnp.full((J,), -1, dtype=jnp.int32)
-    avail = inp.avail
-    prev_placed = -1
-    for _ in range(num_refresh):
-        pref_fit, pref_host = pallas_match.topk_prefs(
-            inp.job_res, inp.constraint_mask, inp.valid & (assign < 0),
-            avail, inp.capacity, k=num_prefs, interpret=interpret)
-        assign, avail = _auction_rounds_jit(inp, pref_fit, pref_host, assign,
-                                            avail, num_rounds=num_rounds)
-        now_placed = int(np.asarray(jnp.sum(assign >= 0)))
-        if now_placed == prev_placed:
-            break
-        prev_placed = now_placed
-    return assign, avail
-
-
-@functools.partial(jax.jit, static_argnames=("num_rounds",))
-def _auction_rounds_jit(inp, pref_fit, pref_host, assign, avail, *,
-                        num_rounds):
-    return _auction_rounds(inp, pref_fit, pref_host, num_rounds,
-                           assign=assign, avail=avail)
+# auction_match_pallas (a dense-mask auction whose preference build ran
+# as a blockwise Pallas kernel) was REMOVED in round 5: across three
+# rounds of on-chip measurement it never beat the XLA auction at any
+# scale that fits a dense mask (r4 capture: 295 ms vs 50 ms p50 at
+# 1k x 50k; 2550 ms vs 736 ms compiled at 10k x 50k) and its ~20 s
+# first-call compile burned bench deadline every round.  The regime a
+# dense kernel cannot reach at all (structured masks at 100k-1M jobs)
+# is served by pallas_match.topk_prefs_structured, which stays.
 
 
 def _auction_rounds(inp: MatchInputs, pref_fit: jax.Array,

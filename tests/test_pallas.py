@@ -111,42 +111,3 @@ def test_topk_prefs_structured_matches_dense(J, H, E, k):
                                   np.asarray(ref_host)[finite])
 
 
-def test_auction_match_pallas_equals_xla_auction():
-    rng = np.random.default_rng(11)
-    job_res, cmask, valid, avail, capacity = _rand_problem(rng, 160, 140)
-    inp = match.MatchInputs(job_res=job_res, constraint_mask=cmask,
-                            avail=avail, capacity=capacity, valid=valid)
-    a_x, avail_x = match.auction_match_kernel(inp)
-    a_p, avail_p = match.auction_match_pallas(inp, interpret=True)
-    np.testing.assert_array_equal(np.asarray(a_x), np.asarray(a_p))
-    np.testing.assert_allclose(np.asarray(avail_x), np.asarray(avail_p),
-                               rtol=1e-6)
-
-
-def test_pallas_backend_full_scheduler_cycle():
-    """The tpu-auction-pallas matcher backend drives the full
-    submit->rank->match->launch loop (interpret mode on CPU)."""
-    from cook_tpu.cluster import FakeCluster, FakeHost
-    from cook_tpu.config import Config
-    from cook_tpu.sched import Scheduler
-    from cook_tpu.state import (Job, JobState, Resources, Store, new_uuid)
-
-    store = Store()
-    hosts = [FakeHost(hostname=f"h{i}", capacity=Resources(cpus=8.0, mem=8192.0))
-             for i in range(4)]
-    cluster = FakeCluster("fake-1", hosts, default_task_duration_ms=1000)
-    config = Config()
-    config.default_matcher.backend = "tpu-auction-pallas"
-    sched = Scheduler(store, config, [cluster])
-    uuids = store.create_jobs([
-        Job(uuid=new_uuid(), user=u, command="true", pool="default",
-            resources=Resources(cpus=1.0, mem=100.0))
-        for u in ("alice", "alice", "bob")])
-    sched.step_rank()
-    res = sched.step_match()["default"]
-    assert len(res.launched_task_ids) == 3
-    for uuid in uuids:
-        assert store.job(uuid).state is JobState.RUNNING
-    cluster.advance_to(1500)
-    for uuid in uuids:
-        assert store.job(uuid).state is JobState.COMPLETED
