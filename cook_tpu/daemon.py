@@ -74,8 +74,15 @@ def build_scheduler_config(spec: Dict) -> Config:
             setattr(cfg, key, spec[key])
     if "default_matcher" in spec:
         for k, v in spec["default_matcher"].items():
-            if hasattr(cfg.default_matcher, k):
-                setattr(cfg.default_matcher, k, v)
+            if not hasattr(cfg.default_matcher, k):
+                # a typo'd KEY silently keeping the default would let an
+                # operator believe a knob is set (e.g. "auto_paking")
+                raise ValueError(
+                    f"unknown default_matcher key {k!r}")
+            setattr(cfg.default_matcher, k, v)
+        # setattr bypasses dataclass construction: re-validate so a
+        # typo'd backend/auto_packing VALUE also fails the BOOT
+        cfg.default_matcher.__post_init__()
     if "rebalancer" in spec:
         for k, v in spec["rebalancer"].items():
             if hasattr(cfg.rebalancer, k):

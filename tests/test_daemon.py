@@ -278,3 +278,28 @@ def test_build_scheduler_config_refuses_wire_bytes_in_planes():
     cfg = build_scheduler_config({"default_envs": [
         {"pool-regex": ".*", "env": {"A": "line1\nline2"}}]})
     assert cfg.default_env_for_pool("x") == {"A": "line1\nline2"}
+
+
+def test_build_scheduler_config_validates_matcher_knobs():
+    """JSON-configured matcher knobs go through setattr, which bypasses
+    dataclass construction — the loader must re-validate so a typo'd
+    backend or auto_packing fails the boot, not every match cycle."""
+    import pytest
+    from cook_tpu.daemon import build_scheduler_config
+    cfg = build_scheduler_config({"default_matcher": {
+        "auto_packing": "tight", "auto_large_j_threshold": 500}})
+    assert cfg.default_matcher.auto_packing == "tight"
+    with pytest.raises(ValueError, match="auto_packing"):
+        build_scheduler_config({"default_matcher": {
+            "auto_packing": "Tight"}})
+    with pytest.raises(ValueError, match="backend"):
+        build_scheduler_config({"default_matcher": {
+            "backend": "tpu-watrfill"}})
+    # the removed backend migrates instead of failing
+    cfg = build_scheduler_config({"default_matcher": {
+        "backend": "tpu-auction-pallas"}})
+    assert cfg.default_matcher.backend == "tpu-auction"
+    # typo'd KEY also fails the boot (it would silently keep defaults)
+    with pytest.raises(ValueError, match="auto_paking"):
+        build_scheduler_config({"default_matcher": {
+            "auto_paking": "tight"}})
