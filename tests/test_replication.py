@@ -133,6 +133,63 @@ class TestMirror:
                 assert len(Store.replay_only(dir_b).jobs_where(lambda j: True)) == 1
 
 
+class TestCatchUpInterruptions:
+    def test_follower_killed_mid_catchup_reconnects_and_converges(
+            self, tmp_path):
+        """A large backlog streamed in 1 MiB chunks; the follower is
+        stopped partway, restarts, HELLOs with its trimmed offset, and
+        must converge byte-identically (incremental, same base)."""
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        store.create_jobs([make_job(i) for i in range(3000)])
+        total = journal_size(dir_a)
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port,
+                                     dir_b) as f:
+                # stop somewhere in the middle of the catch-up (the
+                # context manager guarantees cleanup if the wait raises;
+                # the explicit stop below is the intentional mid-kill)
+                wait_for(lambda: f.offset >= total // 3, timeout=10)
+                f.stop()
+            partial = journal_size(dir_b)
+            # a fast machine may finish the catch-up before the stop
+            # lands; the reconnect below then exercises HELLO-at-head
+            # instead of mid-stream resume — both are valid paths
+            assert 0 < partial <= total, (partial, total)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f2:
+                assert f2.wait_offset(total)
+        a = open(os.path.join(dir_a, "journal.jsonl"), "rb").read()
+        b = open(os.path.join(dir_b, "journal.jsonl"), "rb").read()
+        assert a == b
+        assert len(Store.replay_only(dir_b)
+                   .jobs_where(lambda j: True)) == 3000
+
+    def test_checkpoint_during_catchup_resyncs_to_new_base(self,
+                                                           tmp_path):
+        """The leader compacts WHILE a follower is still streaming the
+        old journal: the serving loop detects the moved base mid-stream
+        and full-resyncs; the mirror must end on the new snapshot +
+        post-checkpoint tail."""
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        store = Store.open(dir_a, epoch=1, shared=False)
+        store.create_jobs([make_job(i) for i in range(2500)])
+        with ReplicationServer(dir_a) as srv:
+            store.attach_replication(srv, sync=True)
+            with ReplicationFollower("127.0.0.1", srv.port, dir_b) as f:
+                # checkpoint as soon as the stream is underway
+                wait_for(lambda: f.offset > 0, timeout=10)
+                store.checkpoint()
+                store.create_jobs([make_job(i)
+                                   for i in range(2500, 2600)])
+                assert wait_for(
+                    lambda: journal_size(dir_b) == journal_size(dir_a)
+                    and os.path.exists(
+                        os.path.join(dir_b, "snapshot.json")))
+        assert len(Store.replay_only(dir_b)
+                   .jobs_where(lambda j: True)) == 2600
+
+
 class TestPromotion:
     def test_promotion_gate_refuses_unsynced_mirror(self, tmp_path):
         """A standby mid-catch-up (token written, head never reached)
