@@ -95,7 +95,8 @@ class _Txn:
         self.latch_registrations: List[Tuple[str, List[str]]] = []
         self.latch_pops: List[str] = []
 
-    def _get(self, table: str, key: str, for_write: bool) -> Any:
+    def _get(self, table: str, key: str, for_write: bool,
+             clone: bool = True) -> Any:
         wk = (table, key)
         if wk in self._deletes:
             return None
@@ -104,6 +105,10 @@ class _Txn:
         ent = getattr(self._store, "_" + table).get(key)
         if ent is None:
             return None
+        if not clone:
+            # peek mode: a guard that only INSPECTS must not pay the
+            # defensive copy; the caller promises not to mutate
+            return ent
         # Reads are deep-copied too: a transaction fn mutating a read-returned
         # entity must not leak into the store outside the write log (the
         # all-or-nothing guarantee would silently break on abort otherwise).
@@ -143,6 +148,20 @@ class _Txn:
     def delete(self, table: str, key: str) -> None:
         self._writes.pop((table, key), None)
         self._deletes.add((table, key))
+
+    def peek(self, table: str, key: str) -> Any:
+        """Txn-consistent READ-ONLY view WITHOUT the defensive clone.
+        For guards that only inspect: _get's copy-on-read exists so a
+        mutating txn fn can't leak into the store, but a guard that
+        mutates nothing pays the full entity clone for every launch.
+        The caller MUST NOT mutate the returned entity."""
+        return self._get(table, key, for_write=False, clone=False)
+
+    def peek_instances_of(self, job: Job) -> Dict[str, Instance]:
+        """``instances_of`` for read-only guards (no defensive clones):
+        one definition of "a job's instances as this txn sees them"."""
+        return {tid: inst for tid in job.instances
+                if (inst := self.peek("instances", tid)) is not None}
 
     def abort(self, reason: str) -> None:
         raise AbortTransaction(reason)
@@ -501,21 +520,26 @@ class Store:
         def _launch_all(txn: _Txn):
             out: List[Instance] = []
             failures: List[Tuple[str, str]] = []
+            t = self.clock()  # one clock read per batch (as create_jobs)
             for e in entries:
-                # guard on a READ: taking write intent first would install
-                # (and journal) the unchanged entity even when the guard
-                # denies — a lingering denied job would then append a no-op
-                # record to the redo journal every match cycle
-                job = txn.job(e["job_uuid"])
+                # guard on a non-cloning PEEK: taking write intent first
+                # would install (and journal) the unchanged entity even
+                # when the guard denies — a lingering denied job would
+                # append a no-op record to the redo journal every match
+                # cycle — and a cloning read would pay a full Job copy
+                # per launch just to inspect it (the hot path at 1000+
+                # launches/cycle; txn.job_w below still owns the single
+                # defensive clone for the mutation)
+                job = txn.peek("jobs", e["job_uuid"])
                 if job is None:
                     failures.append((e["job_uuid"], "no-such-job"))
                     continue
-                deny = machines.allowed_to_start(job, txn.instances_of(job))
+                deny = machines.allowed_to_start(
+                    job, txn.peek_instances_of(job))
                 if deny is not None:
                     failures.append((e["job_uuid"], deny))
                     continue
                 job = txn.job_w(e["job_uuid"])
-                t = self.clock()
                 hostname = e["hostname"]
                 inst = Instance(
                     task_id=e["task_id"], job_uuid=e["job_uuid"],
