@@ -200,7 +200,10 @@ class _Txn:
     def recompute_job_state(self, job: Job) -> None:
         """Re-derive job state from instances; emits job-state event on change
         (reference: :job/update-state side of :instance/update-state)."""
-        new_state, reason = machines.next_job_state(job, self.instances_of(job))
+        # next_job_state only READS the instances — the non-cloning view
+        # saves one Instance clone per live attempt on every status update
+        new_state, reason = machines.next_job_state(
+            job, self.peek_instances_of(job))
         if new_state is not job.state:
             old = job.state
             job.state = new_state
