@@ -4,9 +4,10 @@ spark/ — patches running Spark executors as Cook jobs).
 
 The building block is :class:`ServiceFarm` — a manager for N long-running
 service jobs (scale up/down, status, teardown) over the REST client —
-which both the Dask backend (:mod:`cook_tpu.ecosystem.dask_backend`) and a
-Spark standalone deployment (docs/ECOSYSTEM.md) drive.
+which the Dask backend (:mod:`cook_tpu.ecosystem.dask_backend`) and the
+Spark standalone deployment (:mod:`cook_tpu.ecosystem.spark`) drive.
 """
 
 from .service_farm import ServiceFarm  # noqa: F401
 from .dask_backend import CookCluster  # noqa: F401
+from .spark import SparkOnCook  # noqa: F401

@@ -80,22 +80,11 @@ class CookCluster:
     def start_scheduler(self, timeout_s: float = 60.0) -> str:
         """Submit the scheduler job (if needed) and resolve its address from
         the running instance's hostname."""
-        fleet = self._sched_farm.scale(1)
-        self._scheduler_uuid = fleet[0]
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            [job] = self.client.query([self._scheduler_uuid])
-            if job["state"] == "running" and job.get("instances"):
-                inst = job["instances"][-1]
-                host = inst.get("hostname", "")
-                ports = inst.get("ports") or []
-                port = ports[0] if ports else self.scheduler_port
-                self._scheduler_address = f"tcp://{host}:{port}"
-                return self._scheduler_address
-            if job["state"] in TERMINAL_STATES:
-                raise RuntimeError("dask scheduler job completed early")
-            time.sleep(0.2)
-        raise TimeoutError("dask scheduler not running within timeout")
+        self._scheduler_uuid, host, ports = \
+            self._sched_farm.start_singleton(timeout_s=timeout_s)
+        port = ports[0] if ports else self.scheduler_port
+        self._scheduler_address = f"tcp://{host}:{port}"
+        return self._scheduler_address
 
     @property
     def scheduler_address(self) -> str:

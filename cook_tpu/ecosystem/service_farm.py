@@ -152,6 +152,27 @@ class ServiceFarm:
         raise TimeoutError(
             f"{self.name}: {n} running workers not reached in {timeout_s}s")
 
+    def start_singleton(self, timeout_s: float = 60.0,
+                        poll_s: float = 0.2):
+        """Scale to ONE member and resolve its placement once running:
+        returns ``(uuid, hostname, ports)``.  The shared head-node
+        bring-up for the dask scheduler and the spark master — one
+        definition of the poll/resolve/terminal-check loop."""
+        [uuid] = self.scale(1)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            [job] = self.client.query([uuid])
+            if job["state"] == "running" and job.get("instances"):
+                inst = job["instances"][-1]
+                return (uuid, inst.get("hostname", ""),
+                        inst.get("ports") or [])
+            if job["state"] in ("completed", "success", "failed"):
+                raise RuntimeError(
+                    f"{self.name}: singleton job completed early")
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"{self.name}: singleton not running within {timeout_s}s")
+
     def close(self) -> None:
         """Kill the whole fleet."""
         if self._workers:

@@ -155,3 +155,91 @@ class TestDaskCookCluster:
         cluster_be.complete_task(job.instances[-1], exit_code=1)
         with pytest.raises((RuntimeError, TimeoutError)):
             cluster.start_scheduler(timeout_s=1.0)
+
+
+class TestSparkOnCook:
+    def test_master_then_workers_then_submit(self, system):
+        from cook_tpu.ecosystem import SparkOnCook
+        store, _cluster, sched, server = system
+        client = JobClient(server.url, user="spark")
+        with SparkOnCook(client, name="s1") as cluster:
+            fleet = cluster._master_farm.scale(1)
+            cycle(sched)
+            url = cluster.start_master(timeout_s=5.0)
+            assert url.startswith("spark://h")
+            # the master command binds the Cook-assigned ports
+            [mjob] = client.query(fleet)
+            assert "deploy.master.Master" in mjob["command"]
+            assert "${PORT0:-7077}" in mjob["command"]
+            workers = cluster.scale(3)
+            assert len(workers) == 3
+            cycle(sched)
+            assert len(cluster._workers.running()) == 3
+            # worker commands embed the resolved master URL and advertise
+            # exactly the Cook-allotted resources
+            cmds = [j["command"] for j in client.query(workers)]
+            assert all(url in c for c in cmds)
+            assert all("--cores 2" in c and "--memory 4096M" in c
+                       for c in cmds)
+            # spark-submit runs as a Cook job against the master URL
+            app = cluster.submit("wordcount.py", app_args="in.txt out",
+                                 submit_args="--deploy-mode client")
+            [ajob] = client.query([app])
+            assert ajob["command"] == (
+                f"spark-submit --master {url} --deploy-mode client "
+                "wordcount.py in.txt out")
+            cycle(sched)
+        # context exit tears the whole fleet down
+        states = {j["state"] for j in client.query(fleet + workers)}
+        assert states == {"failed"}
+
+    def test_master_completing_early_raises(self, system):
+        from cook_tpu.ecosystem import SparkOnCook
+        store, cluster_be, sched, server = system
+        client = JobClient(server.url, user="spark")
+        cluster = SparkOnCook(client, name="s2")
+        [uuid] = cluster._master_farm.scale(1)
+        cycle(sched)
+        job = store.job(uuid)
+        cluster_be.complete_task(job.instances[-1], exit_code=1)
+        with pytest.raises((RuntimeError, TimeoutError)):
+            cluster.start_master(timeout_s=1.0)
+
+    def test_readoption_same_name(self, system):
+        """A restarted SparkOnCook with the same name re-adopts the live
+        fleet (the ServiceFarm label) instead of duplicating it."""
+        from cook_tpu.ecosystem import SparkOnCook
+        _store, _c, sched, server = system
+        client = JobClient(server.url, user="spark")
+        c1 = SparkOnCook(client, name="s3")
+        c1._master_farm.scale(1)
+        cycle(sched)
+        c1.start_master(timeout_s=5.0)
+        first = set(c1.scale(2))
+        cycle(sched)
+        c2 = SparkOnCook(client, name="s3")
+        c2._master_farm.scale(1)   # adopts, does not duplicate
+        c2.start_master(timeout_s=5.0)
+        assert set(c2.scale(2)) == first
+        c2.close()
+
+    def test_fractional_worker_cpus_refused(self, system):
+        from cook_tpu.ecosystem import SparkOnCook
+        _store, _c, _s, server = system
+        client = JobClient(server.url, user="spark")
+        with pytest.raises(ValueError, match="whole number"):
+            SparkOnCook(client, name="s4",
+                        worker_spec={"cpus": 0.5, "mem": 512.0})
+
+    def test_wait_workers(self, system):
+        from cook_tpu.ecosystem import SparkOnCook
+        _store, _c, sched, server = system
+        client = JobClient(server.url, user="spark")
+        cluster = SparkOnCook(client, name="s5")
+        cluster._master_farm.scale(1)
+        cycle(sched)
+        cluster.start_master(timeout_s=5.0)
+        cluster.scale(2)
+        cycle(sched)
+        cluster.wait_workers(2, timeout_s=5.0)
+        cluster.close()
