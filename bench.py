@@ -602,6 +602,13 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     from cook_tpu.state import Job, Resources, Store, new_uuid
 
     rng = np.random.default_rng(5)
+    # optional flight-recorder section telemetry (COOK_BENCH_FLIGHT=1):
+    # per-cycle records for the timed reps — recompiles, transfer bytes,
+    # sync-wait — summarized into the section payload
+    flight_seq0 = None
+    if os.environ.get("COOK_BENCH_FLIGHT"):
+        from cook_tpu.utils.flight import recorder as _flight
+        flight_seq0 = _flight.last_seq()
     store = Store()
     hosts = [FakeHost(f"h{i}", Resources(cpus=64.0, mem=65536.0))
              for i in range(H)]
@@ -657,6 +664,9 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     out = {"p50_ms": round(pctl(samples, 50), 1),
            "p99_ms": round(pctl(samples, 99), 1),
            "launched": launched}
+    if flight_seq0 is not None:
+        from cook_tpu.utils.flight import recorder as _flight
+        out["flight"] = _flight.summary(since_seq=flight_seq0)
     print(f"driver_cycle[{n_jobs//1000}k jobs x {H//1000}k hosts] "
           f"production step_cycle p50={out['p50_ms']}ms "
           f"p99={out['p99_ms']}ms launched={launched}", file=sys.stderr)

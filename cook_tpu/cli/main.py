@@ -470,6 +470,35 @@ def cmd_admin(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """Flight-recorder access: ``cs debug cycles`` lists recent per-cycle
+    records; ``cs debug trace [TRACE_ID]`` exports one cycle's spans as
+    Chrome trace-event JSON (default: the newest recorded cycle) for
+    chrome://tracing / ui.perfetto.dev."""
+    client = clients(args)[0]
+    if args.debug_cmd == "cycles":
+        out(client.debug_cycles(limit=args.limit))
+        return 0
+    trace_id = args.trace_id
+    if not trace_id:
+        cycles = client.debug_cycles(limit=1).get("cycles", [])
+        if not cycles or not cycles[-1].get("trace_id"):
+            print("error: no cycle records yet (is the scheduler "
+                  "cycling?); pass an explicit TRACE_ID", file=sys.stderr)
+            return 1
+        trace_id = cycles[-1]["trace_id"]
+    trace = client.debug_trace(trace_id)
+    if args.out_file:
+        with open(args.out_file, "w") as f:
+            json.dump(trace, f)
+        print(f"wrote {len(trace.get('traceEvents', []))} events to "
+              f"{args.out_file} (open in chrome://tracing or "
+              "https://ui.perfetto.dev)", file=sys.stderr)
+    else:
+        out(trace)
+    return 0
+
+
 def _resolve_instance(args, uuid: str) -> Tuple[Dict, Dict]:
     """uuid (job or instance) -> (job, instance) for sandbox access
     (reference: cli/cook/querying.py query_unique_and_run)."""
@@ -753,6 +782,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("uuid", nargs=1)
     sp.add_argument("--dry-run", dest="dry_run", action="store_true")
     sp.set_defaults(fn=cmd_ssh)
+
+    sp = sub.add_parser("debug", help="flight recorder: cycle records "
+                                      "and Perfetto trace export")
+    sp.add_argument("debug_cmd", choices=["cycles", "trace"])
+    sp.add_argument("trace_id", nargs="?",
+                    help="trace to export (trace subcommand); default: "
+                         "the newest cycle record's trace")
+    sp.add_argument("--limit", type=int, default=50,
+                    help="cycle records to list (cycles subcommand)")
+    sp.add_argument("--out", dest="out_file",
+                    help="write the trace JSON here instead of stdout")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("config")
     sp.add_argument("--set-url", dest="set_url")

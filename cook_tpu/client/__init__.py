@@ -273,3 +273,15 @@ class JobClient:
 
     def metrics(self) -> str:
         return self._request("GET", "/metrics")
+
+    def debug_cycles(self, limit: int = 50) -> Dict:
+        """GET /debug/cycles — the scheduler's flight-recorder ring of
+        per-cycle records (newest last)."""
+        return self._request("GET", "/debug/cycles",
+                             params={"limit": str(limit)})
+
+    def debug_trace(self, trace_id: str) -> Dict:
+        """GET /debug/trace — one trace's spans as Chrome trace-event
+        JSON, loadable in chrome://tracing / ui.perfetto.dev."""
+        return self._request("GET", "/debug/trace",
+                             params={"trace_id": trace_id})

@@ -134,6 +134,27 @@ class TaskConstraints:
 
 
 @dataclass
+class SloConfig:
+    """Service-level objectives published by the monitor sweep
+    (sched/monitor.py) as burn-rate gauges on /metrics.
+
+    Burn rate = breach fraction / error budget: 1.0 means errors arrive
+    exactly at the rate that exhausts the budget over the SLO window,
+    >1 burns faster (page), <1 is healthy.  Objectives are deployment
+    policy, so both knobs are plain config."""
+
+    # a pending job older than this breaches the queue-latency SLO
+    queue_latency_objective_s: float = 300.0
+    # a scheduler cycle slower than this breaches the cycle-duration SLO
+    cycle_duration_objective_s: float = 1.0
+    # allowed breach fraction (0.01 = 99% of cycles/jobs within objective)
+    error_budget: float = 0.01
+    # how many recent flight-recorder cycles the cycle-duration burn
+    # rate is computed over
+    cycle_window: int = 100
+
+
+@dataclass
 class EstimatedCompletionConfig:
     """estimated-completion constraint knobs (reference:
     config/estimated-completion-config, constraints.clj:408-432). Disabled
@@ -185,6 +206,8 @@ class Config:
     straggler_interval_seconds: float = 30.0
     # user/pool gauge sweeper (monitor.clj:209)
     monitor_interval_seconds: float = 30.0
+    # queue-latency / cycle-duration SLOs exposed on /metrics
+    slo: SloConfig = field(default_factory=SloConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

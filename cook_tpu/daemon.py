@@ -92,6 +92,12 @@ def build_scheduler_config(spec: Dict) -> Config:
         for k, v in spec["task_constraints"].items():
             if hasattr(cfg.task_constraints, k):
                 setattr(cfg.task_constraints, k, v)
+    if "slo" in spec:
+        # queue-latency / cycle-duration objectives (docs/OBSERVABILITY.md)
+        for k, v in spec["slo"].items():
+            if not hasattr(cfg.slo, k):
+                raise ValueError(f"unknown slo key {k!r}")
+            setattr(cfg.slo, k, v)
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

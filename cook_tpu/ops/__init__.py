@@ -20,4 +20,4 @@ from .rebalance import (  # noqa: F401
     preemption_kernel,
 )
 from .scan import segmented_cumsum  # noqa: F401
-from . import host_prep, reference_impl  # noqa: F401
+from . import host_prep, reference_impl, telemetry  # noqa: F401

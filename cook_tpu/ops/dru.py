@@ -151,3 +151,13 @@ def user_quota_mask(job_usage: jax.Array, user_rank: jax.Array,
     cum = segment_cumsum(job_usage * valid[:, None], first_idx)
     total = cum + base_usage[user_rank]
     return valid & jnp.all(total <= quota, axis=-1)
+
+
+# recompile telemetry per kernel (see ops/telemetry.py)
+from . import telemetry as _telemetry  # noqa: E402
+
+rank_kernel = _telemetry.instrument_jit("dru.rank", rank_kernel)
+pool_quota_mask = _telemetry.instrument_jit(
+    "dru.pool_quota_mask", pool_quota_mask)
+user_quota_mask = _telemetry.instrument_jit(
+    "dru.user_quota_mask", user_quota_mask)

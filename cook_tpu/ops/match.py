@@ -420,6 +420,18 @@ def waterfill_match_kernel(inp: MatchInputs, *, num_rounds: int = 32,
     return assign, avail
 
 
+# Per-kernel recompile telemetry (ops/telemetry.py): a shape change or new
+# static-arg combination shows up as cook_jit_compile_total{kernel=...} and
+# a tag on the owning cycle's flight record instead of a silent p99 blip.
+from . import telemetry as _telemetry  # noqa: E402
+
+greedy_match_kernel = _telemetry.instrument_jit(
+    "match.greedy", greedy_match_kernel)
+auction_match_kernel = _telemetry.instrument_jit(
+    "match.auction", auction_match_kernel)
+waterfill_match_kernel = _telemetry.instrument_jit(
+    "match.waterfill", waterfill_match_kernel)
+
 # Backwards-compatible alias; the auction formulation superseded the naive
 # every-job-argmax multipass, which converged one host per pass.
 multipass_match_kernel = auction_match_kernel

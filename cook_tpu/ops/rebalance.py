@@ -77,3 +77,10 @@ def preemption_kernel(inp: RebalanceInputs) -> RebalanceDecision:
     return RebalanceDecision(found=found, spare_only=spare_only, host=host,
                              victim_mask=victim_mask,
                              decision_dru=decision_dru)
+
+
+# recompile telemetry per kernel (see ops/telemetry.py)
+from . import telemetry as _telemetry  # noqa: E402
+
+preemption_kernel = _telemetry.instrument_jit(
+    "rebalance.preemption", preemption_kernel)

@@ -1,0 +1,136 @@
+"""JAX-level device telemetry: recompile counters, transfer byte counters,
+device sync-wait accounting.
+
+Three legs, all feeding the metrics registry AND the active cycle's
+flight record (cook_tpu/utils/flight.py) so a recompile storm or transfer
+regression is attributed to the exact cycle whose p99 it blew:
+
+* :func:`instrument_jit` wraps a jitted kernel entry point; each call
+  compares the jit cache size before/after, so a tracing/compilation
+  (shape change, new static arg) increments
+  ``cook_jit_compile_total{kernel=...}``, tags the enclosing tracing span,
+  and lands on the owning CycleRecord.  Every kernel in cook_tpu/ops and
+  the fused pool-cycle executable are wrapped at definition site.
+
+* :func:`count_transfer` / :func:`sync_wait` are called by the dispatch
+  paths (sched/fused.py staging + fetch, sched/matcher.py kernel runs)
+  around ``device_put``/``copy_to_host_async``-style boundaries:
+  ``cook_device_transfer_bytes_total{direction=h2d|d2h}`` plus
+  ``cook_sync_wait_seconds`` for time spent blocked on the device.
+
+* :func:`install_jax_monitoring` (opt-in, COOK_JAX_MONITORING=1 or an
+  explicit call) forwards ``jax.monitoring`` events into
+  ``cook_jax_event_total{event=...}`` — the firehose view when the
+  per-kernel counters aren't enough.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from ..utils import tracing
+from ..utils.flight import recorder
+from ..utils.metrics import registry
+
+
+def _on_compile(kernel: str, n: int) -> None:
+    registry.counter_inc("cook_jit_compile", float(n), {"kernel": kernel})
+    recorder.note_recompile(kernel, n)
+    sp = tracing.tracer.current()
+    if sp is not None:
+        sp.set_tag("recompiles", int(sp.tags.get("recompiles", 0)) + n)
+        sp.set_tag("recompiled_kernel", kernel)
+
+
+class InstrumentedJit:
+    """Transparent wrapper over a jitted callable that detects cache
+    growth (= a fresh trace+compile) per call.  Attribute access (lower,
+    _cache_size, static argname plumbing) forwards to the wrapped fn."""
+
+    def __init__(self, kernel: str, fn):
+        self._kernel = kernel
+        self._fn = fn
+        try:
+            functools.update_wrapper(self, fn, updated=())
+        except Exception:  # jit objects without full wrapper attrs
+            pass
+
+    def __call__(self, *args, **kwargs):
+        fn = self._fn
+        before: Optional[int]
+        try:
+            before = fn._cache_size()
+        except Exception:
+            before = None
+        out = fn(*args, **kwargs)
+        if before is not None:
+            try:
+                after = fn._cache_size()
+            except Exception:
+                after = before
+            if after > before:
+                _on_compile(self._kernel, after - before)
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.__dict__["_fn"], name)
+
+
+def instrument_jit(kernel: str, fn) -> InstrumentedJit:
+    """Wrap a jitted entry point with per-kernel compile counting."""
+    return InstrumentedJit(kernel, fn)
+
+
+def count_transfer(direction: str, nbytes: int) -> None:
+    """Record ``nbytes`` crossing the host<->device boundary
+    (direction: "h2d" or "d2h")."""
+    if nbytes:
+        registry.counter_inc("cook_device_transfer_bytes", float(nbytes),
+                             {"direction": direction})
+        recorder.note_transfer(direction, nbytes)
+
+
+@contextmanager
+def sync_wait(kind: str = "fetch"):
+    """Time a block that waits on the device (device_get / block_until_
+    ready): observed on ``cook_sync_wait_seconds{kind=}`` and summed into
+    the cycle record's sync_wait_ms."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        registry.observe("cook_sync_wait_seconds", dt, {"kind": kind})
+        recorder.note_sync_wait(dt)
+
+
+_monitoring_installed = False
+
+
+def install_jax_monitoring() -> bool:
+    """Forward jax.monitoring events into the metrics registry.  Opt-in
+    (global listener, so tests and embedders choose); returns True when
+    the listeners are installed."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax without monitoring
+        return False
+    monitoring.register_event_listener(
+        lambda event, **kw: registry.counter_inc(
+            "cook_jax_event", 1.0, {"event": event}))
+    monitoring.register_event_duration_secs_listener(
+        lambda event, duration, **kw: registry.observe(
+            "cook_jax_event_duration_seconds", duration, {"event": event}))
+    _monitoring_installed = True
+    return True
+
+
+if os.environ.get("COOK_JAX_MONITORING"):  # pragma: no cover - env opt-in
+    install_jax_monitoring()

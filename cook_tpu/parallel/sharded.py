@@ -467,7 +467,13 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
     cmask transfer); with ``compact=True`` (implies structured) it takes
     CompactPoolCycleInputs — the minimum-transfer wire form the production
     fused driver sends — expanded on device by ``expand_compact``."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+        _replication_kw = "check_vma"
+    except ImportError:  # jax < 0.6 ships shard_map under experimental,
+        # where the replication-check kwarg is still called check_rep
+        from jax.experimental.shard_map import shard_map
+        _replication_kw = "check_rep"
     from jax.sharding import PartitionSpec as P
 
     # pools shard over every mesh axis: ("pool",) single-slice, or
@@ -554,5 +560,5 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
             match_valid=spec, queue_ok=spec, accepted=spec,
             matched_usage=P(), total_matched=P(), queue_rows=spec,
             n_queue=spec, cand_row=spec, cand_assign=spec, cand_qpos=spec),
-        check_vma=False)
+        **{_replication_kw: False})
     return jax.jit(sharded)

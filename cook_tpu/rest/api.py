@@ -21,6 +21,8 @@ over the stdlib threading HTTP server:
   GET    /failure_reasons
   GET    /stats/instances
   GET    /settings, /info, /debug, /metrics
+  GET    /debug/cycles?limit=       flight-recorder CycleRecords
+  GET    /debug/trace?trace_id=     Chrome/Perfetto trace-event export
   POST   /progress/<task-id>  sidecar progress callback
 
 AuthN is the reference's composable scheme reduced to HTTP basic / an
@@ -96,6 +98,8 @@ API_ROUTES = [
     ("GET", "/pools", "pool listing", False),
     ("GET", "/info", "version + leadership", False),
     ("GET", "/debug", "health + recent tracing spans", False),
+    ("GET", "/debug/cycles", "flight-recorder cycle records", False),
+    ("GET", "/debug/trace", "Chrome/Perfetto trace-event export", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -1269,6 +1273,13 @@ class CookApi:
             ("GET", "/unscheduled_jobs"): [
                 ("job", True, "repeatable"),
                 ("partial", False, "true returns the found subset")],
+            ("GET", "/debug/cycles"): [
+                ("limit", False, "newest-last record count, default 50")],
+            ("GET", "/debug/trace"): [
+                ("trace_id", True,
+                 "trace_id of a span or CycleRecord; the response is "
+                 "Chrome trace-event JSON (chrome://tracing, "
+                 "ui.perfetto.dev)")],
         }
         for method, path, summary, leader_only in API_ROUTES:
             entry = paths.setdefault(path, {})
@@ -1319,12 +1330,38 @@ class CookApi:
                 "</table></body></html>")
 
     def debug(self) -> Dict:
+        from ..utils.flight import recorder
         from ..utils.tracing import tracer
         return {"healthy": True,
                 "pools": [p.name for p in self.store.pools()],
                 "clusters": (list(self.scheduler.clusters)
                              if self.scheduler else []),
-                "recent-spans": tracer.recent(limit=50)}
+                "recent-spans": tracer.recent(limit=50),
+                "recent-cycles": recorder.recent(limit=10)}
+
+    def debug_cycles(self, params: Dict) -> Dict:
+        """GET /debug/cycles?limit= — the flight recorder's newest-last
+        CycleRecords (docs/OBSERVABILITY.md documents every field)."""
+        from ..utils.flight import recorder
+        try:
+            limit = int(params.get("limit", ["50"])[0])
+        except ValueError:
+            raise ApiError(400, "limit must be an integer")
+        return {"cycles": recorder.recent(limit=limit)}
+
+    def debug_trace(self, params: Dict) -> Dict:
+        """GET /debug/trace?trace_id= — one trace's spans as Chrome
+        trace-event JSON (load in chrome://tracing / ui.perfetto.dev).
+        CycleRecords carry their trace_id, so
+        /debug/cycles -> /debug/trace is the slow-cycle drill-down."""
+        from ..utils.tracing import tracer
+        trace_id = params.get("trace_id", [None])[0]
+        if not trace_id:
+            raise ApiError(400, "trace_id query parameter is required")
+        trace = tracer.export_chrome_trace(trace_id)
+        if not trace["traceEvents"]:
+            raise ApiError(404, f"no spans recorded for trace {trace_id}")
+        return trace
 
     def settings(self) -> Dict:
         from ..sched.rebalancer import effective_rebalancer_params
@@ -1676,8 +1713,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(500, {"error": f"internal error: {e}"})
 
     # ------------------------------------------------------------- dispatch
-    _LOCAL_PATHS = {"/info", "/debug", "/metrics", "/failure_reasons",
-                    "/settings", "/swagger-docs", "/swagger-ui"}
+    _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
+                    "/metrics", "/failure_reasons", "/settings",
+                    "/swagger-docs", "/swagger-ui"}
 
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
@@ -1725,6 +1763,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.info()
             if path == "/debug":
                 return api.debug()
+            if path == "/debug/cycles":
+                return api.debug_cycles(params)
+            if path == "/debug/trace":
+                return api.debug_trace(params)
             if path == "/swagger-docs":
                 return api.swagger_docs()
             if path == "/swagger-ui":

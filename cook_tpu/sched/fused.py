@@ -37,10 +37,12 @@ import numpy as np
 from ..cluster.base import Offer
 from ..config import Config
 from ..ops import host_prep
+from ..ops import telemetry
 from ..ops.padding import bucket, pad_to
 from ..state.schema import DruMode, Job, Pool, SchedulerKind
 from ..state.store import Store
 from ..utils import tracing
+from ..utils.flight import recorder as _flight
 from .constraints import build_constraint_mask, validate_group_placement
 from .matcher import MatchCycleResult, Matcher, _BackoffState
 from .ranker import build_user_tasks, _quota_vec, _pool_quota_vec
@@ -137,11 +139,11 @@ class FusedCycleDriver:
         fn = self._cycles.get(key)
         if fn is None:
             from ..parallel.sharded import make_pool_cycle
-            fn = make_pool_cycle(
+            fn = telemetry.instrument_jit("fused.pool_cycle", make_pool_cycle(
                 self.mesh(), gpu_mode=gpu_mode,
                 max_over_quota_jobs=self.config.max_over_quota_jobs,
                 considerable_cap=considerable_cap, structured=structured,
-                compact=compact)
+                compact=compact))
             self._cycles[key] = fn
         return fn
 
@@ -177,6 +179,8 @@ class FusedCycleDriver:
                 dchunk = np.zeros(kb, dtype=F32)
                 dchunk[:k] = disk_base[self._mir_n:n]
                 off = jnp.asarray(self._mir_n, dtype=jnp.int32)
+                telemetry.count_transfer("h2d",
+                                         chunk.nbytes + dchunk.nbytes)
                 self._mir_res = self._append(
                     self._mir_res, jnp.asarray(chunk), off)
                 self._mir_disk = self._append(
@@ -188,6 +192,7 @@ class FusedCycleDriver:
             res_p[:n] = res_base
             disk_p = np.zeros(cap, dtype=F32)
             disk_p[:n] = disk_base
+            telemetry.count_transfer("h2d", res_p.nbytes + disk_p.nbytes)
             self._mir_res = jnp.asarray(res_p)
             self._mir_disk = jnp.asarray(disk_p)
             self._mir_key, self._mir_n, self._mir_cap = compactions, n, cap
@@ -344,6 +349,7 @@ class FusedCycleDriver:
                 pp.offensive = [j for j in (store.job(str(u))
                                             for u in uuid_at(bad))
                                 if j is not None]
+                _flight.note_skips({"offensive": int(bad.sum())})
         pp.enqueue_ok = enqueue_ok
 
         # plugin launch verdicts: only when a filter is configured, and the
@@ -370,6 +376,9 @@ class FusedCycleDriver:
                         cached = self.plugins.launch_allowed(job)
                 if not cached:
                     launch_ok[i] = False
+            filtered = int((~launch_ok).sum())
+            if filtered:
+                _flight.note_skips({"launch-filtered": filtered})
         pp.launch_ok = launch_ok
 
         # launch-rate token budgets per USER (device gathers via user_rank)
@@ -527,7 +536,10 @@ class FusedCycleDriver:
         pools = [p for p in self.store.pools()
                  if p.state == "active" and p.scheduler is not SchedulerKind.DIRECT]
         packed: List[_PackedPool] = []
-        with tracing.span("fused.pack"):
+        # "cycle.rank" is the canonical rank-phase span on the cycle trace
+        # (flight.PHASE_BY_SPAN): host-side rank staging — the columnar
+        # pack that feeds the device the rank+match problem
+        with tracing.span("cycle.rank"), tracing.span("fused.pack"):
             for pool in pools:
                 pp = self._pack_pool(scheduler, pool)
                 if pp is not None:
@@ -736,36 +748,52 @@ class FusedCycleDriver:
                 print(f"[profile] stage={stage_ms}ms upload="
                       f"{(time.perf_counter()-_t)*1e3:.0f}ms "
                       f"({nbytes/1e6:.1f}MB)", file=_sys.stderr)
-            with tracing.span("fused.dispatch", pools=len(group),
-                              tasks=T, hosts=H, gpu=gpu_mode,
-                              stage_ms=stage_ms):
-                res = self._cycle_fn(gpu_mode, min(cap, T), structured,
-                                     compact=structured)(inp)
-            # fetch ONLY the compact outputs: [C]-sized candidate triples +
-            # the queue count.  The full [T] arrays (order/queue_ok/assign)
-            # and the rank-ordered queue_rows stay device-resident; the
-            # published RankedQueue fetches queue_rows lazily when a
-            # consumer actually touches the queue.  Device->host bandwidth
-            # is the cycle's scarcest resource on a tunneled chip (~10 MB/s
-            # observed): the old four-[T]-array fetch cost 2.1 MB /
-            # 210-250 ms per cycle at T=131k; this fetches ~50 KB.
-            outs = (res.cand_row, res.cand_assign, res.cand_qpos,
-                    res.n_queue)
-            for out_arr in outs:
-                copy_async = getattr(out_arr, "copy_to_host_async", None)
-                if copy_async is not None:
-                    copy_async()
-            # one batched fetch: each separate np.asarray pays a full
-            # device->host round trip (expensive on a tunneled chip)
-            import jax
-            with tracing.span("fused.fetch"):
-                cand_row, cand_assign, cand_qpos, n_queue = \
-                    jax.device_get(outs)
+            # staged wire bytes this dispatch (the device-resident base
+            # mirror fields are NOT re-uploaded per cycle — the mirror
+            # sync accounts its own uploads)
+            telemetry.count_transfer("h2d", sum(
+                getattr(a, "nbytes", 0)
+                for name, a in zip(type(inp)._fields, inp)
+                if name not in ("res_base", "disk_base")))
+            with tracing.span("cycle.match", pools=len(group), tasks=T,
+                              hosts=H, gpu=gpu_mode):
+                with tracing.span("fused.dispatch", pools=len(group),
+                                  tasks=T, hosts=H, gpu=gpu_mode,
+                                  stage_ms=stage_ms):
+                    res = self._cycle_fn(gpu_mode, min(cap, T), structured,
+                                         compact=structured)(inp)
+                # fetch ONLY the compact outputs: [C]-sized candidate
+                # triples + the queue count.  The full [T] arrays
+                # (order/queue_ok/assign) and the rank-ordered queue_rows
+                # stay device-resident; the published RankedQueue fetches
+                # queue_rows lazily when a consumer actually touches the
+                # queue.  Device->host bandwidth is the cycle's scarcest
+                # resource on a tunneled chip (~10 MB/s observed): the old
+                # four-[T]-array fetch cost 2.1 MB / 210-250 ms per cycle
+                # at T=131k; this fetches ~50 KB.
+                outs = (res.cand_row, res.cand_assign, res.cand_qpos,
+                        res.n_queue)
+                for out_arr in outs:
+                    copy_async = getattr(out_arr, "copy_to_host_async", None)
+                    if copy_async is not None:
+                        copy_async()
+                # one batched fetch: each separate np.asarray pays a full
+                # device->host round trip (expensive on a tunneled chip)
+                import jax
+                with tracing.span("fused.fetch"), \
+                        telemetry.sync_wait("fused.fetch"):
+                    cand_row, cand_assign, cand_qpos, n_queue = \
+                        jax.device_get(outs)
+                telemetry.count_transfer("d2h", sum(
+                    getattr(a, "nbytes", 0)
+                    for a in (cand_row, cand_assign, cand_qpos, n_queue)))
 
-            for i, pp in enumerate(group):
-                self._apply_pool(scheduler, pp, cand_row[i], cand_assign[i],
-                                 cand_qpos[i], int(n_queue[i]),
-                                 res.queue_rows, i, queues, results)
+            with tracing.span("cycle.launch", pools=len(group)):
+                for i, pp in enumerate(group):
+                    self._apply_pool(scheduler, pp, cand_row[i],
+                                     cand_assign[i], cand_qpos[i],
+                                     int(n_queue[i]), res.queue_rows, i,
+                                     queues, results)
         return queues, results
 
     # ----------------------------------------------------------------- apply
@@ -794,8 +822,10 @@ class FusedCycleDriver:
             # logic) actually touches the published queue
             if fetched_rows[0] is None:
                 import jax
-                fetched_rows[0] = np.asarray(jax.device_get(
-                    dev_rows[:n_queue]))
+                with telemetry.sync_wait("queue.rows"):
+                    fetched_rows[0] = np.asarray(jax.device_get(
+                        dev_rows[:n_queue]))
+                telemetry.count_transfer("d2h", fetched_rows[0].nbytes)
             return fetched_rows[0]
 
         def local_rows_with_drops(drop_qpos) -> np.ndarray:
@@ -882,4 +912,6 @@ class FusedCycleDriver:
             result.queue_pruned = True
         else:
             publish_queue()
+        _flight.note_skips({"unmatched": len(result.unmatched),
+                            "launch-failed": len(result.launch_failures)})
         results[pool_name] = result

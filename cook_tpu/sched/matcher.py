@@ -24,9 +24,12 @@ import numpy as np
 from ..cluster.base import ComputeCluster, LaunchSpec, Offer
 from ..config import Config, MatcherConfig
 from ..ops import host_prep, reference_impl
+from ..ops import telemetry
 from ..state.schema import InstanceStatus, Job, Reasons, new_uuid
 from ..state.store import Store
 from ..utils import tracing
+from ..utils.flight import recorder as flight_recorder
+from ..utils.metrics import LATENCY_BUCKETS, registry
 from .constraints import (
     LOCATION_ATTRIBUTE,
     ConstraintContext,
@@ -101,6 +104,8 @@ class Matcher:
         out: List[Job] = []
         user_tokens: Dict[str, float] = {}
         user_seen: Dict[str, int] = {}
+        # head-of-line skip reasons for the cycle's flight record
+        skips: Dict[str, int] = {}
         for job in ranked:
             quota = self.store.get_quota(job.user, pool_name)
             qvec = np.array([quota.get("cpus", np.inf), quota.get("mem", np.inf),
@@ -109,6 +114,7 @@ class Matcher:
             u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
             u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
             if not np.all(u <= qvec):
+                skips["over-quota"] = skips.get("over-quota", 0) + 1
                 continue
             # per-user-per-pool launch rate limit: each user passes at most
             # token-count jobs per cycle (reference:
@@ -120,13 +126,18 @@ class Matcher:
                 seen = user_seen.get(job.user, 0)
                 user_seen[job.user] = seen + 1
                 if seen >= int(tokens):  # a fractional token is not a launch
+                    skips["rate-limited"] = skips.get("rate-limited", 0) + 1
                     continue
             # launch-filter plugin with cached accept/defer verdicts
             if not self.plugins.launch_allowed(job):
+                skips["launch-filtered"] = \
+                    skips.get("launch-filtered", 0) + 1
                 continue
             out.append(job)
             if len(out) >= limit:
                 break
+        if skips:
+            flight_recorder.note_skips(skips)
         return out
 
     # -------------------------------------------------------------- context
@@ -268,6 +279,9 @@ class Matcher:
             else:
                 result.matched.append((job, offers[h]))
         self._launch(pool_name, result, clusters)
+        flight_recorder.note_skips({
+            "unmatched": len(result.unmatched),
+            "launch-failed": len(result.launch_failures)})
         return result
 
     def record_placement_failures(self, jobs: List[Job], assign: np.ndarray,
@@ -353,6 +367,8 @@ class Matcher:
         from ..ops import MatchInputs, auction_match_kernel, greedy_match_kernel
         from ..ops.match import waterfill_match_kernel
         arrays = host_prep.pack_match_inputs(job_res, cmask, avail, cap)
+        telemetry.count_transfer("h2d", sum(
+            getattr(a, "nbytes", 0) for a in arrays.values()))
         inp = MatchInputs(
             job_res=jnp.asarray(arrays["job_res"]),
             constraint_mask=jnp.asarray(arrays["constraint_mask"]),
@@ -390,8 +406,11 @@ class Matcher:
                 num_compaction=mc.waterfill_num_compaction)
             assign = jnp.where(assign < 0, tail_assign, assign)
         n_hosts = len(avail)
-        return (np.asarray(assign)[:arrays["num_jobs"]],
-                np.asarray(left)[:n_hosts])
+        with telemetry.sync_wait("match.fetch"):
+            assign_np = np.asarray(assign)
+            left_np = np.asarray(left)
+        telemetry.count_transfer("d2h", assign_np.nbytes + left_np.nbytes)
+        return assign_np[:arrays["num_jobs"]], left_np[:n_hosts]
 
     # ---------------------------------------------------------------- launch
     def _launch(self, pool_name: str, result: MatchCycleResult,
@@ -430,6 +449,13 @@ class Matcher:
         result.launch_failures.extend(failures)
         for inst in insts:
             job, offer = by_task[inst.task_id]
+            # launch-time wait histogram: the queue-latency SLO's
+            # companion (monitor samples pending ages; this records the
+            # realized wait of every job that actually launched)
+            registry.observe("cook_queue_latency_seconds",
+                             inst.queue_time_ms / 1000.0,
+                             labels={"pool": pool_name},
+                             buckets=LATENCY_BUCKETS)
             launch_rl.spend(pool_user_key(pool_name, job.user))
             cluster_rl.spend(offer.cluster)
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
@@ -456,6 +482,7 @@ class Matcher:
         if len(targets) == 1:
             launch_on(*targets[0])
         elif targets:
+            import contextvars
             import threading
             errors: List[BaseException] = []
 
@@ -465,9 +492,14 @@ class Matcher:
                 except BaseException as e:  # propagate after join
                     errors.append(e)
 
-            threads = [threading.Thread(target=launch_guarded, args=t,
-                                        name=f"launch-{t[0].name}")
-                       for t in targets]
+            # copy_context: the per-cluster launch spans (and their
+            # flight-record attribution) stay nested under the calling
+            # cycle's trace instead of starting orphan root traces
+            threads = [threading.Thread(
+                target=contextvars.copy_context().run,
+                args=(launch_guarded,) + t,
+                name=f"launch-{t[0].name}")
+                for t in targets]
             for th in threads:
                 th.start()
             for th in threads:

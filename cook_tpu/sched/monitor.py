@@ -10,14 +10,23 @@ their quota on every dimension), **hungry** (waiting but not starved) and
 as gauges — including an aggregated pseudo-user ``all`` and zeroing of
 series for users that disappeared since the previous sweep
 (clear-old-counters!, monitor.clj:137-156).
+
+The sweep is also the SLO layer (config.SloConfig): per-pool pending-age
+distributions vs the queue-latency objective and the flight recorder's
+recent cycle durations vs the cycle-duration objective, published as
+``cook_slo_objective_seconds`` / ``cook_slo_breach_ratio`` /
+``cook_slo_burn_rate`` gauges plus a sampled
+``cook_queue_latency_seconds`` histogram — the alerting surface every
+perf PR is judged against (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..config import Config, SloConfig
 from ..state.store import Store
-from ..utils.metrics import MetricsRegistry
+from ..utils.metrics import LATENCY_BUCKETS, MetricsRegistry
 from ..utils.metrics import registry as default_registry
 
 _STAT_DIMS = ("cpus", "mem", "jobs")
@@ -97,9 +106,12 @@ class Monitor:
     (start-collecting-stats, monitor.clj:209)."""
 
     def __init__(self, store: Store,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 config: Optional[Config] = None):
         self.store = store
         self.registry = registry if registry is not None else default_registry
+        self.slo: SloConfig = (config.slo if config is not None
+                               else SloConfig())
         # (pool, state) -> {user -> stats} from the previous sweep, so
         # series for vanished users can be zeroed
         self._previous: Dict[Tuple[str, str], Dict[str, Dict]] = {}
@@ -112,15 +124,18 @@ class Monitor:
         out: Dict[str, Dict[str, int]] = {}
         for pool in self.store.pools():
             out[pool.name] = self._sweep_pool(pool.name)
+        self._sweep_cycle_slo()
         return out
 
     def _sweep_pool(self, pool_name: str) -> Dict[str, int]:
+        pending = self.store.pending_jobs(pool_name)
         running_stats = _job_stats([
             (job.user, job.resources.cpus, job.resources.mem)
             for job, _inst in self.store.running_instances(pool_name)])
         waiting_stats = _job_stats([
             (job.user, job.resources.cpus, job.resources.mem)
-            for job in self.store.pending_jobs(pool_name)])
+            for job in pending])
+        self._sweep_queue_slo(pool_name, pending)
         starved = compute_starved_stats(
             self.store, pool_name, running_stats, waiting_stats)
         under_quota = compute_waiting_under_quota_stats(
@@ -165,3 +180,53 @@ class Monitor:
                     "cook_user_resource", float(s.get(dim, 0.0)),
                     labels={"pool": pool_name, "user": user, "state": state,
                             "resource": dim})
+
+    # ------------------------------------------------------------------- SLO
+    def _publish_slo(self, slo_name: str, objective_s: float,
+                     breach_ratio: float,
+                     pool: Optional[str] = None) -> None:
+        labels = {"slo": slo_name}
+        if pool is not None:
+            labels["pool"] = pool
+        self.registry.gauge_set("cook_slo_objective_seconds", objective_s,
+                                labels=labels)
+        self.registry.gauge_set("cook_slo_breach_ratio", breach_ratio,
+                                labels=labels)
+        budget = max(self.slo.error_budget, 1e-9)
+        self.registry.gauge_set("cook_slo_burn_rate", breach_ratio / budget,
+                                labels=labels)
+
+    def _sweep_queue_slo(self, pool_name: str, pending) -> None:
+        """Pending-age distribution vs the queue-latency objective.  Ages
+        are sampled at sweep time (a job still waiting counts against the
+        SLO *now*, not only once it finally launches — the launch-time
+        wait histogram is observed separately by the matcher).  The age
+        basis is the CURRENT wait (last_waiting_start_ms, the same basis
+        the store stamps queue_time_ms from): a retried job re-enters the
+        queue with a fresh clock, it does not inherit hours of prior
+        runtime as instant SLO breach."""
+        now_ms = self.store.clock()
+        ages = [(now_ms - (j.last_waiting_start_ms or j.submit_time_ms))
+                / 1000.0 for j in pending]
+        self.registry.observe_many("cook_queue_age_seconds", ages,
+                                   labels={"pool": pool_name},
+                                   buckets=LATENCY_BUCKETS)
+        obj = self.slo.queue_latency_objective_s
+        breach = sum(1 for a in ages if a > obj)
+        ratio = breach / len(ages) if ages else 0.0
+        self._publish_slo("queue-latency", obj, ratio, pool=pool_name)
+
+    def _sweep_cycle_slo(self) -> None:
+        """Cycle-duration burn rate over the flight recorder's recent
+        window (fused/match cycles only — rank/rebalance cadences have
+        their own budgets and would dilute the signal)."""
+        from ..utils.flight import recorder
+        obj = self.slo.cycle_duration_objective_s
+        # kind-filtered BEFORE the window cut: rank/rebalance records
+        # interleave with the match cadence and would otherwise silently
+        # shrink the configured window
+        durations = recorder.recent_durations(("fused", "match"),
+                                              self.slo.cycle_window)
+        breach = sum(1 for d in durations if d > obj * 1000.0)
+        ratio = breach / len(durations) if durations else 0.0
+        self._publish_slo("cycle-duration", obj, ratio)

@@ -39,6 +39,7 @@ from ..state.schema import (
 )
 from ..state.store import AbortTransaction, Store
 from ..utils import tracing
+from ..utils.flight import recorder as flight_recorder
 from .matcher import MatchCycleResult, Matcher
 from .ranker import Ranker
 from .rebalancer import Rebalancer
@@ -60,7 +61,7 @@ class Scheduler:
                                rate_limits=self.rate_limits)
         self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
         from .monitor import Monitor
-        self.monitor = Monitor(store)
+        self.monitor = Monitor(store, config=self.config)
         from .heartbeat import HeartbeatTracker
         self.heartbeats = HeartbeatTracker(self.config.heartbeat_timeout_ms)
         # Heartbeat stamps and reaper sweeps follow the store's injectable
@@ -198,7 +199,7 @@ class Scheduler:
         """Rank cycle across all schedulable pools (reference: rank-jobs +
         reset! pool-name->pending-jobs-atom, scheduler.clj:2286-2296)."""
         queues: Dict[str, List[Job]] = {}
-        with tracing.span("rank.cycle"):
+        with flight_recorder.cycle(kind="rank"), tracing.span("rank.cycle"):
             for pool in self.store.pools():
                 if pool.state != "active":
                     continue
@@ -270,56 +271,65 @@ class Scheduler:
             self._fused = FusedCycleDriver(
                 self.store, self.config, self.matcher, self.plugins,
                 self.rate_limits)
-        import gc
-        gc_paused = self.gc_discipline and gc.isenabled()
-        if gc_paused:
-            gc.disable()
-        try:
-            with tracing.span("fused.cycle"):
-                queues, results = self._fused.step(self)
-        finally:
+        with flight_recorder.cycle(kind="fused") as rec:
+            import gc
+            gc_paused = self.gc_discipline and gc.isenabled()
             if gc_paused:
-                gc.enable()
-                self._gc_cycles += 1
-                # collect after the FIRST cycle (freeze the heap the
-                # warm-up built) and then every 10th
-                if self._gc_cycles == 1 or self._gc_cycles % 10 == 0:
-                    self._gc_collect_due = True
-        # direct pools: host rank + backpressure submission
-        for pool in self.store.pools():
-            if pool.state != "active" or pool.scheduler is not SchedulerKind.DIRECT:
-                continue
-            ranked = self._filter_offensive_jobs(
-                self.ranker.rank_pool(pool.name, pool.dru_mode))
-            queues[pool.name] = ranked
-            results[pool.name] = self._match_direct(pool.name, ranked)
-        # queues were computed pre-launch; prune the jobs this cycle
-        # launched so consumers (rebalancer, /queue, direct pools) see
-        # current state.  Pools whose producer already dropped launches by
-        # exact queue position (fused _apply_pool) are skipped — the
-        # full-queue isin scan is O(T) string work at the 100k+ scale.
-        launched_uuids = set()
-        for pool_name, result in results.items():
-            if result.queue_pruned:
-                continue
-            launched_uuids.update(result.launched_job_uuids)
-        if launched_uuids:
-            from .ranker import RankedQueue
+                gc.disable()
+            try:
+                with tracing.span("fused.cycle"):
+                    queues, results = self._fused.step(self)
+            finally:
+                if gc_paused:
+                    gc.enable()
+                    self._gc_cycles += 1
+                    # collect after the FIRST cycle (freeze the heap the
+                    # warm-up built) and then every 10th
+                    if self._gc_cycles == 1 or self._gc_cycles % 10 == 0:
+                        self._gc_collect_due = True
+            # direct pools: host rank + backpressure submission
+            for pool in self.store.pools():
+                if pool.state != "active" \
+                        or pool.scheduler is not SchedulerKind.DIRECT:
+                    continue
+                ranked = self._filter_offensive_jobs(
+                    self.ranker.rank_pool(pool.name, pool.dru_mode))
+                queues[pool.name] = ranked
+                results[pool.name] = self._match_direct(pool.name, ranked)
+            # queues were computed pre-launch; prune the jobs this cycle
+            # launched so consumers (rebalancer, /queue, direct pools) see
+            # current state.  Pools whose producer already dropped launches
+            # by exact queue position (fused _apply_pool) are skipped — the
+            # full-queue isin scan is O(T) string work at the 100k+ scale.
+            launched_uuids = set()
+            for pool_name, result in results.items():
+                if result.queue_pruned:
+                    continue
+                launched_uuids.update(result.launched_job_uuids)
+            if launched_uuids:
+                from .ranker import RankedQueue
 
-            def prune(q):
-                if isinstance(q, RankedQueue):
-                    # columnar: vectorized, no full-queue materialization
-                    import numpy as np
-                    return q.filtered(~np.isin(q.uuids,
-                                               list(launched_uuids)))
-                return [j for j in q if j.uuid not in launched_uuids]
-            queues = {p: (q if results.get(p) is not None
-                          and results[p].queue_pruned else prune(q))
-                      for p, q in queues.items()}
-        self.pending_queues = queues
-        for pool_name, result in results.items():
-            self._autoscale(pool_name, result)
-        self.last_match_results.update(results)
+                def prune(q):
+                    if isinstance(q, RankedQueue):
+                        # columnar: vectorized, no full-queue
+                        # materialization
+                        import numpy as np
+                        return q.filtered(~np.isin(q.uuids,
+                                                   list(launched_uuids)))
+                    return [j for j in q if j.uuid not in launched_uuids]
+                queues = {p: (q if results.get(p) is not None
+                              and results[p].queue_pruned else prune(q))
+                          for p, q in queues.items()}
+            self.pending_queues = queues
+            for pool_name, result in results.items():
+                self._autoscale(pool_name, result)
+            self.last_match_results.update(results)
+            if rec is not None:
+                rec.pools = len(results)
+                rec.jobs_considered = sum(r.considered
+                                          for r in results.values())
+                rec.jobs_placed = sum(len(r.launched_task_ids)
+                                      for r in results.values())
         return results
 
     def step_match(self, pool_name: Optional[str] = None
@@ -328,23 +338,31 @@ class Scheduler:
         results: Dict[str, MatchCycleResult] = {}
         pools = ([p for p in self.store.pools() if p.name == pool_name]
                  if pool_name else self.store.pools())
-        for pool in pools:
-            if pool.state != "active":
-                continue
-            ranked = self.pending_queues.get(pool.name, [])
-            with tracing.span("scheduler.pool-handler", pool=pool.name):
-                if pool.scheduler is SchedulerKind.DIRECT:
-                    results[pool.name] = self._match_direct(pool.name, ranked)
+        with flight_recorder.cycle(kind="match") as rec:
+            for pool in pools:
+                if pool.state != "active":
                     continue
-                offers = []
-                for cluster in list(self.clusters.values()):
-                    if cluster.accepts_pool(pool.name):
-                        offers.extend(cluster.pending_offers(pool.name))
-                result = self.matcher.match_pool(
-                    pool.name, ranked, offers, self.clusters,
-                    reserved_hosts=self.reserved_hosts)
-                results[pool.name] = result
-                self._autoscale(pool.name, result)
+                ranked = self.pending_queues.get(pool.name, [])
+                with tracing.span("scheduler.pool-handler", pool=pool.name):
+                    if pool.scheduler is SchedulerKind.DIRECT:
+                        results[pool.name] = self._match_direct(pool.name,
+                                                                ranked)
+                        continue
+                    offers = []
+                    for cluster in list(self.clusters.values()):
+                        if cluster.accepts_pool(pool.name):
+                            offers.extend(cluster.pending_offers(pool.name))
+                    result = self.matcher.match_pool(
+                        pool.name, ranked, offers, self.clusters,
+                        reserved_hosts=self.reserved_hosts)
+                    results[pool.name] = result
+                    self._autoscale(pool.name, result)
+            if rec is not None:
+                rec.pools = len(results)
+                rec.jobs_considered = sum(r.considered
+                                          for r in results.values())
+                rec.jobs_placed = sum(len(r.launched_task_ids)
+                                      for r in results.values())
         self.last_match_results.update(results)
         return results
 
@@ -380,6 +398,7 @@ class Scheduler:
                     if c.accepts_pool(pool_name)]
         if not clusters:
             result.unmatched = considerable
+            flight_recorder.note_skips({"unmatched": len(result.unmatched)})
             return result
         from ..policy import pool_user_key
         launch_rl = self.rate_limits.job_launch
@@ -414,6 +433,9 @@ class Scheduler:
                 cluster.kill_lock.release_read()
             result.launched_task_ids.append(task_id)
             result.launched_job_uuids.append(job.uuid)
+        flight_recorder.note_skips({
+            "unmatched": len(result.unmatched),
+            "launch-failed": len(result.launch_failures)})
         return result
 
     def step_rebalance(self) -> Dict[str, list]:
@@ -421,18 +443,29 @@ class Scheduler:
         if not self.rebalancer.effective_params().enabled:
             return {}
         decisions: Dict[str, list] = {}
-        for pool in self.store.pools():
-            if pool.state != "active":
-                continue
-            with tracing.span("rebalancer.pool", pool=pool.name):
-                pool_decisions = self.rebalancer.rebalance_pool(
-                    pool.name, pool.dru_mode,
-                    self.pending_queues.get(pool.name, []), self.clusters)
-            if pool_decisions:
-                decisions[pool.name] = pool_decisions
-                for d in pool_decisions:
-                    if len(d.victim_task_ids) > 1:
-                        self.reserved_hosts[d.job_uuid] = d.hostname
+        with flight_recorder.cycle(kind="rebalance") as rec:
+            for pool in self.store.pools():
+                if pool.state != "active":
+                    continue
+                with tracing.span("rebalancer.pool", pool=pool.name):
+                    pool_decisions = self.rebalancer.rebalance_pool(
+                        pool.name, pool.dru_mode,
+                        self.pending_queues.get(pool.name, []), self.clusters)
+                if pool_decisions:
+                    decisions[pool.name] = pool_decisions
+                    victims = sum(len(d.victim_task_ids)
+                                  for d in pool_decisions)
+                    if victims:
+                        from ..utils.metrics import registry
+                        registry.counter_inc("cook_preemptions",
+                                             float(victims),
+                                             {"pool": pool.name})
+                        flight_recorder.note_preemptions(victims)
+                    for d in pool_decisions:
+                        if len(d.victim_task_ids) > 1:
+                            self.reserved_hosts[d.job_uuid] = d.hostname
+            if rec is not None:
+                rec.pools = len(decisions)
         return decisions
 
     # --------------------------------------------------------------- reapers

@@ -41,6 +41,9 @@ class SimResult:
     rank_wall_ms: List[float] = field(default_factory=list)
     placements: int = 0
     task_records: List[Dict] = field(default_factory=list)
+    # flight-recorder aggregate over this run's cycles (utils/flight.py):
+    # cycle count/percentiles, recompiles, transfer bytes, skip reasons
+    flight: Dict = field(default_factory=dict)
 
     def summary(self) -> Dict:
         wt = np.asarray(self.wait_times_ms or [0])
@@ -60,6 +63,7 @@ class SimResult:
             "placements": self.placements,
             "placements_per_wall_s": (self.placements / wall_s
                                       if wall_s > 0 else float("inf")),
+            "flight": self.flight,
         }
 
 
@@ -126,9 +130,12 @@ class Simulator:
 
     def run(self, until_ms: Optional[int] = None,
             max_virtual_ms: int = 24 * 3600 * 1000) -> SimResult:
+        from ..utils.flight import recorder as flight_recorder
         result = SimResult(total=len(self.trace))
         if not self.trace:
             return result
+        # the flight-recorder summary covers only THIS run's cycles
+        flight_seq0 = flight_recorder.last_seq()
         pending = list(self.trace)
         now = pending[0].submit_time_ms
         # every stamp (queue/start/end times, heartbeats, reaper sweeps)
@@ -193,6 +200,7 @@ class Simulator:
                 break
 
         # harvest
+        result.flight = flight_recorder.summary(since_seq=flight_seq0)
         result.makespan_ms = now - start_ms
         for job in self.trace:
             stored = self.store.job(job.uuid)

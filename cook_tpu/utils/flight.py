@@ -1,0 +1,269 @@
+"""Scheduler flight recorder: a fixed ring of per-cycle CycleRecords.
+
+The per-cycle correlation layer the span ring alone can't give: every
+driver cycle (fused production dispatch, split rank/match, rebalance)
+opens a :meth:`FlightRecorder.cycle` context that
+
+  1. roots a ``cycle`` tracing span, so every nested span (pack, kernel
+     dispatch, fetch, launch RPC) shares the cycle's trace_id and the
+     whole cycle exports as one Chrome/Perfetto flamegraph
+     (``GET /debug/trace?trace_id=``);
+  2. collects the cycle's device telemetry — recompiles per kernel,
+     host<->device bytes, device sync-wait time (fed by
+     cook_tpu.ops.telemetry), head-of-line skip reasons, preemptions,
+     jobs considered/placed;
+  3. on exit harvests the trace's spans into per-phase durations
+     (rank / match / launch / rebalance) and lands the finished record in
+     a fixed-size ring served by ``GET /debug/cycles`` and the
+     ``cook-tpu debug cycles`` CLI.
+
+This is the repro of the reference's structured match-cycle log documents
+(scheduler.clj match cycle logging + prometheus_metrics.clj with-duration
+tri-recording), extended with the JAX-level counters the reference never
+needed: a recompile storm or transfer regression shows up as a labeled
+field on the slow cycle's record, not a mystery p99 blip.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from cook_tpu.utils import tracing
+from cook_tpu.utils.metrics import registry
+
+_DEFAULT_CAPACITY = 512
+
+# span name -> canonical phase; phase durations on a CycleRecord are the
+# sum of the trace's span durations per phase.  Only TOP-LEVEL phase spans
+# are mapped (cycle.rank contains fused.pack; summing both would double
+# count), the finer span names stay visible in the trace export.
+PHASE_BY_SPAN = {
+    "cycle.rank": "rank",
+    "rank.cycle": "rank",
+    "cycle.match": "match",
+    "scheduler.pool-handler": "match",
+    "cycle.launch": "launch",
+    "rebalancer.pool": "rebalance",
+}
+
+_current_record: "contextvars.ContextVar[Optional[CycleRecord]]" = \
+    contextvars.ContextVar("cook_cycle_record", default=None)
+
+
+class CycleRecord:
+    """One scheduler cycle's instrument-panel readings."""
+
+    __slots__ = ("seq", "kind", "trace_id", "start_s", "duration_ms",
+                 "phases", "pools", "jobs_considered", "jobs_placed",
+                 "skip_reasons", "preemptions", "recompiles", "h2d_bytes",
+                 "d2h_bytes", "sync_wait_ms", "error", "_t0")
+
+    def __init__(self, seq: int, kind: str):
+        self.seq = seq
+        self.kind = kind
+        self.trace_id: Optional[str] = None
+        self.start_s = time.time()
+        self.duration_ms = 0.0
+        self.phases: Dict[str, float] = {}       # phase -> ms
+        self.pools = 0
+        self.jobs_considered = 0
+        self.jobs_placed = 0
+        self.skip_reasons: Dict[str, int] = {}   # reason -> count
+        self.preemptions = 0
+        self.recompiles: Dict[str, int] = {}     # kernel -> compiles
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.sync_wait_ms = 0.0
+        self.error: Optional[str] = None
+        self._t0 = time.perf_counter()
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq, "kind": self.kind, "trace_id": self.trace_id,
+            "start": self.start_s, "duration_ms": round(self.duration_ms, 3),
+            "phases_ms": {k: round(v, 3) for k, v in self.phases.items()},
+            "pools": self.pools,
+            "jobs_considered": self.jobs_considered,
+            "jobs_placed": self.jobs_placed,
+            "skip_reasons": dict(self.skip_reasons),
+            "preemptions": self.preemptions,
+            "recompiles": dict(self.recompiles),
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "sync_wait_ms": round(self.sync_wait_ms, 3),
+            "error": self.error,
+        }
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: "deque[CycleRecord]" = deque(maxlen=capacity)
+        self._seq = 0
+        self.enabled = True
+
+    # ------------------------------------------------------------- lifecycle
+    @contextmanager
+    def cycle(self, kind: str = "cycle", **tags: Any):
+        """Open (or join) the current cycle record.  Re-entrant: a nested
+        call (e.g. a sub-step that can also run standalone) joins the
+        enclosing record instead of splitting the cycle in two."""
+        cur = _current_record.get()
+        if not self.enabled or cur is not None:
+            yield cur
+            return
+        with self._lock:
+            self._seq += 1
+            rec = CycleRecord(self._seq, kind)
+        token = _current_record.set(rec)
+        try:
+            with tracing.span("cycle", kind=kind, seq=rec.seq, **tags) as sp:
+                rec.trace_id = getattr(sp, "trace_id", None)
+                yield rec
+        except BaseException as exc:
+            rec.error = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            _current_record.reset(token)
+            rec.duration_ms = (time.perf_counter() - rec._t0) * 1000.0
+            self._finish(rec)
+
+    def _finish(self, rec: CycleRecord) -> None:
+        if rec.trace_id is not None:
+            for doc in tracing.tracer.traces(rec.trace_id):
+                phase = PHASE_BY_SPAN.get(doc["span"])
+                if phase is not None:
+                    rec.phases[phase] = rec.phases.get(phase, 0.0) \
+                        + (doc.get("duration_ms") or 0.0)
+        with self._lock:
+            self._ring.append(rec)
+        registry.observe("cook_cycle_duration_seconds",
+                         rec.duration_ms / 1000.0, {"kind": rec.kind})
+        if rec.jobs_considered:
+            registry.counter_inc("cook_cycle_jobs_considered",
+                                 rec.jobs_considered)
+        if rec.jobs_placed:
+            registry.counter_inc("cook_cycle_jobs_placed", rec.jobs_placed)
+
+    # ------------------------------------------------------------- telemetry
+    def current(self) -> Optional[CycleRecord]:
+        return _current_record.get()
+
+    def note_recompile(self, kernel: str, n: int = 1) -> None:
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.recompiles[kernel] = rec.recompiles.get(kernel, 0) + n
+
+    def note_transfer(self, direction: str, nbytes: int) -> None:
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                if direction == "h2d":
+                    rec.h2d_bytes += int(nbytes)
+                else:
+                    rec.d2h_bytes += int(nbytes)
+
+    def note_sync_wait(self, seconds: float) -> None:
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.sync_wait_ms += seconds * 1000.0
+
+    def note_skips(self, reasons: Dict[str, int]) -> None:
+        """Head-of-line skip reasons histogram (why a pending job was
+        passed over this cycle: over-quota, rate-limited, launch-filtered,
+        offensive, unmatched, launch-failed)."""
+        rec = _current_record.get()
+        if rec is None:
+            return
+        with self._lock:
+            for reason, n in reasons.items():
+                if n:
+                    rec.skip_reasons[reason] = \
+                        rec.skip_reasons.get(reason, 0) + int(n)
+
+    def note_preemptions(self, n: int) -> None:
+        rec = _current_record.get()
+        if rec is not None and n:
+            with self._lock:
+                rec.preemptions += int(n)
+
+    # ----------------------------------------------------------------- query
+    def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-last list of finished cycle record documents."""
+        limit = int(limit)
+        if limit <= 0:
+            return []
+        with self._lock:
+            records = list(self._ring)
+        return [r.to_doc() for r in records[-limit:]]
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def recent_durations(self, kinds, limit: int) -> List[float]:
+        """duration_ms of the newest ``limit`` records of the given kinds,
+        oldest first — the SLO sweep's cheap periodic read (no to_doc
+        dict materialization for the whole ring)."""
+        with self._lock:
+            records = list(self._ring)
+        out = [r.duration_ms for r in records if r.kind in kinds]
+        return out[-max(int(limit), 0):] if limit > 0 else []
+
+    def summary(self, since_seq: int = 0) -> Dict[str, Any]:
+        """Aggregate over records with seq > since_seq (the simulator and
+        bench sections snapshot last_seq() at start and summarize their
+        own cycles at the end).  A run longer than the ring capacity is
+        reported with ``truncated``/``cycles_evicted`` so an aggregate
+        over a partial window is never mistaken for the whole run."""
+        with self._lock:
+            records = [r for r in self._ring if r.seq > since_seq]
+            oldest = self._ring[0].seq if self._ring else self._seq + 1
+        if not records:
+            return {"cycles": 0}
+        evicted = max(0, oldest - since_seq - 1)
+        durs = sorted(r.duration_ms for r in records)
+
+        def pctl(q: float) -> float:
+            idx = min(len(durs) - 1, int(round(q / 100.0 * (len(durs) - 1))))
+            return round(durs[idx], 3)
+
+        by_kind: Dict[str, int] = {}
+        recompiles: Dict[str, int] = {}
+        skips: Dict[str, int] = {}
+        for r in records:
+            by_kind[r.kind] = by_kind.get(r.kind, 0) + 1
+            for k, v in r.recompiles.items():
+                recompiles[k] = recompiles.get(k, 0) + v
+            for k, v in r.skip_reasons.items():
+                skips[k] = skips.get(k, 0) + v
+        return {
+            "cycles": len(records),
+            **({"truncated": True, "cycles_evicted": evicted}
+               if evicted else {}),
+            "by_kind": by_kind,
+            "cycle_ms_p50": pctl(50),
+            "cycle_ms_p99": pctl(99),
+            "jobs_considered": sum(r.jobs_considered for r in records),
+            "jobs_placed": sum(r.jobs_placed for r in records),
+            "preemptions": sum(r.preemptions for r in records),
+            "recompiles": recompiles,
+            "skip_reasons": skips,
+            "h2d_bytes": sum(r.h2d_bytes for r in records),
+            "d2h_bytes": sum(r.d2h_bytes for r in records),
+            "sync_wait_ms": round(sum(r.sync_wait_ms for r in records), 3),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+recorder = FlightRecorder()

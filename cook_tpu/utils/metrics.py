@@ -16,15 +16,29 @@ from typing import Dict, List, Optional, Tuple
 _BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
             5.0, 10.0)
 
+# wait/age histograms (queue latency SLOs) live on second-to-hour scales
+# the default duration buckets can't resolve
+LATENCY_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                   1800.0, 3600.0, 7200.0, 14400.0)
+
 
 def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping (exposition spec: label_value
+    may contain any UTF-8 but ``\\``, ``"`` and line feeds must be escaped
+    as ``\\\\``, ``\\"`` and ``\\n``).  Without this, a label like
+    reason="no \"fit\"" corrupts the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _labels_str(key: Tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -48,18 +62,56 @@ class MetricsRegistry:
             self._gauges[(name, _labels_key(labels))] = value
 
     def observe(self, name: str, value_s: float,
-                labels: Optional[Dict[str, str]] = None) -> None:
+                labels: Optional[Dict[str, str]] = None,
+                buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Record one histogram observation.  ``buckets`` fixes the bound
+        set on FIRST observation of a series (later values are ignored —
+        cumulative bucket counts cannot be re-bucketed); default is the
+        sub-second duration ladder, pass ``LATENCY_BUCKETS`` for
+        second-to-hour wait times."""
         key = (name, _labels_key(labels))
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
-                h = {"buckets": [0] * len(_BUCKETS), "count": 0, "sum": 0.0}
+                bounds = tuple(buckets) if buckets is not None else _BUCKETS
+                h = {"bounds": bounds, "buckets": [0] * len(bounds),
+                     "count": 0, "sum": 0.0}
                 self._histograms[key] = h
-            for i, b in enumerate(_BUCKETS):
+            for i, b in enumerate(h["bounds"]):
                 if value_s <= b:
                     h["buckets"][i] += 1
             h["count"] += 1
             h["sum"] += value_s
+
+    def observe_many(self, name: str, values_s,
+                     labels: Optional[Dict[str, str]] = None,
+                     buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Bulk histogram observation: per-bucket counts are computed
+        OUTSIDE the lock (one sort + searchsorted), then merged under one
+        lock hold — the monitor's 100k-pending-job age sweep must not
+        turn into 100k individual locked bucket scans."""
+        import numpy as np
+        vals = np.asarray(list(values_s), dtype=float)
+        if vals.size == 0:
+            return
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                bounds = tuple(buckets) if buckets is not None else _BUCKETS
+                h = {"bounds": bounds, "buckets": [0] * len(bounds),
+                     "count": 0, "sum": 0.0}
+                self._histograms[key] = h
+            bounds = h["bounds"]
+        # cumulative "value <= bound" counts, vectorized and unlocked
+        counts = np.searchsorted(np.sort(vals), np.asarray(bounds),
+                                 side="right")
+        total, vsum = int(vals.size), float(vals.sum())
+        with self._lock:
+            for i, c in enumerate(counts):
+                h["buckets"][i] += int(c)
+            h["count"] += total
+            h["sum"] += vsum
 
     @contextmanager
     def time(self, name: str, labels: Optional[Dict[str, str]] = None):
@@ -90,7 +142,7 @@ class MetricsRegistry:
             for (name, key), value in sorted(self._gauges.items()):
                 lines.append(f"{name}{_labels_str(key)} {value}")
             for (name, key), h in sorted(self._histograms.items()):
-                for i, b in enumerate(_BUCKETS):
+                for i, b in enumerate(h.get("bounds", _BUCKETS)):
                     bucket_key = key + (("le", str(b)),)
                     lines.append(f"{name}_bucket{_labels_str(bucket_key)} "
                                  f"{h['buckets'][i]}")

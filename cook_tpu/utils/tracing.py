@@ -13,13 +13,24 @@ with-duration + structured match-cycle log documents): each finished span
   2. emits a structured JSON log line on the `cook.trace` logger,
   3. lands in an in-memory ring buffer served by the /debug REST endpoint.
 
-Spans nest via a thread-local stack so kernel dispatch spans inherit a
+Spans nest via a ``contextvars`` stack so kernel dispatch spans inherit a
 trace id from the enclosing cycle span — enough to reconstruct per-cycle
 flamegraphs offline without an external collector (zero-egress friendly).
+Context variables (unlike the previous thread-local stack) survive the
+async/executor boundaries the fused dispatch path uses: a launch thread
+started under ``contextvars.copy_context().run`` keeps its kernel spans
+under the owning cycle's trace_id, while plain ``threading.Thread``
+workers still start with an empty stack (fresh root traces).
+
+The whole span ring of one trace can be exported as Chrome/Perfetto
+trace-event JSON (:meth:`Tracer.export_chrome_trace`), served by
+``GET /debug/trace?trace_id=`` — load it in ``chrome://tracing`` or
+https://ui.perfetto.dev to see the cycle flamegraph.
 """
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
 import time
@@ -32,6 +43,12 @@ from cook_tpu.utils.metrics import registry
 _log = logging.getLogger("cook.trace")
 
 _MAX_FINISHED = 4096
+
+# The span stack is an immutable tuple in a context variable: each span
+# push/pop is a set/reset, so a context copied into an executor sees a
+# consistent snapshot and mutations never leak between contexts.
+_stack_var: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "cook_span_stack", default=())
 
 
 class Span:
@@ -62,20 +79,12 @@ class Span:
 
 class Tracer:
     def __init__(self) -> None:
-        self._tls = threading.local()
         self._lock = threading.Lock()
         self.finished: List[Dict[str, Any]] = []
         self.enabled = True
 
-    def _stack(self) -> List[Span]:
-        st = getattr(self._tls, "stack", None)
-        if st is None:
-            st = []
-            self._tls.stack = st
-        return st
-
     def current(self) -> Optional[Span]:
-        st = self._stack()
+        st = _stack_var.get()
         return st[-1] if st else None
 
     @contextmanager
@@ -90,7 +99,7 @@ class Tracer:
         trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
         parent_id = parent.span_id if parent else None
         sp = Span(name, trace_id, parent_id, tags)
-        self._stack().append(sp)
+        token = _stack_var.set(_stack_var.get() + (sp,))
         t0 = time.perf_counter()
         try:
             yield sp
@@ -99,7 +108,7 @@ class Tracer:
             raise
         finally:
             sp.duration_s = time.perf_counter() - t0
-            self._stack().pop()
+            _stack_var.reset(token)
             self._record(sp)
 
     def _record(self, sp: Span) -> None:
@@ -121,12 +130,55 @@ class Tracer:
         with self._lock:
             if name is None:
                 return self.finished[-limit:]
-            docs = [d for d in self.finished if d["span"] == name]
-        return docs[-limit:]
+            # copy under the lock, filter OUTSIDE it: the name scan is
+            # O(ring) python work that would otherwise stall every
+            # concurrent span completion for its duration
+            docs = list(self.finished)
+        out: List[Dict[str, Any]] = []
+        # newest-first scan honoring the limit: the common "recent N of a
+        # hot span name" query stops after N hits instead of walking the
+        # whole ring
+        for d in reversed(docs):
+            if d["span"] == name:
+                out.append(d)
+                if len(out) >= limit:
+                    break
+        out.reverse()
+        return out
 
     def traces(self, trace_id: str) -> List[Dict[str, Any]]:
         with self._lock:
-            return [d for d in self.finished if d["trace_id"] == trace_id]
+            docs = list(self.finished)
+        return [d for d in docs if d["trace_id"] == trace_id]
+
+    def export_chrome_trace(self, trace_id: str) -> Dict[str, Any]:
+        """Export one trace's spans as Chrome trace-event JSON (the
+        "JSON Array Format" with complete 'X' events), loadable in
+        chrome://tracing and https://ui.perfetto.dev.
+
+        ``ts``/``dur`` are microseconds; ``ts`` comes from the span's
+        wall-clock start so events across processes line up.  Durations
+        are clamped to >= 1 us: a zero-width event is dropped by some
+        viewers, and every real span costs more than that anyway."""
+        events: List[Dict[str, Any]] = []
+        for d in self.traces(trace_id):
+            args = {k: v for k, v in d.items()
+                    if k not in ("span", "trace_id", "start", "duration_ms")
+                    and v is not None}
+            events.append({
+                "name": d["span"],
+                "cat": "cook",
+                "ph": "X",
+                "ts": round(d["start"] * 1e6, 3),
+                "dur": max(round((d.get("duration_ms") or 0.0) * 1000.0, 3),
+                           1.0),
+                "pid": 1,
+                "tid": 1,
+                "args": args,
+            })
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"trace_id": trace_id}}
 
     def reset(self) -> None:
         with self._lock:
@@ -144,5 +196,5 @@ tracer = Tracer()
 
 
 def span(name: str, **tags: Any):
-    """Module-level shorthand: `with tracing.span("match.cycle", pool=p):`"""
+    """Module-level shorthand: `with tracing.span("rank.cycle", pool=p):`"""
     return tracer.span(name, **tags)

@@ -1,0 +1,430 @@
+"""Observability layer: flight recorder, Chrome trace export, device
+telemetry (recompile/transfer counters), Prometheus exposition golden
+parse, SLO burn rates, and the span-catalog doc check
+(docs/OBSERVABILITY.md).
+
+Ordering note: the ``system`` fixture (one fused-cycle simulator run +
+live API server) is module-scoped — the classes that inspect its
+recorder/tracer state (TestFlightRecorder, TestDebugCli) run before the
+classes that reset global state for isolation (_reset at test start).
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from cook_tpu.utils.flight import recorder
+from cook_tpu.utils.metrics import LATENCY_BUCKETS, registry
+from cook_tpu.utils.tracing import span, tracer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _reset():
+    tracer.reset()
+    registry.reset()
+    recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format golden parse
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r' (?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_prometheus(text: str):
+    """Strict mini-parser for the exposition format: every line must be a
+    well-formed sample; returns [(name, {label: value}, float)]."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = _LABEL_RE.sub("", raw).replace(",", "").strip()
+            assert consumed == "", f"unparsed label text {consumed!r} " \
+                                   f"in line {line!r}"
+            labels = {k: _unescape(v) for k, v in _LABEL_RE.findall(raw)}
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: simulator -> flight recorder -> REST -> Chrome trace
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def system():
+    """One small fused-cycle simulator run with a live API server over
+    its store (module-scoped: the run compiles the fused cycle once)."""
+    from cook_tpu.rest import ApiServer, CookApi
+    from cook_tpu.sim.simulator import (
+        Simulator,
+        generate_example_hosts,
+        generate_example_trace,
+        load_hosts,
+        load_trace,
+    )
+    _reset()
+    sim = Simulator(load_trace(generate_example_trace(20, seed=3)),
+                    load_hosts(generate_example_hosts(3)))
+    result = sim.run()
+    assert result.placements > 0
+    sim.result = result
+    api = CookApi(sim.store, scheduler=sim.scheduler)
+    server = ApiServer(api)
+    server.start()
+    yield sim, server
+    server.stop()
+
+
+def _get_json(server, path):
+    return json.load(urllib.request.urlopen(server.url + path))
+
+
+class TestFlightRecorder:
+    def test_every_cycle_recorded(self, system):
+        sim, _server = system
+        records = recorder.recent(limit=500)
+        fused = [r for r in records if r["kind"] == "fused"]
+        # one record per driven fused cycle
+        assert len(fused) == len(sim.result.match_wall_ms)
+        assert all(r["trace_id"] for r in fused)
+        assert all(r["duration_ms"] > 0 for r in fused)
+
+    def test_placed_cycle_has_phases_and_counts(self, system):
+        _sim, _server = system
+        placed = [r for r in recorder.recent(limit=500)
+                  if r["kind"] == "fused" and r["jobs_placed"] > 0]
+        assert placed
+        r = placed[0]
+        for phase in ("rank", "match", "launch"):
+            assert r["phases_ms"].get(phase, 0.0) > 0.0, (phase, r)
+        assert r["jobs_considered"] >= r["jobs_placed"] > 0
+        assert r["h2d_bytes"] > 0 and r["d2h_bytes"] > 0
+
+    def test_simulator_emits_flight_summary(self, system):
+        sim, _server = system
+        flight = sim.result.summary()["flight"]
+        assert flight["cycles"] >= len(sim.result.match_wall_ms)
+        assert flight["jobs_placed"] == sim.result.placements
+        assert flight["by_kind"].get("fused")
+
+    def test_debug_cycles_endpoint(self, system):
+        _sim, server = system
+        body = _get_json(server, "/debug/cycles?limit=5")
+        assert len(body["cycles"]) == 5
+        doc = body["cycles"][-1]
+        for field in ("seq", "kind", "trace_id", "duration_ms", "phases_ms",
+                      "skip_reasons", "recompiles", "h2d_bytes",
+                      "d2h_bytes", "sync_wait_ms"):
+            assert field in doc
+
+    def test_debug_trace_is_valid_chrome_trace(self, system):
+        _sim, server = system
+        placed = [r for r in recorder.recent(limit=500)
+                  if r["kind"] == "fused" and r["jobs_placed"] > 0]
+        trace = _get_json(server,
+                          "/debug/trace?trace_id=" + placed[0]["trace_id"])
+        # schema check: the trace-event JSON Object Format
+        assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+        assert trace["displayTimeUnit"] in ("ms", "ns")
+        ts = []
+        for ev in trace["traceEvents"]:
+            assert set(("name", "cat", "ph", "ts", "dur", "pid",
+                        "tid")) <= set(ev)
+            assert ev["ph"] == "X"
+            assert isinstance(ev["name"], str) and ev["name"]
+            assert ev["dur"] > 0
+            assert isinstance(ev.get("args", {}), dict)
+            ts.append(ev["ts"])
+        assert ts == sorted(ts)
+        names = {ev["name"] for ev in trace["traceEvents"]}
+        # the nested spans cover the rank, match, and launch phases
+        assert {"cycle", "cycle.rank", "cycle.match",
+                "cycle.launch"} <= names
+        # valid JSON round trip
+        json.loads(json.dumps(trace))
+
+    def test_debug_trace_error_paths(self, system):
+        _sim, server = system
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(server.url + "/debug/trace")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                server.url + "/debug/trace?trace_id=deadbeef00000000")
+        assert e.value.code == 404
+
+    def test_live_server_metrics_parse(self, system):
+        _sim, server = system
+        text = urllib.request.urlopen(server.url + "/metrics").read().decode()
+        samples = parse_prometheus(text)
+        names = {n for n, _l, _v in samples}
+        assert any(n.startswith("cook_span_duration_seconds") for n in names)
+        assert any(n.startswith("cook_cycle_duration_seconds")
+                   for n in names)
+
+
+class TestDebugCli:
+    def test_cycles_and_trace_subcommands(self, system, capsys):
+        from cook_tpu.cli.main import main as cli_main
+        _sim, server = system
+        assert cli_main(["--url", server.url, "debug", "cycles",
+                         "--limit", "3"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert len(body["cycles"]) == 3
+        # trace with no id resolves to the newest cycle's trace
+        assert cli_main(["--url", server.url, "debug", "trace"]) == 0
+        trace = json.loads(capsys.readouterr().out)
+        assert trace["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition details (isolated registry state)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusExposition:
+    def test_label_escaping_round_trips(self):
+        _reset()
+        nasty = 'no "fit"\\ at all\nsecond line'
+        registry.counter_inc("cook_test_skips", 2.0, {"reason": nasty})
+        text = registry.expose()
+        samples = parse_prometheus(text)
+        hits = [(n, lbl, v) for n, lbl, v in samples
+                if n == "cook_test_skips_total"]
+        assert len(hits) == 1
+        _n, labels, value = hits[0]
+        assert labels["reason"] == nasty
+        assert value == 2.0
+        # raw text is single-line per sample: the newline was escaped
+        assert "no \\\"fit\\\"" in text
+
+    def test_histogram_buckets_monotone_and_inf_equals_count(self):
+        _reset()
+        for v in (0.003, 0.02, 0.7, 9.0, 42.0):
+            registry.observe("cook_test_hist", v, {"pool": "p"})
+        for v in (2.0, 400.0):
+            registry.observe("cook_test_wait", v, {"pool": "p"},
+                             buckets=LATENCY_BUCKETS)
+        samples = parse_prometheus(registry.expose())
+        by_name = {}
+        for n, lbl, v in samples:
+            by_name.setdefault(n, []).append((lbl, v))
+        for base, total in (("cook_test_hist", 5), ("cook_test_wait", 2)):
+            buckets = by_name[base + "_bucket"]
+            # exposition order preserves the bound ladder; counts must be
+            # non-decreasing and the +Inf bucket must equal _count
+            counts = [v for _lbl, v in buckets]
+            assert counts == sorted(counts)
+            inf = [v for lbl, v in buckets if lbl["le"] == "+Inf"]
+            assert inf == [total]
+            (_, count), = by_name[base + "_count"]
+            assert count == total
+            # le label values parse as floats (except +Inf)
+            for lbl, _v in buckets:
+                if lbl["le"] != "+Inf":
+                    float(lbl["le"])
+
+
+# ---------------------------------------------------------------------------
+# Device telemetry: recompiles tagged to cycle + /metrics
+# ---------------------------------------------------------------------------
+
+class TestRecompileTelemetry:
+    def test_shape_change_recompile_counted_and_tagged(self):
+        import jax.numpy as jnp
+
+        from cook_tpu.ops import MatchInputs, greedy_match_kernel
+        _reset()
+
+        def inputs(j, h):
+            return MatchInputs(
+                job_res=jnp.ones((j, 4)),
+                constraint_mask=jnp.ones((j, h), bool),
+                avail=jnp.full((h, 4), 100.0),
+                capacity=jnp.full((h, 4), 100.0),
+                valid=jnp.ones(j, bool))
+
+        with recorder.cycle(kind="fused") as rec:
+            greedy_match_kernel(inputs(9, 4))
+            before = rec.recompiles.get("match.greedy", 0)
+            # shape change forces a fresh trace+compile
+            greedy_match_kernel(inputs(17, 6))
+            assert rec.recompiles["match.greedy"] == before + 1
+        # the owning cycle's record is tagged...
+        doc = recorder.recent(limit=1)[0]
+        assert doc["recompiles"]["match.greedy"] >= 1
+        # ...and /metrics carries the per-kernel counter
+        samples = parse_prometheus(registry.expose())
+        hits = [v for n, lbl, v in samples
+                if n == "cook_jit_compile_total"
+                and lbl.get("kernel") == "match.greedy"]
+        assert hits and hits[0] >= 1
+
+    def test_transfer_and_sync_wait_flow_to_record(self):
+        from cook_tpu.ops import telemetry
+        _reset()
+        with recorder.cycle(kind="fused") as rec:
+            telemetry.count_transfer("h2d", 1000)
+            telemetry.count_transfer("d2h", 500)
+            with telemetry.sync_wait("fused.fetch"):
+                pass
+        assert rec.h2d_bytes == 1000 and rec.d2h_bytes == 500
+        assert rec.sync_wait_ms >= 0.0
+        samples = parse_prometheus(registry.expose())
+        directions = {lbl["direction"]: v for n, lbl, v in samples
+                      if n == "cook_device_transfer_bytes_total"}
+        assert directions == {"h2d": 1000.0, "d2h": 500.0}
+
+    def test_nested_cycle_joins_enclosing_record(self):
+        _reset()
+        with recorder.cycle(kind="fused") as outer:
+            with recorder.cycle(kind="match") as inner:
+                assert inner is outer
+        assert [r["kind"] for r in recorder.recent()] == ["fused"]
+
+
+# ---------------------------------------------------------------------------
+# Tracer: contextvars propagation + recent() filter
+# ---------------------------------------------------------------------------
+
+class TestTracerContext:
+    def test_copied_context_keeps_cycle_trace(self):
+        import contextvars
+        import threading
+        _reset()
+        seen = {}
+
+        def worker():
+            with span("cluster.launch-tasks", cluster="c"):
+                seen["trace"] = tracer.current().trace_id
+
+        with span("cycle", kind="fused") as root:
+            t = threading.Thread(target=contextvars.copy_context().run,
+                                 args=(worker,))
+            t.start()
+            t.join()
+        assert seen["trace"] == root.trace_id
+        docs = tracer.traces(root.trace_id)
+        assert {d["span"] for d in docs} == {"cycle",
+                                             "cluster.launch-tasks"}
+
+    def test_recent_name_filter_honors_limit(self):
+        _reset()
+        for i in range(20):
+            with span("rank.pool", pool=f"p{i}"):
+                pass
+            with span("rank.cycle"):
+                pass
+        docs = tracer.recent(limit=3, name="rank.pool")
+        assert [d["pool"] for d in docs] == ["p17", "p18", "p19"]
+        assert all(d["span"] == "rank.pool" for d in docs)
+
+
+# ---------------------------------------------------------------------------
+# SLO layer
+# ---------------------------------------------------------------------------
+
+class TestSloLayer:
+    def test_queue_latency_burn_rate(self):
+        from cook_tpu.config import Config
+        from cook_tpu.sched.monitor import Monitor
+        from cook_tpu.state import Job, Pool, Resources, Store, new_uuid
+        _reset()
+
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        now = store.clock()
+        cfg = Config()
+        cfg.slo.queue_latency_objective_s = 60.0
+        cfg.slo.error_budget = 0.1
+        # two pending jobs: one fresh, one 10 minutes old
+        store.create_jobs([
+            Job(uuid=new_uuid(), user="u", command="x",
+                resources=Resources(cpus=1, mem=10),
+                submit_time_ms=now - 600_000),
+            Job(uuid=new_uuid(), user="u", command="x",
+                resources=Resources(cpus=1, mem=10),
+                submit_time_ms=now),
+        ])
+        Monitor(store, config=cfg).sweep()
+        samples = parse_prometheus(registry.expose())
+        gauges = {(n, lbl.get("slo"), lbl.get("pool")): v
+                  for n, lbl, v in samples}
+        assert gauges[("cook_slo_objective_seconds", "queue-latency",
+                       "default")] == 60.0
+        assert gauges[("cook_slo_breach_ratio", "queue-latency",
+                       "default")] == 0.5
+        assert gauges[("cook_slo_burn_rate", "queue-latency",
+                       "default")] == pytest.approx(5.0)
+        # the sampled age histogram exists with latency-scale buckets
+        ages = [lbl["le"] for n, lbl, _v in samples
+                if n == "cook_queue_age_seconds_bucket"]
+        assert "600.0" in ages
+
+    def test_cycle_duration_burn_rate_from_flight_recorder(self):
+        import time as _time
+
+        from cook_tpu.config import Config
+        from cook_tpu.sched.monitor import Monitor
+        from cook_tpu.state import Store
+        _reset()
+
+        cfg = Config()
+        cfg.slo.cycle_duration_objective_s = 0.005
+        cfg.slo.error_budget = 0.5
+        with recorder.cycle(kind="fused"):
+            _time.sleep(0.02)       # breaches the 5ms objective
+        with recorder.cycle(kind="fused"):
+            pass                    # within objective
+        Monitor(Store(), config=cfg).sweep()
+        samples = parse_prometheus(registry.expose())
+        burn = [v for n, lbl, v in samples
+                if n == "cook_slo_burn_rate"
+                and lbl.get("slo") == "cycle-duration"]
+        assert burn == [pytest.approx(1.0)]
+
+
+# ---------------------------------------------------------------------------
+# Span catalog: every span name in cook_tpu/ documented
+# ---------------------------------------------------------------------------
+
+def test_span_catalog_documented():
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    pattern = re.compile(r'tracing\.span\(\s*["\']([^"\']+)')
+    names = set()
+    for path in (REPO / "cook_tpu").rglob("*.py"):
+        for m in pattern.finditer(path.read_text()):
+            names.add(m.group(1))
+    # the flight recorder's root span is opened via tracing.span too
+    assert names, "no spans found — did the span helper get renamed?"
+    undocumented = {n for n in names if f"`{n}`" not in doc}
+    assert not undocumented, (
+        f"spans missing from docs/OBSERVABILITY.md: {sorted(undocumented)}")
