@@ -474,10 +474,15 @@ def cmd_debug(args) -> int:
     """Flight-recorder access: ``cs debug cycles`` lists recent per-cycle
     records; ``cs debug trace [TRACE_ID]`` exports one cycle's spans as
     Chrome trace-event JSON (default: the newest recorded cycle) for
-    chrome://tracing / ui.perfetto.dev."""
+    chrome://tracing / ui.perfetto.dev; ``cs debug faults`` dumps the
+    degradation panel — armed fault points, per-cluster circuit-breaker
+    states, and open launch intents (docs/ROBUSTNESS.md)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
+        return 0
+    if args.debug_cmd == "faults":
+        out(client.debug_faults())
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -783,9 +788,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dry-run", dest="dry_run", action="store_true")
     sp.set_defaults(fn=cmd_ssh)
 
-    sp = sub.add_parser("debug", help="flight recorder: cycle records "
-                                      "and Perfetto trace export")
-    sp.add_argument("debug_cmd", choices=["cycles", "trace"])
+    sp = sub.add_parser("debug", help="flight recorder: cycle records, "
+                                      "Perfetto trace export, fault/"
+                                      "breaker states")
+    sp.add_argument("debug_cmd", choices=["cycles", "trace", "faults"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

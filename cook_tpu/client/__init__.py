@@ -68,7 +68,16 @@ class JobClient:
                 f"{self.basic_auth[0]}:{self.basic_auth[1]}".encode()).decode()
             headers["Authorization"] = "Basic " + cred
         raw = None
-        for _hop in range(4):  # follow leader redirects (307) incl. POST,
+        # transient-failure budget for idempotent requests: a dropped
+        # connection mid-failover must not surface as an error when a
+        # jittered retry (utils/retry.py) would land on the new leader
+        transient = None
+        if method == "GET":
+            from ..utils.retry import Backoff
+            transient = [2, Backoff(base_s=0.1, cap_s=1.0)]
+        # 6 hops: room for the transient-retry budget on top of the
+        # 307 leader-redirect chain
+        for _hop in range(6):  # follow leader redirects (307) incl. POST,
             req = urllib.request.Request(url, data=data, method=method,
                                          headers=headers)
             try:
@@ -85,6 +94,11 @@ class JobClient:
                 except Exception:
                     message = str(e)
                 raise JobClientError(e.code, message)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if transient is None or transient[0] <= 0:
+                    raise
+                transient[0] -= 1
+                time.sleep(transient[1].next_delay())
         else:
             raise JobClientError(508, "redirect loop")
         if path == "/metrics":
@@ -285,3 +299,8 @@ class JobClient:
         JSON, loadable in chrome://tracing / ui.perfetto.dev."""
         return self._request("GET", "/debug/trace",
                              params={"trace_id": trace_id})
+
+    def debug_faults(self) -> Dict:
+        """GET /debug/faults — armed fault points, per-cluster circuit
+        breaker states, and open launch intents (docs/ROBUSTNESS.md)."""
+        return self._request("GET", "/debug/faults")

@@ -138,9 +138,19 @@ class FakeCluster(ComputeCluster):
             return offers
 
     def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
+        from ..utils.faults import injector as _faults
+        from ..utils.retry import breakers as _breakers
+        breaker = _breakers.get(self.name)
         rejected: List[str] = []
         with self._lock:
             for spec in specs:
+                if _faults.should_fire("cluster.launch"):
+                    # injected backend/RPC fault: the launch is rejected
+                    # (mea-culpa, pod-submission-failed) and the failure
+                    # counts against this cluster's circuit breaker
+                    rejected.append(spec.task_id)
+                    breaker.record_failure()
+                    continue
                 if not spec.hostname:
                     # direct (Kenzo) mode: the backend's own scheduler places
                     # the task — first-fit stand-in for kube-scheduler
@@ -181,6 +191,7 @@ class FakeCluster(ComputeCluster):
                     exit_code=self.task_exit_codes.get(spec.task_id, 0))
                 self._consume(spec.hostname, spec.resources, 1.0)
                 self.launched_order.append(spec.task_id)
+                breaker.record_success()
         for spec in specs:
             if spec.task_id not in rejected:
                 self._emit(spec.task_id, InstanceStatus.RUNNING, None,

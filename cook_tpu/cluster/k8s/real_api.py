@@ -567,12 +567,25 @@ class RealKubernetesApi:
     def _watch_loop(self, kind: str, start_rv: int,
                     stop: threading.Event) -> None:
         import logging
+
+        from ...utils.faults import injector as _faults
+        from ...utils.retry import Backoff
         log = logging.getLogger(__name__)
         rv: Optional[int] = start_rv
         known: Dict[str, object] = {}  # name -> last obj (for gap deletes)
-        backoff = 0.0
+        # ONE jittered-exponential policy for every retry branch below
+        # (ERROR events, HTTP errors, dropped streams, parse errors):
+        # full jitter so a fleet of watchers reconnecting after one
+        # apiserver restart cannot synchronize into a relist storm
+        backoff = Backoff(base_s=0.1, cap_s=5.0)
+        delay = 0.0
         while not stop.is_set():
             try:
+                _faults.fire(
+                    "k8s.watch.disconnect",
+                    lambda: ConnectionError("injected watch disconnect"))
+                if _faults.should_fire("k8s.watch.gone"):
+                    rv = None  # injected 410: force the relist path
                 if rv is None:
                     rv = self._relist(kind, known, stop)
                 q = urllib.parse.urlencode(
@@ -601,7 +614,7 @@ class RealKubernetesApi:
                                 log.warning(
                                     "k8s %s watch ERROR event: %s",
                                     kind, evt.get("object"))
-                                backoff = min(max(backoff * 2, 0.2), 5.0)
+                                delay = backoff.next_delay()
                             break
                         raw = evt.get("object") or {}
                         obj = (self._pod_from_json(raw) if kind == "pod"
@@ -615,26 +628,28 @@ class RealKubernetesApi:
                             known[obj.name] = obj
                         self._emit(kind, evt.get("type", "MODIFIED"),
                                    obj, orv, stop)
-                        backoff = 0.0  # healthy stream
+                        backoff.reset()  # healthy stream
+                        delay = 0.0
                 self.watch_reconnects += 1
             except urllib.error.HTTPError as e:
                 if e.code == 410:
                     rv = None
                     continue
-                backoff = min(max(backoff * 2, 0.2), 5.0)
+                delay = backoff.next_delay()
                 log.warning("k8s %s watch HTTP %s; retrying in %.1fs",
-                            kind, e.code, backoff)
+                            kind, e.code, delay)
             except (urllib.error.URLError, socket.timeout,
                     ConnectionError, OSError) as e:
                 # dropped stream: reconnect and resume from last seen rv
                 self.watch_reconnects += 1
-                backoff = min(max(backoff * 2, 0.1), 5.0)
+                delay = backoff.next_delay()
                 log.debug("k8s %s watch dropped (%s); resuming rv=%s",
                           kind, e, rv)
             except json.JSONDecodeError:
-                backoff = min(max(backoff * 2, 0.1), 5.0)
-            if backoff:
-                stop.wait(backoff)
+                delay = backoff.next_delay()
+            if delay:
+                stop.wait(delay)
+                delay = 0.0
 
     # --------------------------------------------------------------- leases
     # (coordination.k8s.io/v1; the surface LeaseLeaderElector drives —

@@ -303,6 +303,12 @@ class RemoteComputeCluster(ComputeCluster):
         self.executor_pythonpath = executor_pythonpath or str(_REPO_ROOT)
         self._endpoints = endpoints
         self._agents: Dict[str, AgentConnection] = {}  # hostname -> conn
+        # endpoints that failed to connect at initialize: while any
+        # remain, this backend cannot POSITIVELY enumerate its tasks
+        # (running_task_ids returns None), so the launch-intent sweep
+        # defers instead of refunding a task that may be running on the
+        # unreachable agent
+        self._failed_endpoints: set = set()
         self._lock = threading.RLock()
         # task_id -> (hostname, resources); consumption tracking for offers
         self._tasks: Dict[str, Tuple[str, Resources]] = {}
@@ -322,6 +328,8 @@ class RemoteComputeCluster(ComputeCluster):
             try:
                 self._connect_agent(host, port)
             except (ConnectionError, RuntimeError) as e:
+                with self._lock:
+                    self._failed_endpoints.add((host, port))
                 logging.getLogger(__name__).warning(
                     "agent %s:%s unreachable at startup: %s", host, port, e)
         self._reconcile_store_tasks()
@@ -329,6 +337,7 @@ class RemoteComputeCluster(ComputeCluster):
     def _connect_agent(self, host: str, port: int) -> AgentConnection:
         conn = AgentConnection(host, port)
         with self._lock:
+            self._failed_endpoints.discard((host, port))
             self._agents[conn.hostname] = conn
             # Adopt tasks already running on the agent (reconnect after a
             # scheduler restart) so offers subtract their consumption.
@@ -452,7 +461,10 @@ class RemoteComputeCluster(ComputeCluster):
 
     def _on_agent_lost(self, conn: AgentConnection) -> None:
         """Connection dropped: its tasks are NODE_LOST (mea-culpa), exactly
-        the reference's slave-lost semantics."""
+        the reference's slave-lost semantics.  Deliberately NOT a
+        circuit-breaker failure: agent loss is a capacity event, and
+        counting it would let routine node churn black out launches on
+        the cluster's remaining healthy agents."""
         with self._lock:
             if self._agents.get(conn.hostname) is conn:
                 del self._agents[conn.hostname]
@@ -492,6 +504,9 @@ class RemoteComputeCluster(ComputeCluster):
         return offers
 
     def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
+        from ..utils.faults import injector as _faults
+        from ..utils.retry import breakers as _breakers
+        breaker = _breakers.get(self.name)
         for spec in specs:
             with self._lock:
                 conn = self._agents.get(spec.hostname)
@@ -520,16 +535,24 @@ class RemoteComputeCluster(ComputeCluster):
             container = spec.container or {}
             with tracing.span("remote.launch", cluster=self.name,
                               hostname=spec.hostname):
-                ok = conn.launch(
-                    spec.task_id, command,
-                    spec.resources.cpus, spec.resources.mem,
-                    env={**spec.env, **extra_env},
-                    port_count=spec.port_count,
-                    image=container.get("image", ""),
-                    volumes=[v if isinstance(v, str)
-                             else f"{v['host-path']}:{v['container-path']}"
-                             for v in container.get("volumes", [])],
-                    params=container.get("parameters") or [])
+                if _faults.should_fire("remote.rpc"):
+                    ok = False  # injected transport fault: RPC never lands
+                else:
+                    ok = conn.launch(
+                        spec.task_id, command,
+                        spec.resources.cpus, spec.resources.mem,
+                        env={**spec.env, **extra_env},
+                        port_count=spec.port_count,
+                        image=container.get("image", ""),
+                        volumes=[v if isinstance(v, str)
+                                 else f"{v['host-path']}:"
+                                      f"{v['container-path']}"
+                                 for v in container.get("volumes", [])],
+                        params=container.get("parameters") or [])
+            if ok:
+                breaker.record_success()
+            else:
+                breaker.record_failure()
             if not ok:
                 with self._lock:
                     self._tasks.pop(spec.task_id, None)
@@ -589,6 +612,18 @@ class RemoteComputeCluster(ComputeCluster):
             command = (f"exec {shlex.quote(self.executor_python)} -m "
                        f"cook_tpu.agent.executor {shlex.quote(command)}")
         return command, extra
+
+    def running_task_ids(self) -> Optional[List[str]]:
+        """Task ids this backend is tracking (launched here or adopted
+        from agent reconnects) — the launch-intent sweep's positive
+        does-the-cluster-know-it check.  None while any configured
+        endpoint never connected: the enumeration is incomplete, so a
+        task's absence proves nothing (refunding it could double-run
+        work still executing on the unreachable agent)."""
+        with self._lock:
+            if self._failed_endpoints:
+                return None
+            return list(self._tasks)
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:

@@ -155,6 +155,29 @@ class SloConfig:
 
 
 @dataclass
+class FaultInjectionConfig:
+    """Deterministic fault injection (utils/faults.py).  Off by default;
+    arming is an operator/chaos decision.  ``points`` maps fault-point
+    name -> {"probability": p, "schedule": [call indices],
+    "max_fires": n} (see utils/faults.py for the point registry)."""
+
+    enabled: bool = False
+    seed: int = 0
+    points: Dict[str, Dict] = field(default_factory=dict)
+
+
+@dataclass
+class CircuitBreakerConfig:
+    """Per-compute-cluster launch circuit breaker (utils/retry.py):
+    ``failure_threshold`` consecutive backend failures open the breaker
+    (the matcher routes launches to healthy clusters); a half-open probe
+    after ``reset_timeout_s`` discovers recovery."""
+
+    failure_threshold: int = 5
+    reset_timeout_s: float = 30.0
+
+
+@dataclass
 class EstimatedCompletionConfig:
     """estimated-completion constraint knobs (reference:
     config/estimated-completion-config, constraints.clj:408-432). Disabled
@@ -208,6 +231,12 @@ class Config:
     monitor_interval_seconds: float = 30.0
     # queue-latency / cycle-duration SLOs exposed on /metrics
     slo: SloConfig = field(default_factory=SloConfig)
+    # deterministic fault injection + launch circuit breakers
+    # (docs/ROBUSTNESS.md); the scheduler applies both at construction
+    faults: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig)
+    circuit_breaker: CircuitBreakerConfig = field(
+        default_factory=CircuitBreakerConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

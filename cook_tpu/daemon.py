@@ -98,6 +98,22 @@ def build_scheduler_config(spec: Dict) -> Config:
             if not hasattr(cfg.slo, k):
                 raise ValueError(f"unknown slo key {k!r}")
             setattr(cfg.slo, k, v)
+    if "faults" in spec:
+        # deterministic fault injection (docs/ROBUSTNESS.md): arming from
+        # config is explicit chaos opt-in, applied by the scheduler at
+        # takeover.  A typo'd knob must fail the boot, not silently arm
+        # nothing while the operator believes chaos is running.
+        for k, v in spec["faults"].items():
+            if not hasattr(cfg.faults, k):
+                raise ValueError(f"unknown faults key {k!r}")
+            setattr(cfg.faults, k, v)
+        cfg.faults.enabled = bool(spec["faults"].get(
+            "enabled", bool(cfg.faults.points)))
+    if "circuit_breaker" in spec:
+        for k, v in spec["circuit_breaker"].items():
+            if not hasattr(cfg.circuit_breaker, k):
+                raise ValueError(f"unknown circuit_breaker key {k!r}")
+            setattr(cfg.circuit_breaker, k, v)
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

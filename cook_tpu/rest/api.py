@@ -100,6 +100,8 @@ API_ROUTES = [
     ("GET", "/debug", "health + recent tracing spans", False),
     ("GET", "/debug/cycles", "flight-recorder cycle records", False),
     ("GET", "/debug/trace", "Chrome/Perfetto trace-event export", False),
+    ("GET", "/debug/faults",
+     "active fault points, breaker states, open launch intents", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -1363,6 +1365,18 @@ class CookApi:
             raise ApiError(404, f"no spans recorded for trace {trace_id}")
         return trace
 
+    def debug_faults(self) -> Dict:
+        """GET /debug/faults — degradation panel: armed fault points and
+        their trigger counts, per-cluster circuit-breaker states, and open
+        launch intents (docs/ROBUSTNESS.md).  Served locally on every
+        node like the other debug surfaces."""
+        from ..utils.faults import injector
+        from ..utils.retry import breakers
+        return {"fault_points": injector.active(),
+                "seed": injector.seed,
+                "breakers": breakers.states(),
+                "launch_intents": self.store.launch_intents()}
+
     def settings(self) -> Dict:
         from ..sched.rebalancer import effective_rebalancer_params
         cfg = self.config
@@ -1714,8 +1728,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
-                    "/metrics", "/failure_reasons", "/settings",
-                    "/swagger-docs", "/swagger-ui"}
+                    "/debug/faults", "/metrics", "/failure_reasons",
+                    "/settings", "/swagger-docs", "/swagger-ui"}
 
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
@@ -1767,6 +1781,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_cycles(params)
             if path == "/debug/trace":
                 return api.debug_trace(params)
+            if path == "/debug/faults":
+                return api.debug_faults()
             if path == "/swagger-docs":
                 return api.swagger_docs()
             if path == "/swagger-ui":

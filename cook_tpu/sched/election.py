@@ -97,6 +97,9 @@ class LeaseLeaderElector(LeaderElector):
     def try_once(self) -> bool:
         """One acquire/renew attempt (exposed for deterministic tests and
         for external pacing)."""
+        from ..utils.faults import injector as _faults
+        _faults.fire("leader.lease",
+                     lambda: ConnectionError("injected lease fault"))
         lease = self.api.try_acquire_lease(
             self.lease_name, self.identity, self.clock(),
             duration_s=self.duration_s, holder_url=self.node_url)
@@ -195,6 +198,10 @@ class FileLeaderElector(LeaderElector):
 
     def _try_acquire(self) -> bool:
         import fcntl
+
+        from ..utils.faults import injector as _faults
+        if _faults.should_fire("leader.lease"):
+            return False  # injected election fault: this attempt loses
         # first boot on a fresh host: the shared election dir may not
         # exist yet; a missing dir must not kill the campaign loop
         os.makedirs(os.path.dirname(self.lock_path) or ".", exist_ok=True)

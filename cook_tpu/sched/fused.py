@@ -255,9 +255,10 @@ class FusedCycleDriver:
 
         # offers from every cluster serving this pool
         offers: List[Offer] = []
-        for cluster in list(scheduler.clusters.values()):
-            if cluster.accepts_pool(pool.name):
-                offers.extend(cluster.pending_offers(pool.name))
+        # breaker-filtered: a tripped cluster contributes no offers, so
+        # the kernel routes demand at healthy clusters
+        for cluster in scheduler.launchable_clusters(pool.name):
+            offers.extend(cluster.pending_offers(pool.name))
         pp.offers = offers
         pp.n_hosts = len(offers)
 
@@ -450,9 +451,10 @@ class FusedCycleDriver:
 
         # offers from every cluster serving this pool
         offers: List[Offer] = []
-        for cluster in list(scheduler.clusters.values()):
-            if cluster.accepts_pool(pool.name):
-                offers.extend(cluster.pending_offers(pool.name))
+        # breaker-filtered: a tripped cluster contributes no offers, so
+        # the kernel routes demand at healthy clusters
+        for cluster in scheduler.launchable_clusters(pool.name):
+            offers.extend(cluster.pending_offers(pool.name))
         pp.offers = offers
         pp.n_hosts = len(offers)
 
@@ -531,6 +533,8 @@ class FusedCycleDriver:
         """One fused cycle over all active non-direct pools.  Returns
         (pending queues, match results); direct pools are handled by the
         scheduler separately."""
+        from ..utils.faults import injector as _faults
+        _faults.fire("fused.dispatch")
         import jax.numpy as jnp
 
         pools = [p for p in self.store.pools()

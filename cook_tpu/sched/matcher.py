@@ -160,7 +160,13 @@ class Matcher:
             for tid in full.instances:
                 inst = self.store.instance(tid)
                 if inst is not None and inst.status is InstanceStatus.FAILED:
-                    failed.add(inst.hostname)
+                    # a launch cancelled before the backend ever saw it
+                    # (crash-window refund, reconcile sweep) proves nothing
+                    # about the host; novel-host-excluding it would livelock
+                    # single-host relaunches after a leader crash
+                    if inst.reason_code != \
+                            Reasons.CANCELLED_DURING_LAUNCH.code:
+                        failed.add(inst.hostname)
                     if (inst.reason_code == Reasons.NODE_LOST.code
                             and inst.end_time_ms and inst.start_time_ms):
                         node_lost_runtimes.append(
@@ -332,6 +338,22 @@ class Matcher:
         cmask = np.asarray(cmask, dtype=bool)
         if mc.backend == "cpu":
             return reference_impl.greedy_match(job_res, cmask, avail, cap)
+        try:
+            return self._dispatch_device(mc, job_res, cmask, avail, cap)
+        except Exception:
+            # a kernel dispatch failure (XLA error, device loss, injected
+            # fault) degrades to the host reference path instead of
+            # killing the whole match cycle (docs/ROBUSTNESS.md)
+            import logging
+            logging.getLogger(__name__).exception(
+                "kernel dispatch failed; falling back to host match")
+            registry.counter_inc("cook_kernel_fallback",
+                                 labels={"kernel": "match"})
+            flight_recorder.note_fault("kernel.dispatch-fallback")
+            return reference_impl.greedy_match(job_res, cmask, avail, cap)
+
+    def _dispatch_device(self, mc: MatcherConfig, job_res, cmask, avail,
+                         cap) -> np.ndarray:
         backend = self.resolve_backend(mc, len(job_res))
         if backend == "tpu-waterfill" and mc.backend == "auto" \
                 and len(job_res):
@@ -363,6 +385,8 @@ class Matcher:
                     avail, cap):
         """One kernel call; returns (assign over real jobs, remaining
         host availability over real hosts)."""
+        from ..utils.faults import injector as _faults
+        _faults.fire("kernel.dispatch")
         import jax.numpy as jnp
         from ..ops import MatchInputs, auction_match_kernel, greedy_match_kernel
         from ..ops.match import waterfill_match_kernel
@@ -469,13 +493,23 @@ class Matcher:
         # cluster, scheduler.clj:1034-1048) — one slow backend must not
         # serialize the others
         def launch_on(cluster, specs):
+            from ..utils.retry import breakers
             cluster.kill_lock.acquire_read()
             try:
                 with tracing.span("cluster.launch-tasks", pool=pool_name,
                                   cluster=cluster.name, tasks=len(specs)):
                     cluster.launch_tasks(pool_name, specs)
+            except Exception:
+                # a whole-batch dispatch failure counts against the
+                # cluster's breaker; the intents stay open so a crash or
+                # restart reconciles them (refund, never duplicate)
+                breakers.get(cluster.name).record_failure()
+                raise
             finally:
                 cluster.kill_lock.release_read()
+            # dispatch acked by the backend: confirm the launch intents
+            # (tasks whose status already arrived were cleared in-line)
+            self.store.clear_launch_intents([s.task_id for s in specs])
 
         targets = [(clusters[name], specs)
                    for name, specs in by_cluster.items() if name in clusters]

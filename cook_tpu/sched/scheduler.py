@@ -53,6 +53,17 @@ class Scheduler:
         from ..policy import PluginRegistry, RateLimits
         self.store = store
         self.config = config or Config()
+        # fault-injection + breaker policy are config planes the scheduler
+        # owns applying (docs/ROBUSTNESS.md): arming is explicit opt-in
+        from ..utils.faults import injector as _faults
+        from ..utils.retry import breakers as _breakers
+        if self.config.faults.enabled:
+            _faults.configure({"seed": self.config.faults.seed,
+                               "points": self.config.faults.points})
+        _breakers.configure(
+            failure_threshold=self.config.circuit_breaker.failure_threshold,
+            reset_timeout_s=self.config.circuit_breaker.reset_timeout_s)
+        self.breakers = _breakers
         self.plugins = plugins or PluginRegistry()
         self.rate_limits = rate_limits or RateLimits()
         self.clusters: Dict[str, ComputeCluster] = {}
@@ -113,11 +124,90 @@ class Scheduler:
         # this scheduler's tx subscription, so watch them now.
         for _job, inst in store.running_instances():
             self.heartbeats.watch(inst.task_id, self.clock())
+        # Crash-consistency: sweep launch intents the previous leader left
+        # open (died between match and launch-ack) against actual cluster
+        # state — refund or adopt, never duplicate, never lose.
+        self.reconcile_launch_intents()
 
     # ---------------------------------------------------------------- wiring
     def add_cluster(self, cluster: ComputeCluster) -> None:
         cluster.initialize(self._on_status_update)
         self.clusters[cluster.name] = cluster
+
+    def launchable_clusters(self, pool_name: str) -> List[ComputeCluster]:
+        """Clusters accepting ``pool_name`` whose circuit breaker allows
+        launches.  A tripped breaker's cluster contributes no offers, so
+        the matcher routes its demand at healthy clusters; the skip is
+        noted on the cycle record (a degraded cycle explains itself)."""
+        out: List[ComputeCluster] = []
+        skipped = 0
+        for cluster in list(self.clusters.values()):
+            if not cluster.accepts_pool(pool_name):
+                continue
+            if not self.breakers.get(cluster.name).allow():
+                skipped += 1
+                continue
+            out.append(cluster)
+        if skipped:
+            flight_recorder.note_fault("breaker-open", skipped)
+        return out
+
+    def reconcile_launch_intents(self) -> int:
+        """Leader-startup sweep of open launch intents (store records for
+        dispatches never confirmed).  For each intent:
+
+        - instance missing or already past UNKNOWN -> the dispatch (or
+          its failure) was observed; just drop the intent;
+        - owning cluster positively knows the task -> adopt (drop intent,
+          status updates flow normally);
+        - owning cluster positively does NOT know the task, or is gone ->
+          the crash window hit between match and launch-ack: fail the
+          instance mea-culpa (CANCELLED_DURING_LAUNCH) so the job
+          relaunches exactly once with no retry-budget charge;
+        - cluster cannot enumerate its tasks -> leave the verdict to that
+          backend's own reconciliation (remote NODE_LOSTs unknown tasks on
+          reconnect) and drop the intent.
+        """
+        swept = 0
+        to_clear: List[str] = []
+        for intent in self.store.launch_intents():
+            task_id = intent["task_id"]
+            inst = self.store.instance(task_id)
+            if inst is not None and inst.status is InstanceStatus.UNKNOWN:
+                cluster = self.clusters.get(
+                    intent.get("compute_cluster", ""))
+                enumerate_tasks = getattr(cluster, "running_task_ids", None)
+                known = None
+                if enumerate_tasks is not None:
+                    try:
+                        ids = enumerate_tasks()
+                        # None = the backend cannot POSITIVELY enumerate
+                        # right now (e.g. an agent unreachable at
+                        # startup): absence proves nothing, defer
+                        known = (task_id in set(ids)
+                                 if ids is not None else None)
+                    except Exception:
+                        known = None
+                if known is False or cluster is None:
+                    # the refund's status update deletes the intent in
+                    # its own transaction; no separate clear needed
+                    self.store.update_instance_status(
+                        task_id, InstanceStatus.FAILED,
+                        reason_code=Reasons.CANCELLED_DURING_LAUNCH.code)
+                else:
+                    to_clear.append(task_id)
+            else:
+                to_clear.append(task_id)
+            swept += 1
+        # ONE transaction for every adopt/drop (a crash can leave
+        # hundreds of intents; per-intent journaled txns would serialize
+        # the new leader's startup)
+        self.store.clear_launch_intents(to_clear)
+        if swept:
+            from ..utils.metrics import registry
+            registry.counter_inc("cook_launch_intents_swept", float(swept))
+            flight_recorder.note_fault("launch-intents-swept", swept)
+        return swept
 
     def _on_status_update(self, task_id: str, status: InstanceStatus,
                           reason_code: Optional[int], exit_code=None,
@@ -140,6 +230,9 @@ class Scheduler:
         """Explicit liveness signal from an executor/sidecar (progress
         frames route here too, matching the reference where any framework
         message resets the heartbeat timer, heartbeat.clj:100-123)."""
+        from ..utils.faults import injector as _faults
+        if _faults.should_fire("agent.heartbeat"):
+            return  # injected delivery loss: the frame never arrives
         self.heartbeats.beat(task_id, self.clock())
 
     def flush_status_updates(self) -> None:
@@ -276,9 +369,22 @@ class Scheduler:
             gc_paused = self.gc_discipline and gc.isenabled()
             if gc_paused:
                 gc.disable()
+            degraded = False
             try:
                 with tracing.span("fused.cycle"):
                     queues, results = self._fused.step(self)
+            except Exception:
+                # device dispatch failed (XLA error, device loss, injected
+                # fault): degrade to the split host path for this cycle
+                # instead of skipping scheduling entirely
+                import logging
+                logging.getLogger(__name__).exception(
+                    "fused cycle failed; degrading to host split path")
+                from ..utils.metrics import registry
+                registry.counter_inc("cook_kernel_fallback",
+                                     labels={"kernel": "fused.pool_cycle"})
+                flight_recorder.note_fault("fused.dispatch-fallback")
+                degraded = True
             finally:
                 if gc_paused:
                     gc.enable()
@@ -287,6 +393,18 @@ class Scheduler:
                     # warm-up built) and then every 10th
                     if self._gc_cycles == 1 or self._gc_cycles % 10 == 0:
                         self._gc_collect_due = True
+            if degraded:
+                # split path: rank, then match (which owns direct pools,
+                # per-pool autoscaling, and last_match_results updates)
+                self.step_rank()
+                results = self.step_match()
+                if rec is not None:
+                    rec.pools = len(results)
+                    rec.jobs_considered = sum(r.considered
+                                              for r in results.values())
+                    rec.jobs_placed = sum(len(r.launched_task_ids)
+                                          for r in results.values())
+                return results
             # direct pools: host rank + backpressure submission
             for pool in self.store.pools():
                 if pool.state != "active" \
@@ -349,9 +467,8 @@ class Scheduler:
                                                                 ranked)
                         continue
                     offers = []
-                    for cluster in list(self.clusters.values()):
-                        if cluster.accepts_pool(pool.name):
-                            offers.extend(cluster.pending_offers(pool.name))
+                    for cluster in self.launchable_clusters(pool.name):
+                        offers.extend(cluster.pending_offers(pool.name))
                     result = self.matcher.match_pool(
                         pool.name, ranked, offers, self.clusters,
                         reserved_hosts=self.reserved_hosts)
@@ -387,19 +504,23 @@ class Scheduler:
         """Direct (Kenzo) mode: submit up to the backends' backpressure
         capacity and let the backend place (scheduler.clj:1728-1771)."""
         result = MatchCycleResult()
-        capacity = sum(c.max_launchable(pool_name)
-                       for c in list(self.clusters.values())
-                       if c.accepts_pool(pool_name))
-        considerable = self.matcher.considerable_jobs(
-            pool_name, ranked,
-            min(capacity, self.config.matcher_for_pool(pool_name).max_jobs_considered))
-        result.considered = len(considerable)
-        clusters = [c for c in list(self.clusters.values())
-                    if c.accepts_pool(pool_name)]
+        clusters = self.launchable_clusters(pool_name)
+        mc_cap = self.config.matcher_for_pool(pool_name).max_jobs_considered
         if not clusters:
+            # no launchable backend (none configured, or every breaker
+            # open): the real demand must still be visible — a
+            # capacity-of-zero truncation would report considered=0 /
+            # unmatched=0 and hide the whole backlog for the outage
+            considerable = self.matcher.considerable_jobs(
+                pool_name, ranked, mc_cap)
+            result.considered = len(considerable)
             result.unmatched = considerable
             flight_recorder.note_skips({"unmatched": len(result.unmatched)})
             return result
+        capacity = sum(c.max_launchable(pool_name) for c in clusters)
+        considerable = self.matcher.considerable_jobs(
+            pool_name, ranked, min(capacity, mc_cap))
+        result.considered = len(considerable)
         from ..policy import pool_user_key
         launch_rl = self.rate_limits.job_launch
         cluster_rl = self.rate_limits.cluster_launch
@@ -433,6 +554,9 @@ class Scheduler:
                 cluster.kill_lock.release_read()
             result.launched_task_ids.append(task_id)
             result.launched_job_uuids.append(job.uuid)
+        # one batched intent-confirm for the cycle's direct launches (a
+        # per-task clear would journal one transaction per job)
+        self.store.clear_launch_intents(result.launched_task_ids)
         flight_recorder.note_skips({
             "unmatched": len(result.unmatched),
             "launch-failed": len(result.launch_failures)})

@@ -1,5 +1,7 @@
 """CLI: python -m cook_tpu.sim --trace trace.json --hosts hosts.json
-     or: python -m cook_tpu.sim --workload spec.json [--emit-trace t.json]"""
+     or: python -m cook_tpu.sim --workload spec.json [--emit-trace t.json]
+     or: python -m cook_tpu.sim --chaos [--seed N]  (fault-schedule run
+         with invariant checks, sim/chaos.py; exit 1 on violations)"""
 
 import argparse
 import json
@@ -27,11 +29,34 @@ def main(argv=None) -> int:
                    help="also write the synthesized trace JSON here")
     p.add_argument("--hosts", help="hosts JSON file (default: generated)")
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
-    p.add_argument("--jobs", type=int, default=200,
-                   help="generated trace size")
-    p.add_argument("--n-hosts", type=int, default=20)
+    p.add_argument("--jobs", type=int, default=None,
+                   help="generated trace size (default 200; chaos "
+                        "mode's own default is smaller)")
+    p.add_argument("--n-hosts", type=int, default=None,
+                   help="generated host count (default 20)")
     p.add_argument("--out", help="write task records CSV here")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the fault-schedule chaos mode (node loss + "
+                        "RPC faults + leader kill/promotion) and assert "
+                        "the robustness invariants; exit 1 on violations")
+    p.add_argument("--leader-kill-at-ms", type=int, default=None,
+                   help="chaos: virtual ms offset of the leader kill "
+                        "(default 15000; negative disables)")
     args = p.parse_args(argv)
+
+    if args.chaos:
+        from .chaos import ChaosConfig, run_chaos
+        cc = ChaosConfig(seed=args.seed or 0)
+        if args.jobs is not None:
+            cc.n_jobs = args.jobs
+        if args.n_hosts is not None:
+            cc.n_hosts = args.n_hosts
+        if args.leader_kill_at_ms is not None:
+            cc.leader_kill_at_ms = (None if args.leader_kill_at_ms < 0
+                                    else args.leader_kill_at_ms)
+        result = run_chaos(cc)
+        print(json.dumps(result.summary(), indent=2))
+        return 0 if result.ok else 1
 
     if args.workload:
         spec = json.load(open(args.workload))
@@ -40,13 +65,13 @@ def main(argv=None) -> int:
             with open(args.emit_trace, "w") as f:
                 json.dump(trace_entries, f)
         host_entries = (json.load(open(args.hosts)) if args.hosts
-                        else generate_hosts(args.n_hosts))
+                        else generate_hosts(args.n_hosts or 20))
     else:
         trace_entries = (json.load(open(args.trace)) if args.trace
                          else generate_example_trace(
-                             args.jobs, seed=args.seed or 0))
+                             args.jobs or 200, seed=args.seed or 0))
         host_entries = (json.load(open(args.hosts)) if args.hosts
-                        else generate_example_hosts(args.n_hosts))
+                        else generate_example_hosts(args.n_hosts or 20))
     sim = Simulator(load_trace(trace_entries), load_hosts(host_entries),
                     backend=args.backend)
     result = sim.run()

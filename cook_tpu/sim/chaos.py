@@ -1,0 +1,345 @@
+"""Chaos-mode simulator: a workload driven under an injected fault
+schedule, with the robustness invariants asserted, not assumed.
+
+The fault-injection counterpart of the faster-than-real-time simulator
+(Basiri et al., *Chaos Engineering*, IEEE Software 2016; Borg treats
+failover/requeue behavior as first-class tested behavior, Verma et al.,
+EuroSys 2015): replay a generated trace against the REAL scheduler +
+store + fake cluster on a virtual clock while injecting
+
+- **node loss** — a loaded host's tasks all fail ``NODE_LOST``
+  (mea-culpa) on a fixed cadence;
+- **launch RPC faults** — ``utils/faults.py`` point ``cluster.launch``
+  rejects backend launches with a seeded probability (mea-culpa
+  ``pod-submission-failed``), feeding the per-cluster circuit breaker;
+- **one leader kill + promotion** — the leader "crashes" between the
+  match transaction and the backend launch-ack (the classic
+  crash-consistency window), the journal is reopened the way a promoted
+  follower re-reads state, and scheduling resumes.
+
+Invariants checked (violations are collected, not raised, so a run
+reports everything it broke):
+
+1. every job reaches a terminal state;
+2. retry budgets are only consumed by non-mea-culpa failures (chaos only
+   injects mea-culpa faults, so every job must end with
+   ``attempts_used == 0``);
+3. no job ever has two concurrently-live instances (checked every tick,
+   and cross-checked against the backend's running set);
+4. promotion loses zero committed transactions: the reopened store's
+   state equals the pre-crash store's state, byte-for-value, and the
+   final journal replays to exactly the final in-memory state.
+
+Run it:  ``python -m cook_tpu.sim --chaos [--seed N]`` or
+``pytest -m chaos``; see docs/ROBUSTNESS.md.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..cluster.fake import FakeCluster
+from ..config import Config
+from ..sched.scheduler import Scheduler
+from ..state.schema import InstanceStatus, JobState, Reasons
+from ..state.store import Store
+from ..utils.faults import injector
+from ..utils.flight import recorder as flight_recorder
+from ..utils.retry import breakers
+from .simulator import (
+    generate_example_hosts,
+    generate_example_trace,
+    load_hosts,
+    load_trace,
+)
+
+
+@dataclass
+class ChaosConfig:
+    seed: int = 0
+    n_jobs: int = 40
+    n_users: int = 4
+    n_hosts: int = 8
+    submit_span_ms: int = 30_000
+    job_duration_ms: int = 6_000
+    tick_ms: int = 1_000
+    # fault schedule.  node_loss_max stays BELOW n_hosts: the novel-host
+    # constraint permanently excludes a job's failed hosts, so losing
+    # every host at least once could make an unlucky job unschedulable
+    # forever — a real small-cluster liveness hazard, but not the
+    # invariant under test here
+    node_loss_every_ms: int = 9_000
+    node_loss_max: int = 5
+    rpc_fault_probability: float = 0.15
+    # cap on injected RPC rejects: each reject marks one host failed for
+    # the job (novel-host), so an unbounded storm over a small pool can
+    # legitimately exclude every host for an unlucky job
+    rpc_fault_max: Optional[int] = None
+    leader_kill_at_ms: Optional[int] = 15_000
+    # breaker policy (virtual-clock): small threshold so chaos actually
+    # exercises trip + half-open heal inside a short run
+    breaker_failure_threshold: int = 3
+    breaker_reset_timeout_s: float = 5.0
+    max_virtual_ms: int = 30 * 60 * 1000
+    data_dir: Optional[str] = None   # journal dir; tempdir when None
+
+
+@dataclass
+class ChaosResult:
+    total: int = 0
+    completed: int = 0
+    violations: List[str] = field(default_factory=list)
+    node_losses: int = 0
+    rpc_faults: int = 0
+    leader_kills: int = 0
+    intents_open_at_kill: int = 0
+    relaunched_after_kill: int = 0
+    breaker_trips: int = 0
+    user_retries_charged: int = 0
+    makespan_ms: int = 0
+    flight: Dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "jobs_total": self.total,
+            "jobs_completed": self.completed,
+            "violations": list(self.violations),
+            "node_losses": self.node_losses,
+            "rpc_faults": self.rpc_faults,
+            "leader_kills": self.leader_kills,
+            "intents_open_at_kill": self.intents_open_at_kill,
+            "relaunched_after_kill": self.relaunched_after_kill,
+            "breaker_trips": self.breaker_trips,
+            "user_retries_charged": self.user_retries_charged,
+            "makespan_virtual_s": self.makespan_ms / 1000.0,
+            "flight": self.flight,
+        }
+
+
+class _LeaderCrash(BaseException):
+    """Simulated process death mid-launch.  BaseException so no
+    defensive ``except Exception`` on the dispatch path can swallow the
+    'crash' and ack the launch anyway."""
+
+
+def _scheduler_config(cc: ChaosConfig) -> Config:
+    cfg = Config()
+    # deterministic host path: the chaos run asserts scheduling
+    # INVARIANTS, not kernel behavior (kernel fallback has its own tests)
+    cfg.cycle_mode = "split"
+    cfg.default_matcher.backend = "cpu"
+    cfg.columnar_index = False
+    cfg.circuit_breaker.failure_threshold = cc.breaker_failure_threshold
+    cfg.circuit_breaker.reset_timeout_s = cc.breaker_reset_timeout_s
+    return cfg
+
+
+def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
+    cc = cc or ChaosConfig()
+    data_dir = cc.data_dir or tempfile.mkdtemp(prefix="cook-chaos-")
+    rng = random.Random(cc.seed)
+    trace = load_trace(generate_example_trace(
+        cc.n_jobs, n_users=cc.n_users, seed=cc.seed,
+        span_ms=cc.submit_span_ms, duration_ms=cc.job_duration_ms))
+    hosts = load_hosts(generate_example_hosts(cc.n_hosts, seed=cc.seed))
+    result = ChaosResult(total=len(trace))
+    if not trace:
+        return result
+
+    now_box = [trace[0].submit_time_ms]
+    clock = lambda: now_box[0]  # noqa: E731 - one timebase for everything
+
+    # process-global planes: seed/arm for this run, restore after
+    injector.clear()
+    injector.reseed(cc.seed)
+    breakers.reset()
+    breakers.configure(failure_threshold=cc.breaker_failure_threshold,
+                       reset_timeout_s=cc.breaker_reset_timeout_s,
+                       clock=lambda: now_box[0] / 1000.0)
+    if cc.rpc_fault_probability > 0:
+        injector.arm("cluster.launch",
+                     probability=cc.rpc_fault_probability,
+                     max_fires=cc.rpc_fault_max)
+    flight_seq0 = flight_recorder.last_seq()
+
+    cfg = _scheduler_config(cc)
+    store = Store.open(data_dir)
+    store.clock = clock
+    cluster = FakeCluster("chaos", hosts)
+    cluster.job_durations_ms = {
+        j.uuid: int(j.labels["sim/duration_ms"]) for j in trace}
+    scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+
+    def check_single_live(when: str) -> None:
+        live_by_job: Dict[str, int] = {}
+        for job, inst in store.running_instances():
+            live_by_job[job.uuid] = live_by_job.get(job.uuid, 0) + 1
+        for uuid, n in live_by_job.items():
+            if n > 1:
+                result.violations.append(
+                    f"{when}: job {uuid} has {n} live instances")
+        # backend cross-check: every task the cluster runs maps to a
+        # still-live store instance (no zombie double-running attempt)
+        for tid in cluster.running_task_ids():
+            inst = store.instance(tid)
+            if inst is None or inst.status not in (
+                    InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                result.violations.append(
+                    f"{when}: cluster runs {tid} but store says "
+                    f"{inst.status.value if inst else 'missing'}")
+
+    def fail_one_node() -> None:
+        if result.node_losses >= cc.node_loss_max:
+            return
+        with cluster._lock:
+            loaded: Dict[str, List[str]] = {}
+            for tid, t in cluster._tasks.items():
+                loaded.setdefault(t.spec.hostname, []).append(tid)
+        if not loaded:
+            return
+        host = rng.choice(sorted(loaded))
+        result.node_losses += 1
+        for tid in loaded[host]:
+            cluster.fail_task(tid, Reasons.NODE_LOST.code)
+
+    # jobs whose dispatch the leader kill interrupted, with their
+    # instance counts at kill time: a post-kill instance PROVES the
+    # refund->relaunch path ran (reported as relaunched_after_kill)
+    crashed_jobs: Dict[str, int] = {}
+
+    def kill_leader_and_promote() -> None:
+        nonlocal store, scheduler
+        result.leader_kills += 1
+        # crash INSIDE the match->launch window: the guard transaction
+        # (instances + intents) commits, the backend dispatch never lands
+        orig_launch = FakeCluster.launch_tasks
+
+        def crash(self, pool, specs):
+            raise _LeaderCrash()
+
+        FakeCluster.launch_tasks = crash
+        try:
+            scheduler.step_rank()
+            scheduler.step_match()
+        except _LeaderCrash:
+            pass
+        finally:
+            FakeCluster.launch_tasks = orig_launch
+        open_intents = store.launch_intents()
+        result.intents_open_at_kill = len(open_intents)
+        for intent in open_intents:
+            j = store.job(intent["job_uuid"])
+            if j is not None:
+                crashed_jobs[j.uuid] = len(j.instances)
+        pre = json.loads(store.snapshot())
+        store.close()  # crash-equivalent: no checkpoint, journal as-is
+        # promotion: the successor re-reads everything the dead leader
+        # committed (snapshot + journal replay)
+        store = Store.open(data_dir)
+        post = json.loads(store.snapshot())
+        # tx_id counts every transaction including write-free ones (an
+        # all-deny launch guard journals nothing); entity state is the
+        # committed truth being compared
+        pre.pop("tx_id", None)
+        post.pop("tx_id", None)
+        if post != pre:
+            result.violations.append(
+                "promotion lost committed transactions: replayed state "
+                "differs from the pre-crash store")
+        store.clock = clock
+        # the new leader adopts the (still-running) cluster and sweeps
+        # the open launch intents in its constructor
+        scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+
+    pending = list(trace)
+    deadline = pending[-1].submit_time_ms + cc.max_virtual_ms
+    start_ms = now_box[0]
+    next_node_loss = start_ms + cc.node_loss_every_ms
+    kill_at = (start_ms + cc.leader_kill_at_ms
+               if cc.leader_kill_at_ms is not None else None)
+    breaker = breakers.get(cluster.name)
+    last_breaker_state = breaker.state
+
+    while now_box[0] <= deadline:
+        now = now_box[0]
+        while pending and pending[0].submit_time_ms <= now:
+            store.create_jobs([pending.pop(0)])
+        if kill_at is not None and now >= kill_at:
+            kill_at = None
+            kill_leader_and_promote()
+        if now >= next_node_loss:
+            next_node_loss = now + cc.node_loss_every_ms
+            fail_one_node()
+        scheduler.step_rank()
+        scheduler.step_match()
+        scheduler.step_reapers(current_ms=now)
+        state = breaker.state
+        if state == "open" and last_breaker_state != "open":
+            result.breaker_trips += 1
+        last_breaker_state = state
+        check_single_live(f"t={now}")
+        if result.violations:
+            break  # a broken invariant only compounds; stop and report
+        now_box[0] = now + cc.tick_ms
+        cluster.advance_to(now_box[0])
+        if not pending and not store.jobs_where(
+                lambda j: j.state is not JobState.COMPLETED):
+            break
+
+    result.makespan_ms = now_box[0] - start_ms
+    result.rpc_faults = injector.active().get(
+        "cluster.launch", {}).get("fires", 0)
+    # MEASURED relaunches: a crash-window job gained an instance after
+    # the kill (the refund->relaunch path actually ran, not assumed)
+    result.relaunched_after_kill = sum(
+        1 for uuid, n_at_kill in crashed_jobs.items()
+        if (j := store.job(uuid)) is not None
+        and len(j.instances) > n_at_kill)
+
+    # terminal-state + retry-budget invariants
+    for job in trace:
+        stored = store.job(job.uuid)
+        if stored is None:
+            result.violations.append(f"job {job.uuid} vanished")
+            continue
+        if stored.state is JobState.COMPLETED:
+            result.completed += 1
+        else:
+            result.violations.append(
+                f"job {job.uuid} not terminal: {stored.state.value}")
+        insts = {t: i for t in stored.instances
+                 if (i := store.instance(t)) is not None}
+        charged = stored.attempts_used(insts)
+        result.user_retries_charged += charged
+        if charged:
+            # chaos injects only mea-culpa failures; any consumed budget
+            # means a cluster fault was charged to the user
+            result.violations.append(
+                f"job {job.uuid}: {charged} user retr"
+                f"{'y' if charged == 1 else 'ies'} consumed by "
+                "injected (mea-culpa) failures")
+
+    # the journal IS the state: a fresh replay must reproduce the final
+    # store exactly (what the NEXT promotion would read)
+    final_live = json.loads(store.snapshot())
+    final_replayed = json.loads(Store.replay_only(data_dir).snapshot())
+    final_live.pop("tx_id", None)
+    final_replayed.pop("tx_id", None)
+    if final_live != final_replayed:
+        result.violations.append(
+            "final journal replay diverges from the live store")
+
+    result.flight = flight_recorder.summary(since_seq=flight_seq0)
+    store.close()
+    injector.clear()
+    breakers.reset()
+    return result

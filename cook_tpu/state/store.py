@@ -231,6 +231,14 @@ class Store:
         # dynamic config documents (reference: the DB-backed no-restart
         # config planes — rebalancer params at rebalancer.clj:535-557)
         self._configs: Dict[str, Dict[str, Any]] = {}
+        # crash-consistent launch intents: one record per instance whose
+        # backend dispatch has not been confirmed yet, written in the SAME
+        # transaction as the instance (docs/ROBUSTNESS.md).  A leader that
+        # dies between match and launch-ack leaves the intent in the
+        # journal; startup reconciliation sweeps intents against actual
+        # cluster state so the task is exactly-once relaunched or refunded
+        # — never duplicated, never lost.
+        self._intents: Dict[str, Dict[str, Any]] = {}
         self._latches: Dict[str, List[str]] = {}   # latch uuid -> job uuids
         self._tx_id = 0
         self._subscribers: List[Callable[[int, List[TxEvent]], None]] = []
@@ -328,12 +336,22 @@ class Store:
         # every append flushes, so the buffer is empty here and tell() is
         # the true end-of-good-records offset
         good_offset = f.tell()
+        from ..utils.faults import injector as _faults
         try:
+            _faults.fire("store.journal.append",
+                         lambda: OSError("injected journal write failure"))
             f.write(json.dumps(rec) + "\n")
             f.flush()
             if self._journal_fsync:
+                _faults.fire(
+                    "store.journal.fsync",
+                    lambda: OSError("injected journal fsync failure"))
                 os.fsync(f.fileno())
             if self._repl_server is not None:
+                _faults.fire(
+                    "repl.stream",
+                    lambda: ReplicationTimeout("injected replication "
+                                               "stream fault"))
                 # sync replication: commit = fsynced on every connected
                 # follower.  Raising here (inside the try) excises the
                 # local record and aborts the transaction, so a client
@@ -554,6 +572,15 @@ class Store:
                     node_location=e.get("node_location", ""),
                     queue_time_ms=max(0, t - job.last_waiting_start_ms))
                 txn.put("instances", e["task_id"], inst)
+                # launch intent, atomic with the instance: the dispatch to
+                # the backend has NOT happened yet.  Cleared by the first
+                # status update or an explicit clear_launch_intents after
+                # the backend acked; swept by leader-startup reconciliation
+                # against actual cluster state otherwise.
+                txn.put("intents", e["task_id"], {
+                    "task_id": e["task_id"], "job_uuid": e["job_uuid"],
+                    "compute_cluster": e.get("compute_cluster", ""),
+                    "hostname": hostname, "created_ms": t})
                 job.instances.append(e["task_id"])
                 job.state = JobState.RUNNING
                 txn.event("instance-created", task_id=e["task_id"],
@@ -578,6 +605,11 @@ class Store:
             inst = txn.instance_w(task_id)
             if inst is None:
                 return False
+            # any backend status proves the dispatch reached the cluster:
+            # the launch intent has served its purpose (guarded so the
+            # common no-intent case journals nothing extra)
+            if task_id in self._intents:
+                txn.delete("intents", task_id)
             if inst.status is new_status:
                 # Redelivered status (k8s watch replays, mesos re-sends): a
                 # pure no-op — must not overwrite end_time/reason/exit_code.
@@ -610,6 +642,29 @@ class Store:
             return True
 
         return self.transact(_update)
+
+    def clear_launch_intents(self, task_ids: List[str]) -> int:
+        """Confirm backend dispatch: drop the launch intents for
+        ``task_ids`` (a no-op — no transaction at all — for ids whose
+        intent was already cleared by a status update)."""
+        with self._lock:
+            live = [t for t in task_ids if t in self._intents]
+        if not live:
+            return 0
+
+        def _clear(txn: _Txn) -> int:
+            for t in live:
+                txn.delete("intents", t)
+            return len(live)
+
+        return self.transact(_clear)
+
+    def launch_intents(self) -> List[Dict[str, Any]]:
+        """Open launch intents (dispatch not yet confirmed), oldest first."""
+        with self._lock:
+            out = [dict(v) for v in self._intents.values()]
+        out.sort(key=lambda r: r.get("created_ms", 0))
+        return out
 
     def update_instance_progress(self, task_id: str, progress: int,
                                  message: str = "", sequence: int = 0) -> bool:
@@ -915,6 +970,7 @@ class Store:
                 "shares": {k: to_json(v) for k, v in self._shares.items()},
                 "quotas": {k: to_json(v) for k, v in self._quotas.items()},
                 "configs": {k: to_json(v) for k, v in self._configs.items()},
+                "intents": {k: dict(v) for k, v in self._intents.items()},
                 "latches": dict(self._latches),
             }
         return json.dumps(state)
@@ -925,7 +981,7 @@ class Store:
         store = cls()
         store._tx_id = state["tx_id"]
         for table in ("jobs", "instances", "groups", "pools", "shares",
-                      "quotas", "configs"):
+                      "quotas", "configs", "intents"):
             target = getattr(store, "_" + table)
             for k, v in state.get(table, {}).items():
                 target[k] = _entity_from_json(table, v)
@@ -1200,8 +1256,8 @@ def _entity_from_json(table: str, v: Dict[str, Any]) -> Any:
     if table == "quotas":
         v["count"] = float(v["count"]) if v["count"] is not None else float("inf")
         return QuotaEntry(**v)
-    if table == "configs":
-        return v  # plain dicts: dynamic config documents
+    if table in ("configs", "intents"):
+        return v  # plain dicts: dynamic config documents / launch intents
     raise ValueError(f"unknown entity table {table}")
 
 

@@ -61,7 +61,7 @@ class CycleRecord:
     __slots__ = ("seq", "kind", "trace_id", "start_s", "duration_ms",
                  "phases", "pools", "jobs_considered", "jobs_placed",
                  "skip_reasons", "preemptions", "recompiles", "h2d_bytes",
-                 "d2h_bytes", "sync_wait_ms", "error", "_t0")
+                 "d2h_bytes", "sync_wait_ms", "faults", "error", "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
@@ -79,6 +79,10 @@ class CycleRecord:
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self.sync_wait_ms = 0.0
+        # fault-point triggers and degradations observed during this
+        # cycle (utils/faults.py + kernel/fused fallbacks): a degraded
+        # cycle explains itself without cross-referencing logs
+        self.faults: Dict[str, int] = {}
         self.error: Optional[str] = None
         self._t0 = time.perf_counter()
 
@@ -96,6 +100,7 @@ class CycleRecord:
             "h2d_bytes": self.h2d_bytes,
             "d2h_bytes": self.d2h_bytes,
             "sync_wait_ms": round(self.sync_wait_ms, 3),
+            "faults": dict(self.faults),
             "error": self.error,
         }
 
@@ -194,6 +199,14 @@ class FlightRecorder:
             with self._lock:
                 rec.preemptions += int(n)
 
+    def note_fault(self, point: str, n: int = 1) -> None:
+        """A fault-point trigger or degradation (kernel fallback, breaker
+        reroute) attributed to the cycle it happened inside."""
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.faults[point] = rec.faults.get(point, 0) + int(n)
+
     # ----------------------------------------------------------------- query
     def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
         """Newest-last list of finished cycle record documents."""
@@ -238,12 +251,15 @@ class FlightRecorder:
         by_kind: Dict[str, int] = {}
         recompiles: Dict[str, int] = {}
         skips: Dict[str, int] = {}
+        faults: Dict[str, int] = {}
         for r in records:
             by_kind[r.kind] = by_kind.get(r.kind, 0) + 1
             for k, v in r.recompiles.items():
                 recompiles[k] = recompiles.get(k, 0) + v
             for k, v in r.skip_reasons.items():
                 skips[k] = skips.get(k, 0) + v
+            for k, v in r.faults.items():
+                faults[k] = faults.get(k, 0) + v
         return {
             "cycles": len(records),
             **({"truncated": True, "cycles_evicted": evicted}
@@ -256,6 +272,7 @@ class FlightRecorder:
             "preemptions": sum(r.preemptions for r in records),
             "recompiles": recompiles,
             "skip_reasons": skips,
+            "faults": faults,
             "h2d_bytes": sum(r.h2d_bytes for r in records),
             "d2h_bytes": sum(r.d2h_bytes for r in records),
             "sync_wait_ms": round(sum(r.sync_wait_ms for r in records), 3),

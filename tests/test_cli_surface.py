@@ -397,9 +397,13 @@ class TestRetryCommand:
         for u in uuids:
             shown = json.loads(cli(daemon, "show", u).stdout)[0]
             assert shown["max_retries"] == 3
-            # resurrection: failed jobs leave the failed state
-            assert shown["state"] != "failed" or shown["status"] != \
-                "completed"
+            # resurrection: the job leaves the failed state.  With a
+            # 0.1s match interval and 300ms fake tasks it may have
+            # already burned the fresh budget and re-failed before this
+            # subprocess observes it — extra instances prove the
+            # resurrection happened either way.
+            assert shown["state"] != "failed" \
+                or len(shown.get("instances", [])) > 1, shown
         # increment raises BY n
         r = cli(daemon, "retry", uuids[0], "--increment", "2")
         assert r.returncode == 0, r.stderr
