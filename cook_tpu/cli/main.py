@@ -476,13 +476,19 @@ def cmd_debug(args) -> int:
     Chrome trace-event JSON (default: the newest recorded cycle) for
     chrome://tracing / ui.perfetto.dev; ``cs debug faults`` dumps the
     degradation panel — armed fault points, per-cluster circuit-breaker
-    states, and open launch intents (docs/ROBUSTNESS.md)."""
+    states, and open launch intents (docs/ROBUSTNESS.md); ``cs debug
+    replication`` dumps the failover panel — per-follower offsets,
+    min_acked, synced set, and the candidate positions published into
+    the election medium (docs/OBSERVABILITY.md)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
         return 0
     if args.debug_cmd == "faults":
         out(client.debug_faults())
+        return 0
+    if args.debug_cmd == "replication":
+        out(client.debug_replication())
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -790,8 +796,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("debug", help="flight recorder: cycle records, "
                                       "Perfetto trace export, fault/"
-                                      "breaker states")
-    sp.add_argument("debug_cmd", choices=["cycles", "trace", "faults"])
+                                      "breaker states, replication/"
+                                      "failover panel")
+    sp.add_argument("debug_cmd",
+                    choices=["cycles", "trace", "faults", "replication"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

@@ -21,10 +21,22 @@ TERMINAL_STATES = frozenset({"completed", "success", "failed"})
 
 
 class JobClientError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 body: Optional[Dict] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        # the parsed JSON error body, when there was one — carries the
+        # indeterminate-commit contract (``{"indeterminate": true,
+        # "jobs": [...]}``, HTTP 504; docs/DEPLOY.md)
+        self.body = body or {}
+
+    @property
+    def indeterminate(self) -> bool:
+        """True when the server could not confirm whether the write
+        committed (replication unconfirmed mid-failover).  Safe to
+        retry: submission is idempotent on job uuid."""
+        return bool(self.body.get("indeterminate"))
 
 
 class JobClient:
@@ -90,10 +102,11 @@ class JobClient:
                     url = e.headers["Location"]
                     continue
                 try:
-                    message = json.loads(e.read()).get("error", str(e))
+                    err_body = json.loads(e.read())
+                    message = err_body.get("error", str(e))
                 except Exception:
-                    message = str(e)
-                raise JobClientError(e.code, message)
+                    err_body, message = {}, str(e)
+                raise JobClientError(e.code, message, body=err_body)
             except (urllib.error.URLError, ConnectionError, OSError):
                 if transient is None or transient[0] <= 0:
                     raise
@@ -107,13 +120,45 @@ class JobClient:
 
     # ---------------------------------------------------------------- jobs
     def submit(self, jobs: List[Dict], pool: Optional[str] = None,
-               groups: Optional[List[Dict]] = None) -> List[str]:
+               groups: Optional[List[Dict]] = None,
+               indeterminate_retries: int = 2,
+               idempotent: bool = False) -> List[str]:
+        """Submit a batch.  Every spec gets a client-side uuid up front,
+        which makes the submission idempotent on job uuid: when the
+        server answers HTTP 504 ``indeterminate`` (the commit is
+        journaled on the leader but unconfirmed on its mirror — a
+        failover may or may not preserve it), the SAME batch is resent
+        with ``"idempotent": true`` so the post-failover leader treats
+        surviving jobs as successes and creates only the missing ones —
+        the retry neither loses nor duplicates (docs/DEPLOY.md).
+        ``indeterminate_retries=0`` disables the automatic retry; the
+        504 then surfaces as a :class:`JobClientError` whose
+        ``indeterminate`` property is True — re-calling submit with the
+        same uuid-carrying specs and ``idempotent=True`` is the manual
+        form of the same recovery."""
+        import uuid as _uuid
+        jobs = [dict(spec) for spec in jobs]
+        for spec in jobs:
+            spec.setdefault("uuid", str(_uuid.uuid4()))
         body: Dict[str, Any] = {"jobs": jobs}
         if pool:
             body["pool"] = pool
         if groups:
             body["groups"] = groups
-        return self._request("POST", "/jobs", body=body)["jobs"]
+        if idempotent:
+            body["idempotent"] = True
+        from ..utils.retry import Backoff
+        backoff = Backoff(base_s=0.2, cap_s=2.0)
+        attempts = max(0, int(indeterminate_retries))
+        while True:
+            try:
+                return self._request("POST", "/jobs", body=body)["jobs"]
+            except JobClientError as e:
+                if not e.indeterminate or attempts <= 0:
+                    raise
+                attempts -= 1
+                body["idempotent"] = True
+                time.sleep(backoff.next_delay())
 
     def submit_one(self, command: str, **spec) -> str:
         spec["command"] = command
@@ -304,3 +349,9 @@ class JobClient:
         """GET /debug/faults — armed fault points, per-cluster circuit
         breaker states, and open launch intents (docs/ROBUSTNESS.md)."""
         return self._request("GET", "/debug/faults")
+
+    def debug_replication(self) -> Dict:
+        """GET /debug/replication — the failover panel: per-follower
+        offsets, min_acked, synced set, mirror position, and the
+        candidate positions published into the election medium."""
+        return self._request("GET", "/debug/replication")

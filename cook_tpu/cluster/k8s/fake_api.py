@@ -88,10 +88,33 @@ class FakeKubernetesApi:
         self.sticky_deletion = False
 
     # -------------------------------------------------------------- leases
+    @staticmethod
+    def _lease_copy(lease: Lease) -> Lease:
+        # annotations is the one mutable field: a caller mutating the
+        # returned copy must not reach back into the stored lease
+        return Lease(**{**vars(lease),
+                        "annotations": dict(lease.annotations)})
+
     def get_lease(self, name: str) -> Optional[Lease]:
         with self._lock:
             lease = self._leases.get(name)
-            return Lease(**vars(lease)) if lease else None
+            return self._lease_copy(lease) if lease else None
+
+    def annotate_lease(self, name: str,
+                       annotations: Dict[str, Optional[str]]) -> None:
+        """Merge-patch the lease's metadata annotations (None deletes a
+        key) — the coordination surface candidate positions ride
+        (sched/election.py LeaseLeaderElector.publish_candidate)."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                lease = Lease(name=name)
+                self._leases[name] = lease
+            for k, v in annotations.items():
+                if v is None:
+                    lease.annotations.pop(k, None)
+                else:
+                    lease.annotations[k] = str(v)
 
     def try_acquire_lease(self, name: str, identity: str, now_s: float,
                           duration_s: float = 15.0,
@@ -114,7 +137,7 @@ class FakeKubernetesApi:
             lease.holder_url = holder_url
             lease.renew_time_s = now_s
             lease.duration_s = duration_s
-            return Lease(**vars(lease))
+            return self._lease_copy(lease)
 
     def release_lease(self, name: str, identity: str) -> None:
         """Explicit release on clean shutdown: clears the hold so a

@@ -670,7 +670,8 @@ class RealKubernetesApi:
                 "cook/leader-url", ""),
             renew_time_s=(_ts_ms(renew) or 0) / 1000.0,
             duration_s=float(spec.get("leaseDurationSeconds") or 15),
-            transitions=int(spec.get("leaseTransitions") or 0))
+            transitions=int(spec.get("leaseTransitions") or 0),
+            annotations=dict(meta.get("annotations") or {}))
 
     def get_lease(self, name: str) -> Optional[Lease]:
         try:
@@ -719,6 +720,11 @@ class RealKubernetesApi:
         transitions = int(spec.get("leaseTransitions") or 0)
         if holder != identity:
             transitions += 1
+        # preserve foreign annotations (candidate positions ride here) —
+        # a renewal replacing the whole object must not wipe them
+        body["metadata"]["annotations"] = {
+            **((cur.get("metadata") or {}).get("annotations") or {}),
+            "cook/leader-url": holder_url}
         body["metadata"]["resourceVersion"] = \
             (cur.get("metadata") or {}).get("resourceVersion")
         body["spec"]["leaseTransitions"] = transitions
@@ -731,6 +737,46 @@ class RealKubernetesApi:
         return Lease(name=name, holder=identity, holder_url=holder_url,
                      renew_time_s=now_s, duration_s=duration_s,
                      transitions=transitions)
+
+    def annotate_lease(self, name: str,
+                       annotations: Dict[str, Optional[str]]) -> None:
+        """Merge annotations onto the lease (None deletes a key) — the
+        candidate-position plane of coordinated promotion.  CAS via
+        resourceVersion with a small retry budget: losing the race to a
+        renewal just means re-reading and re-merging."""
+        for _attempt in range(4):
+            try:
+                cur = self._request("GET", self._lease_path(name))
+            except ApiError as e:
+                if e.status != 404:
+                    raise
+                cur = {"apiVersion": "coordination.k8s.io/v1",
+                       "kind": "Lease",
+                       "metadata": {"name": name,
+                                    "namespace": self.namespace},
+                       "spec": {}}
+            meta = cur.setdefault("metadata", {})
+            merged = dict(meta.get("annotations") or {})
+            for k, v in annotations.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = str(v)
+            meta["annotations"] = merged
+            create = not meta.get("resourceVersion")
+            try:
+                if create:
+                    self._request("POST", self._lease_path(), body=cur)
+                else:
+                    self._request("PUT", self._lease_path(name), body=cur)
+                return
+            except ApiError as e:
+                if e.status != 409:  # CAS/create race: re-read, re-merge
+                    raise
+        # one-shot callers (clear_candidate, the promotion-time final
+        # position) must not believe a dropped update was applied
+        raise ApiError(409, f"lease {name} annotation update lost the "
+                            "CAS race 4 times; retry")
 
     def release_lease(self, name: str, identity: str) -> None:
         """Explicit release on clean shutdown: clear holderIdentity so a

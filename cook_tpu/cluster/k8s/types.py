@@ -3,7 +3,8 @@ in-process fake and the live-apiserver adapter)."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Dict
 
 
 @dataclass
@@ -12,6 +13,9 @@ class Lease:
     leaseDurationSeconds, leaseTransitions) — the object behind k8s-native
     leader election.  ``renew_time_s`` is wall-clock epoch seconds (what a
     real apiserver stamps), so electors must compare against a wall clock.
+    ``annotations`` carries the coordinated-promotion candidate positions
+    (``cook.io/candidate-*``; sched/election.py) next to the holder-url
+    annotation real leases already use.
     """
 
     name: str
@@ -20,3 +24,4 @@ class Lease:
     renew_time_s: float = 0.0
     duration_s: float = 15.0
     transitions: int = 0
+    annotations: Dict[str, str] = field(default_factory=dict)

@@ -167,6 +167,55 @@ class FaultInjectionConfig:
 
 
 @dataclass
+class ReplicationConfig:
+    """Socket journal replication + coordinated failover knobs (the
+    daemon's ``"replication"`` conf section; state/replication.py,
+    docs/DEPLOY.md).  Parsed through :meth:`from_conf` so a typo'd knob
+    fails the BOOT instead of silently running with defaults while the
+    operator believes durability/failover policy is set."""
+
+    listen_port: int = 0               # 0 = pick a free port, publish it
+    sync: bool = True                  # commit = fsynced on every synced
+    #                                    follower (False = async mirror)
+    ack_timeout_seconds: float = 5.0
+    min_sync_followers: int = 0        # > 0 = CP mode (refuse lone commits)
+    advertise_host: str = ""           # "" = the daemon's bind host
+    # coordinated promotion (quorum-aware failover): how long the
+    # election winner waits collecting candidate positions before
+    # deciding whether it must first pull a delta from a better-synced
+    # peer (Raft's vote comparison expressed over the election medium)
+    candidacy_window_seconds: float = 1.0
+    # how often standbys publish their replication position
+    position_interval_seconds: float = 0.5
+    # a candidate position older than this is a dead node's ghost and is
+    # ignored by the ranking (and by catch-up failure handling)
+    position_stale_seconds: float = 10.0
+    # how long the winner tries to pull the delta from a live
+    # better-synced peer before failing the takeover (exit nonzero so
+    # that peer can win instead)
+    catchup_timeout_seconds: float = 30.0
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "ReplicationConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown replication key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                # bool("false") is True — a templated string here would
+                # silently invert the operator's durability policy
+                if not isinstance(v, bool):
+                    raise ValueError(
+                        f"replication key {k!r} must be a JSON boolean, "
+                        f"got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker

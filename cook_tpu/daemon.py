@@ -38,6 +38,7 @@ import signal
 import re
 import sys
 import threading
+import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
@@ -244,6 +245,12 @@ class CookDaemon:
         self.repl_follower = None
         self._repl_stop = threading.Event()
         self._repl_thread: Optional[threading.Thread] = None
+        # coordinated promotion (quorum-aware failover): a standby also
+        # serves its own mirror (standby→standby catch-up) and publishes
+        # its replication position into the election medium
+        self.standby_server = None
+        self._node_id: str = ""
+        self._fence_thread: Optional[threading.Thread] = None
 
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
@@ -274,6 +281,10 @@ class CookDaemon:
         self.repl_conf = dict(conf.get("replication") or {})
         self.replication = bool(self.repl_conf) and not self.shared_data \
             and bool(self.data_dir)
+        if self.replication:
+            from .config import ReplicationConfig
+            # a typo'd knob fails the BOOT, like the scheduler sections
+            self.repl_cfg = ReplicationConfig.from_conf(self.repl_conf)
         if self.repl_conf and self.shared_data:
             print("cook_tpu: replication ignored (shared_data_dir wins)",
                   flush=True)
@@ -319,6 +330,7 @@ class CookDaemon:
         self.server = ApiServer(self.api, host=self.host, port=self.port)
         self.server.start()
         self.node_url = f"http://{self.host}:{self.server.port}"
+        self._node_id = f"{self.host}-{self.server.port}"
 
         election = conf.get("election", {})
         if election.get("mode") == "k8s-lease":
@@ -374,6 +386,7 @@ class CookDaemon:
                 raise ValueError(
                     "replication requires the native toolchain "
                     "(libcookrepl failed to build — see stderr)")
+            self.api.repl_dir = self.data_dir  # /debug/replication panel
             self._repl_thread = threading.Thread(
                 target=self._follow_leader_loop, daemon=True)
             self._repl_thread.start()
@@ -415,38 +428,107 @@ class CookDaemon:
             self._done.set()
 
     def _promote_replicated(self) -> None:
-        """Become the leader of a socket-replicated deployment: stop
-        mirroring, re-open the LOCAL mirror fenced at the election epoch
-        (replaying every transaction the dead leader committed — sync
-        replication means the mirror has them all), then serve this
-        journal to the next generation of standbys.  The reference
-        equivalent is the new leader re-reading the networked store
-        (mesos.clj:153-328)."""
-        from .state.replication import ReplicationServer
+        """Become the leader of a socket-replicated deployment —
+        COORDINATED promotion (quorum-aware failover, docs/DEPLOY.md):
+
+        1. stop mirroring, publish this node's final replication
+           position, and hold a candidacy window so every live standby's
+           position is on the table;
+        2. rank candidates by ``(synced, epoch, offset)`` (Raft's vote
+           comparison, Ongaro & Ousterhout §5.4.1); if a synced peer is
+           strictly ahead, pull the missing delta from it over the
+           framed-TCP carrier first (Viewstamped Replication's
+           view-change state transfer) — winning the lock race must not
+           mean losing the tail only the most-advanced mirror holds;
+        3. re-open the local mirror FENCED at the election epoch, with
+           the fence authority pointed at the SHARED election epoch file
+           so a later successor's mint fences this leader's appends,
+           checkpoints, and REST writes end-to-end;
+        4. serve replication to the next generation — losers re-follow
+           the address published here.
+
+        The reference equivalent is the new leader re-reading the
+        networked store (mesos.clj:153-328)."""
+        from .state import replication as repl
         if self.repl_follower is not None:
             self.repl_follower.stop()
             self.repl_follower = None
+            self.api.repl_follower = None
+        cfg = self.repl_cfg
+        # ---- candidacy window: collect peer positions, rank, catch up
+        my_pos = repl.candidate_position(self.data_dir)
+        self.elector.publish_candidate(self._node_id, dict(
+            my_pos, url=self.node_url, ts=time.time()))
+        if cfg.candidacy_window_seconds > 0:
+            self._repl_stop.wait(cfg.candidacy_window_seconds)
+        peers = {nid: pos
+                 for nid, pos in self.elector.read_candidates().items()
+                 if nid != self._node_id}
+        ahead = repl.choose_successor(my_pos, peers,
+                                      stale_s=cfg.position_stale_seconds)
+        if ahead is not None and not my_pos.get("synced"):
+            # a live SYNCED candidate holds state this node lacks (we
+            # are genesis or mid-catch-up): winning the lock race must
+            # not install an empty/partial authority over it
+            raise RuntimeError(
+                f"candidate {ahead[0]} is synced ahead of this "
+                "unsynced node; yielding the takeover")
+        if ahead is not None:
+            peer_id, pos = ahead
+            host, _, port = str(pos.get("catchup", "")).rpartition(":")
+            print(f"cook_tpu: candidate {peer_id} is ahead "
+                  f"(epoch {pos.get('epoch')}, offset "
+                  f"{pos.get('offset')} > {my_pos.get('offset')}); "
+                  f"pulling delta from {host}:{port}", flush=True)
+            if not host or not repl.catch_up_from_peer(
+                    host, int(port or 0), self.data_dir,
+                    int(pos.get("offset") or 0),
+                    timeout_s=cfg.catchup_timeout_seconds):
+                # the better-synced peer is live but unreachable: failing
+                # the takeover (exit nonzero, lock released) lets THAT
+                # peer win with its longer log instead of us truncating
+                # history it holds
+                raise RuntimeError(
+                    f"could not catch up from better-synced candidate "
+                    f"{peer_id} at {pos.get('catchup')!r}; yielding the "
+                    "takeover so it can win")
         # Promotion gate (see assert_promotable): refusing raises into
         # _on_leadership's failed-takeover path — exit nonzero, lock
         # released, a synced peer wins instead.
-        from .state.replication import assert_promotable
-        assert_promotable(self.data_dir)
+        repl.assert_promotable(self.data_dir)
+        self.elector.clear_candidate(self._node_id)
+        if self.standby_server is not None:
+            # the real replication server replaces the catch-up server
+            self.standby_server.stop()
+            self.standby_server = None
         epoch = self.elector.epoch if self.elector is not None else None
         self.store = Store.open(self.data_dir,
                                 epoch=epoch if epoch is not None
                                 else "auto", shared=False)
+        authority = self._epoch_authority_path()
+        if authority is not None:
+            # fence against the SHARED election epoch, not the local
+            # claim file nobody else writes: a successor's mint must
+            # reject this node's late appends/checkpoints
+            self.store.attach_fence_authority(str(authority))
         self.api.store = self.store
         self.queue_limits.store = self.store
-        self.repl_server = ReplicationServer(
-            self.data_dir, int(self.repl_conf.get("listen_port", 0)))
+        self.repl_server = repl.ReplicationServer(
+            self.data_dir, int(cfg.listen_port))
+        self.repl_server.epoch = self.store._journal_epoch
         self.store.attach_replication(
-            self.repl_server,
-            sync=bool(self.repl_conf.get("sync", True)),
-            timeout_s=float(self.repl_conf.get("ack_timeout_seconds", 5.0)),
-            min_followers=int(self.repl_conf.get("min_sync_followers", 0)))
+            self.repl_server, sync=bool(cfg.sync),
+            timeout_s=float(cfg.ack_timeout_seconds),
+            min_followers=int(cfg.min_sync_followers))
         self.api.repl_server = self.repl_server  # surfaced in GET /info
-        host = self.repl_conf.get("advertise_host") or self.host
-        self._publish_repl_addr(f"{host}:{self.repl_server.port}")
+        self.api.fence_guard = self._fence_superseded
+        host = cfg.advertise_host or self.host
+        self._publish_repl_addr(f"{host}:{self.repl_server.port}",
+                                self.store._journal_epoch)
+        self._fence_thread = threading.Thread(
+            target=self._fence_watch_loop, daemon=True,
+            name="repl-fence-watch")
+        self._fence_thread.start()
         print(f"cook_tpu: replication leader serving "
               f"{host}:{self.repl_server.port} "
               f"(epoch {self.store._journal_epoch})", flush=True)
@@ -455,27 +537,83 @@ class CookDaemon:
         lock = getattr(self.elector, "lock_path", None)
         return Path(str(lock) + ".repl") if lock is not None else None
 
-    def _publish_repl_addr(self, addr: str) -> None:
+    def _epoch_authority_path(self) -> Optional[Path]:
+        return getattr(self.elector, "epoch_path", None)
+
+    def _publish_repl_addr(self, addr: str,
+                           epoch: Optional[int] = None) -> None:
         path = self._repl_addr_path()
         if path is None:
             return
         from .utils.fsatomic import write_atomic_text
-        write_atomic_text(str(path), addr)
+        write_atomic_text(str(path), json.dumps(
+            {"addr": addr, "epoch": epoch}))
+
+    def _read_repl_addr(self) -> "tuple[Optional[str], Optional[int]]":
+        """(addr, leader epoch) from the published file; tolerates the
+        pre-coordination plain ``host:port`` format."""
+        path = self._repl_addr_path()
+        try:
+            text = path.read_text().strip() if path else ""
+        except OSError:
+            return None, None
+        if not text:
+            return None, None
+        try:
+            doc = json.loads(text)
+            return doc.get("addr") or None, doc.get("epoch")
+        except ValueError:
+            return text, None  # legacy plain address
+
+    def _fence_superseded(self) -> bool:
+        """True once a successor minted a HIGHER election epoch than the
+        one this leader's store is fenced at — the REST write path flips
+        to 503/redirect immediately (journal fencing alone only rejects
+        the next append; reads of a stale leader are the client's
+        redirect problem, writes must never be accepted)."""
+        authority = self._epoch_authority_path()
+        store = self.store
+        if authority is None or store is None \
+                or store._journal_epoch is None:
+            return False
+        from .utils.fsatomic import read_int_file
+        current = read_int_file(str(authority))
+        return current is not None and current > store._journal_epoch
+
+    def _fence_watch_loop(self) -> None:
+        """Leader-side watchdog: a partitioned-but-alive deposed leader
+        must stop SERVING, not just fail its next append — fence the
+        replication server (standbys re-point at the successor's
+        published address) and exit nonzero for the supervisor."""
+        while not self._repl_stop.is_set():
+            if self.repl_server is None:
+                return
+            if self._fence_superseded():
+                print("cook_tpu: superseded by a higher election epoch; "
+                      "fencing and exiting", flush=True)
+                try:
+                    self.repl_server.fence()
+                except Exception:
+                    pass
+                self._on_loss()
+                return
+            self._repl_stop.wait(1.0)
 
     def _follow_leader_loop(self) -> None:
         """Standby side: keep a native follower mirroring whichever node
         currently publishes the replication address (re-pointing on
-        failover), until this node is elected itself."""
-        from .state.replication import ReplicationFollower
+        failover), until this node is elected itself.  Each tick also
+        publishes this standby's replication position ``(epoch, offset,
+        synced)`` plus a catch-up address into the election medium — the
+        inputs coordinated promotion ranks candidates by."""
+        from .state import replication as repl
+        cfg = self.repl_cfg
         current = None
+        last_publish = 0.0
         while not self._repl_stop.is_set():
             if self.elector is not None and self.elector.is_leader:
                 return  # _on_leadership owns (and stopped) the follower
-            path = self._repl_addr_path()
-            try:
-                addr = path.read_text().strip() if path else None
-            except OSError:
-                addr = None
+            addr, leader_epoch = self._read_repl_addr()
             if addr and addr != current:
                 try:
                     with self._lock:
@@ -485,8 +623,14 @@ class CookDaemon:
                         if self.repl_follower is not None:
                             self.repl_follower.stop()
                         host, _, port = addr.rpartition(":")
-                        self.repl_follower = ReplicationFollower(
+                        if leader_epoch is not None:
+                            # ranking orders mirrors of DIFFERENT
+                            # leaderships by this epoch
+                            repl.record_followed_epoch(self.data_dir,
+                                                       leader_epoch)
+                        self.repl_follower = repl.ReplicationFollower(
                             host, int(port), self.data_dir)
+                        self.api.repl_follower = self.repl_follower
                         current = addr
                 except Exception as e:
                     # a transient native-build failure or malformed
@@ -496,6 +640,27 @@ class CookDaemon:
                     # retry on the next tick
                     print(f"cook_tpu: replication follower for {addr!r} "
                           f"failed ({e}); retrying", file=sys.stderr)
+            now = time.time()
+            if self.elector is not None and self.elector.is_leader:
+                return  # promotion raced this tick: no stale publishes
+            if now - last_publish >= cfg.position_interval_seconds:
+                last_publish = now
+                try:
+                    if self.standby_server is None:
+                        # serve our own mirror for standby→standby
+                        # catch-up (the winner pulls its missing delta
+                        # from whichever candidate is most advanced)
+                        self.standby_server = repl.ReplicationServer(
+                            self.data_dir, 0)
+                    pos = repl.candidate_position(self.data_dir)
+                    pos.update(
+                        catchup=f"{cfg.advertise_host or self.host}:"
+                                f"{self.standby_server.port}",
+                        url=self.node_url, ts=now)
+                    self.elector.publish_candidate(self._node_id, pos)
+                except Exception as e:
+                    print(f"cook_tpu: candidate-position publish failed "
+                          f"({e}); retrying", file=sys.stderr)
             self._repl_stop.wait(0.5)
 
     def _on_loss(self) -> None:
@@ -534,9 +699,19 @@ class CookDaemon:
         self._repl_stop.set()
         if self._repl_thread is not None:
             self._repl_thread.join(timeout=2.0)
+        if self._fence_thread is not None:
+            self._fence_thread.join(timeout=2.0)
         if self.repl_follower is not None:
             self.repl_follower.stop()
             self.repl_follower = None
+        if self.standby_server is not None:
+            self.standby_server.stop()
+            self.standby_server = None
+        if self.elector is not None and self._node_id:
+            try:
+                self.elector.clear_candidate(self._node_id)
+            except Exception:
+                pass
         if self.elector is not None:
             # resign AFTER scheduler stop; suppress on_loss (clean exit)
             self.elector.on_loss = None

@@ -166,7 +166,7 @@ class ServiceFarm:
                 inst = job["instances"][-1]
                 return (uuid, inst.get("hostname", ""),
                         inst.get("ports") or [])
-            if job["state"] in ("completed", "success", "failed"):
+            if job["state"] in TERMINAL_STATES:
                 raise RuntimeError(
                     f"{self.name}: singleton job completed early")
             time.sleep(poll_s)

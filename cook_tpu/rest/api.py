@@ -40,7 +40,7 @@ import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..config import Config
 from ..policy import PluginRegistry, QueueLimits, RateLimits
@@ -59,7 +59,8 @@ from ..state.schema import (
     new_uuid,
     to_json,
 )
-from ..state.store import AbortTransaction, Store
+from ..state.store import (AbortTransaction, ReplicationIndeterminate,
+                           Store)
 from . import task_stats
 
 
@@ -102,6 +103,9 @@ API_ROUTES = [
     ("GET", "/debug/trace", "Chrome/Perfetto trace-event export", False),
     ("GET", "/debug/faults",
      "active fault points, breaker states, open launch intents", False),
+    ("GET", "/debug/replication",
+     "replication/failover panel: follower offsets, min_acked, synced "
+     "set, candidate positions", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -117,11 +121,15 @@ API_ROUTES = [
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str,
-                 headers: Optional[Dict[str, str]] = None):
+                 headers: Optional[Dict[str, str]] = None,
+                 extra: Optional[Dict[str, Any]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
         self.headers = headers or {}
+        # merged into the JSON error body (e.g. the indeterminate-commit
+        # contract: {"error": ..., "indeterminate": true, "jobs": [...]})
+        self.extra = extra or {}
 
 
 class RequestUser(str):
@@ -548,6 +556,14 @@ class CookApi:
         # elected leader (reference: leader-redirect, api-only? config.clj:692)
         self.elector = elector
         self.node_url = node_url
+        # socket-replication surfaces (set by the daemon): the leader's
+        # ReplicationServer / a standby's ReplicationFollower, and the
+        # fence guard that flips the write path to 503/redirect the
+        # moment a successor mints a higher election epoch
+        self.repl_server = None
+        self.repl_follower = None
+        self.repl_dir: Optional[str] = None
+        self.fence_guard: Optional[Callable[[], bool]] = None
         # HTTP-level per-client-IP throttle (reference: ip-rate-limit
         # middleware wrapping the handler, components.clj:214-221);
         # None = unlimited
@@ -701,15 +717,62 @@ class CookApi:
                                     "reference them")
             groups.append(parse_group_spec(
                 gspec, [j.uuid for j in jobs if j.group == guuid]))
-        # atomic batch visibility via commit latch (metatransaction)
-        latch = new_uuid()
-        try:
-            self.store.create_jobs(jobs, groups=groups, latch=latch)
-        except AbortTransaction as e:
-            raise ApiError(409, e.reason)
-        self.store.commit_latch(latch)
+        all_uuids = [j.uuid for j in jobs]
+
+        def _indeterminate(exc: Exception) -> ApiError:
+            # HTTP 504 + ambiguous-outcome body: the batch is journaled
+            # locally but unconfirmed on the mirror.  The uuids let the
+            # client retry the SAME logical submission ("idempotent":
+            # true) after a failover — neither losing nor duplicating.
+            return ApiError(504, str(exc),
+                            extra={"indeterminate": True,
+                                   "jobs": all_uuids})
+
+        to_create = jobs
+        if body.get("idempotent"):
+            # retry of an indeterminate submission: jobs that survived
+            # (or were stranded mid-latch by the ambiguous commit) count
+            # as successes and are made visible; only the rest are
+            # created.  Keyed on job uuid — the issue's idempotency unit.
+            existing, to_create = [], []
+            for job in jobs:
+                prior = self.store.job(job.uuid)
+                if prior is None:
+                    to_create.append(job)
+                elif prior.user != user:
+                    raise ApiError(409, f"job {job.uuid} exists and "
+                                        "belongs to another user")
+                else:
+                    existing.append(job.uuid)
+            if existing:
+                try:
+                    self.store.commit_jobs(existing)
+                except ReplicationIndeterminate as e:
+                    raise _indeterminate(e)
+        if to_create:
+            # atomic batch visibility via commit latch (metatransaction)
+            latch = new_uuid()
+            try:
+                self.store.create_jobs(to_create, groups=groups,
+                                       latch=latch)
+            except AbortTransaction as e:
+                raise ApiError(409, e.reason)
+            except ReplicationIndeterminate as e:
+                # the jobs ARE installed locally (uncommitted); try to
+                # finish the latch so they aren't stranded invisible —
+                # a second indeterminate outcome changes nothing the
+                # client's retry can't heal via the idempotent path
+                try:
+                    self.store.commit_latch(latch)
+                except ReplicationIndeterminate:
+                    pass
+                raise _indeterminate(e)
+            try:
+                self.store.commit_latch(latch)
+            except ReplicationIndeterminate as e:
+                raise _indeterminate(e)
         rl.spend(user, len(specs))
-        return {"jobs": [j.uuid for j in jobs]}
+        return {"jobs": all_uuids}
 
     def get_jobs(self, params: Dict) -> List[Dict]:
         uuids = params.get("uuid", [])
@@ -1377,6 +1440,50 @@ class CookApi:
                 "breakers": breakers.states(),
                 "launch_intents": self.store.launch_intents()}
 
+    def debug_replication(self) -> Dict:
+        """GET /debug/replication — the failover-protocol panel
+        (docs/OBSERVABILITY.md): per-follower acked offsets and synced
+        flags, min_acked, journal head and lag on the leader; the
+        mirror's offset/synced state on a standby; plus every candidate
+        position currently published into the election medium.  Served
+        locally on every node (each node's view IS the datum)."""
+        out: Dict[str, Any] = {"role": "none"}
+        rs = self.repl_server
+        if rs is not None:
+            followers = rs.status()
+            head = 0
+            if getattr(rs, "directory", None):
+                try:
+                    import os as _os
+                    head = _os.path.getsize(
+                        _os.path.join(rs.directory, "journal.jsonl"))
+                except OSError:
+                    head = 0
+            for f in followers:
+                f["lag_bytes"] = max(0, head - int(f.get("acked", 0)))
+            out.update(
+                role="leader", epoch=getattr(rs, "epoch", None),
+                fenced=bool(getattr(rs, "fenced", False)),
+                port=rs.port, journal_bytes=head,
+                min_acked=rs.min_acked(),
+                follower_count=rs.follower_count,
+                synced_followers=rs.synced_follower_count,
+                followers=followers)
+        rf = self.repl_follower
+        if rf is not None:
+            out["role"] = "standby"
+            out["mirror"] = {"offset": rf.offset,
+                             "connected": rf.connected}
+        if self.repl_dir:
+            from ..state.replication import candidate_position
+            out["position"] = candidate_position(self.repl_dir)
+        if self.elector is not None:
+            try:
+                out["candidates"] = self.elector.read_candidates()
+            except Exception:
+                out["candidates"] = {}
+        return out
+
     def settings(self) -> Dict:
         from ..sched.rebalancer import effective_rebalancer_params
         cfg = self.config
@@ -1561,6 +1668,27 @@ class CookApi:
         """Prometheus text exposition (reference: prometheus_metrics.clj +
         /metrics handler rest/api.clj:3981)."""
         from ..utils.metrics import registry
+        rs = self.repl_server
+        if rs is not None and not getattr(rs, "fenced", False):
+            # per-follower mirror lag, refreshed at scrape time (the
+            # replication-health signal operators alert on:
+            # docs/OBSERVABILITY.md cook_replication_lag_bytes).  The
+            # follower label is a per-CONNECTION id, so stale series are
+            # dropped first — reconnect churn must not accumulate frozen
+            # dead-follower series forever
+            registry.gauge_clear("cook_replication_lag_bytes")
+            try:
+                import os as _os
+                head = _os.path.getsize(
+                    _os.path.join(rs.directory, "journal.jsonl"))
+            except OSError:
+                head = 0
+            for f in rs.status():
+                registry.gauge_set(
+                    "cook_replication_lag_bytes",
+                    max(0, head - int(f.get("acked", 0))),
+                    labels={"follower": str(f.get("id")),
+                            "synced": str(bool(f.get("synced"))).lower()})
         lines = registry.expose()
         # always include live gauges derivable from state
         with self.store._lock:
@@ -1721,15 +1849,21 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
         except ApiError as e:
-            self._respond(e.status, {"error": e.message},
+            self._respond(e.status, {"error": e.message, **e.extra},
                           extra_headers=e.headers)
+        except ReplicationIndeterminate as e:
+            # write paths that don't build their own ambiguous-outcome
+            # body (kill/retry/status — all idempotent): the transaction
+            # is applied locally but unconfirmed on the mirror
+            self._respond(504, {"error": str(e), "indeterminate": True})
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": f"internal error: {e}"})
 
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
-                    "/debug/faults", "/metrics", "/failure_reasons",
-                    "/settings", "/swagger-docs", "/swagger-ui"}
+                    "/debug/faults", "/debug/replication", "/metrics",
+                    "/failure_reasons", "/settings", "/swagger-docs",
+                    "/swagger-ui"}
 
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
@@ -1739,6 +1873,24 @@ class _Handler(BaseHTTPRequestHandler):
             if target is not None:
                 query = urllib.parse.urlparse(self.path).query
                 raise _Redirect(target + path + ("?" + query if query else ""))
+            if method in ("POST", "PUT", "DELETE") \
+                    and api.fence_guard is not None and api.fence_guard():
+                # deposed replication leader: a successor minted a higher
+                # election epoch.  Journal fencing already rejects the
+                # next append, but accepting the request at all risks a
+                # split-brain write observed by clients — flip the write
+                # path immediately (redirect when the successor is
+                # already published, 503 otherwise).
+                successor = api.elector.leader_url() if api.elector \
+                    else None
+                if successor and successor != api.node_url:
+                    query = urllib.parse.urlparse(self.path).query
+                    raise _Redirect(successor + path
+                                    + ("?" + query if query else ""))
+                raise ApiError(
+                    503, "this leader has been superseded (stale "
+                         "election epoch); retry against the new leader",
+                    headers={"Retry-After": "1"})
         if method == "GET":
             if path == "/jobs" or path == "/rawscheduler":
                 return api.get_jobs(params)
@@ -1783,6 +1935,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_trace(params)
             if path == "/debug/faults":
                 return api.debug_faults()
+            if path == "/debug/replication":
+                return api.debug_replication()
             if path == "/swagger-docs":
                 return api.swagger_docs()
             if path == "/swagger-ui":

@@ -17,15 +17,26 @@ System/exit on loss :296-313).  Same shape here:
 
 from __future__ import annotations
 
+import json
 import os
+import re
 import threading
 import time
 from pathlib import Path
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
+
+
+def _safe_node_id(node_id: str) -> str:
+    """Node ids become filenames / annotation keys: keep them tame."""
+    return re.sub(r"[^A-Za-z0-9._-]+", "-", node_id).strip("-") or "node"
 
 
 class LeaderElector:
-    """Interface: campaign, observe, resign."""
+    """Interface: campaign, observe, resign — plus the candidate-position
+    plane coordinated promotion publishes through (each standby's
+    replication position ``(epoch, offset, synced)`` rides the election
+    medium so the winner can rank candidates and pull a missing delta
+    before opening its store; state/replication.py choose_successor)."""
 
     #: monotonic election epoch minted at acquisition when the elector
     #: supports it (None otherwise; the store falls back to "auto")
@@ -43,6 +54,19 @@ class LeaderElector:
 
     def leader_url(self) -> Optional[str]:
         raise NotImplementedError
+
+    # ---------------------------------------------- candidate positions
+    def publish_candidate(self, node_id: str, position: Dict) -> None:
+        """Publish this node's replication position into the election
+        medium (no-op for electors without a coordination surface)."""
+
+    def read_candidates(self) -> Dict[str, Dict]:
+        """All published candidate positions, keyed by node id."""
+        return {}
+
+    def clear_candidate(self, node_id: str) -> None:
+        """Withdraw a candidacy (a promoted winner's stale position must
+        not confuse the next election)."""
 
 
 class LeaseLeaderElector(LeaderElector):
@@ -166,6 +190,39 @@ class LeaseLeaderElector(LeaderElector):
             return None  # stale hold: no live leader to redirect to
         return lease.holder_url or None
 
+    # ---------------------------------------------- candidate positions
+    # Candidate positions ride the Lease object's annotations (the same
+    # coordination object that carries the election — no extra
+    # infrastructure), one ``cook.io/candidate-<id>`` key per standby.
+    _CAND_PREFIX = "cook.io/candidate-"
+
+    def publish_candidate(self, node_id: str, position: Dict) -> None:
+        annotate = getattr(self.api, "annotate_lease", None)
+        if annotate is None:
+            return  # adapter without annotation support: no-op
+        annotate(self.lease_name,
+                 {self._CAND_PREFIX + _safe_node_id(node_id):
+                  json.dumps(position)})
+
+    def read_candidates(self) -> Dict[str, Dict]:
+        lease = self.api.get_lease(self.lease_name)
+        annotations = getattr(lease, "annotations", None) or {}
+        out: Dict[str, Dict] = {}
+        for key, value in annotations.items():
+            if not key.startswith(self._CAND_PREFIX):
+                continue
+            try:
+                out[key[len(self._CAND_PREFIX):]] = json.loads(value)
+            except (TypeError, ValueError):
+                continue  # a torn/foreign annotation must not kill ranking
+        return out
+
+    def clear_candidate(self, node_id: str) -> None:
+        annotate = getattr(self.api, "annotate_lease", None)
+        if annotate is not None:
+            annotate(self.lease_name,
+                     {self._CAND_PREFIX + _safe_node_id(node_id): None})
+
 
 class FileLeaderElector(LeaderElector):
     def __init__(self, lock_path: str, node_url: str,
@@ -268,3 +325,39 @@ class FileLeaderElector(LeaderElector):
             return self.url_path.read_text().strip() or None
         except OSError:
             return None
+
+    # ---------------------------------------------- candidate positions
+    # Candidate positions live as sidecar files next to the lock
+    # (``<lock>.cand.<node-id>``), written atomically — the same shared
+    # medium that carries the lock, the minted epoch, and the published
+    # replication address (docs/DEPLOY.md: the election authority).
+    def _cand_path(self, node_id: str) -> Path:
+        return Path(f"{self.lock_path}.cand.{_safe_node_id(node_id)}")
+
+    def publish_candidate(self, node_id: str, position: Dict) -> None:
+        from ..utils.fsatomic import write_atomic_text
+        os.makedirs(os.path.dirname(self.lock_path) or ".", exist_ok=True)
+        write_atomic_text(str(self._cand_path(node_id)),
+                          json.dumps(position))
+
+    def read_candidates(self) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        prefix = self.lock_path.name + ".cand."
+        try:
+            entries = list(self.lock_path.parent.iterdir())
+        except OSError:
+            return out
+        for p in entries:
+            if not p.name.startswith(prefix):
+                continue
+            try:
+                out[p.name[len(prefix):]] = json.loads(p.read_text())
+            except (OSError, ValueError):
+                continue  # a mid-write or corrupt sidecar never wins
+        return out
+
+    def clear_candidate(self, node_id: str) -> None:
+        try:
+            self._cand_path(node_id).unlink()
+        except OSError:
+            pass

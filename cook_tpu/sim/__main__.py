@@ -42,7 +42,22 @@ def main(argv=None) -> int:
     p.add_argument("--leader-kill-at-ms", type=int, default=None,
                    help="chaos: virtual ms offset of the leader kill "
                         "(default 15000; negative disables)")
+    p.add_argument("--chaos-failover", action="store_true",
+                   help="run the multi-standby failover chaos (candidate "
+                        "ranking, delta pull, old-leader fencing, "
+                        "indeterminate commits) over real socket "
+                        "replication; exit 1 on violations")
+    p.add_argument("--leader-mode", default="sigkill",
+                   choices=["sigkill", "partition"],
+                   help="chaos-failover: how the leader is lost")
     args = p.parse_args(argv)
+
+    if args.chaos_failover:
+        from .chaos import FailoverChaosConfig, run_failover_chaos
+        result = run_failover_chaos(FailoverChaosConfig(
+            seed=args.seed or 0, leader_mode=args.leader_mode))
+        print(json.dumps(result.summary(), indent=2))
+        return 0 if result.ok else 1
 
     if args.chaos:
         from .chaos import ChaosConfig, run_chaos

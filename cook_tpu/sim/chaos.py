@@ -343,3 +343,321 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     injector.clear()
     breakers.reset()
     return result
+
+
+# --------------------------------------------------------------------------
+# Multi-standby failover chaos: the quorum-aware promotion protocol under
+# fire (candidate ranking, standby→standby delta pull, old-leader fencing,
+# indeterminate commits), over REAL socket replication — native framed-TCP
+# mirrors, real journals, real fencing files (docs/DEPLOY.md).
+# --------------------------------------------------------------------------
+
+@dataclass
+class FailoverChaosConfig:
+    seed: int = 0
+    #: "sigkill" — the leader process dies outright (store closed, server
+    #: gone); "partition" — the leader stays ALIVE but cut off, and must
+    #: end up fenced end-to-end (journal append, replication serving,
+    #: REST writes)
+    leader_mode: str = "sigkill"
+    #: which standby wins the election lock race: "advanced" (the synced
+    #: one — promotes directly), "laggard" (must pull the delta from the
+    #: advanced peer first), or None (seeded coin flip)
+    winner: Optional[str] = None
+    n_jobs_before_lag: int = 15    # committed while BOTH standbys synced
+    n_jobs_after_lag: int = 10     # committed while standby B lags
+    inject_indeterminate: bool = True
+    ack_timeout_s: float = 5.0
+    data_root: Optional[str] = None
+
+
+@dataclass
+class FailoverChaosResult:
+    violations: List[str] = field(default_factory=list)
+    committed: int = 0
+    winner: str = ""
+    winner_was_laggard: bool = False
+    delta_pulled: bool = False
+    laggard_converged: bool = False
+    indeterminate_commits: int = 0
+    fenced_appends_rejected: int = 0
+    fenced_rest_writes_rejected: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict:
+        return {
+            "ok": self.ok, "violations": list(self.violations),
+            "committed": self.committed, "winner": self.winner,
+            "winner_was_laggard": self.winner_was_laggard,
+            "delta_pulled": self.delta_pulled,
+            "laggard_converged": self.laggard_converged,
+            "indeterminate_commits": self.indeterminate_commits,
+            "fenced_appends_rejected": self.fenced_appends_rejected,
+            "fenced_rest_writes_rejected":
+                self.fenced_rest_writes_rejected,
+        }
+
+
+def _failover_job(i: int):
+    from ..state.schema import Job, Resources
+    return Job(uuid=f"00000000-0000-4000-8000-{i:012d}", user="chaos",
+               command=f"echo {i}", resources=Resources(cpus=1, mem=64))
+
+
+def _journal_bytes(d: str) -> int:
+    import os
+    try:
+        return os.path.getsize(os.path.join(d, "journal.jsonl"))
+    except OSError:
+        return 0
+
+
+def _wait(pred, timeout_s: float = 15.0) -> bool:
+    import time
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
+                       ) -> FailoverChaosResult:
+    """One full quorum-aware failover under an adverse schedule:
+
+    1. leader + two synced standbys, sync replication;
+    2. standby B drops off (once-synced-then-lagged) and the leader
+       keeps committing — including one commit whose ack is fault-lost
+       (``repl.ack``): a first-class INDETERMINATE outcome;
+    3. the leader dies (``sigkill``) or is partitioned (``partition``);
+    4. a seeded lock race decides the election winner; the candidate
+       ranking must still make the BEST-SYNCED position the authority —
+       a laggard winner pulls the delta from the advanced peer before
+       opening its store;
+    5. the loser re-follows the winner and must converge
+       byte-identically;
+    6. (partition mode) the deposed leader's journal appends AND REST
+       writes must be rejected — no split brain.
+
+    Invariants are collected as violations, not raised, so one run
+    reports everything it broke."""
+    import json as _json
+    import os
+    import random
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from ..state import replication as repl
+    from ..state.store import ReplicationIndeterminate, StaleEpochError
+    from ..utils.fsatomic import read_int_file, write_atomic_int
+
+    cc = cc or FailoverChaosConfig()
+    result = FailoverChaosResult()
+    if not repl.replication_available():
+        result.violations.append("native replication library unavailable")
+        return result
+    rng = random.Random(cc.seed)
+    root = cc.data_root or tempfile.mkdtemp(prefix="cook-failover-")
+    d_leader = os.path.join(root, "leader")
+    d_a = os.path.join(root, "standby-a")
+    d_b = os.path.join(root, "standby-b")
+    epoch_authority = os.path.join(root, "election", "cook-leader.lock"
+                                                     ".epoch")
+    os.makedirs(os.path.dirname(epoch_authority), exist_ok=True)
+    write_atomic_int(epoch_authority, 1)
+
+    committed: List[str] = []
+    cleanup = []
+    try:
+        # ---- epoch-1 leadership: leader + two synced standbys --------
+        store = Store.open(d_leader, epoch=1, shared=False)
+        store.attach_fence_authority(epoch_authority)
+        srv = repl.ReplicationServer(d_leader, 0)
+        srv.epoch = 1
+        cleanup.append(srv.stop)
+        store.attach_replication(srv, sync=True,
+                                 timeout_s=cc.ack_timeout_s)
+        fa = repl.ReplicationFollower("127.0.0.1", srv.port, d_a)
+        fb = repl.ReplicationFollower("127.0.0.1", srv.port, d_b)
+        cleanup += [fa.stop, fb.stop]
+        repl.record_followed_epoch(d_a, 1)
+        repl.record_followed_epoch(d_b, 1)
+        if not _wait(lambda: srv.synced_follower_count >= 2):
+            result.violations.append("standbys never synced")
+            return result
+        for i in range(cc.n_jobs_before_lag):
+            store.create_jobs([_failover_job(i)])
+            committed.append(_failover_job(i).uuid)
+        # ---- standby B lags (once-synced-then-lagged candidate) ------
+        if not _wait(lambda: os.path.exists(
+                os.path.join(d_b, "repl_synced"))):
+            result.violations.append("standby B never got its marker")
+        fb.stop()
+        # the server only notices the dead conn when the next append's
+        # JDATA send fails — the first post-lag commit flushes it out
+        # (wait_acked unblocks the moment the worker erases the conn)
+        n = cc.n_jobs_before_lag
+        for i in range(n, n + cc.n_jobs_after_lag):
+            store.create_jobs([_failover_job(i)])
+            committed.append(_failover_job(i).uuid)
+        if srv.synced_follower_count != 1:
+            result.violations.append(
+                "server still counts the lagged standby as synced after "
+                f"{cc.n_jobs_after_lag} commits")
+        if cc.inject_indeterminate:
+            # one commit's ack is lost AFTER the record is durable: the
+            # store must report indeterminate, NOT excise the record —
+            # standby A pulls it anyway, so the failover must keep it
+            # (the phantom-commit hole this PR closes; ADVICE r5)
+            amb = _failover_job(n + cc.n_jobs_after_lag)
+            injector.arm("repl.ack", probability=1.0, max_fires=1)
+            try:
+                store.create_jobs([amb])
+                result.violations.append(
+                    "injected ack loss did not surface as indeterminate")
+            except ReplicationIndeterminate:
+                result.indeterminate_commits += 1
+                committed.append(amb.uuid)  # it IS on the synced mirror
+            finally:
+                injector.disarm("repl.ack")
+            if store.job(amb.uuid) is None:
+                result.violations.append(
+                    "indeterminate commit was rolled back locally")
+        result.committed = len(committed)
+        if not _wait(lambda: fa.offset >= _journal_bytes(d_leader)):
+            result.violations.append("standby A never reached the head")
+
+        # ---- leader loss ---------------------------------------------
+        # either way the standbys lose their stream (fa released so a
+        # winning candidate can reopen d_a as its own store/server)
+        fa.stop()
+        old_store = None
+        if cc.leader_mode == "sigkill":
+            srv.stop()
+            store.close()
+        else:  # partition: alive but cut off from the standbys
+            old_store = store
+        pos_a = dict(repl.candidate_position(d_a), ts=None)
+        pos_b = dict(repl.candidate_position(d_b), ts=None)
+        if repl.rank_key(pos_a) <= repl.rank_key(pos_b):
+            result.violations.append(
+                f"ranking failed to order the synced-ahead candidate "
+                f"first: {pos_a} vs {pos_b}")
+        # ---- election: a seeded lock race, then candidate ranking ----
+        winner = cc.winner or rng.choice(["advanced", "laggard"])
+        result.winner = winner
+        result.winner_was_laggard = winner == "laggard"
+        write_atomic_int(epoch_authority, 2)
+        if winner == "laggard":
+            # B won the lock but A's position is strictly ahead: B must
+            # pull the delta from A over the carrier before promoting
+            ahead = repl.choose_successor(pos_b, {"a": pos_a})
+            if ahead is None or ahead[0] != "a":
+                result.violations.append(
+                    f"laggard winner did not choose the advanced peer "
+                    f"({ahead!r})")
+            catchup_srv = repl.ReplicationServer(d_a, 0)
+            cleanup.append(catchup_srv.stop)
+            if not repl.catch_up_from_peer("127.0.0.1", catchup_srv.port,
+                                           d_b, pos_a["offset"]):
+                result.violations.append("delta pull from peer failed")
+            else:
+                result.delta_pulled = True
+            catchup_srv.stop()
+            d_winner, d_loser = d_b, d_a
+        else:
+            if repl.choose_successor(pos_a, {"b": pos_b}) is not None:
+                result.violations.append(
+                    "advanced winner was told to catch up from a "
+                    "lagging peer")
+            d_winner, d_loser = d_a, d_b
+        try:
+            repl.assert_promotable(d_winner)
+        except RuntimeError as e:
+            result.violations.append(f"promotion gate refused the "
+                                     f"winner: {e}")
+            return result
+        promoted = Store.open(d_winner, epoch=2, shared=False)
+        promoted.attach_fence_authority(epoch_authority)
+        new_srv = repl.ReplicationServer(d_winner, 0)
+        new_srv.epoch = 2
+        cleanup.append(new_srv.stop)
+        promoted.attach_replication(new_srv, sync=True,
+                                    timeout_s=cc.ack_timeout_s)
+        # ---- zero loss ----------------------------------------------
+        for uuid in committed:
+            if promoted.job(uuid) is None:
+                result.violations.append(
+                    f"committed job {uuid} lost by the failover")
+        # ---- the loser re-follows the winner and converges ----------
+        loser_f = repl.ReplicationFollower("127.0.0.1", new_srv.port,
+                                           d_loser)
+        cleanup.append(loser_f.stop)
+        repl.record_followed_epoch(d_loser, 2)
+        promoted.create_jobs([_failover_job(999_999)])  # post-failover tx
+        result.laggard_converged = _wait(
+            lambda: open(os.path.join(d_loser, "journal.jsonl"),
+                         "rb").read()
+            == open(os.path.join(d_winner, "journal.jsonl"), "rb").read()
+            if os.path.exists(os.path.join(d_loser, "journal.jsonl"))
+            else False)
+        if not result.laggard_converged:
+            result.violations.append(
+                "the losing standby did not converge on the winner")
+        loser_f.stop()
+        # ---- fencing the deposed-but-alive leader -------------------
+        if old_store is not None:
+            try:
+                old_store.create_jobs([_failover_job(666_666)])
+                result.violations.append(
+                    "deposed leader's journal append was accepted")
+            except StaleEpochError:
+                result.fenced_appends_rejected += 1
+            srv.fence()
+            if srv.wait_acked(10 ** 9, timeout_s=0.01):
+                result.violations.append(
+                    "fenced replication server confirmed an ack wait")
+            # REST write path flips the moment the epoch is superseded
+            from ..rest.api import ApiServer, CookApi
+            api = CookApi(old_store)
+            api.fence_guard = lambda: (
+                (read_int_file(epoch_authority) or 0)
+                > (old_store._journal_epoch or 0))
+            rest = ApiServer(api)
+            rest.start()
+            cleanup.append(rest.stop)
+            req = urllib.request.Request(
+                rest.url + "/jobs", method="POST",
+                data=_json.dumps({"jobs": [{"command": "x"}]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Cook-User": "chaos"})
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                result.violations.append(
+                    "deposed leader accepted a REST write")
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    result.fenced_rest_writes_rejected += 1
+                else:
+                    result.violations.append(
+                        f"deposed leader's REST write got {e.code}, "
+                        "not 503")
+            # no split brain: the deposed leader holds no commit the
+            # successor lacks (its last accepted tx was pre-partition)
+            if old_store.job(_failover_job(666_666).uuid) is not None:
+                result.violations.append(
+                    "fenced append landed in the deposed leader's store")
+        promoted.close()
+    finally:
+        for fn in reversed(cleanup):
+            try:
+                fn()
+            except Exception:
+                pass
+        injector.disarm("repl.ack")
+    return result

@@ -25,7 +25,7 @@ from .schema import (  # noqa: F401
     now_ms,
     to_json,
 )
-from .store import (AbortTransaction, ReplicationTimeout,  # noqa: F401
-                    StaleEpochError, Store, TxEvent)
+from .store import (AbortTransaction, ReplicationIndeterminate,  # noqa: F401
+                    ReplicationTimeout, StaleEpochError, Store, TxEvent)
 from .index import ColumnarIndex  # noqa: F401
 from . import machines  # noqa: F401

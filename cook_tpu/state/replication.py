@@ -26,10 +26,11 @@ Roles:
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import time
 from pathlib import Path
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 _NATIVE = Path(__file__).resolve().parent.parent.parent / "native"
 _SRC = _NATIVE / "repl.cpp"
@@ -59,6 +60,9 @@ def _load() -> Optional[ctypes.CDLL]:
                                    ctypes.c_int]
     lib.crp_min_acked.restype = ctypes.c_longlong
     lib.crp_min_acked.argtypes = [ctypes.c_void_p]
+    lib.crp_status_json.restype = ctypes.c_int
+    lib.crp_status_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
     lib.crp_stop.argtypes = [ctypes.c_void_p]
     lib.crf_follow.restype = ctypes.c_void_p
     lib.crf_follow.argtypes = [ctypes.c_char_p, ctypes.c_int,
@@ -75,6 +79,119 @@ def replication_available() -> bool:
     return _load() is not None
 
 
+#: sidecar in a mirror directory recording the election epoch of the
+#: leader this mirror last followed — the first component of the
+#: candidate-ranking key (Raft compares (term, log index); here
+#: (followed epoch, mirrored offset), Ongaro & Ousterhout §5.4.1)
+REPL_EPOCH_FILE = "repl_epoch"
+
+
+def record_followed_epoch(directory: str, epoch: int) -> None:
+    """Durably note which election epoch this mirror is following —
+    written by the standby wiring whenever it (re)points its follower at
+    a published leader address."""
+    from ..utils.fsatomic import write_atomic_int
+    os.makedirs(directory, exist_ok=True)
+    write_atomic_int(os.path.join(directory, REPL_EPOCH_FILE), int(epoch))
+
+
+def _trimmed_journal_bytes(path: str) -> int:
+    """Journal bytes up to the last record boundary (the follower only
+    ever acks whole lines; a torn tail from a crash doesn't count)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    with open(path, "rb") as f:
+        # scan back for the last newline in bounded chunks
+        at = size
+        while at > 0:
+            frm = max(0, at - (1 << 16))
+            f.seek(frm)
+            chunk = f.read(at - frm)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                return frm + nl + 1
+            at = frm
+    return 0
+
+
+def candidate_position(directory: str) -> Dict:
+    """This mirror's replication position, as published into the
+    election medium for candidate ranking: ``epoch`` (election epoch of
+    the leader last followed), ``offset`` (mirrored journal bytes at a
+    record boundary), ``synced`` (reached that leader's head at least
+    once), ``began`` (ever was a mirror at all — False = genesis)."""
+    d = Path(directory)
+    from ..utils.fsatomic import read_int_file
+    return {
+        "epoch": read_int_file(str(d / REPL_EPOCH_FILE), 0) or 0,
+        "offset": _trimmed_journal_bytes(str(d / "journal.jsonl")),
+        "synced": (d / "repl_synced").exists(),
+        "began": (d / "repl_token").exists()
+        or (d / "repl_following").exists(),
+    }
+
+
+def rank_key(pos: Dict) -> Tuple[int, int, int]:
+    """Total order over candidate positions: synced beats unsynced, then
+    higher followed epoch (a mirror of a LATER leadership saw commits the
+    earlier one cannot have), then more mirrored bytes.  The Raft
+    vote-comparison rule (§5.4.1) expressed over (epoch, offset)."""
+    return (1 if pos.get("synced") else 0,
+            int(pos.get("epoch") or 0), int(pos.get("offset") or 0))
+
+
+def choose_successor(my_pos: Dict, peers: Dict[str, Dict],
+                     now: Optional[float] = None,
+                     stale_s: float = 10.0) -> Optional[Tuple[str, Dict]]:
+    """Given this node's position and the candidate positions collected
+    from the election medium, return ``(peer_id, peer_position)`` of the
+    best-synced peer STRICTLY ahead of us — the node to pull the missing
+    delta from before opening our store as the new authority — or None
+    when we already hold the best position.  Ghost entries (older than
+    ``stale_s``) are dead nodes' leftovers and never win."""
+    now = time.time() if now is None else now
+    best: Optional[Tuple[str, Dict]] = None
+    for peer_id, pos in peers.items():
+        ts = pos.get("ts")
+        if ts is not None and now - float(ts) > stale_s:
+            continue
+        if not pos.get("synced"):
+            continue  # an unsynced mirror holds nothing we must preserve
+        if rank_key(pos) <= rank_key(my_pos):
+            continue
+        if best is None or rank_key(pos) > rank_key(best[1]):
+            best = (peer_id, pos)
+    return best
+
+
+def catch_up_from_peer(host: str, port: int, directory: str,
+                       target_offset: int,
+                       timeout_s: float = 30.0) -> bool:
+    """Standby→standby catch-up over the existing framed-TCP carrier
+    (Viewstamped Replication's view-change state transfer, Liskov &
+    Cowling §4.2): mirror the better-synced peer's journal into
+    ``directory`` until at least ``target_offset`` bytes AND the synced
+    marker (HEAD) landed, then stop.  The peer's snapshot token differs
+    from ours (tokens are per-directory), so this is a full resync —
+    always correct, and the delta case costs one snapshot copy."""
+    with ReplicationFollower(host, int(port), directory) as f:
+        if not f.wait_offset(int(target_offset), timeout_s=timeout_s):
+            return False
+        # the HEAD marker re-arms the promotion gate; it follows the
+        # last JDATA ack immediately
+        deadline = time.time() + max(2.0, timeout_s / 4)
+        marker = os.path.join(directory, "repl_synced")
+        while time.time() < deadline:
+            if os.path.exists(marker):
+                return True
+            time.sleep(0.005)
+    return os.path.exists(marker)
+
+
 def assert_promotable(directory: str) -> None:
     """Refuse to promote a mirror that BEGAN following (``repl_token``)
     but never reached the leader's head (no ``repl_synced`` marker —
@@ -82,11 +199,13 @@ def assert_promotable(directory: str) -> None:
     discard commits the dead leader confirmed on its synced peers' acks.
     A never-followed directory (no token) is cluster genesis and allowed.
 
-    Residual (documented in DEPLOY.md): a mirror that synced ONCE and
-    then lagged offline keeps its marker — ordering two once-synced
-    candidates by log position needs quorum election (Raft's vote
-    comparison), which the file elector cannot express.  Operators
-    needing strict no-loss run ``min_sync_followers >= 1``."""
+    A mirror that synced ONCE and then lagged keeps its marker and
+    passes this gate; ordering such candidates is the job of the
+    candidate-ranking layer (:func:`choose_successor` over positions
+    published into the election medium) — the winner pulls the missing
+    delta from the best-synced peer (:func:`catch_up_from_peer`) before
+    opening its store, closing the once-synced-lag hole this gate alone
+    could not express."""
     d = Path(directory)
     began_following = (d / "repl_token").exists() \
         or (d / "repl_following").exists()
@@ -119,6 +238,31 @@ class ReplicationServer:
                                f"{port}")
         self.directory = str(directory)
         self.port = lib.crp_port(self._handle)
+        #: election epoch this server serves for (set by the daemon at
+        #: promotion); a superseding epoch fences the server
+        self.epoch: Optional[int] = None
+        self.fenced = False
+
+    def status(self) -> list:
+        """Per-follower replication status: ``[{"id", "acked",
+        "synced"}, ...]`` — the GET /debug/replication surface."""
+        import json as _json
+        with self._mu:
+            if not self._handle:
+                return []
+            buf = ctypes.create_string_buffer(1 << 16)
+            n = self._lib.crp_status_json(self._handle, buf, len(buf))
+            if n < 0:
+                return []
+            return _json.loads(buf.value.decode())
+
+    def fence(self) -> None:
+        """A higher election epoch superseded this leader: refuse to
+        serve the stale journal to followers (they must re-point at the
+        new leader's published address) and fail every later ack wait so
+        a racing commit cannot report determinate success."""
+        self.fenced = True
+        self.stop()
 
     @property
     def follower_count(self) -> int:
@@ -145,10 +289,19 @@ class ReplicationServer:
         """True once every synced follower fsynced through ``offset``
         (vacuously true with none), False on timeout."""
         with self._mu:
-            if not self._handle:  # stopped server: nothing to wait for
-                return True
-            return bool(self._lib.crp_wait_acked(
+            if not self._handle:
+                # stopped server: nothing to wait for — UNLESS it was
+                # stopped by a fence, where a vacuous True would report
+                # determinate success on a deposed leader (the fenced
+                # flag is re-checked under _mu: fence() can race the
+                # pre-lock window of a committing thread)
+                return not self.fenced
+            acked = bool(self._lib.crp_wait_acked(
                 self._handle, int(offset), int(timeout_s * 1000)))
+            # a fence that landed during the wait demotes the outcome to
+            # indeterminate: the acking mirrors will resync to the
+            # successor, whose replay skips this record's stale epoch
+            return acked and not self.fenced
 
     def min_acked(self) -> int:
         """Lowest synced-follower ack offset, -1 when none."""

@@ -59,9 +59,22 @@ class StaleEpochError(RuntimeError):
 
 
 class ReplicationTimeout(RuntimeError):
-    """Sync replication could not confirm the transaction on the
-    follower(s) in time; the local journal record is excised and the
-    transaction aborted — "committed" always implies "on the mirror"."""
+    """Sync replication refused the transaction BEFORE its record was
+    written anywhere (the CP quorum gate, or the stream down pre-write):
+    a clean abort — nothing on disk, nothing installed, safe to retry."""
+
+
+class ReplicationIndeterminate(RuntimeError):
+    """Sync replication could not CONFIRM the transaction: the record is
+    durable in the local journal and may or may not have reached a
+    mirror.  The transaction IS applied locally (excising the record
+    would resurrect it as a phantom commit on a mirror that did fsync it
+    before a failover — ADVICE r5), but the caller must report the
+    outcome as ambiguous: if this leader survives, the record re-syncs
+    and the commit stands; if a mirror that missed it promotes, the
+    commit is lost.  Journal replay resolves it on the next open either
+    way.  REST surfaces this as HTTP 504 with an ``indeterminate`` body;
+    retries are safe — submission is idempotent on job uuid."""
 
 
 class AbortTransaction(Exception):
@@ -86,6 +99,11 @@ class TxEvent:
 class _Txn:
     """One open transaction: copy-on-write views over the store's entity maps."""
 
+    #: peeked store entities spot-checked per txn (``__debug__`` only):
+    #: mutation by a guard is deterministic, so checking the first few
+    #: catches it without taxing 1000-launch batches
+    _PEEK_CHECKS = 8
+
     def __init__(self, store: "Store"):
         self._store = store
         self._writes: Dict[Tuple[str, str], Any] = {}
@@ -94,6 +112,9 @@ class _Txn:
         # latch registrations/releases applied atomically with the commit
         self.latch_registrations: List[Tuple[str, List[str]]] = []
         self.latch_pops: List[str] = []
+        # (table, key, entity, fingerprint) of peeked LIVE store entities,
+        # re-verified at commit (__debug__ only; see peek())
+        self._peeks: List[Tuple[str, str, Any, str]] = []
 
     def _get(self, table: str, key: str, for_write: bool,
              clone: bool = True) -> Any:
@@ -154,8 +175,30 @@ class _Txn:
         For guards that only inspect: _get's copy-on-read exists so a
         mutating txn fn can't leak into the store, but a guard that
         mutates nothing pays the full entity clone for every launch.
-        The caller MUST NOT mutate the returned entity."""
-        return self._get(table, key, for_write=False, clone=False)
+        The caller MUST NOT mutate the returned entity — under
+        ``__debug__`` a fingerprint taken here is re-checked at commit
+        (``_verify_peeks``), so a guard that breaks the promise fails the
+        transaction loudly instead of silently corrupting committed
+        state outside the undo log."""
+        ent = self._get(table, key, for_write=False, clone=False)
+        if __debug__ and ent is not None \
+                and (table, key) not in self._writes \
+                and len(self._peeks) < self._PEEK_CHECKS:
+            # only LIVE store entities are frozen; a peek that resolved
+            # to this txn's own write intent may be legally mutated via
+            # the _w accessors afterwards
+            self._peeks.append((table, key, ent, repr(ent)))
+        return ent
+
+    def _verify_peeks(self) -> None:
+        """``__debug__``-mode commit check: no peeked store entity was
+        mutated (peek's no-clone contract, spot-checked)."""
+        for table, key, ent, fp in self._peeks:
+            if repr(ent) != fp:
+                raise AssertionError(
+                    f"peeked entity {table}/{key} was mutated inside the "
+                    "transaction: peek()/peek_instances_of return LIVE "
+                    "store entities; use the *_w accessors for writes")
 
     def peek_instances_of(self, job: Job) -> Dict[str, Instance]:
         """``instances_of`` for read-only guards (no defensive clones):
@@ -279,13 +322,22 @@ class Store:
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
         """Run ``fn`` transactionally. Its writes are installed atomically on
-        normal return; AbortTransaction rolls back and re-raises."""
+        normal return; AbortTransaction rolls back and re-raises.
+
+        :class:`ReplicationIndeterminate` is the one exception that does
+        NOT roll back: the record is already durable in the local journal
+        (and possibly on a mirror), so the writes install locally and the
+        exception re-raises for the caller to report the ambiguous
+        outcome (docs/DEPLOY.md indeterminate-commit contract)."""
+        indeterminate: Optional[ReplicationIndeterminate] = None
         with self._lock:
             if self._journal_poisoned:
                 raise RuntimeError(
                     "journal poisoned by a failed append; reopen the store")
             txn = _Txn(self)
             result = fn(txn)  # AbortTransaction propagates; nothing installed
+            if __debug__:
+                txn._verify_peeks()
             self._tx_id += 1
             # Write-ahead: journal BEFORE installing, so a failed append
             # (disk full, bad fd) aborts the transaction instead of leaving
@@ -294,7 +346,10 @@ class Store:
             if self._journal_file is not None and (
                     txn._writes or txn._deletes or txn.latch_registrations
                     or txn.latch_pops):
-                self._journal_append(txn)
+                try:
+                    self._journal_append(txn)
+                except ReplicationIndeterminate as e:
+                    indeterminate = e  # locally durable: install below
             for (table, key), ent in txn._writes.items():
                 getattr(self, "_" + table)[key] = ent
             for table, key in txn._deletes:
@@ -306,6 +361,8 @@ class Store:
             if txn.events:
                 self._event_queue.append((self._tx_id, txn.events))
         self._drain_events()
+        if indeterminate is not None:
+            raise indeterminate
         return result
 
     def _journal_append(self, txn: _Txn) -> None:
@@ -337,6 +394,26 @@ class Store:
         # the true end-of-good-records offset
         good_offset = f.tell()
         from ..utils.faults import injector as _faults
+        # Pre-write replication gates: failures HERE are clean aborts —
+        # the record exists nowhere, so nothing to excise and no phantom
+        # possible.  The CP quorum gate moved ahead of the write for
+        # exactly that reason: refusing AFTER the write would leave a
+        # record some catching-up follower may already be pulling.
+        if self._repl_server is not None:
+            _faults.fire(
+                "repl.stream",
+                lambda: ReplicationTimeout("injected replication "
+                                           "stream fault"))
+            if (self._repl_sync and self._repl_min_followers > 0 and
+                    self._repl_server.synced_follower_count
+                    < self._repl_min_followers):
+                # SYNCED followers: one mid-catch-up neither acks nor
+                # counts, else the CP gate would pass while wait_acked
+                # ignores it (vacuous durability)
+                raise ReplicationTimeout(
+                    f"{self._repl_server.synced_follower_count} "
+                    "synced follower(s) < required "
+                    f"{self._repl_min_followers}")
         try:
             _faults.fire("store.journal.append",
                          lambda: OSError("injected journal write failure"))
@@ -348,34 +425,27 @@ class Store:
                     lambda: OSError("injected journal fsync failure"))
                 os.fsync(f.fileno())
             if self._repl_server is not None:
-                _faults.fire(
-                    "repl.stream",
-                    lambda: ReplicationTimeout("injected replication "
-                                               "stream fault"))
-                # sync replication: commit = fsynced on every connected
-                # follower.  Raising here (inside the try) excises the
-                # local record and aborts the transaction, so a client
-                # never sees "committed" for a record the mirror lacks.
-                # A truncated record a follower DID receive diverges its
-                # tail — the server detects pos > journal size on its
-                # next pass and full-resyncs that follower.
+                # From here on the record is durable locally and visible
+                # to followers: an unconfirmed ack is a first-class
+                # INDETERMINATE outcome, not an abort.  Excising the
+                # record (the pre-PR behavior) could resurrect it as a
+                # phantom commit on a mirror that fsynced it before a
+                # failover (ADVICE r5) — "aborted" must imply "nowhere".
                 self._repl_server.poke()
                 if self._repl_sync:
-                    if (self._repl_min_followers > 0 and
-                            self._repl_server.synced_follower_count
-                            < self._repl_min_followers):
-                        # SYNCED followers: one mid-catch-up neither acks
-                        # nor counts, else the CP gate would pass while
-                        # wait_acked ignores it (vacuous durability)
-                        raise ReplicationTimeout(
-                            f"{self._repl_server.synced_follower_count} "
-                            "synced follower(s) < required "
-                            f"{self._repl_min_followers}")
+                    _faults.fire(
+                        "repl.ack",
+                        lambda: ReplicationIndeterminate(
+                            "injected replication ack loss"))
                     if not self._repl_server.wait_acked(
                             f.tell(), self._repl_timeout_s):
-                        raise ReplicationTimeout(
+                        raise ReplicationIndeterminate(
                             "followers did not ack within "
-                            f"{self._repl_timeout_s}s")
+                            f"{self._repl_timeout_s}s; the record is in "
+                            "the local journal and MAY be mirrored — "
+                            "the commit stands if this leader survives "
+                            "and resolves at the next failover replay "
+                            "otherwise")
                     if (self._repl_min_followers > 0 and
                             self._repl_server.synced_follower_count
                             < self._repl_min_followers):
@@ -383,9 +453,13 @@ class Store:
                         # between the gate and the ack makes wait_acked
                         # pass vacuously (empty quorum) — that must not
                         # count as a confirmed CP commit
-                        raise ReplicationTimeout(
+                        raise ReplicationIndeterminate(
                             "follower lost during ack wait; quorum "
-                            f"below {self._repl_min_followers}")
+                            f"below {self._repl_min_followers} — the "
+                            "record is journaled locally and may be "
+                            "mirrored")
+        except ReplicationIndeterminate:
+            raise  # durable locally: transact installs, caller reports
         except Exception:
             try:
                 if self._journal_epoch is not None and self._journal_shared:
@@ -492,6 +566,36 @@ class Store:
             return uuids
 
         return self.transact(_create)
+
+    def commit_jobs(self, uuids: List[str]) -> int:
+        """Mark already-present jobs committed (visible) directly — the
+        idempotent-resubmission healer: a replication-indeterminate
+        submission can leave jobs created but their latch never
+        committed; the client's retry (same uuids) lands here and makes
+        them visible instead of stranding them forever."""
+
+        def _commit(txn: _Txn) -> int:
+            n = 0
+            target = set(uuids)
+            for uuid in uuids:
+                job = txn.job(uuid)
+                if job is not None and not job.committed:
+                    job = txn.job_w(uuid)
+                    job.committed = True
+                    txn.event("job-committed", uuid=uuid)
+                    n += 1
+            # reap latches the indeterminate submission stranded: once
+            # every member is committed (or gone), commit_latch will
+            # never pop the entry, and it would otherwise leak into
+            # every future checkpoint and replay
+            for latch, members in self._latches.items():
+                if all(u in target
+                       or (j := txn.peek("jobs", u)) is None or j.committed
+                       for u in members):
+                    txn.latch_pops.append(latch)
+            return n
+
+        return self.transact(_commit)
 
     def commit_latch(self, latch: str) -> None:
         def _commit(txn: _Txn) -> None:
@@ -1042,6 +1146,21 @@ class Store:
         self._journal_epoch = epoch
         return epoch
 
+    def attach_fence_authority(self, path: str) -> None:
+        """Point the append-time fence check at a SHARED epoch authority
+        (the election dir's minted counter) instead of the node-local
+        ``<dir>/epoch`` claim file.  In the socket-replication topology
+        the journal directory is node-local, so nothing ever bumps the
+        local epoch file — without this, a deposed-but-alive leader's
+        appends and checkpoints would pass the fence forever and only
+        replay-time epoch skipping on the promoted mirror would protect
+        the cluster.  With it, the first append after a successor mints
+        a higher epoch raises :class:`StaleEpochError` and poisons the
+        journal (same contract as the shared-dir topology)."""
+        with self._lock:
+            self._epoch_path = path
+            self._epoch_stat = None  # force a re-read on the next append
+
     # ------------------------------------------------------- durable journal
     def attach_journal(self, path: str, fsync: bool = False) -> None:
         """Start appending every committed transaction to ``path`` as one
@@ -1058,11 +1177,15 @@ class Store:
         """Stream this store's journal to followers via a running
         :class:`~cook_tpu.state.replication.ReplicationServer` over the
         native framed-TCP carrier.  With ``sync`` (the default), a
-        transaction only commits after every connected follower fsynced
-        its record — :class:`ReplicationTimeout` aborts it otherwise.
-        ``min_followers`` > 0 additionally refuses commits when fewer
-        followers are connected (CP mode; the default 0 keeps a lone
-        leader available, like the reference's single transactor)."""
+        transaction only reports determinate success after every synced
+        follower fsynced its record; an unconfirmed ack raises
+        :class:`ReplicationIndeterminate` (the record stays journaled
+        and applied locally — the ambiguous-outcome contract).
+        ``min_followers`` > 0 refuses commits BEFORE writing anything
+        when fewer synced followers are connected
+        (:class:`ReplicationTimeout`, a clean abort — CP mode; the
+        default 0 keeps a lone leader available, like the reference's
+        single transactor)."""
         with self._lock:
             self._repl_server = server
             self._repl_sync = sync

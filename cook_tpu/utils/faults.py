@@ -20,7 +20,11 @@ Registered point names (the sites that consult this module):
 ==========================  ====================================================
 ``store.journal.append``    `state/store.py` — journal write fails (disk error)
 ``store.journal.fsync``     `state/store.py` — fsync fails after the write
-``repl.stream``             `state/store.py` — follower ack never arrives
+``repl.stream``             `state/store.py` — stream down BEFORE the record
+                            was written (clean abort)
+``repl.ack``                `state/store.py` — follower ack never arrives
+                            AFTER the record is durable locally
+                            (indeterminate outcome)
 ``remote.rpc``              `cluster/remote.py` — agent launch RPC fails
 ``agent.heartbeat``         `sched/scheduler.py` — a heartbeat frame is dropped
 ``k8s.watch.disconnect``    `cluster/k8s/real_api.py` — watch stream drops

@@ -61,6 +61,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(name, _labels_key(labels))] = value
 
+    def gauge_clear(self, name: str) -> None:
+        """Drop every series of ``name`` — for gauges whose label sets
+        name ephemeral entities (e.g. per-connection replication
+        followers): re-set at each refresh, the series set stays bounded
+        to what is live instead of accumulating frozen stale labels."""
+        with self._lock:
+            for key in [k for k in self._gauges if k[0] == name]:
+                del self._gauges[key]
+
     def observe(self, name: str, value_s: float,
                 labels: Optional[Dict[str, str]] = None,
                 buckets: Optional[Tuple[float, ...]] = None) -> None:

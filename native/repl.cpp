@@ -626,6 +626,31 @@ long long crp_min_acked(void* h) {
   return m;
 }
 
+// Per-follower replication status as a JSON array written into `buf`
+// (id, acked offset, synced flag) — the observability surface behind
+// GET /debug/replication.  Returns the number of bytes written (excluding
+// the NUL), or -1 when `cap` is too small.
+int crp_status_json(void* h, char* buf, int cap) {
+  auto* s = static_cast<ReplServer*>(h);
+  std::ostringstream ss;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    ss << "[";
+    bool first = true;
+    for (const auto& kv : s->conns) {
+      if (!first) ss << ",";
+      first = false;
+      ss << "{\"id\":" << kv.first << ",\"acked\":" << kv.second.acked
+         << ",\"synced\":" << (kv.second.synced ? "true" : "false") << "}";
+    }
+    ss << "]";
+  }
+  std::string out = ss.str();
+  if (static_cast<int>(out.size()) + 1 > cap) return -1;
+  std::memcpy(buf, out.c_str(), out.size() + 1);
+  return static_cast<int>(out.size());
+}
+
 void crp_stop(void* h) {
   auto* s = static_cast<ReplServer*>(h);
   s->stopping.store(true);

@@ -1,0 +1,489 @@
+"""Quorum-aware lossless failover: candidate ranking, coordinated
+promotion (standby→standby delta pull), old-leader fencing, and the
+indeterminate-commit contract.
+
+Layered like the protocol itself:
+
+- pure promotion-ordering logic (rank_key / choose_successor /
+  candidate_position / assert_promotable) — no native library needed;
+- indeterminate commits at the store and REST/client layers over a stub
+  replication server — the phantom-commit hole (ADVICE r5) closed;
+- the election medium's candidate-position plane (file sidecars and
+  lease annotations);
+- the full multi-standby chaos scenarios over REAL socket replication
+  (tier-1 smoke with fixed winners; multi-seed soak is ``slow``).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.state import replication as repl
+from cook_tpu.state.store import (
+    ReplicationIndeterminate,
+    ReplicationTimeout,
+    Store,
+)
+from cook_tpu.state.schema import Job, Resources
+
+
+def make_job(i, user="alice"):
+    return Job(uuid=f"00000000-0000-0000-0000-{i:012d}", user=user,
+               command=f"echo {i}", resources=Resources(cpus=1, mem=64))
+
+
+# --------------------------------------------------------------------------
+# Promotion-ordering logic (satellite: successor-logic edge cases)
+# --------------------------------------------------------------------------
+
+class TestCandidateRanking:
+    def test_candidate_position_genesis(self, tmp_path):
+        d = tmp_path / "genesis"
+        d.mkdir()
+        pos = repl.candidate_position(str(d))
+        assert pos == {"epoch": 0, "offset": 0, "synced": False,
+                       "began": False}
+
+    def test_candidate_position_token_but_never_synced(self, tmp_path):
+        d = tmp_path / "m"
+        d.mkdir()
+        (d / "repl_token").write_text("tok")
+        (d / "journal.jsonl").write_bytes(b'{"tx": 1}\n{"torn')
+        repl.record_followed_epoch(str(d), 3)
+        pos = repl.candidate_position(str(d))
+        assert pos["began"] and not pos["synced"]
+        assert pos["epoch"] == 3
+        # torn tail doesn't count: only whole records were ever acked
+        assert pos["offset"] == len(b'{"tx": 1}\n')
+
+    def test_rank_synced_beats_unsynced_then_epoch_then_offset(self):
+        unsynced_big = {"synced": False, "epoch": 9, "offset": 10 ** 9}
+        synced_old = {"synced": True, "epoch": 1, "offset": 10}
+        synced_new_short = {"synced": True, "epoch": 2, "offset": 5}
+        synced_new_long = {"synced": True, "epoch": 2, "offset": 50}
+        ranked = sorted([unsynced_big, synced_old, synced_new_short,
+                         synced_new_long], key=repl.rank_key)
+        assert ranked == [unsynced_big, synced_old, synced_new_short,
+                          synced_new_long]
+
+    def test_choose_successor_prefers_strictly_ahead_synced_peer(self):
+        me = {"synced": True, "epoch": 2, "offset": 100}
+        peers = {
+            "never-synced": {"synced": False, "epoch": 2,
+                             "offset": 10 ** 9},           # holds nothing
+            "lagged": {"synced": True, "epoch": 2, "offset": 50},
+            "ahead": {"synced": True, "epoch": 2, "offset": 200},
+            "older-leadership": {"synced": True, "epoch": 1,
+                                 "offset": 10 ** 9},
+        }
+        peer_id, pos = repl.choose_successor(me, peers)
+        assert peer_id == "ahead" and pos["offset"] == 200
+
+    def test_choose_successor_none_when_best(self):
+        me = {"synced": True, "epoch": 2, "offset": 100}
+        assert repl.choose_successor(me, {
+            "b": {"synced": True, "epoch": 2, "offset": 100},  # tie: me
+            "c": {"synced": False, "epoch": 3, "offset": 999},
+        }) is None
+
+    def test_choose_successor_ignores_stale_ghosts(self):
+        me = {"synced": True, "epoch": 2, "offset": 100}
+        ghost = {"synced": True, "epoch": 2, "offset": 999, "ts": 0.0}
+        assert repl.choose_successor(me, {"g": ghost}, now=100.0,
+                                     stale_s=10.0) is None
+        fresh = dict(ghost, ts=95.0)
+        assert repl.choose_successor(me, {"g": fresh}, now=100.0,
+                                     stale_s=10.0) == ("g", fresh)
+
+    def test_assert_promotable_cases(self, tmp_path):
+        # genesis (never followed): allowed
+        d = tmp_path / "a"
+        d.mkdir()
+        repl.assert_promotable(str(d))
+        # began following, never synced: refused
+        (d / "repl_following").write_text("1")
+        with pytest.raises(RuntimeError, match="never reached"):
+            repl.assert_promotable(str(d))
+        (d / "repl_token").write_text("tok")
+        with pytest.raises(RuntimeError, match="never reached"):
+            repl.assert_promotable(str(d))
+        # once-synced (even if since lagged): passes the GATE — ordering
+        # among synced candidates is choose_successor's job
+        (d / "repl_synced").write_text("1")
+        repl.assert_promotable(str(d))
+
+
+# --------------------------------------------------------------------------
+# Indeterminate commits (stub replication server; no native lib needed)
+# --------------------------------------------------------------------------
+
+class _StubRepl:
+    """Minimal attach_replication target: scripted ack outcomes."""
+
+    def __init__(self, acks=(True,), synced=1):
+        self.acks = list(acks)
+        self.synced = synced
+        self.directory = ""
+        self.port = 0
+
+    def poke(self):
+        pass
+
+    def wait_acked(self, offset, timeout_s=0.0):
+        return self.acks.pop(0) if self.acks else True
+
+    @property
+    def synced_follower_count(self):
+        return self.synced
+
+    def min_acked(self):
+        return -1
+
+    def status(self):
+        return []
+
+
+class TestIndeterminateCommit:
+    def test_unacked_commit_is_indeterminate_not_aborted(self, tmp_path):
+        store = Store.open(str(tmp_path / "d"))
+        store.attach_replication(_StubRepl(acks=[False]), sync=True,
+                                 timeout_s=0.01)
+        job = make_job(1)
+        with pytest.raises(ReplicationIndeterminate):
+            store.create_jobs([job])
+        # applied locally — NOT rolled back...
+        assert store.job(job.uuid) is not None
+        # ...and the record stays in the journal: the next open (this
+        # leader surviving, or its mirror promoting) resolves it as
+        # committed instead of resurrecting a phantom
+        store.close()
+        replayed = Store.replay_only(str(tmp_path / "d"))
+        assert replayed.job(job.uuid) is not None
+
+    def test_quorum_gate_still_aborts_cleanly_before_write(self,
+                                                           tmp_path):
+        store = Store.open(str(tmp_path / "d"))
+        store.attach_replication(_StubRepl(synced=0), sync=True,
+                                 timeout_s=0.01, min_followers=1)
+        job = make_job(1)
+        with pytest.raises(ReplicationTimeout):
+            store.create_jobs([job])
+        # a clean abort: nothing installed, nothing journaled
+        assert store.job(job.uuid) is None
+        store.close()
+        assert Store.replay_only(str(tmp_path / "d")).job(job.uuid) is None
+
+    def test_repl_ack_fault_point_injects_indeterminate(self, tmp_path):
+        from cook_tpu.utils.faults import injector
+        store = Store.open(str(tmp_path / "d"))
+        store.attach_replication(_StubRepl(), sync=True)
+        injector.arm("repl.ack", probability=1.0, max_fires=1)
+        try:
+            with pytest.raises(ReplicationIndeterminate):
+                store.create_jobs([make_job(1)])
+        finally:
+            injector.disarm("repl.ack")
+        assert store.job(make_job(1).uuid) is not None
+
+
+@pytest.fixture()
+def rest_pair(tmp_path):
+    """ApiServer over a journaled store with a scriptable stub repl."""
+    from cook_tpu.rest.api import ApiServer, CookApi
+    store = Store.open(str(tmp_path / "rest"))
+    stub = _StubRepl(acks=[])
+    store.attach_replication(stub, sync=True, timeout_s=0.01)
+    api = CookApi(store)
+    server = ApiServer(api)
+    server.start()
+    yield store, stub, api, server
+    server.stop()
+    store.close()
+
+
+class TestIndeterminateRest:
+    def test_504_with_ambiguous_body_and_client_retry_heals(
+            self, rest_pair):
+        from cook_tpu.client import JobClient, JobClientError
+        store, stub, _api, server = rest_pair
+        client = JobClient(server.url, user="alice")
+        # both the create txn and the latch commit go unconfirmed: the
+        # worst case — jobs journaled but possibly stranded uncommitted
+        stub.acks = [False, False]
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x",
+                            "uuid": "00000000-0000-4000-8000-0000000000aa"}],
+                          indeterminate_retries=0)
+        assert e.value.status == 504
+        assert e.value.indeterminate
+        assert e.value.body["jobs"] == [
+            "00000000-0000-4000-8000-0000000000aa"]
+        # replication heals (acks flow again); the client retry of the
+        # SAME batch — the manual form of the auto-retry — must neither
+        # lose nor duplicate the job
+        stub.acks = []
+        uuids = client.submit(
+            [{"command": "x",
+              "uuid": "00000000-0000-4000-8000-0000000000aa"}],
+            idempotent=True)
+        assert uuids == ["00000000-0000-4000-8000-0000000000aa"]
+        [job] = client.query(uuids)
+        assert job["uuid"] == uuids[0]
+        # exactly one job exists (visible and committed)
+        assert len(store.jobs_where(lambda j: True)) == 1
+        # the stranded latch was reaped by the heal — it must not leak
+        # into every future checkpoint/replay
+        assert store._latches == {}
+
+    def test_client_auto_retry_rides_out_one_indeterminate(self,
+                                                           rest_pair):
+        from cook_tpu.client import JobClient
+        store, stub, _api, server = rest_pair
+        client = JobClient(server.url, user="alice")
+        stub.acks = [False, False]  # first attempt: create+latch unacked
+        uuids = client.submit([{"command": "y"}])  # default retries
+        assert len(uuids) == 1
+        assert store.job(uuids[0]) is not None
+        assert len(store.jobs_where(lambda j: True)) == 1
+
+    def test_retry_after_lost_commit_recreates(self, rest_pair):
+        """The other future: the commit was LOST in the failover (the
+        promoted mirror never had it).  The same idempotent retry simply
+        creates the job — nothing lost, nothing duplicated."""
+        from cook_tpu.client import JobClient
+        _store, _stub, api, server = rest_pair
+        api.store = Store()  # "promoted" store that missed the commit
+        client = JobClient(server.url, user="alice")
+        body = {"jobs": [{"command": "z",
+                          "uuid": "00000000-0000-4000-8000-0000000000bb"}],
+                "idempotent": True}
+        req = urllib.request.Request(
+            server.url + "/jobs", method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": "alice"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.load(resp)["jobs"] == [
+                "00000000-0000-4000-8000-0000000000bb"]
+        assert api.store.job(
+            "00000000-0000-4000-8000-0000000000bb") is not None
+
+    def test_idempotent_refuses_foreign_uuid(self, rest_pair):
+        from cook_tpu.client import JobClient, JobClientError
+        _store, _stub, api, server = rest_pair
+        mallory = JobClient(server.url, user="mallory")
+        alice = JobClient(server.url, user="alice")
+        [uuid] = alice.submit([{"command": "a"}])
+        body = {"jobs": [{"command": "a", "uuid": uuid}],
+                "idempotent": True}
+        req = urllib.request.Request(
+            server.url + "/jobs", method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": "mallory"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 409
+
+
+class TestRestFencing:
+    def test_superseded_leader_rejects_writes_serves_reads(self,
+                                                           rest_pair):
+        _store, _stub, api, server = rest_pair
+        api.fence_guard = lambda: True  # a successor minted a higher epoch
+        req = urllib.request.Request(
+            server.url + "/jobs", method="POST",
+            data=json.dumps({"jobs": [{"command": "x"}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": "alice"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 503
+        # reads still answer (clients re-resolve the leader themselves)
+        with urllib.request.urlopen(server.url + "/jobs?user=alice",
+                                    timeout=5) as resp:
+            assert resp.status == 200
+        # local debug surfaces are never fenced
+        with urllib.request.urlopen(server.url + "/debug/replication",
+                                    timeout=5) as resp:
+            doc = json.load(resp)
+        assert doc["role"] in ("none", "leader", "standby")
+
+
+# --------------------------------------------------------------------------
+# The election medium's candidate-position plane
+# --------------------------------------------------------------------------
+
+class TestCandidatePublication:
+    def test_file_elector_sidecars_roundtrip(self, tmp_path):
+        from cook_tpu.sched.election import FileLeaderElector
+        a = FileLeaderElector(tmp_path / "lock", "http://a")
+        b = FileLeaderElector(tmp_path / "lock", "http://b")
+        a.publish_candidate("node a!", {"epoch": 1, "offset": 10,
+                                        "synced": True})
+        b.publish_candidate("node-b", {"epoch": 1, "offset": 20,
+                                       "synced": False})
+        got = a.read_candidates()
+        assert got["node-a"]["offset"] == 10  # id sanitized for the fs
+        assert got["node-b"]["synced"] is False
+        a.clear_candidate("node a!")
+        assert "node-a" not in b.read_candidates()
+
+    def test_lease_elector_annotations_roundtrip(self):
+        from cook_tpu.cluster.k8s.fake_api import FakeKubernetesApi
+        from cook_tpu.sched.election import LeaseLeaderElector
+        api = FakeKubernetesApi()
+        clock = {"t": 0.0}
+        a = LeaseLeaderElector(api, "node-a", "http://a:1",
+                               clock=lambda: clock["t"])
+        a.publish_candidate("node-a", {"epoch": 2, "offset": 7,
+                                       "synced": True})
+        # positions survive the holder's renewals (the lease is replaced
+        # wholesale on every acquire — annotations must be preserved)
+        assert a.try_once()
+        got = a.read_candidates()
+        assert got == {"node-a": {"epoch": 2, "offset": 7,
+                                  "synced": True}}
+        a.clear_candidate("node-a")
+        assert a.read_candidates() == {}
+
+
+# --------------------------------------------------------------------------
+# Multi-standby chaos over real socket replication
+# --------------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(not repl.replication_available(),
+                                  reason="C++ toolchain unavailable")
+
+
+@needs_native
+@pytest.mark.chaos
+def test_failover_chaos_laggard_winner_pulls_delta(tmp_path):
+    """Leader SIGKILL with one fault-lagged standby, where the LAGGARD
+    wins the lock race: candidate ranking must still make the advanced
+    mirror the authority — the winner pulls the delta first; zero
+    committed transactions lost; the loser re-follows and converges."""
+    from cook_tpu.sim.chaos import FailoverChaosConfig, run_failover_chaos
+    r = run_failover_chaos(FailoverChaosConfig(
+        seed=7, leader_mode="sigkill", winner="laggard",
+        data_root=str(tmp_path)))
+    assert r.ok, r.violations
+    assert r.winner_was_laggard and r.delta_pulled
+    assert r.laggard_converged
+    assert r.indeterminate_commits == 1
+
+
+@needs_native
+@pytest.mark.chaos
+def test_failover_chaos_partitioned_old_leader_is_fenced(tmp_path):
+    """A partitioned-but-alive deposed leader: journal appends AND REST
+    writes rejected, no split brain, and the successor holds every
+    committed transaction (the advanced standby promotes directly)."""
+    from cook_tpu.sim.chaos import FailoverChaosConfig, run_failover_chaos
+    r = run_failover_chaos(FailoverChaosConfig(
+        seed=7, leader_mode="partition", winner="advanced",
+        data_root=str(tmp_path)))
+    assert r.ok, r.violations
+    assert not r.winner_was_laggard and not r.delta_pulled
+    assert r.fenced_appends_rejected == 1
+    assert r.fenced_rest_writes_rejected == 1
+    assert r.laggard_converged
+
+
+@needs_native
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_failover_chaos_soak(tmp_path, seed):
+    """Multi-seed soak: seeded winner/mode coin flips cover every
+    combination of lock-race outcome and leader-death flavor."""
+    import random
+    from cook_tpu.sim.chaos import FailoverChaosConfig, run_failover_chaos
+    rng = random.Random(seed)
+    r = run_failover_chaos(FailoverChaosConfig(
+        seed=seed,
+        leader_mode=rng.choice(["sigkill", "partition"]),
+        n_jobs_before_lag=30, n_jobs_after_lag=20,
+        data_root=str(tmp_path)))
+    assert r.ok, r.violations
+    assert r.laggard_converged
+    assert r.indeterminate_commits == 1
+
+
+@needs_native
+def test_daemon_replicated_failover_end_to_end(tmp_path):
+    """Two in-process CookDaemons over real socket replication: the
+    standby publishes candidate positions while following, and on
+    leader handoff runs the COORDINATED promotion path (candidacy
+    window, ranking, fence authority, /debug/replication role flip)
+    with every committed job surviving."""
+    from cook_tpu.client import JobClient
+    from cook_tpu.daemon import CookDaemon
+
+    election = tmp_path / "election"
+    election.mkdir()
+
+    def conf(node):
+        return {
+            "host": "127.0.0.1", "port": 0,
+            "data_dir": str(tmp_path / f"data-{node}"),
+            "election_dir": str(election),
+            "replication": {"listen_port": 0, "sync": True,
+                            "candidacy_window_seconds": 0.2,
+                            "position_interval_seconds": 0.1},
+            "clusters": [{"factory": "cook_tpu.cluster.fake.factory",
+                          "kwargs": {"name": f"fake-{node}",
+                                     "n_hosts": 2}}],
+            "scheduler": {"rank_backend": "cpu", "cycle_mode": "split",
+                          "match_interval_seconds": 0.1,
+                          "rank_interval_seconds": 0.1},
+        }
+
+    def wait_for(pred, timeout=20.0):
+        import time as _t
+        deadline = _t.time() + timeout
+        while _t.time() < deadline:
+            if pred():
+                return True
+            _t.sleep(0.05)
+        return bool(pred())
+
+    a = CookDaemon(conf("a"))
+    b = None
+    try:
+        a.start()
+        assert wait_for(lambda: a.scheduler is not None), \
+            "node A never took leadership"
+        b = CookDaemon(conf("b"))
+        b.start()
+        # the standby mirrors and publishes its candidate position into
+        # the election medium (the ranking inputs of a future failover)
+        assert wait_for(lambda: a.repl_server is not None
+                        and a.repl_server.synced_follower_count >= 1)
+        assert wait_for(lambda: any(
+            pos.get("synced")
+            for nid, pos in a.elector.read_candidates().items()
+            if nid != a._node_id)), "standby never published synced"
+        client_a = JobClient(a.node_url, user="alice")
+        uuids = client_a.submit([{"command": "sleep 999", "cpus": 1,
+                                  "mem": 64} for _ in range(3)])
+        panel = client_a.debug_replication()
+        assert panel["role"] == "leader" and panel["epoch"] == 1
+        assert panel["synced_followers"] >= 1
+        # ---- handoff: A dies; B must promote with every job ----------
+        a.shutdown()
+        assert wait_for(lambda: b.scheduler is not None, timeout=30), \
+            "standby never promoted"
+        client_b = JobClient(b.node_url, user="alice")
+        got = {j["uuid"] for j in client_b.query(uuids)}
+        assert got == set(uuids), "committed jobs lost in failover"
+        panel = client_b.debug_replication()
+        assert panel["role"] == "leader" and panel["epoch"] == 2
+        # the promoted store fences against the SHARED election epoch
+        assert str(b.store._epoch_path) == str(a.elector.epoch_path)
+    finally:
+        if b is not None:
+            b.shutdown()
+        a.shutdown()

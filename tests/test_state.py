@@ -507,3 +507,51 @@ class TestDurableStore:
         # silently diverging from what a replay would reconstruct
         with pytest.raises(RuntimeError, match="poisoned"):
             store.create_jobs([make_job()])
+
+
+class TestPeekContract:
+    """peek()/peek_instances_of return LIVE store entities guarded by a
+    __debug__-mode fingerprint spot-check (ADVICE r5): a guard that
+    mutates what it peeked fails the transaction loudly instead of
+    silently corrupting committed state outside the undo log."""
+
+    def test_mutating_a_peeked_entity_fails_the_txn(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+
+        def rogue_guard(txn):
+            job = txn.peek("jobs", uuid)
+            job.priority = 99  # violates the read-only promise
+
+        with pytest.raises(AssertionError, match="peeked entity"):
+            store.transact(rogue_guard)
+        # the store entity itself keeps the rogue write (peek is
+        # no-clone by design); the assertion exists to catch the bug in
+        # tests before it ships, not to roll it back
+        assert store.job(uuid) is not None
+
+    def test_peek_then_write_accessor_is_legal(self):
+        store = Store()
+        [uuid] = store.create_jobs([make_job()])
+
+        def guard_then_write(txn):
+            peeked = txn.peek("jobs", uuid)
+            assert peeked.priority == 50
+            job = txn.job_w(uuid)  # the sanctioned mutation path
+            job.priority = 75
+
+        store.transact(guard_then_write)
+        assert store.job(uuid).priority == 75
+
+    def test_peek_of_own_write_is_not_fingerprinted(self):
+        store = Store()
+
+        def create_and_mutate(txn):
+            job = make_job()
+            txn.put("jobs", job.uuid, job)
+            peeked = txn.peek("jobs", job.uuid)  # resolves to OUR write
+            peeked.priority = 60  # legal: txn-local entity
+            return job.uuid
+
+        uuid = store.transact(create_and_mutate)
+        assert store.job(uuid).priority == 60
