@@ -613,16 +613,18 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     hosts = [FakeHost(f"h{i}", Resources(cpus=64.0, mem=65536.0))
              for i in range(H)]
     cluster = FakeCluster("fake-1", hosts)
+    # SYNC driver pinned (pipeline.depth=0): this section is the
+    # cross-round sync-production baseline (r1-r5 numbers predate the
+    # pipelined driver; Config() now defaults depth=2, which would
+    # silently change what this section measures).  The pipelined
+    # production path is the pipeline_driver section's job.
+    cfg = Config()
+    cfg.pipeline.depth = 0
     # status updates ride the hash-sharded in-order queue, off the cycle
     # thread (the reference's 19 sharded agents, scheduler.clj:2370-2396)
-    sched = Scheduler(store, Config(), [cluster], rank_backend="tpu",
+    sched = Scheduler(store, cfg, [cluster], rank_backend="tpu",
                       status_queue_shards=4)
-    jobs = [Job(uuid=new_uuid(), user=f"user{i % n_users:04d}", command="x",
-                priority=int(rng.integers(0, 100)),
-                submit_time_ms=int(rng.integers(0, 10**6)),
-                resources=Resources(cpus=float(rng.integers(1, 8)),
-                                    mem=float(rng.integers(64, 2048))))
-            for i in range(n_jobs)]
+    jobs = _driver_jobs(rng, n_jobs, n_users)
     for i in range(0, n_jobs, 10_000):
         store.create_jobs(jobs[i:i + 10_000])
     store.ensure_index()
@@ -634,12 +636,7 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
         # keep the pending queue at scale so every timed rep schedules a
         # real cycle (at tiny BENCH_SCALE the warm-up could otherwise
         # drain the queue and the reps would time empty no-op cycles)
-        fresh = [Job(uuid=new_uuid(), user=f"user{i % n_users:04d}",
-                     command="x", priority=int(rng.integers(0, 100)),
-                     submit_time_ms=int(rng.integers(0, 10**6)),
-                     resources=Resources(cpus=float(rng.integers(1, 8)),
-                                         mem=float(rng.integers(64, 2048))))
-                for i in range(n)]
+        fresh = _driver_jobs(rng, n, n_users)
         for i in range(0, n, 10_000):
             store.create_jobs(fresh[i:i + 10_000])
 
@@ -827,6 +824,124 @@ def bench_pipeline(T=100_000, n_users=200, H=5000, depth=10):
     print(f"pipeline[{T//1000}k x {H//1000}k, depth={depth}] "
           f"synced_p50={out['synced_per_cycle_p50_ms']}ms "
           f"pipelined_p50={out['pipelined_amortized_p50_ms']}ms",
+          file=sys.stderr)
+    return out
+
+
+def _driver_jobs(rng, n, n_users):
+    """Shared job factory for the driver_cycle / pipeline_driver sections:
+    ONE workload shape so the sync-vs-pipelined comparison compares
+    drivers, not distributions."""
+    from cook_tpu.state import Job, Resources, new_uuid
+    return [Job(uuid=new_uuid(), user=f"user{i % n_users:04d}", command="x",
+                priority=int(rng.integers(0, 100)),
+                submit_time_ms=int(rng.integers(0, 10**6)),
+                resources=Resources(cpus=float(rng.integers(1, 8)),
+                                    mem=float(rng.integers(64, 2048))))
+            for i in range(n)]
+
+
+def bench_pipeline_driver(n_jobs=100_000, n_users=200, H=5000, reps=8):
+    """The PRODUCTION pipelined control loop (sched/pipeline.py) next to
+    the sync driver, both end-to-end through Store + columnar index +
+    Scheduler.step_cycle + transactional launch against a fake backend:
+
+    - sync leg: pipeline_depth=0, the strictly-synchronous
+      FusedCycleDriver (every cycle pays the full dispatch->fetch sync);
+    - pipelined leg: pipeline_depth=2 with boot warmup + the amortized
+      per-step wall time (cycle k+1 computes while cycle k launches),
+      plus the reconciliation conflict counts and the steady-state
+      recompile count (0 expected after warmup).
+
+    Runs inside the standard per-section subprocess (timeout, CPU
+    fallback, partial-results emit after every section) so a wedged
+    tunnel costs this section, not the round's artifact.
+    """
+    from cook_tpu.cluster import FakeCluster, FakeHost
+    from cook_tpu.config import Config
+    from cook_tpu.sched import Scheduler
+    from cook_tpu.state import Job, Resources, Store, new_uuid
+    from cook_tpu.utils.flight import recorder as _flight
+
+    rng = np.random.default_rng(13)
+
+    def make_jobs(n):
+        return _driver_jobs(rng, n, n_users)
+
+    def run_leg(depth):
+        cfg = Config()
+        cfg.pipeline.depth = depth
+        if depth > 0:
+            # boot warmup at this leg's design point (the satellite
+            # acceptance: steady-state recompiles must be 0 after it)
+            cfg.pipeline.warmup_tasks = n_jobs
+            cfg.pipeline.warmup_hosts = H
+            cfg.pipeline.warmup_users = n_users
+        store = Store()
+        hosts = [FakeHost(f"h{i}", Resources(cpus=64.0, mem=65536.0))
+                 for i in range(H)]
+        cluster = FakeCluster(f"fake-d{depth}", hosts)
+        t0 = time.perf_counter()
+        sched = Scheduler(store, cfg, [cluster], rank_backend="tpu",
+                          status_queue_shards=4)
+        warmup_ms = (time.perf_counter() - t0) * 1000.0
+        jobs = make_jobs(n_jobs)
+        for i in range(0, n_jobs, 10_000):
+            store.create_jobs(jobs[i:i + 10_000])
+        store.ensure_index()
+        results = sched.step_cycle()  # cache-warm / pipeline-fill
+        launched = warm = sum(len(r.launched_task_ids)
+                              for r in results.values())
+        sched.flush_status_updates()
+        # one settle cycle (first full GC of the fresh heap, allocator
+        # growth) before the steady-state window opens
+        for i in range(0, warm, 10_000):
+            store.create_jobs(make_jobs(min(10_000, warm - i)))
+        results = sched.step_cycle()
+        warm = sum(len(r.launched_task_ids) for r in results.values())
+        launched += warm
+        sched.flush_status_updates()
+        seq0 = _flight.last_seq()
+        samples = []
+        for _ in range(reps):
+            for i in range(0, warm, 10_000):
+                store.create_jobs(make_jobs(min(10_000, warm - i)))
+            t0 = time.perf_counter()
+            results = sched.step_cycle()
+            samples.append((time.perf_counter() - t0) * 1000.0)
+            warm = sum(len(r.launched_task_ids) for r in results.values())
+            launched += warm
+            sched.flush_status_updates()
+        flight = _flight.summary(since_seq=seq0)
+        leg = {
+            "p50_ms": round(pctl(samples, 50), 1),
+            "p99_ms": round(pctl(samples, 99), 1),
+            "launched": launched,
+            "steady_recompiles": sum(flight.get("recompiles", {}).values()),
+            "steady_sync_wait_ms": flight.get("sync_wait_ms", 0.0),
+        }
+        if depth > 0:
+            drv = sched._pipeline
+            conflicts = (drv.conflicts_state + drv.conflicts_resources
+                         if drv is not None else 0)
+            leg.update({
+                "depth": depth,
+                "warmup_ms": round(warmup_ms, 1),
+                "conflicts": conflicts,
+                "conflict_rate": round(conflicts / max(launched, 1), 5),
+            })
+        sched.shutdown()
+        return leg
+
+    sync = run_leg(0)
+    piped = run_leg(2)
+    out = {"sync": sync, "pipelined": piped,
+           "speedup_p50": round(sync["p50_ms"]
+                                / max(piped["p50_ms"], 1e-9), 2)}
+    print(f"pipeline_driver[{n_jobs//1000}k jobs x {H//1000}k hosts] "
+          f"sync_p50={sync['p50_ms']}ms pipelined_p50={piped['p50_ms']}ms "
+          f"p99={piped['p99_ms']}ms conflicts={piped.get('conflicts')} "
+          f"steady_recompiles={piped['steady_recompiles']}",
           file=sys.stderr)
     return out
 
@@ -1049,6 +1164,10 @@ def run_section(name: str) -> None:
         data = bench_driver_cycle(n_jobs=scaled(100_000),
                                   n_users=scaled(200, lo=8),
                                   H=scaled(5000))
+    elif name == "pipeline_driver":
+        data = bench_pipeline_driver(n_jobs=scaled(100_000),
+                                     n_users=scaled(200, lo=8),
+                                     H=scaled(5000))
     elif name == "placement_quality":
         data = bench_placement_quality()
     elif name == "pipeline":
@@ -1175,6 +1294,8 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["store_scale_1M_jobs"] = results["store_scale"]
     if results.get("driver_cycle") is not None:
         detail["driver_cycle_100k_jobs"] = results["driver_cycle"]
+    if results.get("pipeline_driver") is not None:
+        detail["pipeline_driver_100k_jobs"] = results["pipeline_driver"]
     if results.get("pipeline") is not None:
         detail["pipeline_10cycle"] = results["pipeline"]
     if results.get("placement_quality") is not None:
@@ -1268,9 +1389,10 @@ def main():
     os.environ.pop("BENCH_MIDRUN_FALLBACK", None)
 
     capture, capture_src = _load_prior_capture()
-    sections = ["sync_floor", "rank", "match", "driver_cycle", "fused_cycle",
-                "store_cycle", "store_scale", "match_large", "rebalance",
-                "end2end", "pallas_scale", "pipeline", "placement_quality"]
+    sections = ["sync_floor", "rank", "match", "driver_cycle",
+                "pipeline_driver", "fused_cycle", "store_cycle",
+                "store_scale", "match_large", "rebalance", "end2end",
+                "pallas_scale", "pipeline", "placement_quality"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

@@ -216,6 +216,74 @@ class ReplicationConfig:
 
 
 @dataclass
+class PipelineConfig:
+    """Pipelined fused-cycle driver + compile-warmup knobs (the daemon's
+    ``"pipeline"`` conf section; sched/pipeline.py, docs/PERFORMANCE.md).
+    Parsed through :meth:`from_conf` so a typo'd knob fails the BOOT like
+    ReplicationConfig — a silently-defaulted depth would let an operator
+    believe the sync path is pinned when it isn't (or vice versa)."""
+
+    #: cycles in flight concurrently.  0 = strictly synchronous
+    #: FusedCycleDriver (today's pre-pipeline behavior, bit-for-bit);
+    #: 2 = the production default: while cycle k's launches are applied
+    #: on host, cycle k+1's fused kernel is already computing on device
+    #: against the pre-apply snapshot (Omega-style optimistic cycles,
+    #: reconciled host-side before launch).  >2 is allowed but adds
+    #: speculation: intermediate unfetched cycles' candidates can't be
+    #: masked out of later stages, so the conflict-drop rate rises.
+    depth: int = 2
+    #: JAX persistent compilation cache directory ("" = disabled): fused
+    #: cycle executables survive process restarts, so a failover or
+    #: rolling restart re-traces but never re-COMPILES (the 16.5 s
+    #: first-call spikes in BENCH_r05 land at boot, inside warmup, or
+    #: not at all — never inside a live cycle).
+    compilation_cache_dir: str = ""
+    #: boot-time warmup sweep: pre-compile (and execute once, with
+    #: zeroed inputs) the compact fused cycle at the bucket grid implied
+    #: by these design points.  0 disables warmup.  ``warmup_tasks`` /
+    #: ``warmup_hosts`` are the expected steady-state maxima (padded up
+    #: to their power-of-two buckets, ops/padding.py); ``warmup_users``
+    #: sizes the per-user table bucket (minimum 8).
+    warmup_tasks: int = 0
+    warmup_hosts: int = 0
+    warmup_users: int = 8
+    #: True = warm EVERY bucket up to the targets (cold-start ramp
+    #: traffic hits warm executables at every scale); False = only the
+    #: target buckets.
+    warmup_sweep: bool = False
+    #: also warm the gpu DRU-mode variant of the cycle (pools with
+    #: dru_mode=gpu compile a separate kernel)
+    warmup_gpu: bool = False
+
+    def __post_init__(self):
+        if not isinstance(self.depth, int) or self.depth < 0:
+            raise ValueError(
+                f"pipeline depth must be an int >= 0, got {self.depth!r}")
+        for k in ("warmup_tasks", "warmup_hosts", "warmup_users"):
+            v = getattr(self, k)
+            if not isinstance(v, int) or v < 0:
+                raise ValueError(f"pipeline {k} must be an int >= 0, "
+                                 f"got {v!r}")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "PipelineConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown pipeline key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"pipeline key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -286,6 +354,10 @@ class Config:
         default_factory=FaultInjectionConfig)
     circuit_breaker: CircuitBreakerConfig = field(
         default_factory=CircuitBreakerConfig)
+    # pipelined fused-cycle driver + compile-cache warmup
+    # (sched/pipeline.py, docs/PERFORMANCE.md); depth=0 pins the
+    # strictly-synchronous driver
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

@@ -115,6 +115,13 @@ def build_scheduler_config(spec: Dict) -> Config:
             if not hasattr(cfg.circuit_breaker, k):
                 raise ValueError(f"unknown circuit_breaker key {k!r}")
             setattr(cfg.circuit_breaker, k, v)
+    if "pipeline" in spec:
+        # pipelined fused cycles + compile-cache warmup
+        # (docs/PERFORMANCE.md): a typo'd knob fails the BOOT — a
+        # silently-defaulted depth would run a driver the operator
+        # didn't choose
+        from .config import PipelineConfig
+        cfg.pipeline = PipelineConfig.from_conf(spec["pipeline"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

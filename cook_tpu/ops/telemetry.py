@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -106,6 +107,53 @@ def sync_wait(kind: str = "fetch"):
         dt = time.perf_counter() - t0
         registry.observe("cook_sync_wait_seconds", dt, {"kind": kind})
         recorder.note_sync_wait(dt)
+
+
+def profile_upload(stage_ms: float, inp) -> None:
+    """COOK_PROFILE_UPLOAD=1 debug probe for the dispatch path: block
+    until the staged inputs land on device and print stage/upload times.
+    Lives here so the hot loop in sched/fused.py carries one call, not a
+    conditional-import block."""
+    if not os.environ.get("COOK_PROFILE_UPLOAD"):
+        return
+    import jax
+    t0 = time.perf_counter()
+    jax.block_until_ready(list(inp))
+    nbytes = sum(getattr(a, "nbytes", 0) for a in inp)
+    print(f"[profile] stage={stage_ms}ms upload="
+          f"{(time.perf_counter() - t0) * 1e3:.0f}ms "
+          f"({nbytes / 1e6:.1f}MB)", file=sys.stderr)
+
+
+def enable_compilation_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) with no minimum-compile-time floor, so fused-cycle
+    executables survive process restarts: a failover or rolling restart
+    re-traces but never re-compiles.  Returns True when the cache is
+    active; False (never raises) when this jax build lacks the knobs —
+    the scheduler must still boot on such builds, just without the
+    cache."""
+    if not path:
+        return False
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        try:
+            # compile-once-per-fleet beats the write-amplification guard
+            # for a scheduler whose kernel set is small and stable
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception:
+            pass  # older knob name / absent: dir alone still caches
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            pass
+    except Exception:
+        return False
+    return True
 
 
 _monitoring_installed = False

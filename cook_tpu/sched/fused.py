@@ -102,6 +102,51 @@ class _PackedPool:
         self.n_hosts = 0
 
 
+class _StagedCycle:
+    """Phase-1 (stage) output: one cycle's packed pools, grouped by DRU
+    mode and ready for dispatch."""
+
+    __slots__ = ("pools", "groups")
+
+    def __init__(self, pools: List[Pool]):
+        self.pools = pools
+        self.groups: List["_StagedGroup"] = []
+
+
+class _StagedGroup:
+    """One DRU-mode group's staged kernel inputs (host arrays already
+    stacked/padded; uploaded by dispatch_group)."""
+
+    __slots__ = ("gpu_mode", "group", "inp", "structured", "cap", "T", "H",
+                 "stage_ms")
+
+    def __init__(self, *, gpu_mode, group, inp, structured, cap, T, H,
+                 stage_ms):
+        self.gpu_mode = gpu_mode
+        self.group = group
+        self.inp = inp
+        self.structured = structured
+        self.cap = cap
+        self.T = T
+        self.H = H
+        self.stage_ms = stage_ms
+
+
+class _GroupDispatch:
+    """An in-flight device dispatch of one staged group: the kernel result
+    refs plus the compact-output refs whose async device->host copies are
+    already rolling.  ``fetched`` holds the host arrays after
+    fetch_group."""
+
+    __slots__ = ("sg", "res", "outs", "fetched")
+
+    def __init__(self, sg: _StagedGroup, res, outs):
+        self.sg = sg
+        self.res = res
+        self.outs = outs
+        self.fetched = None
+
+
 class FusedCycleDriver:
     def __init__(self, store: Store, config: Config, matcher: Matcher,
                  plugins, rate_limits, mesh=None):
@@ -146,6 +191,93 @@ class FusedCycleDriver:
                 compact=compact))
             self._cycles[key] = fn
         return fn
+
+    # --------------------------------------------------------------- warmup
+    def warmup(self, *, tasks: int, hosts: int, users: int = 8,
+               sweep: bool = False, gpu: bool = False) -> int:
+        """Boot-time cold-start killer (config.PipelineConfig): compile
+        AND execute once, with zeroed inputs, the compact fused cycle at
+        the bucket grid the configured design point implies, so the
+        16.5 s first-call compile spikes (BENCH_r05) land at boot — inside
+        the leader's takeover window — and never inside a live cycle.
+        Executing (not just AOT-lowering) populates the jit call cache,
+        so steady-state cycles at warmed shapes trace zero times; with
+        the persistent compilation cache enabled the XLA compile itself
+        is also disk-cached across restarts.
+
+        ``sweep=True`` warms every (T, H) bucket up to the targets (ramp
+        traffic hits warm executables at every scale), else just the
+        target buckets.  Returns the number of warmup executions."""
+        if tasks <= 0 or hosts <= 0:
+            return 0
+        if not self.config.columnar_index:
+            # warmup covers the production compact/columnar wire form
+            # only; silently "warming" the wrong kernel variant would
+            # spend boot time and still compile inside the first live
+            # cycle (docs/PERFORMANCE.md)
+            import logging
+            logging.getLogger(__name__).warning(
+                "fused-cycle warmup skipped: columnar_index=False packs "
+                "the dense PoolCycleInputs variant, which warmup does "
+                "not cover")
+            return 0
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.sharded import CompactPoolCycleInputs
+
+        def grid(n: int, minimum: int = 64) -> List[int]:
+            top = bucket(n, minimum=minimum)
+            if not sweep:
+                return [top]
+            out, b = [], minimum
+            while b <= top:
+                out.append(b)
+                b *= 2
+            return out
+
+        P = self.mesh().size
+        U = bucket(max(users, 1), minimum=8)
+        E = 8  # exception bucket floor: no complex jobs in the zero world
+        # the dispatch cap is bucket(max matcher cap over the group's
+        # pools); pool_matchers overrides can bucket differently from the
+        # default, so warm every DISTINCT cap bucket
+        caps = {bucket(self.config.default_matcher.max_jobs_considered)}
+        caps.update(bucket(mc.max_jobs_considered)
+                    for _rx, mc in self.config.pool_matchers)
+        f32, i32 = jnp.float32, jnp.int32
+        runs = 0
+        for gm in ((False, True) if gpu else (False,)):
+            for T in grid(tasks):
+                # the device base mirror's capacity bucket tracks the
+                # index row count (~T at one pool per index row)
+                mir = bucket(T, minimum=1024)
+                res_base = jnp.zeros((mir, 4), dtype=f32)
+                disk_base = jnp.zeros(mir, dtype=f32)
+                for H in grid(hosts):
+                    inp = CompactPoolCycleInputs(
+                        rows=jnp.zeros((P, T), dtype=i32),
+                        flags=jnp.zeros((P, T), dtype=jnp.uint8),
+                        res_base=res_base,
+                        disk_base=disk_base,
+                        tokens_u=jnp.full((P, U), jnp.inf, dtype=f32),
+                        shares_u=jnp.full((P, U, 3), jnp.inf, dtype=f32),
+                        quota_u=jnp.full((P, U, 4), jnp.inf, dtype=f32),
+                        num_considerable=jnp.zeros((P,), dtype=i32),
+                        pool_quota=jnp.full((P, 4), jnp.inf, dtype=f32),
+                        group_quota=jnp.full((P, 4), jnp.inf, dtype=f32),
+                        group_id=jnp.full((P,), -1, dtype=i32),
+                        host_gpu=jnp.zeros((P, H), dtype=bool),
+                        host_blocked=jnp.ones((P, H), dtype=bool),
+                        exc_rows=jnp.full((P, E), -1, dtype=i32),
+                        exc_mask=jnp.zeros((P, E, H), dtype=bool),
+                        avail=jnp.zeros((P, H, 4), dtype=f32),
+                        capacity=jnp.zeros((P, H, 4), dtype=f32))
+                    for cap in sorted({min(c, T) for c in caps}):
+                        fn = self._cycle_fn(gm, cap, True, compact=True)
+                        jax.block_until_ready(fn(inp).n_queue)
+                        runs += 1
+        return runs
 
     # ---------------------------------------------------------- base mirror
     def _append(self, base, chunk, off):
@@ -199,8 +331,8 @@ class FusedCycleDriver:
         return self._mir_res, self._mir_disk
 
     # ------------------------------------------------------------------ pack
-    def _pack_pool_columnar(self, scheduler,
-                            pool: Pool) -> Optional[_PackedPool]:
+    def _pack_pool_columnar(self, scheduler, pool: Pool, exclude=None,
+                            token_delta=None) -> Optional[_PackedPool]:
         """Pack one pool's cycle inputs straight off the columnar index
         (state/index.py): no entity materialization for the plain-job
         majority — entities are fetched only for rows the vectorized path
@@ -380,6 +512,20 @@ class FusedCycleDriver:
             filtered = int((~launch_ok).sum())
             if filtered:
                 _flight.note_skips({"launch-filtered": filtered})
+        # pipelined-driver speculation mask (sched/pipeline.py): rows the
+        # in-flight overlapped cycle is about to launch are withheld from
+        # THIS cycle's launch candidates (they'd conflict at reconcile).
+        # Row ids are only valid within one index compaction epoch; on a
+        # mismatch the mask is skipped and reconciliation catches the
+        # conflicts instead (rare: compaction between two packs).
+        if exclude is not None:
+            kind, epoch, rows = exclude
+            if kind == "rows" and epoch == snap.compactions and len(rows):
+                masked = pend & np.isin(rows_s, rows)
+                if masked.any():
+                    launch_ok = launch_ok & ~masked
+                    _flight.note_skips(
+                        {"pipeline-speculative": int(masked.sum())})
         pp.launch_ok = launch_ok
 
         # launch-rate token budgets per USER (device gathers via user_rank)
@@ -389,6 +535,12 @@ class FusedCycleDriver:
             pp.tokens_u = np.array(
                 [launch_rl.get_token_count(pool_user_key(pool.name, u))
                  for u in users], dtype=F32)
+            if token_delta:
+                # tokens an overlapped in-flight cycle will spend at its
+                # apply (the limiter hasn't seen the spends yet)
+                pp.tokens_u = np.maximum(pp.tokens_u - np.array(
+                    [token_delta.get(u, 0.0) for u in users], dtype=F32),
+                    0.0)
         else:
             pp.tokens_u = np.full(max(len(users), 1), INF, dtype=F32)
 
@@ -428,10 +580,13 @@ class FusedCycleDriver:
         if gq is not None:
             pp.group_quota = _pool_quota_vec(gq)
 
-    def _pack_pool(self, scheduler, pool: Pool) -> Optional[_PackedPool]:
+    def _pack_pool(self, scheduler, pool: Pool, exclude=None,
+                   token_delta=None) -> Optional[_PackedPool]:
         store, cfg = self.store, self.config
         if cfg.columnar_index:
-            return self._pack_pool_columnar(scheduler, pool)
+            return self._pack_pool_columnar(scheduler, pool,
+                                            exclude=exclude,
+                                            token_delta=token_delta)
         pending = store.pending_jobs(pool.name)
         pp = _PackedPool(pool)
         if not pending:
@@ -509,6 +664,17 @@ class FusedCycleDriver:
         for i, j in enumerate(jobs_in_rows):
             if pend_rows[i] and not self.plugins.launch_allowed(j):
                 launch_ok[i] = False
+        # pipelined-driver speculation mask (entity-pack form: by uuid)
+        if exclude is not None:
+            kind, _epoch, uuids = exclude
+            if kind == "uuids" and uuids:
+                masked = 0
+                for i, j in enumerate(jobs_in_rows):
+                    if pend_rows[i] and launch_ok[i] and j.uuid in uuids:
+                        launch_ok[i] = False
+                        masked += 1
+                if masked:
+                    _flight.note_skips({"pipeline-speculative": masked})
         pp.launch_ok = launch_ok
 
         # launch-rate token budgets, per user broadcast to tasks
@@ -518,6 +684,11 @@ class FusedCycleDriver:
             user_tokens = {
                 ut.user: launch_rl.get_token_count(
                     pool_user_key(pool.name, ut.user)) for ut in uts}
+            if token_delta:
+                # overlapped in-flight spends not yet on the limiter
+                user_tokens = {
+                    u: max(t - token_delta.get(u, 0.0), 0.0)
+                    for u, t in user_tokens.items()}
             tok = np.array([user_tokens[pp.id2job[t].user]
                             for t in task_ids], dtype=F32)
         else:
@@ -528,24 +699,48 @@ class FusedCycleDriver:
         return pp
 
     # ------------------------------------------------------------------ step
-    def step(self, scheduler) -> Tuple[Dict[str, List[Job]],
-                                       Dict[str, MatchCycleResult]]:
-        """One fused cycle over all active non-direct pools.  Returns
-        (pending queues, match results); direct pools are handled by the
-        scheduler separately."""
+    def stage(self, scheduler, exclude=None, avail_delta=None,
+              token_delta=None) -> "_StagedCycle":
+        """Phase 1 of a cycle: host-side staging.  Packs every active
+        non-direct pool off the store and builds the per-DRU-mode dispatch
+        groups (padded + stacked, ready for :meth:`dispatch_group`).
+
+        The two optional arguments are the pipelined driver's optimistic-
+        concurrency hooks (sched/pipeline.py, Omega-style):
+
+        - ``exclude``: pool name -> ("rows"|"uuids", epoch, ids) — launch
+          candidates a fetched-but-not-yet-applied overlapped cycle is
+          about to consume; they are withheld from this cycle's
+          launch_ok so back-to-back cycles don't fight over the head of
+          the queue.
+        - ``avail_delta``: (cluster, hostname) -> f32[4] — the resources
+          those candidates will consume, subtracted from the staged offer
+          availability so this cycle's speculative placements stay
+          feasible even though the store doesn't show the launches yet.
+        - ``token_delta``: pool name -> user -> launch-rate tokens those
+          candidates will spend, subtracted from the staged per-user
+          token budgets (the rate limiter's spend() lands only at apply,
+          after this cycle staged — without the delta a user would get
+          depth-x the configured per-cycle launch rate).
+
+        All are None on the sync path, which stays bit-for-bit today's
+        behavior."""
         from ..utils.faults import injector as _faults
         _faults.fire("fused.dispatch")
-        import jax.numpy as jnp
 
         pools = [p for p in self.store.pools()
                  if p.state == "active" and p.scheduler is not SchedulerKind.DIRECT]
         packed: List[_PackedPool] = []
+        excl = exclude or {}
+        tokd = token_delta or {}
         # "cycle.rank" is the canonical rank-phase span on the cycle trace
         # (flight.PHASE_BY_SPAN): host-side rank staging — the columnar
         # pack that feeds the device the rank+match problem
         with tracing.span("cycle.rank"), tracing.span("fused.pack"):
             for pool in pools:
-                pp = self._pack_pool(scheduler, pool)
+                pp = self._pack_pool(scheduler, pool,
+                                     exclude=excl.get(pool.name),
+                                     token_delta=tokd.get(pool.name))
                 if pp is not None:
                     packed.append(pp)
             # compact packs must share ONE index compaction epoch: the
@@ -563,247 +758,292 @@ class FusedCycleDriver:
                         # are pre-compaction row ids.  A re-pack returning
                         # None (pool's pending drained by the same churn)
                         # just drops the pool from this cycle.
-                        pp = self._pack_pool(scheduler, pp.pool)
+                        pp = self._pack_pool(
+                            scheduler, pp.pool,
+                            exclude=excl.get(pp.pool.name),
+                            token_delta=tokd.get(pp.pool.name))
                         if pp is None or (pp.compact and
                                           pp.base_compactions != latest):
                             continue
                     refreshed.append(pp)
                 packed = refreshed
-        queues: Dict[str, List[Job]] = {p.name: [] for p in pools}
-        results: Dict[str, MatchCycleResult] = {}
+        if avail_delta:
+            for pp in packed:
+                for h, o in enumerate(pp.offers):
+                    d = avail_delta.get((o.cluster, o.hostname))
+                    if d is not None:
+                        pp.avail[h] = np.maximum(pp.avail[h] - d, 0.0)
+        staged = _StagedCycle(pools)
         if not packed:
-            return queues, results
+            return staged
 
         # group pools by DRU mode (kernel static)
         by_mode: Dict[bool, List[_PackedPool]] = {}
         for pp in packed:
             by_mode.setdefault(pp.pool.dru_mode is DruMode.GPU, []).append(pp)
-
         for gpu_mode, group in by_mode.items():
-            # Quota-group ids are per dispatch; member pools NOT in this
-            # dispatch (no pending jobs, different dru-mode, or direct) still
-            # consume the group's cap, so their running usage is folded into
-            # the cap host-side (the on-device all_gather covers in-dispatch
-            # members; reference semantics: scheduler.clj:2125-2157 counts
-            # every member pool's running usage).
-            gids: Dict[str, int] = {}
-            in_dispatch = {pp.pool.name for pp in group}
-            missing_by_group: Dict[str, np.ndarray] = {}
+            staged.groups.append(self._stage_group(gpu_mode, group))
+        return staged
 
-            def missing_usage(gname: str) -> np.ndarray:
-                m = missing_by_group.get(gname)
-                if m is None:
-                    m = np.zeros(4, dtype=F32)
-                    idx = (self.store.ensure_index()
-                           if self.config.columnar_index else None)
-                    for member, g in self.config.quota_groups.items():
-                        if g != gname or member in in_dispatch:
-                            continue
-                        if idx is not None:
-                            m += idx.pool_usage_base(member)
-                            continue
-                        for job, _i in self.store.running_instances(member):
-                            m += [job.resources.cpus, job.resources.mem,
-                                  job.resources.gpus, 1.0]
-                    missing_by_group[gname] = m
-                return m
+    def _stage_group(self, gpu_mode: bool,
+                     group: List[_PackedPool]) -> "_StagedGroup":
+        """Fold quota-group caps and build one DRU-mode group's padded,
+        stacked kernel inputs (the wire form :meth:`dispatch_group`
+        uploads)."""
+        import jax.numpy as jnp
 
-            for pp in group:
-                gname = self.config.quota_groups.get(pp.pool.name)
-                if not gname:
-                    continue
-                pp.group_id = gids.setdefault(gname, len(gids))
-                pp.group_quota = (pp.group_quota
-                                  - missing_usage(gname)).astype(F32)
-            n_dev = self.mesh().size
-            T = bucket(max(pp.n_tasks for pp in group))
-            H = bucket(max(max(pp.n_hosts, 1) for pp in group))
-            P = max(n_dev, ((len(group) + n_dev - 1) // n_dev) * n_dev)
+        # Quota-group ids are per dispatch; member pools NOT in this
+        # dispatch (no pending jobs, different dru-mode, or direct) still
+        # consume the group's cap, so their running usage is folded into
+        # the cap host-side (the on-device all_gather covers in-dispatch
+        # members; reference semantics: scheduler.clj:2125-2157 counts
+        # every member pool's running usage).
+        gids: Dict[str, int] = {}
+        in_dispatch = {pp.pool.name for pp in group}
+        missing_by_group: Dict[str, np.ndarray] = {}
 
-            def stack(fn, fill=0, dtype=None):
-                rows = [fn(pp) for pp in group]
-                rows += [np.full_like(rows[0], fill)] * (P - len(group))
-                out = np.stack(rows)
-                return out if dtype is None else out.astype(dtype)
+        def missing_usage(gname: str) -> np.ndarray:
+            m = missing_by_group.get(gname)
+            if m is None:
+                m = np.zeros(4, dtype=F32)
+                idx = (self.store.ensure_index()
+                       if self.config.columnar_index else None)
+                for member, g in self.config.quota_groups.items():
+                    if g != gname or member in in_dispatch:
+                        continue
+                    if idx is not None:
+                        m += idx.pool_usage_base(member)
+                        continue
+                    for job, _i in self.store.running_instances(member):
+                        m += [job.resources.cpus, job.resources.mem,
+                              job.resources.gpus, 1.0]
+                missing_by_group[gname] = m
+            return m
 
-            def padT(a, fill=0):
-                return pad_to(a, T, fill=fill)
+        for pp in group:
+            gname = self.config.quota_groups.get(pp.pool.name)
+            if not gname:
+                continue
+            pp.group_id = gids.setdefault(gname, len(gids))
+            pp.group_quota = (pp.group_quota
+                              - missing_usage(gname)).astype(F32)
+        n_dev = self.mesh().size
+        T = bucket(max(pp.n_tasks for pp in group))
+        H = bucket(max(max(pp.n_hosts, 1) for pp in group))
+        P = max(n_dev, ((len(group) + n_dev - 1) // n_dev) * n_dev)
 
-            from ..parallel.sharded import (
-                CompactPoolCycleInputs,
-                PoolCycleInputs,
-            )
-            arr = lambda k, fill: stack(lambda pp: padT(pp.arrays[k], fill))
-            structured = group[0].columnar
-            stage_t0 = time.perf_counter()
-            avail_p = np.zeros((P, H, 4), dtype=F32)
-            cap_p = np.zeros((P, H, 4), dtype=F32)
+        def stack(fn, fill=0, dtype=None):
+            rows = [fn(pp) for pp in group]
+            rows += [np.full_like(rows[0], fill)] * (P - len(group))
+            out = np.stack(rows)
+            return out if dtype is None else out.astype(dtype)
+
+        def padT(a, fill=0):
+            return pad_to(a, T, fill=fill)
+
+        from ..parallel.sharded import (
+            CompactPoolCycleInputs,
+            PoolCycleInputs,
+        )
+        arr = lambda k, fill: stack(lambda pp: padT(pp.arrays[k], fill))
+        structured = group[0].columnar
+        stage_t0 = time.perf_counter()
+        avail_p = np.zeros((P, H, 4), dtype=F32)
+        cap_p = np.zeros((P, H, 4), dtype=F32)
+        for i, pp in enumerate(group):
+            avail_p[i, :pp.avail.shape[0]] = pp.avail
+            cap_p[i, :pp.capacity.shape[0]] = pp.capacity
+        scalars = dict(
+            num_considerable=jnp.asarray(np.array(
+                [pp.num_considerable for pp in group]
+                + [0] * (P - len(group)), dtype=np.int32)),
+            pool_quota=jnp.asarray(np.stack(
+                [pp.pool_quota for pp in group]
+                + [np.full(4, INF, dtype=F32)] * (P - len(group)))),
+            group_quota=jnp.asarray(np.stack(
+                [pp.group_quota for pp in group]
+                + [np.full(4, INF, dtype=F32)] * (P - len(group)))),
+            group_id=jnp.asarray(np.array(
+                [pp.group_id for pp in group]
+                + [-1] * (P - len(group)), dtype=np.int32)))
+        if structured:
+            # COMPACT wire form: the per-task upload is the sorted row
+            # permutation + one flags byte (~5 B/task); resource
+            # columns live in the device-resident base mirror and
+            # everything else is derived on device (expand_compact).
+            # every pp in the group shares one compaction epoch (step
+            # re-packs or drops stale pools right after the pack loop),
+            # so the mirror's row indices are valid for all of them —
+            # assert rather than silently uploading mixed-epoch content
+            # under one mirror key
+            epoch = max(pp.base_compactions for pp in group)
+            assert all(pp.base_compactions == epoch for pp in group), \
+                [pp.base_compactions for pp in group]
+            base_pp = max(group, key=lambda pp: pp.res_base.shape[0])
+            mir_res, mir_disk = self._sync_base_mirror(
+                base_pp.res_base, base_pp.disk_base, epoch)
+            E = bucket(max(max(len(pp.exc_rows), pp.exc_mask.shape[0])
+                           for pp in group), minimum=8)
+            U = bucket(max(pp.shares_u.shape[0] for pp in group),
+                       minimum=8)
+            rows_p = np.zeros((P, T), dtype=np.int32)
+            exc_rows_p = np.full((P, E), -1, dtype=np.int32)
+            exc_mask_p = np.zeros((P, E, H), dtype=bool)
+            host_gpu_p = np.zeros((P, H), dtype=bool)
+            # padding hosts stay blocked so zero-resource jobs can
+            # never land on them (the dense path's zero rows did this)
+            host_blocked_p = np.ones((P, H), dtype=bool)
+            shares_u_p = np.full((P, U, 3), INF, dtype=F32)
+            quota_u_p = np.full((P, U, 4), INF, dtype=F32)
+            tokens_u_p = np.full((P, U), INF, dtype=F32)
             for i, pp in enumerate(group):
-                avail_p[i, :pp.avail.shape[0]] = pp.avail
-                cap_p[i, :pp.capacity.shape[0]] = pp.capacity
-            scalars = dict(
-                num_considerable=jnp.asarray(np.array(
-                    [pp.num_considerable for pp in group]
-                    + [0] * (P - len(group)), dtype=np.int32)),
-                pool_quota=jnp.asarray(np.stack(
-                    [pp.pool_quota for pp in group]
-                    + [np.full(4, INF, dtype=F32)] * (P - len(group)))),
-                group_quota=jnp.asarray(np.stack(
-                    [pp.group_quota for pp in group]
-                    + [np.full(4, INF, dtype=F32)] * (P - len(group)))),
-                group_id=jnp.asarray(np.array(
-                    [pp.group_id for pp in group]
-                    + [-1] * (P - len(group)), dtype=np.int32)))
-            if structured:
-                # COMPACT wire form: the per-task upload is the sorted row
-                # permutation + one flags byte (~5 B/task); resource
-                # columns live in the device-resident base mirror and
-                # everything else is derived on device (expand_compact).
-                # every pp in the group shares one compaction epoch (step
-                # re-packs or drops stale pools right after the pack loop),
-                # so the mirror's row indices are valid for all of them —
-                # assert rather than silently uploading mixed-epoch content
-                # under one mirror key
-                epoch = max(pp.base_compactions for pp in group)
-                assert all(pp.base_compactions == epoch for pp in group), \
-                    [pp.base_compactions for pp in group]
-                base_pp = max(group, key=lambda pp: pp.res_base.shape[0])
-                mir_res, mir_disk = self._sync_base_mirror(
-                    base_pp.res_base, base_pp.disk_base, epoch)
-                E = bucket(max(max(len(pp.exc_rows), pp.exc_mask.shape[0])
-                               for pp in group), minimum=8)
-                U = bucket(max(pp.shares_u.shape[0] for pp in group),
-                           minimum=8)
-                rows_p = np.zeros((P, T), dtype=np.int32)
-                exc_rows_p = np.full((P, E), -1, dtype=np.int32)
-                exc_mask_p = np.zeros((P, E, H), dtype=bool)
-                host_gpu_p = np.zeros((P, H), dtype=bool)
-                # padding hosts stay blocked so zero-resource jobs can
-                # never land on them (the dense path's zero rows did this)
-                host_blocked_p = np.ones((P, H), dtype=bool)
-                shares_u_p = np.full((P, U, 3), INF, dtype=F32)
-                quota_u_p = np.full((P, U, 4), INF, dtype=F32)
-                tokens_u_p = np.full((P, U), INF, dtype=F32)
-                for i, pp in enumerate(group):
-                    rows_p[i, :pp.n_tasks] = pp.rows_s
-                    exc_rows_p[i, :len(pp.exc_rows)] = pp.exc_rows
-                    e, h = pp.exc_mask.shape
-                    exc_mask_p[i, :e, :h] = pp.exc_mask
-                    host_gpu_p[i, :pp.host_gpu.shape[0]] = pp.host_gpu
-                    host_blocked_p[i, :pp.host_blocked.shape[0]] = \
-                        pp.host_blocked
-                    shares_u_p[i, :pp.shares_u.shape[0]] = pp.shares_u
-                    quota_u_p[i, :pp.quota_u.shape[0]] = pp.quota_u
-                    tokens_u_p[i, :pp.tokens_u.shape[0]] = pp.tokens_u
-                inp = CompactPoolCycleInputs(
-                    rows=jnp.asarray(rows_p),
-                    flags=jnp.asarray(stack(lambda pp: padT(pp.flags, 0))),
-                    res_base=mir_res,
-                    disk_base=mir_disk,
-                    tokens_u=jnp.asarray(tokens_u_p),
-                    shares_u=jnp.asarray(shares_u_p),
-                    quota_u=jnp.asarray(quota_u_p),
-                    **scalars,
-                    host_gpu=jnp.asarray(host_gpu_p),
-                    host_blocked=jnp.asarray(host_blocked_p),
-                    exc_rows=jnp.asarray(exc_rows_p),
-                    exc_mask=jnp.asarray(exc_mask_p),
-                    avail=jnp.asarray(avail_p),
-                    capacity=jnp.asarray(cap_p))
-            else:
-                cmask_p = np.zeros((P, T, H), dtype=bool)
-                for i, pp in enumerate(group):
-                    cmask_p[i, :pp.n_tasks, :pp.cmask.shape[1]] = pp.cmask
-                inp = PoolCycleInputs(
-                    usage=jnp.asarray(arr("usage", 0)),
-                    quota=jnp.asarray(arr("quota", INF)),
-                    shares=jnp.asarray(arr("shares", INF)),
-                    first_idx=jnp.asarray(arr("first_idx", 0)),
-                    user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
-                    pending=jnp.asarray(arr("pending", False)),
-                    valid=jnp.asarray(arr("valid", False)),
-                    enqueue_ok=jnp.asarray(
-                        stack(lambda pp: padT(pp.enqueue_ok, False))),
-                    launch_ok=jnp.asarray(
-                        stack(lambda pp: padT(pp.launch_ok, False))),
-                    tokens=jnp.asarray(
-                        stack(lambda pp: padT(pp.tokens, 0.0))),
-                    **scalars,
-                    job_res=jnp.asarray(
-                        stack(lambda pp: padT(pp.job_res, 0.0))),
-                    cmask=jnp.asarray(cmask_p),
-                    avail=jnp.asarray(avail_p),
-                    capacity=jnp.asarray(cap_p))
+                rows_p[i, :pp.n_tasks] = pp.rows_s
+                exc_rows_p[i, :len(pp.exc_rows)] = pp.exc_rows
+                e, h = pp.exc_mask.shape
+                exc_mask_p[i, :e, :h] = pp.exc_mask
+                host_gpu_p[i, :pp.host_gpu.shape[0]] = pp.host_gpu
+                host_blocked_p[i, :pp.host_blocked.shape[0]] = \
+                    pp.host_blocked
+                shares_u_p[i, :pp.shares_u.shape[0]] = pp.shares_u
+                quota_u_p[i, :pp.quota_u.shape[0]] = pp.quota_u
+                tokens_u_p[i, :pp.tokens_u.shape[0]] = pp.tokens_u
+            inp = CompactPoolCycleInputs(
+                rows=jnp.asarray(rows_p),
+                flags=jnp.asarray(stack(lambda pp: padT(pp.flags, 0))),
+                res_base=mir_res,
+                disk_base=mir_disk,
+                tokens_u=jnp.asarray(tokens_u_p),
+                shares_u=jnp.asarray(shares_u_p),
+                quota_u=jnp.asarray(quota_u_p),
+                **scalars,
+                host_gpu=jnp.asarray(host_gpu_p),
+                host_blocked=jnp.asarray(host_blocked_p),
+                exc_rows=jnp.asarray(exc_rows_p),
+                exc_mask=jnp.asarray(exc_mask_p),
+                avail=jnp.asarray(avail_p),
+                capacity=jnp.asarray(cap_p))
+        else:
+            cmask_p = np.zeros((P, T, H), dtype=bool)
+            for i, pp in enumerate(group):
+                cmask_p[i, :pp.n_tasks, :pp.cmask.shape[1]] = pp.cmask
+            inp = PoolCycleInputs(
+                usage=jnp.asarray(arr("usage", 0)),
+                quota=jnp.asarray(arr("quota", INF)),
+                shares=jnp.asarray(arr("shares", INF)),
+                first_idx=jnp.asarray(arr("first_idx", 0)),
+                user_rank=jnp.asarray(arr("user_rank", 2**31 - 1)),
+                pending=jnp.asarray(arr("pending", False)),
+                valid=jnp.asarray(arr("valid", False)),
+                enqueue_ok=jnp.asarray(
+                    stack(lambda pp: padT(pp.enqueue_ok, False))),
+                launch_ok=jnp.asarray(
+                    stack(lambda pp: padT(pp.launch_ok, False))),
+                tokens=jnp.asarray(
+                    stack(lambda pp: padT(pp.tokens, 0.0))),
+                **scalars,
+                job_res=jnp.asarray(
+                    stack(lambda pp: padT(pp.job_res, 0.0))),
+                cmask=jnp.asarray(cmask_p),
+                avail=jnp.asarray(avail_p),
+                capacity=jnp.asarray(cap_p))
 
-            # static match-problem cap: the configured max_jobs_considered
-            # (>= every pool's dynamic num_considerable), bucketed so the
-            # compiled cycle is reused across config tweaks
-            cap = bucket(max(
-                self.config.matcher_for_pool(pp.pool.name).max_jobs_considered
-                for pp in group))
-            stage_ms = round((time.perf_counter() - stage_t0) * 1000.0, 1)
-            import os
-            if os.environ.get("COOK_PROFILE_UPLOAD"):
-                import jax as _jax
-                _t = time.perf_counter()
-                _jax.block_until_ready(list(inp))
-                import sys as _sys
-                nbytes = sum(getattr(a, "nbytes", 0) for a in inp)
-                print(f"[profile] stage={stage_ms}ms upload="
-                      f"{(time.perf_counter()-_t)*1e3:.0f}ms "
-                      f"({nbytes/1e6:.1f}MB)", file=_sys.stderr)
-            # staged wire bytes this dispatch (the device-resident base
-            # mirror fields are NOT re-uploaded per cycle — the mirror
-            # sync accounts its own uploads)
-            telemetry.count_transfer("h2d", sum(
-                getattr(a, "nbytes", 0)
-                for name, a in zip(type(inp)._fields, inp)
-                if name not in ("res_base", "disk_base")))
-            with tracing.span("cycle.match", pools=len(group), tasks=T,
-                              hosts=H, gpu=gpu_mode):
-                with tracing.span("fused.dispatch", pools=len(group),
-                                  tasks=T, hosts=H, gpu=gpu_mode,
-                                  stage_ms=stage_ms):
-                    res = self._cycle_fn(gpu_mode, min(cap, T), structured,
-                                         compact=structured)(inp)
-                # fetch ONLY the compact outputs: [C]-sized candidate
-                # triples + the queue count.  The full [T] arrays
-                # (order/queue_ok/assign) and the rank-ordered queue_rows
-                # stay device-resident; the published RankedQueue fetches
-                # queue_rows lazily when a consumer actually touches the
-                # queue.  Device->host bandwidth is the cycle's scarcest
-                # resource on a tunneled chip (~10 MB/s observed): the old
-                # four-[T]-array fetch cost 2.1 MB / 210-250 ms per cycle
-                # at T=131k; this fetches ~50 KB.
-                outs = (res.cand_row, res.cand_assign, res.cand_qpos,
-                        res.n_queue)
-                for out_arr in outs:
-                    copy_async = getattr(out_arr, "copy_to_host_async", None)
-                    if copy_async is not None:
-                        copy_async()
-                # one batched fetch: each separate np.asarray pays a full
-                # device->host round trip (expensive on a tunneled chip)
-                import jax
-                with tracing.span("fused.fetch"), \
-                        telemetry.sync_wait("fused.fetch"):
-                    cand_row, cand_assign, cand_qpos, n_queue = \
-                        jax.device_get(outs)
-                telemetry.count_transfer("d2h", sum(
-                    getattr(a, "nbytes", 0)
-                    for a in (cand_row, cand_assign, cand_qpos, n_queue)))
+        # static match-problem cap: the configured max_jobs_considered
+        # (>= every pool's dynamic num_considerable), bucketed so the
+        # compiled cycle is reused across config tweaks
+        cap = bucket(max(
+            self.config.matcher_for_pool(pp.pool.name).max_jobs_considered
+            for pp in group))
+        stage_ms = round((time.perf_counter() - stage_t0) * 1000.0, 1)
+        return _StagedGroup(gpu_mode=gpu_mode, group=group, inp=inp,
+                            structured=structured, cap=cap, T=T, H=H,
+                            stage_ms=stage_ms)
 
-            with tracing.span("cycle.launch", pools=len(group)):
-                for i, pp in enumerate(group):
-                    self._apply_pool(scheduler, pp, cand_row[i],
-                                     cand_assign[i], cand_qpos[i],
-                                     int(n_queue[i]), res.queue_rows, i,
-                                     queues, results)
+    def dispatch_group(self, sg: "_StagedGroup") -> "_GroupDispatch":
+        """Phase 2: upload one staged group's inputs and dispatch the
+        jitted cycle; starts the async device->host copies of the compact
+        outputs so a later :meth:`fetch_group` overlaps the transfer with
+        whatever the host does in between (the pipelined driver's whole
+        point)."""
+        telemetry.profile_upload(sg.stage_ms, sg.inp)
+        # staged wire bytes this dispatch (the device-resident base
+        # mirror fields are NOT re-uploaded per cycle — the mirror
+        # sync accounts its own uploads)
+        telemetry.count_transfer("h2d", sum(
+            getattr(a, "nbytes", 0)
+            for name, a in zip(type(sg.inp)._fields, sg.inp)
+            if name not in ("res_base", "disk_base")))
+        with tracing.span("fused.dispatch", pools=len(sg.group),
+                          tasks=sg.T, hosts=sg.H, gpu=sg.gpu_mode,
+                          stage_ms=sg.stage_ms):
+            res = self._cycle_fn(sg.gpu_mode, min(sg.cap, sg.T),
+                                 sg.structured,
+                                 compact=sg.structured)(sg.inp)
+        # fetch ONLY the compact outputs: [C]-sized candidate
+        # triples + the queue count.  The full [T] arrays
+        # (order/queue_ok/assign) and the rank-ordered queue_rows
+        # stay device-resident; the published RankedQueue fetches
+        # queue_rows lazily when a consumer actually touches the
+        # queue.  Device->host bandwidth is the cycle's scarcest
+        # resource on a tunneled chip (~10 MB/s observed): the old
+        # four-[T]-array fetch cost 2.1 MB / 210-250 ms per cycle
+        # at T=131k; this fetches ~50 KB.
+        outs = (res.cand_row, res.cand_assign, res.cand_qpos,
+                res.n_queue)
+        for out_arr in outs:
+            copy_async = getattr(out_arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        return _GroupDispatch(sg, res, outs)
+
+    def fetch_group(self, gd: "_GroupDispatch"):
+        """Phase 3: one batched device->host fetch of a dispatch's compact
+        outputs (each separate np.asarray would pay a full round trip,
+        expensive on a tunneled chip).  Idempotent."""
+        if gd.fetched is None:
+            import jax
+            with tracing.span("fused.fetch"), \
+                    telemetry.sync_wait("fused.fetch"):
+                gd.fetched = jax.device_get(gd.outs)
+            telemetry.count_transfer("d2h", sum(
+                getattr(a, "nbytes", 0) for a in gd.fetched))
+        return gd.fetched
+
+    def apply_group(self, scheduler, gd: "_GroupDispatch", queues, results,
+                    reconciler=None) -> None:
+        """Phase 4: map one fetched group's outputs back to entities and
+        run the transactional launch path per pool.  ``reconciler`` is the
+        pipelined driver's pre-launch re-validation hook (see
+        :meth:`_apply_pool`)."""
+        cand_row, cand_assign, cand_qpos, n_queue = gd.fetched
+        with tracing.span("cycle.launch", pools=len(gd.sg.group)):
+            for i, pp in enumerate(gd.sg.group):
+                self._apply_pool(scheduler, pp, cand_row[i],
+                                 cand_assign[i], cand_qpos[i],
+                                 int(n_queue[i]), gd.res.queue_rows, i,
+                                 queues, results, reconciler=reconciler)
+
+    def step(self, scheduler) -> Tuple[Dict[str, List[Job]],
+                                       Dict[str, MatchCycleResult]]:
+        """One SYNCHRONOUS fused cycle over all active non-direct pools:
+        stage -> dispatch -> fetch -> apply, group by group, exactly the
+        pre-pipeline behavior (pipeline_depth=0 routes here).  Returns
+        (pending queues, match results); direct pools are handled by the
+        scheduler separately."""
+        staged = self.stage(scheduler)
+        queues: Dict[str, List[Job]] = {p.name: [] for p in staged.pools}
+        results: Dict[str, MatchCycleResult] = {}
+        for sg in staged.groups:
+            with tracing.span("cycle.match", pools=len(sg.group),
+                              tasks=sg.T, hosts=sg.H, gpu=sg.gpu_mode):
+                gd = self.dispatch_group(sg)
+                self.fetch_group(gd)
+            self.apply_group(scheduler, gd, queues, results)
         return queues, results
 
     # ----------------------------------------------------------------- apply
     def _apply_pool(self, scheduler, pp: _PackedPool, cand_row, cand_assign,
                     cand_qpos, n_queue: int, queue_rows_dev, pool_slot: int,
-                    queues, results) -> None:
+                    queues, results, reconciler=None) -> None:
         """Map one pool's COMPACT kernel outputs back to entities: queue
         refresh, within-batch group validation, backoff bookkeeping,
         transactional launch.
@@ -811,7 +1051,17 @@ class FusedCycleDriver:
         ``cand_row``/``cand_assign``/``cand_qpos`` are the [C] admitted-slot
         arrays (-1 = empty slot); the rank-ordered queue rows stay on device
         in ``queue_rows_dev[pool_slot]`` and are fetched only when a queue
-        consumer materializes them."""
+        consumer materializes them.
+
+        ``reconciler`` is the pipelined driver's Omega-style pre-launch
+        re-validation (sched/pipeline.py): called with (pp, cand_jobs,
+        cand_host), returns (state_drop, resource_drop) bool masks over
+        the candidates.  State conflicts (no longer WAITING — launched by
+        an overlapped cycle, or killed since the pack) are removed
+        outright and pruned from the published queue; resource conflicts
+        (the host's availability was consumed by an overlapped launch the
+        staged snapshot didn't see) fall back to unmatched and retry next
+        cycle.  Never passed on the sync path."""
         pool_name = pp.pool.name
         # slice this pool's row off the [P, T] output eagerly (an async
         # device op): the published queue's closure must NOT keep the whole
@@ -887,12 +1137,54 @@ class FusedCycleDriver:
         # clip padding-host assignments (can't happen: padding hosts have
         # zero capacity and all-False masks, but stay defensive)
         cand_host[cand_host >= len(pp.offers)] = -1
+        conflict_qpos = None
+        res_conflict = None
+        dropped_head_matched = False
+        if reconciler is not None:
+            with tracing.span("fused.reconcile", pool=pool_name,
+                              candidates=len(slots)):
+                state_drop, res_drop = reconciler(pp, cand_jobs, cand_host)
+            # a dropped HEAD that held an assignment DID match (it
+            # launched one cycle earlier, or the overlap consumed its
+            # host): backoff must not shrink for a transient conflict
+            dropped_head_matched = bool(
+                (state_drop[0] or res_drop[0]) and cand_host[0] >= 0) \
+                if len(slots) else False
+            if res_drop.any():
+                cand_host[res_drop] = -1
+            if state_drop.any():
+                qp = cand_qpos[slots[state_drop]]
+                conflict_qpos = qp[qp >= 0]
+                keep = ~state_drop
+                slots = slots[keep]
+                cand_jobs = [j for j, k in zip(cand_jobs, keep) if k]
+                cand_host = cand_host[keep]
+                res_drop = res_drop[keep]
+            res_conflict = res_drop if res_drop.any() else None
+            if len(slots) == 0:
+                # every candidate conflicted away: like the empty cycle,
+                # leave backoff untouched (the head DID match — it just
+                # launched one cycle earlier than this stale snapshot saw)
+                publish_queue(conflict_qpos)
+                result.queue_pruned = conflict_qpos is not None \
+                    and len(conflict_qpos) > 0
+                results[pool_name] = result
+                return
         cand_host = validate_group_placement(
             cand_jobs, cand_host, pp.offers, pp.ctx)
-        self.matcher.record_placement_failures(
-            cand_jobs, cand_host, pp.offers, pp.ctx)
+        if res_conflict is not None:
+            # resource-conflicted candidates are a pipeline transient,
+            # not a placement failure: keep them out of the unscheduled
+            # explainer's persisted per-host summaries
+            rp_keep = ~res_conflict
+            self.matcher.record_placement_failures(
+                [j for j, k in zip(cand_jobs, rp_keep) if k],
+                cand_host[rp_keep], pp.offers, pp.ctx)
+        else:
+            self.matcher.record_placement_failures(
+                cand_jobs, cand_host, pp.offers, pp.ctx)
 
-        result.head_matched = bool(cand_host[0] >= 0)
+        result.head_matched = bool(cand_host[0] >= 0) or dropped_head_matched
         mc = self.config.matcher_for_pool(pool_name)
         self.matcher._backoff[pool_name].update(mc, result.head_matched)
 
@@ -905,14 +1197,19 @@ class FusedCycleDriver:
         with tracing.span("fused.launch", pool=pool_name,
                           matched=len(result.matched)):
             self.matcher._launch(pool_name, result, scheduler.clusters)
-        # drop this cycle's launches from the queue by exact position
-        # (launched candidates are always queue members — match_valid
-        # implies queue_ok, so cand_qpos is valid for every launched slot)
+        # drop this cycle's launches — and any reconcile-conflicted
+        # candidates — from the queue by exact position (launched
+        # candidates are always queue members — match_valid implies
+        # queue_ok, so cand_qpos is valid for every launched slot)
+        drops = ([conflict_qpos] if conflict_qpos is not None
+                 and len(conflict_qpos) else [])
         if result.launched_job_uuids:
             cand_uuids = np.array([j.uuid for j in cand_jobs])
             launched_c = np.isin(cand_uuids,
                                  np.array(result.launched_job_uuids))
-            publish_queue(cand_qpos[slots[launched_c]])
+            drops.append(cand_qpos[slots[launched_c]])
+        if drops:
+            publish_queue(np.concatenate(drops))
             result.queue_pruned = True
         else:
             publish_queue()

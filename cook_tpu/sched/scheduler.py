@@ -87,8 +87,23 @@ class Scheduler:
         self.reserved_hosts: Dict[str, str] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        # fused production cycle driver, created lazily on first step_cycle
+        # fused production cycle driver, created lazily on first step_cycle;
+        # _pipeline wraps it when config.pipeline.depth > 0 (the pipelined
+        # optimistic driver, sched/pipeline.py)
         self._fused = None
+        self._pipeline = None
+        # cold-start tail killer (config.PipelineConfig): persistent
+        # compilation cache + boot-time warmup sweep, so first-call
+        # compiles land here — inside the takeover window — and never
+        # inside a live cycle.  Both are opt-in config; the cpu rank
+        # backend has no fused path to warm.
+        if rank_backend != "cpu":
+            pl = self.config.pipeline
+            if pl.compilation_cache_dir:
+                from ..ops.telemetry import enable_compilation_cache
+                enable_compilation_cache(pl.compilation_cache_dir)
+            if pl.warmup_tasks and pl.warmup_hosts:
+                self.warmup_kernels()
         # GC discipline for the production cycle: with 100k+ live entities
         # the interpreter's automatic gen2 collections (full scans of a
         # multi-million-object heap) land mid-cycle and double the p99.
@@ -348,6 +363,52 @@ class Scheduler:
         threading.Thread(target=stifle, daemon=True,
                          name="offensive-job-stifler").start()
 
+    def _ensure_fused(self):
+        """The fused driver (and, at pipeline_depth > 0, the pipelined
+        optimistic wrapper around it), created lazily."""
+        if self._fused is None:
+            from .fused import FusedCycleDriver
+            self._fused = FusedCycleDriver(
+                self.store, self.config, self.matcher, self.plugins,
+                self.rate_limits)
+            if self.config.pipeline.depth > 0:
+                from .pipeline import PipelinedCycleDriver
+                self._pipeline = PipelinedCycleDriver(
+                    self._fused, self.config.pipeline)
+            # gauge emitted for BOTH drivers: a depth-0 deployment must
+            # read 0 on /metrics, not be indistinguishable from a broken
+            # scrape (docs/OBSERVABILITY.md documents "0 = sync")
+            from ..utils.metrics import registry
+            registry.gauge_set("cook_pipeline_depth",
+                               float(self.config.pipeline.depth))
+        return self._pipeline or self._fused
+
+    def warmup_kernels(self) -> int:
+        """Boot-time pre-compile of the fused cycle at the configured
+        (T, H) bucket grid (config.PipelineConfig; FusedCycleDriver.
+        warmup): steady-state cycles then trace/compile nothing, so the
+        first-call compile spike can never land inside a live cycle.
+        Returns the number of warmup executions (0 when unconfigured or
+        the device path is unavailable)."""
+        pl = self.config.pipeline
+        if not (pl.warmup_tasks and pl.warmup_hosts):
+            return 0
+        self._ensure_fused()
+        try:
+            with tracing.span("fused.warmup", tasks=pl.warmup_tasks,
+                              hosts=pl.warmup_hosts, sweep=pl.warmup_sweep):
+                return self._fused.warmup(
+                    tasks=pl.warmup_tasks, hosts=pl.warmup_hosts,
+                    users=pl.warmup_users, sweep=pl.warmup_sweep,
+                    gpu=pl.warmup_gpu)
+        except Exception:
+            # a warmup failure is a cold start, not an outage: the live
+            # path compiles on first use exactly as before
+            import logging
+            logging.getLogger(__name__).exception(
+                "fused-cycle warmup failed; first cycles compile live")
+            return 0
+
     def step_cycle(self) -> Dict[str, MatchCycleResult]:
         """PRODUCTION cycle: rank + admission + match for every active
         non-direct pool in ONE fused device dispatch
@@ -355,15 +416,16 @@ class Scheduler:
         then the transactional launch path on host.  Direct (Kenzo) pools
         keep the host path (there is no match kernel to fuse).
 
+        With ``config.pipeline.depth > 0`` the dispatch is pipelined
+        (sched/pipeline.py): while this cycle's launches are applied, the
+        next cycle's kernel is already computing on device against an
+        optimistically-stale snapshot, reconciled host-side before launch.
+
         Replaces the reference's per-pool handler round-robin
         (scheduler.clj:2398-2517) with a single dispatch; step_rank/
         step_match remain for the CPU fallback and deterministic tests.
         """
-        if self._fused is None:
-            from .fused import FusedCycleDriver
-            self._fused = FusedCycleDriver(
-                self.store, self.config, self.matcher, self.plugins,
-                self.rate_limits)
+        driver = self._ensure_fused()
         with flight_recorder.cycle(kind="fused") as rec:
             import gc
             gc_paused = self.gc_discipline and gc.isenabled()
@@ -372,7 +434,7 @@ class Scheduler:
             degraded = False
             try:
                 with tracing.span("fused.cycle"):
-                    queues, results = self._fused.step(self)
+                    queues, results = driver.step(self)
             except Exception:
                 # device dispatch failed (XLA error, device loss, injected
                 # fault): degrade to the split host path for this cycle
@@ -384,6 +446,10 @@ class Scheduler:
                 registry.counter_inc("cook_kernel_fallback",
                                      labels={"kernel": "fused.pool_cycle"})
                 flight_recorder.note_fault("fused.dispatch-fallback")
+                if self._pipeline is not None:
+                    # in-flight speculation may reference the failed
+                    # device state; drop it (nothing was transacted)
+                    self._pipeline.reset()
                 degraded = True
             finally:
                 if gc_paused:

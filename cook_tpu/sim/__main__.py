@@ -50,7 +50,26 @@ def main(argv=None) -> int:
     p.add_argument("--leader-mode", default="sigkill",
                    choices=["sigkill", "partition"],
                    help="chaos-failover: how the leader is lost")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="chaos: drive the production pipelined fused "
+                        "cycle at this depth instead of the split host "
+                        "path (duplicate-live invariant under overlapped "
+                        "optimistic dispatches)")
+    p.add_argument("--parity-pipeline", action="store_true",
+                   help="run the pipelined-vs-sync parity harness "
+                        "(sim/simulator.py run_pipeline_parity): same "
+                        "launched job set, no duplicate live instances; "
+                        "exit 1 on divergence")
     args = p.parse_args(argv)
+
+    if args.parity_pipeline:
+        from .simulator import run_pipeline_parity
+        result = run_pipeline_parity(
+            seed=args.seed or 0, n_jobs=args.jobs or 60,
+            n_hosts=args.n_hosts or 10,
+            depth=args.pipeline_depth or 2, backend=args.backend)
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
 
     if args.chaos_failover:
         from .chaos import FailoverChaosConfig, run_failover_chaos
@@ -69,6 +88,8 @@ def main(argv=None) -> int:
         if args.leader_kill_at_ms is not None:
             cc.leader_kill_at_ms = (None if args.leader_kill_at_ms < 0
                                     else args.leader_kill_at_ms)
+        if args.pipeline_depth is not None:
+            cc.pipeline_depth = args.pipeline_depth
         result = run_chaos(cc)
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1

@@ -86,6 +86,11 @@ class ChaosConfig:
     breaker_reset_timeout_s: float = 5.0
     max_virtual_ms: int = 30 * 60 * 1000
     data_dir: Optional[str] = None   # journal dir; tempdir when None
+    # > 0 drives the PRODUCTION pipelined fused cycle (sched/pipeline.py,
+    # Scheduler.step_cycle) under the fault schedule instead of the split
+    # host path — the no-duplicate-live-instances invariant is checked
+    # every tick against the overlapped optimistic dispatches
+    pipeline_depth: int = 0
 
 
 @dataclass
@@ -133,9 +138,18 @@ class _LeaderCrash(BaseException):
 
 def _scheduler_config(cc: ChaosConfig) -> Config:
     cfg = Config()
-    # deterministic host path: the chaos run asserts scheduling
-    # INVARIANTS, not kernel behavior (kernel fallback has its own tests)
-    cfg.cycle_mode = "split"
+    if cc.pipeline_depth > 0:
+        # production pipelined fused cycle under chaos: overlapped
+        # optimistic dispatches + reconciliation are exactly what the
+        # duplicate-live invariant must hold against
+        cfg.cycle_mode = "fused"
+        cfg.pipeline.depth = cc.pipeline_depth
+    else:
+        # deterministic host path: the chaos run asserts scheduling
+        # INVARIANTS, not kernel behavior (kernel fallback has its own
+        # tests)
+        cfg.cycle_mode = "split"
+        cfg.pipeline.depth = 0
     cfg.default_matcher.backend = "cpu"
     cfg.columnar_index = False
     cfg.circuit_breaker.failure_threshold = cc.breaker_failure_threshold
@@ -228,8 +242,11 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
 
         FakeCluster.launch_tasks = crash
         try:
-            scheduler.step_rank()
-            scheduler.step_match()
+            if cc.pipeline_depth > 0:
+                scheduler.step_cycle()
+            else:
+                scheduler.step_rank()
+                scheduler.step_match()
         except _LeaderCrash:
             pass
         finally:
@@ -279,8 +296,11 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         if now >= next_node_loss:
             next_node_loss = now + cc.node_loss_every_ms
             fail_one_node()
-        scheduler.step_rank()
-        scheduler.step_match()
+        if cc.pipeline_depth > 0:
+            scheduler.step_cycle()
+        else:
+            scheduler.step_rank()
+            scheduler.step_match()
         scheduler.step_reapers(current_ms=now)
         state = breaker.state
         if state == "open" and last_breaker_state != "open":

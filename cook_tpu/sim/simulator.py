@@ -257,3 +257,74 @@ def generate_example_hosts(n_hosts: int = 20, seed: int = 0) -> List[Dict]:
              "cpus": float(rng.choice([8, 16, 32])),
              "mem": float(rng.choice([8192, 16384, 32768]))}
             for i in range(n_hosts)]
+
+
+def run_pipeline_parity(seed: int = 0, n_jobs: int = 60, n_hosts: int = 10,
+                        depth: int = 2, backend: str = "tpu",
+                        span_ms: int = 60_000,
+                        duration_ms: int = 10_000) -> Dict:
+    """Deterministic pipelined-vs-sync parity harness (docs/PERFORMANCE.md):
+    two identical seeded worlds driven through the PRODUCTION fused cycle
+    (Scheduler.step_cycle), one with ``pipeline_depth=0`` (strictly
+    synchronous) and one pipelined at ``depth``.  Asserted by
+    tests/test_pipeline.py and runnable standalone
+    (``python -m cook_tpu.sim --parity-pipeline``):
+
+    - both runs complete every job;
+    - both runs LAUNCH the same job set (the per-cycle schedule may
+      differ by the pipeline's one-cycle speculation, the work may not);
+    - no job ever holds two live instances (store-level re-check);
+    - the pipelined run's reconciliation conflict drops are reported
+      (zero expected here: the speculation mask makes back-to-back
+      cycles disjoint, and a single-threaded sim has no racing writers).
+    """
+    from ..utils.flight import recorder as _flight
+
+    def run_one(d: int):
+        cfg = Config()
+        cfg.pipeline.depth = d
+        entries = generate_example_trace(n_jobs, seed=seed,
+                                         span_ms=span_ms,
+                                         duration_ms=duration_ms)
+        # FIXED uuids: load_trace otherwise mints fresh ones, and the two
+        # runs' launched sets must be comparable by identity
+        for i, e in enumerate(entries):
+            e["uuid"] = f"00000000-0000-4000-8000-{i:012d}"
+        trace = load_trace(entries)
+        hosts = load_hosts(generate_example_hosts(n_hosts, seed=seed))
+        seq0 = _flight.last_seq()
+        sim = Simulator(trace, hosts, config=cfg, backend=backend,
+                        cycle_mode="fused")
+        res = sim.run()
+        flight = _flight.summary(since_seq=seq0)
+        launched = {r["job"] for r in res.task_records}
+        # store-level duplicate-live re-check (the chaos harness checks
+        # per-tick; end-state must hold too)
+        dup = []
+        for job in sim.store.jobs_where(lambda j: True):
+            live = [t for t in job.instances
+                    if (i := sim.store.instance(t)) is not None
+                    and i.status.value in ("unknown", "running")]
+            if len(live) > 1:
+                dup.append(job.uuid)
+        return res, launched, flight, dup
+
+    res_sync, launched_sync, _fl_sync, dup_sync = run_one(0)
+    res_pipe, launched_pipe, fl_pipe, dup_pipe = run_one(depth)
+    return {
+        "ok": (launched_sync == launched_pipe
+               and res_sync.completed == res_sync.total
+               and res_pipe.completed == res_pipe.total
+               and not dup_sync and not dup_pipe),
+        "jobs": n_jobs,
+        "depth": depth,
+        "sync_completed": res_sync.completed,
+        "pipelined_completed": res_pipe.completed,
+        "launched_equal": launched_sync == launched_pipe,
+        "launched_only_sync": sorted(launched_sync - launched_pipe),
+        "launched_only_pipelined": sorted(launched_pipe - launched_sync),
+        "duplicate_live": sorted(dup_sync + dup_pipe),
+        "pipelined_conflicts": fl_pipe.get("pipeline_conflicts", 0),
+        "sync_placements": res_sync.placements,
+        "pipelined_placements": res_pipe.placements,
+    }

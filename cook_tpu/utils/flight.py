@@ -61,7 +61,9 @@ class CycleRecord:
     __slots__ = ("seq", "kind", "trace_id", "start_s", "duration_ms",
                  "phases", "pools", "jobs_considered", "jobs_placed",
                  "skip_reasons", "preemptions", "recompiles", "h2d_bytes",
-                 "d2h_bytes", "sync_wait_ms", "faults", "error", "_t0")
+                 "d2h_bytes", "sync_wait_ms", "faults", "error",
+                 "pipeline_depth", "pipeline_inflight",
+                 "pipeline_conflicts", "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
@@ -84,6 +86,13 @@ class CycleRecord:
         # cycle explains itself without cross-referencing logs
         self.faults: Dict[str, int] = {}
         self.error: Optional[str] = None
+        # pipelined-driver readings (sched/pipeline.py): configured depth
+        # (0 = sync driver), dispatches in flight when this cycle's step
+        # finished staging, and reconciliation conflict drops applied
+        # inside this cycle
+        self.pipeline_depth = 0
+        self.pipeline_inflight = 0
+        self.pipeline_conflicts = 0
         self._t0 = time.perf_counter()
 
     def to_doc(self) -> Dict[str, Any]:
@@ -101,6 +110,9 @@ class CycleRecord:
             "d2h_bytes": self.d2h_bytes,
             "sync_wait_ms": round(self.sync_wait_ms, 3),
             "faults": dict(self.faults),
+            "pipeline_depth": self.pipeline_depth,
+            "pipeline_inflight": self.pipeline_inflight,
+            "pipeline_conflicts": self.pipeline_conflicts,
             "error": self.error,
         }
 
@@ -199,6 +211,24 @@ class FlightRecorder:
             with self._lock:
                 rec.preemptions += int(n)
 
+    def note_pipeline(self, depth: int, inflight: int) -> None:
+        """Pipelined-driver shape of the current cycle (sched/pipeline.py):
+        configured depth and dispatches in flight after staging."""
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.pipeline_depth = int(depth)
+                rec.pipeline_inflight = int(inflight)
+
+    def note_pipeline_conflicts(self, n: int) -> None:
+        """Reconciliation conflict drops (candidates re-validated against
+        the store and dropped instead of double-launched) inside the
+        current cycle."""
+        rec = _current_record.get()
+        if rec is not None and n:
+            with self._lock:
+                rec.pipeline_conflicts += int(n)
+
     def note_fault(self, point: str, n: int = 1) -> None:
         """A fault-point trigger or degradation (kernel fallback, breaker
         reroute) attributed to the cycle it happened inside."""
@@ -273,6 +303,8 @@ class FlightRecorder:
             "recompiles": recompiles,
             "skip_reasons": skips,
             "faults": faults,
+            "pipeline_conflicts": sum(r.pipeline_conflicts
+                                      for r in records),
             "h2d_bytes": sum(r.h2d_bytes for r in records),
             "d2h_bytes": sum(r.d2h_bytes for r in records),
             "sync_wait_ms": round(sum(r.sync_wait_ms for r in records), 3),
