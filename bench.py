@@ -946,6 +946,127 @@ def bench_pipeline_driver(n_jobs=100_000, n_users=200, H=5000, reps=8):
     return out
 
 
+def bench_gang_cycle(n_jobs=50_000, n_users=100, H=2500, gang_size=4,
+                     reps=6):
+    """Gang-scheduling cost + quality (docs/GANG.md): a gang-fraction
+    sweep through the PRODUCTION fused cycle (Scheduler.step_cycle,
+    pipeline depth pinned 0 for sync comparability) against a slice-
+    topology host fleet.  Each leg reports match p50/p99, the partial-
+    drop rate (gangs reset by the all-or-nothing reduction / gangs
+    submitted), and per-cycle placements so the gang legs read directly
+    against the gang-free baseline.  Rides the standard per-section
+    subprocess timeout/fallback/partial-emit contract."""
+    from cook_tpu.cluster import FakeCluster, FakeHost
+    from cook_tpu.config import Config
+    from cook_tpu.sched import Scheduler
+    from cook_tpu.state import Group, Job, Resources, Store, new_uuid
+    from cook_tpu.utils.flight import recorder as _flight
+
+    def make_jobs(rng, n, frac):
+        jobs, groups = [], []
+        n_gang_jobs = int(n * frac) // gang_size * gang_size
+        for g in range(n_gang_jobs // gang_size):
+            guuid = new_uuid()
+            members = [Job(uuid=new_uuid(), user=f"user{g % n_users:03d}",
+                           command="x", group=guuid,
+                           priority=int(rng.integers(0, 100)),
+                           resources=Resources(cpus=2.0, mem=512.0))
+                       for _ in range(gang_size)]
+            groups.append(Group(uuid=guuid, gang=True,
+                                gang_size=gang_size,
+                                gang_topology="slice-id",
+                                jobs=[m.uuid for m in members]))
+            jobs.extend(members)
+        jobs.extend(_driver_jobs(rng, n - n_gang_jobs, n_users))
+        return jobs, groups
+
+    def run_leg(frac):
+        rng = np.random.default_rng(29)
+        cfg = Config()
+        cfg.pipeline.depth = 0  # sync: the baseline the sweep reads against
+        store = Store()
+        hosts = [FakeHost(f"h{i}", Resources(cpus=64.0, mem=65536.0),
+                          attributes={"slice-id": f"s{i // gang_size}"})
+                 for i in range(H)]
+        cluster = FakeCluster(f"fake-g{int(frac * 100)}", hosts)
+        sched = Scheduler(store, cfg, [cluster], rank_backend="tpu",
+                          status_queue_shards=4)
+        jobs, groups = make_jobs(rng, n_jobs, frac)
+        gang_of = {}
+        for g in groups:
+            for u in g.jobs:
+                gang_of[u] = g.uuid
+        for i in range(0, len(jobs), 10_000):
+            store.create_jobs(jobs[i:i + 10_000], groups=[
+                g for g in groups
+                if g.jobs[0] in {j.uuid for j in jobs[i:i + 10_000]}])
+        store.ensure_index()
+        results = sched.step_cycle()  # compile/cache warm
+        launched = sum(len(r.launched_task_ids) for r in results.values())
+        sched.flush_status_updates()
+        seq0 = _flight.last_seq()
+        # drop rate = partial gangs / gang-cycle OPPORTUNITIES (partials
+        # + gangs placed whole that cycle) so a gang waiting across all
+        # reps cannot push the rate past 1.0
+        samples, placed, gangs_partial, gang_opps = [], [], 0, 0
+        for _ in range(reps):
+            njobs, ngroups = make_jobs(rng, launched or 5000, frac)
+            for g in ngroups:
+                for u in g.jobs:
+                    gang_of[u] = g.uuid
+            for i in range(0, len(njobs), 10_000):
+                chunk = njobs[i:i + 10_000]
+                ids = {j.uuid for j in chunk}
+                store.create_jobs(chunk, groups=[
+                    g for g in ngroups if g.jobs[0] in ids])
+            t0 = time.perf_counter()
+            results = sched.step_cycle()
+            samples.append((time.perf_counter() - t0) * 1000.0)
+            launched = sum(len(r.launched_task_ids)
+                           for r in results.values())
+            partial_g = sum(len(r.gang_partial)
+                            for r in results.values())
+            placed_g = len({gang_of[u] for r in results.values()
+                            for u in r.launched_job_uuids
+                            if u in gang_of})
+            gangs_partial += partial_g
+            gang_opps += partial_g + placed_g
+            placed.append(launched)
+            sched.flush_status_updates()
+        flight = _flight.summary(since_seq=seq0)
+        leg = {
+            "p50_ms": round(pctl(samples, 50), 1),
+            "p99_ms": round(pctl(samples, 99), 1),
+            "placed_per_cycle_mean": round(float(np.mean(placed)), 1),
+            "gang_jobs_frac": frac,
+            # gangs that could not place whole per gang-cycle
+            # opportunity (includes wholly-unmatched gangs waiting on
+            # capacity); always in [0, 1]
+            "partial_drop_rate": round(gangs_partial
+                                       / max(gang_opps, 1), 4),
+            # member placements actually reset by the all-or-nothing
+            # reduction (the capacity the refill pass re-offers)
+            "partial_dropped_jobs": flight.get("skip_reasons", {}).get(
+                "gang-partial", 0),
+        }
+        sched.shutdown()
+        return leg
+
+    baseline = run_leg(0.0)
+    sweep = {f"frac_{int(f * 100)}": run_leg(f) for f in (0.25, 0.5)}
+    out = {"baseline": baseline, **sweep,
+           "gang_size": gang_size,
+           "overhead_p50_vs_baseline": round(
+               sweep["frac_50"]["p50_ms"]
+               / max(baseline["p50_ms"], 1e-9), 2)}
+    print(f"gang_cycle[{n_jobs//1000}k x {H//1000}k, size={gang_size}] "
+          f"base_p50={baseline['p50_ms']}ms "
+          f"frac50_p50={sweep['frac_50']['p50_ms']}ms "
+          f"drop_rate={sweep['frac_50']['partial_drop_rate']}",
+          file=sys.stderr)
+    return out
+
+
 def bench_rebalance(T=1_000_000, H=50_000):
     """Preemption victim scan over 1M running tasks on 50k hosts."""
     import jax.numpy as jnp
@@ -1168,6 +1289,10 @@ def run_section(name: str) -> None:
         data = bench_pipeline_driver(n_jobs=scaled(100_000),
                                      n_users=scaled(200, lo=8),
                                      H=scaled(5000))
+    elif name == "gang_cycle":
+        data = bench_gang_cycle(n_jobs=scaled(50_000),
+                                n_users=scaled(100, lo=8),
+                                H=scaled(2500))
     elif name == "placement_quality":
         data = bench_placement_quality()
     elif name == "pipeline":
@@ -1296,6 +1421,8 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["driver_cycle_100k_jobs"] = results["driver_cycle"]
     if results.get("pipeline_driver") is not None:
         detail["pipeline_driver_100k_jobs"] = results["pipeline_driver"]
+    if results.get("gang_cycle") is not None:
+        detail["gang_cycle_50k_jobs"] = results["gang_cycle"]
     if results.get("pipeline") is not None:
         detail["pipeline_10cycle"] = results["pipeline"]
     if results.get("placement_quality") is not None:
@@ -1390,9 +1517,10 @@ def main():
 
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle",
-                "pipeline_driver", "fused_cycle", "store_cycle",
-                "store_scale", "match_large", "rebalance", "end2end",
-                "pallas_scale", "pipeline", "placement_quality"]
+                "pipeline_driver", "gang_cycle", "fused_cycle",
+                "store_cycle", "store_scale", "match_large", "rebalance",
+                "end2end", "pallas_scale", "pipeline",
+                "placement_quality"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

@@ -173,12 +173,20 @@ def out(payload) -> None:
 def cmd_submit(args) -> int:
     """Submit job(s) (reference: cli/cook/subcommands/submit.py): the
     command comes from argv, or — when absent — from stdin, one job per
-    non-empty line; ``--raw`` instead reads full JSON spec(s) (an object
-    or a list) from stdin and refuses argv commands."""
+    non-empty line; ``--raw`` instead reads full JSON spec(s) (an object,
+    a list, or a ``{"jobs": [...], "groups": [...]}`` body) from stdin
+    and refuses argv commands."""
+    groups = None
     if args.raw:
         if args.command:
             print("error: --raw reads specs from stdin; it cannot be "
                   "combined with a command argument", file=sys.stderr)
+            return 1
+        if (args.gang_size is not None or args.gang_topology
+                or args.gang_policy):
+            print("error: gang flags do not apply to --raw specs; "
+                  'submit a full body {"jobs": [...], "groups": [{..., '
+                  '"gang": {...}}]} instead', file=sys.stderr)
             return 1
         if args.command_prefix is not None:
             print("error: --command-prefix does not apply to --raw "
@@ -193,7 +201,17 @@ def cmd_submit(args) -> int:
         except json.JSONDecodeError as e:
             print(f"error: malformed --raw JSON: {e}", file=sys.stderr)
             return 1
-        specs = raw if isinstance(raw, list) else [raw]
+        if isinstance(raw, dict) and "jobs" in raw:
+            # full submit body {"jobs": [...], "groups": [...]} — the
+            # raw form that can express group/gang membership
+            specs = raw["jobs"]
+            groups = raw.get("groups")
+            if not isinstance(specs, list):
+                print('error: --raw "jobs" must be a list of job '
+                      "specs", file=sys.stderr)
+                return 1
+        else:
+            specs = raw if isinstance(raw, list) else [raw]
     else:
         if args.command:
             commands = [" ".join(args.command)]
@@ -254,8 +272,33 @@ def cmd_submit(args) -> int:
             name, _, version = args.application.partition(":")
             base["application"] = {"name": name, "version": version or "0"}
         specs = [{**base, "command": c} for c in commands]
+        if args.gang_size is not None:
+            # ONE command fans out into gang_size member jobs sharing a
+            # gang group (all-or-nothing placement, docs/GANG.md)
+            if args.gang_size < 1:
+                print("error: --gang-size must be >= 1", file=sys.stderr)
+                return 1
+            if len(specs) != 1:
+                print("error: --gang-size submits ONE command as N "
+                      "member jobs; got multiple commands",
+                      file=sys.stderr)
+                return 1
+            import uuid as uuidlib
+            guuid = str(uuidlib.uuid4())
+            specs = [{**specs[0], "group": guuid}
+                     for _ in range(args.gang_size)]
+            gang: Dict = {"size": args.gang_size}
+            if args.gang_topology:
+                gang["topology"] = args.gang_topology
+            if args.gang_policy:
+                gang["policy"] = args.gang_policy
+            groups = [{"uuid": guuid, "gang": gang}]
+        elif args.gang_topology or args.gang_policy:
+            print("error: --gang-topology/--gang-policy require "
+                  "--gang-size", file=sys.stderr)
+            return 1
     client = clients(args)[0]
-    uuids = client.submit(specs)
+    uuids = client.submit(specs, groups=groups)
     for u in uuids:
         print(u)
     return 0
@@ -699,6 +742,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "tracking executor")
     sp.add_argument("--application",
                     help="submitting application, name[:version]")
+    sp.add_argument("--gang-size", dest="gang_size", type=int,
+                    help="submit the command as an all-or-nothing gang "
+                         "of N member jobs (one group; docs/GANG.md)")
+    sp.add_argument("--gang-topology", dest="gang_topology",
+                    help="host attribute every gang member's host must "
+                         "share, e.g. slice-id")
+    sp.add_argument("--gang-policy", dest="gang_policy",
+                    choices=["requeue", "kill"],
+                    help="what a member failure does to the rest of the "
+                         "gang (default requeue)")
     sp.add_argument("--raw", action="store_true",
                     help="read full JSON job spec(s) from stdin")
     sp.add_argument("--command-prefix", dest="command_prefix",

@@ -247,30 +247,95 @@ class KubernetesCluster(ComputeCluster):
 
     # ------------------------------------------------------------ autoscaling
     def autoscale(self, pool: str, unmatched_jobs: List[Job],
-                  now_ms: int = 0) -> int:
+                  now_ms: int = 0,
+                  gangs: Optional[Dict[str, Dict]] = None) -> int:
         """Launch placeholder synthetic pods sized like unmatched jobs so a
         cluster autoscaler sees unsatisfied demand and provisions nodes
         (reference: autoscale! kubernetes/compute_cluster.clj:590-715,
-        trigger-autoscaling! scheduler.clj:1178). Returns pods created."""
-        existing = sum(1 for p in self.api.pods() if p.synthetic)
+        trigger-autoscaling! scheduler.clj:1178). Returns pods created.
+
+        ``gangs`` (group uuid -> {"size", "topology"}) sizes gang demand
+        as whole-slice pod SETS: the gang's placeholders are created
+        all-or-none within the pod budget and carry a co-location
+        affinity label/annotation so the cluster autoscaler provisions a
+        contiguous slice instead of scattered singles (docs/GANG.md)."""
+        gangs = gangs or {}
         budget = max(0, self.max_total_pods - len(self.api.pods()))
         created = 0
-        for job in unmatched_jobs[:budget]:
-            name = f"{SYNTHETIC_PREFIX}{job.uuid}"
-            if self.api.pod(name) is not None:
-                continue
-            try:
-                self.api.create_pod(FakePod(
-                    name=name, cpus=job.resources.cpus,
-                    mem=job.resources.mem, gpus=job.resources.gpus,
-                    synthetic=True,
-                    labels={"cook/synthetic": "true",
-                            "cook/job": job.uuid},
-                    annotations={"cook/created-ms": str(now_ms)}))
-                created += 1
-            except ValueError:
-                continue
+        # gang members grouped so a set never splits across the budget
+        units: List[List[Job]] = []
+        cohorts: Dict[str, List[Job]] = {}
+        for job in unmatched_jobs:
+            if job.group and job.group in gangs:
+                cohort = cohorts.get(job.group)
+                if cohort is None:
+                    cohort = cohorts[job.group] = []
+                    units.append(cohort)
+                cohort.append(job)
+            else:
+                units.append([job])
+        for unit in units:
+            if budget <= 0:
+                # nothing more can be created — skip the per-job pod
+                # lookups (real API reads) the missing-filter would do
+                break
+            # budget the MISSING placeholders only: members whose pods
+            # survived a previous cycle are free, and counting them
+            # would wrongly skip a nearly-provisioned gang at the cap
+            missing = [job for job in unit
+                       if self.api.pod(f"{SYNTHETIC_PREFIX}{job.uuid}")
+                       is None]
+            if not missing or len(missing) > budget:
+                continue  # a split gang set would under-provision the slice
+            guuid = unit[0].group if unit[0].group in gangs else None
+            made: List[str] = []
+            for job in missing:
+                name = f"{SYNTHETIC_PREFIX}{job.uuid}"
+                labels = {"cook/synthetic": "true", "cook/job": job.uuid}
+                annotations = {"cook/created-ms": str(now_ms)}
+                if guuid:
+                    labels["cook/gang"] = guuid
+                    annotations["cook/gang-size"] = \
+                        str(gangs[guuid].get("size") or len(unit))
+                    topo = gangs[guuid].get("topology")
+                    if topo:
+                        # co-location affinity hint for the autoscaler /
+                        # kube-scheduler: members want one topology domain
+                        annotations["cook/gang-affinity"] = topo
+                try:
+                    self.api.create_pod(FakePod(
+                        name=name, cpus=job.resources.cpus,
+                        mem=job.resources.mem, gpus=job.resources.gpus,
+                        synthetic=True,
+                        labels=labels, annotations=annotations))
+                    made.append(name)
+                    created += 1
+                    budget -= 1
+                except ValueError:
+                    if guuid:
+                        # the set is all-or-none: roll back this gang's
+                        # fresh placeholders rather than leave a partial
+                        # slice signal for the autoscaler
+                        for n in made:
+                            try:
+                                self.api.delete_pod(n)
+                            except Exception:
+                                pass
+                        created -= len(made)
+                        budget += len(made)
+                        break
+                    continue
         return created
+
+    def synthetic_pods_for(self, job_uuids: List[str]) -> List[str]:
+        """Which of these jobs already have a live placeholder here.
+        The scheduler's autoscale routing uses this to tell "at the pod
+        cap" (fall through with the uncovered jobs) apart from "already
+        provisioned" (stay put) when autoscale() creates nothing —
+        autoscale()'s own missing-filter reads the same pods, so this
+        is the established per-cycle read pattern, not a new one."""
+        return [u for u in job_uuids
+                if self.api.pod(f"{SYNTHETIC_PREFIX}{u}") is not None]
 
     def detect_stuck_pods(self, now_ms: Optional[int] = None) -> List[str]:
         """Stuck/unschedulable pod detection (reference:

@@ -13,6 +13,13 @@ from .match import (  # noqa: F401
     multipass_match_kernel,
     waterfill_match_kernel,
 )
+from .gang import (  # noqa: F401
+    GangPack,
+    GangStats,
+    apply_gang_cycle,
+    build_gang_pack,
+    gang_reduce_kernel,
+)
 from .padding import bucket, pad_to  # noqa: F401
 from .rebalance import (  # noqa: F401
     RebalanceDecision,

@@ -145,6 +145,51 @@ def greedy_match(job_res: np.ndarray, constraint_mask: np.ndarray,
 
 
 # --------------------------------------------------------------------------
+# Gang all-or-nothing reduction (docs/GANG.md; the host golden for
+# ops/gang.gang_reduce_kernel)
+# --------------------------------------------------------------------------
+
+def gang_reduce(assign: np.ndarray, gang_id: np.ndarray,
+                gang_size: np.ndarray, gang_attr: np.ndarray,
+                host_topo: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Zero out partial gangs in a match assignment.
+
+    A gang is complete when (a) at least ``gang_size[g]`` of its members
+    hold assignments and (b), for gangs with a topology request
+    (``gang_attr[g] > 0``), every matched member landed on hosts sharing
+    one known topology code.  Members of incomplete gangs are reset to
+    -1 (they retry next cycle; the freed capacity is re-offered by the
+    caller's refill pass).
+
+    ``assign`` i32[J] host index or -1; ``gang_id`` i32[J] segment id or
+    -1 for non-gang rows; ``gang_size`` i32[G]; ``gang_attr`` i32[G]
+    row into ``host_topo`` (0 = no topology requirement); ``host_topo``
+    i32[A, H] topology code per host (-1 = attribute absent).
+
+    Returns (assign', dropped bool[J]).
+    """
+    assign = np.asarray(assign, dtype=np.int32)
+    gang_id = np.asarray(gang_id, dtype=np.int32)
+    G = int(gang_size.shape[0])
+    member = gang_id >= 0
+    matched = member & (assign >= 0)
+    cnt = np.bincount(gang_id[matched], minlength=G)[:G]
+    complete = cnt >= np.asarray(gang_size, dtype=np.int64)
+    topo_required = np.asarray(gang_attr) > 0
+    if topo_required.any():
+        for g in np.flatnonzero(topo_required):
+            rows = matched & (gang_id == g)
+            if not rows.any():
+                continue
+            codes = host_topo[int(gang_attr[g])][assign[rows]]
+            if codes.min() < 0 or codes.min() != codes.max():
+                complete[g] = False
+    dropped = matched & ~complete[np.where(member, gang_id, 0)]
+    out = np.where(dropped, np.int32(-1), assign)
+    return out, dropped
+
+
+# --------------------------------------------------------------------------
 # Preemption decision (reference: rebalancer.clj compute-preemption-decision
 # :320-407)
 # --------------------------------------------------------------------------

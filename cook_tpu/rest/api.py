@@ -46,7 +46,12 @@ from ..config import Config
 from ..policy import PluginRegistry, QueueLimits, RateLimits
 from ..sched.scheduler import Scheduler
 from ..sched.unscheduled import job_reasons
+# re-exported on the REST surface; the store-derived status itself is
+# domain logic and lives in the state layer
+from ..state.machines import gang_status  # noqa: F401
 from ..state.schema import (
+    GANG_POLICIES,
+    GANG_POLICY_REQUEUE,
     Application,
     Constraint,
     Group,
@@ -167,7 +172,8 @@ def job_state_string(store: Store, job: Job,
     return "failed"
 
 
-def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
+def job_to_json(store: Store, job: Job, include_instances=True,
+                gang_cache: Optional[Dict[str, Dict]] = None) -> Dict:
     # fetched once, shared by the state resolution and the instances block;
     # skipped entirely for waiting/running summaries (no reader needs them)
     instances = ([i for t in job.instances
@@ -204,9 +210,24 @@ def job_to_json(store: Store, job: Job, include_instances=True) -> Dict:
                              job.application.workload_details}
                         if job.application else None),
     }
+    if job.group is not None:
+        if gang_cache is not None and job.group in gang_cache:
+            cached = gang_cache[job.group]
+            if cached:  # {} marks a known non-gang group
+                out["gang"] = {"group": job.group, **cached}
+        else:
+            group = store.group(job.group)
+            if group is not None and group.gang:
+                out["gang"] = {"group": group.uuid,
+                               **gang_status(store, group,
+                                             cache=gang_cache)}
+            elif gang_cache is not None:
+                gang_cache[job.group] = {}
     if include_instances:
         out["instances"] = [instance_to_json(i) for i in instances]
     return out
+
+
 
 
 def instance_to_json(inst) -> Dict:
@@ -481,13 +502,42 @@ def parse_job_spec(spec: Dict, user: str, default_pool: str) -> Job:
 
 
 def parse_group_spec(gspec: Dict, job_uuids: List[str]) -> Group:
-    """Group submission schema -> Group, including host-placement and
+    """Group submission schema -> Group, including host-placement,
     straggler-handling (reference: rest/api.clj:489-514 HostPlacement/
-    StragglerHandling schemas + :925 make-group-txn)."""
+    StragglerHandling schemas + :925 make-group-txn), and the gang block
+    (docs/GANG.md): ``{"gang": {"size": N, "topology": attr?,
+    "policy": "requeue"|"kill"}}`` declares an all-or-nothing multi-host
+    slice job; malformed gang specs are a clear 400."""
     try:
         group = Group(uuid=gspec["uuid"],
                       name=gspec.get("name", "defaultgroup"),
                       jobs=job_uuids)
+        gang = gspec.get("gang")
+        if gang is not None:
+            if not isinstance(gang, dict):
+                raise ApiError(400, "gang must be an object like "
+                                    '{"size": N}')
+            size = gang.get("size")
+            if not isinstance(size, int) or isinstance(size, bool) \
+                    or size < 1:
+                raise ApiError(400, "gang.size must be an integer >= 1")
+            topology = gang.get("topology")
+            if topology is not None and (
+                    not isinstance(topology, str) or not topology):
+                raise ApiError(400, "gang.topology must be a non-empty "
+                                    "host attribute name")
+            policy = gang.get("policy", GANG_POLICY_REQUEUE)
+            if policy not in GANG_POLICIES:
+                raise ApiError(
+                    400, f"gang.policy must be one of {GANG_POLICIES}")
+            unknown = set(gang) - {"size", "topology", "policy"}
+            if unknown:
+                raise ApiError(400, "unknown gang spec key(s): "
+                                    f"{sorted(unknown)}")
+            group.gang = True
+            group.gang_size = size
+            group.gang_topology = topology
+            group.gang_policy = policy
         hp = gspec.get("host-placement") or gspec.get("host_placement")
         if hp:
             try:
@@ -715,8 +765,62 @@ class CookApi:
             if not guuid:
                 raise ApiError(400, "groups must carry a uuid so jobs can "
                                     "reference them")
-            groups.append(parse_group_spec(
-                gspec, [j.uuid for j in jobs if j.group == guuid]))
+            group = parse_group_spec(
+                gspec, [j.uuid for j in jobs if j.group == guuid])
+            if group.gang:
+                # a gang launches all-or-nothing, so its members must be
+                # co-submitted: exactly gang_size jobs in this batch, and
+                # never trickled onto an existing gang group
+                if len(group.jobs) != group.gang_size:
+                    raise ApiError(
+                        400, f"gang group {guuid} declares size "
+                             f"{group.gang_size} but the batch carries "
+                             f"{len(group.jobs)} member job(s); gang "
+                             "members must be submitted together")
+                # all members must resolve to ONE pool (per-spec pool
+                # overrides and the pool-selector plugin can split
+                # them): each pool's queue would hold a strict subset,
+                # so cohort admission defers the gang every cycle with
+                # a misleading members-missing diagnosis
+                member_pools = {j.pool for j in jobs
+                                if j.group == guuid}
+                if len(member_pools) > 1:
+                    raise ApiError(
+                        400, f"gang group {guuid} members resolve to "
+                             f"multiple pools {sorted(member_pools)}; "
+                             "a gang schedules within one pool")
+                # an idempotent retry resends the SAME batch after an
+                # indeterminate commit — the group legitimately exists
+                # and its member set MATCHES, so it passes this check on
+                # its own; the idempotent flag must not bypass it (a
+                # "retry" carrying novel members would merge into the
+                # group and grow the gang past gang_size)
+                existing_group = self.store.group(guuid)
+                if existing_group is not None and existing_group.jobs \
+                        and set(existing_group.jobs) != set(group.jobs):
+                    raise ApiError(
+                        400, f"group {guuid} already exists; gang "
+                             "members cannot be added incrementally")
+            groups.append(group)
+        # the no-incremental-members rule must also hold for jobs that
+        # reference a PRE-EXISTING gang group without a groups entry in
+        # this batch: such a job would skip every gang check above and
+        # ride the gang's cohort as a phantom extra member (counted by
+        # the reduction, invisible to the gang policy)
+        batch_guuids = {g.uuid for g in groups}
+        ref_cache: Dict[str, object] = {}
+        for job in jobs:
+            if not job.group or job.group in batch_guuids:
+                continue
+            if job.group not in ref_cache:
+                ref_cache[job.group] = self.store.group(job.group)
+            existing = ref_cache[job.group]
+            if existing is not None and existing.gang \
+                    and not (body.get("idempotent")
+                             and job.uuid in (existing.jobs or [])):
+                raise ApiError(
+                    400, f"group {job.group} is a gang; gang members "
+                         "cannot be added incrementally")
         all_uuids = [j.uuid for j in jobs]
 
         def _indeterminate(exc: Exception) -> ApiError:
@@ -782,13 +886,15 @@ class CookApi:
             # rest/api.clj:1391-1415 retrieve-jobs allow-partial-results)
             partial = first(params.get("partial"), "false") == "true"
             out = []
+            gang_cache: Dict[str, Dict] = {}
             for uuid in uuids:
                 job = self.store.job(uuid)
                 if job is None:
                     if partial:
                         continue
                     raise ApiError(404, f"no such job {uuid}")
-                out.append(job_to_json(self.store, job))
+                out.append(job_to_json(self.store, job,
+                                       gang_cache=gang_cache))
             if not out:
                 raise ApiError(404, f"no such jobs {uuids}")
             return out
@@ -797,7 +903,9 @@ class CookApi:
         jobs = self.store.jobs_where(
             lambda j: (user is None or j.user == user)
             and job_matches_states(self.store, j, states))
-        return [job_to_json(self.store, j, include_instances=False)
+        gang_cache: Dict[str, Dict] = {}
+        return [job_to_json(self.store, j, include_instances=False,
+                            gang_cache=gang_cache)
                 for j in jobs]
 
     def kill_jobs(self, params: Dict, user: str) -> Dict:
@@ -967,6 +1075,8 @@ class CookApi:
                                     "multiplier": group.straggler_multiplier}}
                     if group.straggler_quantile is not None
                     else {"type": "none", "parameters": {}})}
+            if group.gang:
+                entry["gang"] = gang_status(self.store, group)
             jobs = [j for j in (self.store.job(u) for u in group.jobs)
                     if j is not None]
             by_state = {"waiting": 0, "running": 0, "completed": 0}
@@ -974,8 +1084,10 @@ class CookApi:
                 by_state[job.state.value] += 1
             entry.update(by_state)
             if detailed:
+                gang_cache: Dict[str, Dict] = {}
                 entry["detailed"] = [
-                    job_to_json(self.store, j, include_instances=False)
+                    job_to_json(self.store, j, include_instances=False,
+                                gang_cache=gang_cache)
                     for j in jobs]
             out.append(entry)
         if not out:
@@ -1035,7 +1147,9 @@ class CookApi:
             and (name_rx is None or name_rx.match(j.name))
             and (pool is None or j.pool == pool))
         jobs.sort(key=lambda j: j.submit_time_ms, reverse=True)
-        return [job_to_json(self.store, j, include_instances=False)
+        gang_cache: Dict[str, Dict] = {}
+        return [job_to_json(self.store, j, include_instances=False,
+                            gang_cache=gang_cache)
                 for j in jobs[:limit]]
 
     def shutdown_leader(self, user: str) -> Dict:

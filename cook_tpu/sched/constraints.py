@@ -43,6 +43,27 @@ from ..state.schema import (
 
 LOCATION_ATTRIBUTE = "location"
 
+# Topology coordinates hosts advertise for gang scheduling (docs/GANG.md):
+# the slice a host belongs to and its position within it.  Gang groups
+# request co-location by naming an attribute — usually SLICE_ATTRIBUTE —
+# whose value must be equal across every member's host.
+SLICE_ATTRIBUTE = "slice-id"
+SLICE_POSITION_ATTRIBUTE = "slice-position"
+
+
+def member_slots(avail4: np.ndarray, need4: np.ndarray,
+                 cap: int) -> np.ndarray:
+    """How many copies of a gang member's demand each host can hold,
+    capped at ``cap`` (the gang size — more slots than members never
+    changes a decision).  avail4 is [H,4] available cpus/mem/gpus/disk,
+    need4 the member's [4] demand.  Zero-demand members fit everywhere
+    (cap slots per host)."""
+    pos = need4 > 0
+    if not pos.any():
+        return np.full(avail4.shape[0], cap, dtype=np.int64)
+    fit = np.floor(avail4[:, pos] / need4[pos]).min(axis=1)
+    return np.clip(fit, 0, cap).astype(np.int64)
+
 
 @dataclass
 class ConstraintContext:
@@ -165,6 +186,9 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
 
     # group UNIQUE running-cotask host indices, computed once per group
     unique_group_idx: Dict[str, np.ndarray] = {}
+    # gang group uuid -> member row indices (collected in the loop; the
+    # topology-contiguity restriction runs after it, see below)
+    gang_rows: Dict[str, List[int]] = {}
 
     for j, job in enumerate(jobs):
         row = mask[j]
@@ -218,6 +242,9 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
         # group placement vs RUNNING cotasks (within-batch handled post-match)
         if job.group is not None:
             group = ctx.groups.get(job.group)
+            if getattr(group, "gang", False) \
+                    and getattr(group, "gang_topology", None):
+                gang_rows.setdefault(job.group, []).append(j)
             ptype = getattr(group, "placement_type", None)
             if ptype is GroupPlacementType.UNIQUE:
                 idx = unique_group_idx.get(job.group)
@@ -270,6 +297,63 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
                             m = np.ones(H, dtype=bool)
                         eq_masks[key] = m
                     row &= m
+
+    # gang topology-contiguity preference (docs/GANG.md): each gang with
+    # a topology request is restricted to the topology domain (slice)
+    # that can absorb the most members, so the match kernel packs
+    # slice-local by construction — the gang reduction in ops/gang.py
+    # then only enforces the invariant instead of fighting scattered
+    # placements.  Domains are compared by member SLOT capacity, not
+    # host count: the matcher packs several members onto a wide host,
+    # so a 2-host slice of big machines may hold the whole gang while a
+    # 3-host slice of small ones cannot — an argmax on hosts would
+    # hard-pin the gang to the small slice every cycle and starve it.
+    # Score = (holds the whole gang?, remaining slot capacity, feasible
+    # host count); ties break on the lexicographically smallest value
+    # (deterministic).
+    # claimed[(attr, value)]: member slots earlier gangs in THIS batch
+    # were already steered into a domain — without it, every gang
+    # requesting the same attribute would pick the same argmax slice
+    # (identical scores, identical tie-break) and deadlock on it while
+    # other slices sit idle
+    claimed: Dict[tuple, int] = {}
+    if gang_rows:
+        avail4 = np.array([[o.available.cpus, o.available.mem,
+                            o.available.gpus, o.available.disk]
+                           for o in offers], dtype=np.float32)
+    for guuid, rows in gang_rows.items():
+        group = ctx.groups[guuid]
+        attr = group.gang_topology
+        col = attr_col(attr)
+        # size members by the elementwise-MAX demand across the gang and
+        # gate hosts on EVERY member's constraint row: conservative for
+        # heterogeneous gangs (may undercount capacity), but a domain
+        # scored "holds the whole gang" really does — sizing by one
+        # representative member would let a small member's demand pick a
+        # domain its bigger sibling can never fit, pinning the gang
+        # there every cycle
+        need = np.max(np.array(
+            [[jobs[j].resources.cpus, jobs[j].resources.mem,
+              jobs[j].resources.gpus, jobs[j].resources.disk]
+             for j in rows], dtype=np.float32), axis=0)
+        slots = member_slots(avail4, need, cap=len(rows))
+        feasible = np.logical_and.reduce(mask[rows], axis=0) & (slots > 0)
+        values = sorted({v for v in col.tolist() if v is not None})
+        best, best_score = None, None
+        for v in values:
+            dom = feasible & (col == v)
+            cap = int(slots[dom].sum()) - claimed.get((attr, v), 0)
+            score = (cap >= len(rows), cap, int(dom.sum()))
+            if best_score is None or score > best_score:
+                best, best_score = v, score
+        if best is None:
+            # no host advertises the requested attribute: the gang has
+            # no topology domain to land in at all
+            mask[rows] = False
+        else:
+            mask[rows] &= (col == best)[None, :]
+            claimed[(attr, best)] = claimed.get((attr, best), 0) \
+                + len(rows)
     return mask
 
 
@@ -412,6 +496,26 @@ def explain_placement_failure(job: Job, offers: List[Offer],
         group = ctx.groups.get(job.group)
         ptype = getattr(group, "placement_type", None)
         running = ctx.group_running_hosts.get(job.group, ())
+        if getattr(group, "gang", False) \
+                and getattr(group, "gang_topology", None):
+            # hosts outside every topology domain large enough for the
+            # whole gang ("no slice of size K satisfies the request") —
+            # sized in member SLOTS, matching the chooser: a slice of 2
+            # wide hosts that each fit 2 members DOES hold a gang of 3
+            attr = group.gang_topology
+            size = int(getattr(group, "gang_size", 0) or 0)
+            col = np.array([o.attributes.get(attr) for o in offers],
+                           dtype=object)
+            need4 = np.array([job.resources.cpus, job.resources.mem,
+                              job.resources.gpus, job.resources.disk],
+                             dtype=np.float32)
+            slots = member_slots(avail, need4, cap=max(size, 1))
+            ok_hosts = np.zeros(H, dtype=bool)
+            for v in {x for x in col.tolist() if x is not None}:
+                sel = col == v
+                if int(slots[sel].sum()) >= size:
+                    ok_hosts |= sel
+            count("gang_topology_constraint", ~ok_hosts)
         if ptype is GroupPlacementType.UNIQUE:
             count("unique_host_constraint",
                   np.array([h in set(running) for h in host_names]))

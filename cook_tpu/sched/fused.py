@@ -79,6 +79,11 @@ class _PackedPool:
         self.job_res = None
         self.cmask = None
         self.avail = None
+        # overdraft-adjusted availability (pipelined driver only): set by
+        # the reconciler when an overlapped cycle consumed capacity this
+        # pack's staged avail never saw; the gang rescue/refill places
+        # against it instead of pp.avail
+        self.avail_headroom: Optional[np.ndarray] = None  # f32[H, 4]
         self.capacity = None
         self.enqueue_ok = None
         self.launch_ok = None
@@ -518,12 +523,14 @@ class FusedCycleDriver:
         # Row ids are only valid within one index compaction epoch; on a
         # mismatch the mask is skipped and reconciliation catches the
         # conflicts instead (rare: compaction between two packs).
+        spec_masked = None
         if exclude is not None:
             kind, epoch, rows = exclude
             if kind == "rows" and epoch == snap.compactions and len(rows):
                 masked = pend & np.isin(rows_s, rows)
                 if masked.any():
                     launch_ok = launch_ok & ~masked
+                    spec_masked = masked
                     _flight.note_skips(
                         {"pipeline-speculative": int(masked.sum())})
         pp.launch_ok = launch_ok
@@ -544,6 +551,23 @@ class FusedCycleDriver:
         else:
             pp.tokens_u = np.full(max(len(users), 1), INF, dtype=F32)
 
+        # gang-cohort admission: every gang member is a complex row, so
+        # the materialized exception jobs carry the full cohorts
+        gang_members: Dict[str, List] = {}
+        if pp.ctx is not None and len(pp.exc_rows):
+            for i, job in zip(pp.exc_rows, cjobs):
+                if pend[i] and job.group is not None and getattr(
+                        pp.ctx.groups.get(job.group), "gang", False):
+                    gang_members.setdefault(job.group, []).append(
+                        (int(i), job))
+        tok_by_user = dict(zip(users, pp.tokens_u.tolist()))
+        self._gang_cohort_admission(
+            pool, pp.ctx.groups if pp.ctx is not None else {},
+            gang_members, launch_ok,
+            (lambda u: tok_by_user.get(u, 0.0))
+            if launch_rl.enforce else None,
+            spec_masked=spec_masked)
+
         # the admission bools + user-segment boundaries, packed into one
         # wire byte per task (user_rank/first_idx re-derive on device)
         from ..parallel.sharded import (
@@ -563,6 +587,80 @@ class FusedCycleDriver:
 
         self._pack_caps(pp, pool)
         return pp
+
+    def _gang_cohort_admission(self, pool: Pool, groups_ctx: Dict,
+                               members_by_gang: Dict,
+                               launch_ok: np.ndarray,
+                               net_tokens, spec_masked=None) -> None:
+        """Host-side gang-cohort admission for the fused pack paths
+        (mirrors Matcher.considerable_jobs, docs/GANG.md): a gang that
+        cannot clear this cycle's throttles WHOLE is withheld whole by
+        clearing its members' launch_ok bits.  The device admits rows
+        in rank order until tokens/caps run out, so a straddling cohort
+        would admit partial, match, and be reset by the reduction —
+        burning capacity every cycle when the budget can never cover
+        the gang, with a capacity-shaped explanation for what is a
+        rate-limit condition.  (Token/cap contention with earlier
+        singles can still split a cohort transiently on device; the
+        reduction drops it that cycle and the refilled budget admits it
+        whole later.)
+
+        ``members_by_gang``: group uuid -> [(task_row, job)] for the
+        pack's pending gang members; ``net_tokens``: user -> launch
+        tokens net of the pipeline's token_delta, or None when the
+        limiter is off."""
+        deferred_why: Dict[str, Dict] = {}
+        skipped = 0
+        if members_by_gang:
+            mc = self.config.matcher_for_pool(pool.name)
+            backoff = self.matcher._backoff.setdefault(
+                pool.name, _BackoffState(mc.max_jobs_considered))
+            nc = min(backoff.num_considerable, mc.max_jobs_considered)
+            for guuid, members in members_by_gang.items():
+                g = groups_ctx.get(guuid)
+                size = int(getattr(g, "gang_size", 0) or 0) \
+                    if getattr(g, "gang", False) else 0
+                if not size:
+                    continue
+                if len(members) < size:
+                    reason = "members-missing"
+                elif size > nc:
+                    reason = "considerable-cap"
+                elif not all(launch_ok[row] for row, _j in members):
+                    if spec_masked is not None and all(
+                            launch_ok[row] or spec_masked[row]
+                            for row, _j in members):
+                        # every withheld member is the pipeline's
+                        # speculative in-flight footprint: the gang is
+                        # mid-launch in the overlapped cycle, not
+                        # filter/quota-denied — withhold the rest whole
+                        # with no deferral reason (reconcile re-surfaces
+                        # the gang if the overlapped launch conflicts)
+                        extra = 0
+                        for row, _j in members:
+                            if launch_ok[row]:
+                                launch_ok[row] = False
+                                extra += 1
+                        if extra:
+                            _flight.note_skips(
+                                {"pipeline-speculative": extra})
+                        continue
+                    reason = "member-denied"
+                elif net_tokens is not None \
+                        and net_tokens(members[0][1].user) < size:
+                    reason = "rate-limited"
+                else:
+                    continue
+                for row, _job in members:
+                    if launch_ok[row]:
+                        launch_ok[row] = False
+                        skipped += 1
+                deferred_why[guuid] = {"size": size, "reason": reason}
+        # set every cycle, like considerable_jobs on the split path, so
+        # a gang that admitted this cycle sheds last cycle's reason
+        self.matcher.last_admission_deferred[pool.name] = deferred_why
+        if skipped:
+            _flight.note_skips({"gang-deferred": skipped})
 
     def _pack_caps(self, pp: _PackedPool, pool: Pool) -> None:
         """Backoff cap + pool/quota-group caps (shared by both pack paths)."""
@@ -665,14 +763,16 @@ class FusedCycleDriver:
             if pend_rows[i] and not self.plugins.launch_allowed(j):
                 launch_ok[i] = False
         # pipelined-driver speculation mask (entity-pack form: by uuid)
+        spec_masked = None
         if exclude is not None:
             kind, _epoch, uuids = exclude
             if kind == "uuids" and uuids:
-                masked = 0
+                spec_masked = np.zeros(T, dtype=bool)
                 for i, j in enumerate(jobs_in_rows):
                     if pend_rows[i] and launch_ok[i] and j.uuid in uuids:
                         launch_ok[i] = False
-                        masked += 1
+                        spec_masked[i] = True
+                masked = int(spec_masked.sum())
                 if masked:
                     _flight.note_skips({"pipeline-speculative": masked})
         pp.launch_ok = launch_ok
@@ -694,6 +794,21 @@ class FusedCycleDriver:
         else:
             tok = np.full(T, INF, dtype=F32)
         pp.tokens = tok
+
+        # gang-cohort admission (see the columnar pack / helper doc)
+        gang_members: Dict[str, List] = {}
+        if offers and pp.ctx is not None:
+            for i, job in zip(pend_idx, pend_jobs):
+                if job.group is not None and getattr(
+                        pp.ctx.groups.get(job.group), "gang", False):
+                    gang_members.setdefault(job.group, []).append(
+                        (int(i), job))
+        self._gang_cohort_admission(
+            pool, pp.ctx.groups if pp.ctx is not None else {},
+            gang_members, launch_ok,
+            (lambda u: user_tokens.get(u, 0.0))
+            if launch_rl.enforce else None,
+            spec_masked=spec_masked)
 
         self._pack_caps(pp, pool)
         return pp
@@ -1172,6 +1287,38 @@ class FusedCycleDriver:
                 return
         cand_host = validate_group_placement(
             cand_jobs, cand_host, pp.offers, pp.ctx)
+        # gang all-or-nothing over the fetched candidates (ops/gang.py,
+        # docs/GANG.md): partial gangs reset to unmatched with their
+        # capacity refilled to group-less candidates in the SAME cycle.
+        # Under the pipelined driver a reconcile-dropped member already
+        # left its gang incomplete, so a conflicted gang drops atomically
+        # here.  Structural no-op when no candidate is a gang member.
+        groups_ctx = pp.ctx.groups if pp.ctx is not None else {}
+        if any(j.group is not None
+               and getattr(groups_ctx.get(j.group), "gang", False)
+               for j in cand_jobs):
+            from ..ops.gang import apply_gang_cycle
+            H = len(pp.offers)
+            cand_res = np.array(
+                [[j.resources.cpus, j.resources.mem, j.resources.gpus,
+                  j.resources.disk] for j in cand_jobs], dtype=F32)
+            cand_host, gstats = apply_gang_cycle(
+                cand_jobs, cand_host, pp.offers, groups_ctx,
+                job_res=cand_res,
+                cmask_fn=lambda: build_constraint_mask(
+                    cand_jobs, pp.offers, pp.ctx),
+                # reconcile-adjusted availability when an overlapped
+                # cycle overdrafted the staged snapshot: the rescue and
+                # refill passes must not re-place onto a host the
+                # reconciler just protected
+                avail=(pp.avail_headroom if pp.avail_headroom is not None
+                       else pp.avail[:H]),
+                capacity=pp.capacity[:H],
+                device=False,
+                refill_ok=(~res_conflict if res_conflict is not None
+                           else None))
+            if gstats is not None:
+                result.gang_partial = gstats.partial
         if res_conflict is not None:
             # resource-conflicted candidates are a pipeline transient,
             # not a placement failure: keep them out of the unscheduled

@@ -53,6 +53,11 @@ class MatchCycleResult:
     # pool's pending queue (the fused driver prunes by exact queue position;
     # the scheduler's generic isin-based prune then skips the pool)
     queue_pruned: bool = False
+    # gang group uuid -> {"size", "matched", "missing",
+    # "topology_blocked"} for gangs that could not place whole this
+    # cycle (ops/gang.py; feeds the unscheduled explainer's
+    # "waiting on N gang members" reason, docs/GANG.md)
+    gang_partial: Dict[str, Dict] = field(default_factory=dict)
 
 
 class _BackoffState:
@@ -85,6 +90,11 @@ class Matcher:
         self.plugins = plugins or PluginRegistry()
         self.rate_limits = rate_limits or RateLimits()
         self._backoff: Dict[str, _BackoffState] = {}
+        # pool -> {group uuid -> {"size", "reason"}} for gangs deferred at
+        # ADMISSION (before any match ran): the unscheduled-jobs explainer
+        # reads this — such gangs never reach the match pass, so they have
+        # no gang_partial entry to explain them
+        self.last_admission_deferred: Dict[str, Dict[str, Dict]] = {}
 
     # ------------------------------------------------------------ selection
     def considerable_jobs(self, pool_name: str, ranked: List[Job],
@@ -104,9 +114,71 @@ class Matcher:
         out: List[Job] = []
         user_tokens: Dict[str, float] = {}
         user_seen: Dict[str, int] = {}
+        # gang-cohort admission (docs/GANG.md): an all-or-nothing gang
+        # whose members cannot ALL clear this cycle's throttles would
+        # otherwise admit a partial cohort every cycle — matched, then
+        # reset by the reduction, forever.  A gang's FIRST member decides
+        # for the whole cohort: enough rate-limit tokens for gang_size
+        # launches and enough room under the considerable cap, or every
+        # member waits this cycle (tokens refill; the cap resets).
+        gang_size_of: Dict[str, int] = {}
+        gang_deferred: set = set()
+        gang_reserved: set = set()
+        # outstanding considerable-cap slots held for admitted gangs whose
+        # later members have not been reached yet (group -> remaining);
+        # singles must not eat a sibling's slot mid-cohort
+        slots_reserved: Dict[str, int] = {}
+        # a gang whose full cohort is not even in this cycle's ranked
+        # queue (a member completed, or was ranked out) can never fully
+        # admit — defer it outright instead of reserving slots it will
+        # strand for the rest of the scan
+        ranked_members: Dict[str, int] = {}
+        for job in ranked:
+            if job.group is not None:
+                ranked_members[job.group] = \
+                    ranked_members.get(job.group, 0) + 1
         # head-of-line skip reasons for the cycle's flight record
         skips: Dict[str, int] = {}
+        # group uuid -> why the cohort was withheld, for the explainer
+        deferred_why: Dict[str, Dict] = {}
+
+        def _defer(group: str, reason: str) -> None:
+            gang_deferred.add(group)
+            deferred_why.setdefault(group, {
+                "size": gang_size_of.get(group, 0), "reason": reason})
+
+        def _sink_cohort(job, cohort: int, reason: str) -> None:
+            """A member denial sinks its whole cohort: defer the gang,
+            release its token/slot reservation (nothing from it launches,
+            so a later same-user single may use them), and strip
+            already-admitted siblings."""
+            _defer(job.group, reason)
+            slots_reserved.pop(job.group, None)
+            if launch_rl.enforce and job.group in gang_reserved:
+                user_seen[job.user] = max(
+                    user_seen.get(job.user, 0) - cohort, 0)
+            stripped = sum(1 for j in out if j.group == job.group)
+            if stripped:
+                out[:] = [j for j in out if j.group != job.group]
+                skips["gang-deferred"] = \
+                    skips.get("gang-deferred", 0) + stripped
+
         for job in ranked:
+            cohort = 1
+            if job.group is not None:
+                size = gang_size_of.get(job.group)
+                if size is None:
+                    size = self.store.gang_size(job.group)
+                    gang_size_of[job.group] = size
+                if size:
+                    if job.group not in gang_deferred \
+                            and ranked_members.get(job.group, 0) < size:
+                        _defer(job.group, "members-missing")
+                    if job.group in gang_deferred:
+                        skips["gang-deferred"] = \
+                            skips.get("gang-deferred", 0) + 1
+                        continue
+                    cohort = size
             quota = self.store.get_quota(job.user, pool_name)
             qvec = np.array([quota.get("cpus", np.inf), quota.get("mem", np.inf),
                              quota.get("gpus", np.inf), quota.get("count", np.inf)],
@@ -115,27 +187,98 @@ class Matcher:
             u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
             if not np.all(u <= qvec):
                 skips["over-quota"] = skips.get("over-quota", 0) + 1
+                if cohort > 1:
+                    _sink_cohort(job, cohort, "member-denied")
                 continue
-            # per-user-per-pool launch rate limit: each user passes at most
-            # token-count jobs per cycle (reference:
-            # filter-pending-jobs-for-ratelimit tools.clj:940-970)
-            if launch_rl.enforce:
-                tokens = user_tokens.setdefault(
-                    job.user,
-                    launch_rl.get_token_count(pool_user_key(pool_name, job.user)))
-                seen = user_seen.get(job.user, 0)
-                user_seen[job.user] = seen + 1
-                if seen >= int(tokens):  # a fractional token is not a launch
-                    skips["rate-limited"] = skips.get("rate-limited", 0) + 1
+            # gang cohort reservation: the FIRST member clears both the
+            # considerable cap and the per-user launch-rate tokens for the
+            # WHOLE cohort and reserves them (reference:
+            # filter-pending-jobs-for-ratelimit tools.clj:940-970, extended
+            # to cohorts); siblings ride the reservation with no per-member
+            # check.  A gang straddling either budget defers whole —
+            # admitting partial would match, then burn on the reduction
+            # every cycle.
+            if cohort > 1 and job.group not in gang_reserved:
+                if len(out) + sum(slots_reserved.values()) + cohort > limit:
+                    _defer(job.group, "considerable-cap")
+                    skips["gang-deferred"] = \
+                        skips.get("gang-deferred", 0) + 1
+                    continue
+                if launch_rl.enforce:
+                    tokens = user_tokens.setdefault(
+                        job.user,
+                        launch_rl.get_token_count(
+                            pool_user_key(pool_name, job.user)))
+                    seen = user_seen.get(job.user, 0)
+                    if seen + cohort > int(tokens):
+                        _defer(job.group, "rate-limited")
+                        skips["gang-deferred"] = \
+                            skips.get("gang-deferred", 0) + 1
+                        continue
+                    user_seen[job.user] = seen + cohort
+                gang_reserved.add(job.group)
+                slots_reserved[job.group] = cohort
+            elif cohort == 1:
+                # per-user-per-pool launch rate limit: each user passes at
+                # most token-count jobs per cycle; the accumulator includes
+                # skipped jobs
+                if launch_rl.enforce:
+                    tokens = user_tokens.setdefault(
+                        job.user,
+                        launch_rl.get_token_count(
+                            pool_user_key(pool_name, job.user)))
+                    seen = user_seen.get(job.user, 0)
+                    user_seen[job.user] = seen + 1
+                    if seen >= int(tokens):
+                        # a fractional token is not a launch
+                        skips["rate-limited"] = \
+                            skips.get("rate-limited", 0) + 1
+                        continue
+                # singles fill remaining slots but never the ones held
+                # for a reserved gang's unseen members
+                if slots_reserved and \
+                        len(out) + sum(slots_reserved.values()) >= limit:
+                    skips["cap-reserved"] = \
+                        skips.get("cap-reserved", 0) + 1
                     continue
             # launch-filter plugin with cached accept/defer verdicts
             if not self.plugins.launch_allowed(job):
                 skips["launch-filtered"] = \
                     skips.get("launch-filtered", 0) + 1
+                if cohort > 1:
+                    _sink_cohort(job, cohort, "member-denied")
                 continue
             out.append(job)
+            if cohort > 1:
+                rem = slots_reserved.get(job.group, 0) - 1
+                if rem > 0:
+                    slots_reserved[job.group] = rem
+                else:
+                    slots_reserved.pop(job.group, None)
             if len(out) >= limit:
                 break
+        # hard cohort guarantee: a gang that did not FULLY admit (a
+        # launch filter denied one member, or the cap's break landed
+        # mid-cohort behind same-rank fillers) is withheld whole — a
+        # partial cohort would match and then be reset by the reduction
+        # every cycle, burning capacity forever
+        if gang_size_of and any(gang_size_of.values()):
+            admitted: Dict[str, int] = {}
+            for j in out:
+                if j.group is not None and gang_size_of.get(j.group):
+                    admitted[j.group] = admitted.get(j.group, 0) + 1
+            short = {g for g, n in admitted.items()
+                     if n < gang_size_of[g]}
+            if short:
+                dropped = sum(admitted[g] for g in short)
+                out = [j for j in out if j.group not in short]
+                skips["gang-deferred"] = \
+                    skips.get("gang-deferred", 0) + dropped
+                for g in short:
+                    deferred_why.setdefault(g, {
+                        "size": gang_size_of.get(g, 0),
+                        "reason": "partial-admission"})
+        self.last_admission_deferred[pool_name] = deferred_why
         if skips:
             flight_recorder.note_skips(skips)
         return out
@@ -163,9 +306,13 @@ class Matcher:
                     # a launch cancelled before the backend ever saw it
                     # (crash-window refund, reconcile sweep) proves nothing
                     # about the host; novel-host-excluding it would livelock
-                    # single-host relaunches after a leader crash
-                    if inst.reason_code != \
-                            Reasons.CANCELLED_DURING_LAUNCH.code:
+                    # single-host relaunches after a leader crash.  Same
+                    # for a gang-policy sibling kill (gang-member-lost):
+                    # the host did nothing wrong and the gang NEEDS it to
+                    # relaunch whole (docs/GANG.md)
+                    if inst.reason_code not in (
+                            Reasons.CANCELLED_DURING_LAUNCH.code,
+                            Reasons.GANG_MEMBER_LOST.code):
                         failed.add(inst.hostname)
                     if (inst.reason_code == Reasons.NODE_LOST.code
                             and inst.end_time_ms and inst.start_time_ms):
@@ -272,6 +419,18 @@ class Matcher:
                           jobs=len(considerable), offers=len(offers)):
             assign = self._dispatch(mc, job_res, cmask, avail, cap)
             assign = validate_group_placement(considerable, assign, offers, ctx)
+            # gang all-or-nothing reduction + same-cycle refill of the
+            # freed capacity (structural no-op without gang members)
+            from ..ops.gang import apply_gang_cycle
+            assign, gstats = apply_gang_cycle(
+                considerable, assign, offers, ctx.groups,
+                job_res=np.asarray(job_res, dtype=F32),
+                cmask_fn=lambda: cmask,
+                avail=np.asarray(avail, dtype=F32),
+                capacity=np.asarray(cap, dtype=F32),
+                device=mc.backend != "cpu")
+            if gstats is not None:
+                result.gang_partial = gstats.partial
         self.record_placement_failures(considerable, assign, offers, ctx)
 
         # head-of-queue backoff bookkeeping
@@ -449,22 +608,67 @@ class Matcher:
         by_cluster: Dict[str, List[LaunchSpec]] = {}
         entries: List[Dict] = []
         by_task: Dict[str, Tuple[Job, Offer]] = {}
+        # gang cohorts launch atomically: every member clears the
+        # per-cluster rate limit together or the whole gang waits, and
+        # the entries carry the gang uuid so the guard transaction (and
+        # the crash-recovery intent sweep) treats them as one unit
+        gangs = self.store.gang_groups_of(j for j, _o in result.matched)
+        # units preserve match order: singles as-is, gang cohorts whole
+        units: List[List[Tuple[Job, Offer]]] = []
+        cohort_by_gang: Dict[str, List[Tuple[Job, Offer]]] = {}
         for job, offer in result.matched:
+            guuid = job.group if job.group in gangs else None
+            if guuid is None:
+                units.append([(job, offer)])
+            else:
+                cohort = cohort_by_gang.get(guuid)
+                if cohort is None:
+                    cohort = cohort_by_gang[guuid] = []
+                    units.append(cohort)
+                cohort.append((job, offer))
+        for unit in units:
             # per-compute-cluster launch rate limit (reference:
-            # filter-matches-for-ratelimit scheduler.clj:887)
+            # filter-matches-for-ratelimit scheduler.clj:887) — applied
+            # to the whole unit: a gang partially over the limit would
+            # otherwise launch partial
             if cluster_rl.enforce:
-                budget = cluster_budget.setdefault(
-                    offer.cluster, cluster_rl.get_token_count(offer.cluster))
-                if budget < 1:
-                    result.unmatched.append(job)
+                need: Dict[str, int] = {}
+                for _job, offer in unit:
+                    need[offer.cluster] = need.get(offer.cluster, 0) + 1
+                ok = True
+                for cname, n in need.items():
+                    budget = cluster_budget.setdefault(
+                        cname, cluster_rl.get_token_count(cname))
+                    if budget < n:
+                        ok = False
+                if not ok:
+                    result.unmatched.extend(job for job, _o in unit)
+                    guuid = unit[0][0].group \
+                        if unit[0][0].group in gangs else None
+                    if guuid:
+                        # surface the wait to the unscheduled explainer:
+                        # the gang MATCHED but the cluster launch budget
+                        # cannot cover the whole cohort yet (tokens
+                        # refill; permanent only if the bucket is
+                        # smaller than the gang)
+                        result.gang_partial.setdefault(guuid, {
+                            "size": len(unit), "matched": len(unit),
+                            "missing": 0, "topology_blocked": False,
+                            "rate_limited": True})
                     continue
-                cluster_budget[offer.cluster] = budget - 1
-            task_id = new_uuid()
-            entries.append(dict(
-                job_uuid=job.uuid, task_id=task_id, hostname=offer.hostname,
-                slave_id=offer.slave_id, compute_cluster=offer.cluster,
-                node_location=offer.attributes.get(LOCATION_ATTRIBUTE, "")))
-            by_task[task_id] = (job, offer)
+                for cname, n in need.items():
+                    cluster_budget[cname] -= n
+            guuid = unit[0][0].group if unit[0][0].group in gangs else None
+            for job, offer in unit:
+                task_id = new_uuid()
+                entries.append(dict(
+                    job_uuid=job.uuid, task_id=task_id,
+                    hostname=offer.hostname,
+                    slave_id=offer.slave_id, compute_cluster=offer.cluster,
+                    node_location=offer.attributes.get(
+                        LOCATION_ATTRIBUTE, ""),
+                    **({"gang": guuid} if guuid else {})))
+                by_task[task_id] = (job, offer)
         # ONE guard transaction for the whole cycle's launches (reference:
         # launch-matched-tasks! transacts all task txns at once,
         # scheduler.clj:810-1009); per-job guard failures are reported and
@@ -482,10 +686,20 @@ class Matcher:
                              buckets=LATENCY_BUCKETS)
             launch_rl.spend(pool_user_key(pool_name, job.user))
             cluster_rl.spend(offer.cluster)
+            env = job.env
+            guuid = job.group if job.group in gangs else None
+            if guuid:
+                # executors gate on the gang barrier via the task env
+                # (docs/GANG.md); the scheduler's barrier state is the
+                # authoritative mirror on /group
+                g = gangs.get(guuid)
+                env = {**env, "COOK_GANG_UUID": guuid,
+                       "COOK_GANG_SIZE":
+                           str(getattr(g, "gang_size", 0) or 0)}
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
                 task_id=inst.task_id, job_uuid=job.uuid,
                 hostname=offer.hostname, slave_id=offer.slave_id,
-                resources=job.resources, env=job.env, port_count=job.ports,
+                resources=job.resources, env=env, port_count=job.ports,
                 container=job.container))
             result.launched_task_ids.append(inst.task_id)
             result.launched_job_uuids.append(job.uuid)

@@ -236,7 +236,15 @@ class PipelinedCycleDriver:
     def _candidate_footprint(self, entry: _InFlight) -> None:
         """From the fetched outputs, the footprint the NEXT stage must
         speculate around: which queue rows/uuids are about to launch, and
-        how much of each host they will consume."""
+        how much of each host they will consume.
+
+        Gang candidates need care (docs/GANG.md): a PARTIAL gang among
+        the candidates will be reset by the all-or-nothing reduction at
+        apply — it launches nothing — so masking its members out of the
+        next stage would let the two in-flight cycles hold complementary
+        halves of the gang forever (each stage only ever sees the part
+        the other isn't holding: a permanent ping-pong livelock).  Only
+        COMPLETE gang cohorts enter the exclusion/consumption footprint."""
         for gd in entry.dispatches:
             cand_row, cand_assign, _qpos, _nq = gd.fetched
             for i, pp in enumerate(gd.sg.group):
@@ -252,6 +260,11 @@ class PipelinedCycleDriver:
                     continue
                 if pp.columnar:
                     rows = pp.rows_s[cand_row[i][sel]]
+                    uuids = [str(u) for u in pp.uuid_base[rows]]
+                    keep = self._whole_gang_mask(pp, uuids)
+                    sel, hosts, rows = sel[keep], hosts[keep], rows[keep]
+                    if not len(sel):
+                        continue
                     entry.exclude[pp.pool.name] = (
                         "rows", pp.base_compactions, rows)
                     res = np.concatenate(
@@ -261,6 +274,12 @@ class PipelinedCycleDriver:
                 else:
                     jobs = [pp.id2job[pp.task_ids[r]]
                             for r in cand_row[i][sel]]
+                    keep = self._whole_gang_mask(
+                        pp, [j.uuid for j in jobs])
+                    sel, hosts = sel[keep], hosts[keep]
+                    jobs = [j for j, k in zip(jobs, keep) if k]
+                    if not len(sel):
+                        continue
                     entry.exclude[pp.pool.name] = (
                         "uuids", -1, frozenset(j.uuid for j in jobs))
                     res = np.array(
@@ -277,6 +296,41 @@ class PipelinedCycleDriver:
                     cur = entry.consumed.get(key)
                     entry.consumed[key] = (res[j] if cur is None
                                            else cur + res[j])
+
+    def _whole_gang_mask(self, pp, uuids) -> np.ndarray:
+        """bool mask over assigned candidates keeping non-gang jobs and
+        COMPLETE gang cohorts; members of partial cohorts are dropped
+        from the speculation footprint (they cannot launch — the
+        reduction resets them at apply).  Membership is derived from the
+        pack context's gang groups (``Group.jobs`` — the REST layer
+        guarantees a gang's member set is exactly its co-submitted
+        jobs), so the mask never reads the store: a candidate batch with
+        zero gang members stays a structural no-op even while unrelated
+        gang groups sit waiting in the pool."""
+        n = len(uuids)
+        keep = np.ones(n, dtype=bool)
+        groups = getattr(pp.ctx, "groups", None) if pp.ctx else None
+        if not groups:
+            return keep
+        gang_of: Dict[str, str] = {}
+        for guuid, g in groups.items():
+            if getattr(g, "gang", False):
+                for member_uuid in getattr(g, "jobs", None) or ():
+                    gang_of[member_uuid] = guuid
+        if not gang_of:
+            return keep
+        counts: Dict[str, int] = {}
+        member_gang = [gang_of.get(u) for u in uuids]
+        for guuid in member_gang:
+            if guuid is not None:
+                counts[guuid] = counts.get(guuid, 0) + 1
+        partial = {guuid for guuid, c in counts.items()
+                   if c < int(getattr(groups[guuid], "gang_size", 0) or 0)}
+        if partial:
+            for i, guuid in enumerate(member_gang):
+                if guuid in partial:
+                    keep[i] = False
+        return keep
 
     # ----------------------------------------------------------------- apply
     def _apply(self, scheduler, entry: _InFlight
@@ -344,6 +398,11 @@ class PipelinedCycleDriver:
                 if hit:
                     headroom = np.maximum(
                         pp.avail[:H].astype(np.float64) - over, 0.0)
+                    # the gang pass's rescue/refill re-places against
+                    # availability too — hand it the same overdraft-
+                    # adjusted view or it can refill a host this very
+                    # reconcile just protected
+                    pp.avail_headroom = headroom.astype(F32)
                     used = np.zeros((H, 4), dtype=np.float64)
                     for i, job in enumerate(cand_jobs):
                         h = int(cand_host[i])

@@ -80,9 +80,27 @@ class _State:
         self.store = store
         # user -> tasks in comparator order (running only)
         self.user_tasks: Dict[str, List[_Task]] = {}
+        # gang bookkeeping (docs/GANG.md): task -> gang group uuid and
+        # gang -> member tasks, so victims are priced and expanded at
+        # whole-gang granularity — preemption must never strand a
+        # partial gang
+        self.gang_of_task: Dict[str, str] = {}
+        self.gang_tasks: Dict[str, List[str]] = {}
+        gang_groups: Dict[str, bool] = {}
         for job, inst in running:
             self.user_tasks.setdefault(job.user, []).append(
                 _Task(inst.task_id, job, inst))
+            if job.group is not None:
+                is_gang = gang_groups.get(job.group)
+                if is_gang is None:
+                    g = store.group(job.group)
+                    is_gang = bool(g is not None
+                                   and getattr(g, "gang", False))
+                    gang_groups[job.group] = is_gang
+                if is_gang:
+                    self.gang_of_task[inst.task_id] = job.group
+                    self.gang_tasks.setdefault(
+                        job.group, []).append(inst.task_id)
         for user, tasks in self.user_tasks.items():
             tasks.sort(key=lambda t: _job_feature_key(t.job, t.inst))
         self.shares: Dict[str, Tuple[float, float, float]] = {}
@@ -159,10 +177,16 @@ class _State:
         lst.sort(key=lambda t: _job_feature_key(t.job, t.inst))
         for user in changed:
             self._recompute_user(user)
-        freed = self.spare.get(hostname, Resources())
+        # each victim's capacity frees on ITS OWN host (identical to the
+        # old single-host sum when all victims share the target host —
+        # always true for non-gang decisions — but a whole-gang closure
+        # spans hosts and must not credit them all to the target)
         for v in victims:
-            freed = freed + v.job.resources
-        self.spare[hostname] = freed - job.resources
+            self.spare[v.inst.hostname] = \
+                self.spare.get(v.inst.hostname, Resources()) \
+                + v.job.resources
+        self.spare[hostname] = \
+            self.spare.get(hostname, Resources()) - job.resources
 
 
 class Rebalancer:
@@ -202,6 +226,7 @@ class Rebalancer:
 
         decisions: List[PreemptionDecision] = []
         budget = params.max_preemption
+        task_by_id = {t.task_id: t for t in state.all_tasks()}
         for job in pending_ranked:
             if budget <= 0:
                 break
@@ -210,6 +235,22 @@ class Rebalancer:
                 continue
             victims = decision[1]
             hostname = decision[0]
+            # whole-gang closure (docs/GANG.md): preempting any member
+            # kills its entire gang — across hosts — so the decision can
+            # never strand a partial gang holding fragmented capacity
+            if victims and state.gang_of_task:
+                seen = {v.task_id for v in victims}
+                for v in list(victims):
+                    g = state.gang_of_task.get(v.task_id)
+                    if g is None:
+                        continue
+                    for tid in state.gang_tasks.get(g, ()):
+                        if tid in seen or tid in state.preempted_ids:
+                            continue
+                        mate = task_by_id.get(tid)
+                        if mate is not None:
+                            victims.append(mate)
+                            seen.add(tid)
             state.apply_decision(job, hostname, victims)
             decisions.append(PreemptionDecision(
                 job_uuid=job.uuid, hostname=hostname,
@@ -228,6 +269,21 @@ class Rebalancer:
         job_ok_quota = state.job_below_quota(job)
 
         tasks = state.all_tasks()
+        # whole-gang pricing (docs/GANG.md): preempting any member kills
+        # the whole gang, so a member's effective DRU for eligibility,
+        # scan order, and the decision score is its gang's MINIMUM — the
+        # gang is never cheaper than its most-protected member
+        gang_min: Dict[str, float] = {}
+        if state.gang_of_task:  # gang-free clusters skip the O(tasks) pass
+            for t in tasks:
+                g = state.gang_of_task.get(t.task_id)
+                if g is not None:
+                    cur = gang_min.get(g)
+                    gang_min[g] = t.dru if cur is None else min(cur, t.dru)
+
+        def edru(t: "_Task") -> float:
+            g = state.gang_of_task.get(t.task_id)
+            return gang_min[g] if g is not None else t.dru
         # only hosts with a backend inventory entry are preemption targets:
         # a host known solely from a running task has no attribute/capacity
         # facts, so constraint evaluation there would be guesswork
@@ -244,9 +300,10 @@ class Rebalancer:
                 return False  # no backend inventory for this host
             if not (job_ok_quota or t.job.user == job.user):
                 return False
-            if t.dru < params.safe_dru_threshold:
+            d = edru(t)
+            if d < params.safe_dru_threshold:
                 return False
-            return (t.dru - pending_dru) > params.min_dru_diff
+            return (d - pending_dru) > params.min_dru_diff
 
         # host constraint check with the match-side compiler
         offers = [offers_by_host[h] for h in hostnames]
@@ -256,7 +313,7 @@ class Rebalancer:
 
         order = sorted(range(len(tasks)),
                        key=lambda i: (host_index.get(tasks[i].inst.hostname, 0),
-                                      -tasks[i].dru, i))
+                                      -edru(tasks[i]), i))
         demand = np.array([job.resources.cpus, job.resources.mem,
                            job.resources.gpus, 0.0], dtype=F32)
         spare_arr = np.zeros((len(hostnames), 4), dtype=F32)
@@ -265,7 +322,7 @@ class Rebalancer:
             spare_arr[h] = [s.cpus, s.mem, s.gpus, 0.0]
 
         # gpu feasibility only matters when requested; padding col 3 unused
-        task_dru = np.array([tasks[i].dru for i in order], dtype=F32)
+        task_dru = np.array([edru(tasks[i]) for i in order], dtype=F32)
         task_res = np.array(
             [[tasks[i].job.resources.cpus, tasks[i].job.resources.mem,
               tasks[i].job.resources.gpus, 0.0] for i in order], dtype=F32) \

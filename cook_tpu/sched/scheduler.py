@@ -22,7 +22,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..cluster.base import ComputeCluster, LaunchSpec
 from ..config import Config
@@ -117,6 +117,20 @@ class Scheduler:
         self._gc_collect_due = False
         # task_id -> first-seen-orphaned ms (reaper grace bookkeeping)
         self._orphan_first_seen: Dict[str, int] = {}
+        # gang scheduling bookkeeping (docs/GANG.md): task -> gang group
+        # uuid (populated from the launch event's gang tag, so non-gang
+        # traffic pays nothing), and per-gang barrier state — a gang's
+        # barrier "releases" when every member is RUNNING; the wait is
+        # observed on cook_gang_barrier_wait_ms
+        self._gang_of_task: Dict[str, str] = {}
+        self._gang_barrier: Dict[str, Dict] = {}
+        # groups whose gang policy is mid-reaction: our own kill_job
+        # calls commit synchronously and re-enter _on_tx_events, which
+        # must not count (or act) as a fresh policy reaction
+        self._gang_policy_active: set = set()
+        # backend class -> whether autoscale() takes a gangs= kwarg; the
+        # backend set is fixed at construction, so probe each class once
+        self._autoscale_takes_gangs: Dict[type, bool] = {}
         # Side-effect worker: cluster kills requested from a thread that
         # already holds that cluster's kill-lock read side (e.g. a tx-event
         # delivered during a launch) must run elsewhere or they self-deadlock.
@@ -137,8 +151,17 @@ class Scheduler:
             store.put_pool(Pool(name=self.config.default_pool))
         # Resume path: instances already live in a reopened store predate
         # this scheduler's tx subscription, so watch them now.
-        for _job, inst in store.running_instances():
+        running = store.running_instances()
+        gangs = store.gang_groups_of(j for j, _i in running)
+        for _job, inst in running:
             self.heartbeats.watch(inst.task_id, self.clock())
+            # re-learn gang membership so barrier release + gang policy
+            # keep working across a leader handoff
+            if _job.group in gangs:
+                self._gang_of_task[inst.task_id] = _job.group
+                self._gang_barrier.setdefault(
+                    _job.group, {"first_live_ms": self.clock(),
+                                 "released": False})
         # Crash-consistency: sweep launch intents the previous leader left
         # open (died between match and launch-ack) against actual cluster
         # state — refund or adopt, never duplicate, never lose.
@@ -182,9 +205,18 @@ class Scheduler:
         - cluster cannot enumerate its tasks -> leave the verdict to that
           backend's own reconciliation (remote NODE_LOSTs unknown tasks on
           reconnect) and drop the intent.
+
+        Gang intents (tagged with their gang group uuid) are swept as
+        ONE unit: any member refunded refunds every member still in the
+        crash window — the sweep rolls back or adopts whole gangs, never
+        leaving a partial gang live (docs/GANG.md).  Members already
+        past the window are cleaned up by the gang policy reacting to
+        the refunds' failure events.
         """
         swept = 0
         to_clear: List[str] = []
+        # verdict pass: (task_id, refund?, gang uuid, cluster known task?)
+        verdicts: List[Tuple[str, bool, str, Optional[bool]]] = []
         for intent in self.store.launch_intents():
             task_id = intent["task_id"]
             inst = self.store.instance(task_id)
@@ -203,17 +235,31 @@ class Scheduler:
                                  if ids is not None else None)
                     except Exception:
                         known = None
-                if known is False or cluster is None:
-                    # the refund's status update deletes the intent in
-                    # its own transaction; no separate clear needed
-                    self.store.update_instance_status(
-                        task_id, InstanceStatus.FAILED,
-                        reason_code=Reasons.CANCELLED_DURING_LAUNCH.code)
-                else:
-                    to_clear.append(task_id)
+                verdicts.append((task_id, known is False or cluster is None,
+                                 intent.get("gang", ""), known))
             else:
                 to_clear.append(task_id)
             swept += 1
+        refund_gangs = {g for _t, refund, g, _k in verdicts if g and refund}
+        for task_id, refund, gang, known in verdicts:
+            if refund or gang in refund_gangs:
+                # the refund's status update deletes the intent in
+                # its own transaction; no separate clear needed
+                self.store.update_instance_status(
+                    task_id, InstanceStatus.FAILED,
+                    reason_code=Reasons.CANCELLED_DURING_LAUNCH.code)
+                if not refund:
+                    # a gang-mate dragged down by a refunded sibling:
+                    # the backend may know it (known True) or be unable
+                    # to say (known None — an unreachable agent could
+                    # still be running it); either way issue the
+                    # idempotent backend kill so no zombie double-runs
+                    # the work when the gang relaunches
+                    inst = self.store.instance(task_id)
+                    if inst is not None:
+                        self._cluster_kill(inst.compute_cluster, task_id)
+            else:
+                to_clear.append(task_id)
         # ONE transaction for every adopt/drop (a crash can leave
         # hundreds of intents; per-intent journaled txns would serialize
         # the new leader's startup)
@@ -285,6 +331,20 @@ class Scheduler:
                         tid, InstanceStatus.FAILED,
                         reason_code=Reasons.KILLED_BY_USER.code)
                     self._cluster_kill(inst.compute_cluster, tid)
+                # a gang member that went terminal WITHOUT ever succeeding
+                # (user kill while WAITING, say) breaks its gang for good.
+                # Instance-failure events cover members that had
+                # instances, but a WAITING kill emits none — take the
+                # rest of the gang down here or the siblings would sit
+                # gang-deferred forever.  (Members that completed after a
+                # SUCCESS are normal staggered finishes, not a break; a
+                # redundant call is a no-op once every member is
+                # terminal.)
+                if self.store.group_is_gang(job.group) and not any(
+                        (i := self.store.instance(t)) is not None
+                        and i.status is InstanceStatus.SUCCESS
+                        for t in job.instances):
+                    self._apply_gang_policy(job, None)
             if e.kind == "job-state" and e.data.get("new") in (
                     "running", "completed"):
                 # consume rebalancer reservations once the job launches —
@@ -293,14 +353,159 @@ class Scheduler:
             if e.kind == "instance-created":
                 # start the heartbeat clock at launch (heartbeat.clj:92)
                 self.heartbeats.watch(e.data["task_id"], self.clock())
+                # gang bookkeeping rides the event's gang tag so the
+                # non-gang launch path fetches nothing extra
+                guuid = e.data.get("gang")
+                if guuid:
+                    self._gang_of_task[e.data["task_id"]] = guuid
+                    self._gang_barrier.setdefault(
+                        guuid, {"first_live_ms": self.clock(),
+                                "released": False})
+            if e.kind == "instance-status" and e.data.get("new") == "running":
+                guuid = self._gang_of_task.get(e.data["task_id"])
+                if guuid:
+                    self._maybe_release_gang_barrier(guuid)
             if e.kind == "instance-status" and e.data.get("new") in (
                     "success", "failed"):
                 self.heartbeats.forget(e.data["task_id"])
+                self._gang_of_task.pop(e.data["task_id"], None)
                 # InstanceCompletionHandler plugins (plugins/definitions.clj)
                 inst = self.store.instance(e.data["task_id"])
                 job = self.store.job(e.data["job"]) if inst else None
                 if inst is not None and job is not None:
                     self.plugins.on_instance_completion(job, inst)
+                if (e.data.get("new") == "failed" and job is not None
+                        and self.store.group_is_gang(job.group)):
+                    self._apply_gang_policy(job, e.data.get("reason"))
+                if (job is not None and job.group is not None
+                        and job.group in self._gang_barrier
+                        and job.state is JobState.COMPLETED):
+                    # retire the barrier entry once the whole gang is
+                    # terminal — it would otherwise leak one dict entry
+                    # per finished gang for the leader's lifetime
+                    group = self.store.group(job.group)
+                    if group is not None and all(
+                            (m := self.store.job(u)) is None
+                            or m.state is JobState.COMPLETED
+                            for u in group.jobs):
+                        self._gang_barrier.pop(job.group, None)
+
+    # ------------------------------------------------------------------ gangs
+    def _apply_gang_policy(self, failed_job: Job,
+                           reason_code: Optional[int]) -> None:
+        """A gang member's instance failed: run the configured gang
+        policy (state/machines.gang_failure_action, docs/GANG.md).
+        ``requeue`` (default) kills every sibling's live instances with
+        the mea-culpa ``gang-member-lost`` reason so the WHOLE gang
+        returns to WAITING and relaunches atomically; ``kill`` — or a
+        member whose job went terminal — takes the whole gang down."""
+        from ..state import machines
+        group = self.store.group(failed_job.group)
+        action = machines.gang_failure_action(group, reason_code,
+                                              failed_job.state)
+        if action == "none":
+            return
+        if action == "requeue" and any(
+                u != failed_job.uuid
+                and (m := self.store.job(u)) is not None
+                and m.state is JobState.COMPLETED
+                for u in group.jobs):
+            # a sibling that already finished (a short member exiting
+            # SUCCESS mid-gang is a normal staggered finish) can never
+            # run again, so the gang can never re-admit whole —
+            # requeueing would strand the live members in WAITING
+            # forever behind a members-missing deferral
+            action = "kill"
+        if group.uuid in self._gang_policy_active:
+            return
+        self._gang_policy_active.add(group.uuid)
+        try:
+            self._run_gang_policy(group, action, failed_job)
+        finally:
+            self._gang_policy_active.discard(group.uuid)
+
+    def _run_gang_policy(self, group, action: str, failed_job: Job) -> None:
+        # collect what there actually is to do FIRST: a whole-gang
+        # failure (e.g. rebalancer preemption of the full closure)
+        # delivers one failure event per member, and only the first
+        # should count as a policy reaction — the rest find nothing
+        # left to kill and must not inflate the metric or re-loop
+        if action == "kill":
+            targets = [u for u in group.jobs
+                       if (m := self.store.job(u)) is not None
+                       and m.state is not JobState.COMPLETED]
+            if not targets:
+                return
+            self._gang_barrier.pop(group.uuid, None)
+            from ..utils.metrics import registry
+            registry.counter_inc("cook_gang_policy_kills",
+                                 labels={"action": action})
+            for member_uuid in targets:
+                try:
+                    self.store.kill_job(member_uuid)
+                except Exception:  # pragma: no cover - converges next sweep
+                    pass
+            return
+        live: List[Tuple[str, str]] = []  # (task_id, cluster)
+        for member_uuid in group.jobs:
+            if member_uuid == failed_job.uuid:
+                continue
+            member = self.store.job(member_uuid)
+            if member is None:
+                continue
+            for tid in member.instances:
+                mi = self.store.instance(tid)
+                if mi is not None and mi.status in (
+                        InstanceStatus.UNKNOWN, InstanceStatus.RUNNING):
+                    live.append((tid, mi.compute_cluster))
+        if not live:
+            return
+        self._gang_barrier.pop(group.uuid, None)  # barrier re-arms
+        from ..utils.metrics import registry
+        registry.counter_inc("cook_gang_policy_kills",
+                             labels={"action": action})
+        for tid, cluster_name in live:
+            # authoritative store transition first (single-writer
+            # discipline, like _kill_instance), then the backend kill
+            self.store.update_instance_status(
+                tid, InstanceStatus.FAILED,
+                reason_code=Reasons.GANG_MEMBER_LOST.code)
+            self._cluster_kill(cluster_name, tid)
+
+    def _maybe_release_gang_barrier(self, guuid: str) -> None:
+        """Release the gang's barrier once EVERY member has STARTED —
+        currently RUNNING, or already finished a run (a short member can
+        exit SUCCESS before the last member comes up; requiring all
+        members to be simultaneously RUNNING would then block release
+        forever).  The wait (first launch -> all started) is observed on
+        ``cook_gang_barrier_wait_ms``."""
+        st = self._gang_barrier.get(guuid)
+        if st is None or st.get("released"):
+            return
+        group = self.store.group(guuid)
+        if group is None:
+            return
+        for member_uuid in group.jobs:
+            member = self.store.job(member_uuid)
+            if member is None:
+                return
+            started = any(
+                (mi := self.store.instance(tid)) is not None
+                and (mi.status is InstanceStatus.RUNNING
+                     or (member.state is JobState.COMPLETED
+                         and (mi.status is InstanceStatus.SUCCESS
+                              or mi.mesos_start_time_ms)))
+                for tid in member.instances)
+            if not started:
+                return
+        st["released"] = True
+        st["released_ms"] = self.clock()
+        from ..utils.metrics import registry
+        registry.observe(
+            "cook_gang_barrier_wait_ms",
+            float(max(self.clock() - st["first_live_ms"], 0)),
+            buckets=(1.0, 10.0, 100.0, 1000.0, 10_000.0, 60_000.0,
+                     600_000.0))
 
     # ---------------------------------------------------------------- cycles
     def step_rank(self) -> Dict[str, List[Job]]:
@@ -552,18 +757,88 @@ class Scheduler:
     def _autoscale(self, pool_name: str, result: MatchCycleResult) -> None:
         """Post-match autoscaling: surface unmatched demand as synthetic
         pods, reap placeholders for jobs that launched (reference:
-        trigger-autoscaling! scheduler.clj:1178-1283)."""
+        trigger-autoscaling! scheduler.clj:1178-1283).
+
+        The demand is routed to ONE healthy (circuit-breaker-aware)
+        autoscaling cluster: fanning it out verbatim to every accepting
+        cluster double-provisioned — two clusters would both synthesize
+        full-size placeholder pod sets for the same unmatched jobs.
+        Placeholders are still reaped on EVERY cluster (the routing
+        choice may move between cycles).  Gang demand is sized as
+        whole-slice synthetic pod sets with co-location affinity
+        (docs/GANG.md)."""
         if not self.config.autoscaling_enabled:
             return
         launched_jobs = list(result.launched_job_uuids)
-        for cluster in list(self.clusters.values()):
-            autoscale = getattr(cluster, "autoscale", None)
-            if autoscale is None or not cluster.accepts_pool(pool_name):
-                continue
-            if result.unmatched:
-                autoscale(pool_name, result.unmatched, now_ms=now_ms())
-            if launched_jobs:
+        scalers = [c for c in self.clusters.values()
+                   if getattr(c, "autoscale", None) is not None
+                   and c.accepts_pool(pool_name)]
+        if launched_jobs:
+            for cluster in scalers:
                 cluster.reap_synthetic_pods(launched_jobs)
+        if not result.unmatched:
+            return
+        healthy = [c for c in scalers if self.breakers.get(c.name).allow()]
+        if not healthy:
+            return
+        gangs: Dict[str, Dict] = {
+            g.uuid: {"size": g.gang_size, "topology": g.gang_topology}
+            for g in self.store.gang_groups_of(result.unmatched).values()}
+        # deterministic routing: first healthy cluster in registration
+        # order that can actually absorb the demand (a stable choice
+        # keeps placeholder ownership from flapping).  A scaler at its
+        # pod cap creates nothing WITHOUT raising, so its breaker never
+        # opens — fall through to the next healthy scaler, but only
+        # with the jobs the target does NOT already hold placeholders
+        # for (re-surfacing covered jobs elsewhere would recreate the
+        # double-provisioning this routing exists to prevent)
+        remaining = list(result.unmatched)
+        for target in healthy:
+            # signature-probe once per backend class (catching TypeError
+            # around the executed call would mask TypeErrors raised
+            # INSIDE the backend and silently re-run it without gang
+            # sizing)
+            takes_gangs = self._autoscale_takes_gangs.get(type(target))
+            if takes_gangs is None:
+                import inspect
+                try:
+                    takes_gangs = "gangs" in inspect.signature(
+                        target.autoscale).parameters
+                except (TypeError, ValueError):
+                    takes_gangs = False
+                self._autoscale_takes_gangs[type(target)] = takes_gangs
+            if takes_gangs:
+                created = target.autoscale(pool_name, remaining,
+                                           now_ms=now_ms(),
+                                           gangs=gangs or None)
+            else:
+                created = target.autoscale(pool_name, remaining,
+                                           now_ms=now_ms())
+            if created:
+                # budget permitting, autoscale covers every missing unit
+                # it was handed; anything cut at the pod cap is caught
+                # next cycle, when created drops to 0 and the coverage
+                # probe routes the uncovered rest onward
+                return
+            probe = getattr(target, "synthetic_pods_for", None)
+            if probe is None:
+                # backend can't report placeholder ownership — assume
+                # it absorbed the demand rather than fan out
+                return
+            covered = set(probe([j.uuid for j in remaining]))
+            # a gang partially covered here (members reaped while the
+            # cluster sits at its pod budget) stays routed here WHOLE:
+            # forwarding just the uncovered members would have the next
+            # cluster synthesize a partial gang pod set — the split-slice
+            # provisioning the all-or-none pod-set logic exists to avoid
+            held = {j.group for j in remaining
+                    if j.group in gangs and j.uuid in covered}
+            remaining = [j for j in remaining
+                         if j.uuid not in covered and j.group not in held]
+            if not remaining:
+                return
+            # at the pod cap with uncovered demand: fall through with
+            # only the uncovered jobs
 
     def _match_direct(self, pool_name: str, ranked: List[Job]
                       ) -> MatchCycleResult:
@@ -593,7 +868,14 @@ class Scheduler:
         cluster_budget = {c.name: cluster_rl.get_token_count(c.name)
                           for c in clusters} if cluster_rl.enforce else None
         i = 0
+        gangs = self.store.gang_groups_of(considerable)
         for job in considerable:
+            # direct (backend-places) mode has no all-or-nothing match
+            # pass, so a gang member submitted here could come up partial
+            # — gangs are BATCH-pool-only (docs/GANG.md) and wait instead
+            if job.group in gangs:
+                result.unmatched.append(job)
+                continue
             cluster = clusters[i % len(clusters)]
             i += 1
             if cluster_budget is not None:
@@ -807,9 +1089,29 @@ class Scheduler:
                 except Exception:  # pragma: no cover
                     import logging
                     logging.getLogger(__name__).exception("deferred kill failed")
+                finally:
+                    self._side_effects.task_done()
 
         self._side_effect_thread = threading.Thread(target=worker, daemon=True)
         self._side_effect_thread.start()
+
+    def drain_side_effects(self, timeout_s: float = 5.0) -> bool:
+        """Block until every queued deferred backend kill has been
+        processed — determinism hook for tests and the chaos simulator
+        (gang-policy sibling kills defer when the triggering event fires
+        under a cluster's kill-lock read side).  Returns False on
+        timeout."""
+        if self._side_effect_thread is None:
+            return True
+        q = self._side_effects
+        deadline = time.time() + timeout_s
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                q.all_tasks_done.wait(remaining)
+        return True
 
     # ------------------------------------------------------------- wall clock
     def run(self) -> None:

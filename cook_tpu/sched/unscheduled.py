@@ -49,6 +49,9 @@ CONSTRAINT_MESSAGES = {
     "max_tasks_per_host_constraint": "Host is at its task-count limit.",
     "disk_type_constraint": "Host has a different disk type.",
     "gpu_model_constraint": "Host has a different GPU model.",
+    "gang_topology_constraint":
+        "Host is outside every topology domain (slice) large enough "
+        "for the whole gang.",
 }
 
 
@@ -187,9 +190,70 @@ def job_reasons(store: Store, job: Job,
                           "launch.",
                 "data": {"plugins": [type(f).__name__
                                      for f in plugins.launch_filters]}})
+        # gang reasons (docs/GANG.md): all-or-nothing placement means a
+        # member can be individually placeable yet waiting on its gang
+        last = getattr(scheduler, "last_match_results", {}).get(job.pool)
+        if job.group is not None:
+            group = store.group(job.group)
+            if group is not None and getattr(group, "gang", False):
+                gp = (getattr(last, "gang_partial", None) or {}).get(
+                    job.group) if last is not None else None
+                if gp and gp.get("rate_limited"):
+                    reasons.append({
+                        "reason": "The gang matched but is waiting for "
+                                  "enough cluster launch-rate budget to "
+                                  "launch all members together.",
+                        "data": dict(gp)})
+                elif gp and gp.get("topology_blocked"):
+                    reasons.append({
+                        "reason": "No slice of size "
+                                  f"{gp['size']} satisfies the gang's "
+                                  "topology request "
+                                  f"({group.gang_topology}).",
+                        "data": dict(gp)})
+                elif gp:
+                    reasons.append({
+                        "reason": f"Waiting on {gp['missing']} of "
+                                  f"{gp['size']} gang members to be "
+                                  "placeable in the same cycle.",
+                        "data": dict(gp)})
+                else:
+                    # deferred at ADMISSION (tokens/cap/denied member):
+                    # the gang never reached the match pass, so there is
+                    # no gang_partial entry to explain it
+                    matcher = getattr(scheduler, "matcher", None)
+                    adm = (getattr(matcher, "last_admission_deferred", {})
+                           .get(job.pool, {}).get(job.group)
+                           if matcher is not None else None)
+                    if adm:
+                        texts = {
+                            "rate-limited":
+                                "The gang is waiting for enough "
+                                "launch-rate tokens to admit all "
+                                f"{adm['size']} members together.",
+                            "considerable-cap":
+                                "The gang is waiting for enough room in "
+                                "the scheduling cycle to consider all "
+                                f"{adm['size']} members together.",
+                            "members-missing":
+                                "A gang member is no longer in the "
+                                "pending queue, so the gang cannot be "
+                                "admitted whole.",
+                            "member-denied":
+                                "A gang member is blocked from launching "
+                                "(launch filter or quota), holding the "
+                                "whole gang.",
+                            "partial-admission":
+                                "The gang could not be admitted whole "
+                                "this cycle.",
+                        }
+                        reasons.append({
+                            "reason": texts.get(
+                                adm["reason"],
+                                "The gang was deferred at admission."),
+                            "data": dict(adm)})
         # placement failure: the two-step under-investigation workflow
         # (reference: check-fenzo-placement unscheduled.clj)
-        last = getattr(scheduler, "last_match_results", {}).get(job.pool)
         unmatched_last_cycle = last is not None and any(
             j.uuid == job.uuid for j in last.unmatched)
         if job.last_placement_failure:

@@ -55,6 +55,12 @@ def main(argv=None) -> int:
                         "cycle at this depth instead of the split host "
                         "path (duplicate-live invariant under overlapped "
                         "optimistic dispatches)")
+    p.add_argument("--gangs", type=int, default=None,
+                   help="chaos: ride N all-or-nothing gang groups on the "
+                        "trace and assert the zero-partial-gangs "
+                        "invariant every tick (docs/GANG.md)")
+    p.add_argument("--gang-size", type=int, default=None,
+                   help="chaos: members per gang (default 3)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -90,6 +96,10 @@ def main(argv=None) -> int:
                                     else args.leader_kill_at_ms)
         if args.pipeline_depth is not None:
             cc.pipeline_depth = args.pipeline_depth
+        if args.gangs is not None:
+            cc.n_gangs = args.gangs
+        if args.gang_size is not None:
+            cc.gang_size = args.gang_size
         result = run_chaos(cc)
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1

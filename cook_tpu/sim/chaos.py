@@ -91,12 +91,26 @@ class ChaosConfig:
     # host path — the no-duplicate-live-instances invariant is checked
     # every tick against the overlapped optimistic dispatches
     pipeline_depth: int = 0
+    # gang chaos (docs/GANG.md): n_gangs all-or-nothing groups of
+    # gang_size members ride the trace; hosts get slice-id topology
+    # attributes in gang_size-sized slices, and the zero-partial-gangs
+    # invariant is checked every tick — node loss, launch-RPC faults,
+    # and a leader kill landing mid-gang-launch must all leave either a
+    # whole gang or no gang, never a partial one
+    n_gangs: int = 0
+    gang_size: int = 3
+    gang_topology: bool = True
+    # one gang is timed to submit just before the leader kill so the
+    # crash window reliably lands inside a gang launch
+    gang_at_kill: bool = True
 
 
 @dataclass
 class ChaosResult:
     total: int = 0
     completed: int = 0
+    gangs: int = 0
+    gang_requeues: int = 0
     violations: List[str] = field(default_factory=list)
     node_losses: int = 0
     rpc_faults: int = 0
@@ -117,6 +131,8 @@ class ChaosResult:
             "ok": self.ok,
             "jobs_total": self.total,
             "jobs_completed": self.completed,
+            "gangs": self.gangs,
+            "gang_requeues": self.gang_requeues,
             "violations": list(self.violations),
             "node_losses": self.node_losses,
             "rpc_faults": self.rpc_faults,
@@ -165,11 +181,49 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         cc.n_jobs, n_users=cc.n_users, seed=cc.seed,
         span_ms=cc.submit_span_ms, duration_ms=cc.job_duration_ms))
     hosts = load_hosts(generate_example_hosts(cc.n_hosts, seed=cc.seed))
-    result = ChaosResult(total=len(trace))
-    if not trace:
+
+    # gang workload (docs/GANG.md): n_gangs groups of gang_size members,
+    # uniform duration (members complete together), hosts carved into
+    # gang_size-wide topology slices
+    from ..state.schema import Group, Job, Resources
+    gang_jobs: List[Job] = []
+    gang_sets: List[tuple] = []  # (submit_ms, [jobs], Group)
+    gang_index: Dict[str, List[str]] = {}
+    if cc.n_gangs > 0:
+        if cc.gang_topology:
+            for i, h in enumerate(hosts):
+                h.attributes["slice-id"] = f"s{i // cc.gang_size}"
+        t0 = trace[0].submit_time_ms if trace else 0
+        for k in range(cc.n_gangs):
+            submit = t0 + (k + 1) * cc.submit_span_ms // (cc.n_gangs + 1)
+            if (cc.gang_at_kill and k == cc.n_gangs - 1
+                    and cc.leader_kill_at_ms is not None):
+                # the last gang lands just before the leader kill so the
+                # crash window reliably interrupts a gang launch
+                submit = max(t0, t0 + cc.leader_kill_at_ms - cc.tick_ms)
+            guuid = f"gang-{k}"
+            members = [Job(
+                uuid=f"{guuid}-m{i}", user=f"gang{k % cc.n_users}",
+                command="sim", group=guuid,
+                resources=Resources(cpus=2.0, mem=256.0),
+                max_retries=3, submit_time_ms=submit,
+                labels={"sim/duration_ms": str(cc.job_duration_ms)})
+                for i in range(cc.gang_size)]
+            group = Group(
+                uuid=guuid, gang=True, gang_size=cc.gang_size,
+                gang_topology="slice-id" if cc.gang_topology else None,
+                jobs=[m.uuid for m in members])
+            gang_jobs.extend(members)
+            gang_sets.append((submit, members, group))
+            gang_index[guuid] = [m.uuid for m in members]
+        gang_sets.sort(key=lambda s: s[0])
+
+    result = ChaosResult(total=len(trace) + len(gang_jobs),
+                         gangs=cc.n_gangs)
+    if not trace and not gang_jobs:
         return result
 
-    now_box = [trace[0].submit_time_ms]
+    now_box = [trace[0].submit_time_ms if trace else gang_sets[0][0]]
     clock = lambda: now_box[0]  # noqa: E731 - one timebase for everything
 
     # process-global planes: seed/arm for this run, restore after
@@ -190,7 +244,8 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     store.clock = clock
     cluster = FakeCluster("chaos", hosts)
     cluster.job_durations_ms = {
-        j.uuid: int(j.labels["sim/duration_ms"]) for j in trace}
+        j.uuid: int(j.labels["sim/duration_ms"])
+        for j in list(trace) + gang_jobs}
     scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
 
     def check_single_live(when: str) -> None:
@@ -210,6 +265,29 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                 result.violations.append(
                     f"{when}: cluster runs {tid} but store says "
                     f"{inst.status.value if inst else 'missing'}")
+
+    def check_no_partial_gang(when: str) -> None:
+        """THE gang invariant (docs/GANG.md): at every consistent point,
+        a gang is whole or absent — never a strict subset of members
+        holding capacity while the rest wait."""
+        for guuid, member_uuids in gang_index.items():
+            live = completed = known = 0
+            for uuid in member_uuids:
+                j = store.job(uuid)
+                if j is None:
+                    continue
+                known += 1
+                if any((mi := store.instance(t)) is not None
+                       and mi.status in (InstanceStatus.UNKNOWN,
+                                         InstanceStatus.RUNNING)
+                       for t in j.instances):
+                    live += 1
+                elif j.state is JobState.COMPLETED:
+                    completed += 1
+            if known and live and live + completed < known:
+                result.violations.append(
+                    f"{when}: gang {guuid} partial — {live} live + "
+                    f"{completed} completed of {known} members")
 
     def fail_one_node() -> None:
         if result.node_losses >= cc.node_loss_max:
@@ -278,7 +356,11 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
 
     pending = list(trace)
-    deadline = pending[-1].submit_time_ms + cc.max_virtual_ms
+    pending_gangs = list(gang_sets)
+    last_submits = [s[0] for s in pending_gangs]
+    if pending:
+        last_submits.append(pending[-1].submit_time_ms)
+    deadline = max(last_submits) + cc.max_virtual_ms
     start_ms = now_box[0]
     next_node_loss = start_ms + cc.node_loss_every_ms
     kill_at = (start_ms + cc.leader_kill_at_ms
@@ -290,6 +372,9 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         now = now_box[0]
         while pending and pending[0].submit_time_ms <= now:
             store.create_jobs([pending.pop(0)])
+        while pending_gangs and pending_gangs[0][0] <= now:
+            _t, members, group = pending_gangs.pop(0)
+            store.create_jobs(members, groups=[group])
         if kill_at is not None and now >= kill_at:
             kill_at = None
             kill_leader_and_promote()
@@ -306,12 +391,17 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         if state == "open" and last_breaker_state != "open":
             result.breaker_trips += 1
         last_breaker_state = state
+        # deferred backend kills (gang-policy siblings killed while the
+        # launch path held the kill-lock read side) must land before the
+        # tick's invariants are judged
+        scheduler.drain_side_effects()
         check_single_live(f"t={now}")
+        check_no_partial_gang(f"t={now}")
         if result.violations:
             break  # a broken invariant only compounds; stop and report
         now_box[0] = now + cc.tick_ms
         cluster.advance_to(now_box[0])
-        if not pending and not store.jobs_where(
+        if not pending and not pending_gangs and not store.jobs_where(
                 lambda j: j.state is not JobState.COMPLETED):
             break
 
@@ -325,8 +415,21 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         if (j := store.job(uuid)) is not None
         and len(j.instances) > n_at_kill)
 
+    check_no_partial_gang("final")
+    # gang requeues actually exercised (observed, not assumed): count
+    # the gang-member-lost sibling kills the policy transacted
+    for uuids in gang_index.values():
+        for uuid in uuids:
+            j = store.job(uuid)
+            if j is None:
+                continue
+            result.gang_requeues += sum(
+                1 for t in j.instances
+                if (mi := store.instance(t)) is not None
+                and mi.reason_code == Reasons.GANG_MEMBER_LOST.code)
+
     # terminal-state + retry-budget invariants
-    for job in trace:
+    for job in list(trace) + gang_jobs:
         stored = store.job(job.uuid)
         if stored is None:
             result.violations.append(f"job {job.uuid} vanished")

@@ -90,3 +90,84 @@ def classify_failure(reason_code: Optional[int]) -> Tuple[bool, Optional[int]]:
     """Return (mea_culpa?, failure_limit) for a failure reason code."""
     reason = Reasons.by_code(reason_code if reason_code is not None else Reasons.UNKNOWN.code)
     return reason.mea_culpa, reason.failure_limit
+
+
+def gang_failure_action(group, reason_code: Optional[int],
+                        failed_member_state: JobState) -> str:
+    """What the gang policy does when one member's instance fails
+    (docs/GANG.md).  Pure so the scheduler's tx-event handler stays a
+    thin dispatcher.
+
+    Returns one of:
+
+    - ``"none"`` — not a gang, or the failure IS a gang-policy kill
+      (``gang-member-lost``): reacting to our own kills would cascade;
+    - ``"requeue"`` — kill the gang's other live instances mea-culpa
+      (``gang-member-lost``) so the whole gang returns to WAITING and
+      relaunches atomically (the default policy);
+    - ``"kill"`` — kill the whole gang's jobs outright.  Chosen when the
+      group's policy says so, and FORCED when the failed member's job
+      went terminal (retries exhausted, user kill): its siblings could
+      otherwise wait forever on a gang that can never be whole again.
+    """
+    from .schema import GANG_POLICY_KILL
+    if group is None or not getattr(group, "gang", False):
+        return "none"
+    if reason_code == Reasons.GANG_MEMBER_LOST.code:
+        return "none"
+    if failed_member_state is JobState.COMPLETED:
+        return "kill"
+    if getattr(group, "gang_policy", "") == GANG_POLICY_KILL:
+        return "kill"
+    return "requeue"
+
+
+def gang_status(store, group,
+                cache: Optional[Dict[str, Dict]] = None) -> Dict:
+    """Gang placement status computed from the store (docs/GANG.md):
+    members placed (live instance) / running, and the barrier state —
+    ``None`` until any member launches, ``"pending"`` while members are
+    coming up, ``"released"`` once every member has STARTED: currently
+    RUNNING, or completed after a run (a short member can exit SUCCESS
+    before the last member comes up — requiring everyone simultaneously
+    RUNNING would misreport such gangs as forever "pending"; this also
+    makes a gang whose members all ran and finished stay "released").
+    Derived on demand so it survives leader handoffs.  ``cache`` (group
+    uuid -> status) lets batch queries compute each gang once instead
+    of once per member job."""
+    if cache is not None and group.uuid in cache:
+        return cache[group.uuid]
+    placed = running = started = 0
+    for member_uuid in group.jobs:
+        member = store.job(member_uuid)
+        if member is None:
+            continue
+        insts = [i for t in member.instances
+                 if (i := store.instance(t)) is not None]
+        if any(i.status in (InstanceStatus.UNKNOWN,
+                            InstanceStatus.RUNNING) for i in insts):
+            placed += 1
+        if any(i.status is InstanceStatus.RUNNING for i in insts):
+            running += 1
+            started += 1
+        elif member.state is JobState.COMPLETED and any(
+                # the member DID run at some point: SUCCESS, or a
+                # terminal instance that reached RUNNING (start stamp)
+                i.status is InstanceStatus.SUCCESS
+                or i.mesos_start_time_ms for i in insts):
+            started += 1
+    size = group.gang_size or len(group.jobs)
+    barrier = None
+    if started >= size:
+        barrier = "released"
+    elif placed:
+        barrier = "pending"
+    out = {"size": size,
+           "topology": group.gang_topology,
+           "policy": group.gang_policy,
+           "members_placed": placed,
+           "members_running": running,
+           "barrier": barrier}
+    if cache is not None:
+        cache[group.uuid] = out
+    return out

@@ -96,6 +96,13 @@ class Reasons:
     # (reference: "Container memory limit exceeded", reason 2002 in
     # reason.clj — the user's fault, consumes a retry)
     MEMORY_LIMIT_EXCEEDED = Reason(16, "memory-limit-exceeded")
+    # a gang sibling failed: this (blameless) member was killed by the
+    # gang policy so the whole gang requeues atomically (docs/GANG.md).
+    # Unlimited free retries — the member that actually failed carries
+    # its own reason and consumes ITS budget; like
+    # CANCELLED_DURING_LAUNCH, the kill proves nothing about the host,
+    # so the matcher does not novel-host-exclude it.
+    GANG_MEMBER_LOST = Reason(17, "gang-member-lost", mea_culpa=True)
 
     _by_code: Dict[int, Reason] = {}
     _by_name: Dict[str, Reason] = {}
@@ -316,10 +323,26 @@ class GroupPlacementType(enum.Enum):
     ATTRIBUTE_EQUALS = "attribute-equals"
 
 
+# Gang member-failure policies (docs/GANG.md): what happens to the rest
+# of a gang when one member's instance fails.
+GANG_POLICY_REQUEUE = "requeue"   # kill siblings mea-culpa, whole gang retries
+GANG_POLICY_KILL = "kill"         # kill the whole gang's jobs outright
+GANG_POLICIES = (GANG_POLICY_REQUEUE, GANG_POLICY_KILL)
+
+
 @dataclass
 class Group:
     """Job group with placement constraints + straggler handling
-    (reference: schema.clj group attributes; group.clj)."""
+    (reference: schema.clj group attributes; group.clj).
+
+    With ``gang=True`` the group is a multi-host slice job scheduled
+    all-or-nothing (docs/GANG.md): all ``gang_size`` members must match
+    in the same cycle, launch in one guard transaction, and — under the
+    default ``requeue`` policy — a member failure kills and requeues the
+    whole gang.  ``gang_topology`` optionally names a host attribute
+    (e.g. "slice-id") whose value must be equal across every member's
+    host, with the matcher preferring the slice with the most feasible
+    capacity."""
 
     uuid: str
     name: str = "defaultgroup"
@@ -329,6 +352,10 @@ class Group:
     straggler_quantile: Optional[float] = None   # e.g. 0.5
     straggler_multiplier: Optional[float] = None  # e.g. 2.0
     jobs: List[str] = field(default_factory=list)
+    gang: bool = False
+    gang_size: int = 0
+    gang_topology: Optional[str] = None
+    gang_policy: str = GANG_POLICY_REQUEUE
 
 
 class DruMode(enum.Enum):

@@ -639,14 +639,28 @@ class Store:
         failure blocks the backend launch) holds per job.
 
         ``entries``: dicts with job_uuid, task_id, hostname and optional
-        slave_id, compute_cluster, ports, node_location.  Returns
+        slave_id, compute_cluster, ports, node_location, gang (gang group
+        uuid).  Entries sharing a ``gang`` are all-or-nothing: one
+        member's guard denial fails every member in the same transaction
+        — no partial gang ever launches (docs/GANG.md).  Returns
         (created instances, [(job_uuid, deny-reason), ...])."""
 
         def _launch_all(txn: _Txn):
             out: List[Instance] = []
             failures: List[Tuple[str, str]] = []
             t = self.clock()  # one clock read per batch (as create_jobs)
-            for e in entries:
+            # pass 1 — guards only (peek, no writes): gang atomicity needs
+            # every member's verdict BEFORE any member's instance is put
+            denied: Dict[int, str] = {}
+            seen_jobs: set = set()
+            for i, e in enumerate(entries):
+                # the sequential guard used to catch a duplicate job via
+                # its freshly-created live instance; the two-pass form
+                # must deny it explicitly
+                if e["job_uuid"] in seen_jobs:
+                    denied[i] = "duplicate-in-batch"
+                    continue
+                seen_jobs.add(e["job_uuid"])
                 # guard on a non-cloning PEEK: taking write intent first
                 # would install (and journal) the unchanged entity even
                 # when the guard denies — a lingering denied job would
@@ -657,12 +671,29 @@ class Store:
                 # defensive clone for the mutation)
                 job = txn.peek("jobs", e["job_uuid"])
                 if job is None:
-                    failures.append((e["job_uuid"], "no-such-job"))
+                    denied[i] = "no-such-job"
                     continue
                 deny = machines.allowed_to_start(
                     job, txn.peek_instances_of(job))
                 if deny is not None:
-                    failures.append((e["job_uuid"], deny))
+                    denied[i] = deny
+            # gang propagation: any denied member denies its whole gang
+            by_gang: Dict[str, List[int]] = {}
+            for i, e in enumerate(entries):
+                g = e.get("gang")
+                if g:
+                    by_gang.setdefault(g, []).append(i)
+            for g, idxs in by_gang.items():
+                bad = [i for i in idxs if i in denied]
+                if bad:
+                    reason = denied[bad[0]]
+                    for i in idxs:
+                        denied.setdefault(
+                            i, f"gang-member-denied:{reason}")
+            # pass 2 — create instances for the allowed entries
+            for i, e in enumerate(entries):
+                if i in denied:
+                    failures.append((e["job_uuid"], denied[i]))
                     continue
                 job = txn.job_w(e["job_uuid"])
                 hostname = e["hostname"]
@@ -684,11 +715,17 @@ class Store:
                 txn.put("intents", e["task_id"], {
                     "task_id": e["task_id"], "job_uuid": e["job_uuid"],
                     "compute_cluster": e.get("compute_cluster", ""),
-                    "hostname": hostname, "created_ms": t})
+                    "hostname": hostname, "created_ms": t,
+                    # gang group uuid: leader-startup reconciliation
+                    # sweeps a gang's intents as one unit (refund any ->
+                    # refund all, docs/GANG.md)
+                    **({"gang": e["gang"]} if e.get("gang") else {})})
                 job.instances.append(e["task_id"])
                 job.state = JobState.RUNNING
                 txn.event("instance-created", task_id=e["task_id"],
-                          job=e["job_uuid"], hostname=hostname)
+                          job=e["job_uuid"], hostname=hostname,
+                          **({"gang": e["gang"]} if e.get("gang")
+                             else {}))
                 txn.event("job-state", uuid=e["job_uuid"], old="waiting",
                           new="running", reason=None)
                 out.append(inst)
@@ -945,6 +982,49 @@ class Store:
         with self._lock:
             g = self._groups.get(uuid)
             return fast_clone(g) if g is not None else None
+
+    def group_is_gang(self, uuid: Optional[str]) -> bool:
+        """Gang-membership test without the ``group()`` clone — the
+        completion hooks consult this for every grouped terminal job,
+        gang or not, so it must not pay a deep copy of the member list."""
+        if not uuid:
+            return False
+        with self._lock:
+            g = self._groups.get(uuid)
+            return bool(g is not None and getattr(g, "gang", False))
+
+    def gang_size(self, uuid: Optional[str]) -> int:
+        """Clone-free gang size: 0 for missing or non-gang groups.  The
+        per-cycle admission path consults this once per distinct group,
+        so ordinary placement groups must not pay a member-list copy."""
+        if not uuid:
+            return 0
+        with self._lock:
+            g = self._groups.get(uuid)
+            if g is None or not getattr(g, "gang", False):
+                return 0
+            return int(getattr(g, "gang_size", 0) or 0)
+
+    def gang_groups_of(self, jobs) -> Dict[str, Group]:
+        """The gang Groups these jobs' ``group`` fields reference, one
+        lookup per distinct group — the shared gang-membership test for
+        every consumer (scheduler resume/autoscale/direct matching, the
+        matcher's launch cohorts, the rebalancer's whole-gang closures),
+        so the semantics can't drift between call sites."""
+        out: Dict[str, Group] = {}
+        seen: set = set()
+        for job in jobs:
+            guuid = getattr(job, "group", None)
+            if not guuid or guuid in seen:
+                continue
+            seen.add(guuid)
+            with self._lock:
+                g = self._groups.get(guuid)
+                # gang test under the lock so ordinary placement groups
+                # never pay the member-list clone
+                if g is not None and getattr(g, "gang", False):
+                    out[guuid] = fast_clone(g)
+        return out
 
     def jobs_where(self, pred: Callable[[Job], bool]) -> List[Job]:
         with self._lock:

@@ -439,3 +439,71 @@ class TestAdminUsage:
             assert r.returncode == 1
         finally:
             cli(daemon, "kill", uuid, user="au1")
+
+
+class TestGangSubmit:
+    def test_gang_size_fans_out_one_group(self, daemon):
+        r = cli(daemon, "submit", "--gang-size", "2", "--cpus", "1",
+                "--mem", "64", "true")
+        assert r.returncode == 0, r.stderr
+        uuids = r.stdout.split()
+        assert len(uuids) == 2
+        # 60s: the whole gang must clear the barrier, and a loaded CI
+        # box has pushed the 30s budget over the line before
+        r = cli(daemon, "wait", *uuids, "--timeout", "60", timeout=90)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # cs show surfaces the gang block (members, barrier state)
+        r = cli(daemon, "show", uuids[0])
+        assert r.returncode == 0, r.stderr
+        shown = json.loads(r.stdout)[0]
+        assert shown["gang"]["size"] == 2
+        assert shown["groups"] == [shown["gang"]["group"]]
+
+    def test_gang_flags_require_size(self, daemon):
+        r = cli(daemon, "submit", "--gang-topology", "slice-id", "true")
+        assert r.returncode == 1
+        assert "--gang-size" in r.stderr
+
+    def test_gang_flags_refused_with_raw(self, daemon):
+        r = cli(daemon, "submit", "--raw", "--gang-size", "2",
+                stdin=json.dumps({"command": "true"}))
+        assert r.returncode == 1
+        assert "gang" in r.stderr
+
+    def test_raw_full_body_submits_a_gang(self, daemon):
+        # --raw accepts a full {"jobs", "groups"} body — the raw-mode
+        # route to gang submission the gang-flags error points at
+        g = "33333333-0000-0000-0000-000000000002"
+        body = {"jobs": [{"command": "true", "group": g,
+                          "cpus": 1, "mem": 64} for _ in range(2)],
+                "groups": [{"uuid": g, "gang": {"size": 2}}]}
+        r = cli(daemon, "submit", "--raw", stdin=json.dumps(body))
+        assert r.returncode == 0, r.stderr
+        uuids = r.stdout.split()
+        assert len(uuids) == 2
+        r = cli(daemon, "show", uuids[0])
+        assert r.returncode == 0, r.stderr
+        shown = json.loads(r.stdout)[0]
+        assert shown["gang"]["size"] == 2
+        assert shown["gang"]["group"] == g
+
+    def test_malformed_gang_spec_is_a_clear_400(self, daemon):
+        # the API rejects a bad gang spec; the CLI surfaces the message
+        spec = {"jobs": [{"command": "true", "group":
+                          "33333333-0000-0000-0000-000000000001"}],
+                "groups": [{"uuid":
+                            "33333333-0000-0000-0000-000000000001",
+                            "gang": {"size": 0}}]}
+        url, home = daemon
+        import urllib.request, urllib.error
+        req = urllib.request.Request(
+            url + "/jobs", method="POST",
+            data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": "alice"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "bad gang spec accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert b"gang.size" in e.read()

@@ -666,7 +666,12 @@ class TestCookExecutorChoice:
         store.create_jobs([job])
         sched.step_rank()
         sched.step_match()
-        assert wait_for(pidfile.exists, timeout=10)
+        # wait for CONTENT, not existence: the shell's `>` redirect
+        # creates the file empty before echo writes the pid (a loaded
+        # box can observe the gap and int("") here)
+        assert wait_for(
+            lambda: pidfile.exists() and pidfile.read_text().strip(),
+            timeout=10)
         workload_pid = int(pidfile.read_text())
         store.kill_job(job.uuid)
 

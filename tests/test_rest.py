@@ -1622,3 +1622,140 @@ class TestSettingsDepth:
         assert "privileged" not in tc["docker-parameters-allowed"]
         assert set(s["pools"]) == {"default-containers", "default-envs",
                                    "valid-gpu-models"}
+
+
+class TestGangEndpoints:
+    """Gang submission + status over the REST surface (docs/GANG.md)."""
+
+    GUUID = "22222222-0000-0000-0000-00000000000%d"
+
+    def submit_gang(self, client, g, size=2, **gang_extra):
+        specs = [{"command": "x", "group": g, "cpus": 1, "mem": 64}
+                 for _ in range(size)]
+        return client.submit(
+            specs, groups=[{"uuid": g,
+                            "gang": {"size": size, **gang_extra}}])
+
+    def test_gang_round_trip_and_status(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        g = self.GUUID % 1
+        uuids = self.submit_gang(client, g, size=2,
+                                 topology="slice-id", policy="requeue")
+        [out] = client._request("GET", "/group", params={"uuid": [g]})
+        assert out["gang"]["size"] == 2
+        assert out["gang"]["topology"] == "slice-id"
+        assert out["gang"]["barrier"] is None
+        # job queries carry the gang block too (cs show reads this)
+        job = client.job(uuids[0])
+        assert job["gang"]["group"] == g
+        assert job["gang"]["members_running"] == 0
+        # fixture hosts carry no slice-id attribute: the gang can never
+        # place, and the unscheduled explainer says why
+        sched.step_rank()
+        sched.step_match()
+        [out] = client.unscheduled_jobs([uuids[0]])
+        texts = " ".join(r["reason"] for r in out["reasons"])
+        assert "gang" in texts.lower()
+
+    def test_gang_places_and_barrier_releases(self, system):
+        store, cluster, sched, server = system
+        client = client_for(server)
+        g = self.GUUID % 2
+        uuids = self.submit_gang(client, g, size=2)
+        sched.step_rank()
+        sched.step_match()
+        [out] = client._request("GET", "/group", params={"uuid": [g]})
+        assert out["gang"]["members_placed"] == 2
+        assert out["gang"]["members_running"] == 2
+        assert out["gang"]["barrier"] == "released"
+        job = client.job(uuids[0])
+        assert job["gang"]["barrier"] == "released"
+
+    def test_malformed_gang_specs_400(self, system):
+        client = client_for(system[3])
+        for i, gang in enumerate([{"size": 0}, {"size": "two"},
+                                  {"size": 2, "policy": "explode"},
+                                  {"size": 2, "topology": ""},
+                                  {"size": 2, "bogus": True}]):
+            g = f"22222222-0000-0000-0001-00000000000{i}"
+            with pytest.raises(JobClientError) as e:
+                client.submit([{"command": "x", "group": g},
+                               {"command": "x", "group": g}],
+                              groups=[{"uuid": g, "gang": gang}])
+            assert e.value.status == 400, gang
+
+    def test_member_count_must_match_size(self, system):
+        client = client_for(system[3])
+        g = self.GUUID % 3
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g}],
+                          groups=[{"uuid": g, "gang": {"size": 3}}])
+        assert e.value.status == 400
+        assert "submitted together" in e.value.message
+
+    def test_no_incremental_gang_members(self, system):
+        client = client_for(system[3])
+        g = self.GUUID % 4
+        self.submit_gang(client, g, size=2)
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g},
+                           {"command": "x", "group": g}],
+                          groups=[{"uuid": g, "gang": {"size": 2}}])
+        assert e.value.status == 400
+        assert "incrementally" in e.value.message
+
+    def test_gang_members_must_share_one_pool(self, system):
+        # per-spec pool overrides can split a gang across pools; each
+        # pool's queue would then hold a strict subset and cohort
+        # admission would defer the gang forever — reject at submit
+        client = client_for(system[3])
+        g = self.GUUID % 7
+        with pytest.raises(JobClientError) as e:
+            client.submit(
+                [{"command": "x", "group": g, "pool": "default"},
+                 {"command": "x", "group": g, "pool": "other-pool"}],
+                groups=[{"uuid": g, "gang": {"size": 2}}])
+        assert e.value.status == 400
+        assert "one pool" in e.value.message
+
+    def test_idempotent_cannot_grow_a_gang(self, system):
+        # the idempotent flag is an escape hatch for retrying the SAME
+        # batch after an indeterminate commit — it must not bypass the
+        # no-incremental-members guard: a "retry" carrying NOVEL member
+        # uuids would merge into the group and grow the gang past
+        # gang_size (partial-gang launches become possible)
+        client = client_for(system[3])
+        g = self.GUUID % 6
+        specs = [{"uuid": f"33333333-0000-0000-0000-00000000000{i}",
+                  "command": "x", "group": g, "cpus": 1, "mem": 64}
+                 for i in range(2)]
+        uuids = client.submit(
+            specs, groups=[{"uuid": g, "gang": {"size": 2}}])
+        # legit idempotent retry of the SAME batch: accepted, no growth
+        again = client.submit(
+            specs, groups=[{"uuid": g, "gang": {"size": 2}}],
+            idempotent=True)
+        assert set(again) == set(uuids)
+        novel = [{"uuid": f"33333333-0000-0000-0001-00000000000{i}",
+                  "command": "x", "group": g, "cpus": 1, "mem": 64}
+                 for i in range(2)]
+        with pytest.raises(JobClientError) as e:
+            client.submit(novel,
+                          groups=[{"uuid": g, "gang": {"size": 2}}],
+                          idempotent=True)
+        assert e.value.status == 400
+        assert "incrementally" in e.value.message
+
+    def test_no_phantom_member_without_groups_block(self, system):
+        # referencing an EXISTING gang group with no groups entry in the
+        # batch must hit the same no-incremental-members 400: such a job
+        # would skip every gang check and ride the gang's cohort as a
+        # phantom extra member the gang policy never kills
+        client = client_for(system[3])
+        g = self.GUUID % 5
+        self.submit_gang(client, g, size=2)
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "x", "group": g}])
+        assert e.value.status == 400
+        assert "incrementally" in e.value.message
