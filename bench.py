@@ -649,6 +649,8 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     warm_launched = sum(len(r.launched_task_ids) for r in results.values())
     launched += warm_launched
     sched.flush_status_updates()
+    from cook_tpu.utils.flight import recorder as _flight_rec
+    steady_seq0 = _flight_rec.last_seq()
     for _ in range(reps):
         top_up(warm_launched)
         t0 = time.perf_counter()
@@ -661,12 +663,165 @@ def bench_driver_cycle(n_jobs=100_000, n_users=200, H=5000, reps=5):
     out = {"p50_ms": round(pctl(samples, 50), 1),
            "p99_ms": round(pctl(samples, 99), 1),
            "launched": launched}
+    # h2d bytes per cycle recorded unconditionally (ISSUE 7 satellite):
+    # the staging win must be visible in the committed trajectory, not
+    # only under COOK_BENCH_FLIGHT
+    from cook_tpu.utils.flight import recorder as _flight
+    steady = _flight.summary(since_seq=steady_seq0)
+    cycles = max(steady.get("cycles", 1), 1)
+    out["h2d_bytes_per_cycle"] = int(steady.get("h2d_bytes", 0) / cycles)
+    out["delta_rows_per_cycle"] = int(steady.get("delta_rows", 0) / cycles)
+    out["full_repacks"] = steady.get("full_repacks", 0)
+    out["detail_ms"] = steady.get("detail_ms", {})
     if flight_seq0 is not None:
-        from cook_tpu.utils.flight import recorder as _flight
         out["flight"] = _flight.summary(since_seq=flight_seq0)
     print(f"driver_cycle[{n_jobs//1000}k jobs x {H//1000}k hosts] "
           f"production step_cycle p50={out['p50_ms']}ms "
-          f"p99={out['p99_ms']}ms launched={launched}", file=sys.stderr)
+          f"p99={out['p99_ms']}ms launched={launched} "
+          f"h2d/cycle={out['h2d_bytes_per_cycle']}", file=sys.stderr)
+    return out
+
+
+def bench_resident_cycle(n_jobs=100_000, n_users=200, H=5000,
+                         n_jobs_large=1_000_000, reps=5):
+    """Device-RESIDENT incremental cycle state (ISSUE 7, ops/delta.py)
+    vs the rebuild-every-cycle staging it replaces, end-to-end through
+    Store + columnar index + Scheduler.step_cycle:
+
+    - ``staging_off`` (resident_pack=True, the new default): the [P, T]
+      rows/flags wire arrays live in donated device buffers; each cycle
+      ships only the scatter delta extracted off the index's tx-event
+      feed;
+    - ``staging_on`` (resident_pack=False): the pre-ISSUE-7 behavior —
+      rebuild + full re-upload every cycle;
+    - ``resident_1m``: the resident leg at the 1M-task design point (the
+      acceptance bar: the 1M cycle must fit the old 100k budget
+      on-chip).
+
+    Three churn regimes, because the resident pack behaves differently
+    in each (docs/PERFORMANCE.md):
+
+    - ``dense``: the driver_cycle workload — thousands of launches per
+      cycle scattered across every user segment shift nearly every
+      position of the sorted permutation, so the pack takes the
+      ``oversize`` full-repack path (bytes-equal to rebuild, by design);
+    - ``sparse``: a single-user submission trickle at the tail of the
+      sort order — the true delta regime: h2d scales with the trickle,
+      not the table;
+    - ``quiet``: zero churn — the delta feed's fast path reuses the
+      pack wholesale: zero repacks, zero delta rows, and h2d drops to
+      the U/H-sized control arrays (vs rebuild re-uploading the [T]
+      world every cycle).
+
+    Each leg reports p50/p99 wall, h2d bytes/cycle, delta rows/cycle,
+    full-repack count, and the pack/stage/apply host breakdown."""
+    from cook_tpu.cluster import FakeCluster, FakeHost
+    from cook_tpu.config import Config
+    from cook_tpu.sched import Scheduler
+    from cook_tpu.state import Resources, Store
+    from cook_tpu.utils.flight import recorder as _flight
+
+    def run_leg(resident, n, leg_reps=reps, churn="dense"):
+        rng = np.random.default_rng(5)
+        cfg = Config()
+        cfg.pipeline.depth = 0  # sync: comparable with driver_cycle
+        cfg.resident_pack = resident
+        store = Store()
+        # quiet/sparse legs: hosts too small to place anything, so the
+        # pending queue (and the resident pack) stays at scale
+        host_cpus = 64.0 if churn == "dense" else 0.5
+        hosts = [FakeHost(f"h{i}", Resources(cpus=host_cpus, mem=65536.0))
+                 for i in range(H)]
+        cluster = FakeCluster("fake-1", hosts)
+        sched = Scheduler(store, cfg, [cluster], rank_backend="tpu",
+                          status_queue_shards=4)
+        jobs = _driver_jobs(rng, n, n_users)
+        for i in range(0, n, 50_000):
+            store.create_jobs(jobs[i:i + 50_000])
+        store.ensure_index()
+        results = sched.step_cycle()  # compile + cold repack
+        warm = sum(len(r.launched_task_ids) for r in results.values())
+        launched = warm
+        sched.flush_status_updates()
+
+        def top_up(k):
+            if churn == "dense":
+                fresh = _driver_jobs(rng, k, n_users)
+                for i in range(0, k, 10_000):
+                    store.create_jobs(fresh[i:i + 10_000])
+            elif churn == "sparse":
+                # one tail-of-sort-order user, increasing submit times:
+                # inserts land at the end of the permutation, so the
+                # positional delta is trickle-sized
+                from cook_tpu.state import Job, Resources as Res, new_uuid
+                base = getattr(top_up, "t", 10**7)
+                fresh = [Job(uuid=new_uuid(), user="zzz-trickle",
+                             command="x", submit_time_ms=base + i,
+                             resources=Res(cpus=8.0, mem=8192.0))
+                         for i in range(64)]
+                top_up.t = base + 64
+                store.create_jobs(fresh)
+
+        top_up(warm)
+        results = sched.step_cycle()  # settle
+        warm = sum(len(r.launched_task_ids) for r in results.values())
+        launched += warm
+        sched.flush_status_updates()
+        seq0 = _flight.last_seq()
+        samples = []
+        for _ in range(leg_reps):
+            top_up(warm)
+            t0 = time.perf_counter()
+            results = sched.step_cycle()
+            samples.append((time.perf_counter() - t0) * 1000.0)
+            warm = sum(len(r.launched_task_ids) for r in results.values())
+            launched += warm
+            sched.flush_status_updates()
+        flight = _flight.summary(since_seq=seq0)
+        cycles = max(flight.get("cycles", 1), 1)
+        sched.shutdown()
+        return {
+            "p50_ms": round(pctl(samples, 50), 1),
+            "p99_ms": round(pctl(samples, 99), 1),
+            "launched": launched,
+            "h2d_bytes_per_cycle": int(flight.get("h2d_bytes", 0)
+                                       / cycles),
+            "delta_rows_per_cycle": int(flight.get("delta_rows", 0)
+                                        / cycles),
+            "full_repacks": flight.get("full_repacks", 0),
+            "steady_recompiles": sum(
+                flight.get("recompiles", {}).values()),
+            "detail_ms": flight.get("detail_ms", {}),
+        }
+
+    off = run_leg(True, n_jobs)
+    on = run_leg(False, n_jobs)
+    quiet_res = run_leg(True, n_jobs, leg_reps=3, churn="quiet")
+    quiet_reb = run_leg(False, n_jobs, leg_reps=3, churn="quiet")
+    sparse = run_leg(True, n_jobs, leg_reps=3, churn="sparse")
+    big = run_leg(True, n_jobs_large, leg_reps=max(2, reps - 2))
+    out = {
+        "staging_off": off,   # resident pack (new default), dense churn
+        "staging_on": on,     # rebuild-every-cycle baseline
+        "quiet_resident": quiet_res,
+        "quiet_rebuild": quiet_reb,
+        "sparse_resident": sparse,
+        "resident_1m": big,
+        "speedup_p50": round(on["p50_ms"] / max(off["p50_ms"], 1e-9), 2),
+        # THE delta-scaling evidence: steady-state (quiet) h2d per cycle,
+        # resident vs rebuild-the-world
+        "h2d_reduction_quiet": round(
+            quiet_reb["h2d_bytes_per_cycle"]
+            / max(quiet_res["h2d_bytes_per_cycle"], 1), 2),
+    }
+    print(f"resident_cycle[{n_jobs//1000}k x {H//1000}k] "
+          f"dense p50 {off['p50_ms']}ms vs rebuild {on['p50_ms']}ms; "
+          f"quiet h2d/cyc {quiet_res['h2d_bytes_per_cycle']} vs "
+          f"{quiet_reb['h2d_bytes_per_cycle']} "
+          f"(x{out['h2d_reduction_quiet']}); sparse delta/cyc="
+          f"{sparse['delta_rows_per_cycle']} repacks="
+          f"{sparse['full_repacks']}; 1M_p50={big['p50_ms']}ms",
+          file=sys.stderr)
     return out
 
 
@@ -1289,6 +1444,11 @@ def run_section(name: str) -> None:
         data = bench_pipeline_driver(n_jobs=scaled(100_000),
                                      n_users=scaled(200, lo=8),
                                      H=scaled(5000))
+    elif name == "resident_cycle":
+        data = bench_resident_cycle(n_jobs=scaled(100_000),
+                                    n_users=scaled(200, lo=8),
+                                    H=scaled(5000),
+                                    n_jobs_large=scaled(1_000_000))
     elif name == "gang_cycle":
         data = bench_gang_cycle(n_jobs=scaled(50_000),
                                 n_users=scaled(100, lo=8),
@@ -1517,9 +1677,9 @@ def main():
 
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle",
-                "pipeline_driver", "gang_cycle", "fused_cycle",
-                "store_cycle", "store_scale", "match_large", "rebalance",
-                "end2end", "pallas_scale", "pipeline",
+                "resident_cycle", "pipeline_driver", "gang_cycle",
+                "fused_cycle", "store_cycle", "store_scale", "match_large",
+                "rebalance", "end2end", "pallas_scale", "pipeline",
                 "placement_quality"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match

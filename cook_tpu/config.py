@@ -317,6 +317,14 @@ class Config:
     # the store (state/index.py) instead of materializing entities per
     # cycle; the entity path remains the CPU-fallback/parity mode
     columnar_index: bool = True
+    # keep the fused cycle's stacked [P, T] wire arrays (row permutation +
+    # admission flags) RESIDENT on device across cycles, scatter-applying
+    # per-cycle deltas extracted off the index's tx-event feed instead of
+    # re-uploading the world (ops/delta.py; docs/PERFORMANCE.md).  Full
+    # repacks happen only on compaction fences, bucket regrows, or kernel
+    # faults.  Decision-identical to the rebuild path; only engages with
+    # columnar_index=True (the compact wire form).
+    resident_pack: bool = True
     default_pool: str = "default"
     # pool-regex -> matcher config, first match wins (config.clj:798)
     pool_matchers: List[tuple] = field(default_factory=list)

@@ -1,8 +1,10 @@
 from .dru import (  # noqa: F401
+    CompactRankInputs,
     RankInputs,
     RankResult,
     pool_quota_mask,
     rank_kernel,
+    rank_kernel_compact,
     segment_cumsum,
     user_quota_mask,
 )

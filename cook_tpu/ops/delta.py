@@ -1,0 +1,200 @@
+"""Device-resident incremental cycle state: the delta scatter-apply
+kernel and the device base mirror (ISSUE 7; ROADMAP item 2).
+
+The production fused cycle used to rebuild its stacked [P, T] wire
+arrays on the host and re-upload them every cycle — the "host staging
+wall" that dominated step_cycle once the kernels were fast.  This module
+is the mechanism that replaces the rebuild with incremental view
+maintenance (Omega's shared-state insight one level down; McSherry-style
+deltas):
+
+* the pack's per-cycle wire arrays (``rows`` row permutation + ``flags``
+  admission bits, CompactPoolCycleInputs) live in DEVICE-RESIDENT
+  buffers across cycles;
+* each cycle the driver diffs the freshly staged host arrays against its
+  host shadow (delta extraction — native/pack.cpp when built) and
+  dispatches :func:`apply_pack_delta`, a jitted scatter of just the
+  changed positions, instead of uploading the world;
+* a full repack happens only on an index compaction fence, a bucket
+  regrow / group reshape, a kernel-dispatch fault (degrading like every
+  other kernel, ``cook_kernel_fallback_total``), or when the delta is so
+  large the full upload is cheaper.
+
+Flag-bit constants live here (not in parallel/sharded.py) so the state
+and sched layers can reason about wire flags without importing the mesh
+layer; parallel/sharded re-exports them under the same names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry
+from .padding import bucket
+
+F32 = np.float32
+
+# flag bits of CompactPoolCycleInputs.flags (one wire byte per task)
+FLAG_PENDING = 1
+FLAG_VALID = 2
+FLAG_ENQUEUE_OK = 4
+FLAG_LAUNCH_OK = 8
+FLAG_USER_FIRST = 16   # first row of a user segment
+
+# delta batches are padded to power-of-two buckets so the scatter
+# executable is reused across cycles (min floor keeps tiny deltas from
+# compiling log2(min) variants)
+_DELTA_MIN_BUCKET = 256
+
+
+def pack_flags(pending: np.ndarray, valid: np.ndarray,
+               is_first: np.ndarray, enqueue_ok=None,
+               launch_ok=None) -> np.ndarray:
+    """The wire flags byte, packed ONE way for every producer (the fused
+    pack and the compact rank path must never drift on bit layout).
+    ``enqueue_ok``/``launch_ok`` default to all-accept when omitted —
+    note the rank kernel simply ignores those bits."""
+    flags = (pending.astype(np.uint8) * FLAG_PENDING
+             + valid.astype(np.uint8) * FLAG_VALID
+             + is_first.astype(np.uint8) * FLAG_USER_FIRST)
+    if enqueue_ok is not None:
+        flags += enqueue_ok.astype(np.uint8) * FLAG_ENQUEUE_OK
+    if launch_ok is not None:
+        flags += launch_ok.astype(np.uint8) * FLAG_LAUNCH_OK
+    return flags
+
+
+def _donate_default() -> bool:
+    """Donate the resident buffers into the scatter only where XLA
+    honors input-output aliasing (TPU/GPU).  On CPU donation is ignored
+    with a warning per call — the copy is cheap there anyway."""
+    import jax
+    return jax.default_backend() not in ("cpu",)
+
+
+class PackDeltaApplier:
+    """Caches one jitted scatter executable per (buffer shape, delta
+    bucket); donation re-uses the old buffer's device memory so the
+    resident pack never doubles its footprint during the update."""
+
+    def __init__(self, donate: Optional[bool] = None):
+        self._fns: Dict[Tuple, object] = {}
+        self._donate = donate
+
+    def _fn(self, shape: Tuple[int, ...], kb: int):
+        key = (shape, kb)
+        fn = self._fns.get(key)
+        if fn is None:
+            import jax
+            if self._donate is None:
+                self._donate = _donate_default()
+
+            def _apply(rows_buf, flags_buf, idx, rows_v, flags_v):
+                flat_r = rows_buf.reshape(-1)
+                flat_f = flags_buf.reshape(-1)
+                # padding idx entries are == buffer size: OOB, dropped
+                flat_r = flat_r.at[idx].set(rows_v, mode="drop")
+                flat_f = flat_f.at[idx].set(flags_v, mode="drop")
+                return (flat_r.reshape(rows_buf.shape),
+                        flat_f.reshape(flags_buf.shape))
+
+            fn = telemetry.instrument_jit("delta.apply", jax.jit(
+                _apply,
+                donate_argnums=(0, 1) if self._donate else ()))
+            self._fns[key] = fn
+        return fn
+
+    def apply(self, rows_dev, flags_dev, idx: np.ndarray,
+              rows_vals: np.ndarray, flags_vals: np.ndarray):
+        """Scatter the delta batch into the resident buffers; returns the
+        (new_rows_dev, new_flags_dev) device arrays.  ``idx`` holds flat
+        positions into the raveled buffer."""
+        import jax.numpy as jnp
+        n_flat = int(np.prod(rows_dev.shape))
+        k = int(idx.size)
+        kb = min(bucket(max(k, 1), minimum=_DELTA_MIN_BUCKET), n_flat)
+        if kb < k:  # bucket clamped under the delta: caller should repack
+            raise ValueError(f"delta larger than buffer ({k} > {n_flat})")
+        idx_p = np.full(kb, n_flat, dtype=np.int32)  # OOB sentinel pad
+        idx_p[:k] = idx
+        rows_p = np.zeros(kb, dtype=np.int32)
+        rows_p[:k] = rows_vals
+        flags_p = np.zeros(kb, dtype=np.uint8)
+        flags_p[:k] = flags_vals
+        telemetry.count_transfer(
+            "h2d", idx_p.nbytes + rows_p.nbytes + flags_p.nbytes)
+        fn = self._fn(tuple(rows_dev.shape), kb)
+        return fn(rows_dev, flags_dev, jnp.asarray(idx_p),
+                  jnp.asarray(rows_p), jnp.asarray(flags_p))
+
+
+class DeviceBaseMirror:
+    """Device-resident mirror of the columnar index's immutable res/disk
+    base columns: rows are append-only while the compaction epoch is
+    unchanged, so steady-state cycles upload only the NEW rows (one
+    bucketed chunk append); a compaction epoch change or capacity
+    overflow triggers a full (re)upload.  Shared by the fused driver and
+    the columnar rank path."""
+
+    def __init__(self):
+        self._key: Optional[int] = None   # compaction epoch mirrored
+        self._n = 0                       # rows synced
+        self._cap = 0                     # device buffer capacity
+        self._res = None                  # f32[cap, 4] on device
+        self._disk = None                 # f32[cap] on device
+        self._append_fn = None            # shared jitted chunk append
+
+    def _append(self, base, chunk, off):
+        """Donating chunk append (jit caches one executable per shape)."""
+        if self._append_fn is None:
+            import jax
+            from jax import lax
+            self._append_fn = jax.jit(
+                lambda b, c, o: lax.dynamic_update_slice(
+                    b, c, (o,) + (0,) * (c.ndim - 1)),
+                donate_argnums=0)
+        return self._append_fn(base, chunk, off)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def sync(self, res_base: np.ndarray, disk_base: np.ndarray,
+             compactions: int):
+        """Bring the device mirror up to the snapshot: full (re)upload on
+        a compaction epoch change or capacity overflow, else one bucketed
+        chunk append of the rows added since the last cycle.  Returns the
+        (res, disk) device arrays (capacity-padded)."""
+        import jax.numpy as jnp
+        n = res_base.shape[0]
+        full = (self._key != compactions or n > self._cap)
+        if not full and n > self._n:
+            k = n - self._n
+            kb = bucket(k, minimum=1024)
+            if self._n + kb > self._cap:
+                full = True  # dynamic_update_slice would clamp, not grow
+            else:
+                chunk = np.zeros((kb, 4), dtype=F32)
+                chunk[:k] = res_base[self._n:n]
+                dchunk = np.zeros(kb, dtype=F32)
+                dchunk[:k] = disk_base[self._n:n]
+                off = jnp.asarray(self._n, dtype=jnp.int32)
+                telemetry.count_transfer("h2d",
+                                         chunk.nbytes + dchunk.nbytes)
+                self._res = self._append(self._res, jnp.asarray(chunk), off)
+                self._disk = self._append(self._disk, jnp.asarray(dchunk),
+                                          off)
+                self._n = n
+        if full:
+            cap = bucket(n, minimum=1024)
+            res_p = np.zeros((cap, 4), dtype=F32)
+            res_p[:n] = res_base
+            disk_p = np.zeros(cap, dtype=F32)
+            disk_p[:n] = disk_base
+            telemetry.count_transfer("h2d", res_p.nbytes + disk_p.nbytes)
+            self._res = jnp.asarray(res_p)
+            self._disk = jnp.asarray(disk_p)
+            self._key, self._n, self._cap = compactions, n, cap
+        return self._res, self._disk

@@ -123,6 +123,49 @@ def rank_kernel(inp: RankInputs, *, gpu_mode: bool = False,
                       keep=keep, num_ranked=num_ranked)
 
 
+class CompactRankInputs(NamedTuple):
+    """Minimum-transfer wire form of RankInputs (the split rank path's
+    twin of parallel/sharded.CompactPoolCycleInputs): the per-cycle
+    per-task upload is the sorted row permutation + one flags byte;
+    resource columns live in the device-resident base mirror
+    (ops/delta.DeviceBaseMirror) and the per-task share/quota columns
+    are gathered ON DEVICE from per-user tables via the USER_FIRST
+    segment bit.  At the 1M design point this replaces ~60 MB of host
+    broadcast + upload per rank cycle with ~5 B/task."""
+
+    rows: jax.Array       # i32[T] absolute base row per sorted position
+    flags: jax.Array      # u8[T] ops/delta FLAG_* bits
+    res_base: jax.Array   # f32[N, 4] (cpus, mem, gpus, 1) base mirror
+    shares_u: jax.Array   # f32[U, 3] per-user DRU divisors
+    quota_u: jax.Array    # f32[U, 4] per-user quota
+
+
+@functools.partial(jax.jit, static_argnames=("gpu_mode",
+                                             "max_over_quota_jobs"))
+def rank_kernel_compact(inp: CompactRankInputs, *, gpu_mode: bool = False,
+                        max_over_quota_jobs: int = 100) -> RankResult:
+    """rank_kernel over the compact wire form: usage gathered from the
+    resident base mirror, first_idx/user_rank re-derived from the
+    USER_FIRST segment boundaries, shares/quota from per-user tables.
+    Decision-identical to rank_kernel on the expanded arrays."""
+    from .delta import FLAG_PENDING, FLAG_USER_FIRST, FLAG_VALID
+    from .scan import user_segments_from_flags
+    usage = inp.res_base[inp.rows]
+    flags = inp.flags
+    pending = (flags & FLAG_PENDING) != 0
+    valid = (flags & FLAG_VALID) != 0
+    is_first = (flags & FLAG_USER_FIRST) != 0
+    user_rank, first_idx = user_segments_from_flags(is_first)
+    ur = jnp.clip(user_rank, 0, inp.shares_u.shape[0] - 1)
+    shares = inp.shares_u[ur]
+    quota = inp.quota_u[ur]
+    order, num_ranked, dru, keep, _rankable = rank_body(
+        usage, quota, shares, first_idx, user_rank, pending, valid,
+        gpu_mode, max_over_quota_jobs)
+    return RankResult(order=order, dru=jnp.where(keep, dru, jnp.inf),
+                      keep=keep, num_ranked=num_ranked)
+
+
 @jax.jit
 def pool_quota_mask(job_usage: jax.Array, base_usage: jax.Array,
                     quota: jax.Array, valid: jax.Array) -> jax.Array:
@@ -157,6 +200,8 @@ def user_quota_mask(job_usage: jax.Array, user_rank: jax.Array,
 from . import telemetry as _telemetry  # noqa: E402
 
 rank_kernel = _telemetry.instrument_jit("dru.rank", rank_kernel)
+rank_kernel_compact = _telemetry.instrument_jit(
+    "dru.rank_compact", rank_kernel_compact)
 pool_quota_mask = _telemetry.instrument_jit(
     "dru.pool_quota_mask", pool_quota_mask)
 user_quota_mask = _telemetry.instrument_jit(

@@ -234,3 +234,19 @@ def preemption_decision(task_dru: np.ndarray, task_res: np.ndarray,
         return None
     score, host, victims = best
     return host, victims, score
+
+
+def apply_pack_delta(rows_buf: np.ndarray, flags_buf: np.ndarray,
+                     idx: np.ndarray, rows_vals: np.ndarray,
+                     flags_vals: np.ndarray):
+    """Host reference of ops/delta.PackDeltaApplier.apply: scatter the
+    delta batch (flat positions; entries == buffer size are padding and
+    dropped) into copies of the resident rows/flags buffers."""
+    n_flat = rows_buf.size
+    keep = np.asarray(idx) < n_flat
+    idx = np.asarray(idx)[keep]
+    rows = np.array(rows_buf, copy=True)
+    flags = np.array(flags_buf, copy=True)
+    rows.reshape(-1)[idx] = np.asarray(rows_vals)[keep]
+    flags.reshape(-1)[idx] = np.asarray(flags_vals)[keep]
+    return rows, flags

@@ -36,3 +36,23 @@ def segmented_cumsum_by_first_idx(x: jax.Array, first_idx: jax.Array) -> jax.Arr
     start (the contiguous-segment encoding used by the rank kernel)."""
     t = jnp.arange(x.shape[0], dtype=first_idx.dtype)
     return segmented_cumsum(x, t == first_idx)
+
+
+def user_segments_from_flags(is_first: jax.Array, axis: int = -1):
+    """Derive (user_rank, first_idx) from the wire form's USER_FIRST
+    segment-boundary bits — the decision-critical recipe shared by the
+    fused cycle's device-side expansion (parallel/sharded.expand_compact)
+    and the compact rank kernel (ops/dru.rank_kernel_compact), kept in
+    ONE place so the two paths cannot silently diverge.  Padding rows
+    (flags 0) inherit the last segment, inert downstream because their
+    valid bit is 0."""
+    if axis < 0:
+        axis += is_first.ndim
+    T = is_first.shape[axis]
+    user_rank = jnp.cumsum(is_first.astype(jnp.int32), axis=axis) - 1
+    shape = [1] * is_first.ndim
+    shape[axis] = T
+    iota = jnp.arange(T, dtype=jnp.int32).reshape(shape)
+    first_idx = jax.lax.cummax(
+        jnp.where(is_first, iota, 0), axis=axis)
+    return user_rank, first_idx

@@ -47,3 +47,14 @@ def multislice_pool_mesh(n_slices: int,
         raise ValueError(f"need {need} devices, have {len(devices)}")
     grid = np.array(devices[:need]).reshape(n_slices, devices_per_slice)
     return Mesh(grid, (DCN_AXIS, POOL_AXIS))
+
+
+def pool_sharding(mesh: Mesh):
+    """NamedSharding that splits a [P, ...] pool-stacked array over every
+    mesh axis — the committed placement for DEVICE-RESIDENT cycle state
+    (sched/fused.py resident pack): each pool shard owns its own slice of
+    the resident rows/flags buffers, so the per-cycle delta scatter and
+    the fused cycle's shard_map read the same owner-local memory instead
+    of resharding an uncommitted host upload every dispatch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(mesh.axis_names))

@@ -147,12 +147,17 @@ class StructuredPoolCycleInputs(NamedTuple):
     capacity: jax.Array
 
 
-# flag bits of CompactPoolCycleInputs.flags
-FLAG_PENDING = 1
-FLAG_VALID = 2
-FLAG_ENQUEUE_OK = 4
-FLAG_LAUNCH_OK = 8
-FLAG_USER_FIRST = 16   # first row of a user segment
+# flag bits of CompactPoolCycleInputs.flags: canonically defined beside
+# the delta scatter-apply kernel (ops/delta.py) so the state and sched
+# layers can reason about wire flags without importing the mesh layer;
+# re-exported here under their historical names
+from ..ops.delta import (  # noqa: E402,F401
+    FLAG_ENQUEUE_OK,
+    FLAG_LAUNCH_OK,
+    FLAG_PENDING,
+    FLAG_USER_FIRST,
+    FLAG_VALID,
+)
 
 
 class CompactPoolCycleInputs(NamedTuple):
@@ -213,11 +218,10 @@ def expand_compact(inp: CompactPoolCycleInputs) -> StructuredPoolCycleInputs:
     job_res = jnp.concatenate(
         [usage[..., :3], disk[..., None]], axis=-1) * pending[..., None]
     # user_rank / first_idx from the segment boundaries (rows arrive
-    # user-sorted; padding rows have flags=0 and inherit the last segment,
-    # inert because valid=False there)
-    user_rank = jnp.cumsum(is_first.astype(jnp.int32), axis=1) - 1
-    iota = jnp.arange(T, dtype=jnp.int32)[None, :]
-    first_idx = jax.lax.cummax(jnp.where(is_first, iota, 0), axis=1)
+    # user-sorted; ops/scan.user_segments_from_flags — one derivation
+    # shared with the compact rank kernel)
+    from ..ops.scan import user_segments_from_flags
+    user_rank, first_idx = user_segments_from_flags(is_first, axis=1)
     ur = jnp.clip(user_rank, 0, inp.tokens_u.shape[1] - 1)
     tokens = jnp.take_along_axis(inp.tokens_u, ur, axis=1)
     shares = jax.vmap(lambda s, u: s[u])(inp.shares_u, ur)

@@ -38,6 +38,13 @@ from ..cluster.base import Offer
 from ..config import Config
 from ..ops import host_prep
 from ..ops import telemetry
+from ..ops.delta import (
+    FLAG_ENQUEUE_OK,
+    FLAG_LAUNCH_OK,
+    FLAG_PENDING,
+    FLAG_USER_FIRST,
+    FLAG_VALID,
+)
 from ..ops.padding import bucket, pad_to
 from ..state.schema import DruMode, Job, Pool, SchedulerKind
 from ..state.store import Store
@@ -120,13 +127,16 @@ class _StagedCycle:
 
 class _StagedGroup:
     """One DRU-mode group's staged kernel inputs (host arrays already
-    stacked/padded; uploaded by dispatch_group)."""
+    stacked/padded; uploaded by dispatch_group).  With ``resident`` the
+    rows/flags fields are the device-resident buffers (already synced by
+    the delta scatter — dispatch_group must not re-account them as
+    upload bytes)."""
 
     __slots__ = ("gpu_mode", "group", "inp", "structured", "cap", "T", "H",
-                 "stage_ms")
+                 "stage_ms", "resident")
 
     def __init__(self, *, gpu_mode, group, inp, structured, cap, T, H,
-                 stage_ms):
+                 stage_ms, resident=False):
         self.gpu_mode = gpu_mode
         self.group = group
         self.inp = inp
@@ -135,6 +145,7 @@ class _StagedGroup:
         self.T = T
         self.H = H
         self.stage_ms = stage_ms
+        self.resident = resident
 
 
 class _GroupDispatch:
@@ -152,6 +163,26 @@ class _GroupDispatch:
         self.fetched = None
 
 
+class _ResidentPack:
+    """One DRU-mode group's device-resident wire arrays: the [P, T] rows
+    permutation + flags bytes living on device across cycles, plus the
+    host shadow the per-cycle diff runs against.  ``key`` pins the group
+    composition and bucket shape; ``epoch`` the index compaction epoch
+    the row ids are valid in."""
+
+    __slots__ = ("key", "epoch", "rows_dev", "flags_dev", "rows_host",
+                 "flags_host")
+
+    def __init__(self, key, epoch, rows_dev, flags_dev, rows_host,
+                 flags_host):
+        self.key = key
+        self.epoch = epoch
+        self.rows_dev = rows_dev
+        self.flags_dev = flags_dev
+        self.rows_host = rows_host
+        self.flags_host = flags_host
+
+
 class FusedCycleDriver:
     def __init__(self, store: Store, config: Config, matcher: Matcher,
                  plugins, rate_limits, mesh=None):
@@ -165,12 +196,21 @@ class FusedCycleDriver:
         # device-resident mirror of the columnar index's immutable res/disk
         # base columns: rows append-only while the compaction epoch is
         # unchanged, so steady-state cycles upload only the NEW rows
-        self._mir_key: Optional[int] = None   # compaction epoch mirrored
-        self._mir_n = 0                       # rows synced
-        self._mir_cap = 0                     # device buffer capacity
-        self._mir_res = None                  # f32[cap, 4] on device
-        self._mir_disk = None                 # f32[cap] on device
-        self._append_fn = None                # shared jitted chunk append
+        # (ops/delta.DeviceBaseMirror, shared with the columnar rank path)
+        from ..ops.delta import DeviceBaseMirror, PackDeltaApplier
+        self._mirror = DeviceBaseMirror()
+        # device-RESIDENT pack (ISSUE 7 tentpole): the stacked [P, T]
+        # rows/flags wire arrays live in device buffers across cycles,
+        # keyed by DRU mode; each stage diffs the freshly built host
+        # arrays against the shadow and scatter-applies just the delta
+        self._resident: Dict[bool, _ResidentPack] = {}
+        self._applier = PackDeltaApplier()
+        # quiet-pool fast path: the index's tx-event delta feed
+        # (state/index.py attach_pack_consumer) tells the pack when a
+        # pool saw zero churn since its last pack, letting it reuse the
+        # cached [T]-sized arrays wholesale instead of rebuilding them
+        self._delta_cid: Optional[int] = None
+        self._pack_cache: Dict[str, Dict] = {}
 
     # ------------------------------------------------------------------ mesh
     def mesh(self):
@@ -282,58 +322,156 @@ class FusedCycleDriver:
                         fn = self._cycle_fn(gm, cap, True, compact=True)
                         jax.block_until_ready(fn(inp).n_queue)
                         runs += 1
+                if self.config.resident_pack:
+                    # the resident pack's delta scatter compiles once per
+                    # (buffer shape+sharding, delta bucket): warm every
+                    # bucket up to the buffer size so a steady-state
+                    # delta never traces inside a live cycle (the
+                    # zero-recompile guarantee the warmup assertion
+                    # protects).  The warm buffers must carry the SAME
+                    # placement as the live resident buffers — jit keys
+                    # executables on input sharding, so an unsharded warm
+                    # pass would leave the sharded variant cold
+                    from ..ops.delta import _DELTA_MIN_BUCKET
+                    n_flat = P * T
+                    kbs, k = set(), _DELTA_MIN_BUCKET
+                    while k < n_flat:
+                        kbs.add(k)
+                        k *= 2
+                    kbs.add(n_flat)  # the clamped top bucket
+                    if self.mesh().size > 1:
+                        from ..parallel.mesh import pool_sharding
+                        sh = pool_sharding(self.mesh())
+                        rows_b = jax.device_put(
+                            np.zeros((P, T), dtype=np.int32), sh)
+                        flags_b = jax.device_put(
+                            np.zeros((P, T), dtype=np.uint8), sh)
+                    else:
+                        rows_b = jnp.zeros((P, T), dtype=i32)
+                        flags_b = jnp.zeros((P, T), dtype=jnp.uint8)
+                    for k in sorted(kbs):
+                        idx = np.full(k, n_flat, dtype=np.int32)  # no-op
+                        rows_b, flags_b = self._applier.apply(
+                            rows_b, flags_b, idx,
+                            np.zeros(k, dtype=np.int32),
+                            np.zeros(k, dtype=np.uint8))
+                    jax.block_until_ready(rows_b)
         return runs
 
     # ---------------------------------------------------------- base mirror
-    def _append(self, base, chunk, off):
-        """Donating chunk append (jit caches one executable per shape)."""
-        if self._append_fn is None:
-            import jax
-            from jax import lax
-            self._append_fn = jax.jit(
-                lambda b, c, o: lax.dynamic_update_slice(
-                    b, c, (o,) + (0,) * (c.ndim - 1)),
-                donate_argnums=0)
-        return self._append_fn(base, chunk, off)
-
     def _sync_base_mirror(self, res_base: np.ndarray, disk_base: np.ndarray,
                           compactions: int):
-        """Bring the device mirror up to the snapshot: full (re)upload on a
-        compaction epoch change or capacity overflow, else one bucketed
-        chunk append of the rows added since the last cycle.  Returns the
-        (res, disk) device arrays (capacity-padded)."""
-        import jax.numpy as jnp
-        n = res_base.shape[0]
-        full = (self._mir_key != compactions or n > self._mir_cap)
-        if not full and n > self._mir_n:
-            k = n - self._mir_n
-            kb = bucket(k, minimum=1024)
-            if self._mir_n + kb > self._mir_cap:
-                full = True  # dynamic_update_slice would clamp, not grow
+        """Bring the device base mirror up to the snapshot (see
+        ops/delta.DeviceBaseMirror): full (re)upload on a compaction
+        epoch change or capacity overflow, else one bucketed chunk append
+        of the rows added since the last cycle."""
+        return self._mirror.sync(res_base, disk_base, compactions)
+
+    # ------------------------------------------------------- resident pack
+    def reset_resident(self) -> None:
+        """Drop ALL device-resident state — the rows/flags pack, the
+        quiet-pool cache, and the res/disk base mirror — so the next
+        stage rebuilds from scratch (leader handoff, degraded cycle,
+        tests).  The mirror must go too: after a device failure its
+        buffers live on the failed device state, and its compaction-epoch
+        key would otherwise keep handing them out forever.  Safe at any
+        time — residency is a pure mirror of what the next full pack
+        would build."""
+        from ..ops.delta import DeviceBaseMirror
+        self._resident.clear()
+        self._pack_cache.clear()
+        self._mirror = DeviceBaseMirror()
+
+    def _sync_resident(self, gpu_mode: bool, key: Tuple, rows_p: np.ndarray,
+                       flags_p: np.ndarray, epoch: int):
+        """Bring the resident [P, T] rows/flags device buffers up to the
+        freshly staged host arrays: steady state diffs against the host
+        shadow (delta EXTRACTION — native/pack.cpp when built) and
+        dispatches the jitted scatter (ops/delta.PackDeltaApplier) of
+        just the changed positions; a compaction-epoch fence, group/
+        bucket reshape, or kernel fault forces a clean full upload
+        (``cook_resident_repack_total{reason=}``).  Returns
+        (rows_dev, flags_dev)."""
+        from ..native import pack as native_pack
+        from ..utils.faults import injector as _faults
+        from ..utils.metrics import registry
+        st = self._resident.get(gpu_mode)
+        reason = None
+        if st is None:
+            reason = "cold"
+        elif st.key != key:
+            reason = "shape"
+        elif st.epoch != epoch:
+            reason = "compaction"
+        if reason is None:
+            try:
+                _faults.fire("delta.extract")
+                idx = native_pack.pack_diff(st.rows_host, rows_p,
+                                            st.flags_host, flags_p)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "resident-pack delta extraction failed; full repack")
+                registry.counter_inc("cook_kernel_fallback",
+                                     labels={"kernel": "delta.extract"})
+                _flight.note_fault("kernel.dispatch-fallback")
+                reason = "fault"
             else:
-                chunk = np.zeros((kb, 4), dtype=F32)
-                chunk[:k] = res_base[self._mir_n:n]
-                dchunk = np.zeros(kb, dtype=F32)
-                dchunk[:k] = disk_base[self._mir_n:n]
-                off = jnp.asarray(self._mir_n, dtype=jnp.int32)
-                telemetry.count_transfer("h2d",
-                                         chunk.nbytes + dchunk.nbytes)
-                self._mir_res = self._append(
-                    self._mir_res, jnp.asarray(chunk), off)
-                self._mir_disk = self._append(
-                    self._mir_disk, jnp.asarray(dchunk), off)
-                self._mir_n = n
-        if full:
-            cap = bucket(n, minimum=1024)
-            res_p = np.zeros((cap, 4), dtype=F32)
-            res_p[:n] = res_base
-            disk_p = np.zeros(cap, dtype=F32)
-            disk_p[:n] = disk_base
-            telemetry.count_transfer("h2d", res_p.nbytes + disk_p.nbytes)
-            self._mir_res = jnp.asarray(res_p)
-            self._mir_disk = jnp.asarray(disk_p)
-            self._mir_key, self._mir_n, self._mir_cap = compactions, n, cap
-        return self._mir_res, self._mir_disk
+                k = int(idx.size)
+                if k == 0:
+                    _flight.note_delta(0)
+                    return st.rows_dev, st.flags_dev
+                # a scatter pair costs ~9 B/row vs ~5 B/row for the full
+                # upload: past roughly half the table the repack is the
+                # cheaper transfer AND skips the scatter dispatch
+                if 2 * k > rows_p.size:
+                    reason = "oversize"
+                else:
+                    try:
+                        with tracing.span("delta.apply", rows=k,
+                                          gpu=gpu_mode):
+                            _faults.fire("delta.apply")
+                            flat = rows_p.reshape(-1)
+                            fflat = flags_p.reshape(-1)
+                            rows_dev, flags_dev = self._applier.apply(
+                                st.rows_dev, st.flags_dev, idx,
+                                flat[idx], fflat[idx])
+                    except Exception:
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "resident-pack delta apply failed; full repack")
+                        registry.counter_inc(
+                            "cook_kernel_fallback",
+                            labels={"kernel": "delta.apply"})
+                        _flight.note_fault("kernel.dispatch-fallback")
+                        reason = "fault"
+                    else:
+                        registry.counter_inc("cook_delta_rows", float(k))
+                        _flight.note_delta(k)
+                        st.rows_dev, st.flags_dev = rows_dev, flags_dev
+                        st.rows_host, st.flags_host = rows_p, flags_p
+                        return rows_dev, flags_dev
+        import jax.numpy as jnp
+        registry.counter_inc("cook_resident_repack",
+                             labels={"reason": reason})
+        _flight.note_repack(reason)
+        telemetry.count_transfer("h2d", rows_p.nbytes + flags_p.nbytes)
+        mesh = self.mesh()
+        if mesh.size > 1:
+            # each pool shard owns its own resident buffer slice: commit
+            # the [P, T] arrays with the pool-axis sharding the cycle's
+            # shard_map expects (parallel/mesh.pool_sharding)
+            import jax
+            from ..parallel.mesh import pool_sharding
+            sh = pool_sharding(mesh)
+            rows_dev = jax.device_put(rows_p, sh)
+            flags_dev = jax.device_put(flags_p, sh)
+        else:
+            rows_dev = jnp.asarray(rows_p)
+            flags_dev = jnp.asarray(flags_p)
+        self._resident[gpu_mode] = _ResidentPack(
+            key, epoch, rows_dev, flags_dev, rows_p, flags_p)
+        return rows_dev, flags_dev
 
     # ------------------------------------------------------------------ pack
     def _pack_pool_columnar(self, scheduler, pool: Pool, exclude=None,
@@ -352,6 +490,25 @@ class FusedCycleDriver:
         # reserved_hosts concurrently, and every later read in this pack
         # (owner rows, host blocks, local owners) must see the same set
         resv = dict(scheduler.reserved_hosts)
+        # tx-event delta feed (state/index.py attach_pack_consumer): one
+        # drain per pack.  A quiet pool — zero journaled rows, no fence —
+        # reuses its cached [T]-sized pack products wholesale instead of
+        # rebuilding them (the incremental-view-maintenance fast path;
+        # ineligible shapes fall through to the full rebuild below)
+        if self._delta_cid is None:
+            self._delta_cid = idx.attach_pack_consumer()
+        delta = idx.pack_delta(self._delta_cid, pool.name)
+        cached = self._pack_cache.get(pool.name)
+        if (cached is not None and not delta.fence
+                and delta.rows.size == 0
+                and delta.epoch == cached["epoch"]
+                and delta.version == cached["version"]
+                and not self.plugins.launch_filters
+                and not self._resv_owner_in_pack(idx, resv, cached)):
+            return self._pack_pool_cached(scheduler, pool, cached, resv,
+                                          exclude=exclude,
+                                          token_delta=token_delta)
+        self._pack_cache.pop(pool.name, None)
         snap = idx.fused_arrays(pool.name, owner_uuids=list(resv),
                                 compact=True)
         if snap is None:
@@ -380,45 +537,16 @@ class FusedCycleDriver:
         # per-user share/quota TABLES: the kernel gathers them on device via
         # user_rank (CompactPoolCycleInputs), so the host never broadcasts
         # ~32 B/task of user data into [T]-sized columns
-        share_mat = np.stack([
-            np.array([store.get_share(u, pool.name).get(d, INF)
-                      for d in ("cpus", "mem", "gpus")], dtype=F32)
-            for u in users]) if users else np.full((1, 3), INF, dtype=F32)
-        quota_mat = np.stack([
-            _quota_vec(store.get_quota(u, pool.name)) for u in users]) \
-            if users else np.full((1, 4), INF, dtype=F32)
-        pp.shares_u = share_mat
-        pp.quota_u = quota_mat
+        pp.shares_u, pp.quota_u = self._user_tables(pool, users)
 
-        # offers from every cluster serving this pool
-        offers: List[Offer] = []
-        # breaker-filtered: a tripped cluster contributes no offers, so
-        # the kernel routes demand at healthy clusters
-        for cluster in scheduler.launchable_clusters(pool.name):
-            offers.extend(cluster.pending_offers(pool.name))
-        pp.offers = offers
-        pp.n_hosts = len(offers)
-
+        host_index = self._pack_offers(pp, scheduler, pool)
+        offers = pp.offers
         if offers:
             H = len(offers)
-            host_gpu = np.array([o.capacity.gpus > 0 for o in offers],
-                                dtype=bool)
-            host_tasks = np.array([o.task_count for o in offers],
-                                  dtype=np.int32)
-            host_index = {o.hostname: h for h, o in enumerate(offers)}
-            # STRUCTURED mask (no dense [T, H] build or transfer, see
-            # parallel/sharded.StructuredPoolCycleInputs): per-host base
-            # vectors express gpu isolation / max-tasks / reservations
-            # (constraints.clj:122,433,242) for the plain-job majority; the
-            # kernel composes per-row masks on device for only the
-            # compacted match candidates.
-            host_blocked = np.zeros(H, dtype=bool)
-            if cfg.max_tasks_per_host is not None:
-                host_blocked |= host_tasks >= cfg.max_tasks_per_host
             reserved_idx = [host_index[hn]
                             for hn in resv.values()
                             if hn in host_index]
-            host_blocked[reserved_idx] = True
+            pp.host_blocked[reserved_idx] = True
             # exception rows = complex jobs + reservation owners (owners
             # must punch through the blanket reserved-host block; owners
             # whose reserved host serves another pool need no exception)
@@ -455,22 +583,6 @@ class FusedCycleDriver:
             else:
                 pp.exc_mask = np.zeros((1, H), dtype=bool)
                 pp.exc_rows = np.zeros(0, dtype=np.int32)
-            pp.host_gpu = host_gpu
-            pp.host_blocked = host_blocked
-            pp.avail = np.array(
-                [[o.available.cpus, o.available.mem, o.available.gpus,
-                  o.available.disk] for o in offers], dtype=F32)
-            pp.capacity = np.array(
-                [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
-                  o.capacity.disk] for o in offers], dtype=F32)
-        else:
-            pp.host_gpu = np.zeros(1, dtype=bool)
-            pp.host_blocked = np.ones(1, dtype=bool)
-            pp.exc_rows = np.zeros(0, dtype=np.int32)
-            pp.exc_mask = np.zeros((1, 1), dtype=bool)
-            pp.avail = np.zeros((1, 4), dtype=F32)
-            pp.capacity = np.zeros((1, 4), dtype=F32)
-            pp.n_hosts = 0
 
         # offensive-job filter: vectorized over the BASE columns (the
         # compact pack gathers no per-task resource columns), then one
@@ -537,19 +649,7 @@ class FusedCycleDriver:
 
         # launch-rate token budgets per USER (device gathers via user_rank)
         launch_rl = self.rate_limits.job_launch
-        if launch_rl.enforce:
-            from ..policy import pool_user_key
-            pp.tokens_u = np.array(
-                [launch_rl.get_token_count(pool_user_key(pool.name, u))
-                 for u in users], dtype=F32)
-            if token_delta:
-                # tokens an overlapped in-flight cycle will spend at its
-                # apply (the limiter hasn't seen the spends yet)
-                pp.tokens_u = np.maximum(pp.tokens_u - np.array(
-                    [token_delta.get(u, 0.0) for u in users], dtype=F32),
-                    0.0)
-        else:
-            pp.tokens_u = np.full(max(len(users), 1), INF, dtype=F32)
+        pp.tokens_u = self._tokens_u(pool, users, token_delta)
 
         # gang-cohort admission: every gang member is a complex row, so
         # the materialized exception jobs carry the full cohorts
@@ -570,21 +670,190 @@ class FusedCycleDriver:
 
         # the admission bools + user-segment boundaries, packed into one
         # wire byte per task (user_rank/first_idx re-derive on device)
-        from ..parallel.sharded import (
-            FLAG_ENQUEUE_OK,
-            FLAG_LAUNCH_OK,
-            FLAG_PENDING,
-            FLAG_USER_FIRST,
-            FLAG_VALID,
-        )
-        is_first = arrays["first_idx"] == np.arange(T, dtype=np.int32)
-        pp.flags = (
-            pend.astype(np.uint8) * FLAG_PENDING
-            + arrays["valid"].astype(np.uint8) * FLAG_VALID
-            + enqueue_ok.astype(np.uint8) * FLAG_ENQUEUE_OK
-            + launch_ok.astype(np.uint8) * FLAG_LAUNCH_OK
-            + is_first.astype(np.uint8) * FLAG_USER_FIRST)
+        from ..ops.delta import pack_flags
+        pp.flags = pack_flags(pend, arrays["valid"], arrays["is_first"],
+                              enqueue_ok=enqueue_ok, launch_ok=launch_ok)
 
+        # quiet-pool cache (the delta-feed fast path above): only shapes
+        # with no entity-coupled rows are reusable wholesale — no COMPLEX
+        # pending rows (their constraint masks depend on entities the
+        # event feed doesn't cover; checked against the snapshot, NOT
+        # pp.exc_rows, which is only populated when offers exist — an
+        # offer-less cycle must not cache a constrained job as maskless),
+        # no offensive rows (their stifle kills are in flight), no launch
+        # filters (verdict TTLs live outside the index).  Reservations
+        # per se are fine: their blanket host blocks are re-applied per
+        # cycle by the fast path, and an OWNER entering this pool's
+        # pending set is re-checked against the live map on every reuse
+        if (not self.plugins.launch_filters and not pp.offensive
+                and not (pend & complex_rows).any()):
+            flags0 = pp.flags
+            if spec_masked is not None and spec_masked.any():
+                # cache the PRE-speculation flags: the in-flight footprint
+                # changes every cycle and is re-patched by the fast path
+                flags0 = flags0.copy()
+                flags0[spec_masked] |= np.uint8(FLAG_LAUNCH_OK)
+            self._pack_cache[pool.name] = {
+                "epoch": snap.compactions, "version": delta.version,
+                "rows_s": rows_s, "pend": pend, "flags0": flags0,
+                "users": users, "uuid_base": uuid_base,
+                "user_base": snap.user_base, "res_base": snap.res_base,
+                "disk_base": snap.disk_base}
+
+        self._pack_caps(pp, pool)
+        return pp
+
+    def _pack_offers(self, pp: _PackedPool, scheduler, pool: Pool
+                     ) -> Optional[Dict[str, int]]:
+        """Per-cycle offer staging shared by the full pack and the
+        quiet-pool fast path: breaker-filtered offers (a tripped cluster
+        contributes none, so the kernel routes demand at healthy
+        clusters) plus the STRUCTURED per-host base vectors — gpu
+        isolation and max-tasks blocking (constraints.clj:122,433; see
+        parallel/sharded.StructuredPoolCycleInputs) — and the
+        avail/capacity stacks.  Returns hostname -> index for the full
+        path's reservation/exception handling (None when no offers; the
+        empty-offer fallback shapes are set here so the two paths can
+        never diverge)."""
+        cfg = self.config
+        offers: List[Offer] = []
+        for cluster in scheduler.launchable_clusters(pool.name):
+            offers.extend(cluster.pending_offers(pool.name))
+        pp.offers = offers
+        pp.n_hosts = len(offers)
+        if not offers:
+            pp.host_gpu = np.zeros(1, dtype=bool)
+            pp.host_blocked = np.ones(1, dtype=bool)
+            pp.exc_rows = np.zeros(0, dtype=np.int32)
+            pp.exc_mask = np.zeros((1, 1), dtype=bool)
+            pp.avail = np.zeros((1, 4), dtype=F32)
+            pp.capacity = np.zeros((1, 4), dtype=F32)
+            return None
+        H = len(offers)
+        pp.host_gpu = np.array([o.capacity.gpus > 0 for o in offers],
+                               dtype=bool)
+        host_tasks = np.array([o.task_count for o in offers],
+                              dtype=np.int32)
+        host_blocked = np.zeros(H, dtype=bool)
+        if cfg.max_tasks_per_host is not None:
+            host_blocked |= host_tasks >= cfg.max_tasks_per_host
+        pp.host_blocked = host_blocked
+        pp.avail = np.array(
+            [[o.available.cpus, o.available.mem, o.available.gpus,
+              o.available.disk] for o in offers], dtype=F32)
+        pp.capacity = np.array(
+            [[o.capacity.cpus, o.capacity.mem, o.capacity.gpus,
+              o.capacity.disk] for o in offers], dtype=F32)
+        return {o.hostname: h for h, o in enumerate(offers)}
+
+    def _user_tables(self, pool: Pool, users: List[str]
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-user share/quota tables in segment order (shared by the
+        full pack, the quiet-pool fast path, and — via the same module
+        function — the columnar rank path)."""
+        from .ranker import build_user_tables
+        return build_user_tables(self.store, pool.name, users)
+
+    def _tokens_u(self, pool: Pool, users: List[str],
+                  token_delta) -> np.ndarray:
+        """Per-user launch-rate token budgets, net of the pipelined
+        driver's in-flight spends (shared by both pack paths)."""
+        launch_rl = self.rate_limits.job_launch
+        if not launch_rl.enforce:
+            return np.full(max(len(users), 1), INF, dtype=F32)
+        from ..policy import pool_user_key
+        tokens = np.array(
+            [launch_rl.get_token_count(pool_user_key(pool.name, u))
+             for u in users], dtype=F32)
+        if token_delta:
+            # tokens an overlapped in-flight cycle will spend at its
+            # apply (the limiter hasn't seen the spends yet)
+            tokens = np.maximum(tokens - np.array(
+                [token_delta.get(u, 0.0) for u in users], dtype=F32), 0.0)
+        return tokens
+
+    def _resv_owner_in_pack(self, idx, resv: Dict, c: Dict) -> bool:
+        """True when a reservation OWNER is one of the cached pack's
+        pending rows: owners need an exception-mask punch-through, which
+        only the full pack builds.  Plain reservations (owner elsewhere)
+        stay fast-path compatible — their blanket host blocks are
+        per-cycle state applied by _pack_pool_cached."""
+        if not resv:
+            return False
+        owner_rows = idx.rows_for(list(resv))
+        if not owner_rows.size:
+            return False
+        return bool(np.isin(owner_rows, c["rows_s"][c["pend"]]).any())
+
+    def _pack_pool_cached(self, scheduler, pool: Pool, c: Dict,
+                          resv: Dict, exclude=None,
+                          token_delta=None) -> _PackedPool:
+        """Quiet-pool fast path: the index's delta feed reported zero
+        churn since this pool's last pack, so the [T]-sized pack products
+        (sorted rows, admission flags) are reused WHOLESALE — no index
+        snapshot, no order repair, no flags rebuild, no O(T) host work.
+        Only the per-user tables, offers, reserved-host blocks, caps,
+        and the pipelined driver's speculative mask are rebuilt per
+        cycle; the mask is a bit-patch over the cached flags, which the
+        resident pack then ships as a device-side scatter delta — an
+        in-flight footprint is never a repack (ISSUE 7 tentpole (d)).
+
+        Eligibility was checked by the caller + at cache time: no
+        reservation OWNERS pending in this pool, no launch filters, and
+        the cached pack had no exception or offensive rows — so
+        exceptions are empty and enqueue/launch verdicts are all-accept
+        by construction."""
+        pp = _PackedPool(pool)
+        pp.columnar = True
+        pp.compact = True
+        rows_s = c["rows_s"]
+        pp.rows_s = rows_s
+        pp.uuid_base, pp.user_base = c["uuid_base"], c["user_base"]
+        pp.res_base, pp.disk_base = c["res_base"], c["disk_base"]
+        pp.base_compactions = c["epoch"]
+        T = rows_s.size
+        pp.n_tasks = T
+        pend = c["pend"]
+        users = c["users"]
+        pp.shares_u, pp.quota_u = self._user_tables(pool, users)
+
+        host_index = self._pack_offers(pp, scheduler, pool)
+        if host_index is not None:
+            # blanket reserved-host blocks are per-cycle state, applied
+            # here exactly as the full path does (owners needing the
+            # punch-through exception forced a full rebuild upstream)
+            reserved_idx = [host_index[hn] for hn in resv.values()
+                            if hn in host_index]
+            pp.host_blocked[reserved_idx] = True
+            # eligibility guarantees no exception rows; the empty ctx
+            # still carries the co-task host attributes the gang/group
+            # apply path reads
+            pp.exc_mask = np.zeros((1, len(pp.offers)), dtype=bool)
+            pp.exc_rows = np.zeros(0, dtype=np.int32)
+            ctx = self.matcher._constraint_context([], resv)
+            self.matcher._fill_cotask_host_attributes(
+                ctx, pool.name, pp.offers, scheduler.clusters)
+            pp.ctx = ctx
+
+        pp.enqueue_ok = np.ones(T, dtype=bool)
+        launch_ok = np.ones(T, dtype=bool)
+        flags = c["flags0"]
+        if exclude is not None:
+            kind, epoch, rows = exclude
+            if kind == "rows" and epoch == c["epoch"] and len(rows):
+                masked = pend & np.isin(rows_s, rows)
+                if masked.any():
+                    launch_ok = launch_ok & ~masked
+                    flags = flags.copy()
+                    flags[masked] &= np.uint8(~np.uint8(FLAG_LAUNCH_OK))
+                    _flight.note_skips(
+                        {"pipeline-speculative": int(masked.sum())})
+        pp.launch_ok = launch_ok
+        pp.tokens_u = self._tokens_u(pool, users, token_delta)
+        # no gang members by eligibility, but a gang that admitted last
+        # cycle must still shed its stale deferral reason
+        self.matcher.last_admission_deferred[pool.name] = {}
+        pp.flags = flags
         self._pack_caps(pp, pool)
         return pp
 
@@ -851,6 +1120,7 @@ class FusedCycleDriver:
         # "cycle.rank" is the canonical rank-phase span on the cycle trace
         # (flight.PHASE_BY_SPAN): host-side rank staging — the columnar
         # pack that feeds the device the rank+match problem
+        pack_t0 = time.perf_counter()
         with tracing.span("cycle.rank"), tracing.span("fused.pack"):
             for pool in pools:
                 pp = self._pack_pool(scheduler, pool,
@@ -882,6 +1152,8 @@ class FusedCycleDriver:
                             continue
                     refreshed.append(pp)
                 packed = refreshed
+        _flight.note_phase_detail(
+            "pack", (time.perf_counter() - pack_t0) * 1000.0)
         if avail_delta:
             for pp in packed:
                 for h, o in enumerate(pp.offers):
@@ -1002,6 +1274,7 @@ class FusedCycleDriver:
             U = bucket(max(pp.shares_u.shape[0] for pp in group),
                        minimum=8)
             rows_p = np.zeros((P, T), dtype=np.int32)
+            flags_p = np.zeros((P, T), dtype=np.uint8)
             exc_rows_p = np.full((P, E), -1, dtype=np.int32)
             exc_mask_p = np.zeros((P, E, H), dtype=bool)
             host_gpu_p = np.zeros((P, H), dtype=bool)
@@ -1013,6 +1286,7 @@ class FusedCycleDriver:
             tokens_u_p = np.full((P, U), INF, dtype=F32)
             for i, pp in enumerate(group):
                 rows_p[i, :pp.n_tasks] = pp.rows_s
+                flags_p[i, :pp.n_tasks] = pp.flags
                 exc_rows_p[i, :len(pp.exc_rows)] = pp.exc_rows
                 e, h = pp.exc_mask.shape
                 exc_mask_p[i, :e, :h] = pp.exc_mask
@@ -1022,9 +1296,20 @@ class FusedCycleDriver:
                 shares_u_p[i, :pp.shares_u.shape[0]] = pp.shares_u
                 quota_u_p[i, :pp.quota_u.shape[0]] = pp.quota_u
                 tokens_u_p[i, :pp.tokens_u.shape[0]] = pp.tokens_u
+            if self.config.resident_pack:
+                # DEVICE-RESIDENT wire arrays: steady state ships only
+                # the scatter delta, not the [P, T] world (ISSUE 7)
+                key = (tuple(pp.pool.name for pp in group), P, T)
+                rows_dev, flags_dev = self._sync_resident(
+                    gpu_mode, key, rows_p, flags_p, epoch)
+                resident = True
+            else:  # rebuild mode: dispatch_group accounts the upload
+                rows_dev = jnp.asarray(rows_p)
+                flags_dev = jnp.asarray(flags_p)
+                resident = False
             inp = CompactPoolCycleInputs(
-                rows=jnp.asarray(rows_p),
-                flags=jnp.asarray(stack(lambda pp: padT(pp.flags, 0))),
+                rows=rows_dev,
+                flags=flags_dev,
                 res_base=mir_res,
                 disk_base=mir_disk,
                 tokens_u=jnp.asarray(tokens_u_p),
@@ -1069,9 +1354,12 @@ class FusedCycleDriver:
             self.config.matcher_for_pool(pp.pool.name).max_jobs_considered
             for pp in group))
         stage_ms = round((time.perf_counter() - stage_t0) * 1000.0, 1)
+        _flight.note_phase_detail("stage", stage_ms)
         return _StagedGroup(gpu_mode=gpu_mode, group=group, inp=inp,
                             structured=structured, cap=cap, T=T, H=H,
-                            stage_ms=stage_ms)
+                            stage_ms=stage_ms,
+                            resident=structured and bool(
+                                self.config.resident_pack))
 
     def dispatch_group(self, sg: "_StagedGroup") -> "_GroupDispatch":
         """Phase 2: upload one staged group's inputs and dispatch the
@@ -1080,13 +1368,18 @@ class FusedCycleDriver:
         whatever the host does in between (the pipelined driver's whole
         point)."""
         telemetry.profile_upload(sg.stage_ms, sg.inp)
-        # staged wire bytes this dispatch (the device-resident base
-        # mirror fields are NOT re-uploaded per cycle — the mirror
-        # sync accounts its own uploads)
+        # staged wire bytes this dispatch: the device-resident base
+        # mirror fields are never re-uploaded per cycle (the mirror sync
+        # accounts its own transfers), and in resident-pack mode the
+        # rows/flags buffers are device-resident too — only their delta
+        # scatter moved bytes, accounted by _sync_resident
+        skip = {"res_base", "disk_base"}
+        if sg.resident:
+            skip |= {"rows", "flags"}
         telemetry.count_transfer("h2d", sum(
             getattr(a, "nbytes", 0)
             for name, a in zip(type(sg.inp)._fields, sg.inp)
-            if name not in ("res_base", "disk_base")))
+            if name not in skip))
         with tracing.span("fused.dispatch", pools=len(sg.group),
                           tasks=sg.T, hosts=sg.H, gpu=sg.gpu_mode,
                           stage_ms=sg.stage_ms):
@@ -1130,12 +1423,15 @@ class FusedCycleDriver:
         pipelined driver's pre-launch re-validation hook (see
         :meth:`_apply_pool`)."""
         cand_row, cand_assign, cand_qpos, n_queue = gd.fetched
+        apply_t0 = time.perf_counter()
         with tracing.span("cycle.launch", pools=len(gd.sg.group)):
             for i, pp in enumerate(gd.sg.group):
                 self._apply_pool(scheduler, pp, cand_row[i],
                                  cand_assign[i], cand_qpos[i],
                                  int(n_queue[i]), gd.res.queue_rows, i,
                                  queues, results, reconciler=reconciler)
+        _flight.note_phase_detail(
+            "apply", (time.perf_counter() - apply_t0) * 1000.0)
 
     def step(self, scheduler) -> Tuple[Dict[str, List[Job]],
                                        Dict[str, MatchCycleResult]]:
@@ -1200,9 +1496,9 @@ class FusedCycleDriver:
         def local_rows_with_drops(drop_qpos) -> np.ndarray:
             rows = fetch_local_rows()
             if drop_qpos is not None and len(drop_qpos):
-                keep = np.ones(len(rows), dtype=bool)
-                keep[drop_qpos] = False
-                rows = rows[keep]
+                # post-match queue prune (native/pack.cpp when built)
+                from ..native.pack import prune_rows
+                rows = prune_rows(rows, np.unique(drop_qpos))
             return rows
 
         def publish_queue(drop_qpos=None):

@@ -68,6 +68,21 @@ def _pool_quota_vec(q: PoolQuota) -> np.ndarray:
     return np.array([q.cpus, q.mem, q.gpus, q.count], dtype=F32)
 
 
+def build_user_tables(store: Store, pool_name: str, users) -> tuple:
+    """Per-user share/quota tables in segment order — the compact wire
+    form's U-sized control arrays, gathered on device via user_rank.
+    ONE builder shared by the fused pack and the columnar rank path so
+    the two decision-identical paths cannot drift."""
+    share_mat = np.stack([
+        np.array([store.get_share(u, pool_name).get(d, np.inf)
+                  for d in ("cpus", "mem", "gpus")], dtype=F32)
+        for u in users]) if users else np.full((1, 3), np.inf, dtype=F32)
+    quota_mat = np.stack([
+        _quota_vec(store.get_quota(u, pool_name)) for u in users]) \
+        if users else np.full((1, 4), np.inf, dtype=F32)
+    return share_mat, quota_mat
+
+
 class RankedQueue:
     """Lazy ranked queue: uuids + resource columns from the columnar index;
     Job entities are materialized only for the prefix a consumer actually
@@ -182,6 +197,16 @@ class Ranker:
         self.store = store
         self.config = config
         self.backend = backend
+        # device-resident res/disk base mirror for the compact rank wire
+        # form (ops/delta.DeviceBaseMirror), created on first columnar rank
+        self._mirror = None
+
+    def reset_device_state(self) -> None:
+        """Drop the rank path's device base mirror (device failure /
+        degraded cycle): its sync is keyed on the compaction epoch, so
+        after a device restart it would keep handing out dead buffers
+        until the next index compaction."""
+        self._mirror = None
 
     def rank_pool(self, pool_name: str,
                   dru_mode: DruMode = DruMode.DEFAULT) -> List[Job]:
@@ -221,36 +246,60 @@ class Ranker:
     # -- columnar fast path (state/index.py; VERDICT r1 weak #4) -----------
     def _rank_pool_columnar(self, pool_name: str, dru_mode: DruMode):
         """Rank straight off the incrementally-maintained columnar index:
-        no entity deep-copies, no per-task Python on the hot path."""
+        no entity deep-copies, no per-task Python on the hot path — and
+        since ISSUE 7, no [T]-sized host staging either: the per-task
+        upload is the sorted row permutation + one flags byte
+        (ops/dru.CompactRankInputs), usage is gathered on device from the
+        resident base mirror, shares/quota ride per-USER tables, and the
+        ranked queue is a lazy selection over the index's base snapshots
+        (no full uuid/user unicode gathers)."""
         import jax.numpy as jnp
-        from ..ops import rank_kernel
-        from ..ops.dru import RankInputs
+        from ..ops import CompactRankInputs, bucket, rank_kernel_compact
+        from ..ops import telemetry
+        from ..ops.delta import DeviceBaseMirror, pack_flags
 
         idx = self.store.ensure_index()
-        got = idx.rank_arrays(pool_name)
-        if got is None:
+        snap = idx.fused_arrays(pool_name, compact=True)
+        if snap is None:
             return RankedQueue(self.store, np.zeros(0, dtype="<U36"),
                                np.zeros((0, 4), dtype=F32))
-        arrays, uuids_sorted, row_users, users = got
-        counts = np.bincount(arrays["user_rank"],
-                             minlength=len(users)).astype(np.int64)
-        share_mat = np.stack([
-            np.array([self.store.get_share(u, pool_name).get(d, np.inf)
-                      for d in ("cpus", "mem", "gpus")], dtype=F32)
-            for u in users])
-        quota_mat = np.stack([
-            _quota_vec(self.store.get_quota(u, pool_name)) for u in users])
-        arrays["shares"] = np.repeat(share_mat, counts, axis=0)
-        arrays["quota"] = np.repeat(quota_mat, counts, axis=0)
-        arrays = host_prep.pad_rank_arrays(arrays)
-        res = rank_kernel(
-            RankInputs(**{k: jnp.asarray(v) for k, v in arrays.items()}),
+        arrays, rows_s, users = snap.arrays, snap.rows_s, snap.users
+        T = rows_s.size
+        share_mat, quota_mat = build_user_tables(self.store, pool_name,
+                                                 users)
+        flags = pack_flags(arrays["pending"], arrays["valid"],
+                           arrays["is_first"])
+        TB = bucket(T)
+        rows_p = np.zeros(TB, dtype=np.int32)
+        rows_p[:T] = rows_s
+        flags_p = np.zeros(TB, dtype=np.uint8)  # padding: valid=False
+        flags_p[:T] = flags
+        UB = bucket(max(len(users), 1), minimum=8)
+        shares_u = np.full((UB, 3), np.inf, dtype=F32)
+        shares_u[:share_mat.shape[0]] = share_mat
+        quota_u = np.full((UB, 4), np.inf, dtype=F32)
+        quota_u[:quota_mat.shape[0]] = quota_mat
+        if self._mirror is None:
+            self._mirror = DeviceBaseMirror()
+        res_dev, _disk_dev = self._mirror.sync(
+            snap.res_base, snap.disk_base, snap.compactions)
+        telemetry.count_transfer(
+            "h2d", rows_p.nbytes + flags_p.nbytes + shares_u.nbytes
+            + quota_u.nbytes)
+        res = rank_kernel_compact(
+            CompactRankInputs(rows=jnp.asarray(rows_p),
+                              flags=jnp.asarray(flags_p),
+                              res_base=res_dev,
+                              shares_u=jnp.asarray(shares_u),
+                              quota_u=jnp.asarray(quota_u)),
             gpu_mode=dru_mode is DruMode.GPU,
             max_over_quota_jobs=self.config.max_over_quota_jobs)
         n = int(res.num_ranked)
-        order = np.asarray(res.order)[:n]
-        queue = RankedQueue(self.store, uuids_sorted[order],
-                            arrays["usage"][order], row_users[order])
+        with telemetry.sync_wait("rank.order"):
+            order = np.asarray(res.order[:n])
+        telemetry.count_transfer("d2h", order.nbytes)
+        queue = RankedQueue(self.store, snap.uuid_base, snap.res_base,
+                            snap.user_base, rows=rows_s[order])
         return self._apply_pool_quota_columnar(pool_name, queue)
 
     def _apply_pool_quota_columnar(self, pool_name: str,

@@ -655,6 +655,12 @@ class Scheduler:
                     # in-flight speculation may reference the failed
                     # device state; drop it (nothing was transacted)
                     self._pipeline.reset()
+                # resident buffers may live on the failed device state
+                # too: rebuild them from scratch next fused cycle — and
+                # the split-path Ranker this very fallback runs has its
+                # own device base mirror to shed
+                self._fused.reset_resident()
+                self.ranker.reset_device_state()
                 degraded = True
             finally:
                 if gc_paused:

@@ -61,6 +61,15 @@ def main(argv=None) -> int:
                         "invariant every tick (docs/GANG.md)")
     p.add_argument("--gang-size", type=int, default=None,
                    help="chaos: members per gang (default 3)")
+    p.add_argument("--resident", action="store_true",
+                   help="chaos: drive the fused cycle off the columnar "
+                        "index with the DEVICE-RESIDENT pack on (ISSUE "
+                        "7); leader kill rebuilds the resident pack on "
+                        "the promoted driver")
+    p.add_argument("--delta-faults", type=float, default=None,
+                   help="chaos: per-call fire probability for the "
+                        "delta.extract/delta.apply fault points (each "
+                        "hit degrades that cycle to a full repack)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -100,6 +109,10 @@ def main(argv=None) -> int:
             cc.n_gangs = args.gangs
         if args.gang_size is not None:
             cc.gang_size = args.gang_size
+        if args.resident:
+            cc.resident = True
+        if args.delta_faults is not None:
+            cc.delta_fault_probability = args.delta_faults
         result = run_chaos(cc)
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1

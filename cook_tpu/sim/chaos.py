@@ -103,6 +103,16 @@ class ChaosConfig:
     # one gang is timed to submit just before the leader kill so the
     # crash window reliably lands inside a gang launch
     gang_at_kill: bool = True
+    # resident-mode chaos (ISSUE 7, docs/PERFORMANCE.md): drive the
+    # fused cycle off the columnar index with the DEVICE-RESIDENT pack
+    # on (the production wire form), optionally storming the
+    # delta.extract / delta.apply fault points — every hit must degrade
+    # that cycle to a clean full repack (cook_kernel_fallback_total,
+    # cook_resident_repack_total{reason="fault"}) while scheduling
+    # continues, and the leader kill's journal-replay promotion must
+    # rebuild the resident pack from scratch on the successor's driver
+    resident: bool = False
+    delta_fault_probability: float = 0.0
 
 
 @dataclass
@@ -114,6 +124,7 @@ class ChaosResult:
     violations: List[str] = field(default_factory=list)
     node_losses: int = 0
     rpc_faults: int = 0
+    delta_faults: int = 0
     leader_kills: int = 0
     intents_open_at_kill: int = 0
     relaunched_after_kill: int = 0
@@ -136,6 +147,7 @@ class ChaosResult:
             "violations": list(self.violations),
             "node_losses": self.node_losses,
             "rpc_faults": self.rpc_faults,
+            "delta_faults": self.delta_faults,
             "leader_kills": self.leader_kills,
             "intents_open_at_kill": self.intents_open_at_kill,
             "relaunched_after_kill": self.relaunched_after_kill,
@@ -154,10 +166,10 @@ class _LeaderCrash(BaseException):
 
 def _scheduler_config(cc: ChaosConfig) -> Config:
     cfg = Config()
-    if cc.pipeline_depth > 0:
-        # production pipelined fused cycle under chaos: overlapped
-        # optimistic dispatches + reconciliation are exactly what the
-        # duplicate-live invariant must hold against
+    if cc.pipeline_depth > 0 or cc.resident:
+        # production fused cycle under chaos (pipelined when depth > 0):
+        # overlapped optimistic dispatches + reconciliation are exactly
+        # what the duplicate-live invariant must hold against
         cfg.cycle_mode = "fused"
         cfg.pipeline.depth = cc.pipeline_depth
     else:
@@ -166,8 +178,11 @@ def _scheduler_config(cc: ChaosConfig) -> Config:
         # tests)
         cfg.cycle_mode = "split"
         cfg.pipeline.depth = 0
+    # resident mode needs the columnar compact wire form; otherwise the
+    # entity pack keeps chaos deterministic as before
+    cfg.columnar_index = bool(cc.resident)
+    cfg.resident_pack = bool(cc.resident)
     cfg.default_matcher.backend = "cpu"
-    cfg.columnar_index = False
     cfg.circuit_breaker.failure_threshold = cc.breaker_failure_threshold
     cfg.circuit_breaker.reset_timeout_s = cc.breaker_reset_timeout_s
     return cfg
@@ -237,6 +252,15 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         injector.arm("cluster.launch",
                      probability=cc.rpc_fault_probability,
                      max_fires=cc.rpc_fault_max)
+    if cc.delta_fault_probability > 0:
+        # resident-pack kernel faults: extraction and scatter-apply each
+        # degrade that cycle to a full repack, never kill it (both armed
+        # at the configured per-call probability, as --delta-faults
+        # documents)
+        injector.arm("delta.extract",
+                     probability=cc.delta_fault_probability)
+        injector.arm("delta.apply",
+                     probability=cc.delta_fault_probability)
     flight_seq0 = flight_recorder.last_seq()
 
     cfg = _scheduler_config(cc)
@@ -320,7 +344,7 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
 
         FakeCluster.launch_tasks = crash
         try:
-            if cc.pipeline_depth > 0:
+            if cc.pipeline_depth > 0 or cc.resident:
                 scheduler.step_cycle()
             else:
                 scheduler.step_rank()
@@ -381,7 +405,7 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         if now >= next_node_loss:
             next_node_loss = now + cc.node_loss_every_ms
             fail_one_node()
-        if cc.pipeline_depth > 0:
+        if cc.pipeline_depth > 0 or cc.resident:
             scheduler.step_cycle()
         else:
             scheduler.step_rank()
@@ -408,6 +432,9 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     result.makespan_ms = now_box[0] - start_ms
     result.rpc_faults = injector.active().get(
         "cluster.launch", {}).get("fires", 0)
+    result.delta_faults = sum(
+        injector.active().get(p, {}).get("fires", 0)
+        for p in ("delta.extract", "delta.apply"))
     # MEASURED relaunches: a crash-window job gained an instance after
     # the kill (the refund->relaunch path actually ran, not assumed)
     result.relaunched_after_kill = sum(

@@ -26,6 +26,7 @@ within a user by the feature key (-priority, start, submit, uuid)
 
 from __future__ import annotations
 
+import itertools
 import re
 import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
@@ -46,11 +47,18 @@ PENDING_START = np.int64(2**62)
 
 _LIVE = (InstanceStatus.UNKNOWN, InstanceStatus.RUNNING)
 
-# composite sort key for the per-pool incremental order cache; field order
-# IS the comparison order and must equal the lexsort key order below
-# (uid, -prio, start, submit, uuid-hi, uuid-lo)
-_KEY_DT = np.dtype([("uid", "i4"), ("nprio", "i4"), ("st", "i8"),
-                    ("sb", "i8"), ("uh", "u8"), ("ul", "u8")])
+# composite sort key for the per-pool incremental order cache, packed as
+# fixed-width big-endian byte strings so every comparison is one memcmp
+# (numpy structured-dtype comparisons cost 3-4x more in the searchsorted
+# merge).  Field order IS the comparison order and must equal the lexsort
+# key order below: (uid, -prio, start, submit, uuid-hi, uuid-lo), each
+# field sign-biased into unsigned big-endian bytes so byte order equals
+# numeric order.  At fixed width the S-dtype's trailing-NUL-stripping
+# compare is exactly memcmp: two keys differing only in trailing zeros
+# cannot exist (both are the full 40 bytes), and at the first differing
+# byte both stripped forms still disagree there.
+_KEY_NBYTES = 40
+_KEY_DT = np.dtype(f"S{_KEY_NBYTES}")
 
 # canonical lowercase uuid: ONLY this form sorts identically as a string
 # and as a 128-bit integer (int(h, 16) would also accept uppercase/'0x'/
@@ -67,8 +75,8 @@ class FusedSnapshot(NamedTuple):
     views stay valid; ``compactions`` keys device-side mirrors of the
     res/disk base columns (unchanged counter = row indices stable)."""
 
-    arrays: Dict[str, np.ndarray]   # first_idx/user_rank/pending/valid
-    #                                 (+ usage unless compact)
+    arrays: Dict[str, np.ndarray]   # pending/valid/is_first (+ first_idx/
+    #                                 user_rank/usage unless compact)
     rows_s: np.ndarray              # i64[T] sorted absolute base rows
     uuid_base: np.ndarray           # U36[n] by row
     user_base: np.ndarray           # U64[n] by row
@@ -79,6 +87,24 @@ class FusedSnapshot(NamedTuple):
     complex_s: np.ndarray           # bool[T] entity-constraint rows
     owner_rows: Dict[str, int]      # reservation owner uuid -> base row
     compactions: int                # index compaction epoch at snapshot
+
+
+class PackDelta(NamedTuple):
+    """One consumer's drained per-pool delta batch (see
+    :meth:`ColumnarIndex.pack_delta`): the tx-event feed compacted into
+    the row set a device-resident pack consumer must reconcile, plus an
+    explicit compaction-epoch fence.  ``rows``/``tombstones`` are base
+    row ids valid ONLY within ``epoch``; a ``fence`` means row ids were
+    remapped (compaction), the user-id space shifted, or sorted mode
+    flipped — the consumer must full-repack, never scatter."""
+
+    epoch: int              # index compaction epoch the row ids live in
+    fence: bool             # True -> full repack required
+    rows: np.ndarray        # i64[k] rows touched since the last drain
+    tombstones: np.ndarray  # i64[m] rows that LEFT the pack (pending off
+    #                         or live instance removed); subset semantics:
+    #                         also present in ``rows``
+    version: int            # the pool's pack version at drain time
 
 
 def _is_complex(job) -> bool:
@@ -156,13 +182,26 @@ class ColumnarIndex:
         self._inst_job_row = np.zeros(1024, dtype=np.int64)
         self._inst_start = np.zeros(1024, dtype=np.int64)
         self._ninst = 0
-        # per-pool incremental sorted order: pool -> {"keys": sorted
-        # _KEY_DT array, "rows": row index per entry, "log": ordered
+        # per-pool incremental sorted order: pool -> {"kb": sorted _KEY_DT
+        # byte-key array, "st": i64 start per entry, "uid": i32 user id
+        # per entry, "rows": row index per entry, "log": ordered
         # (+1/-1, row, start) delta journal}.  The full lexsort is ~40 ms
         # at the 100k design point and re-ran every cycle; scheduling churn
         # only touches O(launched) rows, so the order is repaired by
         # searchsorted merge instead.
         self._ord: Dict[str, Dict] = {}
+        # ---- delta feed (device-resident pack consumers) ----
+        # consumer id -> {"pools": {pool: {"rows": set, "tombs": set}},
+        #                 "fence_seen": {pool: fence_version}}
+        self._consumers: Dict[int, Dict] = {}
+        self._consumer_ids = itertools.count(1)
+        # bumped on EVERY event that touches a pool's pack (membership,
+        # pending flips, instance churn); cheap equality token for "has
+        # anything about this pool changed since my last pack"
+        self._pool_version: Dict[str, int] = {}
+        # bumped on global order invalidations: compaction (row remap),
+        # user-id shift (cached keys embed ids), sorted-mode flip
+        self._fence_version = 0
         self._attach()
 
     # ------------------------------------------------------------ lifecycle
@@ -268,13 +307,16 @@ class ColumnarIndex:
             self._res[row] = (r.cpus, r.mem, r.gpus, 1.0)
             self._disk[row] = r.disk
             self._prio[row] = job.priority
-            self._uid[row] = self._user_id(job.user)
+            self._uid[row] = self._user_id(job.user, new_row=row)
             if _CANON_UUID.match(job.uuid):
                 h = job.uuid.replace("-", "")
                 self._uhi[row] = np.uint64(int(h[:16], 16))
                 self._ulo[row] = np.uint64(int(h[16:], 16))
-            else:
+            elif self._sortable:
+                # sorted-mode flip: cached byte keys and resident row
+                # orders are built on the int-key order — fence them
                 self._sortable = False
+                self._fence_all()
             self._submit[row] = job.submit_time_ms
             self._uuid[row] = job.uuid
             self._user = _fit_str(self._user, job.user)
@@ -295,20 +337,31 @@ class ColumnarIndex:
         if done != self._done[row]:
             self._dead += 1 if done else -1  # retry paths resurrect rows
             self._done[row] = done
+        # delta feed: every synced row is a touch; leaving the pending
+        # set is a tombstone (the resident pack row becomes a running or
+        # dead row, never a stale pending scatter)
+        self._touch_row(str(self._pool[row]), row,
+                        tomb=was_pending and not now_pending)
 
-    def _user_id(self, user: str) -> int:
+    def _user_id(self, user: str, new_row: Optional[int] = None) -> int:
         """Order-preserving user id (caller holds the lock).  A new name
         inserts into the sorted list and shifts every later id up — one
-        vectorized pass, and only when a never-seen user first submits."""
+        vectorized pass, and only when a never-seen user first submits.
+        ``new_row`` is the not-yet-assigned row this id is FOR: its slot
+        still holds uid 0 and must not count as a shifted existing key
+        (it would fence/clear on every first-in-sort-order user)."""
         import bisect
         pos = bisect.bisect_left(self._user_names, user)
         if pos < len(self._user_names) and self._user_names[pos] == user:
             return pos
         self._user_names.insert(pos, user)
         shift = self._uid[:self._n] >= pos
+        if new_row is not None and new_row < self._n:
+            shift[new_row] = False
         if shift.any():
             self._uid[:self._n][shift] += 1
             self._ord.clear()  # cached keys embed the shifted ids
+            self._fence_all()  # so do resident consumers' sorted orders
         return pos
 
     def _add_instance_raw(self, inst) -> None:
@@ -326,18 +379,22 @@ class ColumnarIndex:
         self._inst_job_row[slot] = row
         self._inst_start[slot] = inst.start_time_ms
         self._inst_slot[inst.task_id] = slot
-        e = self._ord.get(str(self._pool[row]))
+        pool = str(self._pool[row])
+        e = self._ord.get(pool)
         if e is not None:
             e["log"].append((1, int(row), int(inst.start_time_ms)))
+        self._touch_row(pool, int(row))
 
     def _remove_instance_raw(self, task_id: str) -> None:
         slot = self._inst_slot.pop(task_id, None)
         if slot is None:
             return
         row = self._inst_job_row[slot]
-        e = self._ord.get(str(self._pool[row]))
+        pool = str(self._pool[row])
+        e = self._ord.get(pool)
         if e is not None:
             e["log"].append((-1, int(row), int(self._inst_start[slot])))
+        self._touch_row(pool, int(row), tomb=True)
         last = self._ninst - 1
         if slot != last:
             self._inst_job_row[slot] = self._inst_job_row[last]
@@ -346,6 +403,64 @@ class ColumnarIndex:
             self._inst_task[slot] = moved
             self._inst_slot[moved] = slot
         self._ninst = last
+
+    # ------------------------------------------------------------ delta feed
+    def attach_pack_consumer(self) -> int:
+        """Register a device-resident pack consumer: from now on every tx
+        event that touches a pool's pack is journaled for this consumer
+        (row ids + tombstones + fences) until :meth:`pack_delta` drains
+        it.  Consumers attach cold (their first pack is a full build), so
+        the journal starts empty."""
+        with self._lock:
+            cid = next(self._consumer_ids)
+            self._consumers[cid] = {"pools": {}, "fence_seen": {}}
+            return cid
+
+    def detach_pack_consumer(self, cid: int) -> None:
+        with self._lock:
+            self._consumers.pop(cid, None)
+
+    def pack_delta(self, cid: int, pool: str) -> PackDelta:
+        """Drain one pool's journaled delta batch for a consumer: the
+        compact per-cycle change feed of the incremental-view-maintenance
+        path (ISSUE 7; McSherry-style deltas, not rebuilds).  A ``fence``
+        (compaction row remap, user-id shift, sorted-mode flip) means the
+        consumer's resident row ids are invalid — full repack."""
+        with self._lock:
+            c = self._consumers.get(cid)
+            if c is None:  # detached/unknown: behave as a permanent fence
+                return PackDelta(self.compactions, True,
+                                 np.zeros(0, dtype=np.int64),
+                                 np.zeros(0, dtype=np.int64), -1)
+            fence = self._fence_version > c["fence_seen"].get(pool, 0)
+            c["fence_seen"][pool] = self._fence_version
+            d = c["pools"].pop(pool, None)
+            rows = np.fromiter(d["rows"], dtype=np.int64,
+                               count=len(d["rows"])) if d else \
+                np.zeros(0, dtype=np.int64)
+            tombs = np.fromiter(d["tombs"], dtype=np.int64,
+                                count=len(d["tombs"])) if d else \
+                np.zeros(0, dtype=np.int64)
+            return PackDelta(self.compactions, fence, rows, tombs,
+                             self._pool_version.get(pool, 0))
+
+    def _touch_row(self, pool: str, row: int, tomb: bool = False) -> None:
+        """Journal one row touch for every attached consumer (caller
+        holds self._lock)."""
+        self._pool_version[pool] = self._pool_version.get(pool, 0) + 1
+        for c in self._consumers.values():
+            d = c["pools"].get(pool)
+            if d is None:
+                d = c["pools"][pool] = {"rows": set(), "tombs": set()}
+            d["rows"].add(int(row))
+            if tomb:
+                d["tombs"].add(int(row))
+
+    def _fence_all(self) -> None:
+        """Global order invalidation (caller holds self._lock): every
+        consumer must full-repack every pool before trusting row ids or
+        cached keys again."""
+        self._fence_version += 1
 
     # ------------------------------------------------------------ tx events
     def _on_events(self, tx_id: int, events) -> None:
@@ -395,27 +510,44 @@ class ColumnarIndex:
             return (arrays, self._uuid[rows_s], user_s,
                     list(user_s[seg_start]))
 
-    def _keys_for(self, rows: np.ndarray, start: np.ndarray) -> np.ndarray:
-        """Composite sort keys for (row, start) task entries (caller holds
-        _lock).  Field comparison order must match the lexsort keys."""
-        k = np.empty(len(rows), dtype=_KEY_DT)
-        k["uid"] = self._uid[rows]
-        k["nprio"] = -self._prio[rows]
-        k["st"] = start
-        k["sb"] = self._submit[rows]
-        k["uh"] = self._uhi[rows]
-        k["ul"] = self._ulo[rows]
-        return k
+    def _key_fields(self, rows: np.ndarray, start: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(byte keys, start, uid) for (row, start) task entries (caller
+        holds _lock).  Keys are fixed-width big-endian byte strings —
+        each field sign-biased so that one memcmp equals the lexsort
+        field comparison order below."""
+        n = len(rows)
+        kb = np.empty((n, _KEY_NBYTES), dtype=np.uint8)
+        uid = self._uid[rows].astype(np.int32, copy=True)
+        st = np.ascontiguousarray(start, dtype=np.int64)
+
+        def be32(x, off):  # i64-safe signed -> biased big-endian u32
+            kb[:, off:off + 4] = (x.astype(np.int64) + 2**31) \
+                .astype(">u4").view(np.uint8).reshape(n, 4)
+
+        def be64(x, off):  # u64 (sign bit pre-flipped for signed) -> BE
+            kb[:, off:off + 8] = x.astype(">u8").view(np.uint8) \
+                .reshape(n, 8)
+
+        be32(uid, 0)
+        be32(-self._prio[rows], 4)  # int32 negation, as in the lexsort
+        be64(st.astype(np.uint64) ^ np.uint64(1 << 63), 8)
+        be64(self._submit[rows].astype(np.uint64) ^ np.uint64(1 << 63), 16)
+        be64(self._uhi[rows], 24)
+        be64(self._ulo[rows], 32)
+        return kb.reshape(-1).view(_KEY_DT), st, uid
 
     def _repair_order(self, e: Dict) -> None:
         """Apply the journaled (row, start) add/del deltas to one pool's
         cached sorted order by searchsorted merge — O(churn log n + n
-        memcpy) instead of the full O(n log n) lexsort.
+        memcpy) instead of the full O(n log n) lexsort.  The memcpy tail
+        runs in native/pack.cpp when the toolchain built it (one merge
+        pass over the four parallel arrays) and falls back to
+        np.delete/np.insert otherwise.
 
         The journal is order-preserving: an entry added and removed between
         two ranks (launch then completion inside one cycle) must cancel,
         not apply as a del-miss followed by a stale insert."""
-        keys, rows = e["keys"], e["rows"]
         adds: Dict[Tuple[int, int], int] = {}
         dels: List[Tuple[int, int]] = []
         for op, row, start in e["log"]:
@@ -427,35 +559,49 @@ class ColumnarIndex:
             else:
                 dels.append(k)
         e["log"] = []
+        if not dels and not adds:
+            return
+        kb, st, uid, rows = e["kb"], e["st"], e["uid"], e["rows"]
+        del_pos = np.zeros(0, dtype=np.int64)
         if dels:
             drows = np.array([r for r, _ in dels], dtype=np.int64)
             dstart = np.array([s for _, s in dels], dtype=np.int64)
-            dkeys = self._keys_for(drows, dstart)
-            dorder = np.argsort(dkeys, kind="stable")
-            dkeys, drows = dkeys[dorder], drows[dorder]
-            pos = np.searchsorted(keys, dkeys, side="left")
+            dkb, _dst, _duid = self._key_fields(drows, dstart)
+            dkb = dkb[np.argsort(dkb, kind="stable")]
+            pos = np.searchsorted(kb, dkb, side="left")
             # identical keys (same job, same start) form a run: the k-th
             # duplicate delete takes the k-th entry of the run
             for i in range(1, len(pos)):
-                if pos[i] <= pos[i - 1] and dkeys[i] == dkeys[i - 1]:
+                if pos[i] <= pos[i - 1] and dkb[i] == dkb[i - 1]:
                     pos[i] = pos[i - 1] + 1
-            ok = (pos < len(keys)) & (keys[np.minimum(pos, len(keys) - 1)]
-                                      == dkeys)
-            pos = pos[ok]  # a miss means the entry predates the cache
-            if len(pos):
-                keys = np.delete(keys, pos)
-                rows = np.delete(rows, pos)
+            # a miss means the entry predates the cache; `pos` is already
+            # sorted (nondecreasing from sorted needles, strictly advanced
+            # within equal-key runs)
+            ok = pos < len(kb)
+            if ok.any():
+                ok[ok] = kb[pos[ok]] == dkb[ok]
+            del_pos = pos[ok].astype(np.int64)
         add_list = [k for k, c in adds.items() for _ in range(c)]
         if add_list:
             arows = np.array([r for r, _ in add_list], dtype=np.int64)
             astart = np.array([s for _, s in add_list], dtype=np.int64)
-            akeys = self._keys_for(arows, astart)
-            aorder = np.argsort(akeys, kind="stable")
-            akeys, arows = akeys[aorder], arows[aorder]
-            pos = np.searchsorted(keys, akeys, side="left")
-            keys = np.insert(keys, pos, akeys)
-            rows = np.insert(rows, pos, arows)
-        e["keys"], e["rows"] = keys, rows
+            akb, ast, auid = self._key_fields(arows, astart)
+            aorder = np.argsort(akb, kind="stable")
+            akb, ast, auid, arows = \
+                akb[aorder], ast[aorder], auid[aorder], arows[aorder]
+            # insertion points in the POST-delete array, computed without
+            # materializing it: entries before a side="left" boundary are
+            # strictly smaller, so deletions below the boundary shift it
+            # down one-for-one
+            ins = np.searchsorted(kb, akb, side="left")
+            if len(del_pos):
+                ins = ins - np.searchsorted(del_pos, ins, side="left")
+        else:
+            akb = ast = auid = arows = None
+            ins = np.zeros(0, dtype=np.int64)
+        from ..native.pack import order_merge
+        e["kb"], e["st"], e["uid"], e["rows"] = order_merge(
+            kb, st, uid, rows, del_pos, ins, akb, ast, auid, arows)
 
     def _rank_rows_locked(self, pool: str, skip_usage: bool = False):
         """Shared body of rank_arrays/fused_arrays (caller holds _lock):
@@ -468,11 +614,11 @@ class ColumnarIndex:
             if e is not None:
                 self._repair_order(e)
                 rows_s = e["rows"]
-                pending = e["keys"]["st"] == PENDING_START
+                pending = e["st"] == PENDING_START
                 if not pending.any():
                     return None  # no pending jobs (entity-path early-out)
                 return self._rank_arrays_tail(rows_s, pending,
-                                              uid_s=e["keys"]["uid"],
+                                              uid_s=e["uid"],
                                               skip_usage=skip_usage)
         pool_match = self._pool[:n] == pool
         prow = np.flatnonzero(pool_match & self._pending[:n])
@@ -503,9 +649,9 @@ class ColumnarIndex:
         rows_s = rows[order]
         if self._sortable:
             # seed the incremental order cache for the next cycles
-            self._ord[pool] = {
-                "keys": self._keys_for(rows_s, start[order]),
-                "rows": rows_s.copy(), "log": []}
+            kb, st_s, uid_s = self._key_fields(rows_s, start[order])
+            self._ord[pool] = {"kb": kb, "st": st_s, "uid": uid_s,
+                               "rows": rows_s.copy(), "log": []}
         user_s = self._user[rows_s]
         return self._rank_arrays_tail(rows_s, pending[order], user_s=user_s,
                                       skip_usage=skip_usage)
@@ -532,16 +678,19 @@ class ColumnarIndex:
         else:
             first[1:] = user_s[1:] != user_s[:-1]
         seg_start = np.flatnonzero(first)
-        seg_id = np.cumsum(first) - 1
         arrays = {
-            "first_idx": seg_start.astype(np.int32)[seg_id],
-            "user_rank": seg_id.astype(np.int32),
             "pending": pending_s,
             "valid": np.ones(rows_s.size, dtype=bool),
+            "is_first": first,
         }
         if not skip_usage:
-            # the compact device path gathers res on device via the base
-            # mirror; only the legacy/rank paths pay this [T, 4] gather
+            # the compact device path re-derives first_idx/user_rank ON
+            # DEVICE from the is_first flag bit (parallel/sharded
+            # expand_compact) and gathers res via the base mirror; only
+            # the legacy/rank paths pay these [T]-sized builds
+            seg_id = np.cumsum(first) - 1
+            arrays["first_idx"] = seg_start.astype(np.int32)[seg_id]
+            arrays["user_rank"] = seg_id.astype(np.int32)
             arrays["usage"] = self._res[rows_s]
         return (arrays, rows_s, user_s, seg_start)
 
@@ -647,6 +796,8 @@ class ColumnarIndex:
         self._dead = int(self._done[:self._n].sum())
         # row indices were remapped: device-resident base mirrors keyed on
         # this counter must fully resync (growth, by contrast, preserves
-        # row indices and never bumps it)
+        # row indices and never bumps it), and every delta consumer's
+        # resident rows are invalid — fence, never scatter stale rows
         self.compactions += 1
+        self._fence_all()
         return True

@@ -59,11 +59,12 @@ class CycleRecord:
     """One scheduler cycle's instrument-panel readings."""
 
     __slots__ = ("seq", "kind", "trace_id", "start_s", "duration_ms",
-                 "phases", "pools", "jobs_considered", "jobs_placed",
-                 "skip_reasons", "preemptions", "recompiles", "h2d_bytes",
-                 "d2h_bytes", "sync_wait_ms", "faults", "error",
-                 "pipeline_depth", "pipeline_inflight",
-                 "pipeline_conflicts", "_t0")
+                 "phases", "detail_ms", "pools", "jobs_considered",
+                 "jobs_placed", "skip_reasons", "preemptions", "recompiles",
+                 "h2d_bytes", "d2h_bytes", "sync_wait_ms", "faults",
+                 "error", "pipeline_depth", "pipeline_inflight",
+                 "pipeline_conflicts", "delta_rows", "full_repacks",
+                 "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
@@ -93,6 +94,16 @@ class CycleRecord:
         self.pipeline_depth = 0
         self.pipeline_inflight = 0
         self.pipeline_conflicts = 0
+        # sub-phase breakdown the whole-phase durations hide (ISSUE 7
+        # satellite): host staging split into pack (store->arrays) /
+        # stage (arrays->wire form) / apply (outputs->transactions), so a
+        # staging regression is diagnosable from /debug/cycles without a
+        # profiler.  Plus the resident-pack readings: delta rows shipped
+        # on-chip this cycle and full repacks (reason-labeled on
+        # cook_resident_repack_total).
+        self.detail_ms: Dict[str, float] = {}
+        self.delta_rows = 0
+        self.full_repacks = 0
         self._t0 = time.perf_counter()
 
     def to_doc(self) -> Dict[str, Any]:
@@ -113,6 +124,9 @@ class CycleRecord:
             "pipeline_depth": self.pipeline_depth,
             "pipeline_inflight": self.pipeline_inflight,
             "pipeline_conflicts": self.pipeline_conflicts,
+            "detail_ms": {k: round(v, 3) for k, v in self.detail_ms.items()},
+            "delta_rows": self.delta_rows,
+            "full_repacks": self.full_repacks,
             "error": self.error,
         }
 
@@ -229,6 +243,31 @@ class FlightRecorder:
             with self._lock:
                 rec.pipeline_conflicts += int(n)
 
+    def note_phase_detail(self, name: str, ms: float) -> None:
+        """Sub-phase duration (pack / stage / apply) summed onto the
+        current record's detail breakdown."""
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.detail_ms[name] = rec.detail_ms.get(name, 0.0) \
+                    + float(ms)
+
+    def note_delta(self, rows: int) -> None:
+        """Delta rows scatter-applied into the device-resident pack this
+        cycle (0 on a quiet cycle; the steady-state guard asserts it)."""
+        rec = _current_record.get()
+        if rec is not None and rows:
+            with self._lock:
+                rec.delta_rows += int(rows)
+
+    def note_repack(self, reason: str) -> None:
+        """A full resident-pack repack (reason also labels
+        cook_resident_repack_total)."""
+        rec = _current_record.get()
+        if rec is not None:
+            with self._lock:
+                rec.full_repacks += 1
+
     def note_fault(self, point: str, n: int = 1) -> None:
         """A fault-point trigger or degradation (kernel fallback, breaker
         reroute) attributed to the cycle it happened inside."""
@@ -282,6 +321,7 @@ class FlightRecorder:
         recompiles: Dict[str, int] = {}
         skips: Dict[str, int] = {}
         faults: Dict[str, int] = {}
+        detail: Dict[str, float] = {}
         for r in records:
             by_kind[r.kind] = by_kind.get(r.kind, 0) + 1
             for k, v in r.recompiles.items():
@@ -290,6 +330,8 @@ class FlightRecorder:
                 skips[k] = skips.get(k, 0) + v
             for k, v in r.faults.items():
                 faults[k] = faults.get(k, 0) + v
+            for k, v in r.detail_ms.items():
+                detail[k] = detail.get(k, 0.0) + v
         return {
             "cycles": len(records),
             **({"truncated": True, "cycles_evicted": evicted}
@@ -308,6 +350,9 @@ class FlightRecorder:
             "h2d_bytes": sum(r.h2d_bytes for r in records),
             "d2h_bytes": sum(r.d2h_bytes for r in records),
             "sync_wait_ms": round(sum(r.sync_wait_ms for r in records), 3),
+            "detail_ms": {k: round(v, 3) for k, v in detail.items()},
+            "delta_rows": sum(r.delta_rows for r in records),
+            "full_repacks": sum(r.full_repacks for r in records),
         }
 
     def reset(self) -> None:

@@ -1,0 +1,109 @@
+// Native kernels for the device-resident incremental cycle state
+// (cook_tpu/state/index.py order cache + cook_tpu/sched/fused.py
+// resident pack; bound by cook_tpu/native/pack.py).
+//
+// Two Python hot loops move down here, where object semantics are the
+// cost (ISSUE 7 tentpole (c)):
+//
+//  * delta EXTRACTION: diffing the freshly staged rows/flags arrays
+//    against the resident pack's host shadow (cpk_diff_pack), and the
+//    order-journal merge that repairs a pool's cached sorted order from
+//    the tx-event deltas (cpk_order_merge) — one pass over four parallel
+//    arrays instead of np.delete + np.insert per array;
+//
+//  * post-match APPLY: pruning launched/conflicted positions out of the
+//    published queue's row list (cpk_prune_rows).
+//
+// Everything is dependency-free C, operating on caller-owned buffers;
+// the Python side falls back to vectorized numpy when no toolchain is
+// available (tests carry a `native` build-presence marker).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Positions where the staged rows/flags differ from the resident
+// shadow.  out_idx must have capacity n; returns the count.
+long cpk_diff_pack(const int32_t* rows_a, const int32_t* rows_b,
+                   const uint8_t* fl_a, const uint8_t* fl_b, long n,
+                   int32_t* out_idx) {
+  long k = 0;
+  for (long i = 0; i < n; ++i) {
+    if (rows_a[i] != rows_b[i] || fl_a[i] != fl_b[i]) {
+      out_idx[k++] = (int32_t)i;
+    }
+  }
+  return k;
+}
+
+// Single-pass order-journal merge: drop `nd` entries at del_pos (sorted,
+// unique, positions into the ORIGINAL arrays), then weave `na` inserts
+// at ins_pos (sorted, np.insert semantics: positions into the
+// POST-delete array; entry j lands before the element currently at
+// ins_pos[j]).  kb entries are key_nbytes-wide byte strings; st/uid/rows
+// ride along.  Output capacity must be n - nd + na; returns the output
+// length.
+long cpk_order_merge(const uint8_t* kb, const int64_t* st,
+                     const int32_t* uid, const int64_t* rows, long n,
+                     long key_nbytes,
+                     const int64_t* del_pos, long nd,
+                     const int64_t* ins_pos, const uint8_t* akb,
+                     const int64_t* ast, const int32_t* auid,
+                     const int64_t* arows, long na,
+                     uint8_t* out_kb, int64_t* out_st, int32_t* out_uid,
+                     int64_t* out_rows) {
+  long o = 0;   // output cursor
+  long d = 0;   // next delete
+  long a = 0;   // next insert
+  long pd = 0;  // post-delete position of the next surviving source row
+  for (long i = 0; i < n; ++i) {
+    if (d < nd && del_pos[d] == i) {
+      ++d;
+      continue;
+    }
+    while (a < na && ins_pos[a] <= pd) {
+      std::memcpy(out_kb + o * key_nbytes, akb + a * key_nbytes,
+                  (size_t)key_nbytes);
+      out_st[o] = ast[a];
+      out_uid[o] = auid[a];
+      out_rows[o] = arows[a];
+      ++o;
+      ++a;
+    }
+    std::memcpy(out_kb + o * key_nbytes, kb + i * key_nbytes,
+                (size_t)key_nbytes);
+    out_st[o] = st[i];
+    out_uid[o] = uid[i];
+    out_rows[o] = rows[i];
+    ++o;
+    ++pd;
+  }
+  while (a < na) {  // tail inserts (ins_pos == post-delete length)
+    std::memcpy(out_kb + o * key_nbytes, akb + a * key_nbytes,
+                (size_t)key_nbytes);
+    out_st[o] = ast[a];
+    out_uid[o] = auid[a];
+    out_rows[o] = arows[a];
+    ++o;
+    ++a;
+  }
+  return o;
+}
+
+// Queue prune: copy `rows` skipping the `k` positions in `drop` (sorted,
+// unique).  out capacity n; returns the surviving count.
+long cpk_prune_rows(const int32_t* rows, long n, const int64_t* drop,
+                    long k, int32_t* out) {
+  long o = 0, d = 0;
+  for (long i = 0; i < n; ++i) {
+    if (d < k && drop[d] == i) {
+      ++d;
+      continue;
+    }
+    out[o++] = rows[i];
+  }
+  return o;
+}
+
+}  // extern "C"
