@@ -513,6 +513,84 @@ def cmd_admin(args) -> int:
     return 0
 
 
+def _fmt_ts(ms) -> str:
+    import datetime
+    try:
+        return datetime.datetime.fromtimestamp(
+            ms / 1000.0).strftime("%H:%M:%S")
+    except (OverflowError, OSError, ValueError):
+        return str(ms)
+
+
+def cmd_why(args) -> int:
+    """``cs why <uuid>`` — the whole lifecycle, human-readable: every
+    audit event (submit, rank position + DRU, skip/defer reasons, launch
+    intent/ack, instance transitions, preemption with the DRU delta,
+    terminal), then — for a still-waiting job — the unscheduled
+    explainer's live reasons.  The trail survives leader failover
+    (journal-backed lane, docs/OBSERVABILITY.md), so this works for jobs
+    scheduled by a previous leader too.  ``--json`` emits the raw
+    timeline document; ``--perfetto FILE`` writes the newest cycle's
+    trace with this job's events stitched in as a dedicated track."""
+    uuids = resolve_refs(args, args.uuid)
+    if uuids is None:
+        return 1
+    client = clients(args)[0]
+    rc = 0
+    shown = []
+    for uuid in uuids:
+        try:
+            doc = client.job_timeline(uuid)
+        except JobClientError as e:
+            print(f"error: {uuid}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        shown.append((uuid, doc.get("timeline", [])))
+        if args.json:
+            out(doc)
+            continue
+        head = f"job {uuid}"
+        if doc.get("user"):
+            head += f" (user={doc['user']}, pool={doc.get('pool')})"
+        if doc.get("state"):
+            head += f" — {doc['state']}"
+        if doc.get("user_dru") is not None:
+            head += f" [user DRU {doc['user_dru']:.3f}]"
+        print(head)
+        for ev in doc.get("timeline", []):
+            data = dict(ev.get("data") or {})
+            reason = data.pop("reason", None)
+            label = ev["kind"] + (f":{reason}" if reason else "")
+            extras = " ".join(f"{k}={v}" for k, v in data.items()
+                              if v is not None and k != "pool")
+            times = _fmt_ts(ev["ts"])
+            if ev.get("count", 1) > 1:
+                times += (f" (x{ev['count']}, last "
+                          f"{_fmt_ts(ev.get('ts_last', ev['ts']))})")
+            print(f"  {times}  {label}" + (f"  {extras}" if extras
+                                           else ""))
+        for r in doc.get("reasons", []):
+            print(f"  why waiting: {r['reason']}")
+    if args.perfetto and shown:
+        # ONE export with every requested job as its own track (a
+        # per-uuid write would silently keep only the last job)
+        cycles = client.debug_cycles(limit=1).get("cycles", [])
+        if cycles and cycles[-1].get("trace_id"):
+            from ..utils.tracing import job_track_events
+            trace = client.debug_trace(cycles[-1]["trace_id"])
+            for i, (uuid, timeline) in enumerate(shown):
+                trace["traceEvents"].extend(
+                    job_track_events(uuid, timeline, tid=2 + i))
+            with open(args.perfetto, "w") as f:
+                json.dump(trace, f)
+            print(f"wrote perfetto trace with {len(shown)} job "
+                  f"track(s) to {args.perfetto}", file=sys.stderr)
+        else:
+            print("no cycle trace available for --perfetto",
+                  file=sys.stderr)
+    return rc
+
+
 def cmd_debug(args) -> int:
     """Flight-recorder access: ``cs debug cycles`` lists recent per-cycle
     records; ``cs debug trace [TRACE_ID]`` exports one cycle's spans as
@@ -846,6 +924,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("uuid", nargs=1)
     sp.add_argument("--dry-run", dest="dry_run", action="store_true")
     sp.set_defaults(fn=cmd_ssh)
+
+    sp = sub.add_parser("why", help="why isn't my job running: the "
+                                    "per-job scheduling audit timeline "
+                                    "+ live unscheduled reasons")
+    sp.add_argument("uuid", nargs="*",
+                    help="job uuid or entity ref; stdin when omitted")
+    sp.add_argument("--json", action="store_true",
+                    help="raw timeline document instead of the "
+                         "rendered lifecycle")
+    sp.add_argument("--perfetto", metavar="FILE",
+                    help="also export the newest cycle's Chrome trace "
+                         "with this job's events as a dedicated track")
+    sp.set_defaults(fn=cmd_why)
 
     sp = sub.add_parser("debug", help="flight recorder: cycle records, "
                                       "Perfetto trace export, fault/"

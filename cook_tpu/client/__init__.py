@@ -339,11 +339,22 @@ class JobClient:
         return self._request("GET", "/debug/cycles",
                              params={"limit": str(limit)})
 
-    def debug_trace(self, trace_id: str) -> Dict:
+    def debug_trace(self, trace_id: str,
+                    job: Optional[str] = None) -> Dict:
         """GET /debug/trace — one trace's spans as Chrome trace-event
-        JSON, loadable in chrome://tracing / ui.perfetto.dev."""
-        return self._request("GET", "/debug/trace",
-                             params={"trace_id": trace_id})
+        JSON, loadable in chrome://tracing / ui.perfetto.dev.  With
+        ``job``, the job's audit timeline is stitched in as a per-job
+        instant-event track."""
+        params: Dict = {"trace_id": trace_id}
+        if job:
+            params["job"] = job
+        return self._request("GET", "/debug/trace", params=params)
+
+    def job_timeline(self, uuid: str) -> Dict:
+        """GET /debug/job/<uuid>/timeline — the job's full scheduling
+        audit trail plus, while it waits, the unscheduled explainer's
+        current reasons and the user's fairness position (`cs why`)."""
+        return self._request("GET", f"/debug/job/{uuid}/timeline")
 
     def debug_faults(self) -> Dict:
         """GET /debug/faults — armed fault points, per-cluster circuit

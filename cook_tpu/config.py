@@ -152,6 +152,12 @@ class SloConfig:
     # how many recent flight-recorder cycles the cycle-duration burn
     # rate is computed over
     cycle_window: int = 100
+    # per-user metric families are capped at this many distinct user
+    # label values per pool (top-K by usage; the tail folds into an
+    # "other" series) so fairness gauges can't blow up the Prometheus
+    # registry at millions-of-users scale (utils/metrics.py label caps,
+    # cook_metrics_dropped_labels_total)
+    max_user_series: int = 1000
 
 
 @dataclass
@@ -284,6 +290,54 @@ class PipelineConfig:
 
 
 @dataclass
+class AuditConfig:
+    """Per-job scheduling audit trail knobs (utils/audit.py; the daemon's
+    ``"audit"`` conf section, validated like PipelineConfig so a typo'd
+    knob fails the boot).  docs/OBSERVABILITY.md."""
+
+    #: record per-job decision events at all.  Off = the trail records
+    #: nothing and `cs why` falls back to the stateless explainer.
+    enabled: bool = True
+    #: cap on jobs with a live event lane; the oldest-CREATED lane is
+    #: evicted past this (insertion order, not LRU — the hot path skips
+    #: per-event touch bookkeeping; the earliest submissions are the
+    #: likeliest terminal)
+    max_jobs: int = 100_000
+    #: per-job event cap; repeated advisory events (ranked position,
+    #: same-reason skips) coalesce into one counted event, and lifecycle
+    #: events are evicted last
+    per_job_events: int = 64
+    #: journal durable events (lifecycle atomically with their txn,
+    #: advisory once per cycle) so timelines survive leader failover;
+    #: a store without an attached journal ignores this
+    journal: bool = True
+
+    def __post_init__(self):
+        for k in ("max_jobs", "per_job_events"):
+            v = getattr(self, k)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"audit {k} must be an int >= 1, "
+                                 f"got {v!r}")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "AuditConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown audit key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"audit key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -366,6 +420,9 @@ class Config:
     # (sched/pipeline.py, docs/PERFORMANCE.md); depth=0 pins the
     # strictly-synchronous driver
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    # per-job scheduling audit trail (utils/audit.py; the "why isn't my
+    # job running" lane, docs/OBSERVABILITY.md)
+    audit: AuditConfig = field(default_factory=AuditConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

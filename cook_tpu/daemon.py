@@ -123,6 +123,11 @@ def build_scheduler_config(spec: Dict) -> Config:
         # didn't choose
         from .config import PipelineConfig
         cfg.pipeline = PipelineConfig.from_conf(spec["pipeline"])
+    if "audit" in spec:
+        # per-job scheduling audit trail (docs/OBSERVABILITY.md); a
+        # typo'd knob fails the boot like the pipeline section
+        from .config import AuditConfig
+        cfg.audit = AuditConfig.from_conf(spec["audit"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

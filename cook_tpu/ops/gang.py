@@ -200,6 +200,8 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
                      capacity: Optional[np.ndarray] = None,
                      device: bool = False,
                      refill_ok: Optional[np.ndarray] = None,
+                     audit_trail=None,
+                     audit_pool: Optional[str] = None,
                      ) -> Tuple[np.ndarray, Optional[GangStats]]:
     """The full per-cycle gang pass: reduce partial gangs to nothing and
     refill the freed capacity with still-unmatched group-less jobs.
@@ -307,7 +309,14 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
     if stats.dropped_jobs:
         registry.counter_inc("cook_gang_partial_drops",
                              float(stats.dropped_gangs))
-        _flight.note_skips({"gang-partial": stats.dropped_jobs})
+        # aggregate histogram + per-job attribution from one drop mask
+        # (utils/audit.note_skips; the member resets explain themselves
+        # on each job's timeline)
+        from ..utils import audit as _audit
+        _audit.note_skips(audit_trail, {
+            "gang-partial": [jobs[i].uuid
+                             for i in np.flatnonzero(dropped)]},
+            pool=audit_pool)
         # ---- same-cycle refill: the freed capacity goes back to the
         # pool for group-less unmatched jobs (group members need their
         # own group semantics re-validated, so they wait a cycle)

@@ -111,6 +111,9 @@ API_ROUTES = [
     ("GET", "/debug/replication",
      "replication/failover panel: follower offsets, min_acked, synced "
      "set, candidate positions", False),
+    ("GET", "/debug/job/{uuid}/timeline",
+     "per-job scheduling audit timeline (why isn't my job running)",
+     False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -1344,7 +1347,12 @@ class CookApi:
             out.append({"uuid": uuid,
                         "reasons": job_reasons(self.store, job,
                                                scheduler=self.scheduler,
-                                               queue_limits=self.queue_limits)})
+                                               queue_limits=self.queue_limits),
+                        # decision HISTORY next to the live reasons: the
+                        # newest audit events (utils/audit.py) — "what
+                        # has the scheduler done with this job so far",
+                        # not just "what blocks it right now"
+                        "history": self.store.audit.timeline(uuid)[-20:]})
         if not out and uuids and partial:
             raise ApiError(404, "none of the requested jobs exist")
         return out
@@ -1540,7 +1548,42 @@ class CookApi:
         trace = tracer.export_chrome_trace(trace_id)
         if not trace["traceEvents"]:
             raise ApiError(404, f"no spans recorded for trace {trace_id}")
+        job = params.get("job", [None])[0]
+        if job:
+            # stitch the job's audit events in as a per-job instant-event
+            # track (utils/audit.py; docs/OBSERVABILITY.md "debugging one
+            # job"): the cycle flamegraph and the job's decision history
+            # line up on one Perfetto timeline
+            from ..utils.tracing import job_track_events
+            trace["traceEvents"].extend(
+                job_track_events(job, self.store.audit.timeline(job)))
         return trace
+
+    def debug_job_timeline(self, uuid: str) -> Dict:
+        """GET /debug/job/<uuid>/timeline — the job's full decision
+        audit trail (utils/audit.py): submit -> ranked -> skips/deferrals
+        with reasons -> launch intent/ack -> instance transitions ->
+        preemption (with the DRU delta) -> terminal, surviving leader
+        failover via the journal-backed lane.  Answers live next to the
+        history: a still-waiting job also gets the unscheduled
+        explainer's current reasons and the user's fairness position."""
+        job = self.store.job(uuid)
+        timeline = self.store.audit.timeline(uuid)
+        if job is None and not timeline:
+            raise ApiError(404, f"no such job {uuid}")
+        out: Dict[str, Any] = {"uuid": uuid, "timeline": timeline}
+        if job is not None:
+            out["state"] = job_state_string(self.store, job)
+            out["user"] = job.user
+            out["pool"] = job.pool
+            dru = self.store.audit.user_dru(job.pool, job.user)
+            if dru is not None:
+                out["user_dru"] = dru
+            if job.state is JobState.WAITING:
+                out["reasons"] = job_reasons(
+                    self.store, job, scheduler=self.scheduler,
+                    queue_limits=self.queue_limits)
+        return out
 
     def debug_faults(self) -> Dict:
         """GET /debug/faults — degradation panel: armed fault points and
@@ -2051,6 +2094,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_faults()
             if path == "/debug/replication":
                 return api.debug_replication()
+            if len(parts) == 4 and parts[0] == "debug" \
+                    and parts[1] == "job" and parts[3] == "timeline":
+                return api.debug_job_timeline(parts[2])
             if path == "/swagger-docs":
                 return api.swagger_docs()
             if path == "/swagger-ui":

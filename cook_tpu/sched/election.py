@@ -348,7 +348,10 @@ class FileLeaderElector(LeaderElector):
         except OSError:
             return out
         for p in entries:
-            if not p.name.startswith(prefix):
+            if not p.name.startswith(prefix) or ".tmp." in p.name:
+                # crash-orphaned atomic-write temps (now dot-prefixed,
+                # but older layouts left `<cand>.tmp*` behind) must
+                # never be parsed as a live candidate
                 continue
             try:
                 out[p.name[len(prefix):]] = json.loads(p.read_text())

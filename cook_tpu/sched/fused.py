@@ -48,6 +48,7 @@ from ..ops.delta import (
 from ..ops.padding import bucket, pad_to
 from ..state.schema import DruMode, Job, Pool, SchedulerKind
 from ..state.store import Store
+from ..utils import audit as _audit
 from ..utils import tracing
 from ..utils.flight import recorder as _flight
 from .constraints import build_constraint_mask, validate_group_placement
@@ -599,7 +600,11 @@ class FusedCycleDriver:
                 pp.offensive = [j for j in (store.job(str(u))
                                             for u in uuid_at(bad))
                                 if j is not None]
-                _flight.note_skips({"offensive": int(bad.sum())})
+                # one gather over the existing wire arrays attributes the
+                # aggregate to job uuids (utils/audit.py)
+                _audit.note_skips(store.audit,
+                                  {"offensive": list(uuid_at(bad))},
+                                  pool=pool.name)
         pp.enqueue_ok = enqueue_ok
 
         # plugin launch verdicts: only when a filter is configured, and the
@@ -626,9 +631,12 @@ class FusedCycleDriver:
                         cached = self.plugins.launch_allowed(job)
                 if not cached:
                     launch_ok[i] = False
-            filtered = int((~launch_ok).sum())
-            if filtered:
-                _flight.note_skips({"launch-filtered": filtered})
+            filtered = ~launch_ok
+            if filtered.any():
+                _audit.note_skips(
+                    store.audit,
+                    {"launch-filtered": list(uuid_at(filtered))},
+                    pool=pool.name)
         # pipelined-driver speculation mask (sched/pipeline.py): rows the
         # in-flight overlapped cycle is about to launch are withheld from
         # THIS cycle's launch candidates (they'd conflict at reconcile).
@@ -643,8 +651,10 @@ class FusedCycleDriver:
                 if masked.any():
                     launch_ok = launch_ok & ~masked
                     spec_masked = masked
-                    _flight.note_skips(
-                        {"pipeline-speculative": int(masked.sum())})
+                    _audit.note_skips(
+                        store.audit,
+                        {"pipeline-speculative": list(uuid_at(masked))},
+                        pool=pool.name)
         pp.launch_ok = launch_ok
 
         # launch-rate token budgets per USER (device gathers via user_rank)
@@ -846,8 +856,11 @@ class FusedCycleDriver:
                     launch_ok = launch_ok & ~masked
                     flags = flags.copy()
                     flags[masked] &= np.uint8(~np.uint8(FLAG_LAUNCH_OK))
-                    _flight.note_skips(
-                        {"pipeline-speculative": int(masked.sum())})
+                    _audit.note_skips(
+                        self.store.audit,
+                        {"pipeline-speculative":
+                             list(pp.uuid_base[rows_s[masked]])},
+                        pool=pool.name)
         pp.launch_ok = launch_ok
         pp.tokens_u = self._tokens_u(pool, users, token_delta)
         # no gang members by eligibility, but a gang that admitted last
@@ -879,7 +892,7 @@ class FusedCycleDriver:
         tokens net of the pipeline's token_delta, or None when the
         limiter is off."""
         deferred_why: Dict[str, Dict] = {}
-        skipped = 0
+        skipped: List = []
         if members_by_gang:
             mc = self.config.matcher_for_pool(pool.name)
             backoff = self.matcher._backoff.setdefault(
@@ -905,14 +918,16 @@ class FusedCycleDriver:
                         # filter/quota-denied — withhold the rest whole
                         # with no deferral reason (reconcile re-surfaces
                         # the gang if the overlapped launch conflicts)
-                        extra = 0
-                        for row, _j in members:
+                        extra = []
+                        for row, j in members:
                             if launch_ok[row]:
                                 launch_ok[row] = False
-                                extra += 1
+                                extra.append(j.uuid)
                         if extra:
-                            _flight.note_skips(
-                                {"pipeline-speculative": extra})
+                            _audit.note_skips(
+                                self.store.audit,
+                                {"pipeline-speculative": extra},
+                                pool=pool.name)
                         continue
                     reason = "member-denied"
                 elif net_tokens is not None \
@@ -920,16 +935,17 @@ class FusedCycleDriver:
                     reason = "rate-limited"
                 else:
                     continue
-                for row, _job in members:
+                for row, job in members:
                     if launch_ok[row]:
                         launch_ok[row] = False
-                        skipped += 1
+                        skipped.append((job.uuid, {"why": reason}))
                 deferred_why[guuid] = {"size": size, "reason": reason}
         # set every cycle, like considerable_jobs on the split path, so
         # a gang that admitted this cycle sheds last cycle's reason
         self.matcher.last_admission_deferred[pool.name] = deferred_why
         if skipped:
-            _flight.note_skips({"gang-deferred": skipped})
+            _audit.note_skips(self.store.audit,
+                              {"gang-deferred": skipped}, pool=pool.name)
 
     def _pack_caps(self, pp: _PackedPool, pool: Pool) -> None:
         """Backoff cap + pool/quota-group caps (shared by both pack paths)."""
@@ -1037,13 +1053,17 @@ class FusedCycleDriver:
             kind, _epoch, uuids = exclude
             if kind == "uuids" and uuids:
                 spec_masked = np.zeros(T, dtype=bool)
+                masked_uuids = []
                 for i, j in enumerate(jobs_in_rows):
                     if pend_rows[i] and launch_ok[i] and j.uuid in uuids:
                         launch_ok[i] = False
                         spec_masked[i] = True
-                masked = int(spec_masked.sum())
-                if masked:
-                    _flight.note_skips({"pipeline-speculative": masked})
+                        masked_uuids.append(j.uuid)
+                if masked_uuids:
+                    _audit.note_skips(
+                        store.audit,
+                        {"pipeline-speculative": masked_uuids},
+                        pool=pool.name)
         pp.launch_ok = launch_ok
 
         # launch-rate token budgets, per user broadcast to tasks
@@ -1536,6 +1556,15 @@ class FusedCycleDriver:
             slots = np.array(cand_keep, dtype=np.int64)
         else:
             cand_jobs = [pp.id2job[pp.task_ids[r]] for r in cand_row[slots]]
+        # per-job rank attribution for the fetched candidate slots
+        # (bounded by the considerable cap, never [T]-sized): the
+        # device-computed queue position, straight off the compact
+        # outputs already on host (utils/audit.py)
+        if len(slots):
+            self.store.audit.ranked(
+                [j.uuid for j in cand_jobs],
+                [int(q) for q in cand_qpos[slots]], pool_name,
+                users=[j.user for j in cand_jobs])
         if len(slots) == 0 or not pp.offers:
             # mirror Matcher.match_pool: an empty cycle returns the
             # considerable set unmatched and leaves backoff untouched
@@ -1612,7 +1641,8 @@ class FusedCycleDriver:
                 capacity=pp.capacity[:H],
                 device=False,
                 refill_ok=(~res_conflict if res_conflict is not None
-                           else None))
+                           else None),
+                audit_trail=self.store.audit, audit_pool=pool_name)
             if gstats is not None:
                 result.gang_partial = gstats.partial
         if res_conflict is not None:
@@ -1656,6 +1686,9 @@ class FusedCycleDriver:
             result.queue_pruned = True
         else:
             publish_queue()
-        _flight.note_skips({"unmatched": len(result.unmatched),
-                            "launch-failed": len(result.launch_failures)})
+        _audit.note_skips(self.store.audit, {
+            "unmatched": [j.uuid for j in result.unmatched],
+            "launch-failed": [(u, {"why": why})
+                              for u, why in result.launch_failures],
+        }, pool=pool_name)
         results[pool_name] = result

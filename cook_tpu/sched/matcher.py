@@ -27,7 +27,7 @@ from ..ops import host_prep, reference_impl
 from ..ops import telemetry
 from ..state.schema import InstanceStatus, Job, Reasons, new_uuid
 from ..state.store import Store
-from ..utils import tracing
+from ..utils import audit, tracing
 from ..utils.flight import recorder as flight_recorder
 from ..utils.metrics import LATENCY_BUCKETS, registry
 from .constraints import (
@@ -137,8 +137,15 @@ class Matcher:
             if job.group is not None:
                 ranked_members[job.group] = \
                     ranked_members.get(job.group, 0) + 1
-        # head-of-line skip reasons for the cycle's flight record
-        skips: Dict[str, int] = {}
+        # head-of-line skip reasons for the cycle's flight record AND the
+        # per-job audit lanes: reason -> [uuid | (uuid, extra)], so the
+        # aggregate histogram and the per-job attribution come from ONE
+        # structure (utils/audit.note_skips; attribution parity)
+        skips: Dict[str, List] = {}
+
+        def _skip(reason: str, job, **extra) -> None:
+            skips.setdefault(reason, []).append(
+                (job.uuid, extra) if extra else job.uuid)
         # group uuid -> why the cohort was withheld, for the explainer
         deferred_why: Dict[str, Dict] = {}
 
@@ -157,11 +164,11 @@ class Matcher:
             if launch_rl.enforce and job.group in gang_reserved:
                 user_seen[job.user] = max(
                     user_seen.get(job.user, 0) - cohort, 0)
-            stripped = sum(1 for j in out if j.group == job.group)
+            stripped = [j for j in out if j.group == job.group]
             if stripped:
                 out[:] = [j for j in out if j.group != job.group]
-                skips["gang-deferred"] = \
-                    skips.get("gang-deferred", 0) + stripped
+                for j in stripped:
+                    _skip("gang-deferred", j, why=reason)
 
         for job in ranked:
             cohort = 1
@@ -175,8 +182,7 @@ class Matcher:
                             and ranked_members.get(job.group, 0) < size:
                         _defer(job.group, "members-missing")
                     if job.group in gang_deferred:
-                        skips["gang-deferred"] = \
-                            skips.get("gang-deferred", 0) + 1
+                        _skip("gang-deferred", job)
                         continue
                     cohort = size
             quota = self.store.get_quota(job.user, pool_name)
@@ -186,7 +192,7 @@ class Matcher:
             u = usage.setdefault(job.user, np.zeros(4, dtype=F32))
             u += [job.resources.cpus, job.resources.mem, job.resources.gpus, 1.0]
             if not np.all(u <= qvec):
-                skips["over-quota"] = skips.get("over-quota", 0) + 1
+                _skip("over-quota", job)
                 if cohort > 1:
                     _sink_cohort(job, cohort, "member-denied")
                 continue
@@ -201,8 +207,7 @@ class Matcher:
             if cohort > 1 and job.group not in gang_reserved:
                 if len(out) + sum(slots_reserved.values()) + cohort > limit:
                     _defer(job.group, "considerable-cap")
-                    skips["gang-deferred"] = \
-                        skips.get("gang-deferred", 0) + 1
+                    _skip("gang-deferred", job, why="considerable-cap")
                     continue
                 if launch_rl.enforce:
                     tokens = user_tokens.setdefault(
@@ -212,8 +217,7 @@ class Matcher:
                     seen = user_seen.get(job.user, 0)
                     if seen + cohort > int(tokens):
                         _defer(job.group, "rate-limited")
-                        skips["gang-deferred"] = \
-                            skips.get("gang-deferred", 0) + 1
+                        _skip("gang-deferred", job, why="rate-limited")
                         continue
                     user_seen[job.user] = seen + cohort
                 gang_reserved.add(job.group)
@@ -231,20 +235,17 @@ class Matcher:
                     user_seen[job.user] = seen + 1
                     if seen >= int(tokens):
                         # a fractional token is not a launch
-                        skips["rate-limited"] = \
-                            skips.get("rate-limited", 0) + 1
+                        _skip("rate-limited", job)
                         continue
                 # singles fill remaining slots but never the ones held
                 # for a reserved gang's unseen members
                 if slots_reserved and \
                         len(out) + sum(slots_reserved.values()) >= limit:
-                    skips["cap-reserved"] = \
-                        skips.get("cap-reserved", 0) + 1
+                    _skip("cap-reserved", job)
                     continue
             # launch-filter plugin with cached accept/defer verdicts
             if not self.plugins.launch_allowed(job):
-                skips["launch-filtered"] = \
-                    skips.get("launch-filtered", 0) + 1
+                _skip("launch-filtered", job)
                 if cohort > 1:
                     _sink_cohort(job, cohort, "member-denied")
                 continue
@@ -270,17 +271,17 @@ class Matcher:
             short = {g for g, n in admitted.items()
                      if n < gang_size_of[g]}
             if short:
-                dropped = sum(admitted[g] for g in short)
+                for j in out:
+                    if j.group in short:
+                        _skip("gang-deferred", j, why="partial-admission")
                 out = [j for j in out if j.group not in short]
-                skips["gang-deferred"] = \
-                    skips.get("gang-deferred", 0) + dropped
                 for g in short:
                     deferred_why.setdefault(g, {
                         "size": gang_size_of.get(g, 0),
                         "reason": "partial-admission"})
         self.last_admission_deferred[pool_name] = deferred_why
         if skips:
-            flight_recorder.note_skips(skips)
+            audit.note_skips(self.store.audit, skips, pool=pool_name)
         return out
 
     # -------------------------------------------------------------- context
@@ -399,6 +400,12 @@ class Matcher:
             pool_name, ranked, min(backoff.num_considerable,
                                    mc.max_jobs_considered))
         result.considered = len(considerable)
+        # per-job rank attribution for the admitted candidates (bounded
+        # by the considerable cap): queue position this cycle + the
+        # user's cached DRU (utils/audit.py)
+        self.store.audit.ranked(
+            [j.uuid for j in considerable], range(len(considerable)),
+            pool_name, users=[j.user for j in considerable])
         if not considerable or not offers:
             result.unmatched = considerable
             # an empty cycle leaves the backoff state untouched
@@ -428,7 +435,8 @@ class Matcher:
                 cmask_fn=lambda: cmask,
                 avail=np.asarray(avail, dtype=F32),
                 capacity=np.asarray(cap, dtype=F32),
-                device=mc.backend != "cpu")
+                device=mc.backend != "cpu",
+                audit_trail=self.store.audit, audit_pool=pool_name)
             if gstats is not None:
                 result.gang_partial = gstats.partial
         self.record_placement_failures(considerable, assign, offers, ctx)
@@ -444,9 +452,11 @@ class Matcher:
             else:
                 result.matched.append((job, offers[h]))
         self._launch(pool_name, result, clusters)
-        flight_recorder.note_skips({
-            "unmatched": len(result.unmatched),
-            "launch-failed": len(result.launch_failures)})
+        audit.note_skips(self.store.audit, {
+            "unmatched": [j.uuid for j in result.unmatched],
+            "launch-failed": [(u, {"why": why})
+                              for u, why in result.launch_failures],
+        }, pool=pool_name)
         return result
 
     def record_placement_failures(self, jobs: List[Job], assign: np.ndarray,

@@ -115,6 +115,22 @@ class Monitor:
         # (pool, state) -> {user -> stats} from the previous sweep, so
         # series for vanished users can be zeroed
         self._previous: Dict[Tuple[str, str], Dict[str, Dict]] = {}
+        # metric-cardinality guard (utils/metrics.py): the sweep folds
+        # per-user families to top-K-by-usage + an "other" bucket itself;
+        # the registry cap is the hard backstop should any publisher
+        # emit user-labeled series unfolded.  The window is scoped per
+        # (pool, state) for cook_user_resource — the four per-state
+        # publishes have DISJOINT user sets, so a shared per-pool window
+        # would overflow at populations near the fold cap — and sized
+        # 2*cap+16 so one sweep's own writes can never fold: the live
+        # publish is <= cap+2 series (top-K + "all" + "other") and the
+        # departed-user zero-writes are <= the previous sweep's cap+2.
+        cap = max(int(self.slo.max_user_series), 1)
+        self.registry.set_label_cap("cook_user_resource", "user",
+                                    cap * 2 + 16,
+                                    scope=("pool", "state"))
+        self.registry.set_label_cap("cook_user_dru", "user",
+                                    cap * 2 + 16, scope=("pool",))
 
     # ------------------------------------------------------------- one sweep
     def sweep(self) -> Dict[str, Dict[str, int]]:
@@ -122,20 +138,48 @@ class Monitor:
         (total/starved/hungry/satisfied/waiting_under_quota) for tests and
         structured logging."""
         out: Dict[str, Dict[str, int]] = {}
+        # DRU series are re-derived whole each sweep (top-K churns):
+        # clear-then-set keeps the exported set exactly the live one,
+        # and the cardinality-guard admission window resets so THIS
+        # sweep's top-K claims the slots (without the reset, the
+        # first-ever cap*8 users would hold them forever and every later
+        # heavy user would fold into "other"; utils/metrics.py contract)
+        self.registry.gauge_clear("cook_user_dru")
+        for metric in ("cook_user_resource", "cook_user_dru"):
+            self.registry.reset_label_window(metric, "user")
         for pool in self.store.pools():
-            out[pool.name] = self._sweep_pool(pool.name)
+            out[pool.name] = self._sweep_pool(pool)
         self._sweep_cycle_slo()
         return out
 
-    def _sweep_pool(self, pool_name: str) -> Dict[str, int]:
+    def _sweep_pool(self, pool) -> Dict[str, int]:
+        from ..state.schema import DruMode
+        pool_name = pool.name
         pending = self.store.pending_jobs(pool_name)
+        running = self.store.running_instances(pool_name)
         running_stats = _job_stats([
             (job.user, job.resources.cpus, job.resources.mem)
-            for job, _inst in self.store.running_instances(pool_name)])
+            for job, _inst in running])
         waiting_stats = _job_stats([
             (job.user, job.resources.cpus, job.resources.mem)
             for job in pending])
         self._sweep_queue_slo(pool_name, pending)
+        # fairness plane (docs/OBSERVABILITY.md): per-user DRU (actual
+        # usage normalized by share), published top-K + cached on the
+        # audit trail for rank-event context, and the wait-phase split
+        # of the pending queue (fairness vs capacity vs constraints)
+        gpu_usage = None
+        if pool.dru_mode is DruMode.GPU:
+            # GPU pools rank/rebalance on the gpus dimension — the DRU
+            # gauge must price the same dimension or it diverges from
+            # what the rebalancer actually preempts against
+            gpu_usage = {}
+            for job, _inst in running:
+                gpu_usage[job.user] = \
+                    gpu_usage.get(job.user, 0.0) + job.resources.gpus
+        dru = self._sweep_user_dru(pool_name, running_stats,
+                                   waiting_stats, gpu_usage=gpu_usage)
+        self._sweep_wait_phases(pool_name, pending, dru)
         starved = compute_starved_stats(
             self.store, pool_name, running_stats, waiting_stats)
         under_quota = compute_waiting_under_quota_stats(
@@ -161,23 +205,136 @@ class Monitor:
                 labels={"pool": pool_name, "state": state.replace("_", "-")})
         return counts
 
+    def _fold_tail(self, stats: Dict[str, Dict[str, float]]
+                   ) -> Dict[str, Dict[str, float]]:
+        """Top-K-by-usage + an aggregated ``other`` bucket past the
+        per-user series cap (SloConfig.max_user_series): the fairness
+        gauges stay bounded at millions-of-users scale, with the folded
+        tail still visible in aggregate
+        (``cook_metrics_dropped_labels_total`` counts registry-level
+        folds from any publisher that skips this)."""
+        cap = max(int(self.slo.max_user_series), 1)
+        if len(stats) <= cap:
+            return stats
+        ranked = sorted(
+            stats.items(),
+            key=lambda kv: -(kv[1].get("cpus", 0.0) + kv[1].get("mem", 0.0)))
+        out = dict(ranked[:cap])
+        other = {k: 0.0 for k in _STAT_DIMS}
+        for _u, s in ranked[cap:]:
+            for k in _STAT_DIMS:
+                other[k] += s.get(k, 0.0)
+        out["other"] = other
+        return out
+
+    def _sweep_user_dru(self, pool_name: str,
+                        running_stats: Dict[str, Dict[str, float]],
+                        waiting_stats: Dict[str, Dict[str, float]],
+                        gpu_usage: Optional[Dict[str, float]] = None
+                        ) -> Dict[str, float]:
+        """Per-user DRU = usage normalized by share on the pool's DRU
+        dimension(s) — the fair-share position the rebalancer prices
+        preemption against (rebalancer._recompute_user), now visible as
+        a gauge next to the share itself.  ``gpu_usage`` non-None marks
+        a DruMode.GPU pool: DRU is gpus/share like the rebalancer's,
+        not cpus/mem.  Every user's value is cached on the audit trail
+        (rank events and ``cs why`` attach it); only the top-K +
+        ``other`` (max of the tail) are exported as series."""
+        dru: Dict[str, float] = {}
+        for user in set(running_stats) | set(waiting_stats):
+            share = self.store.get_share(user, pool_name)
+            if gpu_usage is not None:
+                sg = share.get("gpus")
+                dru[user] = (gpu_usage.get(user, 0.0) / sg
+                             if sg and sg != float("inf") else 0.0)
+                continue
+            used = running_stats.get(user, {})
+            vals = [used.get(dim, 0.0) / share[dim]
+                    for dim in ("cpus", "mem")
+                    if share.get(dim) and share[dim] != float("inf")]
+            dru[user] = max(vals) if vals else 0.0
+        # wholesale replace: departed users age out of the cache instead
+        # of accumulating for the leader's lifetime
+        self.store.audit.set_user_dru(pool_name, dru)
+        cap = max(int(self.slo.max_user_series), 1)
+        top = sorted(dru.items(), key=lambda kv: -kv[1])
+        for user, v in top[:cap]:
+            self.registry.gauge_set("cook_user_dru", round(v, 6),
+                                    {"pool": pool_name, "user": user})
+        if len(top) > cap:
+            self.registry.gauge_set(
+                "cook_user_dru", round(top[cap][1], 6),
+                {"pool": pool_name, "user": "other"})
+        return dru
+
+    def _sweep_wait_phases(self, pool_name: str, pending,
+                           dru: Dict[str, float]) -> None:
+        """Split the pending queue's current waits by WHY (utils/audit.
+        wait_phase): ``fairness`` (quota / rate limit / gang admission /
+        at-or-over share), ``constraints`` (placement-constraint or
+        topology blocked), ``capacity`` (placeable, no room).  Each
+        phase gets its own latency histogram + job-count gauge and its
+        own queue-latency SLO breach ratio, so "users are waiting" pages
+        name the mechanism before anyone opens a timeline."""
+        from ..utils.audit import wait_phase
+        now_ms = self.store.clock()
+        # ONE lock hold for the whole queue's reasons: a per-job
+        # last_reason() would pay 100k lock round-trips contending with
+        # the scheduler's hot-path record() calls
+        reasons = self.store.audit.last_reasons(
+            [j.uuid for j in pending])
+        by_phase: Dict[str, list] = {
+            "fairness": [], "capacity": [], "constraints": []}
+        for j in pending:
+            reason = reasons.get(j.uuid)
+            # the persisted placement-failure census refines "couldn't
+            # place" into constraints-vs-capacity, but it is STICKY
+            # (never cleared once set) — a fresher fairness-side skip
+            # reason from the audit trail must win over it, or a job
+            # that failed placement once and is now quota-throttled
+            # would misreport as capacity forever
+            if reason is None or reason == "unmatched":
+                lpf = j.last_placement_failure
+                if lpf:
+                    reason = ("constraints" if lpf.get("constraints")
+                              else "unmatched")
+            phase = wait_phase(reason, dru.get(j.user, 0.0) >= 1.0)
+            age = (now_ms - (j.last_waiting_start_ms
+                             or j.submit_time_ms)) / 1000.0
+            by_phase[phase].append(age)
+        obj = self.slo.queue_latency_objective_s
+        for phase, ages in by_phase.items():
+            labels = {"pool": pool_name, "phase": phase}
+            self.registry.gauge_set("cook_wait_phase_jobs",
+                                    float(len(ages)), labels)
+            self.registry.observe_many("cook_wait_phase_seconds", ages,
+                                       labels, buckets=LATENCY_BUCKETS)
+            breach = sum(1 for a in ages if a > obj)
+            self._publish_slo(f"queue-latency-{phase}", obj,
+                              breach / len(ages) if ages else 0.0,
+                              pool=pool_name)
+
     def _publish_state(self, pool_name: str, state: str,
                        stats: Dict[str, Dict[str, float]]) -> None:
         key = (pool_name, state)
+        stats = self._fold_tail(stats)
         previous: Set[str] = set(self._previous.get(key, {}))
         with_all = _with_aggregate(stats) if stats else {
             "all": {k: 0.0 for k in _STAT_DIMS}}
-        for user in previous - set(with_all):
-            for dim in _STAT_DIMS:
-                self.registry.gauge_set(
-                    "cook_user_resource", 0.0,
-                    labels={"pool": pool_name, "user": user, "state": state,
-                            "resource": dim})
+        # LIVE series first, vanished-user zeroing after: the
+        # cardinality window admits first-come, and the zero-writes for
+        # departed users must never crowd this sweep's top-K out of it
         self._previous[key] = dict(stats)
         for user, s in with_all.items():
             for dim in _STAT_DIMS:
                 self.registry.gauge_set(
                     "cook_user_resource", float(s.get(dim, 0.0)),
+                    labels={"pool": pool_name, "user": user, "state": state,
+                            "resource": dim})
+        for user in previous - set(with_all):
+            for dim in _STAT_DIMS:
+                self.registry.gauge_set(
+                    "cook_user_resource", 0.0,
                     labels={"pool": pool_name, "user": user, "state": state,
                             "resource": dim})
 

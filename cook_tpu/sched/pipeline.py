@@ -427,7 +427,16 @@ class PipelinedCycleDriver:
                 self.conflicts_resources += nr
             if ns or nr:
                 _flight.note_pipeline_conflicts(ns + nr)
-                _flight.note_skips({"pipeline-conflict": ns + nr})
+                # per-job attribution of the drops (utils/audit.py): the
+                # reconcile masks already name the jobs
+                from ..utils import audit as _audit
+                _audit.note_skips(self.fused.store.audit, {
+                    "pipeline-conflict": [
+                        (cand_jobs[i].uuid,
+                         {"why": "state" if state_drop[i]
+                          else "resources"})
+                        for i in np.flatnonzero(state_drop | res_drop)],
+                }, pool=pp.pool.name)
             return state_drop, res_drop
 
         return reconcile

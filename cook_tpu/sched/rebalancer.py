@@ -59,6 +59,14 @@ class PreemptionDecision:
     victim_task_ids: List[str]
     dru: float
     spare_only: bool = False
+    # fairness observability (docs/OBSERVABILITY.md): the DRU facts that
+    # justified the decision — per-victim DRU at decision time, the
+    # beneficiary's pending DRU, and which victims were only taken by a
+    # whole-gang closure (they label cook_preemptions_total{reason} and
+    # land on both sides' audit timelines)
+    victim_drus: Dict[str, float] = field(default_factory=dict)
+    pending_dru: float = 0.0
+    gang_victim_ids: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -235,6 +243,10 @@ class Rebalancer:
                 continue
             victims = decision[1]
             hostname = decision[0]
+            # the beneficiary's DRU BEFORE the decision mutates state:
+            # the victim/beneficiary delta is the fairness justification
+            pending_dru = state.pending_job_dru(job)
+            direct = {v.task_id for v in victims}
             # whole-gang closure (docs/GANG.md): preempting any member
             # kills its entire gang — across hosts — so the decision can
             # never strand a partial gang holding fragmented capacity
@@ -251,11 +263,17 @@ class Rebalancer:
                         if mate is not None:
                             victims.append(mate)
                             seen.add(tid)
+            victim_drus = {v.task_id: round(float(v.dru), 4)
+                           for v in victims}
             state.apply_decision(job, hostname, victims)
             decisions.append(PreemptionDecision(
                 job_uuid=job.uuid, hostname=hostname,
                 victim_task_ids=[v.task_id for v in victims],
-                dru=decision[2], spare_only=not victims))
+                dru=decision[2], spare_only=not victims,
+                victim_drus=victim_drus,
+                pending_dru=round(float(pending_dru), 4),
+                gang_victim_ids=[v.task_id for v in victims
+                                 if v.task_id not in direct]))
             if victims:
                 budget -= 1
         self._execute(decisions, clusters)
@@ -371,8 +389,13 @@ class Rebalancer:
     def _execute(self, decisions: List[PreemptionDecision],
                  clusters: Dict[str, ComputeCluster]) -> None:
         """Transact preemptions then kill under the write lock (reference:
-        rebalancer.clj:482-533)."""
+        rebalancer.clj:482-533).  Both sides of every decision land on
+        the audit trail with the DRU delta that justified it: the victim
+        records who preempted it and at what DRU, the beneficiary
+        records whose capacity it received (docs/OBSERVABILITY.md)."""
+        audit = self.store.audit
         for d in decisions:
+            gang_mates = set(d.gang_victim_ids)
             for tid in d.victim_task_ids:
                 inst = self.store.instance(tid)
                 if inst is None:
@@ -381,6 +404,19 @@ class Rebalancer:
                     tid, InstanceStatus.FAILED,
                     reason_code=Reasons.PREEMPTED_BY_REBALANCER.code,
                     preempted=True)
+                audit.record(inst.job_uuid, "preempted", {
+                    "task": tid, "by": d.job_uuid,
+                    "host": inst.hostname,
+                    "dru": d.victim_drus.get(tid),
+                    "beneficiary_dru": d.pending_dru,
+                    **({"gang_closure": True} if tid in gang_mates
+                       else {})}, durable=True)
                 cluster = clusters.get(inst.compute_cluster)
                 if cluster is not None:
                     cluster.safe_kill_task(tid)
+            if d.victim_task_ids:
+                audit.record(d.job_uuid, "preemption-benefit", {
+                    "victims": len(d.victim_task_ids),
+                    "host": d.hostname, "dru": d.pending_dru,
+                    "victim_dru_min": min(d.victim_drus.values())
+                    if d.victim_drus else None}, durable=True)

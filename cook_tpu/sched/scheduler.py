@@ -64,6 +64,10 @@ class Scheduler:
             failure_threshold=self.config.circuit_breaker.failure_threshold,
             reset_timeout_s=self.config.circuit_breaker.reset_timeout_s)
         self.breakers = _breakers
+        # per-job audit trail knobs (utils/audit.py): the trail lives on
+        # the store (it must survive into a successor's replay), the
+        # scheduler owns applying the config like faults/breakers
+        store.audit.configure(self.config.audit)
         self.plugins = plugins or PluginRegistry()
         self.rate_limits = rate_limits or RateLimits()
         self.clusters: Dict[str, ComputeCluster] = {}
@@ -725,6 +729,10 @@ class Scheduler:
                                           for r in results.values())
                 rec.jobs_placed = sum(len(r.launched_task_ids)
                                       for r in results.values())
+        # once per cycle: journal the trail's pending advisory events so
+        # decision context survives a leader failover (utils/audit.py;
+        # a no-op without a journal or with nothing pending)
+        self.store.flush_audit()
         return results
 
     def step_match(self, pool_name: Optional[str] = None
@@ -758,6 +766,7 @@ class Scheduler:
                 rec.jobs_placed = sum(len(r.launched_task_ids)
                                       for r in results.values())
         self.last_match_results.update(results)
+        self.store.flush_audit()
         return results
 
     def _autoscale(self, pool_name: str, result: MatchCycleResult) -> None:
@@ -862,7 +871,10 @@ class Scheduler:
                 pool_name, ranked, mc_cap)
             result.considered = len(considerable)
             result.unmatched = considerable
-            flight_recorder.note_skips({"unmatched": len(result.unmatched)})
+            from ..utils import audit as _audit
+            _audit.note_skips(self.store.audit, {
+                "unmatched": [j.uuid for j in result.unmatched]},
+                pool=pool_name)
             return result
         capacity = sum(c.max_launchable(pool_name) for c in clusters)
         considerable = self.matcher.considerable_jobs(
@@ -911,9 +923,12 @@ class Scheduler:
         # one batched intent-confirm for the cycle's direct launches (a
         # per-task clear would journal one transaction per job)
         self.store.clear_launch_intents(result.launched_task_ids)
-        flight_recorder.note_skips({
-            "unmatched": len(result.unmatched),
-            "launch-failed": len(result.launch_failures)})
+        from ..utils import audit as _audit
+        _audit.note_skips(self.store.audit, {
+            "unmatched": [j.uuid for j in result.unmatched],
+            "launch-failed": [(u, {"why": why})
+                              for u, why in result.launch_failures],
+        }, pool=pool_name)
         return result
 
     def step_rebalance(self) -> Dict[str, list]:
@@ -935,15 +950,29 @@ class Scheduler:
                                   for d in pool_decisions)
                     if victims:
                         from ..utils.metrics import registry
-                        registry.counter_inc("cook_preemptions",
-                                             float(victims),
-                                             {"pool": pool.name})
+                        # preemption ATTRIBUTION (docs/OBSERVABILITY.md):
+                        # direct fair-share victims vs gang-closure mates
+                        # taken only because a sibling was chosen
+                        closure = sum(len(d.gang_victim_ids)
+                                      for d in pool_decisions)
+                        if victims - closure:
+                            registry.counter_inc(
+                                "cook_preemptions",
+                                float(victims - closure),
+                                {"pool": pool.name,
+                                 "reason": "fair-share"})
+                        if closure:
+                            registry.counter_inc(
+                                "cook_preemptions", float(closure),
+                                {"pool": pool.name,
+                                 "reason": "gang-closure"})
                         flight_recorder.note_preemptions(victims)
                     for d in pool_decisions:
                         if len(d.victim_task_ids) > 1:
                             self.reserved_hosts[d.job_uuid] = d.hostname
             if rec is not None:
                 rec.pools = len(decisions)
+        self.store.flush_audit()
         return decisions
 
     # --------------------------------------------------------------- reapers

@@ -125,6 +125,11 @@ class ChaosResult:
     node_losses: int = 0
     rpc_faults: int = 0
     delta_faults: int = 0
+    # audit-trail continuity (docs/OBSERVABILITY.md): True when the
+    # promoted leader's journal replay reconstructed a pre-kill job's
+    # full timeline (submit -> ranked -> launched) — `cs why` keeps
+    # answering across the failover
+    audit_timeline_ok: bool = True
     leader_kills: int = 0
     intents_open_at_kill: int = 0
     relaunched_after_kill: int = 0
@@ -148,6 +153,7 @@ class ChaosResult:
             "node_losses": self.node_losses,
             "rpc_faults": self.rpc_faults,
             "delta_faults": self.delta_faults,
+            "audit_timeline_ok": self.audit_timeline_ok,
             "leader_kills": self.leader_kills,
             "intents_open_at_kill": self.intents_open_at_kill,
             "relaunched_after_kill": self.relaunched_after_kill,
@@ -359,6 +365,14 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             j = store.job(intent["job_uuid"])
             if j is not None:
                 crashed_jobs[j.uuid] = len(j.instances)
+        # audit-continuity probe: a job LAUNCHED in an earlier (fully
+        # flushed) cycle — after promotion its timeline must replay
+        # whole from the journal.  Crash-window jobs are excluded: their
+        # launch rode the txn record, but the interrupted cycle's
+        # advisory flush legitimately never ran.
+        probe_uuid = next(
+            (j.uuid for j, _i in store.running_instances()
+             if j.uuid not in crashed_jobs), None)
         pre = json.loads(store.snapshot())
         store.close()  # crash-equivalent: no checkpoint, journal as-is
         # promotion: the successor re-reads everything the dead leader
@@ -374,6 +388,18 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             result.violations.append(
                 "promotion lost committed transactions: replayed state "
                 "differs from the pre-crash store")
+        if probe_uuid is not None:
+            # the NEW store's trail was rebuilt purely from journal
+            # replay (the old process's in-memory trail died with it):
+            # `cs why` on a pre-kill job must still show the lifecycle
+            kinds = {e["kind"] for e in store.audit.timeline(probe_uuid)}
+            missing = {"submitted", "ranked", "launched"} - kinds
+            if missing:
+                result.audit_timeline_ok = False
+                result.violations.append(
+                    f"audit trail lost across failover: job "
+                    f"{probe_uuid} timeline missing {sorted(missing)} "
+                    f"after promotion (has {sorted(kinds)})")
         store.clock = clock
         # the new leader adopts the (still-running) cluster and sweeps
         # the open launch intents in its constructor
@@ -463,6 +489,15 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             continue
         if stored.state is JobState.COMPLETED:
             result.completed += 1
+            # every finished job's audit timeline tells its whole story
+            # (submit -> ... -> terminal), across the mid-run failover
+            kinds = {e["kind"]
+                     for e in store.audit.timeline(job.uuid)}
+            if not {"submitted", "terminal"} <= kinds:
+                result.audit_timeline_ok = False
+                result.violations.append(
+                    f"job {job.uuid} completed with an incomplete audit "
+                    f"timeline: {sorted(kinds)}")
         else:
             result.violations.append(
                 f"job {job.uuid} not terminal: {stored.state.value}")
@@ -532,6 +567,10 @@ class FailoverChaosResult:
     indeterminate_commits: int = 0
     fenced_appends_rejected: int = 0
     fenced_rest_writes_rejected: int = 0
+    # True when the promoted store's replayed audit trail carries the
+    # pre-failover jobs' timelines (journal-backed lane mirrored over
+    # socket replication, docs/OBSERVABILITY.md)
+    audit_timeline_ok: bool = True
 
     @property
     def ok(self) -> bool:
@@ -548,6 +587,7 @@ class FailoverChaosResult:
             "fenced_appends_rejected": self.fenced_appends_rejected,
             "fenced_rest_writes_rejected":
                 self.fenced_rest_writes_rejected,
+            "audit_timeline_ok": self.audit_timeline_ok,
         }
 
 
@@ -744,6 +784,16 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
             if promoted.job(uuid) is None:
                 result.violations.append(
                     f"committed job {uuid} lost by the failover")
+            elif not any(
+                    e["kind"] == "submitted"
+                    for e in promoted.audit.timeline(uuid)):
+                # the audit lane rode the mirrored journal bytes: the
+                # winner's replay must reconstruct each committed job's
+                # timeline too (a laggard winner gets it via delta pull)
+                result.audit_timeline_ok = False
+                result.violations.append(
+                    f"audit timeline for committed job {uuid} lost by "
+                    "the failover")
         # ---- the loser re-follows the winner and converges ----------
         loser_f = repl.ReplicationFollower("127.0.0.1", new_srv.port,
                                            d_loser)

@@ -44,6 +44,9 @@ class SimResult:
     # flight-recorder aggregate over this run's cycles (utils/flight.py):
     # cycle count/percentiles, recompiles, transfer bytes, skip reasons
     flight: Dict = field(default_factory=dict)
+    # per-job audit-trail aggregate (utils/audit.py): jobs tracked +
+    # event counts by kind — sanity that attribution engaged
+    audit: Dict = field(default_factory=dict)
 
     def summary(self) -> Dict:
         wt = np.asarray(self.wait_times_ms or [0])
@@ -64,6 +67,7 @@ class SimResult:
             "placements_per_wall_s": (self.placements / wall_s
                                       if wall_s > 0 else float("inf")),
             "flight": self.flight,
+            "audit": self.audit,
         }
 
 
@@ -201,6 +205,7 @@ class Simulator:
 
         # harvest
         result.flight = flight_recorder.summary(since_seq=flight_seq0)
+        result.audit = self.store.audit.stats()
         result.makespan_ms = now - start_ms
         for job in self.trace:
             stored = self.store.job(job.uuid)

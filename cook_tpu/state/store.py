@@ -318,6 +318,23 @@ class Store:
         # a local fenced journal in the replication topology, where a
         # failed append may safely truncate (no concurrent appender).
         self._journal_shared = True
+        # per-job scheduling audit trail (utils/audit.py): lifecycle
+        # events feed off this store's tx events and are journaled
+        # atomically with their transaction ("a" key on the txn record);
+        # decision paths record advisory events directly and
+        # flush_audit() journals them once per cycle.  Store-scoped (not
+        # a module global) so a promoted leader's replayed trail is
+        # genuinely its own, not a leak from the deposed process.
+        from ..utils.audit import AuditTrail
+        self.audit = AuditTrail(clock=lambda: self.clock())
+        # fed through the commit-ordered subscriber queue (FIRST in the
+        # list, ahead of any scheduler subscription): recording inline
+        # after the lock release could interleave two transactions'
+        # lifecycle events out of commit order (e.g. "instance: running"
+        # before "launched"), diverging from the journal's "a"-record
+        # order a promoted leader would replay
+        self._subscribers.append(
+            lambda _tx_id, events: self.audit.on_tx_events(events))
 
     # ------------------------------------------------------------------ txns
     def transact(self, fn: Callable[[_Txn], Any]) -> Any:
@@ -389,6 +406,21 @@ class Store:
             rec["lr"] = txn.latch_registrations
         if txn.latch_pops:
             rec["lp"] = txn.latch_pops
+        if txn.events and self.audit.enabled and self.audit.journal:
+            # lifecycle audit docs ride the SAME record as their
+            # transaction: replay (and a promoted mirror's replay)
+            # rebuilds the per-job timeline with zero extra appends
+            from ..utils.audit import tx_event_to_audit
+            ts = self.clock()
+            docs = []
+            for e in txn.events:
+                wire = tx_event_to_audit(e)
+                if wire is not None:
+                    uuid, kind, data = wire
+                    docs.append({"u": uuid, "k": kind, "t": ts,
+                                 **({"d": data} if data else {})})
+            if docs:
+                rec["a"] = docs
         f = self._journal_file
         # every append flushes, so the buffer is empty here and tell() is
         # the true end-of-good-records offset
@@ -483,6 +515,85 @@ class Store:
                 except Exception:
                     pass
             raise
+
+    def flush_audit(self) -> int:
+        """Journal the audit trail's pending ADVISORY events (ranked
+        positions, skip/defer attributions) as one ``{"a": [...]}``
+        record — called once per scheduler cycle, so pre-failover
+        decision context survives a leader kill the same way entity
+        state does (lifecycle events already rode their own txn
+        records).  The advisory lane must never hurt the store: a
+        fenced/deposed leader drops the flush silently, and a failed
+        append excises its torn fragment with the same truncate/poison
+        discipline as _journal_append (a torn audit line would merge
+        with the NEXT committed record at replay and lose it).
+        Returns the number of events journaled."""
+        self.audit.publish_metrics()
+        if not (self.audit.enabled and self.audit.journal) \
+                or self._journal_file is None or self._journal_poisoned:
+            # no durability to provide: drop the pending refs WITHOUT
+            # serializing them (the in-memory lanes keep everything)
+            self.audit.discard_pending()
+            return 0
+        with self._lock:
+            if self._journal_file is None or self._journal_poisoned:
+                self.audit.discard_pending()
+                return 0
+            if self._journal_epoch is not None:
+                try:
+                    self._check_fence()
+                except StaleEpochError:
+                    return 0  # deposed: advisory events just drop
+            # drain UNDER the store lock (store lock -> audit lock is
+            # the one ordering used everywhere): drained-but-unappended
+            # events outside the lock could race a concurrent
+            # checkpoint()'s re-seed and land in the fresh journal twice
+            recs = self.audit.drain_durable()
+            if not recs:
+                return 0
+            if not self._write_audit_record_locked(recs):
+                return 0
+        return len(recs)
+
+    def _write_audit_record_locked(self, recs: List[Dict[str, Any]]
+                                   ) -> bool:
+        """Append one ``{"a": [...]}`` record; caller holds the lock
+        and has fence-checked.  Shares _journal_append's torn-write
+        discipline (truncate the fragment, or poison when it can't be
+        excised — a torn line would merge with the NEXT committed
+        record at replay and lose it) and honors the fsync setting.
+        Returns False on failure (advisory loss, store stays healthy)."""
+        f = self._journal_file
+        rec: Dict[str, Any] = {"a": recs}
+        if self._journal_epoch is not None:
+            rec["ep"] = self._journal_epoch
+        good_offset = f.tell()
+        try:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            if self._journal_fsync:
+                os.fsync(f.fileno())
+        except Exception:
+            try:
+                if self._journal_epoch is not None \
+                        and self._journal_shared:
+                    raise OSError("fenced journal: no truncate")
+                f.seek(good_offset)
+                f.truncate(good_offset)
+                self._bump_journal_gen()
+            except Exception:
+                self._journal_file = None
+                self._journal_poisoned = True
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            return False
+        if self._repl_server is not None:
+            # audit records mirror like any journal bytes, but are
+            # never waited on — audit must not add commit latency
+            self._repl_server.poke()
+        return True
 
     def _bump_journal_gen(self) -> None:
         """Advance ``<dir>/journal_gen`` after ANY journal truncation.
@@ -795,7 +906,13 @@ class Store:
 
         def _clear(txn: _Txn) -> int:
             for t in live:
+                intent = self._intents.get(t)
                 txn.delete("intents", t)
+                if intent is not None:
+                    # intent -> ack on the job's audit timeline (the
+                    # backend confirmed the dispatch; docs/OBSERVABILITY)
+                    txn.event("launch-ack", task_id=t,
+                              job=intent.get("job_uuid", ""))
             return len(live)
 
         return self.transact(_clear)
@@ -1381,6 +1498,10 @@ class Store:
             self._latches.setdefault(latch, []).extend(uuids)
         for latch in rec.get("lp", []):
             self._latches.pop(latch, None)
+        if rec.get("a"):
+            # per-job audit docs (utils/audit.py): a promoted leader's
+            # replay rebuilds pre-failover timelines from these
+            self.audit.load(rec["a"])
         self._tx_id = rec.get("tx", self._tx_id)
 
     def checkpoint(self) -> None:
@@ -1397,15 +1518,30 @@ class Store:
                 # successor's journal
                 self._check_fence()
             snap_path = os.path.join(self._journal_dir, "snapshot.json")
-            tmp = snap_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(self.snapshot())
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, snap_path)
+            # writer-unique temp + directory fsync (utils/fsatomic.py):
+            # a shared ".tmp" name let a deposed leader's last-gasp
+            # checkpoint race the successor's on the same temp file
+            from ..utils.fsatomic import write_atomic_text
+            write_atomic_text(snap_path, self.snapshot())
             self._journal_file.close()
             self._journal_file = open(self._journal_path, "w",
                                       encoding="utf-8")
+            if self.audit.enabled and self.audit.journal:
+                # the snapshot carries no audit lane — re-seed the
+                # compacted journal with the (bounded) current trail so
+                # timeline continuity survives compaction too.  Pending
+                # durable events are marked flushed FIRST: the re-seed
+                # already carries them, and leaving them pending would
+                # journal them a second time at the next flush_audit
+                # (duplicated on every later replay)
+                self.audit.discard_pending()
+                docs = self.audit.export_wire()
+                if docs:
+                    # same torn-write excision/poison + fsync discipline
+                    # as every other audit append: a bare write here
+                    # could leave a torn fragment at the fresh journal's
+                    # head that swallows the next committed txn record
+                    self._write_audit_record_locked(docs)
 
     def close(self) -> None:
         with self._lock:

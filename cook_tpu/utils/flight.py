@@ -64,7 +64,7 @@ class CycleRecord:
                  "h2d_bytes", "d2h_bytes", "sync_wait_ms", "faults",
                  "error", "pipeline_depth", "pipeline_inflight",
                  "pipeline_conflicts", "delta_rows", "full_repacks",
-                 "_t0")
+                 "audit_events", "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
@@ -104,6 +104,10 @@ class CycleRecord:
         self.detail_ms: Dict[str, float] = {}
         self.delta_rows = 0
         self.full_repacks = 0
+        # per-job audit events recorded during this cycle (utils/audit.py):
+        # the audit lane's own overhead meter — a cycle that recorded
+        # nothing proves the quiet fast path stayed zero-work
+        self.audit_events = 0
         self._t0 = time.perf_counter()
 
     def to_doc(self) -> Dict[str, Any]:
@@ -127,6 +131,7 @@ class CycleRecord:
             "detail_ms": {k: round(v, 3) for k, v in self.detail_ms.items()},
             "delta_rows": self.delta_rows,
             "full_repacks": self.full_repacks,
+            "audit_events": self.audit_events,
             "error": self.error,
         }
 
@@ -268,6 +273,14 @@ class FlightRecorder:
             with self._lock:
                 rec.full_repacks += 1
 
+    def note_audit(self, n: int = 1) -> None:
+        """Per-job audit events (utils/audit.py) recorded inside the
+        current cycle."""
+        rec = _current_record.get()
+        if rec is not None and n:
+            with self._lock:
+                rec.audit_events += int(n)
+
     def note_fault(self, point: str, n: int = 1) -> None:
         """A fault-point trigger or degradation (kernel fallback, breaker
         reroute) attributed to the cycle it happened inside."""
@@ -353,6 +366,7 @@ class FlightRecorder:
             "detail_ms": {k: round(v, 3) for k, v in detail.items()},
             "delta_rows": sum(r.delta_rows for r in records),
             "full_repacks": sum(r.full_repacks for r in records),
+            "audit_events": sum(r.audit_events for r in records),
         }
 
     def reset(self) -> None:

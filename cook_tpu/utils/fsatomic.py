@@ -27,13 +27,34 @@ def write_atomic_text(path: str, text: str) -> None:
     fsync of the containing directory.  A power loss leaves either the
     old or the new content, never a torn or REGRESSED one — POSIX does
     not guarantee the rename itself survives power loss without the
-    directory fsync."""
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(text)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    directory fsync.
+
+    The temp name is writer-unique (pid + thread id): two concurrent
+    writers of the SAME path (e.g. the elector's position-publisher
+    thread racing the promotion path on one candidate file) must each
+    rename their own temp — a shared ``.tmp`` name let one writer's
+    os.replace consume the other's temp file and crash it with
+    FileNotFoundError.  The temp is DOT-PREFIXED: consumers that scan
+    directories by filename prefix (the elector's candidate sidecars)
+    must never parse a crash-orphaned temp as a live entry."""
+    import threading
+    head, tail = os.path.split(path)
+    tmp = os.path.join(
+        head, f".{tail}.tmp.{os.getpid()}.{threading.get_ident()}")
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # unique temp names are never reused by later writers, so a
+        # failed write must clean its own up or they accumulate
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     try:
         dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
         try:

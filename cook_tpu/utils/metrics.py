@@ -49,15 +49,83 @@ class MetricsRegistry:
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
         # histogram state is fixed-size: cumulative bucket counts + count/sum
         self._histograms: Dict[Tuple[str, Tuple], Dict] = {}
+        # cardinality guard (docs/OBSERVABILITY.md): (metric, label) ->
+        # (cap, scope-label) on DISTINCT label values.  Past the cap,
+        # samples fold into value "other" and
+        # cook_metrics_dropped_labels_total counts the fold — per-user
+        # fairness gauges stay bounded at millions-of-users scale.  The
+        # window is PER SCOPE value (default scope "pool"): each pool's
+        # user population gets its own cap, so a later-swept pool's
+        # legitimate top-K is never folded just because earlier pools
+        # filled a global window.  Admission is first-come within a
+        # window; publishers that want top-K-by-usage (sched/monitor.py)
+        # sort before publishing and reset_label_window() each sweep.
+        self._label_caps: Dict[Tuple[str, str],
+                               Tuple[int, Tuple[str, ...]]] = {}
+        self._label_seen: Dict[Tuple[str, str], Dict[Tuple, set]] = {}
+
+    # ------------------------------------------------------ cardinality guard
+    OTHER_LABEL = "other"
+
+    def set_label_cap(self, name: str, label: str, cap: int,
+                      scope: Tuple[str, ...] = ("pool",)) -> None:
+        """Cap distinct values of ``label`` on metric ``name`` per
+        distinct combination of the ``scope`` labels (empty tuple = one
+        global window); overflow samples are re-labeled ``other``
+        (idempotent re-registration)."""
+        with self._lock:
+            self._label_caps[(name, label)] = (int(cap), tuple(scope))
+            self._label_seen.setdefault((name, label), {})
+
+    def reset_label_window(self, name: str, label: str) -> None:
+        """Forget which values currently hold a slot (a periodic
+        publisher calls this each sweep so a NEW top-K can claim the
+        slots; already-exported stale series are the publisher's to
+        zero/clear)."""
+        with self._lock:
+            self._label_seen.get((name, label), {}).clear()
+
+    def _guard_labels(self, name: str,
+                      labels: Optional[Dict[str, str]]
+                      ) -> Optional[Dict[str, str]]:
+        """Apply label caps (caller does NOT hold the lock).  Returns
+        possibly-rewritten labels; counts folds."""
+        if not labels or not self._label_caps:
+            return labels
+        folded = None
+        for label, value in list(labels.items()):
+            key = (name, label)
+            capinfo = self._label_caps.get(key)
+            if capinfo is None or value == self.OTHER_LABEL:
+                continue
+            cap, scope = capinfo
+            group = tuple(labels.get(s, "") for s in scope)
+            with self._lock:
+                seen = self._label_seen.setdefault(
+                    key, {}).setdefault(group, set())
+                if value in seen:
+                    continue
+                if len(seen) < cap:
+                    seen.add(value)
+                    continue
+            if folded is None:
+                folded = dict(labels)
+            folded[label] = self.OTHER_LABEL
+            key2 = ("cook_metrics_dropped_labels",
+                    _labels_key({"metric": name, "label": label}))
+            with self._lock:
+                self._counters[key2] = self._counters.get(key2, 0.0) + 1.0
+        return folded if folded is not None else labels
 
     def counter_inc(self, name: str, value: float = 1.0,
                     labels: Optional[Dict[str, str]] = None) -> None:
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(self._guard_labels(name, labels)))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0.0) + value
 
     def gauge_set(self, name: str, value: float,
                   labels: Optional[Dict[str, str]] = None) -> None:
+        labels = self._guard_labels(name, labels)
         with self._lock:
             self._gauges[(name, _labels_key(labels))] = value
 
@@ -78,7 +146,7 @@ class MetricsRegistry:
         cumulative bucket counts cannot be re-bucketed); default is the
         sub-second duration ladder, pass ``LATENCY_BUCKETS`` for
         second-to-hour wait times."""
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(self._guard_labels(name, labels)))
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
@@ -103,7 +171,7 @@ class MetricsRegistry:
         vals = np.asarray(list(values_s), dtype=float)
         if vals.size == 0:
             return
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(self._guard_labels(name, labels)))
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
@@ -167,6 +235,8 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._label_caps.clear()
+            self._label_seen.clear()
 
 
 registry = MetricsRegistry()

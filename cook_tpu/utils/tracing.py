@@ -185,6 +185,39 @@ class Tracer:
             self.finished.clear()
 
 
+def job_track_events(uuid: str, timeline: List[Dict[str, Any]],
+                     tid: int = 2) -> List[Dict[str, Any]]:
+    """One job's audit timeline (utils/audit.py event docs) as a named
+    Chrome-trace TRACK of instant events, stitchable into any
+    export_chrome_trace payload: the cycle flamegraph and the job's
+    decision history line up on one Perfetto timeline
+    (``/debug/trace?trace_id=...&job=<uuid>``).
+
+    Audit timestamps are store-clock epoch ms (wall clock in
+    production); span timestamps are wall-clock too, so the tracks align
+    — under the simulator's virtual clock the job track keeps its own
+    relative ordering but sits at virtual time."""
+    if not timeline:
+        return []
+    # spans live on tid 1; each job track is its own lane (callers
+    # stitching several jobs pass distinct tids)
+    events: List[Dict[str, Any]] = [{
+        "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+        "args": {"name": f"job {uuid}"}}]
+    for ev in timeline:
+        args = dict(ev.get("data") or {})
+        if ev.get("count", 1) > 1:
+            args["count"] = ev["count"]
+        name = ev["kind"]
+        if name == "skip" and args.get("reason"):
+            name = f"skip:{args['reason']}"
+        events.append({
+            "name": name, "cat": "cook.audit", "ph": "i",
+            "ts": round(ev["ts"] * 1000.0, 3), "pid": 1, "tid": tid,
+            "s": "t", "args": args})
+    return events
+
+
 class _NoopSpan:
     def set_tag(self, key: str, value: Any) -> None:
         pass

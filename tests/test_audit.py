@@ -1,0 +1,708 @@
+"""Per-job scheduling audit trail + fairness plane (ISSUE 8;
+utils/audit.py, sched/monitor.py fairness sweep, REST/CLI surfaces).
+
+The contracts under test:
+
+* TRAIL MECHANICS: coalescing of repeated advisory events, lifecycle
+  events outliving advisory ones at the lane cap, LRU job eviction,
+  once-only durable drain, journal wire round trip;
+* ATTRIBUTION PARITY: for a seeded mixed workload (gangs + constraints
+  + rate limits + quota squeeze), the per-job audit skip events sum
+  EXACTLY to the flight recorder's aggregate skip-reason histogram —
+  across the split host driver, the sync fused driver, and the depth-2
+  pipelined resident driver (one mapping feeds both sides, so drift is
+  a bug by construction);
+* FAILOVER CONTINUITY: a reopened store replays its journal's audit
+  records back into per-job timelines (chaos asserts the full
+  leader-kill path; see sim/chaos.py audit_timeline_ok);
+* FAIRNESS PLANE: per-user DRU gauges (top-K + other), wait-phase
+  classification (fairness vs capacity vs constraints), preemption
+  attribution on both sides' timelines;
+* CARDINALITY GUARD: per-label distinct-value caps fold overflow into
+  `other` and count the folds;
+* SURFACES: GET /debug/job/<uuid>/timeline, /unscheduled_jobs history,
+  `cs why`, and the Perfetto per-job track.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import AuditConfig, Config
+from cook_tpu.policy import RateLimits, TokenBucketRateLimiter
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import (
+    Group,
+    Job,
+    Pool,
+    Resources,
+    Store,
+    new_uuid,
+)
+from cook_tpu.utils.audit import AuditTrail, note_skips, wait_phase
+from cook_tpu.utils.flight import recorder as flight_recorder
+from cook_tpu.utils.metrics import MetricsRegistry
+from cook_tpu.utils.metrics import registry as global_registry
+from cook_tpu.utils.tracing import tracer
+
+
+def _reset():
+    tracer.reset()
+    global_registry.reset()
+    flight_recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# Trail mechanics
+# ---------------------------------------------------------------------------
+
+class TestTrailMechanics:
+    def test_coalesce_and_timeline_order(self):
+        t = AuditTrail(clock=lambda: 1000)
+        t.record("j1", "submitted", {"user": "u"}, durable=True)
+        for pos in (5, 4, 3):
+            t.record("j1", "ranked", {"pos": pos})
+        t.record("j1", "skip", {"reason": "rate-limited"})
+        t.record("j1", "skip", {"reason": "rate-limited"})
+        t.record("j1", "skip", {"reason": "unmatched"})
+        tl = t.timeline("j1")
+        assert [e["kind"] for e in tl] == ["submitted", "ranked", "skip",
+                                          "skip"]
+        ranked = tl[1]
+        assert ranked["count"] == 3 and ranked["data"]["pos"] == 3
+        assert tl[2]["count"] == 2
+        assert tl[2]["data"]["reason"] == "rate-limited"
+        assert t.last_reason("j1") == "unmatched"
+
+    def test_lifecycle_survives_lane_cap(self):
+        t = AuditTrail(per_job=8)
+        t.record("j1", "submitted", {})
+        t.record("j1", "launched", {"task": "t1"})
+        # distinct-reason skips don't coalesce: they churn the lane
+        for i in range(40):
+            t.record("j1", "skip", {"reason": f"r{i}"})
+        kinds = [e["kind"] for e in t.timeline("j1")]
+        assert "submitted" in kinds and "launched" in kinds
+        assert len(kinds) <= 8
+
+    def test_job_lane_eviction_insertion_order(self):
+        t = AuditTrail(max_jobs=3)
+        for i in range(5):
+            t.record(f"j{i}", "submitted", {})
+        assert t.jobs_tracked() == 3
+        assert t.timeline("j0") == [] and t.timeline("j4")
+
+    def test_durable_drain_once_and_load_round_trip(self):
+        t = AuditTrail(clock=lambda: 7)
+        t.record("j1", "submitted", {"user": "u"}, durable=True)
+        t.record("j1", "skip", {"reason": "over-quota"}, durable=True)
+        t.record("j1", "skip", {"reason": "over-quota"}, durable=True)
+        wire = t.drain_durable()
+        # the coalesced skip flushes ONCE, carrying its current count
+        assert [w["k"] for w in wire] == ["submitted", "skip"]
+        assert wire[1]["n"] == 2
+        assert t.drain_durable() == []
+        # a further bump after flush stays in-memory only
+        t.record("j1", "skip", {"reason": "over-quota"}, durable=True)
+        assert t.drain_durable() == []
+        t2 = AuditTrail()
+        t2.load(wire)
+        assert [e["kind"] for e in t2.timeline("j1")] == ["submitted",
+                                                          "skip"]
+        assert t2.timeline("j1")[1]["count"] == 2
+        assert t2.drain_durable() == []  # loaded events never re-pend
+
+    def test_disabled_trail_records_nothing(self):
+        t = AuditTrail()
+        t.enabled = False
+        t.record("j1", "submitted", {})
+        note_skips(t, {"unmatched": ["j1"]})
+        assert t.timeline("j1") == [] and t.jobs_tracked() == 0
+
+    def test_note_skips_feeds_both_sides_equally(self):
+        _reset()
+        t = AuditTrail()
+        with flight_recorder.cycle(kind="match") as rec:
+            note_skips(t, {"unmatched": ["a", "b"],
+                           "launch-failed": [("c", {"why": "no-job"})],
+                           "empty": []})
+        assert rec.skip_reasons == {"unmatched": 2, "launch-failed": 1}
+        assert t.skip_counts() == {"unmatched": 2, "launch-failed": 1}
+        assert rec.audit_events == 3
+        assert t.timeline("c")[0]["data"]["why"] == "no-job"
+
+
+# ---------------------------------------------------------------------------
+# Attribution parity across the three drivers
+# ---------------------------------------------------------------------------
+
+def _mixed_world(cfg):
+    """Deterministic store + scheduler with every throttle class armed:
+    quota squeeze, per-user launch-rate limit, an unplaceable-resources
+    job, and a topology gang that can never fit one slice."""
+    store = Store()
+    store.put_pool(Pool(name="default"))
+    hosts = []
+    for i in range(4):
+        h = FakeHost(hostname=f"h{i}",
+                     capacity=Resources(cpus=8.0, mem=8192.0))
+        h.attributes["slice-id"] = f"s{i // 2}"  # 2-host slices
+        hosts.append(h)
+    rl = RateLimits(job_launch=TokenBucketRateLimiter(
+        tokens_per_minute=0.0001, bucket_size=3.0))
+    sched = Scheduler(store, cfg, [FakeCluster("fake-1", hosts)],
+                      rank_backend="tpu", rate_limits=rl)
+    store.set_quota("quotauser", "default",
+                    {"cpus": 3.0, "mem": 100000.0, "count": 100})
+    jobs = []
+    for i in range(10):
+        jobs.append(Job(
+            uuid=f"00000000-0000-4000-8000-{i:012d}",
+            user=f"user{i % 2}", command="true", pool="default",
+            priority=i, resources=Resources(cpus=1.0, mem=256.0),
+            submit_time_ms=1000 + i))
+    for i in range(3):  # 2nd+ exceed the 3-cpu quota
+        jobs.append(Job(
+            uuid=f"00000000-0000-4000-8001-{i:012d}",
+            user="quotauser", command="true", pool="default",
+            resources=Resources(cpus=2.0, mem=128.0),
+            submit_time_ms=900 + i))
+    jobs.append(Job(  # unplaceable: no host has 64 cpus
+        uuid="00000000-0000-4000-8002-000000000000",
+        user="bigjob", command="true", pool="default",
+        resources=Resources(cpus=64.0, mem=128.0), submit_time_ms=800))
+    store.create_jobs(jobs)
+    # 3-gang over 2-host slices, each member 5 of a host's 8 cpus: at
+    # most two members fit any slice, so the gang drops partial every
+    # cycle (gang-partial attribution must fire)
+    members = [Job(uuid=f"00000000-0000-4000-8003-{i:012d}",
+                   user="ganguser", command="true", group="g1",
+                   pool="default", resources=Resources(cpus=5.0, mem=64.0),
+                   submit_time_ms=700)
+               for i in range(3)]
+    store.create_jobs(members, groups=[Group(
+        uuid="g1", gang=True, gang_size=3, gang_topology="slice-id",
+        jobs=[m.uuid for m in members])])
+    return store, sched
+
+
+def _drive(mode, cycles=3):
+    cfg = Config()
+    cfg.default_matcher.backend = "cpu"
+    if mode == "split":
+        cfg.cycle_mode = "split"
+        cfg.pipeline.depth = 0
+    elif mode == "fused":
+        cfg.cycle_mode = "fused"
+        cfg.pipeline.depth = 0
+    else:  # pipelined resident
+        cfg.cycle_mode = "fused"
+        cfg.pipeline.depth = 2
+    assert cfg.resident_pack and cfg.columnar_index
+    store, sched = _mixed_world(cfg)
+    seq0 = flight_recorder.last_seq()
+    for _ in range(cycles):
+        if mode == "split":
+            sched.step_rank()
+            sched.step_match()
+        else:
+            sched.step_cycle()
+    return store, flight_recorder.summary(since_seq=seq0)
+
+
+@pytest.mark.parametrize("mode", ["split", "fused", "pipelined"])
+def test_attribution_parity(mode):
+    """Sum of per-job audit skip events per reason == the flight
+    recorder's aggregate skip-reason histogram, for every driver."""
+    _reset()
+    store, flight = _drive(mode)
+    agg = {k: v for k, v in flight.get("skip_reasons", {}).items() if v}
+    per_job = {k: v for k, v in store.audit.skip_counts().items() if v}
+    assert per_job == agg, (mode, per_job, agg)
+    # the workload actually exercised several throttle classes
+    assert "unmatched" in agg
+    if mode == "split":
+        assert {"rate-limited", "over-quota"} <= set(agg), agg
+    # the gang straddles 2-wide slices: some gang attribution must exist
+    assert any(k.startswith("gang") for k in agg), agg
+    # audit_events landed on cycle records (the overhead meter works)
+    assert flight.get("audit_events", 0) > 0
+    # and admitted candidates got ranked events with positions (the
+    # unplaceable big job is always admitted, then unmatched)
+    g0 = store.audit.timeline("00000000-0000-4000-8002-000000000000")
+    assert any(e["kind"] == "ranked" and "pos" in e.get("data", {})
+               for e in g0), g0
+
+
+def test_lifecycle_events_from_tx_feed():
+    """submitted -> launched -> launch-ack -> instance -> terminal ride
+    the store's transaction feed without any scheduler involvement."""
+    _reset()
+    store = Store()
+    [uuid] = store.create_jobs([Job(
+        uuid=new_uuid(), user="u", command="x",
+        resources=Resources(cpus=1, mem=10))])
+    inst = store.launch_instance(uuid, "t-1", hostname="h1")
+    store.clear_launch_intents(["t-1"])
+    from cook_tpu.state import InstanceStatus
+    store.update_instance_status("t-1", InstanceStatus.RUNNING)
+    store.update_instance_status("t-1", InstanceStatus.SUCCESS)
+    kinds = [e["kind"] for e in store.audit.timeline(uuid)]
+    assert kinds == ["submitted", "launched", "launch-ack", "instance",
+                     "instance", "terminal"]
+    assert inst.task_id == "t-1"
+
+
+# ---------------------------------------------------------------------------
+# Failover continuity (store-level; the full leader-kill path is
+# asserted by sim/chaos.py run_chaos via audit_timeline_ok)
+# ---------------------------------------------------------------------------
+
+class TestFailoverContinuity:
+    def test_journal_replay_rebuilds_timeline(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [uuid] = store.create_jobs([Job(
+            uuid=new_uuid(), user="u", command="x",
+            resources=Resources(cpus=1, mem=10))])
+        store.audit.ranked([uuid], [7], "default", users=["u"])
+        store.audit.record(uuid, "skip", {"reason": "rate-limited"},
+                           durable=True)
+        assert store.flush_audit() == 2
+        store.launch_instance(uuid, "t-1", hostname="h1")
+        store.close()
+        successor = Store.open(d)
+        tl = successor.audit.timeline(uuid)
+        assert [e["kind"] for e in tl] == ["submitted", "ranked", "skip",
+                                          "launched"]
+        assert tl[1]["data"]["pos"] == 7
+        successor.close()
+
+    def test_checkpoint_preserves_timeline(self, tmp_path):
+        d = str(tmp_path / "state")
+        store = Store.open(d)
+        [uuid] = store.create_jobs([Job(
+            uuid=new_uuid(), user="u", command="x",
+            resources=Resources(cpus=1, mem=10))])
+        # a durable advisory event still PENDING at checkpoint time: the
+        # re-seed must carry it exactly once (an unmarked pending would
+        # journal it again at the next flush and duplicate on replay)
+        store.audit.record(uuid, "preempted", {"by": "x"}, durable=True)
+        store.checkpoint()  # journal truncated; trail re-seeded
+        assert store.flush_audit() == 0  # nothing left pending
+        reopened = Store.open(d)
+        assert [e["kind"] for e in reopened.audit.timeline(uuid)] \
+            == ["submitted", "preempted"]
+        reopened.close()
+        store.close()
+
+    def test_flush_is_noop_without_journal(self):
+        store = Store()
+        store.audit.record("j", "skip", {"reason": "x"}, durable=True)
+        assert store.flush_audit() == 0
+
+    @pytest.mark.chaos
+    def test_chaos_leader_kill_keeps_timelines(self):
+        from cook_tpu.sim.chaos import ChaosConfig, run_chaos
+        _reset()
+        r = run_chaos(ChaosConfig(
+            seed=2, n_jobs=10, n_users=2, n_hosts=4,
+            submit_span_ms=8_000, job_duration_ms=3_000,
+            leader_kill_at_ms=5_000, node_loss_every_ms=10 ** 9,
+            rpc_fault_probability=0.0))
+        assert r.ok, r.violations
+        assert r.leader_kills == 1
+        assert r.audit_timeline_ok
+
+
+# ---------------------------------------------------------------------------
+# Fairness plane
+# ---------------------------------------------------------------------------
+
+class TestFairnessPlane:
+    def _world(self):
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        store.set_share("heavy", "default", {"cpus": 1.0, "mem": 100.0})
+        store.set_share("light", "default", {"cpus": 100.0,
+                                             "mem": 100000.0})
+        [running] = store.create_jobs([Job(
+            uuid=new_uuid(), user="heavy", command="x",
+            resources=Resources(cpus=4.0, mem=50.0))])
+        store.launch_instance(running, "t-r", hostname="h1")
+        from cook_tpu.state import InstanceStatus
+        store.update_instance_status("t-r", InstanceStatus.RUNNING)
+        pend = store.create_jobs([
+            Job(uuid=new_uuid(), user="heavy", command="x",
+                resources=Resources(cpus=1, mem=10)),
+            Job(uuid=new_uuid(), user="light", command="x",
+                resources=Resources(cpus=1, mem=10)),
+        ])
+        return store, pend
+
+    def test_user_dru_gauge_and_cache(self):
+        _reset()
+        from cook_tpu.sched.monitor import Monitor
+        store, _pend = self._world()
+        Monitor(store).sweep()
+        gauges = global_registry.snapshot()["gauges"]
+        heavy = [v for k, v in gauges.items()
+                 if k.startswith("cook_user_dru") and 'user="heavy"' in k]
+        assert heavy == [4.0]  # 4 cpus / share 1
+        assert store.audit.user_dru("default", "heavy") == 4.0
+        assert store.audit.user_dru("default", "light") is not None
+
+    def test_wait_phase_classification(self):
+        _reset()
+        from cook_tpu.sched.monitor import Monitor
+        store, pend = self._world()
+        # light's job was skipped for capacity reasons last cycle
+        store.audit.record(pend[1], "skip", {"reason": "unmatched"})
+        Monitor(store).sweep()
+        gauges = global_registry.snapshot()["gauges"]
+
+        def phase_count(phase):
+            return sum(v for k, v in gauges.items()
+                       if k.startswith("cook_wait_phase_jobs")
+                       and f'phase="{phase}"' in k)
+        # heavy's pending job: over share, no contrary signal -> fairness
+        assert phase_count("fairness") == 1
+        assert phase_count("capacity") == 1
+        assert phase_count("constraints") == 0
+        # the per-phase SLO series exist
+        assert any('slo="queue-latency-fairness"' in k for k in gauges)
+
+    def test_wait_phase_helper_table(self):
+        assert wait_phase("rate-limited", False) == "fairness"
+        assert wait_phase("gang-deferred", False) == "fairness"
+        assert wait_phase("unmatched", True) == "capacity"
+        assert wait_phase("gang-partial", False) == "constraints"
+        assert wait_phase("constraints", False) == "constraints"
+        assert wait_phase(None, True) == "fairness"
+        assert wait_phase(None, False) == "capacity"
+
+    def test_preemption_lands_on_both_timelines(self):
+        """Rebalancer preemption: the victim's timeline names the
+        beneficiary and the DRU delta; the beneficiary's names the
+        victims; cook_preemptions_total carries the reason label."""
+        _reset()
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        store.set_share("pig", "default", {"cpus": 1.0, "mem": 100.0})
+        hosts = [FakeHost(hostname="h0",
+                          capacity=Resources(cpus=4.0, mem=4096.0))]
+        cfg = Config()
+        cfg.rebalancer.enabled = True
+        cfg.rebalancer.min_dru_diff = 0.0
+        cfg.rebalancer.safe_dru_threshold = 0.0
+        cfg.default_matcher.backend = "cpu"
+        sched = Scheduler(store, cfg, [FakeCluster("fake-1", hosts)],
+                          rank_backend="cpu")
+        [fat] = store.create_jobs([Job(
+            uuid=new_uuid(), user="pig", command="x",
+            resources=Resources(cpus=4.0, mem=512.0))])
+        sched.step_rank()
+        sched.step_match()
+        assert store.job(fat).instances  # pig fills the host
+        [starved] = store.create_jobs([Job(
+            uuid=new_uuid(), user="newbie", command="x",
+            resources=Resources(cpus=2.0, mem=128.0))])
+        sched.step_rank()
+        decisions = sched.step_rebalance()
+        assert decisions, "expected a preemption decision"
+        victim_tl = store.audit.timeline(fat)
+        pre = [e for e in victim_tl if e["kind"] == "preempted"]
+        assert pre and pre[0]["data"]["by"] == starved
+        assert pre[0]["data"]["dru"] is not None
+        ben = [e for e in store.audit.timeline(starved)
+               if e["kind"] == "preemption-benefit"]
+        assert ben and ben[0]["data"]["victims"] == 1
+        counters = global_registry.snapshot()["counters"]
+        assert any("cook_preemptions" in k and 'reason="fair-share"' in k
+                   for k in counters), counters
+        # record()-path events feed cook_audit_events_total too
+        store.audit.publish_metrics()
+        counters = global_registry.snapshot()["counters"]
+        assert any("cook_audit_events" in k and 'kind="preempted"' in k
+                   for k in counters), counters
+
+    def test_gpu_pool_dru_uses_gpu_dimension(self):
+        """A DruMode.GPU pool's cook_user_dru prices gpus/share — the
+        dimension the rebalancer actually preempts against — not
+        cpus/mem."""
+        _reset()
+        from cook_tpu.sched.monitor import Monitor
+        from cook_tpu.state import DruMode, InstanceStatus
+        store = Store()
+        store.put_pool(Pool(name="gpupool", dru_mode=DruMode.GPU))
+        store.set_share("gpuhog", "gpupool",
+                        {"cpus": 1000.0, "mem": 100000.0, "gpus": 1.0})
+        [running] = store.create_jobs([Job(
+            uuid=new_uuid(), user="gpuhog", command="x", pool="gpupool",
+            resources=Resources(cpus=1.0, mem=10.0, gpus=4.0))])
+        store.launch_instance(running, "t-g", hostname="h1")
+        store.update_instance_status("t-g", InstanceStatus.RUNNING)
+        Monitor(store).sweep()
+        # cpus/mem would give ~0 (huge shares); gpus gives 4/1 = 4
+        assert store.audit.user_dru("gpupool", "gpuhog") == 4.0
+
+    def test_export_wire_newest_lanes_oldest_first_order(self):
+        """The checkpoint re-seed keeps the NEWEST lanes under the cap,
+        but ships them oldest-first so a replayed trail's eviction order
+        matches the original (newest jobs must not become the first
+        evicted after a restart)."""
+        t = AuditTrail()
+        for i in range(6):
+            t.record(f"job{i}", "submitted", {})
+        wire = t.export_wire(max_events=3)
+        assert [w["u"] for w in wire] == ["job3", "job4", "job5"], wire
+        t2 = AuditTrail(max_jobs=3)
+        t2.load(wire)
+        t2.record("fresh", "submitted", {})
+        # job3 (the oldest surviving lane) evicts first, not job5
+        assert t2.timeline("job5") and not t2.timeline("job3")
+
+
+# ---------------------------------------------------------------------------
+# Metric-cardinality guard
+# ---------------------------------------------------------------------------
+
+class TestCardinalityGuard:
+    def test_overflow_folds_to_other_and_counts(self):
+        reg = MetricsRegistry()
+        reg.set_label_cap("cook_user_thing", "user", 2)
+        for u in ("a", "b", "c", "d"):
+            reg.gauge_set("cook_user_thing", 1.0,
+                          {"pool": "p", "user": u})
+        gauges = reg.snapshot()["gauges"]
+        users = {k for k in gauges if k.startswith("cook_user_thing")}
+        assert len(users) == 3  # a, b, other
+        assert any('user="other"' in k for k in users)
+        counters = reg.snapshot()["counters"]
+        dropped = [v for k, v in counters.items()
+                   if k.startswith("cook_metrics_dropped_labels")]
+        assert dropped == [2.0]
+        # uncapped labels/metrics are untouched
+        reg.counter_inc("cook_other_metric", 1.0, {"user": "zzz"})
+        assert any('user="zzz"' in k
+                   for k in reg.snapshot()["counters"])
+
+    def test_window_reset_readmits(self):
+        reg = MetricsRegistry()
+        reg.set_label_cap("m", "user", 1)
+        reg.gauge_set("m", 1.0, {"user": "a"})
+        reg.gauge_set("m", 1.0, {"user": "b"})  # folds
+        reg.reset_label_window("m", "user")
+        reg.gauge_set("m", 2.0, {"user": "b"})  # readmitted
+        gauges = reg.snapshot()["gauges"]
+        assert gauges.get('m{user="b"}') == 2.0
+
+    def test_cap_window_is_per_pool(self):
+        """The admission window is scoped per pool (default scope):
+        one pool's user population must never fold a later pool's
+        legitimate top-K into 'other'."""
+        reg = MetricsRegistry()
+        reg.set_label_cap("m", "user", 2)
+        for u in ("a", "b"):
+            reg.gauge_set("m", 1.0, {"pool": "p1", "user": u})
+        # p1's window is full; p2 still admits its own two users
+        for u in ("c", "d"):
+            reg.gauge_set("m", 1.0, {"pool": "p2", "user": u})
+        gauges = reg.snapshot()["gauges"]
+        assert any('user="c"' in k for k in gauges), gauges
+        assert not any('user="other"' in k for k in gauges), gauges
+        # but p2's THIRD user folds
+        reg.gauge_set("m", 1.0, {"pool": "p2", "user": "e"})
+        gauges = reg.snapshot()["gauges"]
+        assert any('pool="p2"' in k and 'user="other"' in k
+                   for k in gauges), gauges
+
+    def test_cap_window_scopes_per_state(self):
+        """cook_user_resource-style multi-state publishing: each
+        (pool, state) combination gets its own window, so one state's
+        disjoint user population never folds another state's top-K
+        (the running/waiting sets can be fully disjoint)."""
+        reg = MetricsRegistry()
+        reg.set_label_cap("m", "user", 2, scope=("pool", "state"))
+        for u in ("a", "b"):
+            reg.gauge_set("m", 1.0, {"pool": "p", "state": "running",
+                                     "user": u})
+        for u in ("c", "d"):  # disjoint waiting set still admits
+            reg.gauge_set("m", 1.0, {"pool": "p", "state": "waiting",
+                                     "user": u})
+        gauges = reg.snapshot()["gauges"]
+        assert any('user="d"' in k for k in gauges), gauges
+        assert not any('user="other"' in k for k in gauges), gauges
+
+    def test_sweep_never_folds_its_own_series(self):
+        """A steady-state sweep with full-cap disjoint running/waiting
+        populations plus user churn must export every top-K series
+        unfolded (the review-repro scenario: shared windows overflowed
+        on the 2nd state and on departed-user zero-writes)."""
+        _reset()
+        from cook_tpu.config import Config as C
+        from cook_tpu.sched.monitor import Monitor
+        from cook_tpu.state import InstanceStatus
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        cfg = C()
+        cfg.slo.max_user_series = 5
+        mon = Monitor(store, config=cfg)
+        run_jobs = []
+        for i in range(5):
+            [u] = store.create_jobs([Job(
+                uuid=new_uuid(), user=f"run{i}", command="x",
+                resources=Resources(cpus=1, mem=1))])
+            store.launch_instance(u, f"t-{i}", hostname="h")
+            store.update_instance_status(f"t-{i}",
+                                         InstanceStatus.RUNNING)
+            run_jobs.append(u)
+        store.create_jobs([Job(uuid=new_uuid(), user=f"wait{i}",
+                               command="x",
+                               resources=Resources(cpus=1, mem=1))
+                           for i in range(5)])
+        mon.sweep()
+        mon.sweep()  # steady state: same populations + zero churn
+        gauges = global_registry.snapshot()["gauges"]
+        for user in [f"wait{i}" for i in range(5)]:
+            assert any(f'user="{user}"' in k and 'state="waiting"' in k
+                       for k in gauges), user
+        dropped = [k for k in global_registry.snapshot()["counters"]
+                   if "cook_metrics_dropped_labels" in k]
+        assert not dropped, dropped
+
+    def test_monitor_folds_user_tail(self):
+        _reset()
+        from cook_tpu.config import Config as C
+        from cook_tpu.sched.monitor import Monitor
+        store = Store()
+        store.put_pool(Pool(name="default"))
+        jobs = [Job(uuid=new_uuid(), user=f"u{i:03d}", command="x",
+                    resources=Resources(cpus=float(10 - i % 10), mem=10))
+                for i in range(30)]
+        store.create_jobs(jobs)
+        cfg = C()
+        cfg.slo.max_user_series = 5
+        Monitor(store, config=cfg).sweep()
+        gauges = global_registry.snapshot()["gauges"]
+        waiting_users = {k for k in gauges
+                        if k.startswith("cook_user_resource")
+                        and 'state="waiting"' in k
+                        and 'resource="jobs"' in k}
+        # 5 users + "all" + "other"
+        assert len(waiting_users) == 7, sorted(waiting_users)
+        other = [v for k, v in gauges.items()
+                 if k.startswith("cook_user_resource")
+                 and 'user="other"' in k and 'state="waiting"' in k
+                 and 'resource="jobs"' in k]
+        assert other == [25.0]
+
+
+# ---------------------------------------------------------------------------
+# REST / CLI surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def api_world():
+    from cook_tpu.rest import ApiServer, CookApi
+    _reset()
+    store = Store()
+    store.put_pool(Pool(name="default"))
+    uuid = new_uuid()
+    store.create_jobs([Job(uuid=uuid, user="alice", command="x",
+                           resources=Resources(cpus=1, mem=10))])
+    store.audit.ranked([uuid], [3], "default", users=["alice"])
+    store.audit.record(uuid, "skip", {"reason": "rate-limited"},
+                       durable=True)
+    api = CookApi(store)
+    server = ApiServer(api)
+    server.start()
+    yield store, server, uuid
+    server.stop()
+
+
+class TestSurfaces:
+    def test_timeline_endpoint(self, api_world):
+        from cook_tpu.client import JobClient
+        _store, server, uuid = api_world
+        doc = JobClient(server.url, user="alice").job_timeline(uuid)
+        assert doc["state"] == "waiting"
+        assert [e["kind"] for e in doc["timeline"]] \
+            == ["submitted", "ranked", "skip"]
+        assert "reasons" in doc  # still waiting -> live explainer too
+
+    def test_timeline_404(self, api_world):
+        from cook_tpu.client import JobClient, JobClientError
+        _store, server, _uuid = api_world
+        with pytest.raises(JobClientError) as e:
+            JobClient(server.url).job_timeline(new_uuid())
+        assert e.value.status == 404
+
+    def test_unscheduled_gains_history(self, api_world):
+        from cook_tpu.client import JobClient
+        _store, server, uuid = api_world
+        [doc] = JobClient(server.url,
+                          user="alice").unscheduled_jobs([uuid])
+        assert [e["kind"] for e in doc["history"]] \
+            == ["submitted", "ranked", "skip"]
+
+    def test_cs_why_renders_lifecycle(self, api_world, capsys):
+        from cook_tpu.cli.main import main as cli_main
+        _store, server, uuid = api_world
+        assert cli_main(["--url", server.url, "why", uuid]) == 0
+        out = capsys.readouterr().out
+        assert "submitted" in out and "ranked" in out
+        assert "skip:rate-limited" in out
+        assert "why waiting:" in out
+        # --json emits the raw document
+        assert cli_main(["--url", server.url, "why", "--json",
+                         uuid]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["uuid"] == uuid
+
+    def test_perfetto_job_track(self, api_world):
+        import urllib.request
+        from cook_tpu.utils import tracing
+        _store, server, uuid = api_world
+        with tracing.span("cycle", kind="fused") as sp:
+            trace_id = sp.trace_id
+        body = json.load(urllib.request.urlopen(
+            f"{server.url}/debug/trace?trace_id={trace_id}&job={uuid}"))
+        names = [e["name"] for e in body["traceEvents"]]
+        assert "cycle" in names
+        instants = [e for e in body["traceEvents"]
+                    if e.get("cat") == "cook.audit"]
+        assert {e["name"] for e in instants} \
+            == {"submitted", "ranked", "skip:rate-limited"}
+        assert all(e["ph"] == "i" for e in instants)
+        # the job track is named via thread_name metadata
+        assert any(e.get("ph") == "M"
+                   and e.get("args", {}).get("name", "").startswith("job ")
+                   for e in body["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_audit_config_validation(self):
+        assert AuditConfig.from_conf({"enabled": False,
+                                      "max_jobs": 10}).max_jobs == 10
+        with pytest.raises(ValueError):
+            AuditConfig.from_conf({"max_jobz": 1})
+        with pytest.raises(ValueError):
+            AuditConfig.from_conf({"enabled": "yes"})
+        with pytest.raises(ValueError):
+            AuditConfig.from_conf({"per_job_events": 0})
+
+    def test_scheduler_applies_audit_config(self):
+        store = Store()
+        cfg = Config()
+        cfg.audit.enabled = False
+        cfg.audit.max_jobs = 17
+        Scheduler(store, cfg, [], rank_backend="cpu")
+        assert store.audit.enabled is False
+        assert store.audit.max_jobs == 17

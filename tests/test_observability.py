@@ -428,3 +428,41 @@ def test_span_catalog_documented():
     undocumented = {n for n in names if f"`{n}`" not in doc}
     assert not undocumented, (
         f"spans missing from docs/OBSERVABILITY.md: {sorted(undocumented)}")
+
+
+def test_metric_catalog_documented():
+    """Every metric NAME emitted anywhere in cook_tpu/ must be registered
+    in docs/OBSERVABILITY.md — the check fails on unregistered names, not
+    just on missing known ones, so a new metric cannot ship
+    undocumented."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    pattern = re.compile(
+        r'(?:counter_inc|gauge_set|observe_many|observe|\.time)\('
+        r'\s*["\'](cook_[a-z0-9_]+)')
+    names = set()
+    for path in (REPO / "cook_tpu").rglob("*.py"):
+        for m in pattern.finditer(path.read_text()):
+            names.add(m.group(1))
+    assert len(names) > 20, f"metric scan looks broken: {sorted(names)}"
+    # counters are exposed with a _total suffix; either form may be the
+    # one the doc registers
+    undocumented = {n for n in names
+                    if f"`{n}`" not in doc and f"`{n}_total`" not in doc}
+    assert not undocumented, (
+        f"metrics missing from docs/OBSERVABILITY.md: "
+        f"{sorted(undocumented)}")
+
+
+def test_cycle_record_fields_documented():
+    """Every CycleRecord field (the /debug/cycles schema) must be
+    registered in docs/OBSERVABILITY.md."""
+    from cook_tpu.utils.flight import CycleRecord
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    fields = [f for f in CycleRecord.__slots__ if not f.startswith("_")]
+    # to_doc renames a few slots; check the exported names
+    exported = set(CycleRecord(1, "fused").to_doc())
+    assert len(fields) >= 15
+    undocumented = {f for f in exported if f"`{f}`" not in doc}
+    assert not undocumented, (
+        f"CycleRecord fields missing from docs/OBSERVABILITY.md: "
+        f"{sorted(undocumented)}")
