@@ -572,15 +572,32 @@ def cmd_why(args) -> int:
         for r in doc.get("reasons", []):
             print(f"  why waiting: {r['reason']}")
     if args.perfetto and shown:
-        # ONE export with every requested job as its own track (a
-        # per-uuid write would silently keep only the last job)
-        cycles = client.debug_cycles(limit=1).get("cycles", [])
-        if cycles and cycles[-1].get("trace_id"):
-            from ..utils.tracing import job_track_events
-            trace = client.debug_trace(cycles[-1]["trace_id"])
-            for i, (uuid, timeline) in enumerate(shown):
+        # Prefer the server's stitched per-job export for the FIRST job:
+        # the cycle that launched it, the submission request's span
+        # track (http.request -> journal -> replication ack wait), and
+        # its audit lane in one timeline (docs/OBSERVABILITY.md
+        # "tracing one request").  Remaining jobs ride along as extra
+        # tracks (ONE export — a per-uuid write would silently keep
+        # only the last job).
+        from ..utils.tracing import job_track_events
+        trace = None
+        extra = shown[1:]
+        try:
+            trace = client.debug_trace(job=shown[0][0])
+        except (JobClientError, OSError):
+            pass
+        if trace is None:
+            # no trace recorded for the job (old server / trace ring
+            # rolled over): fall back to the newest cycle's flamegraph
+            # with every job's audit track appended client-side
+            cycles = client.debug_cycles(limit=1).get("cycles", [])
+            if cycles and cycles[-1].get("trace_id"):
+                trace = client.debug_trace(cycles[-1]["trace_id"])
+                extra = shown
+        if trace is not None:
+            for i, (uuid, timeline) in enumerate(extra):
                 trace["traceEvents"].extend(
-                    job_track_events(uuid, timeline, tid=2 + i))
+                    job_track_events(uuid, timeline, tid=16 + i))
             with open(args.perfetto, "w") as f:
                 json.dump(trace, f)
             print(f"wrote perfetto trace with {len(shown)} job "
@@ -600,7 +617,12 @@ def cmd_debug(args) -> int:
     states, and open launch intents (docs/ROBUSTNESS.md); ``cs debug
     replication`` dumps the failover panel — per-follower offsets,
     min_acked, synced set, and the candidate positions published into
-    the election medium (docs/OBSERVABILITY.md)."""
+    the election medium; ``cs debug health`` is the one-shot roll-up
+    (SLO burn rates, breakers, replication lag, pipeline depth, repack
+    counters, audit queue depth) replacing five /debug/* fetches;
+    ``cs debug requests`` lists the serving plane's recent + slow
+    captured requests with per-phase breakdowns
+    (docs/OBSERVABILITY.md)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
@@ -610,6 +632,12 @@ def cmd_debug(args) -> int:
         return 0
     if args.debug_cmd == "replication":
         out(client.debug_replication())
+        return 0
+    if args.debug_cmd == "health":
+        out(client.debug_health())
+        return 0
+    if args.debug_cmd == "requests":
+        out(client.debug_requests(limit=args.limit))
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -943,7 +971,8 @@ def build_parser() -> argparse.ArgumentParser:
                                       "breaker states, replication/"
                                       "failover panel")
     sp.add_argument("debug_cmd",
-                    choices=["cycles", "trace", "faults", "replication"])
+                    choices=["cycles", "trace", "faults", "replication",
+                             "health", "requests"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

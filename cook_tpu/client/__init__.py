@@ -38,6 +38,13 @@ class JobClientError(Exception):
         retry: submission is idempotent on job uuid."""
         return bool(self.body.get("indeterminate"))
 
+    @property
+    def request_id(self) -> Optional[str]:
+        """The server-echoed X-Cook-Request-Id carried in the error body:
+        quote it in a report and an operator joins it to the server's
+        slow-request ring (GET /debug/requests) and the trace."""
+        return self.body.get("request_id")
+
 
 class JobClient:
     def __init__(self, url: str, user: str = "anonymous",
@@ -52,6 +59,13 @@ class JobClient:
         # (user, password) basic credentials for verified servers
         self.token = token
         self.basic_auth = basic_auth
+        # trace context of the most recent request (W3C traceparent is
+        # minted per request — or inherited from an active in-process
+        # span — and sent as a header; the server opens its http.request
+        # root under it, so this id keys GET /debug/trace server-side)
+        self.last_trace_id: Optional[str] = None
+        # the server-echoed X-Cook-Request-Id of the most recent response
+        self.last_request_id: Optional[str] = None
 
     # ------------------------------------------------------------- plumbing
     def _request(self, method: str, path: str,
@@ -68,8 +82,21 @@ class JobClient:
             query = "?" + urllib.parse.urlencode(pairs)
         data = json.dumps(body).encode() if body is not None else None
         url = self.url + path + query
+        # Dapper-style propagation: every request carries a W3C
+        # traceparent — an active in-process span's context when one
+        # exists (tests, embedded clients), a freshly minted trace
+        # otherwise — so the server's http.request span, store txn,
+        # journal fsync, and replication ack wait all stitch under ONE
+        # trace this client can name (docs/OBSERVABILITY.md)
+        from ..utils import tracing
+        cur = tracing.tracer.current()
+        traceparent = (tracing.make_traceparent(cur.trace_id, cur.span_id)
+                       if cur is not None else tracing.make_traceparent())
+        self.last_trace_id = \
+            tracing.parse_traceparent(traceparent)[0]
         headers = {"Content-Type": "application/json",
                    "X-Cook-User": self.user,
+                   "traceparent": traceparent,
                    **({"X-Cook-Impersonate": self.impersonate}
                       if self.impersonate else {})}
         if self.token:
@@ -96,6 +123,8 @@ class JobClient:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout_s) as resp:
                     raw = resp.read()
+                    self.last_request_id = resp.headers.get(
+                        "X-Cook-Request-Id")
                 break
             except urllib.error.HTTPError as e:
                 if e.code == 307 and e.headers.get("Location"):
@@ -339,16 +368,31 @@ class JobClient:
         return self._request("GET", "/debug/cycles",
                              params={"limit": str(limit)})
 
-    def debug_trace(self, trace_id: str,
+    def debug_trace(self, trace_id: Optional[str] = None,
                     job: Optional[str] = None) -> Dict:
-        """GET /debug/trace — one trace's spans as Chrome trace-event
-        JSON, loadable in chrome://tracing / ui.perfetto.dev.  With
-        ``job``, the job's audit timeline is stitched in as a per-job
-        instant-event track."""
-        params: Dict = {"trace_id": trace_id}
+        """GET /debug/trace — spans as Chrome trace-event JSON, loadable
+        in chrome://tracing / ui.perfetto.dev.  With ``job``, the job's
+        audit timeline is stitched in as a per-job instant-event track;
+        ``job`` ALONE returns the fully stitched per-job view (launching
+        cycle flamegraph + submission request track + audit lane)."""
+        params: Dict = {}
+        if trace_id:
+            params["trace_id"] = trace_id
         if job:
             params["job"] = job
         return self._request("GET", "/debug/trace", params=params)
+
+    def debug_requests(self, limit: int = 50) -> Dict:
+        """GET /debug/requests — the serving plane's recent + slow
+        request rings with per-phase breakdowns (redacted params)."""
+        return self._request("GET", "/debug/requests",
+                             params={"limit": str(limit)})
+
+    def debug_health(self) -> Dict:
+        """GET /debug/health — the one-shot roll-up behind ``cs debug
+        health``: SLO burn rates, breaker states, replication lag,
+        pipeline depth, repack counters, audit queue depth."""
+        return self._request("GET", "/debug/health")
 
     def job_timeline(self, uuid: str) -> Dict:
         """GET /debug/job/<uuid>/timeline — the job's full scheduling

@@ -158,6 +158,10 @@ class SloConfig:
     # registry at millions-of-users scale (utils/metrics.py label caps,
     # cook_metrics_dropped_labels_total)
     max_user_series: int = 1000
+    # a REST request slower than this breaches its endpoint-latency SLO
+    # (per-endpoint burn rates off the serving-plane RED metrics,
+    # rest/instrument.py; docs/OBSERVABILITY.md)
+    endpoint_latency_objective_s: float = 0.5
 
 
 @dataclass
@@ -338,6 +342,51 @@ class AuditConfig:
 
 
 @dataclass
+class HttpConfig:
+    """Serving-plane request observability knobs (rest/instrument.py;
+    the daemon's ``"http"`` conf section, boot-validated like
+    PipelineConfig so a typo'd knob fails the boot).
+    docs/OBSERVABILITY.md."""
+
+    #: request instrumentation master switch: ``http.request`` spans, the
+    #: per-endpoint RED metrics, and the /debug/requests capture rings.
+    #: Request ids (X-Cook-Request-Id) are always minted/echoed — they
+    #: are part of the error contract, not observability overhead.
+    observe: bool = True
+    #: recent-request ring size (every request, newest evicts oldest)
+    request_log: int = 256
+    #: a request at least this slow is captured in the slow ring with its
+    #: per-phase breakdown ("why was this POST slow")
+    slow_request_ms: float = 500.0
+    #: slow-ring size
+    slow_log: int = 64
+
+    def __post_init__(self):
+        for k in ("request_log", "slow_log"):
+            v = getattr(self, k)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"http {k} must be an int >= 1, "
+                                 f"got {v!r}")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "HttpConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown http key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"http key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -423,6 +472,9 @@ class Config:
     # per-job scheduling audit trail (utils/audit.py; the "why isn't my
     # job running" lane, docs/OBSERVABILITY.md)
     audit: AuditConfig = field(default_factory=AuditConfig)
+    # serving-plane request observability: http.request spans, RED
+    # metrics, /debug/requests capture rings (rest/instrument.py)
+    http: HttpConfig = field(default_factory=HttpConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

@@ -128,6 +128,11 @@ def build_scheduler_config(spec: Dict) -> Config:
         # typo'd knob fails the boot like the pipeline section
         from .config import AuditConfig
         cfg.audit = AuditConfig.from_conf(spec["audit"])
+    if "http" in spec:
+        # serving-plane request observability (docs/OBSERVABILITY.md);
+        # boot-validated like the pipeline/audit sections
+        from .config import HttpConfig
+        cfg.http = HttpConfig.from_conf(spec["http"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

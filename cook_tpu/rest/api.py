@@ -38,11 +38,15 @@ import hmac
 import json
 import re
 import threading
+import time
 import urllib.parse
+import uuid as uuidlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from ..config import Config
+from ..utils import tracing
+from . import instrument
 from ..policy import PluginRegistry, QueueLimits, RateLimits
 from ..sched.scheduler import Scheduler
 from ..sched.unscheduled import job_reasons
@@ -114,6 +118,12 @@ API_ROUTES = [
     ("GET", "/debug/job/{uuid}/timeline",
      "per-job scheduling audit timeline (why isn't my job running)",
      False),
+    ("GET", "/debug/requests",
+     "recent + slow REST requests with per-phase breakdown "
+     "(redacted params)", False),
+    ("GET", "/debug/health",
+     "one-shot health roll-up: SLO burn rates, breakers, replication "
+     "lag, pipeline depth, repack counters, audit queue depth", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -645,6 +655,10 @@ class CookApi:
         # CORS allowed-origin regexes (reference: cors.clj; same-origin
         # requests are always allowed, cross-origin must match a pattern)
         self.cors_origins = [re.compile(p) for p in (cors_origins or [])]
+        # serving-plane request observability (rest/instrument.py): the
+        # module singleton, sized/armed from the "http" config section
+        self.request_obs = instrument.request_log
+        self.request_obs.configure(self.config.http)
 
     def origin_allowed(self, origin: str) -> bool:
         return any(rx.fullmatch(origin) for rx in self.cors_origins)
@@ -697,8 +711,16 @@ class CookApi:
         if rl.enforce and rl.get_token_count(user) < len(specs):
             raise ApiError(429, "job submission rate limit exceeded")
         jobs = []
+        # request trace context (the http.request ingress span, itself
+        # parented under a client-sent traceparent): stamped on every job
+        # so the submission request stays joinable to the job's audit
+        # lifecycle and the launching cycle (docs/OBSERVABILITY.md)
+        _cur = tracing.tracer.current()
+        req_trace = _cur.trace_id if _cur is not None else None
         for spec in specs:
             job = parse_job_spec(spec, user, self.config.default_pool)
+            if req_trace:
+                job.trace_id = req_trace
             validate_task_constraints(job, self.config.task_constraints)
             for uri in job.uris:
                 if uri.get("executable") and uri.get("extract"):
@@ -1463,10 +1485,16 @@ class CookApi:
             ("GET", "/debug/cycles"): [
                 ("limit", False, "newest-last record count, default 50")],
             ("GET", "/debug/trace"): [
-                ("trace_id", True,
+                ("trace_id", False,
                  "trace_id of a span or CycleRecord; the response is "
                  "Chrome trace-event JSON (chrome://tracing, "
-                 "ui.perfetto.dev)")],
+                 "ui.perfetto.dev)"),
+                ("job", False,
+                 "job uuid: stitch the job's audit track in; alone "
+                 "(no trace_id) the export is the per-job stitched "
+                 "view — launching cycle + submission request track")],
+            ("GET", "/debug/requests"): [
+                ("limit", False, "records per ring, default 50")],
         }
         for method, path, summary, leader_only in API_ROUTES:
             entry = paths.setdefault(path, {})
@@ -1537,27 +1565,115 @@ class CookApi:
         return {"cycles": recorder.recent(limit=limit)}
 
     def debug_trace(self, params: Dict) -> Dict:
-        """GET /debug/trace?trace_id= — one trace's spans as Chrome
-        trace-event JSON (load in chrome://tracing / ui.perfetto.dev).
-        CycleRecords carry their trace_id, so
-        /debug/cycles -> /debug/trace is the slow-cycle drill-down."""
-        from ..utils.tracing import tracer
+        """GET /debug/trace?trace_id=&job= — spans as Chrome trace-event
+        JSON (load in chrome://tracing / ui.perfetto.dev).  CycleRecords
+        carry their trace_id, so /debug/cycles -> /debug/trace is the
+        slow-cycle drill-down.
+
+        With ``job`` alone (no trace_id), the export is the STITCHED
+        per-job view (docs/OBSERVABILITY.md "tracing one request"): the
+        cycle that launched the job (resolved from the ``launched``
+        audit event's recorded cycle trace) as the base flamegraph, the
+        submission request's span tree (http.request -> journal append
+        -> replication ack wait) as its own named track, and the job's
+        audit timeline as an instant-event lane — one Perfetto timeline
+        from client submit to launch RPC."""
+        from ..utils.tracing import job_track_events, tracer, track_meta
         trace_id = params.get("trace_id", [None])[0]
-        if not trace_id:
-            raise ApiError(400, "trace_id query parameter is required")
-        trace = tracer.export_chrome_trace(trace_id)
-        if not trace["traceEvents"]:
-            raise ApiError(404, f"no spans recorded for trace {trace_id}")
         job = params.get("job", [None])[0]
+        req_trace = cycle_trace = None
+        timeline: List[Dict[str, Any]] = []
         if job:
-            # stitch the job's audit events in as a per-job instant-event
-            # track (utils/audit.py; docs/OBSERVABILITY.md "debugging one
-            # job"): the cycle flamegraph and the job's decision history
-            # line up on one Perfetto timeline
-            from ..utils.tracing import job_track_events
-            trace["traceEvents"].extend(
-                job_track_events(job, self.store.audit.timeline(job)))
+            timeline = self.store.audit.timeline(job)
+            jb = self.store.job(job)
+            if jb is not None:
+                req_trace = jb.trace_id
+            for ev in timeline:
+                data = ev.get("data") or {}
+                if req_trace is None and ev["kind"] == "submitted":
+                    req_trace = data.get("trace")
+                if ev["kind"] == "launched" and data.get("cycle_trace"):
+                    cycle_trace = data["cycle_trace"]
+        if not trace_id:
+            # job-only form: base the export on the launching cycle when
+            # one is known, else on the request trace alone
+            trace_id = cycle_trace or req_trace
+            if not trace_id:
+                if job:
+                    raise ApiError(
+                        404, f"no trace recorded for job {job}")
+                raise ApiError(400, "trace_id or job query parameter "
+                                    "is required")
+        trace = tracer.export_chrome_trace(trace_id)
+        if not trace["traceEvents"] and not (job and timeline):
+            raise ApiError(404, f"no spans recorded for trace {trace_id}")
+        if job:
+            # stitch the submission request's span tree as a named track
+            # next to the cycle flamegraph (skipped when it IS the base)
+            if req_trace and req_trace != trace_id:
+                req_events = tracer.trace_events(req_trace, tid=3)
+                if req_events:
+                    trace["traceEvents"].append(
+                        track_meta(f"request {job[:13]}", 3))
+                    trace["traceEvents"].extend(req_events)
+            # the job's audit events as a per-job instant-event track
+            # (utils/audit.py; docs/OBSERVABILITY.md "debugging one
+            # job"): decision history and flamegraph on one timeline
+            trace["traceEvents"].extend(job_track_events(job, timeline))
         return trace
+
+    def debug_requests(self, params: Dict) -> Dict:
+        """GET /debug/requests?limit= — the serving plane's bounded
+        request-capture rings (rest/instrument.py): newest recent
+        requests, the slow ring with per-phase breakdowns, and rolling
+        phase-share totals.  Params are redacted; join records to traces
+        via ``trace_id`` and to user reports via ``request_id``."""
+        try:
+            limit = int(params.get("limit", ["50"])[0])
+        except ValueError:
+            raise ApiError(400, "limit must be an integer")
+        return self.request_obs.snapshot(limit=limit)
+
+    def debug_health(self) -> Dict:
+        """GET /debug/health — the one-shot operator roll-up `cs debug
+        health` renders: every "is this cell healthy" signal that
+        otherwise takes five /debug/* fetches (docs/OBSERVABILITY.md)."""
+        from ..utils.metrics import registry
+        from ..utils.retry import breakers
+
+        def series(name: str) -> List[Dict[str, Any]]:
+            return [{**labels, "value": value}
+                    for labels, value in registry.series(name)]
+
+        repl = self.debug_replication()
+        health: Dict[str, Any] = {
+            "healthy": True,
+            "leader": self.scheduler is not None,
+            "slo_burn_rates": series("cook_slo_burn_rate"),
+            "breakers": breakers.states(),
+            "replication": {
+                k: repl.get(k)
+                for k in ("role", "epoch", "fenced", "synced_followers",
+                          "follower_count", "min_acked", "journal_bytes",
+                          "mirror")
+                if repl.get(k) is not None},
+            "pipeline_depth": next(
+                (v for _lbl, v in registry.series("cook_pipeline_depth")),
+                None),
+            "resident_repacks": series("cook_resident_repack"),
+            "audit": {k: v for k, v in self.store.audit.stats().items()
+                      if k in ("jobs", "pending_durable")},
+            "http": self.request_obs.snapshot(limit=0)["totals"],
+        }
+        followers = repl.get("followers") or []
+        if followers:
+            health["replication"]["max_lag_bytes"] = max(
+                int(f.get("lag_bytes", 0)) for f in followers)
+        # burning past budget or a fenced store is not healthy
+        if any(s["value"] > 1.0 for s in health["slo_burn_rates"]) \
+                or repl.get("fenced"):
+            health["healthy"] = False
+        return health
 
     def debug_job_timeline(self, uuid: str) -> Dict:
         """GET /debug/job/<uuid>/timeline — the job's full decision
@@ -1956,15 +2072,43 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, payload,
                  extra_headers: Optional[Dict[str, str]] = None) -> None:
-        data = json.dumps(to_json(payload)).encode()
+        # {"_raw"}/{"_html"} payloads are plain-text surfaces (/metrics,
+        # /swagger-ui); everything else is the JSON plane
+        html = isinstance(payload, dict) and "_html" in payload
+        raw = isinstance(payload, dict) and "_raw" in payload
+        if raw or html:
+            data = payload.get("_raw", payload.get("_html")).encode()
+            ctype = "text/html" if html else "text/plain"
+        else:
+            data = json.dumps(to_json(payload)).encode()
+            ctype = "application/json"
+        self._status = status
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
+        # gzip the observability surfaces (Prometheus scrapes, Perfetto
+        # trace exports run to MBs) when the client opts in; tiny bodies
+        # skip the compressor (the header bytes would outweigh the win)
+        path = self.path.split("?", 1)[0]
+        if len(data) > 512 \
+                and (path == "/metrics" or path.startswith("/debug")) \
+                and instrument.wants_gzip(
+                    self.headers.get("Accept-Encoding")):
+            data = instrument.gzip_body(data)
+            self.send_header("Content-Encoding", "gzip")
+            self.send_header("Vary", "Accept-Encoding")
         self.send_header("Content-Length", str(len(data)))
+        # every response (success AND error) echoes the request id so a
+        # user report joins to the slow-request ring and the trace
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header("X-Cook-Request-Id", rid)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
-        self._cors_headers()
+        if not (raw or html):
+            self._cors_headers()
         self.end_headers()
         self.wfile.write(data)
+        self._bytes_out = len(data)
 
     def _check_ip_limit(self) -> bool:
         """Admit or 429 this request per the client-IP bucket (covers
@@ -1984,11 +2128,66 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     def _route(self, method: str) -> None:
+        """Instrumented ingress (docs/OBSERVABILITY.md serving plane):
+        every request gets an id (client's X-Cook-Request-Id or minted
+        here), and — unless the operator disabled the http observe knob —
+        an ``http.request`` root span under any client-sent traceparent,
+        RED metrics on the templated endpoint, and a capture-ring record
+        carrying the per-phase breakdown the span tree accumulated
+        (journal append, replication ack wait, ...)."""
+        parsed = urllib.parse.urlparse(self.path)
+        self._request_id = (self.headers.get("X-Cook-Request-Id")
+                            or uuidlib.uuid4().hex[:16])
+        self._status = 500
+        self._bytes_out = 0
+        # keep-alive connections reuse this handler instance: a stale
+        # identity from the previous request must not be attributed to
+        # one that fails authentication
+        self._auth_user = ""
+        obs = self.api.request_obs
+        if not (obs.enabled and tracing.tracer.enabled):
+            self._handle(method, parsed)
+            return
+        endpoint = instrument.endpoint_template(method, parsed.path)
+        remote = tracing.parse_traceparent(self.headers.get("traceparent"))
+        try:
+            bytes_in = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            # a garbage Content-Length must not kill the connection
+            # before _handle can answer it with a proper error
+            bytes_in = 0
+        obs.begin()
+        t0 = time.perf_counter()
+        trace_id = None
+        phases: Dict[str, float] = {}
+        try:
+            with tracing.collect_phases() as phases, \
+                    tracing.span("http.request", remote_parent=remote,
+                                 endpoint=endpoint, method=method,
+                                 request_id=self._request_id) as sp:
+                trace_id = getattr(sp, "trace_id", None)
+                self._handle(method, parsed)
+                sp.set_tag("status", self._status)
+                user = str(getattr(self, "_auth_user", "") or "")
+                if user:
+                    sp.set_tag("user", user)
+        finally:
+            obs.end(
+                method=method, endpoint=endpoint, status=self._status,
+                duration_s=time.perf_counter() - t0, phases=phases,
+                params=(urllib.parse.parse_qs(parsed.query)
+                        if parsed.query else {}),
+                request_id=self._request_id, trace_id=trace_id,
+                user=str(getattr(self, "_auth_user", "") or ""),
+                bytes_in=bytes_in, bytes_out=self._bytes_out,
+                objective_s=self.api.config.slo
+                .endpoint_latency_objective_s)
+
+    def _handle(self, method: str, parsed) -> None:
         try:
             if not self._check_ip_limit():
                 return
             self._auth_user = self._authenticate()
-            parsed = urllib.parse.urlparse(self.path)
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
             self._respond(200, payload,
@@ -2001,24 +2200,33 @@ class _Handler(BaseHTTPRequestHandler):
             leftover = int(self.headers.get("Content-Length", 0))
             if leftover:
                 self.rfile.read(leftover)
+            self._status = 307
             self.send_response(307)
             self.send_header("Location", r.location)
+            self.send_header("X-Cook-Request-Id", self._request_id)
             self.send_header("Content-Length", "0")
             self.end_headers()
         except ApiError as e:
-            self._respond(e.status, {"error": e.message, **e.extra},
+            # the request id rides the error BODY too: a pasted error
+            # report alone is joinable to /debug/requests and the trace
+            self._respond(e.status,
+                          {"error": e.message,
+                           "request_id": self._request_id, **e.extra},
                           extra_headers=e.headers)
         except ReplicationIndeterminate as e:
             # write paths that don't build their own ambiguous-outcome
             # body (kill/retry/status — all idempotent): the transaction
             # is applied locally but unconfirmed on the mirror
-            self._respond(504, {"error": str(e), "indeterminate": True})
+            self._respond(504, {"error": str(e), "indeterminate": True,
+                                "request_id": self._request_id})
         except Exception as e:  # pragma: no cover
-            self._respond(500, {"error": f"internal error: {e}"})
+            self._respond(500, {"error": f"internal error: {e}",
+                                "request_id": self._request_id})
 
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
-                    "/debug/faults", "/debug/replication", "/metrics",
+                    "/debug/faults", "/debug/replication",
+                    "/debug/requests", "/debug/health", "/metrics",
                     "/failure_reasons", "/settings", "/swagger-docs",
                     "/swagger-ui"}
 
@@ -2094,6 +2302,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_faults()
             if path == "/debug/replication":
                 return api.debug_replication()
+            if path == "/debug/requests":
+                return api.debug_requests(params)
+            if path == "/debug/health":
+                return api.debug_health()
             if len(parts) == 4 and parts[0] == "debug" \
                     and parts[1] == "job" and parts[3] == "timeline":
                 return api.debug_job_timeline(parts[2])
@@ -2182,26 +2394,9 @@ class ApiServer:
     """Threaded HTTP server wrapper."""
 
     def __init__(self, api: CookApi, host: str = "127.0.0.1", port: int = 0):
+        # _Handler._respond serves the {"_raw"}/{"_html"} text surfaces
+        # (/metrics, /swagger-ui) itself — no wrapper needed
         handler = type("BoundHandler", (_Handler,), {"api": api})
-        # /metrics returns text, special-case the wrapper
-        orig_respond = handler._respond
-
-        def respond(self_h, status, payload, extra_headers=None):
-            if isinstance(payload, dict) and ("_raw" in payload
-                                              or "_html" in payload):
-                html = "_html" in payload
-                data = payload.get("_raw", payload.get("_html")).encode()
-                self_h.send_response(status)
-                self_h.send_header("Content-Type",
-                                   "text/html" if html else "text/plain")
-                self_h.send_header("Content-Length", str(len(data)))
-                self_h.end_headers()
-                self_h.wfile.write(data)
-            else:
-                orig_respond(self_h, status, payload,
-                             extra_headers=extra_headers)
-
-        handler._respond = respond
         self.server = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.server.server_address
         self._thread: Optional[threading.Thread] = None

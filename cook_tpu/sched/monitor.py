@@ -131,6 +131,10 @@ class Monitor:
                                     scope=("pool", "state"))
         self.registry.set_label_cap("cook_user_dru", "user",
                                     cap * 2 + 16, scope=("pool",))
+        # endpoints that have ever carried traffic: quiet ones must be
+        # re-published at 0 each sweep, or one slow request's burn-rate
+        # gauge would stick at its breach value forever
+        self._http_endpoints: Set[str] = set()
 
     # ------------------------------------------------------------- one sweep
     def sweep(self) -> Dict[str, Dict[str, int]]:
@@ -150,6 +154,7 @@ class Monitor:
         for pool in self.store.pools():
             out[pool.name] = self._sweep_pool(pool)
         self._sweep_cycle_slo()
+        self._sweep_http_slo()
         return out
 
     def _sweep_pool(self, pool) -> Dict[str, int]:
@@ -341,10 +346,13 @@ class Monitor:
     # ------------------------------------------------------------------- SLO
     def _publish_slo(self, slo_name: str, objective_s: float,
                      breach_ratio: float,
-                     pool: Optional[str] = None) -> None:
+                     pool: Optional[str] = None,
+                     extra: Optional[Dict[str, str]] = None) -> None:
         labels = {"slo": slo_name}
         if pool is not None:
             labels["pool"] = pool
+        if extra:
+            labels.update(extra)
         self.registry.gauge_set("cook_slo_objective_seconds", objective_s,
                                 labels=labels)
         self.registry.gauge_set("cook_slo_breach_ratio", breach_ratio,
@@ -372,6 +380,26 @@ class Monitor:
         breach = sum(1 for a in ages if a > obj)
         ratio = breach / len(ages) if ages else 0.0
         self._publish_slo("queue-latency", obj, ratio, pool=pool_name)
+
+    def _sweep_http_slo(self) -> None:
+        """Per-endpoint request-latency burn rates off the serving
+        plane's RED window (rest/instrument.py): each sweep drains the
+        since-last-sweep per-endpoint (requests, over-objective) counts
+        and publishes an ``endpoint-latency`` SLO series per endpoint
+        template — the alerting surface ROADMAP item 1's admission
+        batching will be judged against.  Endpoint labels are templates
+        (bounded); quiet endpoints publish nothing this sweep."""
+        from ..rest.instrument import request_log
+        obj = self.slo.endpoint_latency_objective_s
+        window = request_log.drain_slo_window()
+        self._http_endpoints |= set(window)
+        for endpoint in self._http_endpoints:
+            count, breach = window.get(endpoint, (0, 0))
+            # endpoints quiet since the last sweep publish a clean 0 —
+            # same discipline as _sweep_queue_slo's every-pool publish
+            self._publish_slo("endpoint-latency", obj,
+                              breach / count if count else 0.0,
+                              extra={"endpoint": endpoint})
 
     def _sweep_cycle_slo(self) -> None:
         """Cycle-duration burn rate over the flight recorder's recent

@@ -261,6 +261,12 @@ class Job:
     # (reference: :job/last-fenzo-placement-failure)
     last_placement_failure: Optional[Dict[str, Any]] = None
     last_waiting_start_ms: int = 0
+    # request trace context stamped at submission (the client's W3C
+    # traceparent / the REST ingress `http.request` span): joins this
+    # job's audit lifecycle to the serving-plane trace so
+    # `GET /debug/trace?job=` can stitch the submission request next to
+    # the cycle that launched it (docs/OBSERVABILITY.md)
+    trace_id: Optional[str] = None
 
     def attempts_used(self, instances: Dict[str, "Instance"]) -> int:
         """Number of retries consumed: failed, non-mea-culpa instances

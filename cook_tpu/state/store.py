@@ -27,8 +27,10 @@ import json
 import os
 import threading
 import time
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..utils import tracing
 from . import machines
 from .schema import (
     Application,
@@ -236,7 +238,9 @@ class _Txn:
             job.committed = committed
             writes[key] = job
             events.append(TxEvent("job-created", uuid=u,
-                                  user=job.user, pool=job.pool))
+                                  user=job.user, pool=job.pool,
+                                  **({"trace": job.trace_id}
+                                     if job.trace_id else {})))
         return [j.uuid for j in jobs]
 
     # -- composite ops shared by several public store methods ---------------
@@ -446,16 +450,27 @@ class Store:
                     f"{self._repl_server.synced_follower_count} "
                     "synced follower(s) < required "
                     f"{self._repl_min_followers}")
+        # request-path I/O spans (docs/OBSERVABILITY.md serving plane):
+        # opened only under an ACTIVE trace — a REST write's http.request
+        # root or a scheduler cycle — so bare-store bulk loads and
+        # background status txns stay span-free.  tracer.io_spans is the
+        # rest_plane bench's A/B gate for exactly this instrumentation.
+        _io = tracing.tracer.io_spans and tracing.tracer.current() is not None
+        line = json.dumps(rec) + "\n"
         try:
-            _faults.fire("store.journal.append",
-                         lambda: OSError("injected journal write failure"))
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            if self._journal_fsync:
+            with (tracing.span("journal.append", bytes=len(line),
+                               fsync=self._journal_fsync or None)
+                  if _io else nullcontext()):
                 _faults.fire(
-                    "store.journal.fsync",
-                    lambda: OSError("injected journal fsync failure"))
-                os.fsync(f.fileno())
+                    "store.journal.append",
+                    lambda: OSError("injected journal write failure"))
+                f.write(line)
+                f.flush()
+                if self._journal_fsync:
+                    _faults.fire(
+                        "store.journal.fsync",
+                        lambda: OSError("injected journal fsync failure"))
+                    os.fsync(f.fileno())
             if self._repl_server is not None:
                 # From here on the record is durable locally and visible
                 # to followers: an unconfirmed ack is a first-class
@@ -465,12 +480,17 @@ class Store:
                 # failover (ADVICE r5) — "aborted" must imply "nowhere".
                 self._repl_server.poke()
                 if self._repl_sync:
-                    _faults.fire(
-                        "repl.ack",
-                        lambda: ReplicationIndeterminate(
-                            "injected replication ack loss"))
-                    if not self._repl_server.wait_acked(
-                            f.tell(), self._repl_timeout_s):
+                    with (tracing.span(
+                            "repl.ack_wait", offset=f.tell(),
+                            timeout_s=self._repl_timeout_s)
+                          if _io else nullcontext()):
+                        _faults.fire(
+                            "repl.ack",
+                            lambda: ReplicationIndeterminate(
+                                "injected replication ack loss"))
+                        acked = self._repl_server.wait_acked(
+                            f.tell(), self._repl_timeout_s)
+                    if not acked:
                         raise ReplicationIndeterminate(
                             "followers did not ack within "
                             f"{self._repl_timeout_s}s; the record is in "
@@ -760,6 +780,12 @@ class Store:
             out: List[Instance] = []
             failures: List[Tuple[str, str]] = []
             t = self.clock()  # one clock read per batch (as create_jobs)
+            # the enclosing scheduler cycle's trace: recorded on every
+            # launched audit event so /debug/trace?job= can pull the
+            # cycle flamegraph that placed the job next to its
+            # submission request track (docs/OBSERVABILITY.md)
+            _cur = tracing.tracer.current()
+            cycle_trace = _cur.trace_id if _cur is not None else None
             # pass 1 — guards only (peek, no writes): gang atomicity needs
             # every member's verdict BEFORE any member's instance is put
             denied: Dict[int, str] = {}
@@ -836,7 +862,11 @@ class Store:
                 txn.event("instance-created", task_id=e["task_id"],
                           job=e["job_uuid"], hostname=hostname,
                           **({"gang": e["gang"]} if e.get("gang")
-                             else {}))
+                             else {}),
+                          **({"trace": job.trace_id}
+                             if job.trace_id else {}),
+                          **({"cycle_trace": cycle_trace}
+                             if cycle_trace else {}))
                 txn.event("job-state", uuid=e["job_uuid"], old="waiting",
                           new="running", reason=None)
                 out.append(inst)

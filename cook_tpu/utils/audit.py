@@ -490,12 +490,21 @@ def tx_event_to_audit(e) -> Optional[Tuple[str, str, Dict[str, Any]]]:
         gang = data.get("gang")
         if gang:
             d["gang"] = gang
+        # serving-plane stitch points (docs/OBSERVABILITY.md): the
+        # submission request's trace id and the trace of the cycle that
+        # placed the job — /debug/trace?job= resolves both from here
+        if data.get("trace"):
+            d["trace"] = data["trace"]
+        if data.get("cycle_trace"):
+            d["cycle_trace"] = data["cycle_trace"]
         return data["job"], "launched", d
     if kind == "launch-ack":
         return data["job"], "launch-ack", {"task": data.get("task_id")}
     if kind == "job-created":
-        return data["uuid"], "submitted", {
-            "user": data.get("user"), "pool": data.get("pool")}
+        d = {"user": data.get("user"), "pool": data.get("pool")}
+        if data.get("trace"):
+            d["trace"] = data["trace"]
+        return data["uuid"], "submitted", d
     return None
 
 

@@ -199,6 +199,18 @@ class MetricsRegistry:
         finally:
             self.observe(name, time.perf_counter() - t0, labels)
 
+    def series(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        """Every current series of gauge/counter ``name`` as
+        (labels dict, value) pairs — the structured accessor the
+        /debug/health roll-up reads (snapshot() flattens labels into
+        strings, which a consumer would have to re-parse)."""
+        with self._lock:
+            out = [(dict(k), v) for (n, k), v in self._gauges.items()
+                   if n == name]
+            out += [(dict(k), v) for (n, k), v in self._counters.items()
+                    if n == name]
+        return out
+
     def snapshot(self) -> Dict:
         with self._lock:
             return {

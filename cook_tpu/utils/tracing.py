@@ -50,6 +50,70 @@ _MAX_FINISHED = 4096
 _stack_var: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
     "cook_span_stack", default=())
 
+# Per-request phase accumulator (rest/instrument.py): while a collector
+# dict is installed, every finished span adds its duration under its
+# name — the request handler reads back a {span-name: seconds} breakdown
+# ("how much of this POST was replication ack wait") without walking the
+# span ring.  None (the default) costs one contextvar read per span.
+_phases_var: "contextvars.ContextVar[Optional[dict]]" = \
+    contextvars.ContextVar("cook_req_phases", default=None)
+
+
+@contextmanager
+def collect_phases():
+    """Install a fresh per-request phase dict; yields it.  Nested
+    collectors shadow (each request owns exactly its own spans)."""
+    phases: Dict[str, float] = {}
+    token = _phases_var.set(phases)
+    try:
+        yield phases
+    finally:
+        _phases_var.reset(token)
+
+
+# ------------------------------------------------------ W3C trace context
+# Propagated over the `traceparent` HTTP header (W3C Trace Context:
+# 00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>).  Internal span
+# ids are 16-hex; they are zero-padded on the wire and the pad is
+# stripped on parse, so an in-process client span and the server's
+# http.request root share ONE trace id.
+_PAD = "0" * 16
+
+
+def make_traceparent(trace_id: Optional[str] = None,
+                     span_id: Optional[str] = None) -> str:
+    """A traceparent header value; mints a fresh trace when no ids are
+    given (the client-side entry point)."""
+    tid = (trace_id or uuid.uuid4().hex).lower()
+    if len(tid) < 32:
+        tid = tid.rjust(32, "0")
+    sid = (span_id or uuid.uuid4().hex[:16]).lower()
+    if len(sid) < 16:
+        sid = sid.rjust(16, "0")
+    return f"00-{tid[:32]}-{sid[:16]}-01"
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[tuple]:
+    """(trace_id, parent_span_id) from a traceparent header, or None when
+    absent/malformed (a garbage header must never 500 a request)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _ver, tid, sid = parts[0], parts[1].lower(), parts[2].lower()
+    try:
+        int(tid, 16)
+        int(sid, 16)
+    except ValueError:
+        return None
+    if len(tid) != 32 or len(sid) != 16 or tid == "0" * 32:
+        return None
+    if tid.startswith(_PAD):
+        tid = tid[16:]  # our own padded 16-hex form round-trips
+    return tid, sid
+
 
 class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "tags",
@@ -82,22 +146,35 @@ class Tracer:
         self._lock = threading.Lock()
         self.finished: List[Dict[str, Any]] = []
         self.enabled = True
+        # hot-path I/O spans (journal append / replication ack wait,
+        # state/store.py): gated separately so the rest_plane bench can
+        # A/B exactly the serving-plane instrumentation without touching
+        # the cycle spans
+        self.io_spans = True
 
     def current(self) -> Optional[Span]:
         st = _stack_var.get()
         return st[-1] if st else None
 
     @contextmanager
-    def span(self, name: str, **tags: Any):
+    def span(self, name: str, remote_parent: Optional[tuple] = None,
+             **tags: Any):
         """Open a span; tags with None values are dropped (matches the
-        reference's optional pool/cluster tags)."""
+        reference's optional pool/cluster tags).  ``remote_parent`` is a
+        propagated (trace_id, span_id) — e.g. a parsed ``traceparent``
+        header — adopted only when no LOCAL parent is active (the
+        in-process stack always wins)."""
         if not self.enabled:
             yield _NOOP_SPAN
             return
         tags = {k: v for k, v in tags.items() if v is not None}
         parent = self.current()
-        trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
-        parent_id = parent.span_id if parent else None
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif remote_parent is not None:
+            trace_id, parent_id = remote_parent
+        else:
+            trace_id, parent_id = uuid.uuid4().hex[:16], None
         sp = Span(name, trace_id, parent_id, tags)
         token = _stack_var.set(_stack_var.get() + (sp,))
         t0 = time.perf_counter()
@@ -112,6 +189,10 @@ class Tracer:
             self._record(sp)
 
     def _record(self, sp: Span) -> None:
+        phases = _phases_var.get()
+        if phases is not None:
+            phases[sp.name] = phases.get(sp.name, 0.0) \
+                + (sp.duration_s or 0.0)
         metric_labels = {"span": sp.name}
         for key in ("pool", "cluster"):
             if key in sp.tags:
@@ -119,7 +200,8 @@ class Tracer:
         registry.observe("cook_span_duration_seconds", sp.duration_s or 0.0,
                          metric_labels)
         doc = sp.to_doc()
-        _log.debug(sp.name, extra={"doc": doc})
+        if _log.isEnabledFor(logging.DEBUG):
+            _log.debug(sp.name, extra={"doc": doc})
         with self._lock:
             self.finished.append(doc)
             if len(self.finished) > _MAX_FINISHED:
@@ -151,15 +233,11 @@ class Tracer:
             docs = list(self.finished)
         return [d for d in docs if d["trace_id"] == trace_id]
 
-    def export_chrome_trace(self, trace_id: str) -> Dict[str, Any]:
-        """Export one trace's spans as Chrome trace-event JSON (the
-        "JSON Array Format" with complete 'X' events), loadable in
-        chrome://tracing and https://ui.perfetto.dev.
-
-        ``ts``/``dur`` are microseconds; ``ts`` comes from the span's
-        wall-clock start so events across processes line up.  Durations
-        are clamped to >= 1 us: a zero-width event is dropped by some
-        viewers, and every real span costs more than that anyway."""
+    def trace_events(self, trace_id: str, tid: int = 1
+                     ) -> List[Dict[str, Any]]:
+        """One trace's spans as Chrome trace-event 'X' events on thread
+        ``tid`` — the building block :meth:`export_chrome_trace` and the
+        multi-track stitched export (``/debug/trace?job=``) share."""
         events: List[Dict[str, Any]] = []
         for d in self.traces(trace_id):
             args = {k: v for k, v in d.items()
@@ -173,16 +251,35 @@ class Tracer:
                 "dur": max(round((d.get("duration_ms") or 0.0) * 1000.0, 3),
                            1.0),
                 "pid": 1,
-                "tid": 1,
+                "tid": tid,
                 "args": args,
             })
         events.sort(key=lambda e: e["ts"])
-        return {"traceEvents": events, "displayTimeUnit": "ms",
+        return events
+
+    def export_chrome_trace(self, trace_id: str) -> Dict[str, Any]:
+        """Export one trace's spans as Chrome trace-event JSON (the
+        "JSON Array Format" with complete 'X' events), loadable in
+        chrome://tracing and https://ui.perfetto.dev.
+
+        ``ts``/``dur`` are microseconds; ``ts`` comes from the span's
+        wall-clock start so events across processes line up.  Durations
+        are clamped to >= 1 us: a zero-width event is dropped by some
+        viewers, and every real span costs more than that anyway."""
+        return {"traceEvents": self.trace_events(trace_id),
+                "displayTimeUnit": "ms",
                 "otherData": {"trace_id": trace_id}}
 
     def reset(self) -> None:
         with self._lock:
             self.finished.clear()
+
+
+def track_meta(name: str, tid: int) -> Dict[str, Any]:
+    """A Chrome-trace thread_name metadata event: names one stitched
+    track (job lanes, the request track) in the Perfetto timeline."""
+    return {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": name}}
 
 
 def job_track_events(uuid: str, timeline: List[Dict[str, Any]],
@@ -201,9 +298,7 @@ def job_track_events(uuid: str, timeline: List[Dict[str, Any]],
         return []
     # spans live on tid 1; each job track is its own lane (callers
     # stitching several jobs pass distinct tids)
-    events: List[Dict[str, Any]] = [{
-        "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-        "args": {"name": f"job {uuid}"}}]
+    events: List[Dict[str, Any]] = [track_meta(f"job {uuid}", tid)]
     for ev in timeline:
         args = dict(ev.get("data") or {})
         if ev.get("count", 1) > 1:

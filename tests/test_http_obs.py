@@ -1,0 +1,577 @@
+"""Serving-plane request observability (ISSUE 9, docs/OBSERVABILITY.md
+"tracing one request"): end-to-end trace propagation from client to
+launch, RED metrics on every endpoint (templated labels), the request-id
+error contract, the capture rings, gzip on the observability surfaces,
+and the /debug/health roll-up.
+"""
+
+import gzip
+import json
+import urllib.request
+import uuid as uuidlib
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.config import Config, HttpConfig
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.rest import instrument
+from cook_tpu.rest.api import API_ROUTES
+from cook_tpu.state import Resources, Store
+from cook_tpu.utils.metrics import registry
+from cook_tpu.utils.tracing import (make_traceparent, parse_traceparent,
+                                    tracer)
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    registry.reset()
+    tracer.reset()
+    tracer.enabled = True
+    tracer.io_spans = True
+    instrument.request_log.reset()
+    instrument.request_log.enabled = True
+    yield
+    registry.reset()
+    tracer.reset()
+    instrument.request_log.reset()
+    instrument.request_log.enabled = True
+
+
+@pytest.fixture()
+def server():
+    store = Store()
+    api = CookApi(store, admins=["admin"])
+    srv = ApiServer(api)
+    srv.start()
+    yield srv, store, api
+    srv.stop()
+
+
+def wait_until(cond, timeout=3.0):
+    """The http.request span closes AFTER the response bytes hit the
+    socket (the write is part of the measured request), so span/metric
+    asserts made immediately after a client call can beat the server
+    thread by microseconds — poll briefly instead of racing it."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(0.005)
+    return cond()
+
+
+def _http(url, method="GET", body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+# ---------------------------------------------------------------------------
+# W3C trace-context helpers
+# ---------------------------------------------------------------------------
+
+class TestTraceparent:
+    def test_roundtrip_internal_ids(self):
+        tp = make_traceparent("a" * 16, "b" * 16)
+        assert tp == f"00-{'0' * 16}{'a' * 16}-{'b' * 16}-01"
+        assert parse_traceparent(tp) == ("a" * 16, "b" * 16)
+
+    def test_full_width_trace_id_kept(self):
+        tid = uuidlib.uuid4().hex
+        assert parse_traceparent(f"00-{tid}-{'c' * 16}-01") == \
+            (tid, "c" * 16)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-zz-cc-01",
+        "00-" + "0" * 32 + "-" + "c" * 16 + "-01",   # all-zero trace
+        "00-" + "a" * 30 + "-" + "c" * 16 + "-01",   # short trace
+    ])
+    def test_malformed_headers_ignored(self, bad):
+        assert parse_traceparent(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# RED metrics: the golden endpoint-table walk
+# ---------------------------------------------------------------------------
+
+class TestRedMetrics:
+    def test_every_registered_endpoint_emits_red_metrics(self, server):
+        """Walk the WHOLE route table: every endpoint — success or error
+        — must emit cook_http_requests with the TEMPLATED endpoint label
+        (never the raw uuid) and a duration histogram observation."""
+        srv, _store, _api = server
+        raw_uuid = str(uuidlib.uuid4())
+        for method, path, _summary, _leader in API_ROUTES:
+            concrete = path.replace("{uuid}", raw_uuid) \
+                           .replace("{task_id}", raw_uuid) \
+                           .replace("{name}", "c1")
+            body = {} if method in ("POST", "PUT") else None
+            _http(srv.url + concrete, method=method, body=body,
+                  headers={"X-Cook-User": "nobody"})
+        def _counts():
+            c = {}
+            for labels, _v in registry.series("cook_http_requests"):
+                c.setdefault((labels["method"], labels["endpoint"]), 0)
+                c[(labels["method"], labels["endpoint"])] += _v
+            return c if len(c) >= len({(m, pth) for m, pth, _s, _l
+                                       in API_ROUTES}) else None
+        wait_until(lambda: _counts() is not None)
+        counted = {}
+        for labels, value in registry.series("cook_http_requests"):
+            counted.setdefault((labels["method"], labels["endpoint"]),
+                               0)
+            counted[(labels["method"], labels["endpoint"])] += value
+            assert raw_uuid not in labels["endpoint"]
+        for method, path, _summary, _leader in API_ROUTES:
+            assert counted.get((method, path), 0) >= 1, \
+                f"no RED metric for {method} {path}"
+        # duration histograms exist per endpoint template too
+        text = registry.expose()
+        assert 'cook_http_request_duration_seconds_count' in text
+        assert 'endpoint="/jobs/{uuid}"' in text
+
+    def test_unknown_paths_fold_to_unmatched(self, server):
+        srv, _store, _api = server
+        for i in range(3):
+            _http(srv.url + f"/no/such/endpoint-{i}")
+        # a wrong-METHOD probe against a known path must not skew that
+        # endpoint's series either
+        _http(srv.url + "/metrics", method="DELETE")
+        wait_until(lambda: registry.series("cook_http_requests"))
+        endpoints = {(lbl["method"], lbl["endpoint"]) for lbl, _v in
+                     registry.series("cook_http_requests")}
+        assert any(e == instrument.UNMATCHED for _m, e in endpoints)
+        assert not any("no/such" in e for _m, e in endpoints)
+        assert ("DELETE", "/metrics") not in endpoints
+        assert ("DELETE", instrument.UNMATCHED) in endpoints
+
+    def test_malformed_content_length_still_answered(self, server):
+        """A garbage Content-Length must get an HTTP error response, not
+        a dropped connection (the instrumented prologue parses it)."""
+        import socket
+        srv, _store, _api = server
+        with socket.create_connection((srv.host, srv.port),
+                                      timeout=5) as s:
+            s.sendall(b"POST /jobs HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: abc\r\n\r\n")
+            head = s.recv(4096).decode(errors="replace")
+        assert head.startswith("HTTP/1.1 "), head
+        status = int(head.split()[1])
+        assert 400 <= status < 600
+
+    def test_inflight_gauge_and_request_bytes(self, server):
+        srv, _store, _api = server
+        client = JobClient(srv.url, user="alice")
+        client.submit([{"command": "true"}])
+        # begin() publishes 1, end() publishes 0 after the response hit
+        # the socket — wait for the settle
+        wait_until(lambda: registry.series("cook_http_inflight")
+                   == [({}, 0.0)])
+        assert registry.series("cook_http_inflight") == [({}, 0.0)]
+        text = registry.expose()
+        assert "cook_http_request_bytes_bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# Propagation: client traceparent -> server root span -> I/O children
+# ---------------------------------------------------------------------------
+
+class TestPropagation:
+    def test_client_traceparent_becomes_server_root_span(self, server):
+        srv, store, _api = server
+        client = JobClient(srv.url, user="alice")
+        [uuid] = client.submit([{"command": "true"}])
+        assert client.last_trace_id
+        spans = wait_until(lambda: [
+            d for d in tracer.finished
+            if d["span"] == "http.request"
+            and d.get("endpoint") == "/jobs"])
+        assert spans, "no http.request span recorded"
+        root = spans[-1]
+        assert root["trace_id"] == client.last_trace_id
+        assert root["method"] == "POST"
+        assert root["status"] == 200
+        assert root["user"] == "alice"
+        # the job is stamped with the request trace
+        assert store.job(uuid).trace_id == client.last_trace_id
+        # ... and the submitted audit event records it
+        [sub] = [e for e in store.audit.timeline(uuid)
+                 if e["kind"] == "submitted"]
+        assert sub["data"]["trace"] == client.last_trace_id
+
+    def test_explicit_traceparent_header(self, server):
+        srv, _store, _api = server
+        tid = uuidlib.uuid4().hex
+        _http(srv.url + "/pools",
+              headers={"traceparent": f"00-{tid}-{'d' * 16}-01"})
+        [sp] = wait_until(lambda: [
+            d for d in tracer.finished
+            if d["span"] == "http.request" and d["trace_id"] == tid])
+        assert sp["parent_id"] == "d" * 16
+
+    def test_journal_and_ack_wait_spans_nest_under_request(
+            self, tmp_path):
+        """A sync-replicated write's journal append and replication
+        ack wait are children of the http.request root — the per-phase
+        decomposition the slow-request ring serves."""
+
+        class _StubRepl:
+            fenced = False
+            synced_follower_count = 1
+
+            def poke(self):
+                pass
+
+            def wait_acked(self, offset, timeout_s):
+                return True
+
+        store = Store.open(str(tmp_path))
+        store.attach_replication(_StubRepl(), sync=True)
+        api = CookApi(store)
+        srv = ApiServer(api)
+        srv.start()
+        try:
+            client = JobClient(srv.url, user="alice")
+            client.submit([{"command": "true"}])
+            root = wait_until(lambda: [
+                d for d in tracer.finished
+                if d["span"] == "http.request"])[-1]
+            by_name = {d["span"]: d for d in tracer.finished}
+            for name in ("journal.append", "repl.ack_wait"):
+                sp = by_name[name]
+                assert sp["trace_id"] == client.last_trace_id
+                assert sp["parent_id"] == root["span_id"], name
+            # the capture ring recorded the ack-wait phase share
+            snap = instrument.request_log.snapshot()
+            rec = [r for r in snap["recent"]
+                   if r["method"] == "POST"][-1]
+            assert "repl.ack_wait" in rec["phases_ms"]
+            assert "journal.append" in rec["phases_ms"]
+        finally:
+            srv.stop()
+
+    def test_no_io_spans_without_active_trace(self, tmp_path):
+        """A bare-store bulk write (no request, no cycle) opens no
+        journal spans — the bulk-load path stays span-free."""
+        from cook_tpu.state import Job, new_uuid
+        store = Store.open(str(tmp_path))
+        store.create_jobs([Job(uuid=new_uuid(), user="u",
+                               command="x")])
+        assert not any(d["span"] == "journal.append"
+                       for d in tracer.finished)
+
+
+# ---------------------------------------------------------------------------
+# Request-id contract
+# ---------------------------------------------------------------------------
+
+class TestRequestId:
+    def test_minted_and_echoed_on_success(self, server):
+        srv, _store, _api = server
+        status, headers, _body = _http(srv.url + "/pools")
+        assert status == 200
+        assert headers.get("X-Cook-Request-Id")
+
+    def test_client_sent_id_echoed_verbatim(self, server):
+        srv, _store, _api = server
+        _status, headers, _body = _http(
+            srv.url + "/pools",
+            headers={"X-Cook-Request-Id": "my-req-42"})
+        assert headers.get("X-Cook-Request-Id") == "my-req-42"
+
+    def test_error_body_carries_request_id(self, server):
+        srv, _store, _api = server
+        client = JobClient(srv.url, user="alice")
+        with pytest.raises(JobClientError) as err:
+            client.job(str(uuidlib.uuid4()))
+        assert err.value.status == 404
+        assert err.value.request_id
+        # the ring's record carries the same id — a pasted error report
+        # joins to the capture ring
+        ids = {r["request_id"] for r
+               in instrument.request_log.snapshot()["recent"]}
+        assert err.value.request_id in ids
+
+
+# ---------------------------------------------------------------------------
+# Capture rings (/debug/requests)
+# ---------------------------------------------------------------------------
+
+class TestDebugRequests:
+    def test_slow_ring_and_redaction(self, server):
+        srv, _store, api = server
+        api.request_obs.slow_ms = 0.0  # everything is "slow"
+        client = JobClient(srv.url, user="alice")
+        _http(srv.url + "/share?user=alice&token=hunter2",
+              headers={"X-Cook-User": "alice"})
+        doc = client.debug_requests(limit=10)
+        assert doc["slow"], "slow ring empty with threshold 0"
+        rec = [r for r in doc["slow"]
+               if r["endpoint"] == "/share"][-1]
+        assert rec["params"]["token"] == ["[redacted]"]
+        assert rec["params"]["user"] == ["alice"]
+        assert rec["duration_ms"] >= 0
+        assert rec["request_id"]
+
+    def test_snapshot_limit_zero_is_totals_only(self, server):
+        srv, _store, _api = server
+        _http(srv.url + "/pools")
+        wait_until(
+            lambda: instrument.request_log.snapshot(limit=5)["recent"])
+        snap = instrument.request_log.snapshot(limit=0)
+        assert snap["recent"] == [] and snap["slow"] == []
+        assert snap["totals"]["requests_s"] > 0
+
+    def test_ring_is_bounded(self, server):
+        srv, _store, api = server
+        api.request_obs.configure(HttpConfig(request_log=8, slow_log=4))
+        for _ in range(20):
+            _http(srv.url + "/pools")
+        snap = instrument.request_log.snapshot(limit=100)
+        assert len(snap["recent"]) <= 8
+        api.request_obs.configure(HttpConfig())
+
+    def test_observe_off_still_echoes_request_ids(self, server):
+        srv, _store, api = server
+        api.request_obs.enabled = False
+        status, headers, _ = _http(srv.url + "/pools")
+        assert status == 200
+        assert headers.get("X-Cook-Request-Id")
+        assert not instrument.request_log.snapshot()["recent"]
+        assert not any(d["span"] == "http.request"
+                       for d in tracer.finished)
+
+
+# ---------------------------------------------------------------------------
+# gzip on the observability surfaces
+# ---------------------------------------------------------------------------
+
+class TestGzip:
+    def test_metrics_gzipped_when_accepted(self, server):
+        srv, _store, _api = server
+        for _ in range(30):   # fatten the exposition past the threshold
+            _http(srv.url + "/pools")
+        status, headers, body = _http(
+            srv.url + "/metrics", headers={"Accept-Encoding": "gzip"})
+        assert status == 200
+        assert headers.get("Content-Encoding") == "gzip"
+        assert headers.get("Content-Type") == "text/plain"
+        text = gzip.decompress(body).decode()
+        assert "cook_http_requests_total" in text
+        assert int(headers["Content-Length"]) == len(body)
+
+    def test_debug_gzipped_and_parseable(self, server):
+        srv, _store, _api = server
+        for _ in range(30):
+            _http(srv.url + "/pools")
+        _status, headers, body = _http(
+            srv.url + "/debug/requests?limit=50",
+            headers={"Accept-Encoding": "gzip"})
+        assert headers.get("Content-Encoding") == "gzip"
+        doc = json.loads(gzip.decompress(body))
+        assert "recent" in doc
+
+    def test_no_gzip_without_accept_or_off_surface(self, server):
+        srv, _store, _api = server
+        for _ in range(30):
+            _http(srv.url + "/pools")
+        _s, headers, body = _http(srv.url + "/metrics")
+        assert headers.get("Content-Encoding") is None
+        assert b"cook_http" in body
+        # non-observability JSON surfaces stay uncompressed even with
+        # Accept-Encoding (only /metrics and /debug/* opt in)
+        _s, headers, _b = _http(srv.url + "/pools",
+                                headers={"Accept-Encoding": "gzip"})
+        assert headers.get("Content-Encoding") is None
+
+    def test_q_zero_optout(self):
+        assert not instrument.wants_gzip("gzip;q=0")
+        assert instrument.wants_gzip("gzip;q=0.5")
+        assert instrument.wants_gzip("deflate, gzip")
+        assert not instrument.wants_gzip("identity")
+
+
+# ---------------------------------------------------------------------------
+# /debug/health roll-up + cs debug health
+# ---------------------------------------------------------------------------
+
+class TestDebugHealth:
+    def test_rollup_shape(self, server):
+        srv, _store, _api = server
+        client = JobClient(srv.url, user="alice")
+        doc = client.debug_health()
+        for key in ("healthy", "slo_burn_rates", "breakers",
+                    "replication", "resident_repacks", "audit", "http"):
+            assert key in doc, key
+        assert doc["healthy"] is True
+        assert "inflight" in doc["http"]
+
+    def test_cli_debug_health(self, server, capsys):
+        from cook_tpu.cli.main import main as cli_main
+        srv, _store, _api = server
+        rc = cli_main(["--url", srv.url, "--user", "alice",
+                       "debug", "health"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "slo_burn_rates" in doc
+
+    def test_cli_debug_requests(self, server, capsys):
+        from cook_tpu.cli.main import main as cli_main
+        srv, _store, _api = server
+        _http(srv.url + "/pools")
+        rc = cli_main(["--url", srv.url, "--user", "alice",
+                       "debug", "requests", "--limit", "5"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "recent" in doc and "slow" in doc
+
+
+# ---------------------------------------------------------------------------
+# Endpoint-latency SLO wiring (sched/monitor.py)
+# ---------------------------------------------------------------------------
+
+class TestEndpointSlo:
+    def test_burn_rate_published_per_endpoint(self, server):
+        from cook_tpu.sched.monitor import Monitor
+        srv, store, api = server
+        cfg = Config()
+        cfg.slo.endpoint_latency_objective_s = 0.0  # everything breaches
+        # breach counting happens at request time against the SERVING
+        # api's objective; the monitor only publishes the ratio
+        api.config.slo.endpoint_latency_objective_s = 0.0
+        for _ in range(4):
+            _http(srv.url + "/pools")
+        wait_until(lambda: "/pools" in {
+            e for e in instrument.request_log._slo_window})
+        monitor = Monitor(store, config=cfg)
+        monitor.sweep()
+        burns = {lbl.get("endpoint"): v for lbl, v in
+                 registry.series("cook_slo_burn_rate")
+                 if lbl.get("slo") == "endpoint-latency"}
+        assert burns.get("/pools", 0) > 0
+        # a quiet endpoint is re-published at 0 the next sweep — one
+        # slow request must not stick as a permanent burn alarm
+        monitor.sweep()
+        burns = {lbl.get("endpoint"): v for lbl, v in
+                 registry.series("cook_slo_burn_rate")
+                 if lbl.get("slo") == "endpoint-latency"}
+        assert burns.get("/pools") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+class TestHttpConfig:
+    def test_daemon_section_boot_validated(self):
+        from cook_tpu.daemon import build_scheduler_config
+        cfg = build_scheduler_config(
+            {"http": {"observe": False, "slow_request_ms": 100,
+                      "request_log": 32}})
+        assert cfg.http.observe is False
+        assert cfg.http.slow_request_ms == 100.0
+        with pytest.raises(ValueError, match="unknown http key"):
+            build_scheduler_config({"http": {"slowrequest_ms": 5}})
+        with pytest.raises(ValueError, match="boolean"):
+            build_scheduler_config({"http": {"observe": "false"}})
+
+    def test_cookapi_applies_http_config(self):
+        cfg = Config()
+        cfg.http.observe = False
+        CookApi(Store(), config=cfg)
+        assert instrument.request_log.enabled is False
+        instrument.request_log.enabled = True
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: one submission is ONE stitched trace (the demo the issue
+# names as acceptance)
+# ---------------------------------------------------------------------------
+
+class TestStitchedTrace:
+    @pytest.fixture()
+    def cell(self, tmp_path):
+        from cook_tpu.cluster import FakeCluster, FakeHost
+        from cook_tpu.sched import Scheduler
+        store = Store.open(str(tmp_path))
+        cfg = Config()
+        cfg.pipeline.depth = 0
+        hosts = [FakeHost(f"h{i}", Resources(cpus=8.0, mem=1024.0))
+                 for i in range(4)]
+        sched = Scheduler(store, cfg, [FakeCluster("fake-1", hosts)])
+        api = CookApi(store, scheduler=sched, config=cfg)
+        srv = ApiServer(api)
+        srv.start()
+        yield srv, store, sched
+        srv.stop()
+
+    def test_submit_to_launch_single_export(self, cell):
+        srv, store, sched = cell
+        client = JobClient(srv.url, user="alice")
+        [uuid] = client.submit([{"command": "true", "cpus": 1.0,
+                                 "mem": 64.0}])
+        req_trace = client.last_trace_id
+        sched.step_cycle()
+        sched.flush_status_updates()
+        # the launched audit event records BOTH stitch points
+        [launched] = [e for e in store.audit.timeline(uuid)
+                      if e["kind"] == "launched"]
+        assert launched["data"]["trace"] == req_trace
+        cycle_trace = launched["data"]["cycle_trace"]
+        assert cycle_trace and cycle_trace != req_trace
+        # ONE export: request span tree + cycle flamegraph + job lane
+        trace = client.debug_trace(job=uuid)
+        events = trace["traceEvents"]
+        names = {e["name"] for e in events}
+        assert "http.request" in names
+        assert "journal.append" in names
+        assert "fused.cycle" in names or "cycle" in names
+        assert "fused.launch" in names or \
+            "cluster.launch-tasks" in names
+        assert "launched" in names          # audit lane instant event
+        # distinct tracks: cycle (1), job lane (2), request track (3)
+        assert {e["tid"] for e in events} >= {1, 2, 3}
+        http_ev = [e for e in events if e["name"] == "http.request"][0]
+        assert http_ev["tid"] == 3
+        # request-track spans really are the request trace's
+        assert http_ev["args"]["request_id"]
+
+    def test_cs_why_perfetto_includes_request_track(self, cell,
+                                                    tmp_path):
+        from cook_tpu.cli.main import main as cli_main
+        srv, _store, sched = cell
+        client = JobClient(srv.url, user="alice")
+        [uuid] = client.submit([{"command": "true", "cpus": 1.0,
+                                 "mem": 64.0}])
+        sched.step_cycle()
+        sched.flush_status_updates()
+        out_file = tmp_path / "why.json"
+        rc = cli_main(["--url", srv.url, "--user", "alice", "why",
+                       uuid, "--perfetto", str(out_file)])
+        assert rc == 0
+        trace = json.loads(out_file.read_text())
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "http.request" in names
+        assert "launched" in names
+
+    def test_job_only_export_before_launch(self, cell):
+        """A still-waiting job's export is the request trace alone —
+        the submission is traceable before any cycle ran."""
+        srv, _store, _sched = cell
+        client = JobClient(srv.url, user="alice")
+        [uuid] = client.submit([{"command": "true", "cpus": 1.0,
+                                 "mem": 64.0}])
+        wait_until(lambda: [d for d in tracer.finished
+                            if d["span"] == "http.request"])
+        trace = client.debug_trace(job=uuid)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "http.request" in names
+        assert "submitted" in names
