@@ -616,8 +616,11 @@ def cmd_debug(args) -> int:
     degradation panel — armed fault points, per-cluster circuit-breaker
     states, and open launch intents (docs/ROBUSTNESS.md); ``cs debug
     replication`` dumps the failover panel — per-follower offsets,
-    min_acked, synced set, and the candidate positions published into
-    the election medium; ``cs debug health`` is the one-shot roll-up
+    min_acked, synced set, the candidate positions published into
+    the election medium, plus the node's SERVING role: a standby's
+    read-fleet block (reads served, local apply offset vs mirrored
+    head, staleness bytes/age) and a leader's group-commit batching
+    counters (docs/DEPLOY.md read fleet); ``cs debug health`` is the one-shot roll-up
     (SLO burn rates, breakers, replication lag, pipeline depth, repack
     counters, audit queue depth) replacing five /debug/* fetches;
     ``cs debug requests`` lists the serving plane's recent + slow

@@ -7,7 +7,9 @@ wait plus admin helpers, over stdlib urllib (no extra dependencies).
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -50,7 +52,8 @@ class JobClient:
     def __init__(self, url: str, user: str = "anonymous",
                  impersonate: Optional[str] = None, timeout_s: float = 30.0,
                  token: Optional[str] = None,
-                 basic_auth: Optional[tuple] = None):
+                 basic_auth: Optional[tuple] = None,
+                 read_your_writes: bool = True):
         self.url = url.rstrip("/")
         self.user = user
         self.impersonate = impersonate
@@ -66,8 +69,111 @@ class JobClient:
         self.last_trace_id: Optional[str] = None
         # the server-echoed X-Cook-Request-Id of the most recent response
         self.last_request_id: Optional[str] = None
+        # read-your-writes over the follower fleet (docs/DEPLOY.md):
+        # leader write responses carry X-Cook-Commit-Offset (an OPAQUE
+        # session token, "<epoch>:<offset>" on fenced journals); with
+        # read_your_writes on, later GETs thread the most recent token
+        # back as X-Cook-Min-Offset so a behind follower waits briefly
+        # or hands the read to the leader — this client never reads a
+        # state older than its own confirmed writes
+        self.read_your_writes = read_your_writes
+        self.last_commit_offset: Optional[str] = None
+        # staleness of the most recent follower-served response
+        # (X-Cook-Replication-Offset / -Age-Ms), None when the leader
+        # answered
+        self.last_replication_offset: Optional[int] = None
+        self.last_replication_age_ms: Optional[float] = None
+        # pooled keep-alive connections, one per (thread, host:port):
+        # ThreadingHTTPServer spawns a thread per CONNECTION, so per-
+        # request connections meant per-request thread churn + TCP
+        # handshakes — the 4->8 reader QPS regression in the r8 bench.
+        # Thread-local so one client shared across threads stays safe.
+        self._pool = threading.local()
 
     # ------------------------------------------------------------- plumbing
+    #: a reused keep-alive socket idle past this is proactively recycled
+    #: before a NON-idempotent request: the server's idle timeout may
+    #: have closed it, and a write whose response is lost must never be
+    #: silently re-sent (see _exchange)
+    _IDLE_RECYCLE_S = 10.0
+
+    def _connection(self, scheme: str, netloc: str,
+                    fresh_for_write: bool = False):
+        conns = getattr(self._pool, "conns", None)
+        if conns is None:
+            conns = self._pool.conns = {}
+        key = (scheme, netloc)
+        conn = conns.get(key)
+        if conn is not None and fresh_for_write \
+                and conn._cook_served > 0 \
+                and time.monotonic() - conn._cook_last_use \
+                > self._IDLE_RECYCLE_S:
+            self._drop_connection(scheme, netloc)
+            conn = None
+        if conn is None:
+            cls = (http.client.HTTPSConnection if scheme == "https"
+                   else http.client.HTTPConnection)
+            conn = cls(netloc, timeout=self.timeout_s)
+            conn._cook_served = 0  # requests completed on this socket
+            conn._cook_last_use = time.monotonic()
+            conns[key] = conn
+        return conn
+
+    def _drop_connection(self, scheme: str, netloc: str) -> None:
+        conns = getattr(self._pool, "conns", {})
+        conn = conns.pop((scheme, netloc), None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        """Close this thread's pooled keep-alive connections."""
+        for scheme, netloc in list(getattr(self._pool, "conns", {})):
+            self._drop_connection(scheme, netloc)
+
+    def _exchange(self, scheme: str, netloc: str, method: str,
+                  target: str, data: Optional[bytes],
+                  headers: Dict[str, str]):
+        """One HTTP exchange over the pooled keep-alive connection.
+        A REUSED connection the server closed while idle fails on the
+        next exchange; the retry policy distinguishes WHERE it failed:
+
+        - during ``request()`` (send phase): nothing reached the
+          server — safe to retry ANY method once on a fresh socket;
+        - during ``getresponse()``: the server may have processed the
+          request and died before answering — only idempotent GETs are
+          retried (a silently re-sent POST could duplicate its effect;
+          writes surface the error like the per-request-connection
+          client did).  Non-idempotent requests avoid this window by
+          recycling long-idle sockets up front (_IDLE_RECYCLE_S)."""
+        retriable = (http.client.BadStatusLine,
+                     http.client.CannotSendRequest,
+                     ConnectionError, BrokenPipeError, OSError)
+        for attempt in (0, 1):
+            conn = self._connection(scheme, netloc,
+                                    fresh_for_write=method != "GET")
+            reused = conn._cook_served > 0
+            try:
+                conn.request(method, target, body=data, headers=headers)
+            except retriable:
+                self._drop_connection(scheme, netloc)
+                if attempt == 0 and reused:
+                    continue
+                raise
+            try:
+                resp = conn.getresponse()
+                raw = resp.read()  # drain fully: keep-alive reuse
+            except retriable:
+                self._drop_connection(scheme, netloc)
+                if attempt == 0 and reused and method == "GET":
+                    continue
+                raise
+            conn._cook_served += 1
+            conn._cook_last_use = time.monotonic()
+            return resp, raw
+
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, Union[str, Sequence[str]]]] = None,
                  body: Optional[Dict] = None) -> Any:
@@ -99,6 +205,14 @@ class JobClient:
                    "traceparent": traceparent,
                    **({"X-Cook-Impersonate": self.impersonate}
                       if self.impersonate else {})}
+        if data is not None:
+            headers["Content-Length"] = str(len(data))
+        if method == "GET" and self.read_your_writes \
+                and self.last_commit_offset:
+            # the read-your-writes token: a follower behind this
+            # position waits briefly, then redirects the read to the
+            # leader
+            headers["X-Cook-Min-Offset"] = self.last_commit_offset
         if self.token:
             headers["Authorization"] = "Bearer " + self.token
         elif self.basic_auth:
@@ -117,30 +231,52 @@ class JobClient:
         # 6 hops: room for the transient-retry budget on top of the
         # 307 leader-redirect chain
         for _hop in range(6):  # follow leader redirects (307) incl. POST,
-            req = urllib.request.Request(url, data=data, method=method,
-                                         headers=headers)
+            parsed = urllib.parse.urlsplit(url)
+            target = (parsed.path or "/") \
+                + ("?" + parsed.query if parsed.query else "")
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout_s) as resp:
-                    raw = resp.read()
-                    self.last_request_id = resp.headers.get(
-                        "X-Cook-Request-Id")
-                break
-            except urllib.error.HTTPError as e:
-                if e.code == 307 and e.headers.get("Location"):
-                    url = e.headers["Location"]
-                    continue
-                try:
-                    err_body = json.loads(e.read())
-                    message = err_body.get("error", str(e))
-                except Exception:
-                    err_body, message = {}, str(e)
-                raise JobClientError(e.code, message, body=err_body)
+                resp, raw = self._exchange(parsed.scheme or "http",
+                                           parsed.netloc, method, target,
+                                           data, headers)
             except (urllib.error.URLError, ConnectionError, OSError):
                 if transient is None or transient[0] <= 0:
                     raise
                 transient[0] -= 1
                 time.sleep(transient[1].next_delay())
+                continue
+            self.last_request_id = resp.getheader("X-Cook-Request-Id")
+            co = resp.getheader("X-Cook-Commit-Offset")
+            if co is not None:
+                # the token is OPAQUE and the LATEST write wins, not a
+                # max(): the server's offset space re-bases smaller on
+                # a journal checkpoint (and changes epoch on failover),
+                # and a pinned stale token from an old space would be
+                # unsatisfiable forever.  The read-your-writes session
+                # token is the most recent confirmed write, exactly
+                # like any session token.
+                self.last_commit_offset = co
+            ro = resp.getheader("X-Cook-Replication-Offset")
+            self.last_replication_offset = \
+                int(ro) if ro and ro.isdigit() else None
+            age = resp.getheader("X-Cook-Replication-Age-Ms")
+            try:
+                self.last_replication_age_ms = \
+                    float(age) if age is not None else None
+            except ValueError:
+                self.last_replication_age_ms = None
+            if resp.status == 307 and resp.getheader("Location"):
+                url = resp.getheader("Location")
+                continue
+            if resp.status >= 400:
+                try:
+                    err_body = json.loads(raw)
+                    message = err_body.get(
+                        "error", f"HTTP {resp.status}")
+                except Exception:
+                    err_body = {}
+                    message = f"HTTP {resp.status}: {resp.reason}"
+                raise JobClientError(resp.status, message, body=err_body)
+            break
         else:
             raise JobClientError(508, "redirect loop")
         if path == "/metrics":

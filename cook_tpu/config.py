@@ -226,6 +226,71 @@ class ReplicationConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Serving-plane scale-out knobs (the daemon's ``"serving"`` conf
+    section inside ``"scheduler"``; boot-validated like PipelineConfig):
+    the follower read fleet (state/read_replica.py — standbys serve
+    bounded-staleness GETs from a live journal-applied store) and the
+    leader's group-commit admission batching (state/store.py — concurrent
+    write transactions share ONE journal fsync + ONE replication ack
+    round).  docs/DEPLOY.md "read fleet", docs/PERFORMANCE.md
+    "group commit"."""
+
+    #: standbys answer job/group/instance/queue/unscheduled/timeline GETs
+    #: from their live-applied mirror (staleness surfaced per response via
+    #: X-Cook-Replication-Offset / -Age-Ms) instead of 307-redirecting.
+    #: Writes always redirect to the leader.
+    follower_reads: bool = True
+    #: how long the follower's apply loop sleeps between journal polls —
+    #: the steady-state staleness floor (the mirror itself is pushed by
+    #: the leader; this only bounds the local apply cadence)
+    apply_interval_seconds: float = 0.02
+    #: read-your-writes: a follower behind a client's X-Cook-Min-Offset
+    #: token waits up to this long for its mirror to catch up before
+    #: 307-redirecting the read to the leader
+    min_offset_wait_seconds: float = 1.0
+    #: leader write path: amortize journal fsync + replication ack across
+    #: concurrent committers (one durability round per batch, outcomes
+    #: demultiplexed per transaction — incl. the PR 3 indeterminate
+    #: contract).  Engages only on stores with a journal attached.
+    group_commit: bool = True
+    #: coalescing window: after the first waiter arrives the committer
+    #: waits this long for stragglers before draining the batch.  0 =
+    #: drain immediately (whatever accumulated during the previous
+    #: round's fsync/ack still batches).
+    group_commit_window_ms: float = 0.5
+    #: hard per-batch cap (a full batch drains without waiting)
+    group_commit_max_batch: int = 256
+
+    def __post_init__(self):
+        if not isinstance(self.group_commit_max_batch, int) \
+                or self.group_commit_max_batch < 1:
+            raise ValueError("serving group_commit_max_batch must be an "
+                             f"int >= 1, got {self.group_commit_max_batch!r}")
+        for k in ("apply_interval_seconds", "min_offset_wait_seconds",
+                  "group_commit_window_ms"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"serving {k} must be >= 0")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "ServingConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown serving key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"serving key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class PipelineConfig:
     """Pipelined fused-cycle driver + compile-warmup knobs (the daemon's
     ``"pipeline"`` conf section; sched/pipeline.py, docs/PERFORMANCE.md).
@@ -475,6 +540,9 @@ class Config:
     # serving-plane request observability: http.request spans, RED
     # metrics, /debug/requests capture rings (rest/instrument.py)
     http: HttpConfig = field(default_factory=HttpConfig)
+    # serving-plane scale-out: follower read fleet + leader group-commit
+    # admission batching (state/read_replica.py, state/store.py)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

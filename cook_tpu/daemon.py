@@ -133,6 +133,12 @@ def build_scheduler_config(spec: Dict) -> Config:
         # boot-validated like the pipeline/audit sections
         from .config import HttpConfig
         cfg.http = HttpConfig.from_conf(spec["http"])
+    if "serving" in spec:
+        # serving-plane scale-out: follower read fleet + group-commit
+        # admission batching (docs/DEPLOY.md, docs/PERFORMANCE.md); a
+        # typo'd knob fails the boot like the sections above
+        from .config import ServingConfig
+        cfg.serving = ServingConfig.from_conf(spec["serving"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))
@@ -269,6 +275,13 @@ class CookDaemon:
         self.standby_server = None
         self._node_id: str = ""
         self._fence_thread: Optional[threading.Thread] = None
+        # follower read fleet (state/read_replica.py): a standby's live
+        # journal-applied store, served by the REST layer with the
+        # bounded-staleness contract (docs/DEPLOY.md)
+        self.read_view = None
+        # monotonic timestamp of the last NOT-superseded fence verdict
+        # (_fence_superseded's short-TTL cache)
+        self._fence_cache: Optional[float] = None
 
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
@@ -408,8 +421,45 @@ class CookDaemon:
             self._repl_thread = threading.Thread(
                 target=self._follow_leader_loop, daemon=True)
             self._repl_thread.start()
+            if self.sched_config.serving.follower_reads:
+                # promote the byte mirror to a LIVE read store: this
+                # standby serves bounded-staleness GETs instead of
+                # redirecting them (ROADMAP item 1's read fleet).
+                # Subscribe via on_swap() AFTER the assignments — the
+                # method invokes the callback immediately with the
+                # view's store, so api.store is re-pointed even when
+                # the mirror never re-bases again (a restarted standby
+                # resuming an intact mirror by delta would otherwise
+                # serve the frozen boot-time replay forever)
+                from .state.read_replica import FollowerReadView
+                self.read_view = FollowerReadView(
+                    self.data_dir,
+                    interval_s=self.sched_config.serving
+                    .apply_interval_seconds)
+                self.api.read_view = self.read_view
+                self.read_view.on_swap(self._on_view_swap)
+        elif self.data_dir and not self.shared_data:
+            # single-node durable leader: the group-commit stage
+            # amortizes fsync across concurrent REST writers
+            self._maybe_enable_group_commit()
         if not self.api_only:
             self.elector.campaign()
+
+    def _on_view_swap(self, store: Store) -> None:
+        """The read view rebuilt its store (initial build / mirror
+        re-base): the REST layer must serve the fresh object.  A
+        promoted leader ignores late swaps — promotion owns the store."""
+        if self.scheduler is None and self.read_view is not None:
+            self.store = store
+            self.api.store = store
+            self.queue_limits.store = store
+
+    def _maybe_enable_group_commit(self) -> None:
+        sv = self.sched_config.serving
+        if sv.group_commit and self.store is not None:
+            self.store.enable_group_commit(
+                window_ms=sv.group_commit_window_ms,
+                max_batch=sv.group_commit_max_batch)
 
     def _on_leadership(self) -> None:
         """PROCESS-GLOBAL TRANSITION: this node becomes THE scheduler
@@ -426,6 +476,7 @@ class CookDaemon:
                     self.store = Store.open(self.data_dir, epoch="auto")
                     self.api.store = self.store
                     self.queue_limits.store = self.store
+                    self._maybe_enable_group_commit()
                 clusters = build_clusters(self.conf.get("clusters", []),
                                           self.store,
                                           config=self.sched_config)
@@ -468,6 +519,12 @@ class CookDaemon:
         The reference equivalent is the new leader re-reading the
         networked store (mesos.clj:153-328)."""
         from .state import replication as repl
+        if self.read_view is not None:
+            # the promoted store owns the directory now; the read view's
+            # replica store is superseded by the authoritative open below
+            self.read_view.stop()
+            self.read_view = None
+            self.api.read_view = None
         if self.repl_follower is not None:
             self.repl_follower.stop()
             self.repl_follower = None
@@ -540,6 +597,9 @@ class CookDaemon:
             min_followers=int(cfg.min_sync_followers))
         self.api.repl_server = self.repl_server  # surfaced in GET /info
         self.api.fence_guard = self._fence_superseded
+        # write-path admission batching: one fsync + one ack round per
+        # batch of concurrent REST submissions (docs/PERFORMANCE.md)
+        self._maybe_enable_group_commit()
         host = cfg.advertise_host or self.host
         self._publish_repl_addr(f"{host}:{self.repl_server.port}",
                                 self.store._journal_epoch)
@@ -588,7 +648,18 @@ class CookDaemon:
         one this leader's store is fenced at — the REST write path flips
         to 503/redirect immediately (journal fencing alone only rejects
         the next append; reads of a stale leader are the client's
-        redirect problem, writes must never be accepted)."""
+        redirect problem, writes must never be accepted).
+
+        The NOT-superseded verdict is cached for a short TTL: every
+        write AND every token-bearing read consults this guard, and a
+        per-request epoch-file read would tax exactly the hot path the
+        read fleet exists to lighten.  A fenced verdict is never cached
+        stale — once True it recomputes (and stays True, since epochs
+        only grow)."""
+        now = time.monotonic()
+        cached = self._fence_cache
+        if cached is not None and now - cached < 0.25:
+            return False
         authority = self._epoch_authority_path()
         store = self.store
         if authority is None or store is None \
@@ -596,7 +667,10 @@ class CookDaemon:
             return False
         from .utils.fsatomic import read_int_file
         current = read_int_file(str(authority))
-        return current is not None and current > store._journal_epoch
+        superseded = current is not None and current > store._journal_epoch
+        if not superseded:
+            self._fence_cache = now
+        return superseded
 
     def _fence_watch_loop(self) -> None:
         """Leader-side watchdog: a partitioned-but-alive deposed leader
@@ -715,6 +789,9 @@ class CookDaemon:
                         except Exception:
                             pass
         self._repl_stop.set()
+        if self.read_view is not None:
+            self.read_view.stop()
+            self.read_view = None
         if self._repl_thread is not None:
             self._repl_thread.join(timeout=2.0)
         if self._fence_thread is not None:

@@ -627,6 +627,12 @@ class CookApi:
         self.repl_follower = None
         self.repl_dir: Optional[str] = None
         self.fence_guard: Optional[Callable[[], bool]] = None
+        # follower read fleet (state/read_replica.py, set by the daemon
+        # on replication standbys): a live journal-applied store this
+        # node serves bounded-staleness GETs from instead of
+        # 307-redirecting them to the leader (docs/DEPLOY.md)
+        self.read_view = None
+        self.follower_reads = 0
         # HTTP-level per-client-IP throttle (reference: ip-rate-limit
         # middleware wrapping the handler, components.clj:214-221);
         # None = unlimited
@@ -1194,11 +1200,27 @@ class CookApi:
 
     def queue(self, user: str) -> Dict:
         self.require_admin(user)
-        if self.scheduler is None:
-            raise ApiError(503, "no scheduler attached")
-        return {pool: [job_to_json(self.store, j, include_instances=False)
-                       for j in jobs[:200]]
-                for pool, jobs in self.scheduler.pending_queues.items()}
+        if self.scheduler is not None:
+            return {pool: [job_to_json(self.store, j,
+                                       include_instances=False)
+                           for j in jobs[:200]]
+                    for pool, jobs in self.scheduler.pending_queues.items()}
+        if self.read_view is not None:
+            # follower approximation of the ranked queue: the true DRU
+            # order is leader state, so serve the pending set in
+            # (priority, submit-time) order from the live mirror —
+            # honestly stale, labeled by the replication headers the
+            # follower read path attaches (docs/DEPLOY.md)
+            out: Dict[str, List] = {}
+            for job in self.store.pending_jobs():
+                out.setdefault(job.pool, []).append(job)
+            return {pool: [job_to_json(self.store, j,
+                                       include_instances=False)
+                           for j in sorted(
+                               jobs, key=lambda j: (-j.priority,
+                                                    j.submit_time_ms))[:200]]
+                    for pool, jobs in out.items()}
+        raise ApiError(503, "no scheduler attached")
 
     def running(self) -> List[Dict]:
         return [instance_to_json(inst)
@@ -1655,7 +1677,7 @@ class CookApi:
                 k: repl.get(k)
                 for k in ("role", "epoch", "fenced", "synced_followers",
                           "follower_count", "min_acked", "journal_bytes",
-                          "mirror")
+                          "mirror", "serving", "group_commit")
                 if repl.get(k) is not None},
             "pipeline_depth": next(
                 (v for _lbl, v in registry.series("cook_pipeline_depth")),
@@ -1742,11 +1764,24 @@ class CookApi:
                 follower_count=rs.follower_count,
                 synced_followers=rs.synced_follower_count,
                 followers=followers)
+            gc = self.store.group_commit_stats() \
+                if hasattr(self.store, "group_commit_stats") else None
+            if gc is not None:
+                # write-path admission batching: batches, demuxed
+                # outcomes, and the largest batch amortized so far
+                out["group_commit"] = gc
         rf = self.repl_follower
         if rf is not None:
             out["role"] = "standby"
             out["mirror"] = {"offset": rf.offset,
                              "connected": rf.connected}
+        rv = self.read_view
+        if rv is not None:
+            # the SERVING role of this standby: local apply position vs
+            # the mirrored head (staleness in bytes + age) and how many
+            # GETs this node has answered from its live store
+            out["serving"] = {**rv.stats(),
+                              "reads_served": self.follower_reads}
         if self.repl_dir:
             from ..state.replication import candidate_position
             out["position"] = candidate_position(self.repl_dir)
@@ -1962,6 +1997,14 @@ class CookApi:
                     max(0, head - int(f.get("acked", 0))),
                     labels={"follower": str(f.get("id")),
                             "synced": str(bool(f.get("synced"))).lower()})
+        rv = self.read_view
+        if rv is not None:
+            # follower serving-plane staleness, refreshed at scrape time
+            # like the leader's per-follower lag above
+            registry.gauge_set("cook_follower_apply_lag_bytes",
+                               float(rv.lag_bytes()))
+            registry.gauge_set("cook_follower_staleness_seconds",
+                               round(rv.age_ms() / 1000.0, 6))
         lines = registry.expose()
         # always include live gauges derivable from state
         with self.store._lock:
@@ -2019,6 +2062,15 @@ def _finite(d: Dict[str, float]) -> Dict[str, Any]:
 class _Handler(BaseHTTPRequestHandler):
     api: CookApi = None  # set by server factory
     protocol_version = "HTTP/1.1"
+    # keep-alive is the serving plane's thread model: ThreadingHTTPServer
+    # runs one thread per CONNECTION, so connection reuse (JobClient's
+    # pooled http.client sockets) turns per-request thread churn into one
+    # long-lived thread per client.  Nagle off: small JSON responses must
+    # not wait out delayed-ACK interactions on localhost benches.
+    disable_nagle_algorithm = True
+    # an idle keep-alive connection releases its thread eventually
+    # instead of holding it for the client process lifetime
+    timeout = 120
 
     # ------------------------------------------------------------- plumbing
     def log_message(self, fmt, *args):  # pragma: no cover - silence
@@ -2140,6 +2192,10 @@ class _Handler(BaseHTTPRequestHandler):
                             or uuidlib.uuid4().hex[:16])
         self._status = 500
         self._bytes_out = 0
+        # per-request response headers the dispatch layer fills (the
+        # serving-plane contract: X-Cook-Replication-Offset/-Age-Ms on
+        # follower-served reads, X-Cook-Commit-Offset on leader writes)
+        self._resp_headers: Dict[str, str] = {}
         # keep-alive connections reuse this handler instance: a stale
         # identity from the previous request must not be attributed to
         # one that fails authentication
@@ -2190,9 +2246,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._auth_user = self._authenticate()
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
+            if method in ("POST", "PUT", "DELETE") \
+                    and self.api.read_view is None:
+                # leader/standalone write: return the commit position
+                # ("<epoch>:<offset>", offset-space-qualified) so the
+                # client can demand read-your-writes from followers
+                if self.api.store.commit_offset():
+                    self._resp_headers.setdefault(
+                        "X-Cook-Commit-Offset",
+                        self.api.store.commit_token())
             self._respond(200, payload,
-                          extra_headers=getattr(
-                              self, "_auth_respond_headers", None))
+                          extra_headers={
+                              **self._resp_headers,
+                              **(getattr(self, "_auth_respond_headers",
+                                         None) or {})})
         except _Redirect as r:
             # 307 preserves the method+body, as the reference's
             # leader-redirect does. Drain any unread body first: leaving it
@@ -2212,7 +2279,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(e.status,
                           {"error": e.message,
                            "request_id": self._request_id, **e.extra},
-                          extra_headers=e.headers)
+                          extra_headers={
+                              **getattr(self, "_resp_headers", {}),
+                              **(e.headers or {})})
         except ReplicationIndeterminate as e:
             # write paths that don't build their own ambiguous-outcome
             # body (kill/retry/status — all idempotent): the transaction
@@ -2230,14 +2299,94 @@ class _Handler(BaseHTTPRequestHandler):
                     "/failure_reasons", "/settings", "/swagger-docs",
                     "/swagger-ui"}
 
+    #: GET paths a replication standby with a live read view serves
+    #: LOCALLY (bounded staleness, labeled by the replication headers)
+    #: instead of 307-redirecting — ROADMAP item 1's read fleet
+    _FOLLOWER_READ_PATHS = {
+        "/jobs", "/rawscheduler", "/group", "/list", "/running",
+        "/usage", "/share", "/quota", "/pools", "/queue",
+        "/unscheduled_jobs", "/stats/instances"}
+
+    @classmethod
+    def _follower_readable(cls, path: str, parts: List[str]) -> bool:
+        if path in cls._FOLLOWER_READ_PATHS:
+            return True
+        if len(parts) == 2 and parts[0] in ("jobs", "instances"):
+            return True
+        return (len(parts) == 4 and parts[0] == "debug"
+                and parts[1] == "job" and parts[3] == "timeline")
+
+    @staticmethod
+    def _parse_min_offset(token: str):
+        """An X-Cook-Min-Offset token: ``<epoch>:<offset>`` (the epoch
+        qualifies the journal offset SPACE) or bare ``<offset>``.
+        Returns (epoch or None, offset); raises 400 on garbage."""
+        try:
+            if ":" in token:
+                ep, _, off = token.partition(":")
+                return int(ep), int(off)
+            return None, int(token)
+        except ValueError:
+            raise ApiError(400, "malformed X-Cook-Min-Offset")
+
+    def _redirect(self, base: str, path: str) -> None:
+        """Raise the 307 to ``base``, preserving this request's query."""
+        query = urllib.parse.urlparse(self.path).query
+        raise _Redirect(base + path + ("?" + query if query else ""))
+
+    def _serve_from_follower(self, target: str, path: str) -> None:
+        """Admit this GET to the local read view: honor the client's
+        read-your-writes token (wait briefly, else redirect to the
+        leader) and attach the staleness contract headers."""
+        api = self.api
+        rv = api.read_view
+        want = self.headers.get("X-Cook-Min-Offset")
+        if want is not None:
+            ep, off = self._parse_min_offset(want)
+            if not rv.wait_token(
+                    ep, off, api.config.serving.min_offset_wait_seconds):
+                # still behind the client's own write (or mirroring an
+                # EARLIER leadership's offset space): the leader is the
+                # only node that can guarantee read-your-writes
+                self._redirect(target, path)
+        api.follower_reads += 1
+        from ..utils.metrics import registry
+        registry.counter_inc("cook_follower_reads")
+        self._resp_headers["X-Cook-Replication-Offset"] = str(rv.offset)
+        self._resp_headers["X-Cook-Replication-Age-Ms"] = \
+            str(round(rv.age_ms(), 1))
+
     def _dispatch(self, method: str, path: str, params: Dict):
         api = self.api
         parts = [p for p in path.split("/") if p]
         if path not in self._LOCAL_PATHS:
             target = api.leader_redirect_target()
             if target is not None:
-                query = urllib.parse.urlparse(self.path).query
-                raise _Redirect(target + path + ("?" + query if query else ""))
+                if method == "GET" and api.read_view is not None \
+                        and self._follower_readable(path, parts):
+                    # serve from the live mirror instead of redirecting
+                    # (may itself redirect when a read-your-writes token
+                    # cannot be satisfied in time)
+                    self._serve_from_follower(target, path)
+                else:
+                    self._redirect(target, path)
+            elif method == "GET" \
+                    and self.headers.get("X-Cook-Min-Offset") \
+                    and api.fence_guard is not None and api.fence_guard():
+                # a DEPOSED leader cannot honor a read-your-writes token:
+                # the successor holds commits beyond this journal's fence
+                # epoch, so offsets here no longer bound staleness.
+                # Plain reads stay served (honest best-effort, clients
+                # re-resolve the leader); token-bearing reads refuse.
+                successor = api.elector.leader_url() if api.elector \
+                    else None
+                if successor and successor != api.node_url:
+                    self._redirect(successor, path)
+                raise ApiError(
+                    503, "this leader has been superseded (stale "
+                         "election epoch); its offsets cannot satisfy "
+                         "read-your-writes — retry against the new "
+                         "leader", headers={"Retry-After": "1"})
             if method in ("POST", "PUT", "DELETE") \
                     and api.fence_guard is not None and api.fence_guard():
                 # deposed replication leader: a successor minted a higher
@@ -2249,9 +2398,7 @@ class _Handler(BaseHTTPRequestHandler):
                 successor = api.elector.leader_url() if api.elector \
                     else None
                 if successor and successor != api.node_url:
-                    query = urllib.parse.urlparse(self.path).query
-                    raise _Redirect(successor + path
-                                    + ("?" + query if query else ""))
+                    self._redirect(successor, path)
                 raise ApiError(
                     503, "this leader has been superseded (stale "
                          "election epoch); retry against the new leader",
@@ -2390,6 +2537,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._route("PUT")
 
 
+class _CookHTTPServer(ThreadingHTTPServer):
+    # a deep accept backlog: reader fleets open their keep-alive
+    # connections in a burst at client start; the default backlog (5)
+    # made that burst retry its SYNs — part of the 4->8 reader QPS
+    # regression in the r8 rest_plane baseline
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class ApiServer:
     """Threaded HTTP server wrapper."""
 
@@ -2397,7 +2553,7 @@ class ApiServer:
         # _Handler._respond serves the {"_raw"}/{"_html"} text surfaces
         # (/metrics, /swagger-ui) itself — no wrapper needed
         handler = type("BoundHandler", (_Handler,), {"api": api})
-        self.server = ThreadingHTTPServer((host, port), handler)
+        self.server = _CookHTTPServer((host, port), handler)
         self.host, self.port = self.server.server_address
         self._thread: Optional[threading.Thread] = None
 

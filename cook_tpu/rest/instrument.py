@@ -48,8 +48,11 @@ REQUEST_SIZE_BUCKETS = (256.0, 1024.0, 4096.0, 16384.0, 65536.0,
 
 # the span names the phase decomposition publishes (the tracing phase
 # collector records EVERY span; exporting them all as label values would
-# let any future span silently widen a metric family)
-PHASE_SPANS = ("journal.append", "repl.ack_wait", "remote.launch")
+# let any future span silently widen a metric family).  journal.fsync is
+# the group-commit stage's batched force, attributed back into each
+# waiting request via tracer.record_finished (state/store.py).
+PHASE_SPANS = ("journal.append", "journal.fsync", "repl.ack_wait",
+               "remote.launch")
 
 # query params whose values never reach the capture ring verbatim
 _REDACT_KEYS = frozenset({"token", "password", "authorization", "secret"})

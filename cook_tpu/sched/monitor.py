@@ -155,7 +155,25 @@ class Monitor:
             out[pool.name] = self._sweep_pool(pool)
         self._sweep_cycle_slo()
         self._sweep_http_slo()
+        self._sweep_serving()
         return out
+
+    def _sweep_serving(self) -> None:
+        """Leader serving-plane gauges: the journal commit position (the
+        read-your-writes token's upper bound, which follower staleness
+        is measured against) and the group-commit stage's live state —
+        the batch-size HISTOGRAM is recorded by the committer itself
+        per batch (cook_group_commit_batch_size); the sweep publishes
+        the queue depth a stuck committer would show."""
+        co = getattr(self.store, "commit_offset", None)
+        if co is not None and co():
+            self.registry.gauge_set("cook_journal_head_bytes",
+                                    float(co()))
+        gc_stats = getattr(self.store, "group_commit_stats", None)
+        gc = gc_stats() if gc_stats is not None else None
+        if gc is not None:
+            self.registry.gauge_set("cook_group_commit_pending",
+                                    float(gc["pending"]))
 
     def _sweep_pool(self, pool) -> Dict[str, int]:
         from ..state.schema import DruMode

@@ -70,6 +70,13 @@ def main(argv=None) -> int:
                    help="chaos: per-call fire probability for the "
                         "delta.extract/delta.apply fault points (each "
                         "hit degrades that cycle to a full repack)")
+    p.add_argument("--no-group-commit", action="store_true",
+                   help="chaos-failover: disable the leader's "
+                        "group-commit admission batching (default ON: "
+                        "concurrent submissions share one journal "
+                        "fsync + replication ack round, and an ack "
+                        "lost mid-batch must demux indeterminate to "
+                        "every waiter — never a silent drop)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -89,7 +96,8 @@ def main(argv=None) -> int:
     if args.chaos_failover:
         from .chaos import FailoverChaosConfig, run_failover_chaos
         result = run_failover_chaos(FailoverChaosConfig(
-            seed=args.seed or 0, leader_mode=args.leader_mode))
+            seed=args.seed or 0, leader_mode=args.leader_mode,
+            group_commit=not args.no_group_commit))
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1
 

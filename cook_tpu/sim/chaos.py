@@ -554,6 +554,16 @@ class FailoverChaosConfig:
     inject_indeterminate: bool = True
     ack_timeout_s: float = 5.0
     data_root: Optional[str] = None
+    #: leader-side group-commit admission batching (state/store.py):
+    #: concurrent submissions share one fsync + one replication ack
+    #: round.  The scenario adds two concurrent phases — a healthy batch
+    #: (all members must commit and survive the failover) and a batch
+    #: whose ack round is fault-lost mid-flight (every waiter must
+    #: resolve committed or indeterminate, never hang or silently drop;
+    #: the records reached the synced mirror either way, so ALL must
+    #: survive the failover)
+    group_commit: bool = True
+    group_commit_writers: int = 4
 
 
 @dataclass
@@ -567,6 +577,13 @@ class FailoverChaosResult:
     indeterminate_commits: int = 0
     fenced_appends_rejected: int = 0
     fenced_rest_writes_rejected: int = 0
+    # group-commit accounting: durability rounds the stage ran, the
+    # demuxed outcome histogram of the concurrent phases, and waiters
+    # that never resolved (must stay 0 — the never-silently-dropped
+    # contract)
+    group_commit_batches: int = 0
+    group_commit_outcomes: Dict[str, int] = field(default_factory=dict)
+    group_commit_unresolved: int = 0
     # True when the promoted store's replayed audit trail carries the
     # pre-failover jobs' timelines (journal-backed lane mirrored over
     # socket replication, docs/OBSERVABILITY.md)
@@ -588,6 +605,9 @@ class FailoverChaosResult:
             "fenced_rest_writes_rejected":
                 self.fenced_rest_writes_rejected,
             "audit_timeline_ok": self.audit_timeline_ok,
+            "group_commit_batches": self.group_commit_batches,
+            "group_commit_outcomes": dict(self.group_commit_outcomes),
+            "group_commit_unresolved": self.group_commit_unresolved,
         }
 
 
@@ -642,9 +662,37 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
     import urllib.error
     import urllib.request
 
+    import threading
+
     from ..state import replication as repl
-    from ..state.store import ReplicationIndeterminate, StaleEpochError
+    from ..state.store import (ReplicationIndeterminate,
+                               ReplicationTimeout, StaleEpochError)
     from ..utils.fsatomic import read_int_file, write_atomic_int
+
+    def _concurrent_submits(store, base_i: int, n: int, outcomes: list):
+        """n concurrent single-job submissions (one group-commit batch's
+        worth of independent REST writers); each thread records its
+        demuxed outcome — the never-silently-dropped contract is
+        'every thread appends exactly one entry'."""
+        def worker(i: int):
+            job = _failover_job(base_i + i)
+            try:
+                store.create_jobs([job])
+                outcomes.append(("committed", job.uuid))
+            except ReplicationIndeterminate:
+                outcomes.append(("indeterminate", job.uuid))
+            except (StaleEpochError, ReplicationTimeout, RuntimeError):
+                # clean refusals: nothing journaled (or the journal was
+                # already poisoned by an earlier fence) — safe to retry
+                outcomes.append(("aborted", job.uuid))
+            except Exception as e:  # a waiter must never die opaquely
+                outcomes.append((f"unexpected:{type(e).__name__}",
+                                 job.uuid))
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        return threads
 
     cc = cc or FailoverChaosConfig()
     result = FailoverChaosResult()
@@ -672,6 +720,10 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
         cleanup.append(srv.stop)
         store.attach_replication(srv, sync=True,
                                  timeout_s=cc.ack_timeout_s)
+        if cc.group_commit:
+            # a wide coalescing window so the concurrent phases reliably
+            # share durability rounds (production default is sub-ms)
+            store.enable_group_commit(window_ms=5.0)
         fa = repl.ReplicationFollower("127.0.0.1", srv.port, d_a)
         fb = repl.ReplicationFollower("127.0.0.1", srv.port, d_b)
         cleanup += [fa.stop, fb.stop]
@@ -683,6 +735,31 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
         for i in range(cc.n_jobs_before_lag):
             store.create_jobs([_failover_job(i)])
             committed.append(_failover_job(i).uuid)
+        if cc.group_commit:
+            # ---- healthy group-commit batch: concurrent writers share
+            # durability rounds; every member commits and must survive
+            # the failover like any other committed transaction
+            outcomes: list = []
+            threads = _concurrent_submits(store, 500_000,
+                                          cc.group_commit_writers,
+                                          outcomes)
+            for t in threads:
+                t.join(timeout=30.0)
+            result.group_commit_unresolved += sum(
+                1 for t in threads if t.is_alive())
+            for outcome, uuid in outcomes:
+                result.group_commit_outcomes[outcome] = \
+                    result.group_commit_outcomes.get(outcome, 0) + 1
+                if outcome == "committed":
+                    committed.append(uuid)
+                else:
+                    result.violations.append(
+                        f"healthy group-commit writer got {outcome}")
+            gstats = store.group_commit_stats() or {}
+            if gstats.get("max_batch", 0) < 2:
+                result.violations.append(
+                    "concurrent submissions never shared a group-commit "
+                    f"durability round: {gstats}")
         # ---- standby B lags (once-synced-then-lagged candidate) ------
         if not _wait(lambda: os.path.exists(
                 os.path.join(d_b, "repl_synced"))):
@@ -718,6 +795,49 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
             if store.job(amb.uuid) is None:
                 result.violations.append(
                     "indeterminate commit was rolled back locally")
+        if cc.group_commit:
+            # ---- ack lost MID-BATCH: the leader's durability round for
+            # a whole batch of concurrent writers fails (the shape a
+            # leader death mid-group-commit leaves behind).  Every
+            # waiter must resolve — the faulted round's members all
+            # demux indeterminate, any straggler batch commits — and
+            # since each record was written+streamed to the synced
+            # mirror before its ack round, ALL must survive failover.
+            outcomes2: list = []
+            injector.arm("repl.ack", probability=1.0, max_fires=1)
+            try:
+                threads = _concurrent_submits(store, 600_000,
+                                              cc.group_commit_writers,
+                                              outcomes2)
+                for t in threads:
+                    t.join(timeout=30.0)
+            finally:
+                injector.disarm("repl.ack")
+            result.group_commit_unresolved += sum(
+                1 for t in threads if t.is_alive())
+            saw_indeterminate = False
+            for outcome, uuid in outcomes2:
+                result.group_commit_outcomes[outcome] = \
+                    result.group_commit_outcomes.get(outcome, 0) + 1
+                if outcome == "indeterminate":
+                    saw_indeterminate = True
+                    committed.append(uuid)  # on the synced mirror
+                elif outcome == "committed":
+                    committed.append(uuid)
+                else:
+                    result.violations.append(
+                        f"mid-batch ack loss: writer got {outcome} "
+                        "(must be committed or indeterminate)")
+            if not saw_indeterminate:
+                result.violations.append(
+                    "injected mid-batch ack loss demuxed no "
+                    "indeterminate outcome to its waiters")
+            if result.group_commit_unresolved:
+                result.violations.append(
+                    f"{result.group_commit_unresolved} group-commit "
+                    "waiter(s) never resolved (silently dropped)")
+            gstats = store.group_commit_stats() or {}
+            result.group_commit_batches = int(gstats.get("batches", 0))
         result.committed = len(committed)
         if not _wait(lambda: fa.offset >= _journal_bytes(d_leader)):
             result.violations.append("standby A never reached the head")
@@ -732,6 +852,7 @@ def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
             store.close()
         else:  # partition: alive but cut off from the standbys
             old_store = store
+            cleanup.append(store.close)  # incl. its group-commit stage
         pos_a = dict(repl.candidate_position(d_a), ts=None)
         pos_b = dict(repl.candidate_position(d_b), ts=None)
         if repl.rank_key(pos_a) <= repl.rank_key(pos_b):

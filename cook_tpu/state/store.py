@@ -260,6 +260,219 @@ class _Txn:
                        new=new_state.value, reason=reason)
 
 
+#: group-commit batch-size histogram bounds (records per durability round)
+_GC_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                     512.0)
+
+
+class _CommitWaiter:
+    """One transaction's slot in a group-commit batch: resolved by the
+    committer with this txn's outcome (None = confirmed committed, else
+    the exception to raise) plus the shared round's cost breakdown so the
+    waiter can attribute it into its own request trace."""
+
+    __slots__ = ("offset", "done", "error", "batch_size", "fsync_s",
+                 "ack_s", "stage")
+
+    def __init__(self, offset: int, stage: "_GroupCommitStage"):
+        self.offset = offset
+        self.stage = stage
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.batch_size = 0
+        self.fsync_s = 0.0
+        self.ack_s = 0.0
+
+
+class _GroupCommitStage:
+    """Commit-latch group commit (the Gray/DeWitt lineage — amortize one
+    log force across concurrent writers; the same move the fused cycle
+    makes batching a whole match cycle's launches into one txn).
+
+    Records are already WRITTEN + FLUSHED in commit order under the store
+    lock when they reach this stage — a failed write still aborts cleanly
+    inline.  What moves here is the expensive durability tail: ONE
+    ``os.fsync`` and ONE ``repl.wait_acked(max offset)`` per batch
+    instead of per transaction, with per-transaction outcomes
+    (committed / :class:`ReplicationIndeterminate` — the PR 3 contract)
+    demultiplexed back to each waiter.  A clean abort can no longer
+    happen past this point: once a record is flushed and installed (and
+    later transactions may have built on it), an unconfirmed fsync or
+    ack is INDETERMINATE, never excised.
+
+    Lock order: committers hold the store lock when enqueueing (store
+    lock -> stage condvar); the committer thread takes the store lock
+    only with the condvar released — no cycle."""
+
+    def __init__(self, store: "Store", window_ms: float = 0.5,
+                 max_batch: int = 256):
+        self._store = store
+        self.window_s = max(float(window_ms), 0.0) / 1000.0
+        self.max_batch = max(int(max_batch), 1)
+        self._cv = threading.Condition()
+        self._pending: List[_CommitWaiter] = []
+        self._stopped = False
+        # advisory counters (single writer: the committer thread)
+        self.batches = 0
+        self.commits = 0
+        self.indeterminate = 0
+        self.max_batch_seen = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cook-group-commit")
+        self._thread.start()
+
+    def enqueue(self, offset: int) -> _CommitWaiter:
+        w = _CommitWaiter(int(offset), self)
+        with self._cv:
+            if self._stopped:
+                # a closing store can no longer confirm durability; the
+                # record is journaled+flushed, so the honest outcome is
+                # the ambiguous one, not a hang
+                w.error = ReplicationIndeterminate(
+                    "store closing: group-commit durability unconfirmed")
+                w.done.set()
+                return w
+            self._pending.append(w)
+            self._cv.notify()
+        return w
+
+    def wait(self, w: _CommitWaiter) -> Optional[BaseException]:
+        """Block until the waiter's batch resolves; returns the outcome
+        exception (None = confirmed).  Bounded: the committer's own
+        timeouts resolve every batch, but a committer death must not
+        hang every writer forever."""
+        timeout = max(60.0, float(self._store._repl_timeout_s) * 4)
+        if not w.done.wait(timeout=timeout):
+            return ReplicationIndeterminate(
+                "group-commit round did not resolve in time; the record "
+                "is journaled and flushed but durability is unconfirmed")
+        return w.error
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            pending = len(self._pending)
+        return {"pending": pending, "batches": self.batches,
+                "commits": self.commits,
+                "indeterminate": self.indeterminate,
+                "max_batch": self.max_batch_seen,
+                "window_ms": round(self.window_s * 1000.0, 3)}
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ committer
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopped:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # stopped and drained
+                if self.window_s > 0 and not self._stopped \
+                        and len(self._pending) < self.max_batch:
+                    # coalescing window: stragglers arriving during the
+                    # previous round's fsync/ack already batched; this
+                    # only catches near-simultaneous committers
+                    self._cv.wait(self.window_s)
+                batch = self._pending[:self.max_batch]
+                del self._pending[:len(batch)]
+            self._commit_batch(batch)
+
+    def _commit_batch(self, batch: List[_CommitWaiter]) -> None:
+        from ..utils.faults import injector as _faults
+        from ..utils.metrics import registry
+        store = self._store
+        target = max(w.offset for w in batch)
+        n = len(batch)
+        err: Optional[BaseException] = None
+        fsync_s = ack_s = 0.0
+        if store._journal_fsync:
+            t0 = time.perf_counter()
+            try:
+                _faults.fire(
+                    "store.journal.fsync",
+                    lambda: OSError("injected journal fsync failure"))
+                with store._lock:
+                    f = store._journal_file
+                if f is None:
+                    # the store CLOSED under the stage (close() drains
+                    # the committer first, so this only happens when
+                    # that join timed out): no checkpoint covered the
+                    # batch — the honest outcome is the ambiguous one,
+                    # never a silently-skipped fsync reported committed
+                    raise RuntimeError("journal closed mid-batch")
+                os.fsync(f.fileno())
+            except ValueError:
+                # checkpoint() closed/swapped the journal between this
+                # batch's writes and the fsync (a plain close() drains
+                # this stage before touching the file): the atomic
+                # snapshot — written under the store lock AFTER these
+                # records installed, with its own fsync discipline —
+                # durably covers every one, so the batch is confirmed
+                pass
+            except Exception as e:
+                err = ReplicationIndeterminate(
+                    "group-commit fsync failed; the batch is flushed to "
+                    f"the OS but unconfirmed on disk: {e}")
+            fsync_s = time.perf_counter() - t0
+        srv = store._repl_server
+        if err is None and srv is not None and store._repl_sync:
+            t0 = time.perf_counter()
+            acked = False
+            try:
+                _faults.fire(
+                    "repl.ack",
+                    lambda: ReplicationIndeterminate(
+                        "injected replication ack loss"))
+                acked = srv.wait_acked(target, store._repl_timeout_s)
+            except ReplicationIndeterminate as e:
+                err = e
+            ack_s = time.perf_counter() - t0
+            if err is None:
+                if not acked and store._commit_offset < target:
+                    # a checkpoint() interleaved between this batch's
+                    # writes and the ack wait: the journal offset space
+                    # re-based (followers full-resync from the new
+                    # snapshot, which — written under the store lock
+                    # AFTER these writes installed — covers every
+                    # record), so the old-space target is unreachable
+                    # by construction, not unconfirmed.  Same reasoning
+                    # as the fsync half's closed-file case.
+                    acked = True
+                if not acked:
+                    err = ReplicationIndeterminate(
+                        "followers did not ack within "
+                        f"{store._repl_timeout_s}s; the batch is in the "
+                        "local journal and MAY be mirrored — it stands "
+                        "if this leader survives and resolves at the "
+                        "next failover replay otherwise")
+                elif (store._repl_min_followers > 0
+                      and srv.synced_follower_count
+                      < store._repl_min_followers):
+                    # same post-wait quorum recheck as the inline path
+                    err = ReplicationIndeterminate(
+                        "follower lost during ack wait; quorum below "
+                        f"{store._repl_min_followers} — the batch is "
+                        "journaled locally and may be mirrored")
+        registry.observe("cook_group_commit_batch_size", float(n),
+                         buckets=_GC_BATCH_BUCKETS)
+        self.batches += 1
+        self.max_batch_seen = max(self.max_batch_seen, n)
+        if err is None:
+            self.commits += n
+        else:
+            self.indeterminate += n
+        for w in batch:
+            w.batch_size = n
+            w.fsync_s = fsync_s
+            w.ack_s = ack_s
+            w.error = err
+            w.done.set()
+
+
 class Store:
     """Thread-safe entity store. All mutation goes through :meth:`transact`."""
 
@@ -317,6 +530,15 @@ class Store:
         self._repl_sync = False
         self._repl_timeout_s = 5.0
         self._repl_min_followers = 0
+        # byte offset of the journal end after the most recent committed
+        # record — the leader's commit position, returned on REST write
+        # responses (X-Cook-Commit-Offset) so clients can demand
+        # read-your-writes from the follower fleet
+        self._commit_offset = 0
+        # group-commit admission batching (docs/PERFORMANCE.md): when
+        # enabled, concurrent transactions' fsync + replication ack
+        # rounds are amortized by a single committer thread
+        self._group_commit: Optional[_GroupCommitStage] = None
         # True when the journal DIRECTORY is shared between leader hosts
         # (r4 topology: fencing protects concurrent appenders).  False for
         # a local fenced journal in the replication topology, where a
@@ -349,8 +571,16 @@ class Store:
         NOT roll back: the record is already durable in the local journal
         (and possibly on a mirror), so the writes install locally and the
         exception re-raises for the caller to report the ambiguous
-        outcome (docs/DEPLOY.md indeterminate-commit contract)."""
+        outcome (docs/DEPLOY.md indeterminate-commit contract).
+
+        Under group commit the record is written+flushed (and the writes
+        installed) inside the lock as always, but the fsync/replication-
+        ack round resolves on the shared committer AFTER the lock is
+        released — this thread blocks on its waiter and re-raises the
+        demuxed outcome, so callers observe the same contract with the
+        expensive tail amortized across concurrent committers."""
         indeterminate: Optional[ReplicationIndeterminate] = None
+        waiter: Optional[_CommitWaiter] = None
         with self._lock:
             if self._journal_poisoned:
                 raise RuntimeError(
@@ -368,7 +598,7 @@ class Store:
                     txn._writes or txn._deletes or txn.latch_registrations
                     or txn.latch_pops):
                 try:
-                    self._journal_append(txn)
+                    waiter = self._journal_append(txn)
                 except ReplicationIndeterminate as e:
                     indeterminate = e  # locally durable: install below
             for (table, key), ent in txn._writes.items():
@@ -382,13 +612,36 @@ class Store:
             if txn.events:
                 self._event_queue.append((self._tx_id, txn.events))
         self._drain_events()
+        if waiter is not None:
+            err = waiter.stage.wait(waiter)
+            # attribute the SHARED round's cost into this request's own
+            # trace/phase breakdown (rest/instrument.py PHASE_SPANS):
+            # the committer measured it once; every waiter reports it
+            if tracing.tracer.io_spans \
+                    and tracing.tracer.current() is not None:
+                if waiter.fsync_s:
+                    tracing.tracer.record_finished(
+                        "journal.fsync", waiter.fsync_s,
+                        batch=waiter.batch_size, offset=waiter.offset)
+                if waiter.ack_s:
+                    tracing.tracer.record_finished(
+                        "repl.ack_wait", waiter.ack_s,
+                        batch=waiter.batch_size, offset=waiter.offset)
+            if err is not None and indeterminate is None:
+                indeterminate = err if isinstance(
+                    err, ReplicationIndeterminate) \
+                    else ReplicationIndeterminate(str(err))
         if indeterminate is not None:
             raise indeterminate
         return result
 
     def _journal_append(self, txn: _Txn) -> None:
         """Append one committed transaction to the redo journal (caller holds
-        the store lock, so records are in commit order).
+        the store lock, so records are in commit order).  Returns a
+        :class:`_CommitWaiter` when the durability tail (fsync +
+        replication ack) was handed to the group-commit stage — transact
+        blocks on it outside the lock — and None when it completed
+        inline.
 
         On a failed append the torn fragment is truncated away so later
         appends stay parseable; if even the truncate fails the journal is
@@ -456,21 +709,32 @@ class Store:
         # background status txns stay span-free.  tracer.io_spans is the
         # rest_plane bench's A/B gate for exactly this instrumentation.
         _io = tracing.tracer.io_spans and tracing.tracer.current() is not None
+        # group commit engages only when there is a durability tail to
+        # amortize (an fsync or a sync replication ack); otherwise the
+        # inline path below already ends at the flush
+        _gc = self._group_commit if (
+            self._group_commit is not None
+            and (self._journal_fsync
+                 or (self._repl_server is not None and self._repl_sync))
+        ) else None
         line = json.dumps(rec) + "\n"
+        waiter: Optional[_CommitWaiter] = None
         try:
             with (tracing.span("journal.append", bytes=len(line),
-                               fsync=self._journal_fsync or None)
+                               fsync=(self._journal_fsync and _gc is None)
+                               or None)
                   if _io else nullcontext()):
                 _faults.fire(
                     "store.journal.append",
                     lambda: OSError("injected journal write failure"))
                 f.write(line)
                 f.flush()
-                if self._journal_fsync:
+                if self._journal_fsync and _gc is None:
                     _faults.fire(
                         "store.journal.fsync",
                         lambda: OSError("injected journal fsync failure"))
                     os.fsync(f.fileno())
+            self._commit_offset = f.tell()
             if self._repl_server is not None:
                 # From here on the record is durable locally and visible
                 # to followers: an unconfirmed ack is a first-class
@@ -478,38 +742,45 @@ class Store:
                 # record (the pre-PR behavior) could resurrect it as a
                 # phantom commit on a mirror that fsynced it before a
                 # failover (ADVICE r5) — "aborted" must imply "nowhere".
+                # Poked inline even under group commit: followers start
+                # pulling while the batch coalesces.
                 self._repl_server.poke()
-                if self._repl_sync:
-                    with (tracing.span(
-                            "repl.ack_wait", offset=f.tell(),
-                            timeout_s=self._repl_timeout_s)
-                          if _io else nullcontext()):
-                        _faults.fire(
-                            "repl.ack",
-                            lambda: ReplicationIndeterminate(
-                                "injected replication ack loss"))
-                        acked = self._repl_server.wait_acked(
-                            f.tell(), self._repl_timeout_s)
-                    if not acked:
-                        raise ReplicationIndeterminate(
-                            "followers did not ack within "
-                            f"{self._repl_timeout_s}s; the record is in "
-                            "the local journal and MAY be mirrored — "
-                            "the commit stands if this leader survives "
-                            "and resolves at the next failover replay "
-                            "otherwise")
-                    if (self._repl_min_followers > 0 and
-                            self._repl_server.synced_follower_count
-                            < self._repl_min_followers):
-                        # re-check AFTER the wait: a follower dying
-                        # between the gate and the ack makes wait_acked
-                        # pass vacuously (empty quorum) — that must not
-                        # count as a confirmed CP commit
-                        raise ReplicationIndeterminate(
-                            "follower lost during ack wait; quorum "
-                            f"below {self._repl_min_followers} — the "
-                            "record is journaled locally and may be "
-                            "mirrored")
+            if _gc is not None:
+                # the durability tail (fsync + ack) resolves on the
+                # shared committer; transact blocks on the waiter AFTER
+                # releasing the store lock and demuxes the outcome
+                waiter = _gc.enqueue(self._commit_offset)
+            elif self._repl_server is not None and self._repl_sync:
+                with (tracing.span(
+                        "repl.ack_wait", offset=f.tell(),
+                        timeout_s=self._repl_timeout_s)
+                      if _io else nullcontext()):
+                    _faults.fire(
+                        "repl.ack",
+                        lambda: ReplicationIndeterminate(
+                            "injected replication ack loss"))
+                    acked = self._repl_server.wait_acked(
+                        f.tell(), self._repl_timeout_s)
+                if not acked:
+                    raise ReplicationIndeterminate(
+                        "followers did not ack within "
+                        f"{self._repl_timeout_s}s; the record is in "
+                        "the local journal and MAY be mirrored — "
+                        "the commit stands if this leader survives "
+                        "and resolves at the next failover replay "
+                        "otherwise")
+                if (self._repl_min_followers > 0 and
+                        self._repl_server.synced_follower_count
+                        < self._repl_min_followers):
+                    # re-check AFTER the wait: a follower dying
+                    # between the gate and the ack makes wait_acked
+                    # pass vacuously (empty quorum) — that must not
+                    # count as a confirmed CP commit
+                    raise ReplicationIndeterminate(
+                        "follower lost during ack wait; quorum "
+                        f"below {self._repl_min_followers} — the "
+                        "record is journaled locally and may be "
+                        "mirrored")
         except ReplicationIndeterminate:
             raise  # durable locally: transact installs, caller reports
         except Exception:
@@ -535,6 +806,56 @@ class Store:
                 except Exception:
                     pass
             raise
+        return waiter
+
+    def enable_group_commit(self, window_ms: float = 0.5,
+                            max_batch: int = 256) -> bool:
+        """Arm the group-commit stage (docs/PERFORMANCE.md): concurrent
+        write transactions share one journal fsync + one replication ack
+        round, with per-request outcomes demultiplexed.  Returns False
+        (a no-op) on a store without an attached journal — there is no
+        durability tail to amortize.  Idempotent."""
+        with self._lock:
+            if self._group_commit is not None:
+                return True
+            if self._journal_file is None:
+                return False
+            self._group_commit = _GroupCommitStage(
+                self, window_ms=window_ms, max_batch=max_batch)
+        return True
+
+    def disable_group_commit(self) -> None:
+        """Drain and stop the committer; later transactions go back to
+        inline fsync/ack."""
+        with self._lock:
+            gc, self._group_commit = self._group_commit, None
+        if gc is not None:
+            gc.stop()
+
+    def group_commit_stats(self) -> Optional[Dict[str, Any]]:
+        """Committer counters for /debug/replication and the monitor
+        sweep (None when group commit is off)."""
+        gc = self._group_commit
+        return gc.stats() if gc is not None else None
+
+    def commit_offset(self) -> int:
+        """Journal byte offset after the most recently committed record.
+        0 on journal-less stores."""
+        return self._commit_offset
+
+    def commit_token(self) -> str:
+        """The read-your-writes token leader write responses carry
+        (X-Cook-Commit-Offset; docs/DEPLOY.md): ``<epoch>:<offset>`` on
+        epoch-fenced journals, bare ``<offset>`` otherwise.  The epoch
+        qualifies the OFFSET SPACE — a follower still mirroring a
+        previous leadership must not satisfy a new-space token just
+        because its old-space byte count is numerically larger (every
+        leadership change mints a higher epoch, and a determinate
+        commit survives into every later epoch's journal by the no-loss
+        guarantee)."""
+        if self._journal_epoch is not None:
+            return f"{self._journal_epoch}:{self._commit_offset}"
+        return str(self._commit_offset)
 
     def flush_audit(self) -> int:
         """Journal the audit trail's pending ADVISORY events (ranked
@@ -609,6 +930,7 @@ class Store:
                 except Exception:
                     pass
             return False
+        self._commit_offset = f.tell()
         if self._repl_server is not None:
             # audit records mirror like any journal bytes, but are
             # never waited on — audit must not add commit latency
@@ -1397,6 +1719,11 @@ class Store:
             self._journal_path = path
             self._journal_fsync = fsync
             self._journal_file = open(path, "a", encoding="utf-8")
+            try:
+                self._commit_offset = max(self._commit_offset,
+                                          os.path.getsize(path))
+            except OSError:
+                pass
 
     def attach_replication(self, server, sync: bool = True,
                            timeout_s: float = 5.0,
@@ -1480,16 +1807,21 @@ class Store:
         store._journal_file.flush()
         if fsync:
             os.fsync(store._journal_file.fileno())
+        store._commit_offset = store._journal_file.tell()
         records, _good, _size = _scan_journal(journal_path)
         store._replay_records(records)
         return store
 
-    def _replay_records(self, records: List[Dict[str, Any]]) -> None:
+    def _replay_records(self, records: List[Dict[str, Any]],
+                        max_ep: int = 0) -> int:
         """Apply scanned journal records with epoch-fence skipping: a
         record with a lower epoch than one already seen was appended by a
         deposed leader after its successor fenced — never committed from
-        the cluster's point of view."""
-        max_ep = 0
+        the cluster's point of view.  ``max_ep`` seeds (and the return
+        value carries) the epoch high-water mark so an INCREMENTAL
+        replayer — the follower read view's apply loop
+        (state/read_replica.py) — shares this exact skip rule across
+        calls instead of re-implementing it."""
         for rec in records:
             ep = rec.get("ep")
             if ep is not None and ep < max_ep:
@@ -1498,6 +1830,7 @@ class Store:
                 max_ep = ep
             if not rec.get("barrier"):
                 self._apply_journal_record(rec)
+        return max_ep
 
     @classmethod
     def replay_only(cls, directory: str) -> "Store":
@@ -1556,6 +1889,10 @@ class Store:
             self._journal_file.close()
             self._journal_file = open(self._journal_path, "w",
                                       encoding="utf-8")
+            # the commit position re-bases with the compacted journal
+            # (followers full-resync on the new mirror token; a stale
+            # read-your-writes token just redirects to the leader)
+            self._commit_offset = 0
             if self.audit.enabled and self.audit.journal:
                 # the snapshot carries no audit lane — re-seed the
                 # compacted journal with the (bounded) current trail so
@@ -1574,6 +1911,7 @@ class Store:
                     self._write_audit_record_locked(docs)
 
     def close(self) -> None:
+        self.disable_group_commit()  # drain waiters before the fd goes
         with self._lock:
             if self._journal_file is not None:
                 self._journal_file.close()

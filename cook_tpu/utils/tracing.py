@@ -207,6 +207,28 @@ class Tracer:
             if len(self.finished) > _MAX_FINISHED:
                 del self.finished[:_MAX_FINISHED // 2]
 
+    def record_finished(self, name: str, duration_s: float,
+                        **tags: Any) -> None:
+        """Record an already-measured span under the CURRENT context —
+        for costs incurred on a shared worker thread and attributed back
+        to each awaiting request (the group committer's batched journal
+        fsync / replication ack wait, state/store.py): the waiter calls
+        this from its own request context once its batch resolves, so
+        the shared round lands in the request's span tree, phase
+        breakdown, and RED phase metrics like an inline span would."""
+        if not self.enabled:
+            return
+        tags = {k: v for k, v in tags.items() if v is not None}
+        parent = self.current()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = uuid.uuid4().hex[:16], None
+        sp = Span(name, trace_id, parent_id, tags)
+        sp.start_s = time.time() - max(duration_s, 0.0)
+        sp.duration_s = max(duration_s, 0.0)
+        self._record(sp)
+
     def recent(self, limit: int = 100,
                name: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:

@@ -469,9 +469,41 @@ def test_daemon_replicated_failover_end_to_end(tmp_path):
         client_a = JobClient(a.node_url, user="alice")
         uuids = client_a.submit([{"command": "sleep 999", "cpus": 1,
                                   "mem": 64} for _ in range(3)])
+        # leader writes return the commit position (the read-your-writes
+        # token the follower fleet honors)
+        assert client_a.last_commit_offset
         panel = client_a.debug_replication()
         assert panel["role"] == "leader" and panel["epoch"] == 1
         assert panel["synced_followers"] >= 1
+        # group commit is armed on the promoted leader by default
+        assert panel.get("group_commit") is not None
+        # ---- the standby's READ FLEET serves GETs locally ------------
+        assert b.read_view is not None
+        # the REST layer serves the VIEW's store (the initial on_swap
+        # must land even if the mirror never re-bases again — a dropped
+        # swap would freeze api.store at the boot-time replay)
+        assert b.api.store is b.read_view.store
+        assert wait_for(lambda: b.read_view.offset
+                        >= a.store.commit_offset())
+        import http.client as _hc
+        conn = _hc.HTTPConnection(
+            b.node_url.replace("http://", ""), timeout=10)
+        conn.request("GET", f"/jobs/{uuids[0]}",
+                     headers={"X-Cook-User": "alice"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200, "standby redirected instead of serving"
+        assert resp.getheader("X-Cook-Replication-Offset") is not None
+        assert resp.getheader("X-Cook-Replication-Age-Ms") is not None
+        assert body["uuid"] == uuids[0]
+        assert b.api.follower_reads >= 1
+        # read-your-writes THROUGH the standby: the min-offset token is
+        # satisfied by the synced mirror (no redirect needed)
+        reader = JobClient(b.node_url, user="alice")
+        reader.last_commit_offset = client_a.last_commit_offset
+        got = {j["uuid"] for j in reader.query(uuids)}
+        assert got == set(uuids)
         # ---- handoff: A dies; B must promote with every job ----------
         a.shutdown()
         assert wait_for(lambda: b.scheduler is not None, timeout=30), \
@@ -481,8 +513,27 @@ def test_daemon_replicated_failover_end_to_end(tmp_path):
         assert got == set(uuids), "committed jobs lost in failover"
         panel = client_b.debug_replication()
         assert panel["role"] == "leader" and panel["epoch"] == 2
+        # promotion retired the read view: B serves as the authority now
+        assert b.read_view is None and b.api.read_view is None
         # the promoted store fences against the SHARED election epoch
         assert str(b.store._epoch_path) == str(a.elector.epoch_path)
+        # ---- the promoted leader's followers re-sync and serve -------
+        c = CookDaemon(conf("c"), api_only=True)
+        try:
+            c.start()
+            assert wait_for(lambda: b.repl_server is not None
+                            and b.repl_server.synced_follower_count >= 1
+                            ), "new standby never synced to the winner"
+            assert c.read_view is not None
+            assert wait_for(lambda: c.read_view.offset
+                            >= b.store.commit_offset())
+            reader_c = JobClient(c.node_url, user="alice")
+            reader_c.last_commit_offset = client_b.last_commit_offset
+            got = {j["uuid"] for j in reader_c.query(uuids)}
+            assert got == set(uuids), \
+                "re-synced follower does not serve the winner's state"
+        finally:
+            c.shutdown()
     finally:
         if b is not None:
             b.shutdown()

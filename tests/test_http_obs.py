@@ -7,6 +7,7 @@ and the /debug/health roll-up.
 
 import gzip
 import json
+import time
 import urllib.request
 import uuid as uuidlib
 
@@ -295,9 +296,15 @@ class TestRequestId:
         assert err.value.status == 404
         assert err.value.request_id
         # the ring's record carries the same id — a pasted error report
-        # joins to the capture ring
-        ids = {r["request_id"] for r
-               in instrument.request_log.snapshot()["recent"]}
+        # joins to the capture ring.  The keep-alive client can observe
+        # the response a hair before the server's finally-block records
+        # it, so poll briefly.
+        deadline = time.time() + 2.0
+        ids: set = set()
+        while err.value.request_id not in ids and time.time() < deadline:
+            ids = {r["request_id"] for r
+                   in instrument.request_log.snapshot()["recent"]}
+            time.sleep(0.01)
         assert err.value.request_id in ids
 
 

@@ -1,0 +1,607 @@
+"""Serving-plane scale-out: the follower read fleet (live journal-applied
+read replicas with the bounded-staleness / read-your-writes contract) and
+the leader's group-commit admission batching.
+
+Layered like test_failover.py:
+
+- group commit at the store layer (stub replication, no native lib);
+- the FollowerReadView apply loop over plain directories (no sockets);
+- the REST serving contract (staleness headers, min-offset waits and
+  redirects, fenced-token refusal) over stub wiring;
+- end-to-end over REAL socket replication behind the native marker.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.state import replication as repl
+from cook_tpu.state.read_replica import FollowerReadView
+from cook_tpu.state.schema import Job, Resources
+from cook_tpu.state.store import (
+    ReplicationIndeterminate,
+    ReplicationTimeout,
+    Store,
+)
+
+
+def make_job(i, user="alice"):
+    return Job(uuid=f"00000000-0000-0000-0000-{i:012d}", user=user,
+               command=f"echo {i}", resources=Resources(cpus=1, mem=64))
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+class _StubRepl:
+    """attach_replication target with scriptable acks (test_failover)."""
+
+    def __init__(self, acks=(), synced=1):
+        self.acks = list(acks)
+        self.synced = synced
+        self.directory = ""
+        self.port = 0
+        self.pokes = 0
+
+    def poke(self):
+        self.pokes += 1
+
+    def wait_acked(self, offset, timeout_s=0.0):
+        return self.acks.pop(0) if self.acks else True
+
+    @property
+    def synced_follower_count(self):
+        return self.synced
+
+    def min_acked(self):
+        return -1
+
+    def status(self):
+        return []
+
+
+# --------------------------------------------------------------------------
+# Group commit at the store layer
+# --------------------------------------------------------------------------
+
+class TestGroupCommit:
+    def test_concurrent_commits_share_durability_rounds(self, tmp_path):
+        store = Store.open(str(tmp_path / "d"), fsync=True)
+        assert store.enable_group_commit(window_ms=5.0)
+        errs = []
+
+        def submit(i):
+            try:
+                store.create_jobs([make_job(i)])
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(e)
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        stats = store.group_commit_stats()
+        assert stats["commits"] == 12
+        assert stats["batches"] < 12, stats  # amortization happened
+        assert stats["max_batch"] >= 2
+        store.close()
+        # every batched commit is a real journaled commit
+        replayed = Store.replay_only(str(tmp_path / "d"))
+        assert len(replayed.jobs_where(lambda j: True)) == 12
+
+    def test_batch_ack_loss_demuxes_indeterminate_to_every_waiter(
+            self, tmp_path):
+        store = Store.open(str(tmp_path / "d"))
+        store.attach_replication(_StubRepl(acks=[False, False, False]),
+                                 sync=True, timeout_s=0.01)
+        store.enable_group_commit(window_ms=5.0)
+        outcomes = []
+
+        def submit(i):
+            try:
+                store.create_jobs([make_job(i)])
+                outcomes.append("committed")
+            except ReplicationIndeterminate:
+                outcomes.append("indeterminate")
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert outcomes == ["indeterminate"] * 4
+        # applied locally — the PR 3 contract holds through the demux
+        assert store.job(make_job(0).uuid) is not None
+        store.close()
+
+    def test_quorum_gate_still_aborts_cleanly_under_group_commit(
+            self, tmp_path):
+        store = Store.open(str(tmp_path / "d"))
+        store.attach_replication(_StubRepl(synced=0), sync=True,
+                                 timeout_s=0.01, min_followers=1)
+        store.enable_group_commit(window_ms=1.0)
+        with pytest.raises(ReplicationTimeout):
+            store.create_jobs([make_job(1)])
+        # the CP gate fires BEFORE the write: nothing installed anywhere
+        assert store.job(make_job(1).uuid) is None
+        store.close()
+        assert Store.replay_only(str(tmp_path / "d")).job(
+            make_job(1).uuid) is None
+
+    def test_fsync_fault_is_indeterminate_for_the_batch(self, tmp_path):
+        from cook_tpu.utils.faults import injector
+        store = Store.open(str(tmp_path / "d"), fsync=True)
+        store.enable_group_commit(window_ms=1.0)
+        injector.arm("store.journal.fsync", probability=1.0, max_fires=1)
+        try:
+            with pytest.raises(ReplicationIndeterminate):
+                store.create_jobs([make_job(1)])
+        finally:
+            injector.disarm("store.journal.fsync")
+        # flushed + installed: replay keeps it (never excised — later
+        # transactions may already have built on it)
+        assert store.job(make_job(1).uuid) is not None
+        store.close()
+        assert Store.replay_only(str(tmp_path / "d")).job(
+            make_job(1).uuid) is not None
+
+    def test_noop_without_journal_and_commit_offset_tracking(
+            self, tmp_path):
+        assert Store().enable_group_commit() is False
+        store = Store.open(str(tmp_path / "d"))
+        assert store.commit_offset() == 0
+        store.create_jobs([make_job(1)])
+        off1 = store.commit_offset()
+        assert off1 > 0
+        store.create_jobs([make_job(2)])
+        assert store.commit_offset() > off1
+        store.close()
+
+
+# --------------------------------------------------------------------------
+# FollowerReadView apply loop (plain directories — the mirror is just a
+# journal the leader's store happens to write locally)
+# --------------------------------------------------------------------------
+
+class TestFollowerReadView:
+    def test_incremental_apply_and_staleness(self, tmp_path):
+        d = str(tmp_path / "m")
+        leader = Store.open(d)
+        leader.create_jobs([make_job(1)])
+        view = FollowerReadView(d, start=False)
+        assert view.store.job(make_job(1).uuid) is not None
+        assert view.rebuilds == 1
+        # incremental: new records apply through the replay path without
+        # a rebuild
+        leader.create_jobs([make_job(2)])
+        applied = view.poll()
+        assert applied == 1 and view.rebuilds == 1
+        assert view.store.job(make_job(2).uuid) is not None
+        assert view.offset == leader.commit_offset()
+        assert view.lag_bytes() == 0
+        leader.close()
+
+    def test_rebase_detection_rebuilds_and_swaps(self, tmp_path):
+        d = str(tmp_path / "m")
+        leader = Store.open(d)
+        leader.create_jobs([make_job(1)])
+        swaps = []
+        view = FollowerReadView(d, start=False, on_swap=swaps.append)
+        assert len(swaps) == 1
+        # leader checkpoint = snapshot + truncated journal: the byte
+        # space re-based, incremental offsets are meaningless
+        leader.create_jobs([make_job(2)])
+        leader.checkpoint()
+        view.poll()
+        assert view.rebuilds == 2
+        assert len(swaps) == 2
+        assert swaps[-1] is view.store
+        assert view.store.job(make_job(2).uuid) is not None
+        leader.close()
+
+    def test_wait_offset_read_your_writes_gate(self, tmp_path):
+        d = str(tmp_path / "m")
+        leader = Store.open(d)
+        view = FollowerReadView(d, interval_s=0.005)
+        try:
+            leader.create_jobs([make_job(1)])
+            want = leader.commit_offset()
+            assert view.wait_offset(want, timeout_s=5.0)
+            assert view.store.job(make_job(1).uuid) is not None
+            # an offset beyond anything mirrored times out honestly
+            assert not view.wait_offset(want + 10_000, timeout_s=0.05)
+        finally:
+            view.stop()
+            leader.close()
+
+    def test_epoch_fence_skipping_matches_replay(self, tmp_path):
+        """A deposed leader's lower-epoch records interleaved after a
+        higher epoch are skipped by the view exactly as Store.replay
+        would skip them."""
+        d = tmp_path / "m"
+        d.mkdir()
+        journal = d / "journal.jsonl"
+        # build two real records via a scratch store for valid wire form
+        scratch = Store.open(str(tmp_path / "scratch"))
+        scratch.create_jobs([make_job(1)])
+        scratch.create_jobs([make_job(2)])
+        scratch.close()
+        lines = (tmp_path / "scratch" /
+                 "journal.jsonl").read_text().splitlines()
+        rec_a, rec_b = json.loads(lines[0]), json.loads(lines[1])
+        rec_a["ep"] = 2
+        rec_b["ep"] = 1  # deposed leader's late append
+        journal.write_text(json.dumps(rec_a) + "\n"
+                           + json.dumps(rec_b) + "\n")
+        view = FollowerReadView(str(d), start=False)
+        assert view.store.job(make_job(1).uuid) is not None
+        assert view.store.job(make_job(2).uuid) is None
+
+
+# --------------------------------------------------------------------------
+# REST serving contract over stub wiring
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def follower_rest(tmp_path):
+    """A 'leader' journaled store + a follower REST node whose read view
+    tails the same directory (stub topology: what matters is the serving
+    contract, not the socket)."""
+    from cook_tpu.rest.api import ApiServer, CookApi
+
+    d = str(tmp_path / "m")
+    leader_store = Store.open(d)
+    leader_api = CookApi(leader_store)
+    leader = ApiServer(leader_api)
+    leader.start()
+
+    view = FollowerReadView(d, interval_s=0.005)
+
+    class StubElector:
+        def leader_url(self):
+            return leader.url
+
+    api = CookApi(view.store, elector=StubElector(),
+                  node_url="http://follower-node")
+    api.read_view = view
+    view.on_swap(lambda s: setattr(api, "store", s))
+    server = ApiServer(api)
+    server.start()
+    yield leader_store, leader, view, api, server
+    server.stop()
+    leader.stop()
+    view.stop()
+    leader_store.close()
+
+
+class TestFollowerRest:
+    def _get(self, url, headers=None, redirect=False):
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener() if redirect else \
+            urllib.request.build_opener(NoRedirect)
+        req = urllib.request.Request(
+            url, headers={"X-Cook-User": "alice", **(headers or {})})
+        return opener.open(req, timeout=10)
+
+    def test_follower_serves_reads_with_staleness_headers(
+            self, follower_rest):
+        leader_store, _leader, view, api, server = follower_rest
+        leader_store.create_jobs([make_job(1)])
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        resp = self._get(server.url + f"/jobs/{make_job(1).uuid}")
+        assert resp.status == 200
+        assert int(resp.headers["X-Cook-Replication-Offset"]) \
+            == view.offset
+        assert float(resp.headers["X-Cook-Replication-Age-Ms"]) >= 0
+        assert json.load(resp)["uuid"] == make_job(1).uuid
+        assert api.follower_reads == 1
+        # the timeline surface serves from the replicated audit lane
+        resp = self._get(server.url
+                         + f"/debug/job/{make_job(1).uuid}/timeline")
+        kinds = [e["kind"] for e in json.load(resp)["timeline"]]
+        assert "submitted" in kinds
+
+    def test_writes_still_redirect_to_leader(self, follower_rest):
+        _store, leader, _view, _api, server = follower_rest
+        import urllib.error
+        req = urllib.request.Request(
+            server.url + "/jobs", method="POST",
+            data=json.dumps({"jobs": [{"command": "x"}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Cook-User": "alice"})
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            opener.open(req, timeout=10)
+        assert e.value.code == 307
+        assert e.value.headers["Location"].startswith(leader.url)
+
+    def test_min_offset_satisfied_after_wait(self, follower_rest):
+        leader_store, _leader, view, _api, server = follower_rest
+        leader_store.create_jobs([make_job(5)])
+        want = leader_store.commit_offset()
+        # the apply loop races this request: the server-side wait gate
+        # must hold the read until the view catches up
+        resp = self._get(server.url + f"/jobs/{make_job(5).uuid}",
+                         headers={"X-Cook-Min-Offset": str(want)})
+        assert resp.status == 200
+        assert int(resp.headers["X-Cook-Replication-Offset"]) >= want
+
+    def test_min_offset_beyond_mirror_redirects_to_leader(
+            self, follower_rest):
+        leader_store, leader, _view, api, server = follower_rest
+        api.config.serving.min_offset_wait_seconds = 0.05
+        leader_store.create_jobs([make_job(6)])
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(server.url + f"/jobs/{make_job(6).uuid}",
+                      headers={"X-Cook-Min-Offset": str(10 ** 12)})
+        assert e.value.code == 307
+        assert e.value.headers["Location"].startswith(leader.url)
+
+    def test_client_reads_its_own_writes_through_the_fleet(
+            self, follower_rest):
+        from cook_tpu.client import JobClient
+        _store, leader, view, _api, server = follower_rest
+        writer = JobClient(leader.url, user="alice")
+        uuids = writer.submit([{"command": "x"}])
+        assert writer.last_commit_offset  # X-Cook-Commit-Offset landed
+        reader = JobClient(server.url, user="alice")
+        reader.last_commit_offset = writer.last_commit_offset
+        [job] = reader.query(uuids)
+        assert job["uuid"] == uuids[0]
+        # served by the follower (staleness headers present) once caught
+        # up, or by the leader after the redirect — either way the read
+        # observed the write.  The token is opaque "<epoch>:<offset>" or
+        # bare "<offset>" (this stub leader has no epoch).
+        token_off = int(writer.last_commit_offset.split(":")[-1])
+        if reader.last_replication_offset is not None:
+            assert reader.last_replication_offset >= token_off
+
+    def test_follower_keeps_serving_stale_after_leader_death(
+            self, follower_rest):
+        leader_store, leader, view, _api, server = follower_rest
+        leader_store.create_jobs([make_job(7)])
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        leader.stop()  # the leader is gone; the view has no new bytes
+        time.sleep(0.05)
+        resp = self._get(server.url + f"/jobs/{make_job(7).uuid}")
+        assert resp.status == 200  # stale, honestly labeled
+        assert "X-Cook-Replication-Offset" in resp.headers
+
+    def test_follower_queue_approximation(self, follower_rest):
+        leader_store, _leader, view, _api, server = follower_rest
+        leader_store.create_jobs([make_job(8), make_job(9)])
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        resp = self._get(server.url + "/queue")
+        queues = json.load(resp)
+        assert {j["uuid"] for j in queues.get("default", [])} \
+            >= {make_job(8).uuid, make_job(9).uuid}
+
+    def test_debug_replication_serving_block(self, follower_rest):
+        leader_store, _leader, view, _api, server = follower_rest
+        leader_store.create_jobs([make_job(1)])
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        self._get(server.url + f"/jobs/{make_job(1).uuid}")
+        resp = self._get(server.url + "/debug/replication")
+        doc = json.load(resp)
+        assert doc["serving"]["reads_served"] >= 1
+        assert doc["serving"]["offset"] == view.offset
+        assert "lag_bytes" in doc["serving"]
+        assert "age_ms" in doc["serving"]
+
+
+class TestFencedReadToken:
+    def test_deposed_leader_refuses_reads_with_token(self, tmp_path):
+        """A fenced deposed leader cannot honor read-your-writes tokens
+        (the successor holds commits beyond its fence epoch): plain
+        reads stay served, token-bearing reads are refused/redirected."""
+        from cook_tpu.rest.api import ApiServer, CookApi
+        import urllib.error
+        store = Store.open(str(tmp_path / "d"))
+        store.create_jobs([make_job(1)])
+        api = CookApi(store)
+        api.fence_guard = lambda: True  # a successor minted a higher epoch
+        server = ApiServer(api)
+        server.start()
+        try:
+            # plain read: still answered (clients re-resolve the leader)
+            with urllib.request.urlopen(
+                    server.url + f"/jobs/{make_job(1).uuid}",
+                    timeout=10) as resp:
+                assert resp.status == 200
+            # token-bearing read: refused (no successor published)
+            req = urllib.request.Request(
+                server.url + f"/jobs/{make_job(1).uuid}",
+                headers={"X-Cook-Min-Offset": "1"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 503
+        finally:
+            server.stop()
+            store.close()
+
+
+class TestOffsetSpaceTokens:
+    def test_epoch_qualified_token_semantics(self, tmp_path):
+        """A token from a NEWER leadership is never satisfied by an
+        old-space mirror's numerically-larger byte count; a view that
+        applied a higher epoch covers any lower-epoch token."""
+        d = str(tmp_path / "m")
+        leader = Store.open(d)
+        leader.create_jobs([make_job(1)])
+        view = FollowerReadView(d, start=False)
+        # plain-offset token: ordinary compare
+        assert view._satisfies(None, view.offset)
+        assert not view._satisfies(None, view.offset + 1)
+        # un-epoched mirror (max_ep 0) must NOT satisfy an epoch-2
+        # token regardless of its byte count
+        assert not view._satisfies(2, 1)
+        assert not view.wait_token(2, 1, timeout_s=0.05)
+        # a view that applied epoch 3 covers any epoch-2 token
+        view._max_ep = 3
+        assert view._satisfies(2, 10 ** 12)
+        assert view._satisfies(3, view.offset)
+        assert not view._satisfies(3, view.offset + 1)
+        leader.close()
+
+    def test_commit_token_forms(self, tmp_path):
+        plain = Store.open(str(tmp_path / "p"))
+        plain.create_jobs([make_job(1)])
+        assert plain.commit_token() == str(plain.commit_offset())
+        plain.close()
+        fenced = Store.open(str(tmp_path / "f"), epoch=4, shared=False)
+        fenced.create_jobs([make_job(1)])
+        assert fenced.commit_token() == f"4:{fenced.commit_offset()}"
+        fenced.close()
+
+    def test_malformed_min_offset_is_400(self, follower_rest):
+        import urllib.error
+        _store, _leader, _view, _api, server = follower_rest
+        req = urllib.request.Request(
+            server.url + "/jobs?user=alice",
+            headers={"X-Cook-User": "alice",
+                     "X-Cook-Min-Offset": "not-a-token"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+
+
+class TestServingConfig:
+    def test_boot_validation(self):
+        from cook_tpu.config import ServingConfig
+        cfg = ServingConfig.from_conf({"group_commit_window_ms": 2,
+                                       "follower_reads": False})
+        assert cfg.group_commit_window_ms == 2.0
+        assert cfg.follower_reads is False
+        with pytest.raises(ValueError, match="unknown serving key"):
+            ServingConfig.from_conf({"folower_reads": True})
+        with pytest.raises(ValueError, match="JSON boolean"):
+            ServingConfig.from_conf({"group_commit": "true"})
+        with pytest.raises(ValueError, match="max_batch"):
+            ServingConfig.from_conf({"group_commit_max_batch": 0})
+
+    def test_daemon_scheduler_section_parses_serving(self):
+        from cook_tpu.daemon import build_scheduler_config
+        cfg = build_scheduler_config(
+            {"serving": {"group_commit_window_ms": 1.5}})
+        assert cfg.serving.group_commit_window_ms == 1.5
+        with pytest.raises(ValueError):
+            build_scheduler_config({"serving": {"nope": 1}})
+
+
+# --------------------------------------------------------------------------
+# Keep-alive connection reuse (the 4->8 reader regression satellite)
+# --------------------------------------------------------------------------
+
+class TestKeepAlive:
+    def test_jobclient_reuses_one_connection(self, tmp_path):
+        from cook_tpu.client import JobClient
+        from cook_tpu.rest.api import ApiServer, CookApi
+        store = Store.open(str(tmp_path / "d"))
+        server = ApiServer(CookApi(store))
+        server.start()
+        try:
+            client = JobClient(server.url, user="alice")
+            uuids = client.submit([{"command": "x"}])
+            for _ in range(3):
+                client.query(uuids)
+            import urllib.parse
+            netloc = urllib.parse.urlsplit(server.url).netloc
+            conn = client._pool.conns[("http", netloc)]
+            assert conn._cook_served == 4  # one socket served them all
+            client.close()
+            assert not client._pool.conns
+        finally:
+            server.stop()
+            store.close()
+
+    def test_stale_pooled_connection_retries_fresh(self, tmp_path):
+        from cook_tpu.client import JobClient
+        from cook_tpu.rest.api import ApiServer, CookApi
+        store = Store.open(str(tmp_path / "d"))
+        server = ApiServer(CookApi(store))
+        server.start()
+        try:
+            client = JobClient(server.url, user="alice")
+            uuids = client.submit([{"command": "x"}])
+            import urllib.parse
+            netloc = urllib.parse.urlsplit(server.url).netloc
+            # simulate the server idling out the keep-alive socket
+            client._pool.conns[("http", netloc)].sock.close()
+            [job] = client.query(uuids)  # retried on a fresh socket
+            assert job["uuid"] == uuids[0]
+        finally:
+            server.stop()
+            store.close()
+
+
+# --------------------------------------------------------------------------
+# End-to-end over real socket replication
+# --------------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(not repl.replication_available(),
+                                  reason="C++ toolchain unavailable")
+
+
+@needs_native
+def test_read_fleet_over_socket_replication(tmp_path):
+    """Leader + native follower: the mirrored bytes feed the read view
+    through the store's replay path; group commit serves the write side;
+    the follower answers queries including the replicated audit lane."""
+    root = str(tmp_path)
+    d_leader, d_f = os.path.join(root, "l"), os.path.join(root, "f")
+    store = Store.open(d_leader)
+    srv = repl.ReplicationServer(d_leader, 0)
+    store.attach_replication(srv, sync=True)
+    store.enable_group_commit(window_ms=2.0)
+    follower = repl.ReplicationFollower("127.0.0.1", srv.port, d_f)
+    view = None
+    try:
+        assert wait_for(lambda: srv.synced_follower_count >= 1)
+        view = FollowerReadView(d_f, interval_s=0.005)
+        threads = [threading.Thread(
+            target=lambda i=i: store.create_jobs([make_job(i)]))
+            for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert wait_for(lambda: view.offset >= store.commit_offset())
+        assert len(view.store.jobs_where(lambda j: True)) == 8
+        # the audit lane rode the mirrored journal bytes
+        assert any(e["kind"] == "submitted"
+                   for e in view.store.audit.timeline(make_job(3).uuid))
+        stats = store.group_commit_stats()
+        assert stats["commits"] == 8
+    finally:
+        if view is not None:
+            view.stop()
+        follower.stop()
+        srv.stop()
+        store.close()
