@@ -763,6 +763,26 @@ def cmd_ssh(args) -> int:
     os.execvp("ssh", command)  # pragma: no cover - replaces the process
 
 
+def cmd_lint(args) -> int:
+    """``cs lint [--json]`` — run the static analysis passes locally
+    (no server round-trip; the lint reads source, not state) with the
+    ``python -m cook_tpu.lint`` exit contract: 0 = clean tree, 1 = new
+    unsuppressed findings (docs/ANALYSIS.md)."""
+    from ..lint import main as lint_main
+    argv = []
+    if args.as_json:
+        argv.append("--json")
+    if args.root:
+        argv += ["--root", args.root]
+    if args.docs:
+        argv += ["--docs", args.docs]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.show_suppressed:
+        argv.append("--show-suppressed")
+    return lint_main(argv)
+
+
 def cmd_config(args) -> int:
     """Get/set dotted config keys in ~/.cs.json (reference:
     subcommands/config.py — ``cs config defaults.submit.command-prefix
@@ -984,6 +1004,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--out", dest="out_file",
                     help="write the trace JSON here instead of stdout")
     sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("lint", help="repo-native static analysis: lock "
+                                     "discipline, JIT hygiene, docs-"
+                                     "registry completeness "
+                                     "(docs/ANALYSIS.md); exits nonzero "
+                                     "on any unbaselined finding")
+    sp.add_argument("--json", action="store_true", dest="as_json")
+    sp.add_argument("--root", default=None)
+    sp.add_argument("--docs", default=None)
+    sp.add_argument("--baseline", default=None)
+    sp.add_argument("--show-suppressed", action="store_true")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("config")
     sp.add_argument("--set-url", dest="set_url")

@@ -1,0 +1,76 @@
+"""``python -m cook_tpu.lint`` — the repo-native static analysis CLI.
+
+Exit contract (wired into tier-1 via tests/test_analysis.py's self-lint
+golden): **0** when the tree has zero unsuppressed findings, **1** when
+any pass raises a new finding, a file fails to parse, or a baseline
+entry has gone stale — the same verdict the tier-1 golden renders.
+``cs lint`` is the same entry point through the main CLI.
+
+Usage::
+
+    python -m cook_tpu.lint [--json] [--root DIR] [--docs DIR]
+                            [--baseline FILE] [--show-suppressed]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .analysis import run_lint
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs lint",
+        description="repo-native static analysis: lock discipline, "
+                    "JIT hygiene, docs-registry completeness "
+                    "(docs/ANALYSIS.md)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable result document")
+    p.add_argument("--root", default=None,
+                   help="package root to scan (default: the cook_tpu "
+                        "package)")
+    p.add_argument("--docs", default=None,
+                   help="docs directory for the registry pass (default: "
+                        "<root>/../docs when present)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        "cook_tpu/analysis/baseline.json)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list baselined/pragma-suppressed findings")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    result = run_lint(
+        package_root=Path(args.root) if args.root else None,
+        docs_root=Path(args.docs) if args.docs else None,
+        baseline=Path(args.baseline) if args.baseline else None)
+    if args.as_json:
+        print(json.dumps(result.to_doc(), indent=2))
+        return 0 if result.ok else 1
+    for err in result.errors:
+        print(f"ERROR {err}")
+    for f in result.findings:
+        print(f"{f.path}:{f.line}: [{f.check}] {f.message}")
+        print(f"    fingerprint: {f.fingerprint}")
+    if args.show_suppressed:
+        for f in result.suppressed:
+            print(f"suppressed ({f.suppressed_by}) {f.path}:{f.line}: "
+                  f"[{f.check}] {f.detail}")
+    for fp in result.stale_baseline:
+        print(f"stale baseline entry (matches nothing — remove it): {fp}")
+    n, s = len(result.findings), len(result.suppressed)
+    print(f"{result.files_scanned} files scanned: {n} finding(s), "
+          f"{s} suppressed, {len(result.stale_baseline)} stale "
+          "baseline entr(ies)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

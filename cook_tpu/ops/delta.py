@@ -151,10 +151,11 @@ class DeviceBaseMirror:
         if self._append_fn is None:
             import jax
             from jax import lax
-            self._append_fn = jax.jit(
-                lambda b, c, o: lax.dynamic_update_slice(
-                    b, c, (o,) + (0,) * (c.ndim - 1)),
-                donate_argnums=0)
+            self._append_fn = telemetry.instrument_jit(
+                "delta.append", jax.jit(
+                    lambda b, c, o: lax.dynamic_update_slice(
+                        b, c, (o,) + (0,) * (c.ndim - 1)),
+                    donate_argnums=0))
         return self._append_fn(base, chunk, off)
 
     @property

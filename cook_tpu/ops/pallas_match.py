@@ -235,6 +235,18 @@ def _topk_structured_padded(job_res, valid_i8, exc_id, host_gpu_i8,
       avail_t, cap_t)
 
 
+# recompile telemetry per kernel (see ops/telemetry.py): the pallas
+# entry points count like every other jitted kernel, so a tile/shape
+# bucket churn shows up on cook_jit_compile_total instead of as a
+# silent on-chip p99 blip
+from . import telemetry as _telemetry  # noqa: E402
+
+_topk_prefs_padded = _telemetry.instrument_jit(
+    "pallas.topk_prefs", _topk_prefs_padded)
+_topk_structured_padded = _telemetry.instrument_jit(
+    "pallas.topk_structured", _topk_structured_padded)
+
+
 def topk_prefs_structured(job_res: jax.Array, valid: jax.Array,
                           host_gpu: jax.Array, host_blocked: jax.Array,
                           exc_id: jax.Array, exc_mask: jax.Array,

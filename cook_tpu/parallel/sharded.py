@@ -565,4 +565,7 @@ def make_pool_cycle(mesh, *, gpu_mode: bool = False,
             matched_usage=P(), total_matched=P(), queue_rows=spec,
             n_queue=spec, cand_row=spec, cand_assign=spec, cand_qpos=spec),
         **{_replication_kw: False})
-    return jax.jit(sharded)
+    # instrumented by the CALLER: sched/fused.py wraps make_pool_cycle's
+    # product as instrument_jit("fused.pool_cycle", ...) — wrapping here
+    # too would double-count every compile
+    return jax.jit(sharded)  # cs-lint: allow=jit-uninstrumented

@@ -1660,6 +1660,7 @@ class CookApi:
         """GET /debug/health — the one-shot operator roll-up `cs debug
         health` renders: every "is this cell healthy" signal that
         otherwise takes five /debug/* fetches (docs/OBSERVABILITY.md)."""
+        from ..utils.locks import monitor as lock_monitor
         from ..utils.metrics import registry
         from ..utils.retry import breakers
 
@@ -1686,14 +1687,20 @@ class CookApi:
             "audit": {k: v for k, v in self.store.audit.stats().items()
                       if k in ("jobs", "pending_durable")},
             "http": self.request_obs.snapshot(limit=0)["totals"],
+            # lock-order sanitizer (utils/locks.py, docs/ANALYSIS.md):
+            # the observed acquisition-graph edge set + violation counts
+            "locks": lock_monitor.snapshot(),
         }
         followers = repl.get("followers") or []
         if followers:
             health["replication"]["max_lag_bytes"] = max(
                 int(f.get("lag_bytes", 0)) for f in followers)
-        # burning past budget or a fenced store is not healthy
+        # burning past budget, a fenced store, or a potential-deadlock
+        # lock graph is not healthy
         if any(s["value"] > 1.0 for s in health["slo_burn_rates"]) \
-                or repl.get("fenced"):
+                or repl.get("fenced") \
+                or health["locks"]["violations"] \
+                or health["locks"]["blocking_events"]:
             health["healthy"] = False
         return health
 

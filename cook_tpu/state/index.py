@@ -33,6 +33,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..utils.locks import named_lock
 from .schema import (
     DISK_TYPE_LABEL,
     GPU_MODEL_LABEL,
@@ -146,7 +147,8 @@ class ColumnarIndex:
 
     def __init__(self, store):
         self.store = store
-        self._lock = threading.Lock()
+        # named for the lock-order sanitizer (utils/locks.py contract)
+        self._lock = named_lock("index")
         self._n = 0
         # bumped ONLY by _maybe_compact (row remap); consumers holding a
         # (compactions, rows_s) snapshot know base rows < their snapshot's

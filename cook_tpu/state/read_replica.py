@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.locks import named_lock
 from .store import Store, _scan_journal
 
 
@@ -66,7 +67,9 @@ class FollowerReadView:
             self._on_swap.append(on_swap)
         self._journal = os.path.join(self.directory, "journal.jsonl")
         self._stop = threading.Event()
-        self._mu = threading.Lock()
+        # ranks BELOW "store" (utils/locks.py): _rebuild holds _mu while
+        # replaying into the fresh store under that store's own lock
+        self._mu = named_lock("read_replica")
         # staleness bookkeeping
         self.applied_records = 0
         self.rebuilds = 0

@@ -32,6 +32,8 @@ import time
 from pathlib import Path
 from typing import Dict, Optional, Tuple
 
+from ..utils.locks import named_lock
+
 _NATIVE = Path(__file__).resolve().parent.parent.parent / "native"
 _SRC = _NATIVE / "repl.cpp"
 _LIB = _NATIVE / "build" / "libcookrepl.so"
@@ -231,7 +233,9 @@ class ReplicationServer:
                                "(g++ missing or build failed — see "
                                "stderr)")
         self._lib = lib
-        self._mu = threading.Lock()
+        # ranks ABOVE "store" (utils/locks.py): journal appends poke and
+        # await this server while holding the store lock
+        self._mu = named_lock("repl.server")
         self._handle = lib.crp_serve(str(directory).encode(), int(port))
         if not self._handle:
             raise RuntimeError(f"could not serve replication on port "
@@ -332,7 +336,7 @@ class ReplicationFollower:
                                "(g++ missing or build failed — see "
                                "stderr)")
         self._lib = lib
-        self._mu = threading.Lock()
+        self._mu = named_lock("repl.follower")
         self._handle = lib.crf_follow(host.encode(), int(port),
                                       str(directory).encode())
         self.directory = str(directory)

@@ -31,6 +31,7 @@ from contextlib import nullcontext
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..utils import tracing
+from ..utils.locks import named_lock, named_rlock
 from . import machines
 from .schema import (
     Application,
@@ -477,7 +478,9 @@ class Store:
     """Thread-safe entity store. All mutation goes through :meth:`transact`."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # named+ranked for the lock-order sanitizer (utils/locks.py owns
+        # the global acquisition-order contract; docs/ANALYSIS.md)
+        self._lock = named_rlock("store")
         # Injectable clock for every entity timestamp (submit/start/end/
         # queue-time); the simulator swaps in its virtual clock so recorded
         # wait times stay in trace time instead of mixing epochs.
@@ -506,7 +509,7 @@ class Store:
         # events enqueue under the main lock and drain under _notify_lock, so
         # subscribers always observe transactions in tx_id order.
         self._event_queue: List[Tuple[int, List[TxEvent]]] = []
-        self._notify_lock = threading.Lock()
+        self._notify_lock = named_lock("store.notify")
         self._draining = threading.local()
         # durable redo journal (attached via attach_journal / Store.open)
         self._journal_file = None

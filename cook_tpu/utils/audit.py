@@ -50,6 +50,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from cook_tpu.utils.flight import recorder as _flight
+from cook_tpu.utils.locks import named_lock
 from cook_tpu.utils.metrics import registry
 
 # event kinds that are one-shot lifecycle facts: never coalesced, last to
@@ -117,7 +118,10 @@ class AuditTrail:
 
     def __init__(self, clock: Optional[Callable[[], int]] = None,
                  max_jobs: int = 100_000, per_job: int = 64):
-        self._lock = threading.Lock()
+        # "audit" ranks ABOVE "store" in the global lock-order contract
+        # (utils/locks.py): store->audit is the single nesting direction
+        # everywhere (flush_audit drains under the store lock)
+        self._lock = named_lock("audit")
         self._lanes: "OrderedDict[str, _Lane]" = OrderedDict()
         self._clock = clock or (lambda: int(time.time() * 1000))
         self.enabled = True

@@ -4,6 +4,17 @@ Three components persist a monotonic counter with identical durability
 needs — the store's shared-dir epoch claim, the elector's election-epoch
 mint, and the journal write-generation bump.  One implementation keeps
 the ordering rule (write temp → flush → fsync → rename) in one place.
+
+Locking contract: these helpers fsync and therefore BLOCK.  The one
+caller allowed to invoke them while holding a named lock is the store's
+checkpoint/fence path under the ``store`` lock — an allowlisted
+blocking-under-lock site, because snapshot-then-truncate must be atomic
+against concurrent writers.  The global lock-order contract (which lock
+may nest inside which, and which blocking ops are allowed where) has
+ONE home: the ``cook_tpu/utils/locks.py`` module docstring and its
+``ALLOWED_BLOCKING`` table (docs/ANALYSIS.md) — it used to live only in
+CHANGES.md prose.  ``cs lint`` enforces the static half; the tier-1
+lock sanitizer enforces it at runtime.
 """
 
 from __future__ import annotations

@@ -20,3 +20,35 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lock_sanitizer():
+    """Run the whole tier-1 suite under the dynamic lock-order sanitizer
+    (cook_tpu/utils/locks.py, docs/ANALYSIS.md): every named-lock
+    acquisition records its graph edge, and blocking syscalls (fsync /
+    sleep / socket send+connect) are checked against the held-lock
+    allowlist.  The teardown assert makes ANY acquisition-graph cycle,
+    declared-rank inversion, or unallowlisted blocking-under-lock event
+    anywhere in the run a tier-1 failure.
+
+    COOK_LOCK_SANITIZER=0 opts out (e.g. when bisecting an unrelated
+    failure); tests that deliberately construct violations use their own
+    LockMonitor instance so this global stays meaningful."""
+    from cook_tpu.utils import locks
+
+    if os.environ.get("COOK_LOCK_SANITIZER", "1") == "0":
+        yield
+        return
+    locks.monitor.arm_blocking_detector()
+    try:
+        yield
+    finally:
+        locks.monitor.disarm_blocking_detector()
+        problems = locks.monitor.check()
+        assert not problems, (
+            "lock-order sanitizer violations during the run "
+            "(utils/locks.py contract; docs/ANALYSIS.md):\n\n"
+            + "\n\n".join(problems))

@@ -1,0 +1,565 @@
+"""Static analysis engine + dynamic lock-order sanitizer tests
+(cook_tpu/analysis, cook_tpu/utils/locks.py; docs/ANALYSIS.md).
+
+Three tiers:
+
+1. **fixture snippets** — every lint pass must FIRE on a minimal
+   violating snippet (a pass that can't trip is a pass that silently
+   rotted);
+2. **self-lint golden** — the repo lints clean against the checked-in
+   baseline; this is the tier-1 hook that makes a new violation fail the
+   normal verify command;
+3. **sanitizer** — a deliberately constructed A→B/B→A acquisition cycle,
+   a declared-rank inversion, and a blocking-syscall-under-lock are each
+   detected (on private LockMonitor instances, so the session-wide
+   monitor the conftest asserts on stays meaningful).
+"""
+
+import json
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from cook_tpu.analysis import run_lint
+from cook_tpu.analysis.engine import Finding, load_baseline
+from cook_tpu.utils import locks
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.analysis
+
+
+def lint_snippet(tmp_path: Path, source: str, name: str = "mod.py"):
+    """Run the per-file passes over one synthetic module (no docs dir,
+    no baseline)."""
+    pkg = tmp_path / "pkg"
+    target = pkg / name
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    empty = tmp_path / "empty_baseline.json"
+    empty.write_text('{"suppressions": []}')
+    return run_lint(package_root=pkg, docs_root=None, baseline=empty)
+
+
+def checks(result):
+    return {f.check for f in result.findings}
+
+
+# ---------------------------------------------------------------------------
+# pass fixtures: each check fires on a violating snippet
+# ---------------------------------------------------------------------------
+
+class TestLockDisciplinePass:
+    def test_fsync_under_lock_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import os, threading
+
+            class S:
+                def bad(self):
+                    with self._lock:
+                        os.fsync(3)
+        """)
+        assert checks(r) == {"lock-blocking-call"}
+        assert r.findings[0].detail == "os.fsync"
+        assert r.findings[0].scope == "S.bad"
+
+    def test_sleep_and_socket_and_wait_acked_fire(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import time
+
+            class S:
+                def a(self):
+                    with self._mu:
+                        time.sleep(0.1)
+
+                def b(self, sock):
+                    with self._lock:
+                        sock.sendall(b"x")
+
+                def c(self):
+                    with self._lock:
+                        self.server.wait_acked(10, 5.0)
+        """)
+        assert len(r.findings) == 3
+        assert {f.detail for f in r.findings} == {
+            "time.sleep", "sock.sendall", "self.server.wait_acked"}
+
+    def test_locked_suffix_and_caller_holds_docstring_scope(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import os
+
+            class S:
+                def _flush_locked(self):
+                    os.fsync(3)
+
+                def append(self):
+                    '''Append a record (caller holds the store lock).'''
+                    os.fsync(4)
+        """)
+        assert len(r.findings) == 2
+        assert {f.scope for f in r.findings} == {"S._flush_locked",
+                                                 "S.append"}
+
+    def test_clean_lock_body_and_nested_def_ok(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import os, time
+
+            class S:
+                def ok(self):
+                    with self._lock:
+                        x = self._jobs.get("a")
+                    time.sleep(0.1)        # off the lock: fine
+                    return x
+
+                def defer(self):
+                    with self._lock:
+                        # defining a callback under the lock is not
+                        # CALLING it under the lock
+                        def later():
+                            os.fsync(3)
+                        self.cb = later
+        """)
+        assert r.findings == []
+
+    def test_condition_wait_not_flagged(self, tmp_path):
+        # cv.wait releases its lock while waiting — never a violation
+        r = lint_snippet(tmp_path, """
+            class S:
+                def run(self):
+                    with self._cv:
+                        self._cv.wait(0.5)
+        """)
+        assert r.findings == []
+
+    def test_blocking_context_manager_under_lock_fires(self, tmp_path):
+        # with-items evaluate in order: a blocking call used AS a
+        # context manager (nested, or compound after the lock item)
+        # runs while the lock is held
+        r = lint_snippet(tmp_path, """
+            import socket
+
+            class S:
+                def nested(self, addr):
+                    with self._lock:
+                        with socket.create_connection(addr) as s:
+                            pass
+
+                def compound(self, addr):
+                    with self._lock, socket.create_connection(addr) as s:
+                        pass
+
+                def before_lock(self, addr):
+                    # connect BEFORE the lock item: not lock-held
+                    with socket.create_connection(addr) as s, self._lock:
+                        pass
+        """)
+        assert [f.scope for f in r.findings] == ["S.nested", "S.compound"]
+        assert all(f.detail == "socket.create_connection"
+                   for f in r.findings)
+
+
+class TestJitHygienePass:
+    def test_uninstrumented_decorated_jit_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x + 1
+        """, name="ops/k.py")
+        assert checks(r) == {"jit-uninstrumented"}
+        assert r.findings[0].detail == "kernel"
+
+    def test_instrumented_jit_clean(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import functools, jax
+            from . import telemetry as _telemetry
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def kernel(x, mode):
+                return x + 1
+
+            kernel = _telemetry.instrument_jit("k", kernel)
+
+            inline = _telemetry.instrument_jit(
+                "i", jax.jit(lambda b: b * 2))
+        """, name="ops/k.py")
+        assert r.findings == []
+
+    def test_host_numpy_in_jitted_body_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import jax
+            import numpy as np
+            from . import telemetry as _telemetry
+
+            @jax.jit
+            def kernel(x):
+                return np.sum(x)
+
+            kernel = _telemetry.instrument_jit("k", kernel)
+        """, name="ops/k.py")
+        assert checks(r) == {"jit-host-numpy"}
+        assert r.findings[0].detail == "np.sum"
+
+    def test_traced_branch_fires_but_static_arg_does_not(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import functools, jax
+            from . import telemetry as _telemetry
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def kernel(x, flag):
+                if flag:          # static: legal python control flow
+                    x = x + 1
+                if x > 0:         # traced: must be lax.cond/where
+                    x = x - 1
+                return x
+
+            kernel = _telemetry.instrument_jit("k", kernel)
+        """, name="ops/k.py")
+        assert checks(r) == {"jit-traced-branch"}
+        assert r.findings[0].detail == "x"
+
+    def test_wallclock_in_jitted_body_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import jax, time
+            from . import telemetry as _telemetry
+
+            @jax.jit
+            def kernel(x):
+                return x * time.time()
+
+            kernel = _telemetry.instrument_jit("k", kernel)
+        """, name="ops/k.py")
+        assert checks(r) == {"jit-wallclock"}
+
+    def test_body_checks_scoped_to_kernel_paths(self, tmp_path):
+        # host numpy inside a jitted body OUTSIDE ops/ and sched/fused.py
+        # is not body-checked (the instrumentation rule still applies)
+        r = lint_snippet(tmp_path, """
+            import jax
+            import numpy as np
+            from . import telemetry as _telemetry
+
+            @jax.jit
+            def helper(x):
+                return np.sum(x)
+
+            helper = _telemetry.instrument_jit("h", helper)
+        """, name="util/h.py")
+        assert r.findings == []
+
+
+    def test_same_name_in_other_scope_not_vouched(self, tmp_path):
+        # a module-level instrument_jit rebinding must not vouch for a
+        # SAME-NAMED jitted method in a class scope
+        r = lint_snippet(tmp_path, """
+            import jax
+            from . import telemetry as _telemetry
+
+            @jax.jit
+            def kernel(x):
+                return x
+
+            kernel = _telemetry.instrument_jit("k", kernel)
+
+            class S:
+                @jax.jit
+                def kernel(self, x):
+                    return x
+        """, name="ops/k.py")
+        assert [(f.check, f.scope) for f in r.findings] == [
+            ("jit-uninstrumented", "S")]
+
+
+class TestEngineMechanics:
+    def test_pragma_suppression(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda x: x)  # cs-lint: allow=jit-uninstrumented
+        """)
+        assert r.findings == []
+        assert [f.suppressed_by for f in r.suppressed] == ["pragma"]
+
+    def test_malformed_pragma_does_not_crash(self, tmp_path):
+        # '# cs-lint: allow=' with nothing after it suppresses nothing
+        # and must not take the run down
+        r = lint_snippet(tmp_path, """
+            import jax
+
+            fn = jax.jit(lambda x: x)  # cs-lint: allow=
+        """)
+        assert checks(r) == {"jit-uninstrumented"}
+        assert r.errors == []
+
+    def test_baseline_suppression_and_staleness(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""
+            import os
+
+            class S:
+                def bad(self):
+                    with self._lock:
+                        os.fsync(3)
+        """))
+        fp = "lock-blocking-call:m.py:S.bad:os.fsync"
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"suppressions": [
+            {"fingerprint": fp, "justification": "test"},
+            {"fingerprint": "lock-blocking-call:gone.py:X.y:os.fsync",
+             "justification": "stale"}]}))
+        r = run_lint(package_root=pkg, docs_root=None, baseline=base)
+        assert r.findings == []
+        assert [f.suppressed_by for f in r.suppressed] == ["baseline"]
+        assert r.stale_baseline == [
+            "lock-blocking-call:gone.py:X.y:os.fsync"]
+        # a stale entry fails the run: `cs lint` and the tier-1 golden
+        # must render the same verdict on the same tree
+        assert not r.ok
+
+    def test_fingerprint_is_line_free(self):
+        a = Finding("c", "p.py", 10, "S.f", "os.fsync", "m")
+        b = Finding("c", "p.py", 99, "S.f", "os.fsync", "m")
+        assert a.fingerprint == b.fingerprint
+
+    def test_registry_pass_fires_on_undocumented_names(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        docs = tmp_path / "docs"
+        pkg.mkdir()
+        docs.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""
+            from .metrics import registry
+            from . import tracing
+
+            def f(_faults):
+                registry.counter_inc("cook_documented")
+                registry.gauge_set("cook_mystery_gauge", 1.0)
+                with tracing.span("mystery.span"):
+                    _faults.fire("mystery.point")
+        """))
+        (docs / "OBSERVABILITY.md").write_text("`cook_documented_total`")
+        (docs / "ROBUSTNESS.md").write_text("no points here")
+        empty = tmp_path / "b.json"
+        empty.write_text('{"suppressions": []}')
+        r = run_lint(package_root=pkg, docs_root=docs, baseline=empty)
+        got = {(f.check, f.detail) for f in r.findings}
+        assert got == {("registry-metric", "cook_mystery_gauge"),
+                       ("registry-span", "mystery.span"),
+                       ("registry-fault-point", "mystery.point")}
+
+    def test_parse_error_fails(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text("def broken(:\n")
+        empty = tmp_path / "b.json"
+        empty.write_text('{"suppressions": []}')
+        r = run_lint(package_root=pkg, docs_root=None, baseline=empty)
+        assert not r.ok and r.errors
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 hook: the repo lints clean against its own baseline
+# ---------------------------------------------------------------------------
+
+def test_self_lint_repo_is_clean():
+    """`python -m cook_tpu.lint` exits 0 on this tree: zero unsuppressed
+    findings, no parse errors, and no stale baseline entries (a
+    suppression whose site is gone must be deleted, or the baseline
+    only ever grows)."""
+    r = run_lint(package_root=REPO / "cook_tpu", docs_root=REPO / "docs")
+    msgs = [f"{f.path}:{f.line} [{f.check}] {f.message}"
+            for f in r.findings]
+    assert r.ok, "new lint findings (fix or baseline with a " \
+                 "justification — docs/ANALYSIS.md):\n" + "\n".join(msgs)
+    assert not r.stale_baseline, (
+        "stale baseline entries: " + ", ".join(r.stale_baseline))
+
+
+def test_every_baseline_entry_has_justification():
+    base = load_baseline()
+    assert base, "baseline vanished?"
+    for fp, why in base.items():
+        assert why.strip(), f"baseline entry without justification: {fp}"
+
+
+def test_lint_cli_exit_contract(tmp_path):
+    from cook_tpu.lint import main as lint_main
+    assert lint_main(["--root", str(REPO / "cook_tpu"),
+                      "--docs", str(REPO / "docs")]) == 0
+    # a dirty tree exits nonzero
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import os\n\nclass S:\n    def bad(self):\n"
+        "        with self._lock:\n            os.fsync(3)\n")
+    empty = tmp_path / "b.json"
+    empty.write_text('{"suppressions": []}')
+    assert lint_main(["--root", str(pkg), "--baseline", str(empty),
+                      "--json"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# dynamic lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+class TestLockSanitizer:
+    def test_cycle_detected(self):
+        mon = locks.LockMonitor()
+        a = locks.NamedLock("A", monitor=mon)
+        b = locks.NamedLock("B", monitor=mon)
+        with a:
+            with b:
+                pass
+        assert mon.violations == []
+        with b:
+            with a:  # B -> A closes the cycle
+                pass
+        kinds = [v["kind"] for v in mon.violations]
+        assert "cycle" in kinds
+        cyc = next(v for v in mon.violations if v["kind"] == "cycle")
+        assert {cyc["from"], cyc["to"]} == {"A", "B"}
+        # the rendered loop is closed exactly once (first == last, no
+        # phantom self-edge at the tail)
+        nodes = cyc["message"].split("acquisition cycle ")[1].split(
+            " -> ")
+        assert nodes[0] == nodes[-1]
+        assert all(a != b for a, b in zip(nodes, nodes[1:]))
+        snap = mon.snapshot()
+        assert snap["violations"] >= 1
+        assert {"from": "A", "to": "B", "count": 1} in snap["edges"]
+
+    def test_strict_mode_raises(self):
+        mon = locks.LockMonitor(strict=True)
+        a = locks.NamedLock("A", monitor=mon)
+        b = locks.NamedLock("B", monitor=mon)
+        with a:
+            with b:
+                pass
+        with pytest.raises(locks.LockOrderError):
+            with b:
+                with a:
+                    pass
+
+    def test_declared_order_inversion(self):
+        mon = locks.LockMonitor()
+        lo = locks.NamedLock("low", order=10, monitor=mon)
+        hi = locks.NamedLock("high", order=20, monitor=mon)
+        with hi:
+            with lo:
+                pass
+        assert [v["kind"] for v in mon.violations] == ["order"]
+
+    def test_rlock_locked_reports_owner_hold(self):
+        mon = locks.LockMonitor()
+        r = locks.NamedRLock("R", monitor=mon)
+        assert r.locked() is False
+        with r:
+            # the owning thread must see its own hold (a bare
+            # try-acquire would succeed re-entrantly and report False)
+            assert r.locked() is True
+        assert r.locked() is False
+
+    def test_reentrant_rlock_no_edges_no_false_pop(self):
+        mon = locks.LockMonitor()
+        r = locks.NamedRLock("R", monitor=mon)
+        other = locks.NamedLock("O", monitor=mon)
+        with r:
+            with r:
+                with other:
+                    pass
+            # inner release must NOT pop the held entry: edges from R
+            # still attribute correctly
+            assert [h.name for h in mon.held()] == ["R"]
+        assert mon.held() == []
+        assert ("R", "O") in mon.edges and ("R", "R") not in mon.edges
+        assert mon.violations == []
+
+    def test_blocking_syscall_under_lock_detected(self):
+        mon = locks.LockMonitor()
+        a = locks.NamedLock("A", monitor=mon)
+        mon.arm_blocking_detector()
+        try:
+            time.sleep(0.001)  # no lock held: clean
+            assert mon.blocking_events == []
+            with a:
+                time.sleep(0.001)
+        finally:
+            mon.disarm_blocking_detector()
+        assert len(mon.blocking_events) == 1
+        ev = mon.blocking_events[0]
+        assert ev["op"] == "time.sleep" and ev["held"] == ["A"]
+        # dedup: the same site counts, not floods
+        mon.arm_blocking_detector()
+        try:
+            with a:
+                time.sleep(0.001)
+        finally:
+            mon.disarm_blocking_detector()
+        assert len(mon.blocking_events) == 1
+        assert mon.blocking_events[0]["count"] == 2
+
+    def test_allowlisted_blocking_pair_clean(self):
+        mon = locks.LockMonitor()
+        mon.allowed_blocking.add(("A", "time.sleep"))
+        a = locks.NamedLock("A", monitor=mon)
+        mon.arm_blocking_detector()
+        try:
+            with a:
+                time.sleep(0.001)
+        finally:
+            mon.disarm_blocking_detector()
+        assert mon.blocking_events == []
+        assert mon.check() == []
+
+    def test_cross_thread_edges_compose(self):
+        """Thread 1 takes A->B, thread 2 takes B->A: neither thread sees
+        both locks, but the name-level graph still closes the cycle —
+        the Eraser-style point of recording edges, not schedules."""
+        mon = locks.LockMonitor()
+        a = locks.NamedLock("A", monitor=mon)
+        b = locks.NamedLock("B", monitor=mon)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        th = threading.Thread(target=t2)
+        th.start()
+        th.join()
+        assert any(v["kind"] == "cycle" for v in mon.violations)
+
+    def test_global_monitor_store_contract_edges(self):
+        """The production store's named locks record the contractual
+        edge directions on the GLOBAL monitor (the conftest teardown
+        asserts it stays violation-free)."""
+        from cook_tpu.state import Store
+        from cook_tpu.state.schema import Job, Resources
+        s = Store()
+        s.create_jobs([Job(uuid="lk1", user="u", pool="p",
+                           resources=Resources(cpus=1, mem=1))])
+        edges = set(locks.monitor.edges)
+        assert ("store.notify", "store") in edges
+        assert ("store.notify", "audit") in edges
+        # and never the reverse of the declared order
+        assert ("audit", "store") not in edges
+        assert ("store", "store.notify") not in edges
+
+    def test_health_surface_exposes_edge_set(self):
+        snap = locks.monitor.snapshot()
+        assert {"armed", "edges", "violations", "blocking_events",
+                "problems"} <= set(snap)
+        for e in snap["edges"]:
+            assert {"from", "to", "count"} <= set(e)

@@ -146,9 +146,16 @@ class TestRedMetrics:
         # a wrong-METHOD probe against a known path must not skew that
         # endpoint's series either
         _http(srv.url + "/metrics", method="DELETE")
-        wait_until(lambda: registry.series("cook_http_requests"))
-        endpoints = {(lbl["method"], lbl["endpoint"]) for lbl, _v in
-                     registry.series("cook_http_requests")}
+
+        def seen():
+            return {(lbl["method"], lbl["endpoint"]) for lbl, _v in
+                    registry.series("cook_http_requests")}
+
+        # wait for the DELETE's series specifically: the earlier GETs
+        # already satisfy a bare "any series" condition while the last
+        # request's finally-block recording is still in flight
+        wait_until(lambda: ("DELETE", instrument.UNMATCHED) in seen())
+        endpoints = seen()
         assert any(e == instrument.UNMATCHED for _m, e in endpoints)
         assert not any("no/such" in e for _m, e in endpoints)
         assert ("DELETE", "/metrics") not in endpoints

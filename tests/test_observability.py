@@ -413,21 +413,25 @@ class TestSloLayer:
 
 
 # ---------------------------------------------------------------------------
-# Span catalog: every span name in cook_tpu/ documented
+# Docs-registry completeness: spans / metrics / CycleRecord fields /
+# fault points.  ONE static extractor (cook_tpu/analysis/registry.py) is
+# shared by these checks, the `cs lint` registry pass, and
+# tests/test_analysis.py's self-lint golden — the harvesting rules can't
+# drift between the test and the CLI (docs/ANALYSIS.md).
 # ---------------------------------------------------------------------------
 
+def _registry_diffs():
+    from cook_tpu.analysis import registry as _registry
+    return _registry.diff_registries(REPO / "cook_tpu", REPO / "docs")
+
+
 def test_span_catalog_documented():
-    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
-    pattern = re.compile(r'tracing\.span\(\s*["\']([^"\']+)')
-    names = set()
-    for path in (REPO / "cook_tpu").rglob("*.py"):
-        for m in pattern.finditer(path.read_text()):
-            names.add(m.group(1))
-    # the flight recorder's root span is opened via tracing.span too
+    from cook_tpu.analysis import registry as _registry
+    names = _registry.harvest_spans(REPO / "cook_tpu")
     assert names, "no spans found — did the span helper get renamed?"
-    undocumented = {n for n in names if f"`{n}`" not in doc}
-    assert not undocumented, (
-        f"spans missing from docs/OBSERVABILITY.md: {sorted(undocumented)}")
+    missing = _registry_diffs()["span"]
+    assert not missing, (
+        f"spans missing from docs/OBSERVABILITY.md: {sorted(missing)}")
 
 
 def test_metric_catalog_documented():
@@ -435,34 +439,33 @@ def test_metric_catalog_documented():
     in docs/OBSERVABILITY.md — the check fails on unregistered names, not
     just on missing known ones, so a new metric cannot ship
     undocumented."""
-    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
-    pattern = re.compile(
-        r'(?:counter_inc|gauge_set|observe_many|observe|\.time)\('
-        r'\s*["\'](cook_[a-z0-9_]+)')
-    names = set()
-    for path in (REPO / "cook_tpu").rglob("*.py"):
-        for m in pattern.finditer(path.read_text()):
-            names.add(m.group(1))
+    from cook_tpu.analysis import registry as _registry
+    names = _registry.harvest_metrics(REPO / "cook_tpu")
     assert len(names) > 20, f"metric scan looks broken: {sorted(names)}"
-    # counters are exposed with a _total suffix; either form may be the
-    # one the doc registers
-    undocumented = {n for n in names
-                    if f"`{n}`" not in doc and f"`{n}_total`" not in doc}
-    assert not undocumented, (
-        f"metrics missing from docs/OBSERVABILITY.md: "
-        f"{sorted(undocumented)}")
+    missing = _registry_diffs()["metric"]
+    assert not missing, (
+        f"metrics missing from docs/OBSERVABILITY.md: {sorted(missing)}")
 
 
 def test_cycle_record_fields_documented():
-    """Every CycleRecord field (the /debug/cycles schema) must be
-    registered in docs/OBSERVABILITY.md."""
-    from cook_tpu.utils.flight import CycleRecord
-    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
-    fields = [f for f in CycleRecord.__slots__ if not f.startswith("_")]
-    # to_doc renames a few slots; check the exported names
-    exported = set(CycleRecord(1, "fused").to_doc())
-    assert len(fields) >= 15
-    undocumented = {f for f in exported if f"`{f}`" not in doc}
-    assert not undocumented, (
+    """Every exported CycleRecord field (the /debug/cycles schema) must
+    be registered in docs/OBSERVABILITY.md."""
+    from cook_tpu.analysis import registry as _registry
+    assert len(_registry.cycle_record_fields()) >= 15
+    missing = _registry_diffs()["cycle-field"]
+    assert not missing, (
         f"CycleRecord fields missing from docs/OBSERVABILITY.md: "
-        f"{sorted(undocumented)}")
+        f"{sorted(missing)}")
+
+
+def test_fault_point_catalog_documented():
+    """Every fault point consulted/armed in cook_tpu/ must be registered
+    in docs/ROBUSTNESS.md's failure-mode matrix (this is the check that
+    surfaced the undocumented `delta.extract`/`delta.apply` points)."""
+    from cook_tpu.analysis import registry as _registry
+    names = _registry.harvest_fault_points(REPO / "cook_tpu")
+    assert len(names) >= 10, f"fault scan looks broken: {sorted(names)}"
+    missing = _registry_diffs()["fault-point"]
+    assert not missing, (
+        f"fault points missing from docs/ROBUSTNESS.md: "
+        f"{sorted(missing)}")
