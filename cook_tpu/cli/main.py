@@ -620,7 +620,11 @@ def cmd_debug(args) -> int:
     the election medium, plus the node's SERVING role: a standby's
     read-fleet block (reads served, local apply offset vs mirrored
     head, staleness bytes/age) and a leader's group-commit batching
-    counters (docs/DEPLOY.md read fleet); ``cs debug health`` is the one-shot roll-up
+    counters (docs/DEPLOY.md read fleet).  On a PARTITIONED write
+    plane the panel carries a ``partitions`` block — per-partition
+    journal head, lease epoch, group-commit stage, declared pool
+    groups — plus the cross-partition ``summary_exchange`` state
+    (docs/DEPLOY.md partitioned write plane); ``cs debug health`` is the one-shot roll-up
     (SLO burn rates, breakers, replication lag, pipeline depth, repack
     counters, audit queue depth) replacing five /debug/* fetches;
     ``cs debug requests`` lists the serving plane's recent + slow

@@ -78,6 +78,16 @@ class JobClient:
         # state older than its own confirmed writes
         self.read_your_writes = read_your_writes
         self.last_commit_offset: Optional[str] = None
+        # partitioned write plane (docs/DEPLOY.md): a partitioned
+        # leader's token is a VECTOR of per-partition entries
+        # ("p0:3:128,p1:3:64").  The client keeps the LATEST entry PER
+        # PARTITION (each partition is its own offset space and its own
+        # session: latest-wins per partition, exactly the single-token
+        # rule applied P times) and threads the joined vector back as
+        # X-Cook-Min-Offset — so a write to partition 0 followed by a
+        # write to partition 1 still guarantees read-your-writes for
+        # BOTH on later reads.
+        self._commit_tokens: dict = {}
         # staleness of the most recent follower-served response
         # (X-Cook-Replication-Offset / -Age-Ms), None when the leader
         # answered
@@ -174,6 +184,30 @@ class JobClient:
             conn._cook_last_use = time.monotonic()
             return resp, raw
 
+    def _merge_commit_token(self, token: str) -> None:
+        """Fold one X-Cook-Commit-Offset into the session token: plain
+        tokens replace wholesale (latest wins); partition-qualified
+        vectors replace per partition and the session token is the
+        sorted joined vector (string-level — the entries stay opaque)."""
+        entries = [e.strip() for e in token.split(",") if e.strip()]
+        qualified = [e for e in entries if e.startswith("p")
+                     and ":" in e]
+        if not qualified or len(qualified) != len(entries):
+            # legacy single token (or something unrecognized: treat as
+            # the opaque session token it is).  Wholesale replacement
+            # retires any per-partition vector too — the server that
+            # minted this token is not the partitioned plane those
+            # entries measured, and resurrecting them on the next
+            # vector merge would gate reads on an obsolete journal.
+            self._commit_tokens.clear()
+            self.last_commit_offset = token
+            return
+        for e in qualified:
+            self._commit_tokens[e.partition(":")[0]] = e
+        self.last_commit_offset = ",".join(
+            self._commit_tokens[k]
+            for k in sorted(self._commit_tokens))
+
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, Union[str, Sequence[str]]]] = None,
                  body: Optional[Dict] = None) -> Any:
@@ -253,8 +287,10 @@ class JobClient:
                 # and a pinned stale token from an old space would be
                 # unsatisfiable forever.  The read-your-writes session
                 # token is the most recent confirmed write, exactly
-                # like any session token.
-                self.last_commit_offset = co
+                # like any session token.  Partition-qualified entries
+                # ("pN:...") apply that rule PER PARTITION and the
+                # session token becomes the joined vector.
+                self._merge_commit_token(co)
             ro = resp.getheader("X-Cook-Replication-Offset")
             self.last_replication_offset = \
                 int(ro) if ro and ro.isdigit() else None

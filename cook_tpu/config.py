@@ -291,6 +291,61 @@ class ServingConfig:
 
 
 @dataclass
+class PartitionConfig:
+    """Partitioned write plane (state/partition.py; the daemon's
+    ``"partitions"`` conf section inside ``"scheduler"``, boot-validated
+    like the sections around it).  ``count=1`` is the compatibility
+    default: the daemon keeps the classic single Store and nothing on
+    the wire changes.  ``count>1`` shards the store + journal into
+    per-pool-group partitions, each with its own fsync stream,
+    group-commit stage, and lease claim (docs/DEPLOY.md "partitioned
+    write plane")."""
+
+    #: number of write-plane partitions (journals, fsync streams,
+    #: group-commit stages, leases)
+    count: int = 1
+    #: explicit pool → partition routing (the config-declared pool
+    #: groups); pools not listed hash deterministically.  Validated at
+    #: boot: every index must be in [0, count).
+    pools: Dict[str, int] = field(default_factory=dict)
+    #: staleness bound of the cross-partition per-user summary exchange
+    #: (quota enforcement / global DRU view read through it)
+    summary_max_age_seconds: float = 1.0
+
+    def __post_init__(self):
+        if not isinstance(self.count, int) or isinstance(self.count, bool) \
+                or self.count < 1:
+            raise ValueError(
+                f"partitions count must be an int >= 1, got {self.count!r}")
+        for pool, idx in (self.pools or {}).items():
+            if not isinstance(idx, int) or isinstance(idx, bool) \
+                    or not 0 <= idx < self.count:
+                raise ValueError(
+                    f"partitions.pools[{pool!r}] must be an int in "
+                    f"[0, {self.count}), got {idx!r}")
+        if float(self.summary_max_age_seconds) < 0:
+            raise ValueError(
+                "partitions summary_max_age_seconds must be >= 0")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "PartitionConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown partitions key {k!r}")
+            if k == "pools":
+                if not isinstance(v, dict):
+                    raise ValueError("partitions.pools must be a map of "
+                                     "pool name to partition index")
+                cfg.pools = {str(p): i for p, i in v.items()}
+            else:
+                default = getattr(cfg, k)
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class PipelineConfig:
     """Pipelined fused-cycle driver + compile-warmup knobs (the daemon's
     ``"pipeline"`` conf section; sched/pipeline.py, docs/PERFORMANCE.md).
@@ -543,6 +598,10 @@ class Config:
     # serving-plane scale-out: follower read fleet + leader group-commit
     # admission batching (state/read_replica.py, state/store.py)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    # partitioned write plane: per-pool-group store/journal shards with
+    # independent fsync streams + leases (state/partition.py); count=1 =
+    # the classic single-store plane
+    partitions: PartitionConfig = field(default_factory=PartitionConfig)
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

@@ -139,6 +139,12 @@ def build_scheduler_config(spec: Dict) -> Config:
         # typo'd knob fails the boot like the sections above
         from .config import ServingConfig
         cfg.serving = ServingConfig.from_conf(spec["serving"])
+    if "partitions" in spec:
+        # partitioned write plane (docs/DEPLOY.md): pool-group store/
+        # journal shards; the routing map is validated HERE so a typo'd
+        # index fails the boot, not the first submission to that pool
+        from .config import PartitionConfig
+        cfg.partitions = PartitionConfig.from_conf(spec["partitions"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))
@@ -325,7 +331,47 @@ class CookDaemon:
             # everything on the first restart
             raise ValueError("replication requires a data_dir (the "
                              "local journal to replicate)")
-        if not self.data_dir:
+        sched_spec = dict(conf.get("scheduler", {}))
+        self.sched_config = build_scheduler_config(sched_spec)
+        # partitioned write plane (docs/DEPLOY.md): P > 1 shards the
+        # store + journal by pool group.  Config is validated at boot;
+        # P = 1 keeps the classic single Store (compatibility mode).
+        pc = self.sched_config.partitions
+        self.partitioned = pc.count > 1
+        if self.partitioned:
+            if self.shared_data or self.replication:
+                # each partition carries its OWN replication topology;
+                # wiring P topologies through one daemon's follower
+                # loop is the multi-host half of this plane and ships
+                # with the federation work — refusing beats silently
+                # mirroring one journal of P
+                raise ValueError(
+                    "partitions.count > 1 is not yet supported together "
+                    "with shared_data_dir/replication in one daemon; "
+                    "run the partitioned plane standalone (per-partition "
+                    "replication is exercised by sim --chaos-failover "
+                    "--partitions)")
+            if self.sched_config.columnar_index \
+                    or self.sched_config.resident_pack:
+                # the columnar projection is per-store; the partitioned
+                # facade serves the entity path
+                print("cook_tpu: partitions>1 pins columnar_index/"
+                      "resident_pack off (entity path)", flush=True)
+                self.sched_config.columnar_index = False
+                self.sched_config.resident_pack = False
+            from .state.partition import PartitionedStore, PartitionMap
+            pmap = PartitionMap(count=pc.count, pools=pc.pools)
+            if not self.data_dir:
+                self.store = PartitionedStore(
+                    [Store(partition=i) for i in range(pc.count)], pmap,
+                    summary_max_age_s=pc.summary_max_age_seconds)
+            else:
+                # per-partition lease claims: each shard dir fences at
+                # its own epoch (the N-leases-over-P-partitions layout)
+                self.store = PartitionedStore.open(
+                    self.data_dir, pmap,
+                    summary_max_age_s=pc.summary_max_age_seconds)
+        elif not self.data_dir:
             self.store = Store()
         elif self.shared_data or self.replication:
             # follower view until elected (replication: the native
@@ -335,8 +381,6 @@ class CookDaemon:
             self.store = Store.replay_only(self.data_dir)
         else:
             self.store = Store.open(self.data_dir)
-        sched_spec = dict(conf.get("scheduler", {}))
-        self.sched_config = build_scheduler_config(sched_spec)
         # dynamic cluster creation may instantiate exactly the factories
         # the operator already declared (plus an explicit allowlist)
         self.sched_config.cluster_factory_allowlist = sorted(

@@ -625,6 +625,11 @@ class CookApi:
         # moment a successor mints a higher election epoch
         self.repl_server = None
         self.repl_follower = None
+        #: per-partition ReplicationServers on a partitioned leader
+        #: (each partition replicates its own journal to its own
+        #: synced-standby set; surfaced on /debug/replication and as
+        #: partition-labeled cook_replication_lag_bytes series)
+        self.partition_repl_servers: List = []
         self.repl_dir: Optional[str] = None
         self.fence_guard: Optional[Callable[[], bool]] = None
         # follower read fleet (state/read_replica.py, set by the daemon
@@ -790,6 +795,30 @@ class CookApi:
                 msg = self.queue_limits.check_submission(pool, user, n)
                 if msg:
                     raise ApiError(422, msg)
+        # cross-partition per-user quota (partitioned write plane,
+        # state/partition.py): a finite count quota on the reserved
+        # pool "*" caps the user's TOTAL footprint across every
+        # partition, enforced off the bounded-staleness summary
+        # exchange — never by shipping job state between partitions
+        prior_jobs: Dict[str, Any] = {}
+        if body.get("idempotent"):
+            # an indeterminate-retry resubmits uuids that may already
+            # be journaled; ONE membership pass feeds both the quota
+            # gate here and the existing/to_create split below
+            for j in jobs:
+                prior = self.store.job(j.uuid)
+                if prior is not None:
+                    prior_jobs[j.uuid] = prior
+        check_global = getattr(self.store, "check_user_quota", None)
+        if check_global is not None:
+            # only truly-new jobs consume quota headroom — the
+            # already-journaled ones are counted by the summary
+            # exchange, and charging them again would leave a user at
+            # cap unable to heal their own ambiguous submission
+            n_new = sum(1 for j in jobs if j.uuid not in prior_jobs)
+            msg = check_global(str(user), n_new) if n_new else None
+            if msg:
+                raise ApiError(422, msg)
         groups = []
         for gspec in body.get("groups", []):
             guuid = gspec.get("uuid")
@@ -871,7 +900,7 @@ class CookApi:
             # created.  Keyed on job uuid — the issue's idempotency unit.
             existing, to_create = [], []
             for job in jobs:
-                prior = self.store.job(job.uuid)
+                prior = prior_jobs.get(job.uuid)
                 if prior is None:
                     to_create.append(job)
                 elif prior.user != user:
@@ -1417,15 +1446,19 @@ class CookApi:
         counts by status and by reason) — a cook_tpu extension kept for
         dashboards; any parameter engages full reference validation."""
         if not params:
+            from ..state.partition import substores
             by_status: Dict[str, int] = {}
             by_reason: Dict[str, int] = {}
-            with self.store._lock:
-                for inst in self.store._instances.values():
-                    by_status[inst.status.value] = \
-                        by_status.get(inst.status.value, 0) + 1
-                    if inst.reason_code is not None:
-                        name = Reasons.by_code(inst.reason_code).name
-                        by_reason[name] = by_reason.get(name, 0) + 1
+            # one partition's lock at a time, never nested (the
+            # store[pN] sibling rule, utils/locks.py)
+            for shard in substores(self.store):
+                with shard._lock:
+                    for inst in shard._instances.values():
+                        by_status[inst.status.value] = \
+                            by_status.get(inst.status.value, 0) + 1
+                        if inst.reason_code is not None:
+                            name = Reasons.by_code(inst.reason_code).name
+                            by_reason[name] = by_reason.get(name, 0) + 1
             return {"by_status": by_status, "by_reason": by_reason}
         self.require_admin(user)
         try:
@@ -1678,7 +1711,8 @@ class CookApi:
                 k: repl.get(k)
                 for k in ("role", "epoch", "fenced", "synced_followers",
                           "follower_count", "min_acked", "journal_bytes",
-                          "mirror", "serving", "group_commit")
+                          "mirror", "serving", "group_commit",
+                          "partitions", "summary_exchange")
                 if repl.get(k) is not None},
             "pipeline_depth": next(
                 (v for _lbl, v in registry.series("cook_pipeline_depth")),
@@ -1789,6 +1823,26 @@ class CookApi:
             # GETs this node has answered from its live store
             out["serving"] = {**rv.stats(),
                               "reads_served": self.follower_reads}
+        pstats = getattr(self.store, "partition_stats", None)
+        if pstats is not None:
+            # partitioned write plane (state/partition.py): one block
+            # per partition — journal head, lease epoch, group-commit
+            # stage, declared pool groups — plus the summary-exchange
+            # state cross-partition invariants read through
+            out["partitions"] = pstats()
+            summaries = getattr(self.store, "summaries", None)
+            if summaries is not None:
+                out["summary_exchange"] = summaries.stats()
+        for srv in getattr(self, "partition_repl_servers", None) or []:
+            # per-partition replication topologies (each partition owns
+            # its own server + synced-standby set)
+            out.setdefault("partition_replication", []).append({
+                "partition": f"p{srv.partition}"
+                if getattr(srv, "partition", None) is not None else None,
+                "port": srv.port,
+                "synced_followers": srv.synced_follower_count,
+                "min_acked": srv.min_acked(),
+            })
         if self.repl_dir:
             from ..state.replication import candidate_position
             out["position"] = candidate_position(self.repl_dir)
@@ -1983,27 +2037,37 @@ class CookApi:
         """Prometheus text exposition (reference: prometheus_metrics.clj +
         /metrics handler rest/api.clj:3981)."""
         from ..utils.metrics import registry
-        rs = self.repl_server
-        if rs is not None and not getattr(rs, "fenced", False):
+        repl_servers = [s for s in ([self.repl_server]
+                                    + list(self.partition_repl_servers))
+                        if s is not None and not getattr(s, "fenced",
+                                                         False)]
+        if repl_servers:
             # per-follower mirror lag, refreshed at scrape time (the
             # replication-health signal operators alert on:
             # docs/OBSERVABILITY.md cook_replication_lag_bytes).  The
             # follower label is a per-CONNECTION id, so stale series are
             # dropped first — reconnect churn must not accumulate frozen
-            # dead-follower series forever
+            # dead-follower series forever.  On a partitioned leader
+            # every partition's server exports its own partition-labeled
+            # series (each partition is its own replication topology).
             registry.gauge_clear("cook_replication_lag_bytes")
-            try:
-                import os as _os
-                head = _os.path.getsize(
-                    _os.path.join(rs.directory, "journal.jsonl"))
-            except OSError:
-                head = 0
-            for f in rs.status():
-                registry.gauge_set(
-                    "cook_replication_lag_bytes",
-                    max(0, head - int(f.get("acked", 0))),
-                    labels={"follower": str(f.get("id")),
-                            "synced": str(bool(f.get("synced"))).lower()})
+            for rs in repl_servers:
+                try:
+                    import os as _os
+                    head = _os.path.getsize(
+                        _os.path.join(rs.directory, "journal.jsonl"))
+                except OSError:
+                    head = 0
+                part = getattr(rs, "partition", None)
+                for f in rs.status():
+                    registry.gauge_set(
+                        "cook_replication_lag_bytes",
+                        max(0, head - int(f.get("acked", 0))),
+                        labels={"follower": str(f.get("id")),
+                                "synced":
+                                    str(bool(f.get("synced"))).lower(),
+                                **({"partition": f"p{part}"}
+                                   if part is not None else {})})
         rv = self.read_view
         if rv is not None:
             # follower serving-plane staleness, refreshed at scrape time
@@ -2013,12 +2077,17 @@ class CookApi:
             registry.gauge_set("cook_follower_staleness_seconds",
                                round(rv.age_ms() / 1000.0, 6))
         lines = registry.expose()
-        # always include live gauges derivable from state
-        with self.store._lock:
-            waiting = sum(1 for j in self.store._jobs.values()
-                          if j.state is JobState.WAITING and j.committed)
-            running = sum(1 for j in self.store._jobs.values()
-                          if j.state is JobState.RUNNING)
+        # always include live gauges derivable from state (per-shard
+        # locks taken in turn, never nested — utils/locks.py)
+        from ..state.partition import substores
+        waiting = running = 0
+        for shard in substores(self.store):
+            with shard._lock:
+                waiting += sum(1 for j in shard._jobs.values()
+                               if j.state is JobState.WAITING
+                               and j.committed)
+                running += sum(1 for j in shard._jobs.values()
+                               if j.state is JobState.RUNNING)
         lines += (f"\ncook_jobs_waiting {waiting}"
                   f"\ncook_jobs_running {running}\n")
         return lines
@@ -2349,12 +2418,26 @@ class _Handler(BaseHTTPRequestHandler):
         rv = api.read_view
         want = self.headers.get("X-Cook-Min-Offset")
         if want is not None:
-            ep, off = self._parse_min_offset(want)
-            if not rv.wait_token(
-                    ep, off, api.config.serving.min_offset_wait_seconds):
+            # vector-aware gate (the partitioned plane's token form —
+            # entries satisfied against the mirror of THEIR partition);
+            # legacy single tokens go through the same method
+            gate = getattr(rv, "wait_commit_token", None)
+            try:
+                if gate is not None:
+                    ok = gate(want,
+                              api.config.serving.min_offset_wait_seconds)
+                else:
+                    ep, off = self._parse_min_offset(want)
+                    ok = rv.wait_token(
+                        ep, off,
+                        api.config.serving.min_offset_wait_seconds)
+            except ValueError:
+                raise ApiError(400, "malformed X-Cook-Min-Offset")
+            if not ok:
                 # still behind the client's own write (or mirroring an
-                # EARLIER leadership's offset space): the leader is the
-                # only node that can guarantee read-your-writes
+                # EARLIER leadership's / a SIBLING partition's offset
+                # space): the leader is the only node that can
+                # guarantee read-your-writes
                 self._redirect(target, path)
         api.follower_reads += 1
         from ..utils.metrics import registry

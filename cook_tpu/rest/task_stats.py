@@ -130,14 +130,19 @@ def get_stats(store, status: str, start_ms: int, end_ms: int,
               name_fn: Optional[Callable[[str], bool]],
               now_ms: int) -> Dict:
     """The TaskStatsResponse body (task_stats.clj:94-122)."""
+    from ..state.partition import substores
     want = InstanceStatus(status)
     users: List[str] = []
     reasons: List[str] = []
     cpu, mem, run = [], [], []
-    with store._lock:
-        matched = [inst for inst in store._instances.values()
-                   if inst.status is want and inst.start_time_ms
-                   and start_ms <= inst.start_time_ms < end_ms]
+    matched = []
+    # per-shard locks in turn, never nested (utils/locks.py sibling rule)
+    for shard in substores(store):
+        with shard._lock:
+            matched.extend(
+                inst for inst in shard._instances.values()
+                if inst.status is want and inst.start_time_ms
+                and start_ms <= inst.start_time_ms < end_ms)
     # one batched read, one clone per JOB (not per attempt) — per-call
     # store.job() would re-lock and re-clone for every instance
     uuids = list({inst.job_uuid for inst in matched})

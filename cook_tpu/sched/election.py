@@ -224,6 +224,55 @@ class LeaseLeaderElector(LeaderElector):
                      {self._CAND_PREFIX + _safe_node_id(node_id): None})
 
 
+def partition_lock_path(election_dir: str, partition: int) -> str:
+    """The per-partition lease lock in a partitioned write plane
+    (state/partition.py): PR 3's single ``cook-leader.lock`` election
+    generalizes to N leases over P partitions — partition p's leader is
+    whoever holds ``cook-leader-p<p>.lock`` in the shared election dir,
+    with the same minted-epoch fencing, published-URL, and
+    candidate-position machinery per lease."""
+    return str(Path(election_dir) / f"cook-leader-p{int(partition)}.lock")
+
+
+class PartitionLeaseSet:
+    """N independent leader leases over P partitions: one
+    :class:`FileLeaderElector` per partition lock, campaigned and
+    resigned individually.  A node may lead any SUBSET of partitions —
+    losing one partition's lease fires only that partition's
+    ``on_loss`` while the siblings keep serving (the chaos scenario's
+    "sibling partitions never stall" invariant is exactly this
+    isolation)."""
+
+    def __init__(self, election_dir: str, count: int, node_url: str,
+                 on_leadership=None, on_loss=None):
+        self.electors: Dict[int, FileLeaderElector] = {}
+        for p in range(int(count)):
+            self.electors[p] = FileLeaderElector(
+                partition_lock_path(election_dir, p), node_url,
+                on_leadership=(lambda pp=p: on_leadership(pp))
+                if on_leadership else None,
+                on_loss=(lambda pp=p: on_loss(pp)) if on_loss else None)
+
+    def campaign(self, partition: Optional[int] = None) -> None:
+        for p, elector in self.electors.items():
+            if partition is None or p == partition:
+                elector.campaign()
+
+    def resign(self, partition: Optional[int] = None) -> None:
+        for p, elector in self.electors.items():
+            if partition is None or p == partition:
+                elector.resign()
+
+    def led_partitions(self) -> list:
+        return sorted(p for p, e in self.electors.items() if e.is_leader)
+
+    def leader_url(self, partition: int) -> Optional[str]:
+        return self.electors[int(partition)].leader_url()
+
+    def epoch(self, partition: int) -> Optional[int]:
+        return self.electors[int(partition)].epoch
+
+
 class FileLeaderElector(LeaderElector):
     def __init__(self, lock_path: str, node_url: str,
                  on_leadership: Optional[Callable[[], None]] = None,

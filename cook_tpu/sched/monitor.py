@@ -131,6 +131,8 @@ class Monitor:
                                     scope=("pool", "state"))
         self.registry.set_label_cap("cook_user_dru", "user",
                                     cap * 2 + 16, scope=("pool",))
+        self.registry.set_label_cap("cook_user_global_jobs", "user",
+                                    cap * 2 + 16)
         # endpoints that have ever carried traffic: quiet ones must be
         # re-published at 0 each sweep, or one slow request's burn-rate
         # gauge would stick at its breach value forever
@@ -165,15 +167,43 @@ class Monitor:
         the batch-size HISTOGRAM is recorded by the committer itself
         per batch (cook_group_commit_batch_size); the sweep publishes
         the queue depth a stuck committer would show."""
-        co = getattr(self.store, "commit_offset", None)
-        if co is not None and co():
-            self.registry.gauge_set("cook_journal_head_bytes",
-                                    float(co()))
-        gc_stats = getattr(self.store, "group_commit_stats", None)
-        gc = gc_stats() if gc_stats is not None else None
-        if gc is not None:
-            self.registry.gauge_set("cook_group_commit_pending",
-                                    float(gc["pending"]))
+        from ..state.partition import substores
+        shards = substores(self.store)
+        partitioned = len(shards) > 1 or (
+            shards and shards[0] is not self.store)
+        for shard in shards:
+            # one gauge per shard, partition-labeled on the partitioned
+            # plane (each partition's journal is its own offset space —
+            # summing heads across partitions would be the exact
+            # mis-comparison the token vector exists to prevent)
+            pl = getattr(shard, "partition_label", lambda: None)()
+            labels = {"partition": pl} if partitioned and pl else None
+            co = getattr(shard, "commit_offset", None)
+            if co is not None and co():
+                self.registry.gauge_set("cook_journal_head_bytes",
+                                        float(co()), labels=labels)
+            gc_stats = getattr(shard, "group_commit_stats", None)
+            gc = gc_stats() if gc_stats is not None else None
+            if gc is not None:
+                self.registry.gauge_set("cook_group_commit_pending",
+                                        float(gc["pending"]),
+                                        labels=labels)
+        summaries = getattr(self.store, "summaries", None)
+        if summaries is not None:
+            # the monitor's GLOBAL view on a partitioned plane: per-user
+            # total footprint across every partition, read from the
+            # bounded-staleness summary exchange (counts, never job
+            # state) — top-K folding is the registry cap's job here
+            merged = summaries.merged()
+            top = sorted(merged.items(),
+                         key=lambda kv: -(kv[1]["pending"]
+                                          + kv[1]["running"]))
+            self.registry.gauge_clear("cook_user_global_jobs")
+            for user, u in top[:self.slo.max_user_series]:
+                self.registry.gauge_set(
+                    "cook_user_global_jobs",
+                    u["pending"] + u["running"],
+                    labels={"user": user})
 
     def _sweep_pool(self, pool) -> Dict[str, int]:
         from ..state.schema import DruMode

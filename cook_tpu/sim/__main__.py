@@ -50,6 +50,15 @@ def main(argv=None) -> int:
     p.add_argument("--leader-mode", default="sigkill",
                    choices=["sigkill", "partition"],
                    help="chaos-failover: how the leader is lost")
+    p.add_argument("--partitions", type=int, default=None,
+                   help="chaos-failover: run the PARTITIONED write-plane "
+                        "scenario over N partitions instead — kill ONE "
+                        "partition leader mid-batch, its standby "
+                        "promotes via the candidate ranking while "
+                        "sibling partitions keep committing "
+                        "uninterrupted; zero committed txns lost, "
+                        "per-partition indeterminate demux asserted "
+                        "(docs/DEPLOY.md partitioned write plane)")
     p.add_argument("--pipeline-depth", type=int, default=None,
                    help="chaos: drive the production pipelined fused "
                         "cycle at this depth instead of the split host "
@@ -94,6 +103,13 @@ def main(argv=None) -> int:
         return 0 if result["ok"] else 1
 
     if args.chaos_failover:
+        if args.partitions and args.partitions > 1:
+            from .chaos import PartitionChaosConfig, run_partition_chaos
+            presult = run_partition_chaos(PartitionChaosConfig(
+                seed=args.seed or 0, partitions=args.partitions,
+                group_commit=not args.no_group_commit))
+            print(json.dumps(presult.summary(), indent=2))
+            return 0 if presult.ok else 1
         from .chaos import FailoverChaosConfig, run_failover_chaos
         result = run_failover_chaos(FailoverChaosConfig(
             seed=args.seed or 0, leader_mode=args.leader_mode,

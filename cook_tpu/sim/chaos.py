@@ -635,6 +635,337 @@ def _wait(pred, timeout_s: float = 15.0) -> bool:
     return bool(pred())
 
 
+# --------------------------------------------------------------------------
+# Partitioned write-plane chaos: kill ONE partition's leader mid-batch and
+# prove the sibling partitions' commit streams never stall while the
+# victim's standby promotes via the PR 3 candidate ranking — zero
+# committed transactions lost, per-partition indeterminate demux asserted
+# (ISSUE 12; docs/DEPLOY.md "partitioned write plane").
+# --------------------------------------------------------------------------
+
+@dataclass
+class PartitionChaosConfig:
+    seed: int = 0
+    partitions: int = 2
+    #: which partition's leader is killed mid-batch
+    victim: int = 0
+    #: committed per partition before the fault schedule starts
+    jobs_before: int = 8
+    #: concurrent writers per phase (the group-commit batch width)
+    writers: int = 3
+    #: how long the sibling writer threads keep streaming commits
+    #: through the kill + promotion window
+    sibling_stream_s: float = 2.0
+    ack_timeout_s: float = 5.0
+    data_root: Optional[str] = None
+    group_commit: bool = True
+
+
+@dataclass
+class PartitionChaosResult:
+    violations: List[str] = field(default_factory=list)
+    partitions: int = 0
+    committed: int = 0
+    committed_by_partition: Dict[str, int] = field(default_factory=dict)
+    victim_indeterminate: int = 0
+    sibling_commits_during_promotion: int = 0
+    sibling_errors: int = 0
+    promotion_window_s: float = 0.0
+    promoted_epoch: int = 0
+    unresolved_writers: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict:
+        return {
+            "ok": self.ok, "violations": list(self.violations),
+            "partitions": self.partitions,
+            "committed": self.committed,
+            "committed_by_partition": dict(self.committed_by_partition),
+            "victim_indeterminate": self.victim_indeterminate,
+            "sibling_commits_during_promotion":
+                self.sibling_commits_during_promotion,
+            "sibling_errors": self.sibling_errors,
+            "promotion_window_s": round(self.promotion_window_s, 3),
+            "promoted_epoch": self.promoted_epoch,
+            "unresolved_writers": self.unresolved_writers,
+        }
+
+
+def run_partition_chaos(cc: Optional[PartitionChaosConfig] = None
+                        ) -> PartitionChaosResult:
+    """One partition-leader loss under write load, over REAL per-
+    partition socket replication (each partition: its own journal,
+    fsync stream, group-commit stage, ReplicationServer, synced
+    standby, and lease epoch — the N-leases-over-P-partitions layout):
+
+    1. P partition leaders + one synced standby each; a
+       :class:`~cook_tpu.state.partition.PartitionedStore` facade
+       routes per-pool writes;
+    2. a concurrent batch on the VICTIM partition has its replication
+       ack fault-lost mid-flight — every waiter must demux committed or
+       indeterminate (never hang), and ONLY the victim partition's
+       writers may see the ambiguous outcome;
+    3. the victim's leader dies; sibling partitions' writer threads
+       keep streaming commits THROUGH the whole promotion window —
+       zero sibling errors, nonzero sibling commits inside the window
+       (the commit stream never stalls);
+    4. the victim's standby promotes via the PR 3 machinery (candidate
+       position, promotion gate, epoch 2 fencing) and must hold EVERY
+       committed-or-indeterminate transaction (zero loss — the
+       indeterminate records reached the synced mirror before the ack
+       was lost);
+    5. the rebuilt facade serves every committed job from every
+       partition.
+    """
+    import os
+    import tempfile
+    import threading
+    import time as _time
+
+    from ..state import replication as repl
+    from ..state.partition import PartitionedStore, PartitionMap
+    from ..state.schema import Pool
+    from ..state.store import ReplicationIndeterminate
+    from ..utils.fsatomic import write_atomic_int
+
+    cc = cc or PartitionChaosConfig()
+    result = PartitionChaosResult(partitions=cc.partitions)
+    if cc.partitions < 2:
+        result.violations.append("partition chaos needs >= 2 partitions")
+        return result
+    if not 0 <= cc.victim < cc.partitions:
+        result.violations.append(f"victim {cc.victim} out of range")
+        return result
+    if not repl.replication_available():
+        result.violations.append("native replication library unavailable")
+        return result
+    root = cc.data_root or tempfile.mkdtemp(prefix="cook-partchaos-")
+    election = os.path.join(root, "election")
+    os.makedirs(election, exist_ok=True)
+    pools = {f"pool-p{p}": p for p in range(cc.partitions)}
+    pmap = PartitionMap(count=cc.partitions, pools=pools)
+    committed: Dict[int, List[str]] = {p: [] for p in range(cc.partitions)}
+    cleanup = []
+    stores: List[Store] = []
+    servers = []
+    followers = []
+
+    def _job(p: int, i: int):
+        from ..state.schema import Job, Resources
+        return Job(uuid=f"0000000{p}-0000-4000-8000-{i:012d}",
+                   user=f"chaos{p}", command=f"echo {i}",
+                   pool=f"pool-p{p}",
+                   resources=Resources(cpus=1, mem=64))
+
+    try:
+        # ---- per-partition leadership: leader + synced standby -------
+        from ..sched.election import partition_lock_path
+        for p in range(cc.partitions):
+            authority = partition_lock_path(election, p) + ".epoch"
+            write_atomic_int(authority, 1)
+            d_leader = os.path.join(root, f"p{p}", "leader")
+            store = Store.open(d_leader, epoch=1, shared=False,
+                               partition=p)
+            store.attach_fence_authority(authority)
+            srv = repl.ReplicationServer(d_leader, 0)
+            srv.epoch = 1
+            srv.partition = p
+            cleanup.append(srv.stop)
+            store.attach_replication(srv, sync=True,
+                                     timeout_s=cc.ack_timeout_s)
+            if cc.group_commit:
+                store.enable_group_commit(window_ms=2.0)
+            d_standby = os.path.join(root, f"p{p}", "standby")
+            f = repl.ReplicationFollower("127.0.0.1", srv.port, d_standby)
+            cleanup.append(f.stop)
+            repl.record_followed_epoch(d_standby, 1)
+            stores.append(store)
+            servers.append(srv)
+            followers.append(f)
+        for p, srv in enumerate(servers):
+            if not _wait(lambda s=srv: s.synced_follower_count >= 1):
+                result.violations.append(
+                    f"partition {p} standby never synced")
+                return result
+        facade = PartitionedStore(stores, pmap)
+        for name in pools:
+            facade.put_pool(Pool(name=name))
+        for p in range(cc.partitions):
+            for i in range(cc.jobs_before):
+                job = _job(p, i)
+                facade.create_jobs([job])
+                committed[p].append(job.uuid)
+
+        # ---- victim batch with a fault-lost ack ----------------------
+        outcomes: List[tuple] = []
+
+        def victim_writer(i: int):
+            job = _job(cc.victim, 10_000 + i)
+            try:
+                stores[cc.victim].create_jobs([job])
+                outcomes.append(("committed", job.uuid))
+            except ReplicationIndeterminate:
+                outcomes.append(("indeterminate", job.uuid))
+            except Exception as e:
+                outcomes.append((f"unexpected:{type(e).__name__}",
+                                 job.uuid))
+
+        injector.arm("repl.ack", probability=1.0, max_fires=1)
+        try:
+            threads = [threading.Thread(target=victim_writer, args=(i,))
+                       for i in range(cc.writers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+        finally:
+            injector.disarm("repl.ack")
+        result.unresolved_writers += sum(1 for t in threads
+                                         if t.is_alive())
+        for outcome, uuid in outcomes:
+            if outcome == "indeterminate":
+                result.victim_indeterminate += 1
+                committed[cc.victim].append(uuid)  # on the synced mirror
+            elif outcome == "committed":
+                committed[cc.victim].append(uuid)
+            else:
+                result.violations.append(
+                    f"victim-batch writer got {outcome}")
+        if not result.victim_indeterminate:
+            result.violations.append(
+                "injected ack loss demuxed no indeterminate outcome on "
+                "the victim partition")
+
+        # sibling writers must see the fault-point ONLY on the victim:
+        # arm/disarm above is global, so their phase runs after disarm —
+        # what stays per-partition is the demux (asserted below: zero
+        # sibling indeterminates while their streams run through the
+        # victim's whole promotion window)
+
+        # ---- sibling streams through the kill + promotion ------------
+        stop_siblings = threading.Event()
+        sibling_log: List[tuple] = []  # (ts, partition, uuid | error)
+        sibling_errors = [0]
+
+        def sibling_writer(p: int):
+            i = 20_000
+            while not stop_siblings.is_set():
+                job = _job(p, i)
+                i += 1
+                try:
+                    stores[p].create_jobs([job])
+                    sibling_log.append((_time.monotonic(), p, job.uuid))
+                except Exception as e:
+                    sibling_errors[0] += 1
+                    sibling_log.append(
+                        (_time.monotonic(), p,
+                         f"error:{type(e).__name__}"))
+                    return
+
+        sibling_threads = [threading.Thread(target=sibling_writer,
+                                            args=(p,))
+                           for p in range(cc.partitions)
+                           if p != cc.victim]
+        for t in sibling_threads:
+            t.start()
+        _time.sleep(0.1)  # streams flowing before the kill
+
+        # ---- kill the victim's leader (sigkill-equivalent) -----------
+        kill_ts = _time.monotonic()
+        if not _wait(lambda: followers[cc.victim].offset
+                     >= _journal_bytes(os.path.join(
+                         root, f"p{cc.victim}", "leader"))):
+            result.violations.append(
+                "victim standby never reached the head pre-kill")
+        followers[cc.victim].stop()
+        servers[cc.victim].stop()
+        stores[cc.victim].close()  # crash: no checkpoint
+
+        # ---- promote the victim's standby (PR 3 machinery, lease p) --
+        d_standby = os.path.join(root, f"p{cc.victim}", "standby")
+        pos = repl.candidate_position(d_standby)
+        if not pos.get("synced"):
+            result.violations.append(
+                f"victim standby position not synced: {pos}")
+        authority = partition_lock_path(election, cc.victim) + ".epoch"
+        write_atomic_int(authority, 2)
+        try:
+            repl.assert_promotable(d_standby)
+        except RuntimeError as e:
+            result.violations.append(f"promotion gate refused: {e}")
+            return result
+        promoted = Store.open(d_standby, epoch=2, shared=False,
+                              partition=cc.victim)
+        promoted.attach_fence_authority(authority)
+        cleanup.append(promoted.close)
+        result.promoted_epoch = 2
+        promote_ts = _time.monotonic()
+        result.promotion_window_s = promote_ts - kill_ts
+
+        # siblings keep streaming a little past the promotion, then stop
+        deadline = _time.monotonic() + max(
+            0.0, cc.sibling_stream_s - (promote_ts - kill_ts))
+        while _time.monotonic() < deadline and not sibling_errors[0]:
+            _time.sleep(0.01)
+        stop_siblings.set()
+        for t in sibling_threads:
+            t.join(timeout=30.0)
+        result.unresolved_writers += sum(1 for t in sibling_threads
+                                         if t.is_alive())
+        result.sibling_errors = sibling_errors[0]
+        in_window = [e for e in sibling_log
+                     if kill_ts <= e[0] <= promote_ts
+                     and not str(e[2]).startswith("error:")]
+        result.sibling_commits_during_promotion = len(in_window)
+        if sibling_errors[0]:
+            result.violations.append(
+                f"{sibling_errors[0]} sibling writer(s) errored during "
+                "the victim's failover — sibling partitions must keep "
+                "committing uninterrupted")
+        if not in_window:
+            result.violations.append(
+                "no sibling commit landed inside the victim's promotion "
+                "window — the sibling commit stream stalled")
+        for ts, p, uuid in sibling_log:
+            if not str(uuid).startswith("error:"):
+                committed[p].append(uuid)
+
+        # ---- zero loss: promoted store + rebuilt facade --------------
+        for uuid in committed[cc.victim]:
+            if promoted.job(uuid) is None:
+                result.violations.append(
+                    f"victim-partition commit {uuid} lost by the "
+                    "promotion")
+        new_stores = list(stores)
+        new_stores[cc.victim] = promoted
+        facade = PartitionedStore(new_stores, pmap)
+        for p, uuids in committed.items():
+            result.committed_by_partition[f"p{p}"] = len(uuids)
+            result.committed += len(uuids)
+            for uuid in uuids:
+                if facade.job(uuid) is None:
+                    result.violations.append(
+                        f"committed job {uuid} (partition {p}) missing "
+                        "from the rebuilt facade")
+                    break
+    finally:
+        for fn in reversed(cleanup):
+            try:
+                fn()
+            except Exception:
+                pass
+        for store in stores:
+            try:
+                store.close()
+            except Exception:
+                pass
+        injector.disarm("repl.ack")
+    return result
+
+
 def run_failover_chaos(cc: Optional[FailoverChaosConfig] = None
                        ) -> FailoverChaosResult:
     """One full quorum-aware failover under an adverse schedule:

@@ -27,5 +27,9 @@ from .schema import (  # noqa: F401
 )
 from .store import (AbortTransaction, ReplicationIndeterminate,  # noqa: F401
                     ReplicationTimeout, StaleEpochError, Store, TxEvent)
+from .partition import (GLOBAL_POOL, PartitionedReadView,  # noqa: F401
+                        PartitionedStore, PartitionMap,
+                        PartitionRoutingError, UserSummaryExchange,
+                        parse_token_vector, substores)
 from .index import ColumnarIndex  # noqa: F401
 from . import machines  # noqa: F401

@@ -59,9 +59,17 @@ class FollowerReadView:
 
     def __init__(self, directory: str, interval_s: float = 0.02,
                  on_swap: Optional[Callable[[Store], None]] = None,
-                 start: bool = True):
+                 start: bool = True,
+                 partition_id: Optional[int] = None):
         self.directory = str(directory)
         self.interval_s = max(float(interval_s), 0.001)
+        #: partition this view mirrors in a partitioned write plane
+        #: (state/partition.py): the replica store carries the id (lock
+        #: family, metric labels) and the token wait-gate satisfies only
+        #: entries QUALIFIED with this partition — an offset from a
+        #: sibling partition's journal proves nothing here.  None = the
+        #: classic single-journal plane.
+        self.partition_id = partition_id
         self._on_swap: List[Callable[[Store], None]] = []
         if on_swap is not None:
             self._on_swap.append(on_swap)
@@ -75,7 +83,7 @@ class FollowerReadView:
         self.rebuilds = 0
         self._caught_up_ts = time.time()
         self._offset_cv = threading.Condition()
-        self.store: Store = Store()
+        self.store: Store = Store(partition=partition_id)
         self._offset = 0
         self._max_ep = 0
         self._base_sig: Any = None
@@ -119,7 +127,9 @@ class FollowerReadView:
                 "lag_bytes": self.lag_bytes(),
                 "age_ms": round(self.age_ms(), 1),
                 "applied_records": self.applied_records,
-                "rebuilds": self.rebuilds}
+                "rebuilds": self.rebuilds,
+                **({"partition": f"p{self.partition_id}"}
+                   if self.partition_id is not None else {})}
 
     def on_swap(self, fn: Callable[[Store], None]) -> None:
         self._on_swap.append(fn)
@@ -163,6 +173,40 @@ class FollowerReadView:
         """Offset-only form of :meth:`wait_token`."""
         return self.wait_token(None, offset, timeout_s=timeout_s)
 
+    def wait_commit_token(self, token: str, timeout_s: float = 1.0
+                          ) -> bool:
+        """Vector-aware read-your-writes gate (the partitioned plane's
+        X-Cook-Min-Offset form, state/partition.py):
+
+        - an entry qualified with THIS view's partition waits like
+          :meth:`wait_token`;
+        - an entry for a SIBLING partition with bytes committed cannot
+          be verified against this mirror (its offsets live in another
+          journal's space) — False, the caller redirects to the leader;
+          a zero-offset sibling entry is vacuously satisfied;
+        - a partitionless (legacy) entry is satisfiable only by a
+          partitionless view, and vice versa — an unqualified offset
+          does not name which journal it measures.
+
+        Raises ValueError on garbage (callers surface 400)."""
+        from .partition import parse_token_vector
+        entries = parse_token_vector(token)
+        deadline = time.time() + max(timeout_s, 0.0)
+        for part, ep, off in entries:
+            if part is None:
+                if self.partition_id is not None:
+                    return False
+            elif self.partition_id is None:
+                return False
+            elif part != self.partition_id:
+                if off > 0:
+                    return False
+                continue
+            remaining = max(deadline - time.time(), 0.0)
+            if not self.wait_token(ep, off, timeout_s=remaining):
+                return False
+        return True
+
     # ---------------------------------------------------------------- apply
     def _base_signature(self) -> Any:
         """Identity of the mirror BASE: the follower's resync token plus
@@ -184,8 +228,10 @@ class FollowerReadView:
         with self._mu:
             self._base_sig = self._base_signature()
             snap = os.path.join(self.directory, "snapshot.json")
-            store = (Store.restore(_read_text(snap))
-                     if os.path.exists(snap) else Store())
+            store = (Store.restore(_read_text(snap),
+                                   partition=self.partition_id)
+                     if os.path.exists(snap)
+                     else Store(partition=self.partition_id))
             records, good, _size = _scan_journal(self._journal)
             max_ep = store._replay_records(records)
             swapped = store is not self.store

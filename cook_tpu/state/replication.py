@@ -245,6 +245,11 @@ class ReplicationServer:
         #: election epoch this server serves for (set by the daemon at
         #: promotion); a superseding epoch fences the server
         self.epoch: Optional[int] = None
+        #: partition this server replicates in a partitioned write
+        #: plane (state/partition.py) — each partition owns its OWN
+        #: topology: server, synced-standby set, lease.  Labels the
+        #: replication-lag metrics; None = the classic single topology.
+        self.partition: Optional[int] = None
         self.fenced = False
 
     def status(self) -> list:

@@ -318,8 +318,10 @@ class _GroupCommitStage:
         self.commits = 0
         self.indeterminate = 0
         self.max_batch_seen = 0
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="cook-group-commit")
+        _pl = store.partition_label()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="cook-group-commit" + (f"-{_pl}" if _pl else ""))
         self._thread.start()
 
     def enqueue(self, offset: int) -> _CommitWaiter:
@@ -352,11 +354,13 @@ class _GroupCommitStage:
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             pending = len(self._pending)
+        _pl = self._store.partition_label()
         return {"pending": pending, "batches": self.batches,
                 "commits": self.commits,
                 "indeterminate": self.indeterminate,
                 "max_batch": self.max_batch_seen,
-                "window_ms": round(self.window_s * 1000.0, 3)}
+                "window_ms": round(self.window_s * 1000.0, 3),
+                **({"partition": _pl} if _pl else {})}
 
     def stop(self) -> None:
         with self._cv:
@@ -458,8 +462,13 @@ class _GroupCommitStage:
                         "follower lost during ack wait; quorum below "
                         f"{store._repl_min_followers} — the batch is "
                         "journaled locally and may be mirrored")
+        _pl = store.partition_label()
         registry.observe("cook_group_commit_batch_size", float(n),
-                         buckets=_GC_BATCH_BUCKETS)
+                         buckets=_GC_BATCH_BUCKETS,
+                         # per-partition series in the partitioned plane
+                         # (docs/OBSERVABILITY.md); the classic plane's
+                         # unlabeled series stays wire-identical
+                         labels={"partition": _pl} if _pl else None)
         self.batches += 1
         self.max_batch_seen = max(self.max_batch_seen, n)
         if err is None:
@@ -477,10 +486,21 @@ class _GroupCommitStage:
 class Store:
     """Thread-safe entity store. All mutation goes through :meth:`transact`."""
 
-    def __init__(self) -> None:
+    def __init__(self, partition: Optional[int] = None) -> None:
+        #: partition index when this store is one shard of a partitioned
+        #: write plane (state/partition.py): scopes the lock names into
+        #: the ``store[pN]`` rank family, qualifies the commit token with
+        #: ``pN:`` (its own offset space — offsets are NEVER comparable
+        #: across partitions), and labels the per-partition metrics.
+        #: None = the classic single-store plane, wire-compatible with
+        #: every prior round (P=1 compatibility mode).
+        self.partition = partition
+        _sfx = f"[p{partition}]" if partition is not None else ""
         # named+ranked for the lock-order sanitizer (utils/locks.py owns
-        # the global acquisition-order contract; docs/ANALYSIS.md)
-        self._lock = named_rlock("store")
+        # the global acquisition-order contract; docs/ANALYSIS.md) —
+        # partitioned stores get sibling-suffixed names so cross-partition
+        # nesting is a reported violation from day one
+        self._lock = named_rlock("store" + _sfx)
         # Injectable clock for every entity timestamp (submit/start/end/
         # queue-time); the simulator swaps in its virtual clock so recorded
         # wait times stay in trace time instead of mixing epochs.
@@ -509,7 +529,7 @@ class Store:
         # events enqueue under the main lock and drain under _notify_lock, so
         # subscribers always observe transactions in tx_id order.
         self._event_queue: List[Tuple[int, List[TxEvent]]] = []
-        self._notify_lock = named_lock("store.notify")
+        self._notify_lock = named_lock("store.notify" + _sfx)
         self._draining = threading.local()
         # durable redo journal (attached via attach_journal / Store.open)
         self._journal_file = None
@@ -846,6 +866,11 @@ class Store:
         0 on journal-less stores."""
         return self._commit_offset
 
+    def partition_label(self) -> Optional[str]:
+        """``"p<i>"`` on a partitioned shard, None on the classic
+        single-store plane — the metric-label / token-prefix form."""
+        return f"p{self.partition}" if self.partition is not None else None
+
     def commit_token(self) -> str:
         """The read-your-writes token leader write responses carry
         (X-Cook-Commit-Offset; docs/DEPLOY.md): ``<epoch>:<offset>`` on
@@ -855,10 +880,20 @@ class Store:
         because its old-space byte count is numerically larger (every
         leadership change mints a higher epoch, and a determinate
         commit survives into every later epoch's journal by the no-loss
-        guarantee)."""
+        guarantee).
+
+        On a PARTITIONED shard the token is additionally qualified
+        ``p<partition>:<epoch>:<offset>`` — the partition names the
+        journal the offset lives in; two partitions' offsets are never
+        comparable (state/partition.py owns the vector form clients
+        carry)."""
         if self._journal_epoch is not None:
-            return f"{self._journal_epoch}:{self._commit_offset}"
-        return str(self._commit_offset)
+            token = f"{self._journal_epoch}:{self._commit_offset}"
+        else:
+            token = str(self._commit_offset)
+        if self.partition is not None:
+            return f"p{self.partition}:{token}"
+        return token
 
     def flush_audit(self) -> int:
         """Journal the audit trail's pending ADVISORY events (ranked
@@ -1066,6 +1101,39 @@ class Store:
                     txn.event("job-committed", uuid=uuid)
 
         self.transact(_commit)
+
+    def discard_latched(self, latch: str) -> int:
+        """Abort a latched (still-invisible) sub-batch: delete its
+        uncommitted jobs, scrub them out of any group they were merged
+        into (dropping groups left empty), and pop the latch.  The
+        rollback half of the partitioned facade's cross-partition
+        fan-out (state/partition.py): when a LATER partition's
+        sub-batch aborts, the earlier partitions' latched jobs were
+        never observable — deleting them restores all-or-nothing
+        submission semantics.  Jobs already committed (a concurrent
+        commit_latch/commit_jobs won the race) are left alone."""
+
+        def _discard(txn: _Txn) -> int:
+            doomed = set()
+            for uuid in self._latches.get(latch, []):
+                job = txn.job(uuid)
+                if job is not None and not job.committed:
+                    txn.delete("jobs", uuid)
+                    doomed.add(uuid)
+            if doomed:
+                for guuid in list(self._groups):
+                    g = txn.group(guuid)
+                    if g is None or not (set(g.jobs) & doomed):
+                        continue
+                    keep = [u for u in g.jobs if u not in doomed]
+                    if keep:
+                        txn.group_w(guuid).jobs = keep
+                    else:
+                        txn.delete("groups", guuid)
+            txn.latch_pops.append(latch)
+            return len(doomed)
+
+        return self.transact(_discard)
 
     # -------------------------------------------------------------- launches
     def launch_instance(self, job_uuid: str, task_id: str, hostname: str,
@@ -1526,6 +1594,35 @@ class Store:
                 out.append((fast_clone(job), fast_clone(inst)))
             return out
 
+    def user_summary(self) -> Dict[str, Dict[str, float]]:
+        """Bounded per-user summary of this store's committed jobs —
+        the ONLY payload partitions exchange for cross-partition
+        invariants (per-user quotas, the monitor's global DRU view;
+        state/partition.py UserSummaryExchange): pending/running counts
+        and running resource sums, NEVER job state.  Computed under the
+        lock without entity clones (one pass over the jobs table, a few
+        floats per distinct user)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for j in self._jobs.values():
+                if not j.committed:
+                    continue
+                if j.state is JobState.WAITING:
+                    key = "pending"
+                elif j.state is JobState.RUNNING:
+                    key = "running"
+                else:
+                    continue
+                u = out.setdefault(j.user, {
+                    "pending": 0.0, "running": 0.0,
+                    "cpus": 0.0, "mem": 0.0, "gpus": 0.0})
+                u[key] += 1
+                if key == "running":
+                    u["cpus"] += j.resources.cpus
+                    u["mem"] += j.resources.mem
+                    u["gpus"] += j.resources.gpus
+        return out
+
     def user_usage(self, pool: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         """Per-user aggregate usage of running jobs (reference: scheduler.clj
         user->usage)."""
@@ -1632,9 +1729,9 @@ class Store:
         return json.dumps(state)
 
     @classmethod
-    def restore(cls, blob: str) -> "Store":
+    def restore(cls, blob: str, partition: Optional[int] = None) -> "Store":
         state = json.loads(blob)
-        store = cls()
+        store = cls(partition=partition)
         store._tx_id = state["tx_id"]
         for table in ("jobs", "instances", "groups", "pools", "shares",
                       "quotas", "configs", "intents"):
@@ -1751,7 +1848,8 @@ class Store:
 
     @classmethod
     def open(cls, directory: str, fsync: bool = False,
-             epoch=None, shared: bool = True) -> "Store":
+             epoch=None, shared: bool = True,
+             partition: Optional[int] = None) -> "Store":
         """Open a durable store rooted at ``directory`` (snapshot.json +
         journal.jsonl): load the snapshot if present, replay the journal,
         resume appending. The equivalent of a new leader re-reading Datomic
@@ -1774,9 +1872,9 @@ class Store:
         journal_path = os.path.join(directory, "journal.jsonl")
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
-                store = cls.restore(f.read())
+                store = cls.restore(f.read(), partition=partition)
         else:
-            store = cls()
+            store = cls(partition=partition)
         store._journal_dir = directory
         if epoch is None:
             records, good, size = _scan_journal(journal_path)
@@ -1836,7 +1934,8 @@ class Store:
         return max_ep
 
     @classmethod
-    def replay_only(cls, directory: str) -> "Store":
+    def replay_only(cls, directory: str,
+                    partition: Optional[int] = None) -> "Store":
         """Load snapshot + journal WITHOUT attaching the journal: the
         follower/read-replica view of a SHARED data dir.  A follower must
         never append (its writes would interleave with the leader's), so
@@ -1846,9 +1945,9 @@ class Store:
         journal_path = os.path.join(directory, "journal.jsonl")
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
-                store = cls.restore(f.read())
+                store = cls.restore(f.read(), partition=partition)
         else:
-            store = cls()
+            store = cls(partition=partition)
         records, _good, _size = _scan_journal(journal_path)
         store._replay_records(records)
         return store

@@ -16,6 +16,24 @@ locks must acquire them in ascending rank):
     55      ``repl.follower``   `state/replication.py` — native-handle mutex
     ======  ==================  ==============================================
 
+    **Rank families** (the partitioned write plane, state/partition.py):
+    a bracketed suffix scopes a lock to one partition without changing
+    its rank — ``store[p0]``, ``store[p1]``, ``store.notify[p3]`` all
+    carry their base name's declared rank.  SIBLING locks of one family
+    (same base, different suffix — two partitions' store locks) carry
+    the SAME rank, and same-rank cross-acquisition is ambiguous by
+    construction: thread A holding ``store[p0]`` while taking
+    ``store[p1]`` and thread B doing the reverse is a textbook deadlock
+    the rank table cannot order.  The contract is therefore: **sibling
+    locks of a rank family may never nest in each other** (the
+    partitioned facade fans out sequentially, releasing each
+    partition's lock before the next) — the sanitizer reports any
+    sibling nesting as a ``sibling`` violation, and the bare base name
+    counts as a sibling of its bracketed forms (``store`` inside
+    ``store[p0]`` is equally unorderable).  Blocking-op allowlist
+    entries apply family-wide: ``("store", "os.fsync")`` covers every
+    ``store[pN]``.
+
 Canonical nestings this encodes: ``store.notify → store`` (the drain loop
 pops the event queue under the store lock), ``store.notify → index`` /
 ``store.notify → audit`` (tx-feed subscribers), ``store → audit``
@@ -76,6 +94,13 @@ ALLOWED_BLOCKING: Set[Tuple[str, str]] = {
 
 _MAX_VIOLATIONS = 256
 _MAX_BLOCKING_EVENTS = 256
+
+
+def family(name: str) -> str:
+    """A lock's rank family: the declared base name with any bracketed
+    per-instance suffix stripped (``store[p2]`` → ``store``).  Families
+    share one rank; siblings within a family may not nest (module doc)."""
+    return name.split("[", 1)[0]
 
 
 class LockOrderError(RuntimeError):
@@ -171,6 +196,22 @@ class LockMonitor:
                 f"'{dst.name}' (rank {dst.order}) acquired while holding "
                 f"'{src.name}' (rank {src.order}) — violates the declared "
                 "lock-order contract (utils/locks.py)")
+        elif (src.order is not None and dst.order is not None
+                and dst.order == src.order
+                and family(src.name) == family(dst.name)):
+            # SIBLING locks of one rank family (two partitions' store
+            # locks) are unorderable by construction: same rank, and the
+            # opposite nesting is equally "legal" — which is exactly the
+            # ABBA deadlock shape.  The partitioned-facade contract is
+            # strictly sequential fan-out (release p_i before acquiring
+            # p_{i+1}); any sibling nesting is a violation.
+            self._violation(
+                "sibling", src, dst,
+                f"'{dst.name}' acquired while holding sibling "
+                f"'{src.name}' (rank family "
+                f"'{family(src.name)}', rank {src.order}) — sibling "
+                "locks of a rank family may never nest "
+                "(utils/locks.py partitioned-store contract)")
 
     def _find_cycle(self, start: str,
                     target: str) -> Optional[List[str]]:
@@ -230,7 +271,8 @@ class LockMonitor:
         if not stack:
             return
         bad = [h.name for h in stack
-               if (h.name, op) not in self.allowed_blocking]
+               if (h.name, op) not in self.allowed_blocking
+               and (family(h.name), op) not in self.allowed_blocking]
         if not bad:
             return
         key = (op, tuple(bad))
@@ -433,12 +475,14 @@ _DECLARED_ORDER = {
 def named_lock(name: str, monitor: Optional[LockMonitor] = None
                ) -> NamedLock:
     """A :class:`NamedLock` with the rank declared in the module-doc
-    contract table (None = unordered, cycle detection only)."""
-    return NamedLock(name, order=_DECLARED_ORDER.get(name),
+    contract table (None = unordered, cycle detection only).  A
+    bracketed suffix (``store[p1]``) inherits its rank family's rank —
+    and the sibling no-nesting rule that comes with it."""
+    return NamedLock(name, order=_DECLARED_ORDER.get(family(name)),
                      monitor=monitor)
 
 
 def named_rlock(name: str, monitor: Optional[LockMonitor] = None
                 ) -> NamedRLock:
-    return NamedRLock(name, order=_DECLARED_ORDER.get(name),
+    return NamedRLock(name, order=_DECLARED_ORDER.get(family(name)),
                       monitor=monitor)

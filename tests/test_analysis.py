@@ -453,6 +453,77 @@ class TestLockSanitizer:
                 pass
         assert [v["kind"] for v in mon.violations] == ["order"]
 
+    def test_sibling_rank_family_nesting_fires(self):
+        """The partitioned-store rank rule (utils/locks.py contract):
+        ``store[p0]`` and ``store[p1]`` share the ``store`` family's
+        rank and may never nest in each other — same-rank siblings are
+        unorderable by construction (the ABBA shape)."""
+        mon = locks.LockMonitor()
+        p0 = locks.NamedLock("store[p0]", order=20, monitor=mon)
+        p1 = locks.NamedLock("store[p1]", order=20, monitor=mon)
+        with p0:
+            with p1:
+                pass
+        kinds = [v["kind"] for v in mon.violations]
+        assert "sibling" in kinds
+        v = next(v for v in mon.violations if v["kind"] == "sibling")
+        assert {v["from"], v["to"]} == {"store[p0]", "store[p1]"}
+        assert "rank family" in v["message"]
+
+    def test_sibling_rule_covers_bare_base_name(self):
+        """A bare ``store`` nesting into ``store[p0]`` is equally
+        unorderable: the bare base name is a sibling of its bracketed
+        forms."""
+        mon = locks.LockMonitor()
+        bare = locks.NamedLock("store", order=20, monitor=mon)
+        p0 = locks.NamedLock("store[p0]", order=20, monitor=mon)
+        with p0:
+            with bare:
+                pass
+        assert "sibling" in [v["kind"] for v in mon.violations]
+
+    def test_same_rank_different_family_is_legal(self):
+        """Equal rank alone is NOT a violation — only same-FAMILY
+        siblings are (two unrelated subsystems may share a rank
+        number)."""
+        mon = locks.LockMonitor()
+        a = locks.NamedLock("alpha", order=20, monitor=mon)
+        b = locks.NamedLock("beta[p0]", order=20, monitor=mon)
+        with a:
+            with b:
+                pass
+        assert mon.violations == []
+
+    def test_family_rank_lookup_and_blocking_allowlist(self):
+        """named_lock('store[p3]') inherits the store family's declared
+        rank, and the family-wide ALLOWED_BLOCKING entry ('store',
+        'os.fsync') covers every partition suffix."""
+        lk = locks.named_rlock("store[p3]", monitor=locks.LockMonitor())
+        assert lk.order == locks._DECLARED_ORDER["store"]
+        mon = locks.LockMonitor()
+        sub = locks.NamedLock("store[p3]", order=20, monitor=mon)
+        mon._note_acquired(sub)
+        try:
+            mon.note_blocking("os.fsync")      # family-allowlisted
+            assert mon.blocking_events == []
+            mon.note_blocking("time.sleep")    # still a violation
+            assert len(mon.blocking_events) == 1
+        finally:
+            mon._note_released(sub)
+
+    def test_partition_stores_carry_sibling_lock_names(self):
+        """The partitioned facade's shards are born into the store[pN]
+        family (state/partition.py) — the sanitizer covers the new
+        concurrency from day one."""
+        from cook_tpu.state import PartitionedStore, PartitionMap
+        from cook_tpu.state.store import Store
+        ps = PartitionedStore(
+            [Store(partition=0), Store(partition=1)],
+            PartitionMap(count=2))
+        assert [s._lock.name for s in ps.partitions] \
+            == ["store[p0]", "store[p1]"]
+        assert [s._lock.order for s in ps.partitions] == [20, 20]
+
     def test_rlock_locked_reports_owner_hold(self):
         mon = locks.LockMonitor()
         r = locks.NamedRLock("R", monitor=mon)
