@@ -1262,6 +1262,107 @@ def bench_gang_cycle(n_jobs=50_000, n_users=100, H=2500, gang_size=4,
     return out
 
 
+def bench_elastic_cycle(n_gangs=6, gang_size=6, gang_min=2, n_batch=120,
+                        H=12, host_cpus=8.0, span_ms=60_000,
+                        train_ms=60_000, batch_ms=5_000,
+                        horizon_ms=90_000):
+    """Elastic vs rigid gang goodput on ONE mixed batch+training
+    workload (docs/GANG.md elasticity): long-running training gangs
+    contending with a batch-job churn on a deliberately undersized
+    fleet.  The rigid leg places a gang only when all ``gang_size``
+    members fit at once; the elastic leg places at ``gang_min``, grows
+    into freed capacity, and shrinks instead of dying.  Each leg reports
+    placed-member goodput (member-time run / member-time demanded),
+    busy-capacity utilization, the resize rate, and match-cycle
+    p50/p99 — decisions compare on the virtual clock, cycle cost on the
+    wall clock, per the simulator's standing contract."""
+    from cook_tpu.config import Config
+    from cook_tpu.sim.simulator import Simulator, load_hosts
+    from cook_tpu.state import Group, Job, Resources
+
+    def make_world(elastic: bool):
+        rng = np.random.default_rng(31)
+        jobs, groups = [], {}
+        for g in range(n_gangs):
+            guuid = f"gang-{g}"
+            submit = int(rng.integers(0, span_ms // 2))
+            members = [Job(
+                uuid=f"{guuid}-m{i}", user=f"train{g % 2}",
+                command="train", group=guuid,
+                resources=Resources(cpus=4.0, mem=1024.0),
+                submit_time_ms=submit,
+                labels={"sim/duration_ms": str(train_ms)})
+                for i in range(gang_size)]
+            groups[guuid] = Group(
+                uuid=guuid, gang=True, gang_size=gang_size,
+                gang_min=gang_min if elastic else 0,
+                gang_max=gang_size if elastic else 0,
+                jobs=[m.uuid for m in members])
+            jobs.extend(members)
+        for b in range(n_batch):
+            jobs.append(Job(
+                uuid=f"batch-{b}", user=f"user{b % 8:02d}",
+                command="batch",
+                resources=Resources(cpus=float(rng.integers(1, 3)),
+                                    mem=256.0),
+                submit_time_ms=int(rng.integers(0, span_ms)),
+                labels={"sim/duration_ms": str(
+                    int(rng.exponential(batch_ms)) + 500)}))
+        jobs.sort(key=lambda j: j.submit_time_ms)
+        hosts = load_hosts([
+            {"hostname": f"h{i}", "cpus": host_cpus, "mem": 16384.0}
+            for i in range(H)])
+        return jobs, groups, hosts
+
+    def run_leg(elastic: bool):
+        jobs, groups, hosts = make_world(elastic)
+        sim = Simulator(jobs, hosts, config=Config(), backend="cpu",
+                        groups=groups)
+        # FIXED virtual horizon: both legs bank whatever member-time
+        # they can inside the same window (running tasks count their
+        # elapsed time), so a rigid gang stuck waiting shows up as lost
+        # goodput instead of just a longer makespan
+        res = sim.run(until_ms=horizon_ms)
+        s = res.summary()
+        virt_min = max(res.makespan_ms / 60_000.0, 1e-9)
+        g = res.goodput
+        return {
+            "goodput_members": round(g.get("gang_goodput", 0.0), 4),
+            "util": round(g.get("util", 0.0), 4),
+            "grows": g.get("grows", 0),
+            "shrinks": g.get("shrinks", 0),
+            "resizes_per_virtual_min": round(
+                (g.get("grows", 0) + g.get("shrinks", 0)) / virt_min, 2),
+            "preemptions": res.preemptions,
+            "completed": res.completed,
+            "total": res.total,
+            "makespan_virtual_s": round(res.makespan_ms / 1000.0, 1),
+            "match_p50_ms": round(s["match_cycle_p50_ms"], 2),
+            "match_p99_ms": round(s["match_cycle_p99_ms"], 2),
+        }
+
+    rigid = run_leg(False)
+    elastic = run_leg(True)
+    out = {
+        "rigid": rigid,
+        "elastic": elastic,
+        "workload": {"gangs": n_gangs, "gang_size": gang_size,
+                     "gang_min": gang_min, "batch_jobs": n_batch,
+                     "hosts": H, "host_cpus": host_cpus},
+        # THE acceptance ratio (ISSUE 13): elastic placed-member goodput
+        # over rigid on the same workload/fleet
+        "goodput_gain": round(
+            elastic["goodput_members"]
+            / max(rigid["goodput_members"], 1e-9), 2)
+        if rigid["goodput_members"] > 0 else None,
+    }
+    print(f"elastic_cycle rigid_goodput={rigid['goodput_members']} "
+          f"elastic_goodput={elastic['goodput_members']} "
+          f"grows={elastic['grows']} shrinks={elastic['shrinks']} "
+          f"p99={elastic['match_p99_ms']}ms", file=sys.stderr)
+    return out
+
+
 def bench_rebalance(T=1_000_000, H=50_000):
     """Preemption victim scan over 1M running tasks on 50k hosts."""
     import jax.numpy as jnp
@@ -2226,6 +2327,10 @@ def run_section(name: str) -> None:
         data = bench_gang_cycle(n_jobs=scaled(50_000),
                                 n_users=scaled(100, lo=8),
                                 H=scaled(2500))
+    elif name == "elastic_cycle":
+        # decision-quality comparison on the virtual clock: already
+        # small, runs identically under the CPU fallback (no scaling)
+        data = bench_elastic_cycle()
     elif name == "rest_plane":
         data = bench_rest_plane(submit_total=scaled(2000, lo=100),
                                 read_total=scaled(3000, lo=200),
@@ -2362,6 +2467,8 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["pipeline_driver_100k_jobs"] = results["pipeline_driver"]
     if results.get("gang_cycle") is not None:
         detail["gang_cycle_50k_jobs"] = results["gang_cycle"]
+    if results.get("elastic_cycle") is not None:
+        detail["elastic_cycle"] = results["elastic_cycle"]
     if results.get("pipeline") is not None:
         detail["pipeline_10cycle"] = results["pipeline"]
     if results.get("placement_quality") is not None:
@@ -2457,9 +2564,10 @@ def main():
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle",
                 "resident_cycle", "pipeline_driver", "gang_cycle",
-                "rest_plane", "fused_cycle", "store_cycle", "store_scale",
-                "match_large", "rebalance", "end2end", "pallas_scale",
-                "pipeline", "placement_quality"]
+                "elastic_cycle", "rest_plane", "fused_cycle",
+                "store_cycle", "store_scale", "match_large", "rebalance",
+                "end2end", "pallas_scale", "pipeline",
+                "placement_quality"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

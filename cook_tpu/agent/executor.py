@@ -91,7 +91,8 @@ class TaskExecutor:
                  progress_publish: Optional[Callable] = None,
                  progress_file: Optional[str] = None,
                  kill_grace_period_s: float = 2.0,
-                 shell: str = "/bin/sh"):
+                 shell: str = "/bin/sh",
+                 resize_file: Optional[str] = None):
         self.command = command
         self.sandbox = Path(sandbox)
         self.env = dict(env or {})
@@ -103,6 +104,12 @@ class TaskExecutor:
         # EXECUTOR_PROGRESS_OUTPUT_FILE location)
         self.progress_file = (self.sandbox / progress_file
                               if progress_file else None)
+        # elastic-gang resize event file (docs/GANG.md elasticity): the
+        # checkpoint/grace protocol appends one JSON line per resize
+        # advisory here, and its path is advertised to the task as
+        # COOK_GANG_RESIZE_FILE before the fork
+        self.resize_file = (self.sandbox / resize_file
+                            if resize_file else None)
         self.process: Optional[subprocess.Popen] = None
         self.exit_code: Optional[int] = None
         self._reader_threads = []
@@ -117,6 +124,11 @@ class TaskExecutor:
         if self.progress_file is not None:
             # advertised BEFORE the fork so the task can locate its file
             env["EXECUTOR_PROGRESS_OUTPUT_FILE"] = str(self.progress_file)
+        if self.resize_file is not None:
+            # advertised BEFORE the fork so an elastic-gang workload can
+            # watch for resize advisories (docs/GANG.md: SIGUSR1 says
+            # "look at the file"; the file says what is happening)
+            env["COOK_GANG_RESIZE_FILE"] = str(self.resize_file)
         self.process = subprocess.Popen(
             [self.shell, "-c", self.command],
             cwd=str(self.sandbox), env=env,
@@ -210,6 +222,26 @@ class TaskExecutor:
                 pass
         return self.wait(timeout_s=10) or self.process.returncode
 
+    def notify_resize(self, event: Dict) -> None:
+        """Relay an elastic-gang resize advisory to the workload
+        (docs/GANG.md checkpoint/grace protocol): append one JSON line
+        to the resize file, then SIGUSR1 the task's process group so a
+        checkpoint-aware trainer wakes up and reads it.  Best-effort on
+        both legs — the shrink itself executes through the ordinary
+        kill at the grace deadline regardless."""
+        if self.resize_file is not None:
+            try:
+                line = json.dumps({"ts": time.time(), **event})
+                with open(self.resize_file, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+        if self.process is not None and self.process.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.process.pid), signal.SIGUSR1)
+            except (ProcessLookupError, PermissionError):
+                pass
+
     @property
     def running(self) -> bool:
         return self.process is not None and self.process.poll() is None
@@ -226,7 +258,16 @@ def main(argv=None) -> int:
       COOK_PROGRESS_URL        scheduler base URL for POST /progress/:id
       COOK_PROGRESS_REGEX      per-job regex (:job/progress-regex-string)
       COOK_PROGRESS_FILE       per-job explicit progress file
+      COOK_GANG_UUID/MIN/MAX   gang membership + elastic bounds (set by
+                               the launch path, docs/GANG.md)
+      COOK_GANG_RESIZE_FILE    resize-advisory file name (default
+                               ``.cook-gang-resize.jsonl`` for gang
+                               members; re-advertised to the task as an
+                               absolute sandbox path)
     The command is argv (joined), exit code is the task's exit code.
+    SIGUSR1 relays an elastic shrink advisory (checkpoint window open):
+    the event is appended to the resize file and the signal forwarded to
+    the task's process group (docs/GANG.md checkpoint/grace protocol).
     """
     import sys
 
@@ -242,12 +283,16 @@ def main(argv=None) -> int:
     api_url = os.environ.get("COOK_PROGRESS_URL", "")
     if api_url and task_id:
         publish = rest_progress_publisher(api_url, task_id)
+    resize_file = os.environ.get("COOK_GANG_RESIZE_FILE") or (
+        ".cook-gang-resize.jsonl" if os.environ.get("COOK_GANG_UUID")
+        else None)
     ex = TaskExecutor(
         command, sandbox=sandbox,
         progress_regex=os.environ.get("COOK_PROGRESS_REGEX",
                                       DEFAULT_PROGRESS_REGEX),
         progress_publish=publish,
-        progress_file=os.environ.get("COOK_PROGRESS_FILE") or None)
+        progress_file=os.environ.get("COOK_PROGRESS_FILE") or None,
+        resize_file=resize_file)
 
     # The agent kills tasks by signalling the WRAPPER's process group, but
     # TaskExecutor puts the user command in its own session — forward the
@@ -260,6 +305,17 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, forward_kill)
     signal.signal(signal.SIGINT, forward_kill)
+
+    # SIGUSR1 = elastic shrink advisory from the agent (docs/GANG.md
+    # checkpoint/grace): relay to the workload — file event + forwarded
+    # signal — and keep running; the kill comes separately at the grace
+    # deadline
+    def forward_resize(_signum, _frame):
+        ex.notify_resize({"kind": "gang-resize", "direction": "shrink",
+                          "gang": os.environ.get("COOK_GANG_UUID", ""),
+                          "signal": "SIGUSR1"})
+
+    signal.signal(signal.SIGUSR1, forward_resize)
     ex.start()
     code = None
     while code is None:

@@ -183,7 +183,8 @@ def cmd_submit(args) -> int:
                   "combined with a command argument", file=sys.stderr)
             return 1
         if (args.gang_size is not None or args.gang_topology
-                or args.gang_policy):
+                or args.gang_policy or args.gang_min is not None
+                or args.gang_max is not None):
             print("error: gang flags do not apply to --raw specs; "
                   'submit a full body {"jobs": [...], "groups": [{..., '
                   '"gang": {...}}]} instead', file=sys.stderr)
@@ -292,10 +293,27 @@ def cmd_submit(args) -> int:
                 gang["topology"] = args.gang_topology
             if args.gang_policy:
                 gang["policy"] = args.gang_policy
+            # elastic bounds (docs/GANG.md elasticity): the server
+            # validates 1 <= min <= max <= size; pre-check the obvious
+            # inversions here for a clearer error than a 400
+            if args.gang_min is not None:
+                if args.gang_min < 1 or args.gang_min > args.gang_size:
+                    print("error: --gang-min must be in "
+                          "[1, --gang-size]", file=sys.stderr)
+                    return 1
+                gang["min"] = args.gang_min
+            if args.gang_max is not None:
+                if args.gang_max > args.gang_size \
+                        or args.gang_max < (args.gang_min or 1):
+                    print("error: --gang-max must be in "
+                          "[--gang-min, --gang-size]", file=sys.stderr)
+                    return 1
+                gang["max"] = args.gang_max
             groups = [{"uuid": guuid, "gang": gang}]
-        elif args.gang_topology or args.gang_policy:
-            print("error: --gang-topology/--gang-policy require "
-                  "--gang-size", file=sys.stderr)
+        elif args.gang_topology or args.gang_policy \
+                or args.gang_min is not None or args.gang_max is not None:
+            print("error: --gang-topology/--gang-policy/--gang-min/"
+                  "--gang-max require --gang-size", file=sys.stderr)
             return 1
     client = clients(args)[0]
     uuids = client.submit(specs, groups=groups)
@@ -629,7 +647,11 @@ def cmd_debug(args) -> int:
     counters, audit queue depth) replacing five /debug/* fetches;
     ``cs debug requests`` lists the serving plane's recent + slow
     captured requests with per-phase breakdowns
-    (docs/OBSERVABILITY.md)."""
+    (docs/OBSERVABILITY.md); ``cs debug optimizer`` dumps the goodput
+    loop's decision panel — last per-pool decisions (grow budget,
+    shrink pressure, preemption budget, autoscale target), cycle
+    counts/errors, and the elastic resize plane's live state
+    (docs/GANG.md elasticity)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
@@ -645,6 +667,9 @@ def cmd_debug(args) -> int:
         return 0
     if args.debug_cmd == "requests":
         out(client.debug_requests(limit=args.limit))
+        return 0
+    if args.debug_cmd == "optimizer":
+        out(client.debug_optimizer())
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -885,6 +910,13 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["requeue", "kill"],
                     help="what a member failure does to the rest of the "
                          "gang (default requeue)")
+    sp.add_argument("--gang-min", dest="gang_min", type=int,
+                    help="ELASTIC gang: minimum member count the gang "
+                         "may legally run at (docs/GANG.md elasticity; "
+                         "default = --gang-size, i.e. rigid)")
+    sp.add_argument("--gang-max", dest="gang_max", type=int,
+                    help="ELASTIC gang: maximum member count to grow "
+                         "to (default = --gang-size)")
     sp.add_argument("--raw", action="store_true",
                     help="read full JSON job spec(s) from stdin")
     sp.add_argument("--command-prefix", dest="command_prefix",
@@ -999,7 +1031,7 @@ def build_parser() -> argparse.ArgumentParser:
                                       "failover panel")
     sp.add_argument("debug_cmd",
                     choices=["cycles", "trace", "faults", "replication",
-                             "health", "requests"])
+                             "health", "requests", "optimizer"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

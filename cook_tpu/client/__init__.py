@@ -582,3 +582,9 @@ class JobClient:
         offsets, min_acked, synced set, mirror position, and the
         candidate positions published into the election medium."""
         return self._request("GET", "/debug/replication")
+
+    def debug_optimizer(self) -> Dict:
+        """GET /debug/optimizer — the goodput loop's decision panel:
+        last per-pool decisions, cycle counts/errors, and the elastic
+        resize plane's live state (docs/GANG.md elasticity)."""
+        return self._request("GET", "/debug/optimizer")

@@ -150,6 +150,15 @@ class ComputeCluster(abc.ABC):
         finally:
             self.kill_lock.release_write()
 
+    def notify_task(self, task_id: str, event: Dict) -> None:
+        """Best-effort advisory delivery to a RUNNING task — the elastic
+        resize plane's checkpoint warning (docs/GANG.md elasticity: the
+        agent relays SIGUSR1 + a ``COOK_GANG_RESIZE_FILE`` event so the
+        workload can checkpoint inside the grace window).  Never
+        load-bearing: a lost notification only costs the workload its
+        checkpoint opportunity, the shrink itself executes through the
+        ordinary kill path at the grace deadline.  Default: drop."""
+
     # -- capacity (Kenzo-style direct mode backpressure) --------------------
     def max_launchable(self, pool: str) -> int:
         """Headroom for direct-mode submission (reference:

@@ -63,6 +63,9 @@ class FakeCluster(ComputeCluster):
         self.job_durations_ms: Dict[str, int] = {}
         self.task_exit_codes: Dict[str, int] = {}
         self.launched_order: List[str] = []
+        # task_id -> advisory notify_task events delivered while running
+        # (the elastic resize plane's checkpoint warnings, docs/GANG.md)
+        self.notifications: Dict[str, List[Dict]] = {}
         # per-host consumption/counts maintained incrementally on
         # launch/complete/kill: recomputing from _tasks and re-running the
         # generator-based Resources arithmetic for every host cost 25-50 ms
@@ -217,6 +220,15 @@ class FakeCluster(ComputeCluster):
             task = self._pop_task(task_id)
         if task is not None:
             self._emit(task_id, InstanceStatus.FAILED, Reasons.KILLED_BY_USER.code)
+
+    def notify_task(self, task_id: str, event: Dict) -> None:
+        """Record resize notifications per task so tests/sim can assert
+        the checkpoint warning reached a still-running member (the fake
+        analog of the agent's SIGUSR1 + resize-file relay)."""
+        with self._lock:
+            if task_id in self._tasks:
+                self.notifications.setdefault(task_id, []).append(
+                    dict(event))
 
     # ---------------------------------------------------------- virtual time
     def advance_to(self, now_ms: int) -> List[str]:

@@ -507,6 +507,47 @@ class HttpConfig:
 
 
 @dataclass
+class ElasticConfig:
+    """Elastic-gang resize knobs (sched/elastic.py; the daemon's
+    ``"elastic"`` conf section, boot-validated like the sections around
+    it).  docs/GANG.md elasticity."""
+
+    #: master switch: off = elastic bounds are still validated/stored
+    #: but the resize plane (grow metering, grace shrinks, rebalancer
+    #: shrink-instead-of-kill) never engages
+    enabled: bool = True
+    #: checkpoint grace between the shrink notification (SIGUSR1 +
+    #: COOK_GANG_RESIZE_FILE event) and the member's kill.  0 = shed
+    #: immediately (tests/sim).
+    shrink_grace_seconds: float = 5.0
+    #: resize-pass cadence when driven by wall-clock threads (the fused
+    #: cycle also sweeps every cycle)
+    resize_interval_seconds: float = 5.0
+
+    def __post_init__(self):
+        for k in ("shrink_grace_seconds", "resize_interval_seconds"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"elastic {k} must be >= 0")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "ElasticConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown elastic key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"elastic key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -602,6 +643,15 @@ class Config:
     # independent fsync streams + leases (state/partition.py); count=1 =
     # the classic single-store plane
     partitions: PartitionConfig = field(default_factory=PartitionConfig)
+    # elastic-gang resize plane (sched/elastic.py, docs/GANG.md
+    # elasticity): grace-shrink protocol + optimizer-set budgets
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    # the real optimizer loop (sched/optimizer.py): a
+    # ``sched.optimizer.OptimizerConfig`` when the daemon's "optimizer"
+    # conf section enables it, else None (loop off).  Held untyped here
+    # because config.py must not import the sched package (cycle); the
+    # daemon boot-validates the section via OptimizerConfig.from_conf.
+    optimizer: Optional[object] = None
     # executor heartbeat timeout killer (mesos/heartbeat.clj:66-147);
     # disabled by default like the reference (marked deprecated there)
     heartbeat_enabled: bool = False

@@ -145,6 +145,20 @@ def build_scheduler_config(spec: Dict) -> Config:
         # index fails the boot, not the first submission to that pool
         from .config import PartitionConfig
         cfg.partitions = PartitionConfig.from_conf(spec["partitions"])
+    if "elastic" in spec:
+        # elastic-gang resize plane (docs/GANG.md elasticity): grace
+        # window + resize cadence; a typo'd knob fails the boot like
+        # the sections above
+        from .config import ElasticConfig
+        cfg.elastic = ElasticConfig.from_conf(spec["elastic"])
+    if "optimizer" in spec:
+        # the goodput optimizer loop (sched/optimizer.py): factories,
+        # interval, and the nested goodput knobs are ALL validated at
+        # boot — from_conf constructs the cycler once, so a typo'd
+        # candidate list or a non-positive interval fails here, not at
+        # the first cycle half a minute into leadership
+        from .sched.optimizer import OptimizerConfig
+        cfg.optimizer = OptimizerConfig.from_conf(spec["optimizer"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

@@ -55,14 +55,22 @@ F32 = np.float32
 class GangPack:
     """Host-side gang arrays for one match batch (built only when the
     batch actually contains gang members — the no-gang path never
-    allocates any of this)."""
+    allocates any of this).
+
+    ``gang_size`` is the REDUCTION THRESHOLD — the member count below
+    which the gang drops whole.  For rigid gangs that is the declared
+    ``gang_size``; for elastic gangs it is ``gang_min`` (docs/GANG.md
+    elasticity: the segment reduction compares against min; members
+    matched beyond min simply keep their placements as surplus).
+    ``declared`` carries the full declared size for stats/explainers."""
 
     gang_id: np.ndarray          # i32[J], -1 = not a gang member
-    gang_size: np.ndarray        # i32[G]
+    gang_size: np.ndarray        # i32[G] reduction threshold (min)
     gang_attr: np.ndarray        # i32[G] row into host_topo, 0 = none
     host_topo: np.ndarray        # i32[A, H] topology code, -1 = absent
     uuids: List[str]             # gang segment -> group uuid
     topology: List[Optional[str]]  # gang segment -> requested attribute
+    declared: List[int] = None   # gang segment -> declared gang_size
 
 
 @dataclass
@@ -76,23 +84,32 @@ class GangStats:
     partial: Dict[str, Dict] = field(default_factory=dict)
 
 
-def build_gang_pack(jobs, groups: Dict[str, object],
-                    offers) -> Optional[GangPack]:
+def build_gang_pack(jobs, groups: Dict[str, object], offers,
+                    satisfied=None) -> Optional[GangPack]:
     """Gang arrays for a match batch, or None when no job in the batch
     belongs to a gang group (the structural no-op guard that keeps
-    non-gang workloads decision-identical)."""
+    non-gang workloads decision-identical).
+
+    ``satisfied`` (docs/GANG.md elasticity): group uuids of ELASTIC
+    gangs already running at >= gang_min live members.  Their waiting
+    members in this batch are the GROW path — they place individually
+    like group-less jobs, so they are excluded from the pack entirely
+    (no cohort gate to fail, no reduction to reset them)."""
     # membership scan FIRST: the gang-free majority must bail before
     # the [J] array below is allocated (a 100k-job gang-free pool would
     # otherwise pay it every match cycle just to hear "None")
     member_rows = [j for j, job in enumerate(jobs)
                    if getattr(job, "group", None)
-                   and getattr(groups.get(job.group), "gang", False)]
+                   and getattr(groups.get(job.group), "gang", False)
+                   and not (satisfied and job.group in satisfied)]
     if not member_rows:
         return None
+    from ..state.schema import gang_bounds
     J = len(jobs)
     gang_id = np.full(J, -1, dtype=np.int32)
     uuids: List[str] = []
     sizes: List[int] = []
+    declared: List[int] = []
     topo_names: List[Optional[str]] = []
     seg: Dict[str, int] = {}
     for j in member_rows:
@@ -102,7 +119,10 @@ def build_gang_pack(jobs, groups: Dict[str, object],
         if k is None:
             k = seg[guuid] = len(uuids)
             uuids.append(guuid)
-            sizes.append(int(getattr(g, "gang_size", 0) or 0))
+            # the reduction gates on the effective MINIMUM: rigid gangs
+            # read min == declared size, bit-identically
+            sizes.append(gang_bounds(g)[0])
+            declared.append(int(getattr(g, "gang_size", 0) or 0))
             topo_names.append(getattr(g, "gang_topology", None) or None)
         gang_id[j] = k
     # topology code table: one row per distinct requested attribute,
@@ -124,7 +144,8 @@ def build_gang_pack(jobs, groups: Dict[str, object],
     return GangPack(gang_id=gang_id,
                     gang_size=np.array(sizes, dtype=np.int32),
                     gang_attr=gang_attr, host_topo=host_topo,
-                    uuids=uuids, topology=topo_names)
+                    uuids=uuids, topology=topo_names,
+                    declared=declared)
 
 
 # ------------------------------------------------------------------ device
@@ -202,6 +223,7 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
                      refill_ok: Optional[np.ndarray] = None,
                      audit_trail=None,
                      audit_pool: Optional[str] = None,
+                     satisfied=None,
                      ) -> Tuple[np.ndarray, Optional[GangStats]]:
     """The full per-cycle gang pass: reduce partial gangs to nothing and
     refill the freed capacity with still-unmatched group-less jobs.
@@ -211,8 +233,13 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
     decision-identical.  ``cmask_fn``/``avail``/``capacity`` feed the
     refill pass and may be omitted to skip it (the caller then re-offers
     freed capacity next cycle instead).
+
+    ``satisfied``: group uuids of elastic gangs already running at >=
+    gang_min — their waiting members bypass the reduction (grow path)
+    and join the refill pool like group-less jobs (docs/GANG.md
+    elasticity).
     """
-    pack = build_gang_pack(jobs, groups, offers)
+    pack = build_gang_pack(jobs, groups, offers, satisfied=satisfied)
     if pack is None:
         return assign, None
     assign = np.asarray(assign, dtype=np.int32)
@@ -279,10 +306,17 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
                                kind="stable")
             trial = reference_impl.greedy_match(
                 res_f[rows][order], sub_mask[order], avail_left, cap_f)
-            if np.all(trial >= 0):
+            # acceptance threshold = the reduction threshold: rigid
+            # gangs have exactly `need` rows here so this is the old
+            # all-assigned test bit-for-bit; an elastic gang accepts a
+            # partial packing of >= gang_min members (the unassigned
+            # surplus simply stays unmatched, docs/GANG.md elasticity)
+            hit = trial >= 0
+            if int(hit.sum()) >= int(pack.gang_size[g]):
                 out[rows[order]] = trial
                 dropped[rows] = False
-                np.subtract.at(avail_left, trial, res_f[rows][order])
+                np.subtract.at(avail_left, trial[hit],
+                               res_f[rows][order][hit])
                 avail_left = np.maximum(avail_left, 0.0)
     stats = GangStats()
     member = pack.gang_id >= 0
@@ -291,18 +325,24 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
     for g, guuid in enumerate(pack.uuids):
         rows = pack.gang_id == g
         matched = int(matched_before[rows].sum())
-        size = int(pack.gang_size[g])
-        if int(matched_final[rows].sum()) >= size \
+        # need = reduction threshold (gang_min); size = declared size.
+        # Rigid gangs read need == size, so the entry is unchanged.
+        need = int(pack.gang_size[g])
+        size = int(pack.declared[g]) if pack.declared else need
+        if int(matched_final[rows].sum()) >= need \
                 and not dropped[rows].any():
             continue  # placed whole (directly or via the rescue pass)
         # topology_blocked: every member matched but the reduction still
         # dropped them — the placements straddled topology domains (or
         # landed outside any), i.e. no single slice took them all
-        stats.partial[guuid] = {
+        entry = {
             "size": size, "matched": matched,
-            "missing": max(size - matched, 0),
-            "topology_blocked": bool(matched >= size
+            "missing": max(need - matched, 0),
+            "topology_blocked": bool(matched >= need
                                      and dropped[rows].any())}
+        if need != size:
+            entry["min"] = need
+        stats.partial[guuid] = entry
     stats.dropped_jobs = int(dropped.sum())
     stats.dropped_gangs = len(
         {int(g) for g in pack.gang_id[dropped]})
@@ -330,8 +370,13 @@ def apply_gang_cycle(jobs, assign: np.ndarray, offers,
                 np.subtract.at(avail_after, out[taken],
                                np.asarray(job_res, dtype=F32)[taken])
             avail_after = np.maximum(avail_after, 0.0)
+            # group-less jobs — plus the grow members of SATISFIED
+            # elastic gangs, which the elasticity contract says refill
+            # exactly like group-less jobs (docs/GANG.md)
             eligible = ((out < 0) & ~dropped
                         & np.array([not getattr(j, "group", None)
+                                    or bool(satisfied
+                                            and j.group in satisfied)
                                     for j in jobs], dtype=bool))
             if refill_ok is not None:
                 # the caller vetoes rows whose unmatched state is not a

@@ -124,6 +124,9 @@ API_ROUTES = [
     ("GET", "/debug/health",
      "one-shot health roll-up: SLO burn rates, breakers, replication "
      "lag, pipeline depth, repack counters, audit queue depth", False),
+    ("GET", "/debug/optimizer",
+     "goodput optimizer panel: last per-pool decisions, cycle "
+     "counts/errors, elastic resize plane state", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
@@ -519,8 +522,12 @@ def parse_group_spec(gspec: Dict, job_uuids: List[str]) -> Group:
     straggler-handling (reference: rest/api.clj:489-514 HostPlacement/
     StragglerHandling schemas + :925 make-group-txn), and the gang block
     (docs/GANG.md): ``{"gang": {"size": N, "topology": attr?,
-    "policy": "requeue"|"kill"}}`` declares an all-or-nothing multi-host
-    slice job; malformed gang specs are a clear 400."""
+    "policy": "requeue"|"kill", "min": M?, "max": X?}}`` declares an
+    all-or-nothing multi-host slice job; ``min``/``max`` relax it to an
+    ELASTIC gang legal at any member count in ``[min, max]``
+    (docs/GANG.md elasticity; ``1 <= min <= max <= size``, both default
+    to ``size`` — the rigid contract).  Malformed gang specs are a
+    clear 400."""
     try:
         group = Group(uuid=gspec["uuid"],
                       name=gspec.get("name", "defaultgroup"),
@@ -543,14 +550,32 @@ def parse_group_spec(gspec: Dict, job_uuids: List[str]) -> Group:
             if policy not in GANG_POLICIES:
                 raise ApiError(
                     400, f"gang.policy must be one of {GANG_POLICIES}")
-            unknown = set(gang) - {"size", "topology", "policy"}
+            unknown = set(gang) - {"size", "topology", "policy",
+                                   "min", "max"}
             if unknown:
                 raise ApiError(400, "unknown gang spec key(s): "
                                     f"{sorted(unknown)}")
+            # elastic bounds (docs/GANG.md elasticity): unset = rigid
+            lo = gang.get("min", 0)
+            hi = gang.get("max", 0)
+            for key, v in (("min", lo), ("max", hi)):
+                if key in gang and (not isinstance(v, int)
+                                    or isinstance(v, bool) or v < 1):
+                    raise ApiError(400, f"gang.{key} must be an integer "
+                                        ">= 1 (or omitted)")
+            if (lo or size) > (hi or size):
+                raise ApiError(400, "gang.min must be <= gang.max")
+            if lo > size or hi > size:
+                raise ApiError(
+                    400, "gang.min/gang.max cannot exceed gang.size — "
+                         "the co-submitted members ARE the maximum "
+                         "membership (docs/GANG.md elasticity)")
             group.gang = True
             group.gang_size = size
             group.gang_topology = topology
             group.gang_policy = policy
+            group.gang_min = lo
+            group.gang_max = hi
         hp = gspec.get("host-placement") or gspec.get("host_placement")
         if hp:
             try:
@@ -1764,6 +1789,44 @@ class CookApi:
                     queue_limits=self.queue_limits)
         return out
 
+    def debug_optimizer(self) -> Dict:
+        """GET /debug/optimizer — the goodput loop's decision panel
+        (`cs debug optimizer` renders it; docs/GANG.md elasticity):
+        cycle counts + last error, the last per-pool decisions (grow
+        budget, shrink pressure, preemption budget, autoscale target,
+        candidate scores), the legacy observational schedule, and the
+        elastic resize plane's live state (pending grace shrinks,
+        standing budgets, grow/shrink totals)."""
+        sched = self.scheduler
+        if sched is None:
+            raise ApiError(503, "no scheduler attached (not the leader)")
+        out: Dict[str, Any] = {
+            "enabled": sched.config.optimizer is not None,
+            "elastic": sched.elastic.debug(),
+        }
+        cyc = sched.optimizer_cycler
+        if cyc is None:
+            return out
+        decisions = getattr(cyc.optimizer, "last_decisions", {})
+        schedule = None
+        if cyc.last_schedule is not None:
+            # HostInfo keys are not JSON; render them
+            schedule = {
+                str(period): {
+                    "suggested-matches": [
+                        {"host": vars(hi), "jobs": list(uuids)}
+                        for hi, uuids in step["suggested-matches"].items()]}
+                for period, step in cyc.last_schedule.items()}
+        out.update({
+            "cycles": cyc.cycles,
+            "interval_seconds": cyc.interval_seconds,
+            "last_error": (repr(cyc.last_error)
+                           if cyc.last_error is not None else None),
+            "decisions": {p: d.to_dict() for p, d in decisions.items()},
+            "last_schedule": schedule,
+        })
+        return out
+
     def debug_faults(self) -> Dict:
         """GET /debug/faults — degradation panel: armed fault points and
         their trigger counts, per-cluster circuit-breaker states, and open
@@ -2543,6 +2606,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_requests(params)
             if path == "/debug/health":
                 return api.debug_health()
+            if path == "/debug/optimizer":
+                return api.debug_optimizer()
             if len(parts) == 4 and parts[0] == "debug" \
                     and parts[1] == "job" and parts[3] == "timeline":
                 return api.debug_job_timeline(parts[2])

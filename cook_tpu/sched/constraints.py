@@ -325,6 +325,27 @@ def build_constraint_mask(jobs: List[Job], offers: List[Offer],
         group = ctx.groups[guuid]
         attr = group.gang_topology
         col = attr_col(attr)
+        # ELASTIC gangs with members already RUNNING (the grow path,
+        # docs/GANG.md elasticity) are pinned to the topology domain the
+        # gang occupies — a grow member landing in a different slice
+        # would violate the equality invariant the reduction no longer
+        # checks for satisfied gangs.  Rigid gangs never grow, so this
+        # is elastic-only and cannot perturb rigid decisions.
+        from ..state.schema import gang_is_elastic
+        if gang_is_elastic(group):
+            run_vals = set()
+            for hn in ctx.group_running_hosts.get(guuid, ()):
+                h = host_index.get(hn)
+                if h is not None:
+                    run_vals.add(col[h])
+                else:
+                    v = ctx.host_attributes.get(hn, {}).get(attr)
+                    if v is not None:
+                        run_vals.add(v)
+            run_vals.discard(None)
+            if len(run_vals) == 1:
+                mask[rows] &= (col == next(iter(run_vals)))[None, :]
+                continue
         # size members by the elementwise-MAX demand across the gang and
         # gate hosts on EVERY member's constraint row: conservative for
         # heterogeneous gangs (may undercount capacity), but a domain

@@ -894,21 +894,84 @@ class FusedCycleDriver:
         deferred_why: Dict[str, Dict] = {}
         skipped: List = []
         if members_by_gang:
+            from ..state.schema import gang_bounds, gang_is_elastic
+            from .elastic import satisfied_gangs
             mc = self.config.matcher_for_pool(pool.name)
             backoff = self.matcher._backoff.setdefault(
                 pool.name, _BackoffState(mc.max_jobs_considered))
             nc = min(backoff.num_considerable, mc.max_jobs_considered)
+            mgr = self.matcher.elastic
+            if mgr is not None:
+                mgr.start_pool_cycle(pool.name)
+            satisfied = satisfied_gangs(
+                self.store, {guuid: groups_ctx.get(guuid)
+                             for guuid in members_by_gang
+                             if groups_ctx.get(guuid) is not None}) or set()
             for guuid, members in members_by_gang.items():
                 g = groups_ctx.get(guuid)
-                size = int(getattr(g, "gang_size", 0) or 0) \
-                    if getattr(g, "gang", False) else 0
+                if not getattr(g, "gang", False):
+                    continue
+                if guuid in satisfied:
+                    # GROW path (docs/GANG.md elasticity): the gang runs
+                    # at >= min, so its waiting members admit like
+                    # singles — capped at gang_max, then metered by the
+                    # optimizer's grow budget
+                    headroom = self.store.gang_growth_headroom(guuid)
+                    grow_skipped: List[str] = []
+                    max_skipped: List[str] = []
+                    for row, j in members:
+                        if not launch_ok[row]:
+                            continue
+                        if headroom < 1:
+                            launch_ok[row] = False
+                            max_skipped.append(j.uuid)
+                            continue
+                        if mgr is not None \
+                                and not mgr.admit_grow(pool.name):
+                            launch_ok[row] = False
+                            grow_skipped.append(j.uuid)
+                            continue
+                        headroom -= 1
+                    reasons = {}
+                    if grow_skipped:
+                        reasons["gang-grow-deferred"] = grow_skipped
+                    if max_skipped:
+                        reasons["gang-at-max"] = max_skipped
+                    if reasons:
+                        _audit.note_skips(self.store.audit, reasons,
+                                          pool=pool.name)
+                    continue
+                # cohort size: gang_size for rigid gangs (bit-identical
+                # to the pre-elastic admission), gang_min for elastic
+                size = gang_bounds(g)[0] if gang_is_elastic(g) \
+                    else int(getattr(g, "gang_size", 0) or 0)
                 if not size:
                     continue
+                if gang_is_elastic(g):
+                    # surplus beyond the cohort is capped by the growth
+                    # headroom: admit at most max(size, headroom)
+                    # members so an unsatisfied elastic gang cannot
+                    # overshoot gang_max through the min-threshold
+                    # reduction's partial packing (the cohort itself
+                    # always admits — it restores legality)
+                    allowed = int(max(
+                        size, self.store.gang_growth_headroom(guuid)))
+                    over = [(row, j) for row, j in members[allowed:]
+                            if launch_ok[row]]
+                    if over:
+                        for row, _j in over:
+                            launch_ok[row] = False
+                        _audit.note_skips(
+                            self.store.audit,
+                            {"gang-at-max": [j.uuid for _r, j in over]},
+                            pool=pool.name)
+                        members = members[:allowed]
                 if len(members) < size:
                     reason = "members-missing"
                 elif size > nc:
                     reason = "considerable-cap"
-                elif not all(launch_ok[row] for row, _j in members):
+                elif sum(1 for row, _j in members
+                         if launch_ok[row]) < size:
                     if spec_masked is not None and all(
                             launch_ok[row] or spec_masked[row]
                             for row, _j in members):
@@ -1623,6 +1686,7 @@ class FusedCycleDriver:
                and getattr(groups_ctx.get(j.group), "gang", False)
                for j in cand_jobs):
             from ..ops.gang import apply_gang_cycle
+            from .elastic import satisfied_gangs
             H = len(pp.offers)
             cand_res = np.array(
                 [[j.resources.cpus, j.resources.mem, j.resources.gpus,
@@ -1642,7 +1706,8 @@ class FusedCycleDriver:
                 device=False,
                 refill_ok=(~res_conflict if res_conflict is not None
                            else None),
-                audit_trail=self.store.audit, audit_pool=pool_name)
+                audit_trail=self.store.audit, audit_pool=pool_name,
+                satisfied=satisfied_gangs(self.store, groups_ctx))
             if gstats is not None:
                 result.gang_partial = gstats.partial
         if res_conflict is not None:

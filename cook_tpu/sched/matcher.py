@@ -95,6 +95,10 @@ class Matcher:
         # reads this — such gangs never reach the match pass, so they have
         # no gang_partial entry to explain them
         self.last_admission_deferred: Dict[str, Dict[str, Dict]] = {}
+        # elastic resize plane (sched/elastic.ElasticManager, set by the
+        # scheduler): meters grow admissions of satisfied elastic gangs
+        # by the optimizer's per-pool budget.  None = unmetered growth.
+        self.elastic = None
 
     # ------------------------------------------------------------ selection
     def considerable_jobs(self, pool_name: str, ranked: List[Job],
@@ -118,12 +122,21 @@ class Matcher:
         # whose members cannot ALL clear this cycle's throttles would
         # otherwise admit a partial cohort every cycle — matched, then
         # reset by the reduction, forever.  A gang's FIRST member decides
-        # for the whole cohort: enough rate-limit tokens for gang_size
-        # launches and enough room under the considerable cap, or every
+        # for the whole cohort: enough rate-limit tokens for the cohort
+        # size and enough room under the considerable cap, or every
         # member waits this cycle (tokens refill; the cap resets).
+        # ELASTIC gangs (docs/GANG.md elasticity) reserve only gang_min
+        # — members beyond the cohort admit as surplus SINGLES, and a
+        # gang already running at >= min (admission size 0) routes its
+        # waiting members straight to the grow path below.
         gang_size_of: Dict[str, int] = {}
         gang_deferred: set = set()
         gang_reserved: set = set()
+        # groups whose cohort reservation was fully consumed: later
+        # members of the same (elastic) gang are surplus singles
+        gang_cohort_done: set = set()
+        if self.elastic is not None:
+            self.elastic.start_pool_cycle(pool_name)
         # outstanding considerable-cap slots held for admitted gangs whose
         # later members have not been reached yet (group -> remaining);
         # singles must not eat a sibling's slot mid-cohort
@@ -170,12 +183,34 @@ class Matcher:
                 for j in stripped:
                     _skip("gang-deferred", j, why=reason)
 
+        # group uuid -> is-a-gang, for the grow path (admission size 0
+        # covers both plain groups and SATISFIED elastic gangs; only the
+        # latter are metered by the optimizer's grow budget)
+        gang_flag: Dict[str, bool] = {}
+        # growth headroom left per elastic gang this cycle (gang_max -
+        # live - the cohort reserved here): surplus singles and grow
+        # members consume it so a gang never admits past its declared
+        # maximum (docs/GANG.md elasticity)
+        gang_headroom: Dict[str, float] = {}
+
+        def _growth_headroom(group: str) -> float:
+            h = gang_headroom.get(group)
+            if h is None:
+                h = self.store.gang_growth_headroom(group) \
+                    - gang_size_of.get(group, 0)
+                gang_headroom[group] = h = max(h, 0.0)
+            return h
+
         for job in ranked:
             cohort = 1
             if job.group is not None:
                 size = gang_size_of.get(job.group)
                 if size is None:
-                    size = self.store.gang_size(job.group)
+                    # cohort size the admission must reserve: gang_size
+                    # for rigid gangs, gang_min for unsatisfied elastic
+                    # ones, 0 once an elastic gang runs satisfied (its
+                    # members grow like singles, docs/GANG.md)
+                    size = self.store.gang_admission_size(job.group)
                     gang_size_of[job.group] = size
                 if size:
                     if job.group not in gang_deferred \
@@ -184,7 +219,33 @@ class Matcher:
                     if job.group in gang_deferred:
                         _skip("gang-deferred", job)
                         continue
-                    cohort = size
+                    if job.group in gang_cohort_done:
+                        # elastic surplus single beyond the cohort:
+                        # capped by the gang's growth headroom
+                        if _growth_headroom(job.group) < 1:
+                            _skip("gang-at-max", job)
+                            continue
+                        gang_headroom[job.group] -= 1
+                        cohort = 1
+                    else:
+                        cohort = size
+                else:
+                    is_gang = gang_flag.get(job.group)
+                    if is_gang is None:
+                        is_gang = self.store.group_is_gang(job.group)
+                        gang_flag[job.group] = is_gang
+                    if is_gang:
+                        # satisfied elastic gang: the member grows like
+                        # a single — capped at gang_max, then metered
+                        # by the optimizer's per-pool grow budget
+                        if _growth_headroom(job.group) < 1:
+                            _skip("gang-at-max", job)
+                            continue
+                        if self.elastic is not None \
+                                and not self.elastic.admit_grow(pool_name):
+                            _skip("gang-grow-deferred", job)
+                            continue
+                        gang_headroom[job.group] -= 1
             quota = self.store.get_quota(job.user, pool_name)
             qvec = np.array([quota.get("cpus", np.inf), quota.get("mem", np.inf),
                              quota.get("gpus", np.inf), quota.get("count", np.inf)],
@@ -256,6 +317,10 @@ class Matcher:
                     slots_reserved[job.group] = rem
                 else:
                     slots_reserved.pop(job.group, None)
+                    # an elastic gang's members past the reserved
+                    # cohort admit as surplus singles (rigid gangs
+                    # never have extra ranked members to reach this)
+                    gang_cohort_done.add(job.group)
             if len(out) >= limit:
                 break
         # hard cohort guarantee: a gang that did not FULLY admit (a
@@ -427,8 +492,11 @@ class Matcher:
             assign = self._dispatch(mc, job_res, cmask, avail, cap)
             assign = validate_group_placement(considerable, assign, offers, ctx)
             # gang all-or-nothing reduction + same-cycle refill of the
-            # freed capacity (structural no-op without gang members)
+            # freed capacity (structural no-op without gang members);
+            # satisfied elastic gangs' waiting members bypass the
+            # reduction — they are the grow path (docs/GANG.md)
             from ..ops.gang import apply_gang_cycle
+            from .elastic import satisfied_gangs
             assign, gstats = apply_gang_cycle(
                 considerable, assign, offers, ctx.groups,
                 job_res=np.asarray(job_res, dtype=F32),
@@ -436,7 +504,8 @@ class Matcher:
                 avail=np.asarray(avail, dtype=F32),
                 capacity=np.asarray(cap, dtype=F32),
                 device=mc.backend != "cpu",
-                audit_trail=self.store.audit, audit_pool=pool_name)
+                audit_trail=self.store.audit, audit_pool=pool_name,
+                satisfied=satisfied_gangs(self.store, ctx.groups))
             if gstats is not None:
                 result.gang_partial = gstats.partial
         self.record_placement_failures(considerable, assign, offers, ctx)
@@ -701,11 +770,24 @@ class Matcher:
             if guuid:
                 # executors gate on the gang barrier via the task env
                 # (docs/GANG.md); the scheduler's barrier state is the
-                # authoritative mirror on /group
+                # authoritative mirror on /group.  Elastic gangs also
+                # see their legal size range so the workload can adapt
+                # to resize events (COOK_GANG_RESIZE_* protocol,
+                # agent/executor.py).
+                from ..state.schema import gang_bounds, gang_is_elastic
                 g = gangs.get(guuid)
                 env = {**env, "COOK_GANG_UUID": guuid,
                        "COOK_GANG_SIZE":
                            str(getattr(g, "gang_size", 0) or 0)}
+                if gang_is_elastic(g):
+                    lo, hi = gang_bounds(g)
+                    env["COOK_GANG_MIN"] = str(lo)
+                    env["COOK_GANG_MAX"] = str(hi)
+                    # sandbox-relative advisory file the agent executor
+                    # appends resize events to (SIGUSR1 says "look",
+                    # the file says what; agent/executor.py)
+                    env["COOK_GANG_RESIZE_FILE"] = \
+                        ".cook-gang-resize.jsonl"
             by_cluster.setdefault(offer.cluster, []).append(LaunchSpec(
                 task_id=inst.task_id, job_uuid=job.uuid,
                 hostname=offer.hostname, slave_id=offer.slave_id,

@@ -67,6 +67,11 @@ class PreemptionDecision:
     victim_drus: Dict[str, float] = field(default_factory=dict)
     pending_dru: float = 0.0
     gang_victim_ids: List[str] = field(default_factory=list)
+    # ELASTIC shrink victims (docs/GANG.md elasticity): surplus members
+    # of elastic gangs shed through the checkpoint/grace protocol
+    # instead of the immediate preempt kill — their gangs keep running
+    # at >= gang_min, no whole-gang closure
+    shrink_task_ids: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -94,6 +99,12 @@ class _State:
         # partial gang
         self.gang_of_task: Dict[str, str] = {}
         self.gang_tasks: Dict[str, List[str]] = {}
+        # elastic gangs (docs/GANG.md elasticity): effective minimum per
+        # gang, so the decision loop can shed SURPLUS members (live -
+        # min, net of shrinks already pending a grace deadline) instead
+        # of closing the whole gang
+        self.gang_lo: Dict[str, int] = {}
+        self.gang_elastic: set = set()
         gang_groups: Dict[str, bool] = {}
         for job, inst in running:
             self.user_tasks.setdefault(job.user, []).append(
@@ -105,10 +116,21 @@ class _State:
                     is_gang = bool(g is not None
                                    and getattr(g, "gang", False))
                     gang_groups[job.group] = is_gang
+                    if is_gang:
+                        from ..state.schema import (gang_bounds,
+                                                    gang_is_elastic)
+                        self.gang_lo[job.group] = gang_bounds(g)[0]
+                        if gang_is_elastic(g):
+                            self.gang_elastic.add(job.group)
                 if is_gang:
                     self.gang_of_task[inst.task_id] = job.group
                     self.gang_tasks.setdefault(
                         job.group, []).append(inst.task_id)
+        # surplus shrink budget per elastic gang, consumed as decisions
+        # shed members this cycle
+        self.gang_surplus: Dict[str, int] = {
+            g: max(len(self.gang_tasks.get(g, ())) - self.gang_lo[g], 0)
+            for g in self.gang_elastic}
         for user, tasks in self.user_tasks.items():
             tasks.sort(key=lambda t: _job_feature_key(t.job, t.inst))
         self.shares: Dict[str, Tuple[float, float, float]] = {}
@@ -202,6 +224,11 @@ class Rebalancer:
         self.store = store
         self.config = config
         self.backend = backend
+        # elastic resize plane (sched/elastic.ElasticManager, attached
+        # by the scheduler): surplus members of elastic gangs shrink
+        # through the checkpoint/grace protocol instead of dying with a
+        # whole-gang closure.  None = pre-elastic behavior.
+        self.elastic = None
 
     def effective_params(self):
         """Per-cycle parameter resolution: the store's dynamic config
@@ -231,6 +258,16 @@ class Rebalancer:
                 spare[offer.hostname] = offer.available
                 offers_by_host[offer.hostname] = offer
         state = _State(self.store, pool_name, dru_mode, running, spare)
+        if self.elastic is not None and state.gang_elastic:
+            # members already pending a grace shrink are not surplus
+            # twice: shedding "surplus" that is mid-shrink would take
+            # the gang below gang_min once both kills execute
+            pending = self.elastic.pending_shrinks()
+            for tid, entry in pending.items():
+                g = entry.get("gang")
+                if g in state.gang_surplus:
+                    state.gang_surplus[g] = max(
+                        state.gang_surplus[g] - 1, 0)
 
         decisions: List[PreemptionDecision] = []
         budget = params.max_preemption
@@ -247,6 +284,28 @@ class Rebalancer:
             # the victim/beneficiary delta is the fairness justification
             pending_dru = state.pending_job_dru(job)
             direct = {v.task_id for v in victims}
+            # SHRINK instead of closure (docs/GANG.md elasticity): an
+            # elastic gang whose chosen victims fit inside its surplus
+            # budget sheds exactly those members through the grace
+            # protocol and keeps running at >= gang_min — no closure.
+            # Victims beyond the surplus close the whole gang as before.
+            shrink_ids: List[str] = []
+            if victims and state.gang_elastic:
+                per_gang: Dict[str, int] = {}
+                for v in victims:
+                    g = state.gang_of_task.get(v.task_id)
+                    if g in state.gang_elastic:
+                        per_gang[g] = per_gang.get(g, 0) + 1
+                shrink_gangs = {
+                    g for g, n in per_gang.items()
+                    if n <= state.gang_surplus.get(g, 0)}
+                for g in shrink_gangs:
+                    state.gang_surplus[g] -= per_gang[g]
+                shrink_ids = [v.task_id for v in victims
+                              if state.gang_of_task.get(v.task_id)
+                              in shrink_gangs]
+            else:
+                shrink_gangs = set()
             # whole-gang closure (docs/GANG.md): preempting any member
             # kills its entire gang — across hosts — so the decision can
             # never strand a partial gang holding fragmented capacity
@@ -254,7 +313,7 @@ class Rebalancer:
                 seen = {v.task_id for v in victims}
                 for v in list(victims):
                     g = state.gang_of_task.get(v.task_id)
-                    if g is None:
+                    if g is None or g in shrink_gangs:
                         continue
                     for tid in state.gang_tasks.get(g, ()):
                         if tid in seen or tid in state.preempted_ids:
@@ -273,7 +332,8 @@ class Rebalancer:
                 victim_drus=victim_drus,
                 pending_dru=round(float(pending_dru), 4),
                 gang_victim_ids=[v.task_id for v in victims
-                                 if v.task_id not in direct]))
+                                 if v.task_id not in direct],
+                shrink_task_ids=shrink_ids))
             if victims:
                 budget -= 1
         self._execute(decisions, clusters)
@@ -301,7 +361,15 @@ class Rebalancer:
 
         def edru(t: "_Task") -> float:
             g = state.gang_of_task.get(t.task_id)
-            return gang_min[g] if g is not None else t.dru
+            if g is None:
+                return t.dru
+            # elastic gangs with shrink surplus price members at their
+            # OWN dru — the post-shrink cost of the decision is one
+            # member, not the whole gang (docs/GANG.md elasticity);
+            # once the surplus is consumed the gang-min floor returns
+            if state.gang_surplus.get(g, 0) > 0:
+                return t.dru
+            return gang_min[g]
         # only hosts with a backend inventory entry are preemption targets:
         # a host known solely from a running task has no attribute/capacity
         # facts, so constraint evaluation there would be guesswork
@@ -314,6 +382,9 @@ class Rebalancer:
         def ok(t: _Task) -> bool:
             if t.task_id in state.preempted_ids or t.task_id.startswith("virtual-"):
                 return False
+            if self.elastic is not None \
+                    and self.elastic.shrinking(t.task_id):
+                return False  # already mid-grace: its capacity is spoken for
             if t.inst.hostname not in host_index:
                 return False  # no backend inventory for this host
             if not (job_ok_quota or t.job.user == job.user):
@@ -396,9 +467,25 @@ class Rebalancer:
         audit = self.store.audit
         for d in decisions:
             gang_mates = set(d.gang_victim_ids)
+            shrinks = set(d.shrink_task_ids)
             for tid in d.victim_task_ids:
                 inst = self.store.instance(tid)
                 if inst is None:
+                    continue
+                if tid in shrinks and self.elastic is not None \
+                        and self.elastic.enabled:
+                    # elastic surplus member: checkpoint/grace shrink
+                    # instead of the immediate kill — the gang runs on
+                    # at its post-shrink size (docs/GANG.md elasticity)
+                    job = self.store.job(inst.job_uuid)
+                    self.elastic.request_shrink(
+                        tid, inst.job_uuid,
+                        job.group if job is not None else "",
+                        inst.compute_cluster, clusters,
+                        reason="pressure",
+                        facts={"by": d.job_uuid,
+                               "dru": d.victim_drus.get(tid),
+                               "beneficiary_dru": d.pending_dru})
                     continue
                 self.store.update_instance_status(
                     tid, InstanceStatus.FAILED,

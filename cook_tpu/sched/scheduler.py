@@ -75,6 +75,18 @@ class Scheduler:
         self.matcher = Matcher(store, self.config, plugins=self.plugins,
                                rate_limits=self.rate_limits)
         self.rebalancer = Rebalancer(store, self.config, backend=rank_backend)
+        # elastic resize plane (sched/elastic.py, docs/GANG.md
+        # elasticity): grace-shrink ledger + the grow/shrink budgets the
+        # optimizer loop sets; shared with the matcher (grow metering)
+        # and the rebalancer (shrink-instead-of-kill)
+        from .elastic import ElasticManager
+        self.elastic = ElasticManager(store, self.config.elastic)
+        if self.config.elastic.enabled:
+            self.matcher.elastic = self.elastic
+            self.rebalancer.elastic = self.elastic
+        # real optimizer loop (sched/optimizer.py GoodputOptimizer):
+        # built lazily by run()/step_optimize when config enables it
+        self.optimizer_cycler = None
         from .monitor import Monitor
         self.monitor = Monitor(store, config=self.config)
         from .heartbeat import HeartbeatTracker
@@ -362,9 +374,23 @@ class Scheduler:
                 guuid = e.data.get("gang")
                 if guuid:
                     self._gang_of_task[e.data["task_id"]] = guuid
-                    self._gang_barrier.setdefault(
+                    st = self._gang_barrier.setdefault(
                         guuid, {"first_live_ms": self.clock(),
                                 "released": False})
+                    if st.get("released"):
+                        # a member launched AFTER the barrier released:
+                        # a satisfied ELASTIC gang grew into capacity
+                        # (docs/GANG.md elasticity).  Gated on
+                        # elasticity: a rigid gang relaunching after a
+                        # whole-gang requeue also lands here (the
+                        # barrier entry persists released), and that is
+                        # a retry, not a resize.
+                        from ..state.schema import gang_is_elastic
+                        group = self.store.group(guuid)
+                        if group is not None and gang_is_elastic(group):
+                            job = self.store.job(e.data.get("job", ""))
+                            self.elastic.note_grow(
+                                job.pool if job is not None else "")
             if e.kind == "instance-status" and e.data.get("new") == "running":
                 guuid = self._gang_of_task.get(e.data["task_id"])
                 if guuid:
@@ -402,12 +428,27 @@ class Scheduler:
         ``requeue`` (default) kills every sibling's live instances with
         the mea-culpa ``gang-member-lost`` reason so the WHOLE gang
         returns to WAITING and relaunches atomically; ``kill`` — or a
-        member whose job went terminal — takes the whole gang down."""
+        member whose job went terminal — takes the whole gang down.
+        An ELASTIC gang still holding >= gang_min live members absorbs
+        the loss as an implicit shrink instead (docs/GANG.md
+        elasticity); the live count is only fetched for elastic groups
+        so rigid gangs pay nothing new."""
         from ..state import machines
+        from ..state.schema import gang_is_elastic
         group = self.store.group(failed_job.group)
+        live = self.store.gang_live_members(group.uuid) \
+            if group is not None and gang_is_elastic(group) else None
         action = machines.gang_failure_action(group, reason_code,
-                                              failed_job.state)
+                                              failed_job.state,
+                                              live_members=live)
         if action == "none":
+            if live is not None \
+                    and reason_code not in (Reasons.GANG_RESIZED.code,
+                                            Reasons.GANG_MEMBER_LOST.code):
+                # an elastic gang absorbed a member failure as a shrink
+                from ..utils.metrics import registry
+                registry.counter_inc("cook_gang_resize", labels={
+                    "direction": "shrink", "reason": "member-lost"})
             return
         if action == "requeue" and any(
                 u != failed_job.uuid
@@ -477,11 +518,14 @@ class Scheduler:
             self._cluster_kill(cluster_name, tid)
 
     def _maybe_release_gang_barrier(self, guuid: str) -> None:
-        """Release the gang's barrier once EVERY member has STARTED —
-        currently RUNNING, or already finished a run (a short member can
-        exit SUCCESS before the last member comes up; requiring all
-        members to be simultaneously RUNNING would then block release
-        forever).  The wait (first launch -> all started) is observed on
+        """Release the gang's barrier once every REQUIRED member has
+        STARTED — currently RUNNING, or already finished a run (a short
+        member can exit SUCCESS before the last member comes up;
+        requiring all members to be simultaneously RUNNING would then
+        block release forever).  Rigid gangs require every member;
+        ELASTIC gangs make the barrier at ``gang_min`` started members
+        (docs/GANG.md elasticity — the gang is legally whole there).
+        The wait (first launch -> barrier) is observed on
         ``cook_gang_barrier_wait_ms``."""
         st = self._gang_barrier.get(guuid)
         if st is None or st.get("released"):
@@ -489,10 +533,13 @@ class Scheduler:
         group = self.store.group(guuid)
         if group is None:
             return
+        from ..state.schema import gang_bounds
+        need = gang_bounds(group)[0] or len(group.jobs)
+        started_n = 0
         for member_uuid in group.jobs:
             member = self.store.job(member_uuid)
             if member is None:
-                return
+                continue
             started = any(
                 (mi := self.store.instance(tid)) is not None
                 and (mi.status is InstanceStatus.RUNNING
@@ -500,8 +547,12 @@ class Scheduler:
                          and (mi.status is InstanceStatus.SUCCESS
                               or mi.mesos_start_time_ms)))
                 for tid in member.instances)
-            if not started:
-                return
+            if started:
+                started_n += 1
+                if started_n >= need:
+                    break
+        if started_n < need:
+            return
         st["released"] = True
         st["released_ms"] = self.clock()
         from ..utils.metrics import registry
@@ -975,6 +1026,100 @@ class Scheduler:
         self.store.flush_audit()
         return decisions
 
+    # --------------------------------------------------------------- elastic
+    def step_resize(self) -> Dict[str, int]:
+        """Per-cycle elastic resize pass (docs/GANG.md elasticity):
+        execute grace-expired shrinks, then shed standing optimizer
+        shrink pressure per pool.  Growth needs no step of its own —
+        satisfied elastic gangs grow through the ordinary match path,
+        metered by the optimizer's per-pool grow budget.  Structural
+        no-op (empty ledger, zero pressure) for rigid-only workloads."""
+        if not self.config.elastic.enabled:
+            return {}
+        out: Dict[str, int] = {}
+        swept = self.elastic.sweep(self.clusters)
+        if swept:
+            out["_grace_expired"] = len(swept)
+        if any(self.elastic.shrink_pressure.values()):
+            for pool in self.store.pools():
+                if pool.state != "active":
+                    continue
+                shed = self.elastic.apply_pressure(pool.name, self.clusters)
+                if shed:
+                    out[pool.name] = shed
+        return out
+
+    # ------------------------------------------------------------- optimizer
+    def _ensure_optimizer(self):
+        """Build the optimizer cycler lazily from ``config.optimizer``
+        (an OptimizerConfig the daemon boot-validated, or None = loop
+        off)."""
+        if self.optimizer_cycler is None and self.config.optimizer is not None:
+            self.optimizer_cycler = self.config.optimizer.build()
+        return self.optimizer_cycler
+
+    def step_optimize(self) -> Dict:
+        """One optimizer cycle (sched/optimizer.py GoodputOptimizer):
+        sim-replay decision pass + legacy observational schedule, then
+        APPLY the decisions — grow budgets and shrink pressure onto the
+        elastic manager, the preemption budget onto the rebalancer's
+        dynamic-config plane — and journal them durably onto every
+        affected elastic gang member's audit timeline."""
+        cyc = self._ensure_optimizer()
+        if cyc is None:
+            return {}
+        decisions = cyc.run_scheduler_cycle(self)
+        if decisions:
+            self._apply_optimizer_decisions(decisions, cyc)
+        return decisions
+
+    def _apply_optimizer_decisions(self, decisions, cyc) -> None:
+        from ..utils.metrics import registry
+        # pool -> live elastic gang groups, for the audit journaling
+        # (the decision lands on the GANG's timeline: its member jobs)
+        gangs_by_pool: Dict[str, list] = {}
+        for group in self.store.elastic_gang_groups():
+            member = next((self.store.job(u) for u in group.jobs), None)
+            if member is not None:
+                gangs_by_pool.setdefault(member.pool, []).append(group)
+        budgets = []
+        for pool_name, d in decisions.items():
+            if d.grow_budget is None:
+                self.elastic.grow_budget.pop(pool_name, None)
+            else:
+                self.elastic.grow_budget[pool_name] = float(d.grow_budget)
+            if d.shrink_pressure:
+                self.elastic.shrink_pressure[pool_name] = \
+                    int(d.shrink_pressure)
+            else:
+                # a no-shrink decision REVOKES any standing pressure a
+                # previous cycle left unshed — step_resize would
+                # otherwise keep executing a lever the optimizer
+                # already withdrew
+                self.elastic.shrink_pressure.pop(pool_name, None)
+            if d.preemption_budget is not None:
+                budgets.append(int(d.preemption_budget))
+            registry.gauge_set("cook_pool_goodput", d.current_goodput,
+                               {"pool": pool_name})
+            facts = {"optimizer_cycle": cyc.cycles, **d.to_dict()}
+            facts.pop("scores", None)  # debug detail, not timeline fact
+            for group in gangs_by_pool.get(pool_name, ()):
+                for member_uuid in group.jobs:
+                    self.store.audit.record(
+                        member_uuid, "optimizer-decision", facts,
+                        durable=True)
+        if budgets:
+            # the rebalancer re-reads the dynamic document every cycle
+            # (effective_params), so the budget takes effect next cycle
+            # and remains operator-overridable through the same plane
+            self.store.update_dynamic_config(
+                "rebalancer", {"max_preemption": max(budgets)})
+        for pool_name, d in decisions.items():
+            if d.shrink_pressure:
+                self.elastic.apply_pressure(
+                    pool_name, self.clusters,
+                    decision_facts={"optimizer_cycle": cyc.cycles})
+
     # --------------------------------------------------------------- reapers
     def step_reapers(self, current_ms: Optional[int] = None) -> List[str]:
         """Kill tasks over their max runtime (lingering-task killer,
@@ -1153,10 +1298,16 @@ class Scheduler:
         """Start background cycle threads (the chime equivalent)."""
         cfg = self.config
 
-        def loop(interval, fn) -> None:
+        def loop(interval, fn, immediate: bool = False) -> None:
             # interval may be a callable so dynamically-tunable cadences
             # (the rebalancer's no-restart interval-seconds) take effect on
             # the next tick instead of being frozen at startup
+            if immediate and not self._stop.is_set():
+                try:
+                    fn()
+                except Exception:  # pragma: no cover - cycle errors are logged
+                    import logging
+                    logging.getLogger(__name__).exception("cycle failed")
             while not self._stop.wait(interval() if callable(interval)
                                       else interval):
                 try:
@@ -1181,8 +1332,21 @@ class Scheduler:
             (cfg.lingering_task_interval_seconds, self.step_reapers),
             (cfg.monitor_interval_seconds, self.monitor.sweep),
         ]
+        if cfg.elastic.enabled:
+            specs.append((cfg.elastic.resize_interval_seconds,
+                          self.step_resize))
         for interval, fn in specs:
             t = threading.Thread(target=loop, args=(interval, fn), daemon=True)
+            t.start()
+            self._threads.append(t)
+        if cfg.optimizer is not None:
+            # immediate first cycle: the debug surface must not read
+            # dead for a full interval after boot (the OptimizerCycler
+            # fix, mirrored here for the scheduler-driven loop)
+            t = threading.Thread(
+                target=loop,
+                args=(cfg.optimizer.interval_seconds, self.step_optimize),
+                kwargs={"immediate": True}, daemon=True)
             t.start()
             self._threads.append(t)
 

@@ -70,6 +70,13 @@ def main(argv=None) -> int:
                         "invariant every tick (docs/GANG.md)")
     p.add_argument("--gang-size", type=int, default=None,
                    help="chaos: members per gang (default 3)")
+    p.add_argument("--elastic", action="store_true",
+                   help="chaos: make the gangs ELASTIC (gang_min = "
+                        "size//2, docs/GANG.md elasticity) — asserts "
+                        "zero partial gangs at the relaxed minimum and "
+                        "drives grace shrinks through the fault "
+                        "schedule, including one racing the leader "
+                        "kill (defaults --gangs 2 when unset)")
     p.add_argument("--resident", action="store_true",
                    help="chaos: drive the fused cycle off the columnar "
                         "index with the DEVICE-RESIDENT pack on (ISSUE "
@@ -133,6 +140,10 @@ def main(argv=None) -> int:
             cc.n_gangs = args.gangs
         if args.gang_size is not None:
             cc.gang_size = args.gang_size
+        if args.elastic:
+            cc.elastic = True
+            if not cc.n_gangs:
+                cc.n_gangs = 2
         if args.resident:
             cc.resident = True
         if args.delta_faults is not None:

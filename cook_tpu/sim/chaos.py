@@ -103,6 +103,15 @@ class ChaosConfig:
     # one gang is timed to submit just before the leader kill so the
     # crash window reliably lands inside a gang launch
     gang_at_kill: bool = True
+    # ELASTIC gang chaos (docs/GANG.md elasticity): gangs declare
+    # gang_min = max(1, gang_size // 2) and may legally run anywhere in
+    # [min, size].  The zero-partial invariant becomes "live == 0 or
+    # live (+completed) >= gang_min" every tick; a grace SHRINK is
+    # requested just before the leader kill so the crash window races
+    # the resize ledger — the shrink may be delayed by failover (the
+    # in-memory deadline dies with the leader) but must never be
+    # half-applied or lose a member
+    elastic: bool = False
     # resident-mode chaos (ISSUE 7, docs/PERFORMANCE.md): drive the
     # fused cycle off the columnar index with the DEVICE-RESIDENT pack
     # on (the production wire form), optionally storming the
@@ -121,6 +130,10 @@ class ChaosResult:
     completed: int = 0
     gangs: int = 0
     gang_requeues: int = 0
+    # elastic chaos (docs/GANG.md elasticity)
+    elastic_grows: int = 0
+    elastic_shrinks: int = 0
+    shrink_at_kill: str = ""   # outcome of the shrink racing the kill
     violations: List[str] = field(default_factory=list)
     node_losses: int = 0
     rpc_faults: int = 0
@@ -149,6 +162,9 @@ class ChaosResult:
             "jobs_completed": self.completed,
             "gangs": self.gangs,
             "gang_requeues": self.gang_requeues,
+            "elastic_grows": self.elastic_grows,
+            "elastic_shrinks": self.elastic_shrinks,
+            "shrink_at_kill": self.shrink_at_kill,
             "violations": list(self.violations),
             "node_losses": self.node_losses,
             "rpc_faults": self.rpc_faults,
@@ -230,8 +246,11 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                 max_retries=3, submit_time_ms=submit,
                 labels={"sim/duration_ms": str(cc.job_duration_ms)})
                 for i in range(cc.gang_size)]
+            gang_min = max(1, cc.gang_size // 2) if cc.elastic else 0
             group = Group(
                 uuid=guuid, gang=True, gang_size=cc.gang_size,
+                gang_min=gang_min,
+                gang_max=cc.gang_size if cc.elastic else 0,
                 gang_topology="slice-id" if cc.gang_topology else None,
                 jobs=[m.uuid for m in members])
             gang_jobs.extend(members)
@@ -296,10 +315,16 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                     f"{when}: cluster runs {tid} but store says "
                     f"{inst.status.value if inst else 'missing'}")
 
+    # the elastic legal minimum (docs/GANG.md elasticity); None = rigid
+    gang_lo = max(1, cc.gang_size // 2) if cc.elastic else None
+
     def check_no_partial_gang(when: str) -> None:
         """THE gang invariant (docs/GANG.md): at every consistent point,
         a gang is whole or absent — never a strict subset of members
-        holding capacity while the rest wait."""
+        holding capacity while the rest wait.  ELASTIC gangs relax
+        "whole" to "at least gang_min live (or wound down to
+        completion)": any live count in [min, size] is a legal size,
+        below min is the same partial-gang hazard as before."""
         for guuid, member_uuids in gang_index.items():
             live = completed = known = 0
             for uuid in member_uuids:
@@ -314,10 +339,12 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                     live += 1
                 elif j.state is JobState.COMPLETED:
                     completed += 1
-            if known and live and live + completed < known:
+            whole = known if gang_lo is None else min(known, gang_lo)
+            if known and live and live + completed < whole:
                 result.violations.append(
                     f"{when}: gang {guuid} partial — {live} live + "
-                    f"{completed} completed of {known} members")
+                    f"{completed} completed of {known} members "
+                    f"(requires {whole})")
 
     def fail_one_node() -> None:
         if result.node_losses >= cc.node_loss_max:
@@ -338,9 +365,42 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     # refund->relaunch path ran (reported as relaunched_after_kill)
     crashed_jobs: Dict[str, int] = {}
 
+    def find_surplus_member():
+        """A (task_id, job_uuid, gang_uuid) of a RUNNING elastic gang
+        member above gang_min — a legal grace-shrink victim."""
+        for guuid, member_uuids in gang_index.items():
+            live = []
+            for uuid in member_uuids:
+                j = store.job(uuid)
+                if j is None:
+                    continue
+                for t in j.instances:
+                    mi = store.instance(t)
+                    if mi is not None and mi.status in (
+                            InstanceStatus.UNKNOWN,
+                            InstanceStatus.RUNNING):
+                        live.append((t, uuid))
+            if gang_lo is not None and len(live) > gang_lo:
+                tid, uuid = live[-1]
+                return tid, uuid, guuid
+        return None
+
     def kill_leader_and_promote() -> None:
         nonlocal store, scheduler
         result.leader_kills += 1
+        # elastic: open a grace shrink RIGHT before the crash so the
+        # kill window races the resize ledger (docs/GANG.md elasticity:
+        # a shrink may be DELAYED by failover — the in-memory deadline
+        # dies with the leader — but never half-applied)
+        racing_shrink = None
+        if cc.elastic:
+            victim = find_surplus_member()
+            if victim is not None:
+                tid, juuid, guuid = victim
+                scheduler.elastic.request_shrink(
+                    tid, juuid, guuid, cluster.name, scheduler.clusters,
+                    reason="chaos-race")
+                racing_shrink = tid
         # crash INSIDE the match->launch window: the guard transaction
         # (instances + intents) commits, the backend dispatch never lands
         orig_launch = FakeCluster.launch_tasks
@@ -404,6 +464,29 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         # the new leader adopts the (still-running) cluster and sweeps
         # the open launch intents in its constructor
         scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        if racing_shrink is not None:
+            # never half-applied: after promotion the victim is either
+            # UNTOUCHED (ledger + deadline died with the leader — the
+            # shrink was delayed) or cleanly shed with the mea-culpa
+            # gang-resized reason; anything else is a violation
+            mi = store.instance(racing_shrink)
+            if mi is None:
+                result.violations.append(
+                    "shrink-at-kill: victim instance vanished")
+                result.shrink_at_kill = "lost"
+            elif mi.status in (InstanceStatus.UNKNOWN,
+                               InstanceStatus.RUNNING):
+                result.shrink_at_kill = "delayed"
+            elif mi.reason_code == Reasons.GANG_RESIZED.code:
+                result.shrink_at_kill = "applied"
+            elif mi.status is InstanceStatus.SUCCESS:
+                result.shrink_at_kill = "completed"
+            else:
+                result.violations.append(
+                    f"shrink-at-kill: victim {racing_shrink} ended "
+                    f"{mi.status.value}/{mi.reason_code} — neither "
+                    "delayed nor a clean gang-resized shed")
+                result.shrink_at_kill = "corrupt"
 
     pending = list(trace)
     pending_gangs = list(gang_sets)
@@ -415,6 +498,12 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     next_node_loss = start_ms + cc.node_loss_every_ms
     kill_at = (start_ms + cc.leader_kill_at_ms
                if cc.leader_kill_at_ms is not None else None)
+    # elastic: drive ordinary grace shrinks through the run (up to 3,
+    # spaced so at least one grace window expires AWAY from the leader
+    # kill and actually executes; the kill gets its own racing shrink)
+    shrink_at = (start_ms + (cc.leader_kill_at_ms or 20_000) // 2
+                 if cc.elastic else None)
+    shrinks_requested = 0
     breaker = breakers.get(cluster.name)
     last_breaker_state = breaker.state
 
@@ -437,6 +526,21 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             scheduler.step_rank()
             scheduler.step_match()
         scheduler.step_reapers(current_ms=now)
+        if cc.elastic:
+            # a mid-run grace shrink well before the kill: the grace
+            # deadline expires through step_resize ticks on the virtual
+            # clock while node loss + RPC faults keep firing
+            if shrink_at is not None and now >= shrink_at:
+                victim = find_surplus_member()
+                if victim is not None:
+                    tid, juuid, guuid = victim
+                    scheduler.elastic.request_shrink(
+                        tid, juuid, guuid, cluster.name,
+                        scheduler.clusters, reason="chaos")
+                    shrinks_requested += 1
+                    shrink_at = (None if shrinks_requested >= 3
+                                 else now + 8_000)
+            scheduler.step_resize()
         state = breaker.state
         if state == "open" and last_breaker_state != "open":
             result.breaker_trips += 1
@@ -480,6 +584,14 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                 1 for t in j.instances
                 if (mi := store.instance(t)) is not None
                 and mi.reason_code == Reasons.GANG_MEMBER_LOST.code)
+            if cc.elastic:
+                # shrinks observed as transacted gang-resized sheds
+                result.elastic_shrinks += sum(
+                    1 for t in j.instances
+                    if (mi := store.instance(t)) is not None
+                    and mi.reason_code == Reasons.GANG_RESIZED.code)
+    if cc.elastic:
+        result.elastic_grows = scheduler.elastic.grows
 
     # terminal-state + retry-budget invariants
     for job in list(trace) + gang_jobs:

@@ -47,6 +47,11 @@ class SimResult:
     # per-job audit-trail aggregate (utils/audit.py): jobs tracked +
     # event counts by kind — sanity that attribution engaged
     audit: Dict = field(default_factory=dict)
+    # goodput aggregate (docs/GANG.md elasticity; the optimizer loop's
+    # replay score and the elastic_cycle bench read this): busy-capacity
+    # fraction, placed-gang-member fraction, resize counts, and the
+    # never-placed demand the autoscale decision sizes against
+    goodput: Dict = field(default_factory=dict)
 
     def summary(self) -> Dict:
         wt = np.asarray(self.wait_times_ms or [0])
@@ -68,6 +73,7 @@ class SimResult:
                                       if wall_s > 0 else float("inf")),
             "flight": self.flight,
             "audit": self.audit,
+            "goodput": self.goodput,
         }
 
 
@@ -108,8 +114,13 @@ class Simulator:
                  config: Optional[Config] = None, backend: str = "tpu",
                  rank_interval_ms: int = 5000, match_interval_ms: int = 1000,
                  rebalance_interval_ms: int = 30000,
-                 cycle_mode: Optional[str] = None):
+                 cycle_mode: Optional[str] = None,
+                 groups: Optional[Dict[str, object]] = None):
         self.trace = trace
+        # gang groups keyed by uuid (docs/GANG.md): members referencing
+        # a group here are CO-SUBMITTED as one batch with the Group at
+        # the earliest member's submit time — gangs never trickle in
+        self.groups = dict(groups or {})
         self.config = config or Config()
         if backend == "cpu":
             self.config.default_matcher.backend = "cpu"
@@ -153,13 +164,29 @@ class Simulator:
             else pending[-1].submit_time_ms + max_virtual_ms
         start_ms = now
 
+        elastic_on = getattr(self.config.elastic, "enabled", False) \
+            and self.scheduler.elastic is not None
         while now <= deadline:
             # deliver submissions due now
             while pending and pending[0].submit_time_ms <= now:
                 job = pending.pop(0)
                 self._job_durations[job.uuid] = int(
                     job.labels["sim/duration_ms"])
-                self.store.create_jobs([job])
+                if job.group and job.group in self.groups:
+                    # gang cohort: pull the siblings forward and submit
+                    # the whole gang with its Group in one batch (gangs
+                    # are co-submitted, REST enforces exactly this)
+                    cohort = [job] + [j for j in pending
+                                      if j.group == job.group]
+                    pending = [j for j in pending
+                               if j.group != job.group]
+                    for m in cohort:
+                        self._job_durations[m.uuid] = int(
+                            m.labels["sim/duration_ms"])
+                    self.store.create_jobs(
+                        cohort, groups=[self.groups[job.group]])
+                else:
+                    self.store.create_jobs([job])
             # cycles (virtual-time frozen during computation)
             if now >= next_rank and self.cycle_mode != "fused":
                 t0 = time.perf_counter()
@@ -187,6 +214,11 @@ class Simulator:
                         result.preemptions += len(d.victim_task_ids)
                 next_rebalance = now + self.rebalance_interval_ms
             self.scheduler.step_reapers(current_ms=now)
+            if elastic_on:
+                # elastic resize plane (docs/GANG.md elasticity): execute
+                # grace-expired shrinks and the optimizer's standing
+                # shrink pressure on the virtual clock
+                self.scheduler.step_resize()
 
             # advance the clock to the next interesting moment
             candidates = [next_rank, next_match, next_rebalance]
@@ -227,7 +259,65 @@ class Simulator:
                 })
                 if inst.queue_time_ms is not None:
                     result.wait_times_ms.append(inst.queue_time_ms)
+        result.goodput = self._goodput(result, now)
         return result
+
+    def _goodput(self, result: SimResult, now: int) -> Dict:
+        """Goodput aggregate over the finished run (docs/GANG.md
+        elasticity): ``util`` — busy cpu-seconds as a fraction of
+        capacity cpu-seconds over the makespan; ``gang_goodput`` —
+        placed gang-member-seconds as a fraction of the member-seconds a
+        fully-placed gang workload would have run (the bench's
+        placed-member goodput, higher when elastic gangs run at partial
+        strength instead of waiting whole); plus resize counts and the
+        never-placed cpu demand the autoscale decision sizes against."""
+        span_ms = max(result.makespan_ms, 1)
+        cap_cpus = sum(h.capacity.cpus
+                       for h in self.cluster._hosts.values())
+        by_uuid = {j.uuid: j for j in self.trace}
+        busy_cpu_ms = 0.0
+        member_ms = 0.0
+        placed_jobs = set()
+        for r in result.task_records:
+            if r.get("start") is None:
+                continue
+            placed_jobs.add(r["job"])
+            job = by_uuid.get(r["job"])
+            if job is None:
+                continue
+            dur = (r["end"] or now) - r["start"]
+            if dur <= 0:
+                continue
+            busy_cpu_ms += dur * job.resources.cpus
+            if job.group and job.group in self.groups:
+                member_ms += dur
+        gang_members = 0
+        demand_ms = 0.0
+        for j in self.trace:
+            if j.group and j.group in self.groups:
+                gang_members += 1
+                demand_ms += int(j.labels.get("sim/duration_ms", 0))
+        unplaced_cpus = sum(
+            j.resources.cpus for j in self.trace
+            if j.uuid not in placed_jobs)
+        mgr = self.scheduler.elastic
+        out = {
+            "util": (busy_cpu_ms / (cap_cpus * span_ms)
+                     if cap_cpus > 0 else 0.0),
+            "unplaced_cpus": unplaced_cpus,
+            "preemptions": result.preemptions,
+            "grows": getattr(mgr, "grows", 0),
+            "shrinks": getattr(mgr, "shrinks", 0),
+        }
+        if gang_members:
+            # placed member-time over DEMANDED member-time: 1.0 = every
+            # member ran exactly its duration; a rigid gang waiting
+            # whole scores 0 where an elastic one running at gang_min
+            # already banks min/size
+            out["gang_goodput"] = (member_ms / demand_ms
+                                   if demand_ms > 0 else 0.0)
+            out["gang_members"] = gang_members
+        return out
 
     def _next_completion_ms(self) -> Optional[int]:
         with self.cluster._lock:

@@ -93,7 +93,8 @@ def classify_failure(reason_code: Optional[int]) -> Tuple[bool, Optional[int]]:
 
 
 def gang_failure_action(group, reason_code: Optional[int],
-                        failed_member_state: JobState) -> str:
+                        failed_member_state: JobState,
+                        live_members: Optional[int] = None) -> str:
     """What the gang policy does when one member's instance fails
     (docs/GANG.md).  Pure so the scheduler's tx-event handler stays a
     thin dispatcher.
@@ -101,19 +102,30 @@ def gang_failure_action(group, reason_code: Optional[int],
     Returns one of:
 
     - ``"none"`` — not a gang, or the failure IS a gang-policy kill
-      (``gang-member-lost``): reacting to our own kills would cascade;
+      (``gang-member-lost``) or an elastic resize shrink
+      (``gang-resized``): reacting to our own kills would cascade;
+      also chosen for an ELASTIC gang that still holds ``gang_min``
+      live members after the failure (``live_members``, counted by the
+      caller post-transition) — the gang absorbs the loss as a shrink
+      instead of tearing down work that is legal at its current size;
     - ``"requeue"`` — kill the gang's other live instances mea-culpa
       (``gang-member-lost``) so the whole gang returns to WAITING and
       relaunches atomically (the default policy);
     - ``"kill"`` — kill the whole gang's jobs outright.  Chosen when the
       group's policy says so, and FORCED when the failed member's job
       went terminal (retries exhausted, user kill): its siblings could
-      otherwise wait forever on a gang that can never be whole again.
+      otherwise wait forever on a gang that can never be whole again
+      (elastic gangs still above ``gang_min`` excepted — they run on
+      legally without the terminal member).
     """
-    from .schema import GANG_POLICY_KILL
+    from .schema import GANG_POLICY_KILL, gang_bounds, gang_is_elastic
     if group is None or not getattr(group, "gang", False):
         return "none"
-    if reason_code == Reasons.GANG_MEMBER_LOST.code:
+    if reason_code in (Reasons.GANG_MEMBER_LOST.code,
+                       Reasons.GANG_RESIZED.code):
+        return "none"
+    if gang_is_elastic(group) and live_members is not None \
+            and live_members >= gang_bounds(group)[0]:
         return "none"
     if failed_member_state is JobState.COMPLETED:
         return "kill"
@@ -156,9 +168,14 @@ def gang_status(store, group,
                 i.status is InstanceStatus.SUCCESS
                 or i.mesos_start_time_ms for i in insts):
             started += 1
+    from .schema import gang_bounds, gang_is_elastic
     size = group.gang_size or len(group.jobs)
+    # elastic gangs make the barrier at gang_min STARTED members — the
+    # gang is legally whole at any count in [min, max] (docs/GANG.md
+    # elasticity); rigid gangs read lo == size, unchanged
+    lo, hi = gang_bounds(group)
     barrier = None
-    if started >= size:
+    if started >= (lo or size):
         barrier = "released"
     elif placed:
         barrier = "pending"
@@ -168,6 +185,9 @@ def gang_status(store, group,
            "members_placed": placed,
            "members_running": running,
            "barrier": barrier}
+    if gang_is_elastic(group):
+        out["min"] = lo
+        out["max"] = hi
     if cache is not None:
         cache[group.uuid] = out
     return out

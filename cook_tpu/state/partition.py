@@ -696,6 +696,32 @@ class PartitionedStore:
             out.update(store.gang_groups_of(jobs))
         return out
 
+    def gang_live_members(self, uuid: Optional[str]) -> int:
+        # a gang lives whole inside ONE partition (group routing refuses
+        # cross-partition gangs), so the first non-gang-free shard wins
+        for store in self.partitions:
+            if store.group_is_gang(uuid):
+                return store.gang_live_members(uuid)
+        return 0
+
+    def gang_admission_size(self, uuid: Optional[str]) -> int:
+        for store in self.partitions:
+            if store.group_is_gang(uuid):
+                return store.gang_admission_size(uuid)
+        return 0
+
+    def gang_growth_headroom(self, uuid: Optional[str]) -> float:
+        for store in self.partitions:
+            if store.group_is_gang(uuid):
+                return store.gang_growth_headroom(uuid)
+        return float("inf")
+
+    def elastic_gang_groups(self) -> List[Group]:
+        out: List[Group] = []
+        for store in self.partitions:
+            out.extend(store.elastic_gang_groups())
+        return out
+
     def jobs_where(self, pred: Callable[[Job], bool]) -> List[Job]:
         out: List[Job] = []
         for store in self.partitions:

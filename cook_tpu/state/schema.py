@@ -103,6 +103,13 @@ class Reasons:
     # CANCELLED_DURING_LAUNCH, the kill proves nothing about the host,
     # so the matcher does not novel-host-exclude it.
     GANG_MEMBER_LOST = Reason(17, "gang-member-lost", mea_culpa=True)
+    # an ELASTIC gang member shed by the resize pass (checkpoint/grace
+    # shrink, docs/GANG.md elasticity): the cluster reclaimed surplus
+    # capacity, the member did nothing wrong — mea-culpa, free retries,
+    # no novel-host exclusion (the member wants its host back on grow),
+    # and the gang policy never reacts to it (the gang stays whole at
+    # its post-shrink size).
+    GANG_RESIZED = Reason(18, "gang-resized", mea_culpa=True)
 
     _by_code: Dict[int, Reason] = {}
     _by_name: Dict[str, Reason] = {}
@@ -348,7 +355,15 @@ class Group:
     whole gang.  ``gang_topology`` optionally names a host attribute
     (e.g. "slice-id") whose value must be equal across every member's
     host, with the matcher preferring the slice with the most feasible
-    capacity."""
+    capacity.
+
+    ELASTIC gangs (docs/GANG.md elasticity): ``gang_min``/``gang_max``
+    relax the rigid size — the gang launches whole at any member count
+    in ``[gang_min, gang_max]``, grows into spare capacity and shrinks
+    under pressure via the resize pass.  ``0`` (the default) means
+    "same as gang_size": a group with ``gang_min == gang_max ==
+    gang_size`` is exactly the rigid gang, decision-identical to a
+    pre-elasticity build."""
 
     uuid: str
     name: str = "defaultgroup"
@@ -362,6 +377,35 @@ class Group:
     gang_size: int = 0
     gang_topology: Optional[str] = None
     gang_policy: str = GANG_POLICY_REQUEUE
+    # elasticity bounds; 0 = rigid (defaults to gang_size)
+    gang_min: int = 0
+    gang_max: int = 0
+
+
+def gang_bounds(group) -> Tuple[int, int]:
+    """The effective ``(min, max)`` member-count bounds of a gang group
+    (docs/GANG.md elasticity).  Unset (0) bounds default to
+    ``gang_size``, so rigid gangs read ``(size, size)``."""
+    size = int(getattr(group, "gang_size", 0) or 0)
+    lo = int(getattr(group, "gang_min", 0) or 0) or size
+    hi = int(getattr(group, "gang_max", 0) or 0) or size
+    return lo, hi
+
+
+def gang_is_elastic(group) -> bool:
+    """True when the gang's legal member count differs from its rigid
+    all-or-nothing declaration — the gate every elastic-only code path
+    checks so rigid gangs stay decision-identical to a pre-elasticity
+    build.  NOTE ``lo != hi`` alone would be wrong: a gang declaring
+    ``min == max < size`` (run exactly M of the N members) must take
+    the elastic admission/reduction/growth-cap path too, or the rigid
+    cohort gate (all N) and the min-threshold reduction (M) strand a
+    permanent partial gang between them."""
+    if not getattr(group, "gang", False):
+        return False
+    size = int(getattr(group, "gang_size", 0) or 0)
+    lo, hi = gang_bounds(group)
+    return not (lo == hi == size)
 
 
 class DruMode(enum.Enum):

@@ -1545,6 +1545,105 @@ class Store:
                 return 0
             return int(getattr(g, "gang_size", 0) or 0)
 
+    def gang_live_members(self, uuid: Optional[str]) -> int:
+        """Clone-free count of a gang's members with a LIVE instance
+        (unknown/running) — the elastic subsystem's "current size" of a
+        running gang (docs/GANG.md elasticity).  0 for missing or
+        non-gang groups."""
+        if not uuid:
+            return 0
+        with self._lock:
+            g = self._groups.get(uuid)
+            if g is None or not getattr(g, "gang", False):
+                return 0
+            live = 0
+            for member_uuid in g.jobs:
+                j = self._jobs.get(member_uuid)
+                if j is None:
+                    continue
+                if any((i := self._instances.get(t)) is not None
+                       and i.status in (InstanceStatus.UNKNOWN,
+                                        InstanceStatus.RUNNING)
+                       for t in j.instances):
+                    live += 1
+            return live
+
+    def gang_admission_size(self, uuid: Optional[str]) -> int:
+        """Cohort size queue admission must reserve for this group
+        (docs/GANG.md): 0 for non-gang groups; ``gang_size`` for rigid
+        gangs (unchanged all-or-nothing semantics); for ELASTIC gangs,
+        ``gang_min`` while the gang is not yet satisfied, and 0 once it
+        runs at >= gang_min live members — a satisfied elastic gang's
+        remaining waiting members admit like group-less singles (the
+        grow path), no cohort semantics."""
+        if not uuid:
+            return 0
+        from .schema import gang_bounds, gang_is_elastic
+        with self._lock:
+            g = self._groups.get(uuid)
+            if g is None or not getattr(g, "gang", False):
+                return 0
+            if not gang_is_elastic(g):
+                return int(getattr(g, "gang_size", 0) or 0)
+            lo, _hi = gang_bounds(g)
+            live = 0
+            for member_uuid in g.jobs:
+                j = self._jobs.get(member_uuid)
+                if j is None:
+                    continue
+                if any((i := self._instances.get(t)) is not None
+                       and i.status in (InstanceStatus.UNKNOWN,
+                                        InstanceStatus.RUNNING)
+                       for t in j.instances):
+                    live += 1
+                    if live >= lo:
+                        return 0  # satisfied: members grow as singles
+            return lo
+
+    def gang_growth_headroom(self, uuid: Optional[str]) -> float:
+        """How many MORE members this gang may legally admit
+        (docs/GANG.md elasticity): ``gang_max - live`` for elastic
+        gangs, floored at 0; infinity for rigid/non-gang groups (their
+        admission is bounded by the cohort contract, not a cap).  The
+        grow path and surplus-single admission consume this so a gang
+        never runs past its declared maximum."""
+        if not uuid:
+            return float("inf")
+        from .schema import gang_bounds, gang_is_elastic
+        with self._lock:
+            g = self._groups.get(uuid)
+            if g is None or not gang_is_elastic(g):
+                return float("inf")
+            _lo, hi = gang_bounds(g)
+            live = 0
+            for member_uuid in g.jobs:
+                j = self._jobs.get(member_uuid)
+                if j is None:
+                    continue
+                if any((i := self._instances.get(t)) is not None
+                       and i.status in (InstanceStatus.UNKNOWN,
+                                        InstanceStatus.RUNNING)
+                       for t in j.instances):
+                    live += 1
+            return float(max(hi - live, 0))
+
+    def elastic_gang_groups(self) -> List[Group]:
+        """Clone of every ELASTIC gang group with at least one live or
+        waiting member job — the resize pass's scan set (docs/GANG.md
+        elasticity).  Cheap for non-elastic workloads: the elastic test
+        is clone-free and ordinary groups are skipped outright."""
+        from .schema import gang_is_elastic
+        out: List[Group] = []
+        with self._lock:
+            for g in self._groups.values():
+                if not gang_is_elastic(g):
+                    continue
+                if any((j := self._jobs.get(u)) is not None
+                       and j.state is not JobState.COMPLETED
+                       for u in g.jobs):
+                    out.append(fast_clone(g))
+        return out
+
     def gang_groups_of(self, jobs) -> Dict[str, Group]:
         """The gang Groups these jobs' ``group`` fields reference, one
         lookup per distinct group — the shared gang-membership test for

@@ -342,6 +342,14 @@ class AuditTrail:
             tab = self._user_dru.get(pool)
             return tab.get(user) if tab is not None else None
 
+    def user_dru_table(self, pool: str) -> Dict[str, float]:
+        """Copy of a pool's whole per-user DRU table (the fairness
+        plane's objective signal for the goodput optimizer,
+        sched/optimizer.py)."""
+        with self._lock:
+            tab = self._user_dru.get(pool)
+            return dict(tab) if tab is not None else {}
+
     def last_reason(self, uuid: str) -> Optional[str]:
         """The job's most recent skip/defer reason (wait-phase
         classification input; O(1))."""

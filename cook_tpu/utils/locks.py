@@ -9,6 +9,7 @@ locks must acquire them in ascending rank):
     ======  ==================  ==============================================
     10      ``store.notify``    `state/store.py` — commit-ordered event drain
     15      ``read_replica``    `state/read_replica.py` — apply-loop/rebuild mutex
+    18      ``elastic``         `sched/elastic.py` — resize-ledger mutex
     20      ``store``           `state/store.py` — the store's main RLock
     30      ``index``           `state/index.py` — columnar projection mutex
     40      ``audit``           `utils/audit.py` — per-job lane mutex
@@ -464,6 +465,7 @@ monitor = LockMonitor()
 _DECLARED_ORDER = {
     "store.notify": 10,
     "read_replica": 15,
+    "elastic": 18,
     "store": 20,
     "index": 30,
     "audit": 40,

@@ -8,10 +8,19 @@ keyed by (name, labels), exposed at /metrics.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
+
+#: context-scoped write suppression (propagates into copy_context worker
+#: threads, like tracing's span context): the optimizer's
+#: faster-than-real-time sim replay (sched/optimizer.py) drives a REAL
+#: scheduler in-process, and its counters must not leak into the
+#: production exposition — a replayed preemption is not a preemption
+_suppressed: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("cook_metrics_suppressed", default=False)
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
             5.0, 10.0)
@@ -117,14 +126,30 @@ class MetricsRegistry:
                 self._counters[key2] = self._counters.get(key2, 0.0) + 1.0
         return folded if folded is not None else labels
 
+    @contextmanager
+    def suppressed(self):
+        """Suppress every metric WRITE made from this context (and from
+        workers started via ``contextvars.copy_context().run`` under it)
+        — the optimizer's sim replay runs whole schedulers in-process
+        and their counters are simulation, not production truth."""
+        token = _suppressed.set(True)
+        try:
+            yield
+        finally:
+            _suppressed.reset(token)
+
     def counter_inc(self, name: str, value: float = 1.0,
                     labels: Optional[Dict[str, str]] = None) -> None:
+        if _suppressed.get():
+            return
         key = (name, _labels_key(self._guard_labels(name, labels)))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0.0) + value
 
     def gauge_set(self, name: str, value: float,
                   labels: Optional[Dict[str, str]] = None) -> None:
+        if _suppressed.get():
+            return
         labels = self._guard_labels(name, labels)
         with self._lock:
             self._gauges[(name, _labels_key(labels))] = value
@@ -146,6 +171,8 @@ class MetricsRegistry:
         cumulative bucket counts cannot be re-bucketed); default is the
         sub-second duration ladder, pass ``LATENCY_BUCKETS`` for
         second-to-hour wait times."""
+        if _suppressed.get():
+            return
         key = (name, _labels_key(self._guard_labels(name, labels)))
         with self._lock:
             h = self._histograms.get(key)
@@ -167,6 +194,8 @@ class MetricsRegistry:
         OUTSIDE the lock (one sort + searchsorted), then merged under one
         lock hold — the monitor's 100k-pending-job age sweep must not
         turn into 100k individual locked bucket scans."""
+        if _suppressed.get():
+            return
         import numpy as np
         vals = np.asarray(list(values_s), dtype=float)
         if vals.size == 0:

@@ -76,8 +76,15 @@ class TestOptimizerProtocols:
         assert uuids == ["j1", "j2"]
 
     def test_config_driven_factory_loading(self):
+        # default = the REAL goodput loop (ISSUE 13); the dummies stay
+        # loadable as explicit opt-outs for parity
+        from cook_tpu.sched.optimizer import GoodputOptimizer
         cycler = OptimizerConfig().build()
         assert isinstance(cycler.host_feed, DummyHostFeed)
+        assert isinstance(cycler.optimizer, GoodputOptimizer)
+        cycler = OptimizerConfig(
+            optimizer_create_fn="cook_tpu.sched.optimizer.DummyOptimizer"
+        ).build()
         assert isinstance(cycler.optimizer, DummyOptimizer)
 
     def test_cycler_swallows_errors_like_reference(self):
