@@ -535,7 +535,12 @@ def _fused_cycle_setup(T, n_users, H, seed_rank=9, seed_match=10):
         avail=at(avail),
         capacity=at(capacity))
     mesh = Mesh(np.array(jax.devices()[:1]), ("pool",))
-    fused = make_pool_cycle(mesh, considerable_cap=1024, compact=True)
+    from cook_tpu.ops import telemetry as _telemetry
+    # instrumented like production (sched/fused._cycle_fn): the
+    # megakernel_cycle section counts launches off this wrapper
+    fused = _telemetry.instrument_jit(
+        "fused.pool_cycle",
+        make_pool_cycle(mesh, considerable_cap=1024, compact=True))
     return fused, inp
 
 
@@ -553,6 +558,189 @@ def bench_fused_cycle(T=100_000, n_users=200, H=5000):
     print(f"fused_cycle[{T//1000}k tasks x {H//1000}k hosts, 1k "
           f"considerable] amortized_p50={out['p50_ms']}ms "
           f"p99={out['p99_ms']}ms placed={placed}", file=sys.stderr)
+    return out
+
+
+def _mega_wire_from_compact(inp, quantized: bool):
+    """Build the megakernel wire (+ codec tags) from a bench
+    CompactPoolCycleInputs — the same negotiation sched/fused._stage_mega
+    runs, applied to the bench workload."""
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import pallas_cycle, quant
+
+    rows = np.asarray(inp.rows)
+    flags = np.asarray(inp.flags)
+    host_gpu = np.asarray(inp.host_gpu)
+    host_blocked = np.asarray(inp.host_blocked)
+    avail = np.asarray(inp.avail)
+    capacity = np.asarray(inp.capacity)
+    P, TB = rows.shape
+    H = avail.shape[1]
+    rows_codec, avail_scale, cap_scale = quant.ROWS_WIDE, 0.0, 0.0
+    if quantized:
+        qr = quant.quantize_rows(rows)
+        qa = quant.quantize_fixed(avail, "avail")
+        qc = quant.quantize_fixed(capacity, "capacity")
+        rows_codec, avail_scale, cap_scale = qr.codec, qa.scale, qc.scale
+        w_rows, w_avail, w_cap = qr.data, qa.data, qc.data
+        wire_bytes = (qr.nbytes + flags.nbytes + qa.nbytes + qc.nbytes
+                      + quant.pack_bits(host_gpu).nbytes * 2)
+    else:
+        w_rows, w_avail, w_cap = rows, avail, capacity
+        wire_bytes = quant.compact_wire_nbytes(
+            rows, flags, avail, capacity, host_gpu, host_blocked)
+    host_bits = np.stack([quant.pack_bits(host_gpu),
+                          quant.pack_bits(host_blocked)], axis=1)
+    gang_id, gang_size, gang_attr, host_topo = \
+        pallas_cycle.empty_gang_wire(P, TB, H)
+    wire = pallas_cycle.MegaCycleWire(
+        rows=jnp.asarray(w_rows), flags=inp.flags,
+        res_base=inp.res_base, disk_base=inp.disk_base,
+        tokens_u=inp.tokens_u, shares_u=inp.shares_u,
+        quota_u=inp.quota_u, num_considerable=inp.num_considerable,
+        pool_quota=inp.pool_quota, group_quota=inp.group_quota,
+        group_id=inp.group_id, host_bits=jnp.asarray(host_bits),
+        exc_rows=inp.exc_rows, exc_mask=inp.exc_mask,
+        avail=jnp.asarray(w_avail), capacity=jnp.asarray(w_cap),
+        gang_id=jnp.asarray(gang_id), gang_size=jnp.asarray(gang_size),
+        gang_attr=jnp.asarray(gang_attr),
+        host_topo=jnp.asarray(host_topo))
+    return wire, rows_codec, avail_scale, cap_scale, wire_bytes
+
+
+def bench_megakernel_cycle(T=100_000, n_users=200, H=5000, C=1024,
+                           reps=3, inner=2):
+    """ISSUE 14: the single-launch Pallas megakernel vs the fused XLA
+    cycle vs the split per-stage path, on ONE workload (the fused_cycle
+    setup).  p50/p99 per leg PLUS the fusion evidence that stays visible
+    even on CPU (where the megakernel runs interpret-mode and its wall
+    time is not the story): kernel LAUNCHES per cycle — measured off the
+    flight recorder, not estimated — and per-cycle wire bytes (compact
+    vs negotiated quantized form) next to the estimated HBM bytes the
+    [T]-sized inter-stage intermediates cost each non-fused path."""
+    import jax
+    import jax.numpy as jnp
+
+    from cook_tpu.ops import pallas_cycle
+    from cook_tpu.ops.dru import CompactRankInputs, rank_kernel_compact
+    from cook_tpu.ops.gang import GangPack, gang_reduce_kernel
+    from cook_tpu.ops.match import MatchInputs, greedy_match_kernel
+    from cook_tpu.utils.flight import recorder as flight_recorder
+
+    fused, inp = _fused_cycle_setup(T, n_users, H)
+    TB = int(inp.rows.shape[1])
+    HB = int(inp.avail.shape[1])
+    C = min(C, TB)
+
+    # ---- split leg: rank launch -> host round trip -> match launch ->
+    # host round trip -> gang-reduce launch (the pre-fusion shape the
+    # motivation cites; each boundary moves [T]-sized arrays).  The gang
+    # pack is a token 4-member gang so the third launch is real.
+    rinp = CompactRankInputs(
+        rows=inp.rows[0], flags=inp.flags[0], res_base=inp.res_base,
+        shares_u=inp.shares_u[0], quota_u=inp.quota_u[0])
+    job_res_np = np.asarray(inp.res_base)[:TB].copy()
+    job_res_np[:, 3] = np.asarray(inp.disk_base)[:TB]
+    avail_np = np.asarray(inp.avail)[0]
+    cap_np = np.asarray(inp.capacity)[0]
+    pend_np = (np.asarray(inp.flags)[0] & 1) != 0
+    gang_pack = GangPack(
+        gang_id=np.where(np.arange(C) < 4, 0, -1).astype(np.int32),
+        gang_size=np.array([4], dtype=np.int32),
+        gang_attr=np.zeros(1, dtype=np.int32),
+        host_topo=np.zeros((1, HB), dtype=np.int32),
+        uuids=["bench-gang"], topology=[None], declared=[4])
+
+    def split_cycle():
+        r = rank_kernel_compact(rinp)
+        order = np.asarray(r.order)                      # d2h boundary
+        cand = order[pend_np[order]][:C]
+        minp = MatchInputs(                              # h2d boundary
+            job_res=jnp.asarray(job_res_np[cand]),
+            constraint_mask=jnp.ones((len(cand), HB), dtype=bool),
+            avail=jnp.asarray(avail_np),
+            capacity=jnp.asarray(cap_np),
+            valid=jnp.ones(len(cand), dtype=bool))
+        assign, _ = greedy_match_kernel(minp)
+        assign = np.asarray(assign)                      # d2h boundary
+        out, _dropped = gang_reduce_kernel(assign[:C], gang_pack)
+        return out
+
+    # ---- megakernel leg (compact + quantized wire forms)
+    wire_c, *codec_c, wire_c_bytes = _mega_wire_from_compact(inp, False)
+    wire_q, *codec_q, wire_q_bytes = _mega_wire_from_compact(inp, True)
+
+    def mega_cycle(wire, codecs):
+        return pallas_cycle.megacycle(
+            wire, considerable_cap=C, rows_codec=codecs[0],
+            avail_scale=codecs[1], cap_scale=codecs[2])
+
+    def launches(fn):
+        with flight_recorder.cycle(kind="bench") as rec:
+            fn()
+        return rec.kernel_launches if rec is not None else -1
+
+    legs = {}
+    parity = {}
+    fused_out = fused(inp)
+    mega_out = mega_cycle(wire_q, codec_q)
+    parity["mega_vs_fused_bitexact"] = bool(
+        (np.asarray(fused_out.cand_row) == np.asarray(mega_out.cand_row))
+        .all()
+        and (np.asarray(fused_out.cand_assign)
+             == np.asarray(mega_out.cand_assign)).all())
+    for name, fn in (
+            ("split", split_cycle),
+            ("fused_xla", lambda: jax.block_until_ready(
+                fused(inp).cand_assign)),
+            ("megakernel", lambda: jax.block_until_ready(
+                mega_cycle(wire_q, codec_q).cand_assign)),
+            ("megakernel_wide", lambda: jax.block_until_ready(
+                mega_cycle(wire_c, codec_c).cand_assign))):
+        times = timed(fn, reps=reps, inner=inner)
+        legs[name] = {"p50_ms": round(pctl(times, 50), 2),
+                      "p99_ms": round(pctl(times, 99), 2),
+                      "kernel_launches": launches(fn)}
+    # [T]-sized intermediates that cross HBM BETWEEN launches on the
+    # split path (ranked order out, compacted match inputs in, assign
+    # out, gang bits in) — the traffic the megakernel keeps in VMEM.
+    # The fused XLA leg launches once but XLA still materializes the
+    # stage boundaries in HBM inside the launch (fusion islands);
+    # counted here as the same [T] chain for an upper-bound estimate.
+    split_hbm = (TB * 4            # order d2h
+                 + C * (4 * 4 + HB)  # match job_res + mask h2d
+                 + C * 4           # assign d2h
+                 + C * 4)          # gang bits h2d
+    legs["split"]["est_hbm_intermediate_bytes"] = int(split_hbm)
+    legs["fused_xla"]["est_hbm_intermediate_bytes"] = int(TB * 4 * 6)
+    legs["megakernel"]["est_hbm_intermediate_bytes"] = 0
+    out = {
+        "T": TB, "H": HB, "considerable_cap": C,
+        "legs": legs,
+        "parity": parity,
+        "wire": {
+            "compact_bytes_per_cycle": int(wire_c_bytes),
+            "quantized_bytes_per_cycle": int(wire_q_bytes),
+            "quantized_ratio": round(wire_q_bytes / max(wire_c_bytes, 1),
+                                     3),
+            "rows_codec": int(codec_q[0]),
+            "avail_scale": codec_q[1], "capacity_scale": codec_q[2],
+        },
+        "launch_ratio_split_vs_megakernel": round(
+            legs["split"]["kernel_launches"]
+            / max(legs["megakernel"]["kernel_launches"], 1), 2),
+        "note": ("CPU runs the megakernel in interpret mode: wall time "
+                 "is not the on-chip story there — launches/cycle and "
+                 "bytes/cycle are the fusion evidence (ISSUE 14)"),
+    }
+    print(f"megakernel_cycle[{TB//1000}k x {HB//1000}k] launches: "
+          f"split={legs['split']['kernel_launches']} "
+          f"fused={legs['fused_xla']['kernel_launches']} "
+          f"mega={legs['megakernel']['kernel_launches']}; wire "
+          f"{wire_q_bytes}/{wire_c_bytes}B "
+          f"({out['wire']['quantized_ratio']}x); parity="
+          f"{parity['mega_vs_fused_bitexact']}", file=sys.stderr)
     return out
 
 
@@ -588,6 +776,29 @@ def bench_pallas_scale(J=100_000, H=50_000, E=256, k=16):
           f"(dense mask would need "
           f"{J * H / 1e9:.0f} GB + {J * H * 4 / 1e9:.0f} GB scores)",
           file=sys.stderr)
+    # megakernel leg (ISSUE 14): the single-launch fused cycle at the
+    # same J (hosts at the cycle design point — the megakernel's match
+    # stage is C x H, not J x H, so a 50k host axis measures nothing it
+    # does differently).  TPU-only section, so this is the on-chip
+    # Mosaic-lowering probe: a lowering failure shows up here before it
+    # shows up as production fallbacks.
+    try:
+        import jax
+
+        from cook_tpu.ops import pallas_cycle
+        fused, inp = _fused_cycle_setup(J, max(J // 500, 8), 5000)
+        wire, rc, asc, csc, _wb = _mega_wire_from_compact(inp, True)
+        mt = timed(lambda: jax.block_until_ready(
+            pallas_cycle.megacycle(
+                wire, considerable_cap=1024, rows_codec=rc,
+                avail_scale=asc, cap_scale=csc).cand_assign),
+            reps=3, inner=1)
+        out["megakernel_cycle_p50_ms"] = round(pctl(mt, 50), 1)
+        out["megakernel_cycle_p99_ms"] = round(pctl(mt, 99), 1)
+        print(f"pallas_scale megakernel leg p50="
+              f"{out['megakernel_cycle_p50_ms']}ms", file=sys.stderr)
+    except Exception as exc:  # lowering gap is data, not a bench failure
+        out["megakernel_leg_error"] = f"{type(exc).__name__}: {exc}"[:200]
     return out
 
 
@@ -2301,6 +2512,10 @@ def run_section(name: str) -> None:
     elif name == "fused_cycle":
         data = bench_fused_cycle(T=scaled(100_000),
                                  n_users=scaled(200, lo=8), H=scaled(5000))
+    elif name == "megakernel_cycle":
+        data = bench_megakernel_cycle(T=scaled(100_000),
+                                      n_users=scaled(200, lo=8),
+                                      H=scaled(5000))
     elif name == "rebalance":
         data = {"samples_ms": bench_rebalance(T=scaled(1_000_000),
                                               H=scaled(50_000))}
@@ -2455,6 +2670,9 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["match_large_10k_jobs_50k_hosts"] = results["match_large"]
     if results.get("fused_cycle") is not None:
         detail["fused_cycle_100k_tasks_5k_hosts"] = results["fused_cycle"]
+    if results.get("megakernel_cycle") is not None:
+        detail["megakernel_cycle_100k_tasks_5k_hosts"] = \
+            results["megakernel_cycle"]
     if results.get("store_cycle") is not None:
         detail["store_cycle_100k_jobs"] = results["store_cycle"]
     if results.get("store_scale") is not None:
@@ -2563,8 +2781,8 @@ def main():
 
     capture, capture_src = _load_prior_capture()
     sections = ["sync_floor", "rank", "match", "driver_cycle",
-                "resident_cycle", "pipeline_driver", "gang_cycle",
-                "elastic_cycle", "rest_plane", "fused_cycle",
+                "megakernel_cycle", "resident_cycle", "pipeline_driver",
+                "gang_cycle", "elastic_cycle", "rest_plane", "fused_cycle",
                 "store_cycle", "store_scale", "match_large", "rebalance",
                 "end2end", "pallas_scale", "pipeline",
                 "placement_quality"]

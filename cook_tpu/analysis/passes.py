@@ -413,8 +413,63 @@ def registry_completeness(package_root: Path,
     return findings
 
 
+# --------------------------------------------------------------------------
+# pass: pallas module-level jnp constants (the capture pitfall)
+# --------------------------------------------------------------------------
+
+def _expr_uses_jnp(node: ast.AST) -> bool:
+    """True when an expression references jnp / jax.numpy (an array
+    BUILT at import time)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "jnp":
+            return True
+        if isinstance(sub, ast.Attribute):
+            d = _dotted(sub)
+            if d.startswith("jnp.") or d.startswith("jax.numpy."):
+                return True
+    return False
+
+
+def pallas_module_constants(path: Path, relpath: str, tree: ast.Module,
+                           src_lines: Sequence[str]) -> List[Finding]:
+    """No module-level ``jnp`` constants in ``ops/pallas_*.py``: a jnp
+    array built at import time is CAPTURED by every pallas kernel that
+    references it — it pins a device buffer for the process lifetime,
+    breaks interpret/compiled parity across backends, and (on TPU) is
+    constant-folded into the Mosaic binary where a python literal would
+    have stayed a scalar.  ops/pallas_match.py documents the pitfall by
+    hand (`_BIG = 2**31 - 1  # python literal ...`); this pass enforces
+    it for every pallas module (ISSUE 14 satellite)."""
+    name = Path(relpath).name
+    if not (relpath.startswith("ops/") and name.startswith("pallas_")
+            and name.endswith(".py")):
+        return []
+    findings: List[Finding] = []
+    for node in tree.body:  # module level ONLY: function bodies trace
+        targets: List[ast.expr] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None or not _expr_uses_jnp(value):
+            continue
+        tnames = ", ".join(
+            t.id for t in targets if isinstance(t, ast.Name)) or "<target>"
+        findings.append(Finding(
+            check="pallas-module-constant", path=relpath,
+            line=node.lineno, scope="<module>", detail=tnames,
+            message=(f"module-level jnp constant `{tnames}` in a pallas "
+                     "module: import-time jnp arrays are captured by "
+                     "every kernel trace (device-buffer pin, "
+                     "interpret/compiled drift) — use a python literal "
+                     "and build arrays inside the kernel/entry point")))
+    return findings
+
+
 #: the per-file passes, in run order
 PASSES = (
     ("lock-discipline", lock_discipline),
     ("jit-hygiene", jit_hygiene),
+    ("pallas-module-constant", pallas_module_constants),
 )

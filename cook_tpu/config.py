@@ -24,7 +24,11 @@ class MatcherConfig:
     # large-J backend selection is automatic per pool size);
     # "tpu-greedy" = bit-exact greedy scan kernel; "tpu-auction" = top-K
     # adaptive auction + waterfill tail; "tpu-waterfill" = prefix-packing
-    # kernel with no J x H work at all; "cpu" = numpy fallback.
+    # kernel with no J x H work at all; "cpu" = numpy fallback;
+    # "tpu-megakernel" = single-launch Pallas fused cycle (rank +
+    # admission + match + gang reduce in one kernel, ops/pallas_cycle.py;
+    # interpret-mode on CPU — bit-identical to the fused XLA driver, and
+    # what "auto" prefers at the CYCLE level on a real TPU backend).
     backend: str = "auto"
     auto_large_j_threshold: int = 2000
     # what "auto" optimizes for ABOVE the threshold
@@ -69,13 +73,24 @@ class MatcherConfig:
         # backend raising inside the cycle would silently zero out the
         # pool's scheduling instead of failing the daemon's config load
         if self.backend == "tpu-auction-pallas":
+            # LOGGED deprecation with a metric increment (not a silent
+            # rewrite): operators grep /metrics for
+            # cook_config_deprecated_total to find stale configs before
+            # the alias is dropped for good
             import logging
             logging.getLogger(__name__).warning(
-                "matcher backend tpu-auction-pallas was removed "
-                "(docs/PLACEMENT_QUALITY.md); using tpu-auction")
+                "DEPRECATED matcher backend tpu-auction-pallas was "
+                "removed (docs/PLACEMENT_QUALITY.md); rewriting to "
+                "tpu-auction — update the config, this alias will stop "
+                "working in a future release")
+            from .utils.metrics import registry as _registry
+            _registry.counter_inc(
+                "cook_config_deprecated",
+                labels={"knob": "matcher.backend",
+                        "value": "tpu-auction-pallas"})
             self.backend = "tpu-auction"
         if self.backend not in ("auto", "tpu-greedy", "tpu-auction",
-                                "tpu-waterfill", "cpu"):
+                                "tpu-waterfill", "tpu-megakernel", "cpu"):
             raise ValueError(f"unknown matcher backend {self.backend!r}")
         if self.auto_packing not in ("throughput", "tight"):
             raise ValueError(f"unknown auto_packing "
@@ -589,6 +604,14 @@ class Config:
     # faults.  Decision-identical to the rebuild path; only engages with
     # columnar_index=True (the compact wire form).
     resident_pack: bool = True
+    # quantized compact wire (ops/quant.py; docs/PERFORMANCE.md wire
+    # negotiation table): narrow each per-cycle h2d field to the
+    # smallest dtype its domain admits THIS cycle — delta-coded i8/i16
+    # rows, u16 fixed-point host stacks, bitpacked host flags — but only
+    # where the round trip is bit-exact; overflowing domains ship wide
+    # automatically.  Engages on the megakernel dispatch path and the
+    # delta feed's scatter values; never changes a decision.
+    quantized_wire: bool = True
     default_pool: str = "default"
     # pool-regex -> matcher config, first match wins (config.clj:798)
     pool_matchers: List[tuple] = field(default_factory=list)

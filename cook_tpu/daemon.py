@@ -57,7 +57,7 @@ _SCALAR_CONFIG_FIELDS = (
     "lingering_task_interval_seconds", "straggler_interval_seconds",
     "monitor_interval_seconds", "max_tasks_per_host", "heartbeat_enabled",
     "heartbeat_timeout_ms", "orphaned_cluster_grace_seconds",
-    "columnar_index", "resident_pack",
+    "columnar_index", "resident_pack", "quantized_wire",
 )
 
 

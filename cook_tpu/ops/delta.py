@@ -74,28 +74,66 @@ def _donate_default() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+class _StagedDelta:
+    """One staged (h2d-in-flight) scatter batch: the padded device
+    arrays whose host->device copies started at :meth:`stage` time.
+    Holding it across the current cycle's kernel dispatch is the
+    DOUBLE-BUFFERED form (ISSUE 14): the next cycle's delta bytes move
+    while the current kernel computes, because every stage allocates
+    FRESH host buffers — nothing rewrites memory an in-flight copy still
+    reads."""
+
+    __slots__ = ("shape", "kb", "codec", "idx", "vals", "flags", "nbytes")
+
+    def __init__(self, shape, kb, codec, idx, vals, flags, nbytes):
+        self.shape = shape
+        self.kb = kb
+        self.codec = codec
+        self.idx = idx
+        self.vals = vals
+        self.flags = flags
+        self.nbytes = nbytes
+
+
 class PackDeltaApplier:
     """Caches one jitted scatter executable per (buffer shape, delta
-    bucket); donation re-uses the old buffer's device memory so the
-    resident pack never doubles its footprint during the update."""
+    bucket, value codec); donation re-uses the old buffer's device
+    memory so the resident pack never doubles its footprint during the
+    update.
+
+    The scatter's value payload rides the quantized wire's rows codec
+    (ops/quant.py): with ``quantize=True`` row values are coded as
+    deltas against their own target position, so a steady-state scatter
+    row costs 4 (idx) + 1-2 (value) + 1 (flag) bytes instead of 9 —
+    losslessly, with automatic wide fallback when a batch's deltas
+    overflow the narrow width."""
 
     def __init__(self, donate: Optional[bool] = None):
         self._fns: Dict[Tuple, object] = {}
         self._donate = donate
 
-    def _fn(self, shape: Tuple[int, ...], kb: int):
-        key = (shape, kb)
+    def _fn(self, shape: Tuple[int, ...], kb: int, codec: int = 0):
+        key = (shape, kb, codec)
         fn = self._fns.get(key)
         if fn is None:
             import jax
+            import jax.numpy as jnp
+            from .quant import ROWS_WIDE
             if self._donate is None:
                 self._donate = _donate_default()
+            T = shape[-1]
 
             def _apply(rows_buf, flags_buf, idx, rows_v, flags_v):
                 flat_r = rows_buf.reshape(-1)
                 flat_f = flags_buf.reshape(-1)
+                if codec != ROWS_WIDE:
+                    # position-relative decode; the padding sentinel's
+                    # garbage value is dropped by its OOB index anyway
+                    rows_v32 = rows_v.astype(jnp.int32) + (idx % T)
+                else:
+                    rows_v32 = rows_v
                 # padding idx entries are == buffer size: OOB, dropped
-                flat_r = flat_r.at[idx].set(rows_v, mode="drop")
+                flat_r = flat_r.at[idx].set(rows_v32, mode="drop")
                 flat_f = flat_f.at[idx].set(flags_v, mode="drop")
                 return (flat_r.reshape(rows_buf.shape),
                         flat_f.reshape(flags_buf.shape))
@@ -106,28 +144,64 @@ class PackDeltaApplier:
             self._fns[key] = fn
         return fn
 
-    def apply(self, rows_dev, flags_dev, idx: np.ndarray,
-              rows_vals: np.ndarray, flags_vals: np.ndarray):
-        """Scatter the delta batch into the resident buffers; returns the
-        (new_rows_dev, new_flags_dev) device arrays.  ``idx`` holds flat
-        positions into the raveled buffer."""
+    def stage(self, shape: Tuple[int, ...], idx: np.ndarray,
+              rows_vals: np.ndarray, flags_vals: np.ndarray,
+              quantize: bool = False) -> _StagedDelta:
+        """Pad, negotiate the value codec, and START the host->device
+        copies for one delta batch.  Split from :meth:`commit` so a
+        pipelined driver's stage-(k+1) h2d overlaps cycle k's in-flight
+        kernel (the double-buffering half of ISSUE 14's wire work)."""
         import jax.numpy as jnp
-        n_flat = int(np.prod(rows_dev.shape))
+        from . import quant as _q
+        n_flat = int(np.prod(shape))
+        T = int(shape[-1])
         k = int(idx.size)
         kb = min(bucket(max(k, 1), minimum=_DELTA_MIN_BUCKET), n_flat)
         if kb < k:  # bucket clamped under the delta: caller should repack
             raise ValueError(f"delta larger than buffer ({k} > {n_flat})")
         idx_p = np.full(kb, n_flat, dtype=np.int32)  # OOB sentinel pad
         idx_p[:k] = idx
-        rows_p = np.zeros(kb, dtype=np.int32)
-        rows_p[:k] = rows_vals
+        codec = _q.ROWS_WIDE
+        if quantize and k:
+            delta = rows_vals.astype(np.int64) - (idx.astype(np.int64) % T)
+            lo, hi = int(delta.min()), int(delta.max())
+            if -128 <= lo and hi <= 127:
+                codec, dt = _q.ROWS_I8, np.int8
+            elif -32768 <= lo and hi <= 32767:
+                codec, dt = _q.ROWS_I16, np.int16
+            else:
+                # the lossless-or-wide contract counts EVERY wide
+                # fallback (an operator must be able to see the narrow
+                # path never engaging)
+                _q.note_wide("delta")
+        if codec != _q.ROWS_WIDE:
+            rows_p = np.zeros(kb, dtype=dt)
+            rows_p[:k] = delta.astype(dt)
+        else:
+            rows_p = np.zeros(kb, dtype=np.int32)
+            rows_p[:k] = rows_vals
         flags_p = np.zeros(kb, dtype=np.uint8)
         flags_p[:k] = flags_vals
-        telemetry.count_transfer(
-            "h2d", idx_p.nbytes + rows_p.nbytes + flags_p.nbytes)
-        fn = self._fn(tuple(rows_dev.shape), kb)
-        return fn(rows_dev, flags_dev, jnp.asarray(idx_p),
-                  jnp.asarray(rows_p), jnp.asarray(flags_p))
+        nbytes = idx_p.nbytes + rows_p.nbytes + flags_p.nbytes
+        telemetry.count_transfer("h2d", nbytes)
+        return _StagedDelta(tuple(shape), kb, codec, jnp.asarray(idx_p),
+                            jnp.asarray(rows_p), jnp.asarray(flags_p),
+                            nbytes)
+
+    def commit(self, rows_dev, flags_dev, st: _StagedDelta):
+        """Dispatch the scatter against a previously staged batch."""
+        fn = self._fn(st.shape, st.kb, st.codec)
+        return fn(rows_dev, flags_dev, st.idx, st.vals, st.flags)
+
+    def apply(self, rows_dev, flags_dev, idx: np.ndarray,
+              rows_vals: np.ndarray, flags_vals: np.ndarray,
+              quantize: bool = False):
+        """Scatter the delta batch into the resident buffers; returns the
+        (new_rows_dev, new_flags_dev) device arrays.  ``idx`` holds flat
+        positions into the raveled buffer."""
+        st = self.stage(tuple(rows_dev.shape), idx, rows_vals,
+                        flags_vals, quantize=quantize)
+        return self.commit(rows_dev, flags_dev, st)
 
 
 class DeviceBaseMirror:

@@ -1,0 +1,224 @@
+"""Quantized compact wire form: narrow-dtype codecs for the fused
+cycle's per-cycle h2d arrays, negotiated per pool group (ISSUE 14).
+
+The compact wire (parallel/sharded.CompactPoolCycleInputs) already moved
+everything derivable onto the device; what still ships every cycle is
+the sorted row permutation (i32), the flags byte, and the per-host
+avail/capacity stacks (f32).  At the 100k x 5k design point that is
+~940 KB per full upload.  This module halves it again by narrowing each
+field to the smallest dtype its DOMAIN admits this cycle — and only
+when the round trip is EXACT:
+
+* ``rows`` — delta-from-position coding.  The columnar index emits the
+  base rows in user-sorted order and compaction rebuilds them sorted, so
+  the sorted permutation is near-identity and ``rows[t] - t`` fits int8
+  or int16 for the steady-state majority.  Negotiation picks the
+  narrowest width that holds EVERY delta (int8 -> int16 -> wide i32);
+  the device reconstructs ``rows = delta + iota`` losslessly.
+* ``avail``/``capacity`` — fixed-point uint16 with a per-wire
+  power-of-two scale, accepted only when ``decode(encode(x)) == x``
+  bit-for-bit for every element (host resources are overwhelmingly
+  small integers / power-of-two fractions); any non-representable value
+  falls back to the wide f32 form for the whole field.
+* ``host_gpu``/``host_blocked`` — bitpacked, 8 hosts per byte.
+* ``flags`` stays the u8 it already is.
+
+Every codec is negotiated independently and the negotiated wire carries
+its own codec tags, so "quantized" NEVER means "approximate": the
+property ``expand(quantize(x)) == expand(x)`` holds wherever a narrow
+form was chosen, and an overflowing domain is shipped wide with an
+explicit fallback count (``cook_quant_wide_fallback_total{field}``).
+
+The delta feed's scatter path (ops/delta.PackDeltaApplier) reuses the
+rows codec for its value payload: scatter values are coded as deltas
+against their own target position, so a steady-state scatter row costs
+idx + 1-2 value bytes + 1 flag byte instead of 9.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from ..utils.metrics import registry
+
+# rows codec tags (static in the decode executable's jit key)
+ROWS_WIDE = 0    # i32 absolute rows, no transform
+ROWS_I16 = 1     # int16 delta vs position
+ROWS_I8 = 2      # int8 delta vs position
+
+_ROWS_DTYPE = {ROWS_WIDE: np.int32, ROWS_I16: np.int16, ROWS_I8: np.int8}
+
+# fixed-point scales tried for the resource stacks, finest first: the
+# finest exact scale wins; non-power-of-two values (or magnitudes past
+# 65535 * scale) force the wide form
+_FIXED_SCALES = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+
+class QuantizedRows(NamedTuple):
+    """One negotiated rows wire: ``codec`` is a ROWS_* tag, ``data`` the
+    narrow (or wide) array.  Decode: ``data.astype(i32) + iota`` for the
+    delta codecs, identity for wide."""
+
+    codec: int
+    data: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes
+
+
+class QuantizedFixed(NamedTuple):
+    """A fixed-point u16 wire (``scale`` is a per-trailing-column tuple
+    of power-of-two scales) or the wide f32 fallback (``scale`` == 0.0,
+    ``data`` is the original array).  Per-COLUMN scales matter: one
+    resource axis mixes cpus (sub-integer granularity) with disk MB
+    (magnitudes past 65535), and a single shared scale would force the
+    whole field wide."""
+
+    scale: object   # tuple of per-column floats, or 0.0 = wide
+    data: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes
+
+
+def note_wide(field: str) -> None:
+    """Count one lossless-narrow negotiation that fell back to the wide
+    form (the contract: quantization is lossless-or-wide, and wide is
+    always COUNTED so an operator can see it never engaging)."""
+    registry.counter_inc("cook_quant_wide_fallback", labels={"field": field})
+
+
+_note_wide = note_wide
+
+
+def quantize_rows(rows: np.ndarray) -> QuantizedRows:
+    """Negotiate the narrowest exact delta coding for a rows permutation
+    (any leading batch shape; position runs along the LAST axis)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    iota = np.arange(rows.shape[-1], dtype=np.int64)
+    delta = rows - iota
+    lo, hi = (int(delta.min()), int(delta.max())) if delta.size else (0, 0)
+    if -128 <= lo and hi <= 127:
+        return QuantizedRows(ROWS_I8, delta.astype(np.int8))
+    if -32768 <= lo and hi <= 32767:
+        return QuantizedRows(ROWS_I16, delta.astype(np.int16))
+    _note_wide("rows")
+    return QuantizedRows(ROWS_WIDE, rows.astype(np.int32))
+
+
+def expand_rows(q: QuantizedRows) -> np.ndarray:
+    """Host-side decode (the device twin is :func:`expand_rows_device`)."""
+    if q.codec == ROWS_WIDE:
+        return np.asarray(q.data, dtype=np.int32)
+    iota = np.arange(q.data.shape[-1], dtype=np.int32)
+    return q.data.astype(np.int32) + iota
+
+
+def expand_rows_device(codec: int, data, T: int):
+    """Device-side rows decode (jnp; runs inside the megakernel's expand
+    stage or a pre-cycle decode).  ``codec`` must be static."""
+    import jax.numpy as jnp
+    if codec == ROWS_WIDE:
+        return data.astype(jnp.int32)
+    iota = jnp.arange(T, dtype=jnp.int32)
+    return data.astype(jnp.int32) + iota
+
+
+def quantize_fixed(x: np.ndarray, field: str,
+                   prefer=None) -> QuantizedFixed:
+    """Negotiate an exact u16 fixed-point coding for a non-negative f32
+    array (scale chosen PER trailing column), or fall back wide.
+    Exactness is CHECKED, not assumed: the coding is accepted only when
+    every element survives the round trip bit-for-bit.
+
+    ``prefer`` is a previously negotiated scale tuple: when it still
+    round-trips this cycle's values it is reused verbatim, keeping the
+    scale tuple — a STATIC jit key of the consuming kernel — sticky
+    across cycles instead of flapping to the finest exact scale as
+    values shift (each flap would be a full kernel retrace)."""
+    x = np.asarray(x, dtype=np.float32)
+    finite = np.isfinite(x)
+    if not finite.all() or (x < 0).any() or x.ndim == 0:
+        _note_wide(field)
+        return QuantizedFixed(0.0, x)
+    if isinstance(prefer, tuple) and len(prefer) == x.shape[-1]:
+        sv = np.asarray(prefer, dtype=np.float32)
+        q = np.round(x / sv)
+        if (q <= 65535).all() and \
+                (q.astype(np.float32) * sv == x).all():
+            return QuantizedFixed(tuple(prefer), q.astype(np.uint16))
+    scales = []
+    for c in range(x.shape[-1]):
+        col = x[..., c]
+        for s in _FIXED_SCALES:
+            q = np.round(col / np.float32(s))
+            if (q <= 65535).all() and \
+                    (q.astype(np.float32) * np.float32(s) == col).all():
+                scales.append(float(s))
+                break
+        else:
+            _note_wide(field)
+            return QuantizedFixed(0.0, x)
+    sv = np.asarray(scales, dtype=np.float32)
+    return QuantizedFixed(tuple(scales),
+                          np.round(x / sv).astype(np.uint16))
+
+
+def expand_fixed(q: QuantizedFixed) -> np.ndarray:
+    if q.scale == 0.0:
+        return np.asarray(q.data, dtype=np.float32)
+    return q.data.astype(np.float32) \
+        * np.asarray(q.scale, dtype=np.float32)
+
+
+def expand_fixed_device(scale, data):
+    """Device-side fixed-point decode (``scale`` static: a per-column
+    tuple, or 0.0 = wide passthrough).  Column-wise scalar multiplies,
+    not one scale vector: a jnp constant array would be CAPTURED by the
+    pallas kernel that calls this (the pitfall the
+    pallas-module-constant lint pass polices at module level)."""
+    import jax.numpy as jnp
+    if scale == 0.0:
+        return data
+    f = data.astype(jnp.float32)
+    return jnp.stack([f[..., c] * float(s) for c, s in enumerate(scale)],
+                     axis=-1)
+
+
+def pack_bits(x: np.ndarray) -> np.ndarray:
+    """Bitpack a bool array along its last axis (8 entries/byte)."""
+    return np.packbits(np.asarray(x, dtype=bool), axis=-1)
+
+
+def unpack_bits(packed: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(packed, axis=-1, count=n).astype(bool)
+
+
+def unpack_bits_device(packed, n: int):
+    """Device-side bit unpack along the last axis (``n`` static).  Shift
+    math stays in int32 — Mosaic prefers wide integer vectors and the
+    result is a bool mask either way."""
+    import jax.numpy as jnp
+    p32 = packed.astype(jnp.int32)
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    bits = (p32[..., :, None] >> (7 - shifts)) & 1
+    flat = bits.reshape(packed.shape[:-1] + (packed.shape[-1] * 8,))
+    return flat[..., :n] != 0
+
+
+def compact_wire_nbytes(rows: np.ndarray, flags: np.ndarray,
+                        avail: np.ndarray, capacity: np.ndarray,
+                        host_gpu: np.ndarray,
+                        host_blocked: np.ndarray) -> int:
+    """The unquantized compact wire cost of the same fields — the bench's
+    apples-to-apples denominator for the quantization ratio."""
+    return (np.asarray(rows).astype(np.int32).nbytes
+            + np.asarray(flags).astype(np.uint8).nbytes
+            + np.asarray(avail).astype(np.float32).nbytes
+            + np.asarray(capacity).astype(np.float32).nbytes
+            + np.asarray(host_gpu).astype(bool).nbytes
+            + np.asarray(host_blocked).astype(bool).nbytes)

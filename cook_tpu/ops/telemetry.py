@@ -68,6 +68,15 @@ class InstrumentedJit:
         except Exception:
             before = None
         out = fn(*args, **kwargs)
+        # every SUCCESSFUL call through an instrumented entry point is
+        # one device kernel dispatch: the per-cycle launch count
+        # (ISSUE 14) falls out of the wrapper every kernel already
+        # passes through.  Counted after the call — a dispatch that
+        # raises (Mosaic lowering gap, injected fault) never launched,
+        # and charging it would double-count against its fallback
+        registry.counter_inc("cook_kernel_launches", 1.0,
+                             {"kernel": self._kernel})
+        recorder.note_kernel_launch(self._kernel)
         if before is not None:
             try:
                 after = fn._cache_size()

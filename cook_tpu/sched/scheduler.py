@@ -793,6 +793,9 @@ class Scheduler:
         pools = ([p for p in self.store.pools() if p.name == pool_name]
                  if pool_name else self.store.pools())
         with flight_recorder.cycle(kind="match") as rec:
+            # per-stage XLA launches: the split path (also joined by a
+            # degraded fused cycle, which then reads "mixed")
+            flight_recorder.note_path("split")
             for pool in pools:
                 if pool.state != "active":
                     continue

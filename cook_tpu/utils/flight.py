@@ -64,7 +64,7 @@ class CycleRecord:
                  "h2d_bytes", "d2h_bytes", "sync_wait_ms", "faults",
                  "error", "pipeline_depth", "pipeline_inflight",
                  "pipeline_conflicts", "delta_rows", "full_repacks",
-                 "audit_events", "_t0")
+                 "audit_events", "kernel_launches", "path", "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
@@ -108,6 +108,15 @@ class CycleRecord:
         # the audit lane's own overhead meter — a cycle that recorded
         # nothing proves the quiet fast path stayed zero-work
         self.audit_events = 0
+        # device kernel dispatches inside this cycle (ISSUE 14: every
+        # InstrumentedJit call counts one) and the cycle path that made
+        # them: "split" (per-stage XLA launches), "fused" (one XLA pool
+        # cycle), "megakernel" (single Pallas launch), or "mixed" when
+        # one cycle's dispatch groups took different paths — a path
+        # regression (megakernel silently degrading to fused) is visible
+        # in /debug/cycles and the Perfetto export
+        self.kernel_launches = 0
+        self.path: Optional[str] = None
         self._t0 = time.perf_counter()
 
     def to_doc(self) -> Dict[str, Any]:
@@ -132,6 +141,8 @@ class CycleRecord:
             "delta_rows": self.delta_rows,
             "full_repacks": self.full_repacks,
             "audit_events": self.audit_events,
+            "kernel_launches": self.kernel_launches,
+            "path": self.path,
             "error": self.error,
         }
 
@@ -280,6 +291,31 @@ class FlightRecorder:
         if rec is not None and n:
             with self._lock:
                 rec.audit_events += int(n)
+
+    def note_kernel_launch(self, kernel: str, n: int = 1) -> None:
+        """One device kernel dispatch attributed to the current cycle
+        (counted by InstrumentedJit on every call — the megakernel's
+        headline is this number going to 1)."""
+        rec = _current_record.get()
+        if rec is not None and n:
+            with self._lock:
+                rec.kernel_launches += int(n)
+
+    def note_path(self, path: str) -> None:
+        """The cycle's dispatch path (split | fused | megakernel); two
+        different notes inside one cycle record as "mixed".  Also tagged
+        onto the live cycle span so the Perfetto export carries it."""
+        rec = _current_record.get()
+        if rec is None:
+            return
+        with self._lock:
+            if rec.path is None or rec.path == path:
+                rec.path = path
+            else:
+                rec.path = "mixed"
+        sp = tracing.tracer.current()
+        if sp is not None:
+            sp.set_tag("path", rec.path)
 
     def note_fault(self, point: str, n: int = 1) -> None:
         """A fault-point trigger or degradation (kernel fallback, breaker
