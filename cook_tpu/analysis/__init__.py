@@ -16,7 +16,16 @@ machine-checked here —
   CycleRecord field harvested from call sites must appear in the docs
   registries (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md), replacing the
   three runtime doc-check tests with one extractor shared by test and
-  CLI (:mod:`cook_tpu.analysis.registry`).
+  CLI (:mod:`cook_tpu.analysis.registry`);
+* **interprocedural effect summaries** — a whole-repo call graph
+  (:mod:`cook_tpu.analysis.callgraph`) plus a per-function effect
+  fixpoint (:mod:`cook_tpu.analysis.summaries`) extend the lexical
+  passes over call chains: transitive blocking-under-lock, a static
+  lock-order edge set diffed against the dynamic sanitizer's observed
+  edges (``cs lint --lock-coverage``, ``/debug/health`` → ``locks``),
+  verified ``_locked``/"caller holds" contracts, and the
+  journal-record protocol-completeness registry
+  (``state.store.JOURNAL_RECORD_KINDS``).
 
 Findings flow through a checked-in baseline (``analysis/baseline.json``)
 so the repo lints clean and NEW violations fail tier-1.  The dynamic

@@ -48,6 +48,12 @@ class Finding:
                    if self.suppressed_by else {})}
 
 
+#: machine-readable result document version (``--json`` consumers pin
+#: this; bump on any breaking shape change and note it in
+#: docs/ANALYSIS.md)
+SCHEMA_VERSION = 2
+
+
 @dataclass
 class LintResult:
     findings: List[Finding] = field(default_factory=list)
@@ -55,6 +61,14 @@ class LintResult:
     stale_baseline: List[str] = field(default_factory=list)
     files_scanned: int = 0
     errors: List[str] = field(default_factory=list)
+    #: whole-program analysis stats (call resolution coverage,
+    #: fixpoint iterations) — the `unresolved` bucket made explicit
+    callgraph: Dict[str, Any] = field(default_factory=dict)
+    #: static may-be-held-at-acquisition edges (family-normalized
+    #: docs), for `--lock-coverage` and the /debug/health diff
+    lock_edges: List[Dict[str, Any]] = field(default_factory=list)
+    #: set when findings were restricted to a changed-files set
+    changed_only: bool = False
 
     @property
     def ok(self) -> bool:
@@ -62,16 +76,29 @@ class LintResult:
         errors also fail (an unparseable file is an unlinted file), and
         so do STALE baseline entries — the CLI and the tier-1 self-lint
         golden must render the same verdict on the same tree, and the
-        baseline may only shrink honestly."""
+        baseline may only shrink honestly.  (In ``--changed`` mode the
+        stale check is skipped — entries for unchanged files are not
+        stale just because those files were filtered out; the full-repo
+        pass stays the gate.)"""
         return (not self.findings and not self.errors
                 and not self.stale_baseline)
 
     def to_doc(self) -> Dict[str, Any]:
-        return {"ok": self.ok,
+        return {"schema": SCHEMA_VERSION,
+                "ok": self.ok,
                 "files_scanned": self.files_scanned,
+                "summary": {
+                    "findings": len(self.findings),
+                    "suppressed": len(self.suppressed),
+                    "stale_baseline": len(self.stale_baseline),
+                    "errors": len(self.errors),
+                    "changed_only": self.changed_only,
+                },
+                "callgraph": dict(self.callgraph),
                 "findings": [f.to_doc() for f in self.findings],
                 "suppressed": [f.to_doc() for f in self.suppressed],
                 "stale_baseline": list(self.stale_baseline),
+                "lock_edges": list(self.lock_edges),
                 "errors": list(self.errors)}
 
 
@@ -108,11 +135,22 @@ def _pragma_allows(src_lines: List[str], line: int, check: str) -> bool:
 
 def run_lint(package_root: Optional[Path] = None,
              docs_root: Optional[Path] = None,
-             baseline: Optional[Path] = None) -> LintResult:
+             baseline: Optional[Path] = None,
+             changed: Optional[set] = None) -> LintResult:
     """Run every pass over ``package_root`` (default: the installed
     cook_tpu package) and the registry diff against ``docs_root``
-    (default: ``<repo>/docs`` next to the package when present)."""
+    (default: ``<repo>/docs`` next to the package when present).
+
+    ``changed`` (a set of finding paths — package-relative like
+    ``state/store.py``, or doc paths like ``docs/ANALYSIS.md``)
+    restricts REPORTED findings to those files: the whole-program
+    analysis still runs over the full tree (interprocedural summaries
+    need every module), only the report is filtered — the
+    ``cs lint --changed`` sub-second inner loop.  Stale-baseline
+    enforcement is skipped in that mode (docs/ANALYSIS.md exit
+    contract); the full-repo pass remains the tier-1 gate."""
     from .passes import PASSES, registry_completeness
+    from .summaries import run_interprocedural
 
     if package_root is None:
         package_root = Path(__file__).resolve().parent.parent
@@ -123,6 +161,8 @@ def run_lint(package_root: Optional[Path] = None,
     base = load_baseline(baseline)
     result = LintResult()
     raw: List[tuple] = []  # (finding, src_lines)
+    trees: Dict[str, ast.Module] = {}
+    sources: Dict[str, List[str]] = {}
 
     for path in sorted(package_root.rglob("*.py")):
         if "__pycache__" in path.parts:
@@ -135,10 +175,26 @@ def run_lint(package_root: Optional[Path] = None,
             result.errors.append(f"{relpath}: {e}")
             continue
         result.files_scanned += 1
+        trees[relpath] = tree
         src_lines = src.splitlines()
+        sources[relpath] = src_lines
         for _name, fn in PASSES:
             for f in fn(path, relpath, tree, src_lines):
                 raw.append((f, src_lines))
+
+    # whole-program passes: call graph + effect-summary fixpoint
+    # (docs/ANALYSIS.md interprocedural section).  An internal failure
+    # here is an ERROR, not a silent pass skip.
+    try:
+        interproc = run_interprocedural(package_root, trees)
+    except Exception as e:  # pragma: no cover - analysis bug surface
+        result.errors.append(f"interprocedural analysis failed: {e!r}")
+    else:
+        result.callgraph = interproc.stats
+        result.lock_edges = [e.to_doc() for _k, e in
+                             sorted(interproc.edges.items())]
+        for f in interproc.findings:
+            raw.append((f, sources.get(f.path, [])))
 
     for f in registry_completeness(package_root, docs_root):
         raw.append((f, []))
@@ -154,7 +210,15 @@ def run_lint(package_root: Optional[Path] = None,
             result.suppressed.append(f)
         else:
             result.findings.append(f)
-    result.stale_baseline = sorted(
-        fp for fp in base if fp not in seen_fingerprints)
-    result.findings.sort(key=lambda f: (f.path, f.line, f.check))
+    if changed is not None:
+        result.changed_only = True
+        result.findings = [f for f in result.findings
+                           if f.path in changed]
+        result.stale_baseline = []
+    else:
+        result.stale_baseline = sorted(
+            fp for fp in base if fp not in seen_fingerprints)
+    # deterministic order — byte-stable across runs for the same tree
+    result.findings.sort(
+        key=lambda f: (f.path, f.line, f.check, f.detail))
     return result

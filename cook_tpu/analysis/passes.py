@@ -98,14 +98,24 @@ class _ScopeWalker(ast.NodeVisitor):
 # pass 1: lock-discipline
 # --------------------------------------------------------------------------
 
-def _is_lock_scoped_fn(node: ast.FunctionDef) -> bool:
+def _is_lock_scoped_fn(node: ast.FunctionDef) -> Optional[str]:
     """Functions that run with a lock HELD by contract even though no
-    `with` is lexically visible: the repo idiom is a `_locked` suffix or
-    a 'caller holds' docstring."""
-    if node.name.endswith("_locked"):
-        return True
+    `with` is lexically visible: the repo idiom is a `_locked` suffix
+    or a caller-holds docstring naming the lock (the `self._lock`
+    idiom).  Returns the held-lock display token (the parsed name, or
+    the function name for a bare suffix), None when no contract
+    applies.  The docstring parser has ONE home —
+    callgraph.parse_contract_lock — shared with the interprocedural
+    requires_lock verifier, which also warns (`lock-contract-unnamed`)
+    when the contract names no lock."""
+    from .callgraph import parse_contract_lock
     doc = ast.get_docstring(node) or ""
-    return "caller holds" in doc.lower()
+    has_contract, token = parse_contract_lock(doc)
+    if token is not None:
+        return token
+    if has_contract or node.name.endswith("_locked"):
+        return f"<{node.name}>"
+    return None
 
 
 class _LockDiscipline(_ScopeWalker):
@@ -118,8 +128,9 @@ class _LockDiscipline(_ScopeWalker):
 
     def visit_FunctionDef(self, node):  # noqa: N802
         self.scope.append(node.name)
-        if _is_lock_scoped_fn(node):
-            self._held.append(f"<{node.name}>")
+        contract_lock = _is_lock_scoped_fn(node)
+        if contract_lock is not None:
+            self._held.append(contract_lock)
             for child in node.body:
                 self.visit(child)
             self._held.pop()

@@ -94,6 +94,17 @@ def cycle_record_fields() -> Set[str]:
     return set(CycleRecord(1, "fused").to_doc())
 
 
+def journal_record_kinds() -> Set[str]:
+    """The DECLARED journal record kinds — the protocol registry
+    ``state.store.JOURNAL_RECORD_KINDS`` (docs/ROBUSTNESS.md
+    replay-completeness contract).  The static diff against written /
+    handled kinds lives in the journal-record pass
+    (:func:`cook_tpu.analysis.summaries.journal_record_findings`);
+    this accessor is the runtime-facing twin for tests and tooling."""
+    from ..state.store import JOURNAL_RECORD_KINDS
+    return set(JOURNAL_RECORD_KINDS)
+
+
 def documented(doc_text: str, name: str, metric: bool = False) -> bool:
     """Is ``name`` registered in the doc?  Registries reference names in
     backticks; counters may be registered under their exposed ``_total``

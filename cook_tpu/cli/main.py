@@ -809,6 +809,12 @@ def cmd_lint(args) -> int:
         argv += ["--baseline", args.baseline]
     if args.show_suppressed:
         argv.append("--show-suppressed")
+    if args.changed is not None:
+        argv += ["--changed", args.changed]
+    if args.lock_coverage:
+        argv.append("--lock-coverage")
+    if args.observed:
+        argv += ["--observed", args.observed]
     return lint_main(argv)
 
 
@@ -1051,6 +1057,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--docs", default=None)
     sp.add_argument("--baseline", default=None)
     sp.add_argument("--show-suppressed", action="store_true")
+    sp.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="findings restricted to files modified vs a "
+                         "git base (default HEAD)")
+    sp.add_argument("--lock-coverage", action="store_true",
+                    dest="lock_coverage",
+                    help="static-vs-observed lock-edge coverage diff")
+    sp.add_argument("--observed", default=None, metavar="FILE",
+                    help="observed edges source for --lock-coverage "
+                         "(a /debug/health JSON)")
     sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("config")

@@ -135,7 +135,8 @@ class ComputeCluster(abc.ABC):
 
     @abc.abstractmethod
     def launch_tasks(self, pool: str, specs: List[LaunchSpec]) -> None:
-        """Start tasks. Caller holds the kill-lock read side."""
+        """Start tasks. Caller holds kill_lock (the read side), so an
+        in-flight launch always lands before a safe_kill_task."""
 
     @abc.abstractmethod
     def kill_task(self, task_id: str) -> None:

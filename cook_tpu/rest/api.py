@@ -1750,6 +1750,30 @@ class CookApi:
             # the observed acquisition-graph edge set + violation counts
             "locks": lock_monitor.snapshot(),
         }
+        # static-vs-observed lock-coverage diff (docs/ANALYSIS.md): the
+        # static edge set is computed ONCE per process off a background
+        # thread (a ~1 s source scan must never stall a health probe);
+        # until it lands, the block reports "computing".  unexercised =
+        # statically possible orderings tier-1 never drove; observed-
+        # only = a resolution gap in the static analysis (report it).
+        lk = health["locks"]
+        try:
+            from ..analysis.summaries import (static_edge_error,
+                                              static_edge_families)
+            static = static_edge_families(wait=False)
+            err = static_edge_error()
+        except Exception:  # analysis package stripped from this deploy
+            lk["static_edges"] = "unavailable"
+        else:
+            if static is not None:
+                observed = set(lk.get("observed_edges", []))
+                lk["static_edges"] = static
+                lk["unexercised_edges"] = sorted(set(static) - observed)
+                lk["observed_only_edges"] = sorted(observed - set(static))
+            elif err is not None:
+                lk["static_edges"] = f"failed: {err}"
+            else:
+                lk["static_edges"] = "computing"
         followers = repl.get("followers") or []
         if followers:
             health["replication"]["max_lag_bytes"] = max(

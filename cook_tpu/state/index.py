@@ -209,10 +209,16 @@ class ColumnarIndex:
     # ------------------------------------------------------------ lifecycle
     def _attach(self) -> None:
         with self.store._lock:
-            self._bulk_attach_jobs(list(self.store._jobs.values()))
-            for inst in self.store._instances.values():
-                if inst.status in _LIVE:
-                    self._add_instance_raw(inst)
+            # the index lock is uncontended at construction, but the
+            # row-sync helpers run lock-held BY CONTRACT (`caller holds
+            # self._lock`) — hold it so the contract is call-site-true
+            # here too, not just on the tx-feed path (store -> index is
+            # the declared rank order, utils/locks.py)
+            with self._lock:
+                self._bulk_attach_jobs(list(self.store._jobs.values()))
+                for inst in self.store._instances.values():
+                    if inst.status in _LIVE:
+                        self._add_instance_raw(inst)
             self.store.subscribe(self._on_events)
 
     def _bulk_attach_jobs(self, jobs) -> None:
@@ -220,7 +226,9 @@ class ColumnarIndex:
         one `_sync_job_raw` call per row (the per-row path stays for the
         incremental tx feed, where it is the right shape).  At the 1M-job
         design point (BASELINE config 5) this is the difference between
-        ~18 s and a few seconds of index attach."""
+        ~18 s and a few seconds of index attach.  Caller holds
+        self._lock (the attach path takes it; the helpers this calls
+        are lock-held by the same contract)."""
         if not jobs or self._n:
             for job in jobs:  # non-empty index: incremental semantics
                 self._sync_job_raw(job)
@@ -346,7 +354,7 @@ class ColumnarIndex:
                         tomb=was_pending and not now_pending)
 
     def _user_id(self, user: str, new_row: Optional[int] = None) -> int:
-        """Order-preserving user id (caller holds the lock).  A new name
+        """Order-preserving user id (caller holds self._lock).  A new name
         inserts into the sorted list and shifts every later id up — one
         vectorized pass, and only when a never-seen user first submits.
         ``new_row`` is the not-yet-assigned row this id is FOR: its slot

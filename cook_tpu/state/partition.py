@@ -171,7 +171,8 @@ class UserSummaryExchange:
         return time.monotonic() - self._refreshed_at
 
     def _sweep_locked(self) -> None:
-        # caller holds _refresh_mu
+        """Merge every partition's user summary (caller holds
+        _refresh_mu)."""
         summaries = [p.user_summary() for p in self._partitions]
         merged: Dict[str, Dict[str, float]] = {}
         for summary in summaries:

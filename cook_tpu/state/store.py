@@ -483,6 +483,45 @@ class _GroupCommitStage:
             w.done.set()
 
 
+#: The journal record-kind PROTOCOL REGISTRY — the one static home of
+#: every top-level key a journal record may carry (docs/ROBUSTNESS.md
+#: replay-completeness contract).  The `cs lint` journal-record pass
+#: (cook_tpu/analysis/summaries.py) statically diffs this table against
+#: (a) every key written at a ``journal_file.write(json.dumps(...))``
+#: site and (b) every key handled by ``_apply_journal_record`` /
+#: ``_replay_records`` — so a new record kind cannot ship without a
+#: replay handler (it would silently vanish on leader replay, on
+#: checkpoint re-seed, and on the read-replica tail), and a retired
+#: kind cannot linger here undocumented.  Each value states the
+#: kind's replay + checkpoint semantics.
+JOURNAL_RECORD_KINDS: Dict[str, str] = {
+    "tx": "transaction id high-water mark; applied by "
+          "_apply_journal_record, re-derived from the snapshot after a "
+          "checkpoint compaction",
+    "ep": "election-epoch qualifier; drives the fence-skip rule in "
+          "_replay_records (one home, shared with the read-replica "
+          "tail) — lower-epoch records after a higher-epoch one were "
+          "appended by a deposed leader and never committed",
+    "barrier": "leader-takeover no-op marking the epoch boundary "
+               "(open_exclusive); consumed by _replay_records, never "
+               "applied as state",
+    "w": "entity writes (table/key -> json); replayed by "
+         "_apply_journal_record, absorbed into the snapshot at "
+         "checkpoint",
+    "d": "entity deletes (table/key); replayed by "
+         "_apply_journal_record, absorbed into the snapshot at "
+         "checkpoint",
+    "lr": "latch registrations (latch uuid -> job uuids); replayed by "
+          "_apply_journal_record, snapshot carries the latch table",
+    "lp": "latch pops; replayed by _apply_journal_record",
+    "a": "per-job audit docs (utils/audit.py) riding their txn record "
+         "or a flush_audit advisory batch; replayed into the audit "
+         "trail, RE-SEEDED into the fresh journal at checkpoint "
+         "(the snapshot carries no audit lane), and applied by the "
+         "read-replica tail so follower timeline GETs work",
+}
+
+
 class Store:
     """Thread-safe entity store. All mutation goes through :meth:`transact`."""
 
@@ -936,8 +975,8 @@ class Store:
 
     def _write_audit_record_locked(self, recs: List[Dict[str, Any]]
                                    ) -> bool:
-        """Append one ``{"a": [...]}`` record; caller holds the lock
-        and has fence-checked.  Shares _journal_append's torn-write
+        """Append one ``{"a": [...]}`` record; caller holds the store
+        lock and has fence-checked.  Shares _journal_append's torn-write
         discipline (truncate the fragment, or poison when it can't be
         excised — a torn line would merge with the NEXT committed
         record at replay and lose it) and honors the fsync setting.

@@ -81,16 +81,25 @@ import traceback
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 #: (lock name, operation) pairs that are BY DESIGN blocking while held —
-#: each entry is a documented contract, not an oversight:
+#: each entry is a documented contract, not an oversight.  Consulted by
+#: BOTH rails: the armed runtime detector below AND the static
+#: interprocedural blocking pass (cook_tpu/analysis/summaries.py parses
+#: this literal), so the two agree by construction:
 #:   - ("store", "os.fsync"): the write-ahead journal fsync (and the
 #:     checkpoint snapshot's fsatomic fsync) must complete before the
 #:     transaction installs / the journal truncates — durability IS the
 #:     reason the lock is held (state/store.py _journal_append,
 #:     _write_audit_record_locked, checkpoint).  Group commit moves the
 #:     steady-state fsync off the lock; the inline path remains correct.
+#:   - ("store", "fsatomic.fsync"): the same contract through
+#:     utils/fsatomic.py (checkpoint snapshot write, journal_gen bump
+#:     after a truncation) — at runtime the armed detector sees these
+#:     as their inner os.fsync (already allowed); this entry is the
+#:     static pass's name for the same sites.
 #:   - ("store", "time.sleep"): none expected; not allowlisted.
 ALLOWED_BLOCKING: Set[Tuple[str, str]] = {
     ("store", "os.fsync"),
+    ("store", "fsatomic.fsync"),
 }
 
 _MAX_VIOLATIONS = 256
@@ -343,6 +352,21 @@ class LockMonitor:
         self._armed = False
 
     # --------------------------------------------------------------- report
+    def observed_edges(self) -> List[str]:
+        """The FAMILY-normalized observed edge set
+        (``["store.notify->store", ...]``): each entry says a lock of
+        the first family was held while one of the second was acquired
+        at least once this process.  This is the dynamic half of the
+        static-vs-observed lock-coverage diff (``cs lint
+        --lock-coverage``, ``/debug/health`` → ``locks``; the static
+        half comes from cook_tpu/analysis) — family-normalized because
+        the static analysis cannot tell ``store[p0]`` from
+        ``store[p1]`` in an f-string, and the diff must compare like
+        with like."""
+        with self._mu:
+            fams = {(family(a), family(b)) for (a, b) in self.edges}
+        return sorted(f"{a}->{b}" for a, b in fams)
+
     def snapshot(self) -> Dict[str, Any]:
         """The ``/debug/health`` ``"locks"`` block: observed edge set +
         violation counters (full violation docs stay on the monitor; the
@@ -350,11 +374,13 @@ class LockMonitor:
         with self._mu:
             edges = [{"from": a, "to": b, "count": n}
                      for (a, b), n in sorted(self.edges.items())]
+            fams = {(family(a), family(b)) for (a, b) in self.edges}
             violations = list(self.violations)
             blocking = list(self.blocking_events)
         return {
             "armed": self._armed,
             "edges": edges,
+            "observed_edges": sorted(f"{a}->{b}" for a, b in fams),
             "violations": len(violations),
             "blocking_events": sum(e.get("count", 1) for e in blocking),
             "problems": [v["message"] for v in violations[:5]]

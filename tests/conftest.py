@@ -52,3 +52,19 @@ def lock_sanitizer():
             "lock-order sanitizer violations during the run "
             "(utils/locks.py contract; docs/ANALYSIS.md):\n\n"
             + "\n\n".join(problems))
+        # static-coverage contract (docs/ANALYSIS.md): every ordering
+        # the dynamic sanitizer OBSERVED anywhere in this run must be
+        # in the interprocedural analysis's static edge set — an
+        # observed-only edge is a call-resolution gap that would let a
+        # statically-invisible inversion ship.  (The reverse direction
+        # — static edges tier-1 never drove — is the `cs lint
+        # --lock-coverage` report, not a failure.)
+        from cook_tpu.analysis.summaries import static_edge_families
+        static = set(static_edge_families(wait=True) or [])
+        observed = set(locks.monitor.observed_edges())
+        missing = sorted(observed - static)
+        assert not missing, (
+            "lock orderings observed at runtime but missing from the "
+            "static lock-edge set (cs lint --lock-coverage; a "
+            "resolution gap in cook_tpu/analysis/callgraph.py): "
+            + ", ".join(missing))

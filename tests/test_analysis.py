@@ -634,3 +634,612 @@ class TestLockSanitizer:
                 "problems"} <= set(snap)
         for e in snap["edges"]:
             assert {"from", "to", "count"} <= set(e)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural effect summaries (callgraph.py + summaries.py)
+# ---------------------------------------------------------------------------
+
+def lint_tree(tmp_path: Path, files):
+    """Run the full engine (per-file + whole-program passes) over a
+    multi-file synthetic package."""
+    pkg = tmp_path / "pkg"
+    for name, source in files.items():
+        target = pkg / name
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(source))
+    empty = tmp_path / "empty_baseline.json"
+    empty.write_text('{"suppressions": []}')
+    return run_lint(package_root=pkg, docs_root=None, baseline=empty)
+
+
+class TestTransitiveBlocking:
+    def test_two_deep_chain_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            import os
+
+            class S:
+                def top(self):
+                    with self._lock:
+                        self.mid()
+
+                def mid(self):
+                    self.bottom()
+
+                def bottom(self):
+                    os.fsync(3)
+        """)
+        assert checks(r) == {"lock-transitive-blocking"}
+        f = r.findings[0]
+        assert f.scope == "S.top"
+        # the message renders the full chain down to the blocking op
+        assert "S.mid" in f.message and "S.bottom" in f.message
+        assert f.detail.endswith(":os.fsync")
+
+    def test_depth_zero_stays_with_lexical_pass(self, tmp_path):
+        # a DIRECT blocking call under the lock is the lexical pass's
+        # finding, not duplicated by the interprocedural pass
+        r = lint_snippet(tmp_path, """
+            import os
+
+            class S:
+                def direct(self):
+                    with self._lock:
+                        os.fsync(3)
+        """)
+        assert checks(r) == {"lock-blocking-call"}
+
+    def test_allowlist_covers_transitive_chain(self, tmp_path):
+        # the fixture tree declares its OWN contract: the analysis
+        # parses utils/locks.py ALLOWED_BLOCKING, same as the runtime
+        # monitor consults it
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                ALLOWED_BLOCKING = {("store", "os.fsync")}
+
+                def named_lock(name):
+                    return None
+            """,
+            "m.py": """
+                import os
+                from .utils.locks import named_lock
+
+                class S:
+                    def __init__(self):
+                        self._lock = named_lock("store")
+
+                    def top(self):
+                        with self._lock:
+                            self.tail()
+
+                    def tail(self):
+                        os.fsync(3)
+            """,
+        })
+        assert not any(f.check == "lock-transitive-blocking"
+                       for f in r.findings)
+
+    def test_contract_held_function_not_double_reported(self, tmp_path):
+        # callee runs under the lock BY CONTRACT: the report belongs to
+        # the callee's own body (lexical pass), not to every caller
+        r = lint_snippet(tmp_path, """
+            import os
+
+            class S:
+                def caller(self):
+                    with self._lock:
+                        self._flush_locked()
+
+                def _flush_locked(self):
+                    os.fsync(3)
+        """)
+        assert [f.check for f in r.findings] == ["lock-blocking-call"]
+        assert r.findings[0].scope == "S._flush_locked"
+
+
+class TestRequiresLockVerifier:
+    SRC = """
+        import os
+
+        class S:
+            def _flush(self):
+                '''Write the tail (caller holds self._lock).'''
+                os.fsync(3)
+
+            def good(self):
+                with self._lock:
+                    self._flush()
+
+            def bad(self):
+                self._flush()
+    """
+
+    def test_unverified_call_site_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, self.SRC)
+        unverified = [f for f in r.findings
+                      if f.check == "lock-contract-unverified"]
+        assert [f.scope for f in unverified] == ["S.bad"]
+        assert "S._flush" in unverified[0].detail
+
+    def test_lock_held_call_site_verifies(self, tmp_path):
+        r = lint_snippet(tmp_path, self.SRC)
+        assert not any(f.check == "lock-contract-unverified"
+                       and f.scope == "S.good" for f in r.findings)
+
+    def test_unnamed_contract_warns(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            class S:
+                def append(self):
+                    '''Append one record (caller holds the lock).'''
+                    return 1
+        """)
+        assert checks(r) == {"lock-contract-unnamed"}
+        assert r.findings[0].scope == "S.append"
+
+    def test_named_lock_contract_verifies_by_family(self, tmp_path):
+        # the docstring names the lock family ("the store lock") and a
+        # caller holding the class's named lock satisfies it
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                def named_rlock(name):
+                    return None
+            """,
+            "m.py": """
+                import os
+                from .utils.locks import named_rlock
+
+                class Store:
+                    def __init__(self):
+                        self._lock = named_rlock("store")
+
+                    def _append(self):
+                        '''Append (caller holds the store lock).'''
+                        return 1
+
+                    def transact(self):
+                        with self._lock:
+                            self._append()
+            """,
+        })
+        assert not any(f.check.startswith("lock-contract")
+                       for f in r.findings)
+
+
+class TestStaticLockOrder:
+    def test_interprocedural_rank_inversion_fires(self, tmp_path):
+        # the inversion is invisible lexically: outer() holds "high"
+        # and the "low" acquisition is two calls away, through an
+        # untyped parameter resolved by the unique-method fallback
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                _DECLARED_ORDER = {"low": 10, "high": 20}
+
+                def named_lock(name):
+                    return None
+            """,
+            "m.py": """
+                from .utils.locks import named_lock
+
+                def helper(b):
+                    b.grab()
+
+                class A:
+                    def __init__(self):
+                        self._lock = named_lock("high")
+
+                    def outer(self, b):
+                        with self._lock:
+                            helper(b)
+
+                class B:
+                    def __init__(self):
+                        self._lock = named_lock("low")
+
+                    def grab(self):
+                        with self._lock:
+                            pass
+            """,
+        })
+        inv = [f for f in r.findings if f.check == "lock-order-static"]
+        assert len(inv) == 1
+        assert inv[0].detail == "high->low"
+        assert "helper" in inv[0].message and "B.grab" in inv[0].message
+
+    def test_ascending_ranks_clean(self, tmp_path):
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                _DECLARED_ORDER = {"low": 10, "high": 20}
+
+                def named_lock(name):
+                    return None
+            """,
+            "m.py": """
+                from .utils.locks import named_lock
+
+                class A:
+                    def __init__(self):
+                        self._lock = named_lock("low")
+                        self._hi = named_lock("high")
+
+                    def nest(self):
+                        with self._lock:
+                            with self._hi:
+                                pass
+            """,
+        })
+        assert not any(f.check == "lock-order-static"
+                       for f in r.findings)
+
+    def test_sibling_family_nesting_fires_statically(self, tmp_path):
+        # two literal-named siblings of one rank family nesting through
+        # a call chain: the static twin of the sanitizer's ABBA rule
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                def named_lock(name):
+                    return None
+            """,
+            "m.py": """
+                from .utils.locks import named_lock
+
+                class P:
+                    def __init__(self):
+                        self._lock = named_lock("store[p0]")
+
+                    def cross(self, other):
+                        with self._lock:
+                            other.grab_sibling()
+
+                class Q:
+                    def __init__(self):
+                        self._lock = named_lock("store[p1]")
+
+                    def grab_sibling(self):
+                        with self._lock:
+                            pass
+            """,
+        })
+        sib = [f for f in r.findings if f.check == "lock-sibling-static"]
+        assert len(sib) == 1
+        assert sib[0].detail == "store[p0]->store[p1]"
+
+    def test_same_name_reentrancy_no_edge(self, tmp_path):
+        # the RLock idiom: a store method under the store lock calling
+        # another store method that takes the same lock is NOT an edge
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                def named_rlock(name):
+                    return None
+            """,
+            "m.py": """
+                from .utils.locks import named_rlock
+
+                class Store:
+                    def __init__(self):
+                        self._lock = named_rlock("store")
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+            """,
+        })
+        assert r.lock_edges == []
+        assert not any(f.check == "lock-sibling-static"
+                       for f in r.findings)
+
+
+class TestStaticVsDynamicEdgeDiff:
+    def test_static_superset_on_toy_module(self, tmp_path):
+        """The acceptance shape in miniature: drive the toy module's
+        nesting on a real LockMonitor and assert the static edge set
+        covers every observed (family-normalized) edge."""
+        r = lint_tree(tmp_path, {
+            "utils/locks.py": """
+                _DECLARED_ORDER = {"outer.lk": 10, "inner.lk": 20}
+
+                def named_lock(name):
+                    return None
+            """,
+            "m.py": """
+                from .utils.locks import named_lock
+
+                class A:
+                    def __init__(self):
+                        self._lock = named_lock("outer.lk")
+                        self._in = named_lock("inner.lk")
+
+                    def nest(self):
+                        with self._lock:
+                            with self._in:
+                                pass
+            """,
+        })
+        static = {f"{e['from']}->{e['to']}" for e in r.lock_edges}
+        mon = locks.LockMonitor()
+        outer = locks.NamedLock("outer.lk", order=10, monitor=mon)
+        inner = locks.NamedLock("inner.lk", order=20, monitor=mon)
+        with outer:
+            with inner:
+                pass
+        observed = set(mon.observed_edges())
+        assert observed  # the dynamic side saw the nesting
+        assert observed <= static
+        assert mon.violations == []
+
+    def test_observed_edges_family_normalized(self):
+        mon = locks.LockMonitor()
+        p0 = locks.NamedLock("store[p0]", order=20, monitor=mon)
+        au = locks.NamedLock("audit", order=40, monitor=mon)
+        with p0:
+            with au:
+                pass
+        assert mon.observed_edges() == ["store->audit"]
+        snap = mon.snapshot()
+        assert snap["observed_edges"] == ["store->audit"]
+        # the raw edge list keeps the full sibling-suffixed names
+        assert snap["edges"][0]["from"] == "store[p0]"
+
+
+def test_static_edges_superset_of_observed_this_process():
+    """The tier-1 acceptance contract (also asserted at conftest
+    teardown over the FULL run): every lock ordering the dynamic
+    sanitizer has observed on the global monitor so far must be in the
+    interprocedural analysis's static edge set — an observed-only edge
+    is a call-resolution gap."""
+    from cook_tpu.analysis.summaries import static_edge_families
+    from cook_tpu.state import Store
+    from cook_tpu.state.schema import Job, Resources
+
+    # guarantee at least the canonical nestings are on the monitor
+    s = Store()
+    s.ensure_index()
+    s.create_jobs([Job(uuid="sup1", user="u", pool="p",
+                       resources=Resources(cpus=1, mem=1))])
+    static = set(static_edge_families(wait=True) or [])
+    assert static, "static edge computation returned nothing"
+    assert "store.notify->store" in static
+    observed = set(locks.monitor.observed_edges())
+    assert observed
+    missing = sorted(observed - static)
+    assert not missing, (
+        "observed lock edges missing from the static set "
+        f"(resolution gap): {missing}")
+
+
+class TestJournalRecordCompleteness:
+    STORE = """
+        import json
+
+        JOURNAL_RECORD_KINDS = {"w": "writes", "gone": "retired"}
+
+        class Store:
+            def _journal_append(self, txn):
+                rec = {"w": txn.writes}
+                rec["z"] = txn.extra
+                line = json.dumps(rec) + "\\n"
+                f = self._journal_file
+                f.write(line)
+
+            def _apply_journal_record(self, rec):
+                return rec.get("w")
+    """
+
+    def test_missing_replay_handler_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, self.STORE, name="state/store.py")
+        got = {(f.check, f.detail) for f in r.findings}
+        assert ("journal-record-unhandled", "z") in got
+
+    def test_undeclared_and_stale_registry_entries_fire(self, tmp_path):
+        r = lint_snippet(tmp_path, self.STORE, name="state/store.py")
+        got = {(f.check, f.detail) for f in r.findings}
+        assert ("journal-record-undeclared", "z") in got
+        assert ("journal-record-stale", "gone") in got
+        # "w" is written + handled + declared: clean
+        assert not any(d == "w" for _c, d in got)
+
+    def test_replica_tail_must_route_through_replay(self, tmp_path):
+        r = lint_tree(tmp_path, {
+            "state/store.py": self.STORE,
+            "state/read_replica.py": """
+                class View:
+                    def poll(self):
+                        return 0  # applies records some other way
+            """,
+        })
+        assert any(f.check == "journal-record-tail"
+                   for f in r.findings)
+
+    def test_real_repo_registry_is_complete(self):
+        """Every kind written by the real store has a handler and a
+        registry entry, and the registry carries no stale kinds — the
+        self-lint golden enforces this, but assert it directly so a
+        regression names the pass."""
+        r = run_lint(package_root=REPO / "cook_tpu",
+                     docs_root=REPO / "docs")
+        assert not any(f.check.startswith("journal-record")
+                       for f in r.findings + r.suppressed)
+        from cook_tpu.analysis.registry import journal_record_kinds
+        assert journal_record_kinds() == {
+            "tx", "ep", "barrier", "w", "d", "lr", "lp", "a"}
+
+
+class TestChangedMode:
+    def test_changed_filter_restricts_findings(self, tmp_path):
+        files = {
+            "a.py": """
+                import os
+
+                class A:
+                    def bad(self):
+                        with self._lock:
+                            os.fsync(3)
+            """,
+            "b.py": """
+                import time
+
+                class B:
+                    def bad(self):
+                        with self._mu:
+                            time.sleep(1)
+            """,
+        }
+        pkg = tmp_path / "pkg"
+        for name, source in files.items():
+            (pkg / name).parent.mkdir(parents=True, exist_ok=True)
+            (pkg / name).write_text(textwrap.dedent(source))
+        empty = tmp_path / "empty_baseline.json"
+        empty.write_text('{"suppressions": []}')
+        full = run_lint(package_root=pkg, docs_root=None, baseline=empty)
+        assert {f.path for f in full.findings} == {"a.py", "b.py"}
+        only_a = run_lint(package_root=pkg, docs_root=None,
+                          baseline=empty, changed={"a.py"})
+        assert {f.path for f in only_a.findings} == {"a.py"}
+        assert only_a.changed_only and not only_a.ok
+        clean = run_lint(package_root=pkg, docs_root=None,
+                         baseline=empty, changed={"c.py"})
+        assert clean.ok  # dirt elsewhere is the full pass's business
+
+    def test_changed_mode_skips_stale_baseline(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text("x = 1\n")
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"suppressions": [
+            {"fingerprint": "lock-blocking-call:gone.py:X.y:os.fsync",
+             "justification": "stale"}]}))
+        full = run_lint(package_root=pkg, docs_root=None, baseline=base)
+        assert not full.ok and full.stale_baseline
+        changed = run_lint(package_root=pkg, docs_root=None,
+                           baseline=base, changed={"m.py"})
+        assert changed.ok and not changed.stale_baseline
+
+    def test_deterministic_finding_order(self, tmp_path):
+        src = """
+            import os, time
+
+            class S:
+                def a(self):
+                    with self._lock:
+                        os.fsync(3)
+                        time.sleep(1)
+
+                def b(self):
+                    with self._mu:
+                        self.c()
+
+                def c(self):
+                    os.fsync(4)
+        """
+        r1 = lint_snippet(tmp_path, src)
+        r2 = lint_snippet(tmp_path, src)
+        assert len(r1.findings) >= 3
+        assert [f.fingerprint for f in r1.findings] == \
+            [f.fingerprint for f in r2.findings]
+        keys = [(f.path, f.line, f.check, f.detail)
+                for f in r1.findings]
+        assert keys == sorted(keys)
+
+
+class TestJsonSchemaAndCoverage:
+    def test_json_doc_schema_and_summary_counts(self):
+        r = run_lint(package_root=REPO / "cook_tpu",
+                     docs_root=REPO / "docs")
+        doc = r.to_doc()
+        assert doc["schema"] == 2
+        assert doc["ok"] is True
+        assert doc["summary"]["findings"] == len(doc["findings"]) == 0
+        assert doc["summary"]["suppressed"] == len(doc["suppressed"])
+        assert doc["summary"]["changed_only"] is False
+        cg = doc["callgraph"]
+        assert cg["functions"] > 1000
+        assert 0.5 < cg["resolution_coverage"] <= 1.0
+        assert cg["calls_unresolved"] > 0  # the bucket is honest
+        assert any(e["from"] == "store.notify" and e["to"] == "store"
+                   for e in doc["lock_edges"])
+        # resolved edges are rank-ascending on this tree (violations
+        # would have been findings)
+        from cook_tpu.utils.locks import _DECLARED_ORDER
+        for e in doc["lock_edges"]:
+            if e["kind"] != "resolved":
+                continue
+            rs = _DECLARED_ORDER.get(e["from"])
+            rd = _DECLARED_ORDER.get(e["to"])
+            if rs is not None and rd is not None:
+                assert rd > rs, e
+
+    def test_lock_coverage_cli(self, tmp_path, capsys):
+        from cook_tpu.lint import main as lint_main
+        rc = lint_main(["--root", str(REPO / "cook_tpu"),
+                        "--docs", str(REPO / "docs"),
+                        "--lock-coverage"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "lock-order coverage" in out
+        assert "store.notify->store" in out
+        # an --observed file (the /debug/health shape) drives the diff
+        obs = tmp_path / "health.json"
+        obs.write_text(json.dumps(
+            {"locks": {"observed_edges": ["store.notify->store"]}}))
+        rc = lint_main(["--root", str(REPO / "cook_tpu"),
+                        "--docs", str(REPO / "docs"),
+                        "--lock-coverage", "--observed", str(obs)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[ok]         store.notify->store" in out
+
+
+def test_contract_functions_discovered_on_real_tree():
+    """Non-vacuity for the verifier: the known contract functions in
+    state/, utils/audit.py, and sched/ are discovered with the RIGHT
+    lock, so 'repo lints clean' means 'every one of them is
+    call-site-verified or baselined', not 'none were found'."""
+    import ast as _ast
+    from cook_tpu.analysis.callgraph import build_callgraph
+    root = REPO / "cook_tpu"
+    trees = {}
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        trees[p.relative_to(root).as_posix()] = _ast.parse(
+            p.read_text(encoding="utf-8"))
+    cg = build_callgraph(root, trees)
+    req = {f.fid: f.requires_lock.name
+           for f in cg.functions.values() if f.requires_lock}
+    assert req["state.store.Store._journal_append"] == "store"
+    assert req["state.store.Store._write_audit_record_locked"] == "store"
+    assert req["utils.audit.AuditTrail._record_one"] == "audit"
+    assert req["state.index.ColumnarIndex._rank_rows_locked"] == "index"
+    assert req["state.partition.UserSummaryExchange._sweep_locked"] \
+        == "partition.summaries.refresh"
+    # ranker's deferred-fetch helper runs under a PLAIN mutex: pseudo
+    # identity, still verified by attribute tail at every call site
+    assert req["sched.ranker.RankedQueue._resolve_rows"].endswith(
+        "._mat_lock")
+    # no contract function anywhere lost its lock to a parse gap
+    unnamed = [f.fid for f in cg.functions.values()
+               if f.contract_unnamed]
+    assert unnamed == [], unnamed
+
+
+def test_whole_program_analysis_time_budget():
+    """The acceptance bound: call graph + fixpoint + every
+    interprocedural pass completes in well under 10 s on this tree."""
+    import ast as _ast
+    import time as _time
+    from cook_tpu.analysis.summaries import run_interprocedural
+    root = REPO / "cook_tpu"
+    trees = {}
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        trees[p.relative_to(root).as_posix()] = _ast.parse(
+            p.read_text(encoding="utf-8"))
+    t0 = _time.time()
+    res = run_interprocedural(root, trees)
+    elapsed = _time.time() - t0
+    assert elapsed < 10.0, f"fixpoint took {elapsed:.1f}s"
+    assert res.stats["functions"] > 1000
+    assert res.stats["fixpoint_iterations"] > 0
