@@ -2459,6 +2459,232 @@ def _bench_partitioned_write(partition_counts=(1, 2, 4), n_writers=4,
     return out
 
 
+def bench_fleet_obs(submit_total=14_000, batch=20, n_writers=4,
+                    n_members=2, scrape_reps=40, overhead_pairs=7,
+                    scrape_interval_s=1.0, span_total=30_000,
+                    cycle_jobs=5000, cycle_pairs=8):
+    """The fleet observability plane's OWN cost (ISSUE 16): the
+    federation scrape must be invisible to the serving plane it
+    observes.
+
+    Legs:
+    - ``scrape_sweep``: one leader FleetScraper over ``n_members`` real
+      member HTTP servers on localhost — the wall cost of one
+      scrape-everyone sweep (fetch + parse + relabel + publish), the
+      merged /metrics/fleet render, and one compute_saturation pass;
+    - ``federation_overhead``: ABBA-paired sustained batch-submit legs
+      (the same request as rest_plane's submit leg, same server) with a
+      background thread running the scrape sweep every
+      ``scrape_interval_s`` ON vs OFF — median paired submit-p50 delta,
+      budget <=2% of the sustained submit p50.  The 1 s cadence is 10x
+      HOTTER than the production default
+      (fleet.scrape_interval_seconds = 10), so this is a conservative
+      upper bound; legs are sized to span several scrapes each so the
+      duty cycle is actually sampled;
+    - ``span_ring_retention``: per-span cost of the bounded finished
+      ring the trace collector serves from — ns/span with retention on
+      vs tracer disabled, the ring's steady-state memory at cap, and
+      the same retention toggle ABBA-paired on the REAL
+      ``Scheduler.step_cycle`` path (the hot loop the ring rides).
+    """
+    import tempfile
+    import threading
+
+    from cook_tpu.client import JobClient
+    from cook_tpu.cluster import FakeCluster, FakeHost
+    from cook_tpu.config import Config
+    from cook_tpu.rest import ApiServer, CookApi
+    from cook_tpu.sched import Scheduler
+    from cook_tpu.sched.fleet import FleetScraper, compute_saturation
+    from cook_tpu.state import Resources, Store
+    from cook_tpu.utils.tracing import tracer
+
+    tmp = tempfile.mkdtemp(prefix="cook_fleet_obs")
+    store = Store.open(tmp)
+    cfg = Config()
+    cfg.pipeline.depth = 0  # comparability pin (same as rest_plane)
+    hosts = [FakeHost(f"h{i}", Resources(cpus=64.0, mem=65536.0))
+             for i in range(100)]
+    cluster = FakeCluster("fake-1", hosts)
+    sched = Scheduler(store, cfg, [cluster], status_queue_shards=2)
+    api = CookApi(store, scheduler=sched, config=cfg)
+    api.instance = "leader-1"
+    server = ApiServer(api)
+    server.start()
+    member_srvs = []
+    for i in range(n_members):
+        m_api = CookApi(Store(), config=cfg)
+        m_api.instance = f"member-{i}"
+        m_srv = ApiServer(m_api)
+        m_srv.start()
+        member_srvs.append(m_srv)
+    members = {"leader-1": {"url": server.url, "role": "leader",
+                            "self": True}}
+    members.update({f"member-{i}": {"url": s.url, "role": "follower"}
+                    for i, s in enumerate(member_srvs)})
+    scraper = FleetScraper(cfg.fleet, lambda: dict(members))
+    api.fleet = scraper
+    out = {"members": n_members + 1}
+
+    # ---- scrape_sweep leg ------------------------------------------------
+    scrape_ms, render_ms, sat_ms = [], [], []
+    for _ in range(scrape_reps):
+        t0 = time.perf_counter()
+        scraper.scrape()
+        scrape_ms.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        body = scraper.merged_exposition()
+        render_ms.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        compute_saturation(cfg, store=store)
+        sat_ms.append((time.perf_counter() - t0) * 1000.0)
+    out["scrape_sweep"] = {
+        "scrape_p50_ms": round(pctl(scrape_ms, 50), 2),
+        "scrape_p99_ms": round(pctl(scrape_ms, 99), 2),
+        "merged_render_p50_ms": round(pctl(render_ms, 50), 3),
+        "saturation_p50_ms": round(pctl(sat_ms, 50), 3),
+        "merged_bytes": len(body)}
+
+    # ---- federation_overhead leg (ABBA pairs, like obs_overhead) ---------
+    per_leg = max(submit_total // (overhead_pairs * 2), 20)
+
+    def submit_leg(lats):
+        client = JobClient(server.url, user="fleetbench")
+        for _ in range(per_leg):
+            t0 = time.perf_counter()
+            client.submit([{"command": "true", "cpus": 1.0, "mem": 64.0}
+                           for _ in range(batch)])
+            lats.append((time.perf_counter() - t0) * 1000.0)
+
+    def scrape_loop(stop):
+        while not stop.is_set():
+            scraper.scrape()
+            compute_saturation(cfg, store=store)
+            stop.wait(scrape_interval_s)
+
+    submit_leg([])  # warm-up: connection setup, index build, code paths
+    on_p50, off_p50, sustained = [], [], []
+    for pair in range(overhead_pairs):
+        order = [True, False] if pair % 2 == 0 else [False, True]
+        for scraping in order:
+            stop = threading.Event()
+            t = None
+            if scraping:
+                t = threading.Thread(target=scrape_loop, args=(stop,))
+                t.start()
+            lats = []
+            submit_leg(lats)
+            stop.set()
+            if t is not None:
+                t.join()
+            sustained.extend(lats)
+            (on_p50 if scraping else off_p50).append(pctl(lats, 50))
+    deltas = sorted(a - b for a, b in zip(on_p50, off_p50))
+    delta = deltas[len(deltas) // 2] if deltas else 0.0
+    sustained_p50 = pctl(sustained, 50)
+    out["federation_overhead"] = {
+        "submit_p50_ms_scrape_on": round(pctl(on_p50, 50), 3),
+        "submit_p50_ms_scrape_off": round(pctl(off_p50, 50), 3),
+        "paired_delta_ms": round(delta, 3),
+        "scrape_interval_s": scrape_interval_s,
+        "sustained_submit_p50_ms": round(sustained_p50, 3),
+        "overhead_pct": round(delta / sustained_p50 * 100.0, 2)
+        if sustained_p50 else 0.0,
+        # the structural ceiling, independent of paired-leg noise: the
+        # fraction of one core the sweep can possibly consume at this
+        # cadence (scrape wall time over the scrape interval) — on a
+        # 1-core container the submit path cannot lose more than this
+        "duty_cycle_pct": round(
+            pctl(scrape_ms, 50) / (scrape_interval_s * 1000.0) * 100.0,
+            2),
+        "budget_pct": 2.0}
+
+    # ---- span_ring_retention leg -----------------------------------------
+    def span_leg(enabled):
+        tracer.enabled = enabled
+        t0 = time.perf_counter()
+        for k in range(span_total):
+            with tracer.span("bench.retention", k=k):
+                pass
+        return (time.perf_counter() - t0) * 1e9 / span_total
+
+    from cook_tpu.utils import tracing as _tracing
+    span_leg(True)  # warm-up
+    ns_on = [span_leg(True) for _ in range(3)]
+    ns_off = [span_leg(False) for _ in range(3)]
+    tracer.enabled = True
+    ring = list(tracer.finished)[:2000]
+    n_sampled = len(ring) or 1
+    ring_bytes = sum(sys.getsizeof(json.dumps(d)) for d in ring)
+    out["span_ring_retention"] = {
+        "span_ns_retained": round(pctl(ns_on, 50), 1),
+        "span_ns_disabled": round(pctl(ns_off, 50), 1),
+        "retention_ns_per_span": round(pctl(ns_on, 50)
+                                       - pctl(ns_off, 50), 1),
+        "ring_cap_spans": _tracing._MAX_FINISHED,
+        "ring_bytes_at_cap_est": (ring_bytes // n_sampled)
+        * _tracing._MAX_FINISHED}
+
+    # ---- step_cycle retention A/B (the hot path the ring rides) ----------
+    # a DEDICATED store/scheduler: the federation legs above left ~15k
+    # journaled jobs behind, which would both slow the cycle and drift
+    # its population across the AB pairs
+    rng = np.random.default_rng(16)
+    cyc_store = Store()
+    cyc_hosts = [FakeHost(f"c{i}", Resources(cpus=64.0, mem=65536.0))
+                 for i in range(100)]
+    cyc_cluster = FakeCluster("fake-cyc", cyc_hosts)
+    cyc_sched = Scheduler(cyc_store, cfg, [cyc_cluster],
+                          status_queue_shards=2)
+    cyc_store.create_jobs(_driver_jobs(rng, cycle_jobs, 50))
+    cyc_store.ensure_index()
+
+    def settle_cycle():
+        t0 = time.perf_counter()
+        results = cyc_sched.step_cycle()
+        dt = (time.perf_counter() - t0) * 1000.0
+        n = sum(len(r.launched_task_ids) for r in results.values())
+        cyc_sched.flush_status_updates()
+        cyc_cluster.advance_to(cyc_store.clock() + 10**9)
+        cyc_sched.flush_status_updates()
+        if n:
+            cyc_store.create_jobs(_driver_jobs(rng, n, 50))
+        return dt
+
+    for _ in range(3):  # warm-up compile + settle one-off costs
+        settle_cycle()
+    on_cyc, off_cyc = [], []
+    for pair in range(cycle_pairs):
+        order = [True, False] if pair % 2 == 0 else [False, True]
+        for enabled in order:
+            tracer.enabled = enabled
+            (on_cyc if enabled else off_cyc).append(settle_cycle())
+    tracer.enabled = True
+    cyc_deltas = sorted(a - b for a, b in zip(on_cyc, off_cyc))
+    cyc_delta = cyc_deltas[len(cyc_deltas) // 2] if cyc_deltas else 0.0
+    cyc_p50_off = pctl(off_cyc, 50)
+    out["span_ring_retention"]["step_cycle_p50_ms_retention_on"] = \
+        round(pctl(on_cyc, 50), 2)
+    out["span_ring_retention"]["step_cycle_p50_ms_retention_off"] = \
+        round(cyc_p50_off, 2)
+    out["span_ring_retention"]["step_cycle_paired_delta_ms"] = \
+        round(cyc_delta, 3)
+    out["span_ring_retention"]["step_cycle_overhead_pct"] = \
+        round(cyc_delta / cyc_p50_off * 100.0, 2) if cyc_p50_off else 0.0
+
+    for s in member_srvs:
+        s.stop()
+    server.stop()
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"fleet_obs scrape_p50={out['scrape_sweep']['scrape_p50_ms']}ms "
+          f"overhead={out['federation_overhead']['overhead_pct']}% "
+          f"(budget 2%) span_retention="
+          f"{out['span_ring_retention']['retention_ns_per_span']}ns",
+          file=sys.stderr)
+    return out
+
+
 # ---------------------------------------------------------------- sections
 # Each section runs in its OWN subprocess with a timeout (round 2 lost its
 # number to a backend-init hang; round 3 then saw a device read wedge
@@ -2552,6 +2778,10 @@ def run_section(name: str) -> None:
                                 cycle_jobs=scaled(10_000, lo=500))
     elif name == "placement_quality":
         data = bench_placement_quality()
+    elif name == "fleet_obs":
+        data = bench_fleet_obs(submit_total=scaled(14_000, lo=2800),
+                               span_total=scaled(30_000, lo=2000),
+                               cycle_jobs=scaled(5000, lo=500))
     elif name == "pipeline":
         data = bench_pipeline(T=scaled(100_000), n_users=scaled(200, lo=8),
                               H=scaled(5000))
@@ -2691,6 +2921,8 @@ def build_payload(results, platforms, errors, tpu_error, t_start,
         detail["pipeline_10cycle"] = results["pipeline"]
     if results.get("placement_quality") is not None:
         detail["placement_quality"] = results["placement_quality"]
+    if results.get("fleet_obs") is not None:
+        detail["fleet_obs"] = results["fleet_obs"]
     if results.get("pallas_scale") is not None:
         detail["pallas_structured_topk_100k_x_50k"] = results["pallas_scale"]
     if results.get("rebalance"):
@@ -2785,7 +3017,7 @@ def main():
                 "gang_cycle", "elastic_cycle", "rest_plane", "fused_cycle",
                 "store_cycle", "store_scale", "match_large", "rebalance",
                 "end2end", "pallas_scale", "pipeline",
-                "placement_quality"]
+                "placement_quality", "fleet_obs"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

@@ -264,6 +264,15 @@ def main(argv=None) -> int:
                                ``.cook-gang-resize.jsonl`` for gang
                                members; re-advertised to the task as an
                                absolute sandbox path)
+      COOK_TRACEPARENT         W3C trace context propagated from the
+                               launch path (sched/matcher.py): the
+                               wrapper opens an ``agent.exec`` span
+                               under it — retained in this process's
+                               local span ring and appended to the
+                               sandbox's ``trace_spans.jsonl`` so the
+                               fleet trace collector can stitch the
+                               exec leg onto the job's client-minted
+                               timeline (docs/OBSERVABILITY.md)
     The command is argv (joined), exit code is the task's exit code.
     SIGUSR1 relays an elastic shrink advisory (checkpoint window open):
     the event is appended to the resize file and the signal forwarded to
@@ -316,10 +325,42 @@ def main(argv=None) -> int:
                           "signal": "SIGUSR1"})
 
     signal.signal(signal.SIGUSR1, forward_resize)
-    ex.start()
-    code = None
-    while code is None:
-        code = ex.wait(timeout_s=1.0)
+
+    # Adopt a propagated trace context (W3C traceparent stamped into the
+    # task env by the launch path): the exec leg joins the job's
+    # client-minted trace under this process's own identity, so the
+    # fleet-wide stitched export (GET /debug/trace) shows the agent-side
+    # execution next to the leader's txn and the submission request.
+    from ..utils import tracing
+    remote = tracing.parse_traceparent(os.environ.get("COOK_TRACEPARENT"))
+    if remote is not None:
+        tracing.set_process_identity(
+            "agent-" + (os.environ.get("COOK_HOSTNAME")
+                        or os.uname().nodename))
+
+    def run() -> int:
+        ex.start()
+        code = None
+        while code is None:
+            code = ex.wait(timeout_s=1.0)
+        return code
+
+    if remote is None:
+        return run()
+    with tracing.tracer.span("agent.exec", remote_parent=remote,
+                             task=task_id or None,
+                             gang=os.environ.get("COOK_GANG_UUID") or None
+                             ) as sp:
+        code = run()
+        sp.set_tag("exit_code", code)
+    # spans for this trace land in the sandbox as one JSON line each —
+    # retrievable after the wrapper exits (the ring dies with it)
+    try:
+        with open(Path(sandbox) / "trace_spans.jsonl", "a") as f:
+            for doc in tracing.tracer.traces(remote[0]):
+                f.write(json.dumps(doc) + "\n")
+    except OSError:
+        pass  # trace retention is best-effort
     return code
 
 

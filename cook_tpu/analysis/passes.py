@@ -411,7 +411,8 @@ def registry_completeness(package_root: Path,
     doc_for = {"metric": "docs/OBSERVABILITY.md",
                "span": "docs/OBSERVABILITY.md",
                "cycle-field": "docs/OBSERVABILITY.md",
-               "fault-point": "docs/ROBUSTNESS.md"}
+               "fault-point": "docs/ROBUSTNESS.md",
+               "endpoint": "docs/OBSERVABILITY.md"}
     findings: List[Finding] = []
     for surface, missing in _registry.diff_registries(
             package_root, docs_root).items():

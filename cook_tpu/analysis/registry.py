@@ -18,6 +18,8 @@ span names             ``tracing.span("...")`` /               docs/OBSERVABILIT
 fault points           ``injector/_faults.fire("...")`` /      docs/ROBUSTNESS.md
                        ``should_fire("...")`` / ``arm("...")``
 CycleRecord fields     ``flight.CycleRecord.to_doc()`` keys    docs/OBSERVABILITY.md
+debug endpoints        ``API_ROUTES`` entries under            docs/OBSERVABILITY.md
+                       ``/debug`` / ``/metrics``               (endpoint table)
 =====================  =====================================  =================
 """
 
@@ -35,6 +37,18 @@ _SPAN_RE = re.compile(
 _FAULT_RE = re.compile(
     r'(?:\.fire|\.should_fire|injector\.arm)\(\s*\n?\s*'
     r'["\']([a-z0-9._]+)["\']')
+# observability-plane route registrations (rest/api.py API_ROUTES): the
+# operator-facing /debug/* and /metrics* surface must appear in the
+# OBSERVABILITY.md endpoint table — a panel nobody can discover is a
+# panel nobody uses
+_ROUTE_RE = re.compile(
+    r'\(\s*"(?:GET|POST|DELETE|PUT|PATCH)",\s*"(/(?:debug|metrics)[^"]*)"')
+# a backticked endpoint row in the doc: optional method word, the path,
+# optional ?query= suffix; <uuid>-style placeholders normalize to the
+# route table's {uuid} form
+_DOC_ROUTE_RE = re.compile(
+    r'`(?:(?:GET|POST|DELETE|PUT|PATCH)\s+)?'
+    r'(/(?:debug|metrics)[^`?\s]*)(?:\?[^`]*)?`')
 
 
 def _py_files(root: Path) -> Iterable[Path]:
@@ -87,6 +101,19 @@ def harvest_fault_points(root: Path) -> Set[str]:
     return {n for n in _harvest(root, _FAULT_RE) if "." in n}
 
 
+def harvest_endpoints(root: Path) -> Set[str]:
+    """Every ``/debug*`` / ``/metrics*`` route path registered in an
+    ``API_ROUTES``-style table under ``root``."""
+    return _harvest(root, _ROUTE_RE)
+
+
+def documented_endpoints(doc_text: str) -> Set[str]:
+    """The endpoint paths the doc's tables register (backticked, method
+    word and ``?query=`` suffix tolerated, ``<x>`` == ``{x}``)."""
+    return {re.sub(r"<([^<>]+)>", r"{\1}", m.group(1))
+            for m in _DOC_ROUTE_RE.finditer(doc_text)}
+
+
 def cycle_record_fields() -> Set[str]:
     """The exported ``/debug/cycles`` schema — ``to_doc()`` keys of a
     fresh CycleRecord (some slots are renamed on export)."""
@@ -124,7 +151,9 @@ def diff_registries(package_root: Path, docs_root: Path
     obs_text = obs.read_text(encoding="utf-8") if obs.exists() else ""
     rob_text = rob.read_text(encoding="utf-8") if rob.exists() else ""
     harvested = _harvest_all(package_root, {
-        "metric": _METRIC_RE, "span": _SPAN_RE, "fault": _FAULT_RE})
+        "metric": _METRIC_RE, "span": _SPAN_RE, "fault": _FAULT_RE,
+        "endpoint": _ROUTE_RE})
+    doc_endpoints = documented_endpoints(obs_text)
     out: Dict[str, Set[str]] = {
         "metric": {n for n in harvested["metric"]
                    if not documented(obs_text, n, metric=True)},
@@ -132,6 +161,8 @@ def diff_registries(package_root: Path, docs_root: Path
                  if not documented(obs_text, n)},
         "fault-point": {n for n in harvested["fault"] if "." in n
                         if not documented(rob_text, n)},
+        "endpoint": {n for n in harvested["endpoint"]
+                     if n not in doc_endpoints},
         # the CycleRecord schema comes from the IMPORTED flight module,
         # so this surface only applies when scanning the real package
         # (fixture trees have no /debug/cycles schema to drift)

@@ -651,7 +651,11 @@ def cmd_debug(args) -> int:
     loop's decision panel — last per-pool decisions (grow budget,
     shrink pressure, preemption budget, autoscale target), cycle
     counts/errors, and the elastic resize plane's live state
-    (docs/GANG.md elasticity)."""
+    (docs/GANG.md elasticity); ``cs debug fleet`` dumps the federated
+    fleet panel — every known member's health, role, last-scrape age,
+    staleness, SLO burn, and saturation hot-spots, with unreachable
+    members surfaced as rows (up=false), not gaps
+    (docs/OBSERVABILITY.md debugging the fleet)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
@@ -670,6 +674,9 @@ def cmd_debug(args) -> int:
         return 0
     if args.debug_cmd == "optimizer":
         out(client.debug_optimizer())
+        return 0
+    if args.debug_cmd == "fleet":
+        out(client.debug_fleet())
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -1037,7 +1044,7 @@ def build_parser() -> argparse.ArgumentParser:
                                       "failover panel")
     sp.add_argument("debug_cmd",
                     choices=["cycles", "trace", "faults", "replication",
-                             "health", "requests", "optimizer"])
+                             "health", "requests", "optimizer", "fleet"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

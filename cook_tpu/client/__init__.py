@@ -278,7 +278,17 @@ class JobClient:
                 transient[0] -= 1
                 time.sleep(transient[1].next_delay())
                 continue
-            self.last_request_id = resp.getheader("X-Cook-Request-Id")
+            echoed_id = resp.getheader("X-Cook-Request-Id")
+            forwarded_id = headers.get("X-Cook-Request-Id")
+            if forwarded_id and echoed_id and echoed_id != forwarded_id:
+                # the hop adopted a DIFFERENT id than the one this chain
+                # carries: the redirect's log/ring entries and the
+                # leader's can no longer be joined — fail loudly rather
+                # than hand back an id that names only half the request
+                raise JobClientError(
+                    502, "request-id echo mismatch across redirect: "
+                         f"forwarded {forwarded_id}, got {echoed_id}")
+            self.last_request_id = echoed_id
             co = resp.getheader("X-Cook-Commit-Offset")
             if co is not None:
                 # the token is OPAQUE and the LATEST write wins, not a
@@ -302,6 +312,13 @@ class JobClient:
                 self.last_replication_age_ms = None
             if resp.status == 307 and resp.getheader("Location"):
                 url = resp.getheader("Location")
+                if echoed_id:
+                    # forward the id the redirecting node (a follower)
+                    # minted, so the leader ADOPTS it instead of minting
+                    # a second one — the two log/ring entries for this
+                    # one logical request join on a single id
+                    # (docs/OBSERVABILITY.md "Tracing one request")
+                    headers["X-Cook-Request-Id"] = echoed_id
                 continue
             if resp.status >= 400:
                 try:
@@ -315,7 +332,7 @@ class JobClient:
             break
         else:
             raise JobClientError(508, "redirect loop")
-        if path == "/metrics":
+        if path in ("/metrics", "/metrics/fleet"):
             return raw.decode()
         return json.loads(raw) if raw else None
 
@@ -588,3 +605,21 @@ class JobClient:
         last per-pool decisions, cycle counts/errors, and the elastic
         resize plane's live state (docs/GANG.md elasticity)."""
         return self._request("GET", "/debug/optimizer")
+
+    def debug_fleet(self) -> Dict:
+        """GET /debug/fleet — the federated fleet panel behind ``cs
+        debug fleet``: per-member health, staleness, burn, saturation
+        hot-spots, and last-scrape age (docs/OBSERVABILITY.md)."""
+        return self._request("GET", "/debug/fleet")
+
+    def debug_trace_spans(self, trace_id: str) -> Dict:
+        """GET /debug/trace/spans — ONE member's raw span-ring docs for
+        a trace id; the fleet trace collector's per-member stitch
+        source (normally you want ``debug_trace`` instead)."""
+        return self._request("GET", "/debug/trace/spans",
+                             params={"trace_id": trace_id})
+
+    def metrics_fleet(self) -> str:
+        """GET /metrics/fleet — merged fleet exposition: every member's
+        /metrics re-labeled with instance/role."""
+        return self._request("GET", "/metrics/fleet")

@@ -306,6 +306,98 @@ class ServingConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet observability plane (sched/fleet.py; the daemon's
+    ``"fleet"`` conf section, boot-validated like the sections around
+    it): metrics federation over the election candidate registry,
+    cross-process trace stitching, and the saturation-signal layer —
+    docs/OBSERVABILITY.md "Debugging the fleet", docs/DEPLOY.md
+    scrape topology."""
+
+    #: run the FleetScraper at all (the monitor sweep drives it); off =
+    #: /metrics/fleet serves only this process and /debug/fleet reports
+    #: federation disabled
+    enabled: bool = True
+    #: minimum seconds between federation sweeps — the monitor sweep
+    #: fires more often than this; the scraper self-gates
+    scrape_interval_seconds: float = 10.0
+    #: per-member /metrics fetch timeout; an unreachable member costs at
+    #: most this per sweep and surfaces as ``up=0`` data, never a gap
+    scrape_timeout_seconds: float = 2.0
+    #: per-member /debug/trace/spans fetch timeout for the stitched
+    #: fleet trace export
+    trace_fanout_timeout_seconds: float = 2.0
+    #: federated series kept per member per sweep; the excess is folded
+    #: into ``cook_fleet_dropped_series{instance=}`` (the PR 7
+    #: cardinality discipline applied at fleet scale)
+    max_series_per_member: int = 4096
+    #: hard cap on members per sweep (registry entries past it are
+    #: skipped and counted) — a corrupt candidate registry must not turn
+    #: one sweep into an unbounded fan-out
+    max_members: int = 64
+    #: static extra members ``[{"instance":, "url":, "role":}]`` merged
+    #: over the candidate registry — agents or off-registry processes
+    #: that expose /metrics but never campaign
+    members: List[Dict] = field(default_factory=list)
+    #: saturation gauges at/above this are "hot" on /debug/health +
+    #: /debug/fleet — the red line the adaptive-admission consumer
+    #: (ROADMAP item 3) will shed against
+    saturation_red_line: float = 0.8
+    #: follower-staleness normalization: saturation 1.0 == the read
+    #: view's apply age reaching this (also flips a follower's
+    #: /debug/health to unhealthy)
+    staleness_red_line_seconds: float = 5.0
+    #: audit-queue normalization: saturation 1.0 == this many durable
+    #: audit events still buffered for the journal
+    audit_queue_red_line: int = 4096
+    #: journal-head normalization: saturation 1.0 == the live journal
+    #: growing to this many bytes since the last checkpoint compaction
+    journal_head_red_line_bytes: int = 256 * 1024 * 1024
+
+    def __post_init__(self):
+        for k in ("scrape_interval_seconds", "scrape_timeout_seconds",
+                  "trace_fanout_timeout_seconds",
+                  "staleness_red_line_seconds"):
+            if float(getattr(self, k)) <= 0:
+                raise ValueError(f"fleet {k} must be > 0")
+        for k in ("max_series_per_member", "max_members",
+                  "audit_queue_red_line", "journal_head_red_line_bytes"):
+            v = getattr(self, k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(f"fleet {k} must be an int >= 1, "
+                                 f"got {v!r}")
+        if not 0.0 < float(self.saturation_red_line) <= 1.0:
+            raise ValueError("fleet saturation_red_line must be in "
+                             f"(0, 1], got {self.saturation_red_line!r}")
+        for m in self.members:
+            if not isinstance(m, dict) or not m.get("url"):
+                raise ValueError("fleet members entries must be objects "
+                                 f"with a \"url\", got {m!r}")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "FleetConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown fleet key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"fleet key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            elif k == "members":
+                if not isinstance(v, list):
+                    raise ValueError("fleet members must be a list of "
+                                     "{instance, url, role} objects")
+                cfg.members = [dict(m) for m in v]
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class PartitionConfig:
     """Partitioned write plane (state/partition.py; the daemon's
     ``"partitions"`` conf section inside ``"scheduler"``, boot-validated
@@ -662,6 +754,9 @@ class Config:
     # serving-plane scale-out: follower read fleet + leader group-commit
     # admission batching (state/read_replica.py, state/store.py)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    # fleet observability plane: metrics federation + stitched traces +
+    # saturation signals (sched/fleet.py, docs/OBSERVABILITY.md)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     # partitioned write plane: per-pool-group store/journal shards with
     # independent fsync streams + leases (state/partition.py); count=1 =
     # the classic single-store plane

@@ -159,6 +159,13 @@ def build_scheduler_config(spec: Dict) -> Config:
         # the first cycle half a minute into leadership
         from .sched.optimizer import OptimizerConfig
         cfg.optimizer = OptimizerConfig.from_conf(spec["optimizer"])
+    if "fleet" in spec:
+        # fleet observability plane (docs/OBSERVABILITY.md): federation
+        # scrape cadence, trace fan-out timeout, static extra members,
+        # and the saturation red lines; a typo'd knob fails the boot
+        # like the sections above
+        from .config import FleetConfig
+        cfg.fleet = FleetConfig.from_conf(spec["fleet"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))
@@ -302,6 +309,9 @@ class CookDaemon:
         # monotonic timestamp of the last NOT-superseded fence verdict
         # (_fence_superseded's short-TTL cache)
         self._fence_cache: Optional[float] = None
+        # fleet observability plane (sched/fleet.py): federation scraper
+        # + trace fan-out over the candidate registry's topology
+        self.fleet = None
 
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
@@ -420,6 +430,12 @@ class CookDaemon:
         self.server.start()
         self.node_url = f"http://{self.host}:{self.server.port}"
         self._node_id = f"{self.host}-{self.server.port}"
+        # this process's span identity: every span recorded from here on
+        # carries it, so the fleet-stitched Perfetto export renders this
+        # node as its own process track (docs/OBSERVABILITY.md)
+        from .utils import tracing
+        tracing.set_process_identity(self._node_id)
+        self.api.instance = self._node_id
 
         election = conf.get("election", {})
         if election.get("mode") == "k8s-lease":
@@ -447,6 +463,21 @@ class CookDaemon:
                 on_leadership=self._on_leadership, on_loss=self._on_loss)
         self.api.elector = self.elector
         self.api.node_url = self.node_url
+        if self.sched_config.fleet.enabled:
+            # metrics federation + fleet trace fan-out share ONE
+            # topology source: the election medium's candidate registry
+            # (standbys publish url/ts there each position interval),
+            # plus any statically-configured extra members
+            from .sched.fleet import FleetScraper
+            from .state.replication import known_members
+            fleet_cfg = self.sched_config.fleet
+            self.fleet = FleetScraper(
+                fleet_cfg,
+                members_fn=lambda: known_members(
+                    self.elector, self._node_id, self.node_url,
+                    leader=self.scheduler is not None,
+                    extra=fleet_cfg.members))
+            self.api.fleet = self.fleet
         if self.replication:
             if not conf.get("election_dir"):
                 # without an explicit SHARED election dir the elector
@@ -554,6 +585,11 @@ class CookDaemon:
                     rate_limits=self.rate_limits)
                 self.scheduler.run()
                 self.api.scheduler = self.scheduler
+                if self.fleet is not None:
+                    # the leader's monitor sweep drives federation
+                    # scrapes (followers run no Monitor; their /metrics
+                    # and /debug/fleet nudge the self-gated scraper)
+                    self.scheduler.monitor.fleet = self.fleet
         except Exception:
             # A failed takeover (bad cluster factory, store corruption...)
             # must NOT leave this node holding the leader lock with no

@@ -116,6 +116,21 @@ class TokenBucketRateLimiter:
         with self._lock:
             self._buckets.clear()
 
+    def saturation(self) -> float:
+        """Worst-key consumption fraction in [0, 1] — the launch-token
+        saturation signal (sched/fleet.py): 0 = every bucket full,
+        1 = some key fully spent (or in debt, which clamps).  Buckets
+        are lazily refreshed first, so a key idle since its last spend
+        reads its EARNED-BACK level, not its historical debt."""
+        if self.bucket_size <= 0:
+            return 0.0
+        with self._lock:
+            if not self._buckets:
+                return 0.0
+            low = min(self._refresh(key).tokens
+                      for key in list(self._buckets))
+        return min(max(1.0 - low / self.bucket_size, 0.0), 1.0)
+
 
 class UnlimitedRateLimiter:
     """The no-op limiter used when a plane is unconfigured."""
@@ -136,6 +151,9 @@ class UnlimitedRateLimiter:
 
     def flush(self) -> None:
         pass
+
+    def saturation(self) -> float:
+        return 0.0
 
 
 def pool_user_key(pool: str, user: str) -> str:

@@ -127,7 +127,16 @@ API_ROUTES = [
     ("GET", "/debug/optimizer",
      "goodput optimizer panel: last per-pool decisions, cycle "
      "counts/errors, elastic resize plane state", False),
+    ("GET", "/debug/trace/spans",
+     "raw local span-ring docs for one trace id — the fleet trace "
+     "collector's per-member stitch source", False),
+    ("GET", "/debug/fleet",
+     "federated fleet panel: per-member health, staleness, burn, "
+     "saturation hot-spots, last-scrape age", False),
     ("GET", "/metrics", "Prometheus metrics", False),
+    ("GET", "/metrics/fleet",
+     "merged fleet exposition: every member's /metrics re-labeled "
+     "with instance/role", False),
     ("POST", "/progress/{task_id}", "sidecar progress frames", True),
     ("POST", "/shutdown-leader", "resign leadership (admin)", True),
     ("GET", "/compute-clusters", "dynamic cluster configs", False),
@@ -663,6 +672,13 @@ class CookApi:
         # 307-redirecting them to the leader (docs/DEPLOY.md)
         self.read_view = None
         self.follower_reads = 0
+        # fleet observability plane (sched/fleet.py, set by the daemon):
+        # the FleetScraper behind /metrics/fleet + /debug/fleet and the
+        # stitched /debug/trace fan-out, and this node's span identity
+        # (every request's spans record under it — the per-process
+        # track key of the fleet Perfetto export)
+        self.fleet = None
+        self.instance: Optional[str] = None
         # HTTP-level per-client-IP throttle (reference: ip-rate-limit
         # middleware wrapping the handler, components.clj:214-221);
         # None = unlimited
@@ -1684,6 +1700,14 @@ class CookApi:
                         404, f"no trace recorded for job {job}")
                 raise ApiError(400, "trace_id or job query parameter "
                                     "is required")
+        if self.fleet is not None:
+            # fleet-wide stitch (sched/fleet.py): fan out to every
+            # known member's span ring and export per-PROCESS tracks —
+            # leader txn, partition fsync, agent exec, barrier release
+            # on one timeline (docs/OBSERVABILITY.md "Debugging the
+            # fleet")
+            return self._debug_trace_fleet(trace_id, req_trace, job,
+                                           timeline)
         trace = tracer.export_chrome_trace(trace_id)
         if not trace["traceEvents"] and not (job and timeline):
             raise ApiError(404, f"no spans recorded for trace {trace_id}")
@@ -1701,6 +1725,98 @@ class CookApi:
             # job"): decision history and flamegraph on one timeline
             trace["traceEvents"].extend(job_track_events(job, timeline))
         return trace
+
+    def _debug_trace_fleet(self, trace_id: str,
+                           req_trace: Optional[str], job: Optional[str],
+                           timeline: List[Dict[str, Any]]) -> Dict:
+        """The stitched form of /debug/trace: local ring + per-member
+        fan-out, merged and deduped, exported with per-process tracks;
+        a distinct submission-request trace merges onto the same member
+        tracks (the spans carry which process recorded them).  Fan-out
+        provenance lands in ``otherData.members`` so a partial stitch
+        (unreachable member) is visible, not silent."""
+        from ..utils.tracing import export_fleet_trace, job_track_events
+        spans, provenance = self.fleet.collect_trace(trace_id)
+        if req_trace and req_trace != trace_id:
+            req_spans, req_prov = self.fleet.collect_trace(req_trace)
+            seen = {(d.get("proc"), d.get("span_id")) for d in spans}
+            spans += [d for d in req_spans
+                      if (d.get("proc"), d.get("span_id")) not in seen]
+            provenance += [{**p, "trace": req_trace} for p in req_prov]
+        if not spans and not (job and timeline):
+            raise ApiError(404, f"no spans recorded for trace {trace_id}")
+        trace = export_fleet_trace(spans, trace_id, members=provenance)
+        if job and timeline:
+            # the audit lane keeps its classic pid-1 home; name the
+            # process so the fleet view labels the timeline track
+            trace["traceEvents"].append(
+                {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": {"name": f"job {job[:13]} timeline"}})
+            trace["traceEvents"].extend(job_track_events(job, timeline))
+        return trace
+
+    def debug_trace_spans(self, params: Dict) -> Dict:
+        """GET /debug/trace/spans?trace_id= — THIS process's raw span
+        docs for one trace, straight off the bounded local ring
+        (utils/tracing.py): the per-member stitch source the fleet
+        trace collector merges and dedupes.  Served locally on every
+        role — a follower or agent-side process answers for its own
+        ring, it never redirects (the whole point is that each member
+        holds spans nobody else has)."""
+        from ..utils import tracing as _tracing
+        trace_id = params.get("trace_id", [None])[0]
+        if not trace_id:
+            raise ApiError(400, "trace_id query parameter is required")
+        return {"trace_id": trace_id,
+                "proc": self.instance or _tracing.process_identity(),
+                "spans": _tracing.tracer.traces(trace_id)}
+
+    def _role(self) -> str:
+        """This process's fleet role as surfaced on /debug/health and
+        /debug/fleet: ``leader`` (scheduler attached), ``follower`` (a
+        live read view or replication mirror), else ``standby``."""
+        if self.scheduler is not None:
+            return "leader"
+        if self.read_view is not None or self.repl_follower is not None:
+            return "follower"
+        return "standby"
+
+    def debug_fleet(self) -> Dict:
+        """GET /debug/fleet — the federated fleet panel (`cs debug
+        fleet` renders it): per-member health, staleness, SLO burn,
+        saturation hot-spots, and last-scrape age off the FleetScraper,
+        plus this process's LIVE saturation block (recomputed now, not
+        the last sweep's).  Without a scraper attached (follower,
+        api-only node, federation disabled) the local block still
+        serves — a probe of any member always answers."""
+        from ..sched.fleet import compute_saturation
+        sat = compute_saturation(self.config, store=self.store,
+                                 read_view=self.read_view,
+                                 rate_limits=self.rate_limits)
+        red = self.config.fleet.saturation_red_line
+        local = {"instance": self.instance, "role": self._role(),
+                 "saturation": sat,
+                 "hot": sorted(r for r, v in sat.items() if v >= red)}
+        if self.fleet is None:
+            return {"enabled": False, "members": [], "local": local,
+                    "saturation_red_line": red}
+        self.fleet.maybe_scrape()
+        doc = self.fleet.fleet_doc()
+        doc["local"] = local
+        return doc
+
+    def metrics_fleet(self) -> str:
+        """GET /metrics/fleet — the merged fleet exposition: every
+        member's /metrics re-labeled with {instance, role}
+        (sched/fleet.py).  A pull nudges the self-gated scraper, so a
+        fresh leader serves real data without waiting a monitor sweep;
+        without a scraper the local exposition serves (the scrape
+        target never 404s during failover)."""
+        if self.fleet is None:
+            return self.metrics()
+        self.fleet.maybe_scrape()
+        merged = self.fleet.merged_exposition()
+        return merged if merged else self.metrics()
 
     def debug_requests(self, params: Dict) -> Dict:
         """GET /debug/requests?limit= — the serving plane's bounded
@@ -1726,10 +1842,26 @@ class CookApi:
             return [{**labels, "value": value}
                     for labels, value in registry.series(name)]
 
+        from ..sched.fleet import compute_saturation
         repl = self.debug_replication()
+        saturation = compute_saturation(self.config, store=self.store,
+                                        read_view=self.read_view,
+                                        rate_limits=self.rate_limits)
+        red_line = self.config.fleet.saturation_red_line
         health: Dict[str, Any] = {
             "healthy": True,
             "leader": self.scheduler is not None,
+            # fleet role marker: a follower probed directly must SAY so
+            # (and carry its read-view block below) instead of looking
+            # like a healthy leader-shaped process
+            "role": self._role(),
+            # normalized 0-1 saturation signals (sched/fleet.py
+            # formulas; docs/OBSERVABILITY.md) — the adaptive-admission
+            # input contract, recomputed live for this probe
+            "saturation": saturation,
+            "saturation_red_line": red_line,
+            "saturation_hot": sorted(r for r, v in saturation.items()
+                                     if v >= red_line),
             "slo_burn_rates": series("cook_slo_burn_rate"),
             "breakers": breakers.states(),
             "replication": {
@@ -1778,12 +1910,25 @@ class CookApi:
         if followers:
             health["replication"]["max_lag_bytes"] = max(
                 int(f.get("lag_bytes", 0)) for f in followers)
+        rv = self.read_view
+        if rv is not None:
+            # the read-view apply-loop block /debug/replication always
+            # had but this roll-up omitted: a follower probed directly
+            # looked healthier than it was — no staleness age, no
+            # applied offset, no reads-served count
+            health["read_view"] = {**rv.stats(),
+                                   "reads_served": self.follower_reads}
         # burning past budget, a fenced store, or a potential-deadlock
         # lock graph is not healthy
         if any(s["value"] > 1.0 for s in health["slo_burn_rates"]) \
                 or repl.get("fenced") \
                 or health["locks"]["violations"] \
                 or health["locks"]["blocking_events"]:
+            health["healthy"] = False
+        if rv is not None and saturation["follower_staleness"] >= 1.0:
+            # a follower serving reads staler than the red line
+            # (fleet.staleness_red_line_seconds) is NOT healthy — the
+            # exact "looks healthier than it is" gap this block closes
             health["healthy"] = False
         return health
 
@@ -2163,6 +2308,16 @@ class CookApi:
                                float(rv.lag_bytes()))
             registry.gauge_set("cook_follower_staleness_seconds",
                                round(rv.age_ms() / 1000.0, 6))
+        # saturation gauges refresh at scrape time on EVERY role: the
+        # leader's monitor sweep also publishes them, but followers and
+        # api-only nodes run no monitor — without this their federated
+        # series would read a boot-time zero forever (sched/fleet.py)
+        from ..sched.fleet import compute_saturation, publish_saturation
+        publish_saturation(
+            compute_saturation(self.config, store=self.store,
+                               read_view=rv,
+                               rate_limits=self.rate_limits),
+            registry)
         lines = registry.expose()
         # always include live gauges derivable from state (per-shard
         # locks taken in turn, never nested — utils/locks.py)
@@ -2305,7 +2460,8 @@ class _Handler(BaseHTTPRequestHandler):
         # skip the compressor (the header bytes would outweigh the win)
         path = self.path.split("?", 1)[0]
         if len(data) > 512 \
-                and (path == "/metrics" or path.startswith("/debug")) \
+                and (path in ("/metrics", "/metrics/fleet")
+                     or path.startswith("/debug")) \
                 and instrument.wants_gzip(
                     self.headers.get("Accept-Encoding")):
             data = instrument.gzip_body(data)
@@ -2349,7 +2505,17 @@ class _Handler(BaseHTTPRequestHandler):
         an ``http.request`` root span under any client-sent traceparent,
         RED metrics on the templated endpoint, and a capture-ring record
         carrying the per-phase breakdown the span tree accumulated
-        (journal append, replication ack wait, ...)."""
+        (journal append, replication ack wait, ...).
+
+        Spans record under this node's fleet identity (CookApi.instance)
+        for the request's duration: an in-process multi-server topology
+        (tests, the simulator) shares one span ring, and the per-process
+        tracks of the stitched fleet export are grouped by which MEMBER
+        served the request, not which OS process ran it."""
+        with tracing.scoped_identity(getattr(self.api, "instance", None)):
+            self._route_identified(method)
+
+    def _route_identified(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
         self._request_id = (self.headers.get("X-Cook-Request-Id")
                             or uuidlib.uuid4().hex[:16])
@@ -2457,8 +2623,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
+                    "/debug/trace/spans", "/debug/fleet",
                     "/debug/faults", "/debug/replication",
                     "/debug/requests", "/debug/health", "/metrics",
+                    "/metrics/fleet",
                     "/failure_reasons", "/settings", "/swagger-docs",
                     "/swagger-ui"}
 
@@ -2632,6 +2800,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_health()
             if path == "/debug/optimizer":
                 return api.debug_optimizer()
+            if path == "/debug/trace/spans":
+                return api.debug_trace_spans(params)
+            if path == "/debug/fleet":
+                return api.debug_fleet()
             if len(parts) == 4 and parts[0] == "debug" \
                     and parts[1] == "job" and parts[3] == "timeline":
                 return api.debug_job_timeline(parts[2])
@@ -2641,6 +2813,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return {"_html": api.swagger_ui()}
             if path == "/metrics":
                 return {"_raw": api.metrics()}
+            if path == "/metrics/fleet":
+                return {"_raw": api.metrics_fleet()}
             if path == "/compute-clusters":
                 return api.compute_clusters()
             if path == "/incremental-config":

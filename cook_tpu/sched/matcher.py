@@ -773,6 +773,15 @@ class Matcher:
             launch_rl.spend(pool_user_key(pool_name, job.user))
             cluster_rl.spend(offer.cluster)
             env = job.env
+            if job.trace_id:
+                # propagate the submission's trace context to the agent
+                # executor (W3C traceparent in the task env): the exec
+                # span the wrapper opens joins the job's client-minted
+                # trace, so the fleet trace collector can stitch client
+                # submit -> leader txn -> agent exec onto one timeline
+                # (docs/OBSERVABILITY.md)
+                env = {**env, "COOK_TRACEPARENT":
+                       tracing.make_traceparent(job.trace_id)}
             guuid = job.group if job.group in gangs else None
             if guuid:
                 # executors gate on the gang barrier via the task env

@@ -112,6 +112,14 @@ class Monitor:
         self.registry = registry if registry is not None else default_registry
         self.slo: SloConfig = (config.slo if config is not None
                                else SloConfig())
+        self.config: Config = config if config is not None else Config()
+        # fleet observability plane (sched/fleet.py): the scheduler
+        # wires its rate limiters in (launch-token saturation input) and
+        # the daemon attaches a FleetScraper; both stay None in
+        # store-only constructions (tests, the simulator)
+        self.rate_limits = None
+        self.read_view = None
+        self.fleet = None
         # (pool, state) -> {user -> stats} from the previous sweep, so
         # series for vanished users can be zeroed
         self._previous: Dict[Tuple[str, str], Dict[str, Dict]] = {}
@@ -158,7 +166,26 @@ class Monitor:
         self._sweep_cycle_slo()
         self._sweep_http_slo()
         self._sweep_serving()
+        self._sweep_saturation()
+        fleet = self.fleet
+        if fleet is not None:
+            # monitor-driven federation (sched/fleet.py): the scraper
+            # self-gates to its own interval, so the sweep cadence and
+            # the scrape cadence stay independently configurable
+            fleet.maybe_scrape()
         return out
+
+    def _sweep_saturation(self) -> None:
+        """The derived 0-1 saturation layer (sched/fleet.py formulas):
+        recomputed from live counters each sweep and published as
+        ``cook_saturation{resource=}`` — the admission-control input
+        contract, also surfaced on /debug/health + /debug/fleet."""
+        from .fleet import compute_saturation, publish_saturation
+        publish_saturation(
+            compute_saturation(self.config, store=self.store,
+                               read_view=self.read_view,
+                               rate_limits=self.rate_limits),
+            self.registry)
 
     def _sweep_serving(self) -> None:
         """Leader serving-plane gauges: the journal commit position (the

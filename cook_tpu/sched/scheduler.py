@@ -89,6 +89,9 @@ class Scheduler:
         self.optimizer_cycler = None
         from .monitor import Monitor
         self.monitor = Monitor(store, config=self.config)
+        # launch-token saturation input (sched/fleet.py): the sweep
+        # reads the same buckets the matcher admits against
+        self.monitor.rate_limits = self.rate_limits
         from .heartbeat import HeartbeatTracker
         self.heartbeats = HeartbeatTracker(self.config.heartbeat_timeout_ms)
         # Heartbeat stamps and reaper sweeps follow the store's injectable

@@ -218,6 +218,52 @@ def assert_promotable(directory: str) -> None:
             "take over")
 
 
+def known_members(elector, self_id: Optional[str] = None,
+                  self_url: Optional[str] = None, leader: bool = False,
+                  extra: Optional[list] = None) -> Dict[str, Dict]:
+    """The fleet-topology view every observability layer shares
+    (sched/fleet.py; docs/OBSERVABILITY.md "Debugging the fleet"):
+    ``{instance: {url, role, ts}}`` assembled from the election
+    candidate registry (standbys publish their position + REST url each
+    ``position_interval_seconds``, daemon._follow_leader_loop), this
+    node itself, and any config-declared static ``extra`` members
+    (FleetConfig.members — agents or processes that never campaign).
+
+    Entries without a url are skipped (nothing to scrape); a STALE
+    candidate entry is kept — the federation layer surfaces an
+    unreachable member as ``up=0`` data, it never silently narrows the
+    fleet.  A registry read failure degrades to the self + static view
+    rather than raising into the monitor sweep."""
+    out: Dict[str, Dict] = {}
+    if self_id:
+        out[str(self_id)] = {
+            "url": self_url,
+            "role": "leader" if leader else "follower",
+            "ts": time.time(), "self": True}
+    try:
+        candidates = elector.read_candidates() if elector is not None \
+            else {}
+    except Exception:
+        candidates = {}
+    for nid, pos in candidates.items():
+        nid = str(nid)
+        if nid in out:
+            continue
+        url = (pos or {}).get("url")
+        if not url:
+            continue
+        out[nid] = {"url": str(url), "role": "follower",
+                    "ts": (pos or {}).get("ts")}
+    for m in extra or []:
+        inst = str(m.get("instance") or m.get("url"))
+        if inst in out or not m.get("url"):
+            continue
+        out[inst] = {"url": str(m["url"]),
+                     "role": str(m.get("role") or "member"),
+                     "ts": None}
+    return out
+
+
 class ReplicationServer:
     """Leader side: serve ``directory``'s journal to followers.
 

@@ -445,6 +445,14 @@ class AuditTrail:
         with self._lock:
             return len(self._lanes)
 
+    def pending_durable_count(self) -> int:
+        """Durable events still buffered for the journal — the cheap
+        per-sweep read behind the ``audit_queue`` saturation signal
+        (sched/fleet.py); :meth:`stats` walks every lane, this holds
+        the lock for one ``len``."""
+        with self._lock:
+            return len(self._pending)
+
     def stats(self) -> Dict[str, Any]:
         """Aggregate counts for the simulator summary / tests."""
         self.publish_metrics()

@@ -9,6 +9,7 @@ keyed by (name, labels), exposed at /metrics.
 from __future__ import annotations
 
 import contextvars
+import re
 import threading
 import time
 from contextlib import contextmanager
@@ -49,6 +50,63 @@ def _labels_str(key: Tuple) -> str:
         return ""
     inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def _unescape_label_value(value: str) -> str:
+    """Inverse of :func:`_escape_label_value` (federation parse side)."""
+    out: List[str] = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            n = value[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(n, "\\" + n))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_LINE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+([^\s]+)\s*$')
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse Prometheus text exposition into ``(exposed_name, labels,
+    value)`` triples — the federation scraper's read side (sched/fleet.py).
+    Exposed names are kept VERBATIM (``_total``/``_bucket``/``_count``/
+    ``_sum`` suffixes intact): federation re-labels and re-emits, it
+    never re-interprets metric types.  Comment/HELP/TYPE lines and
+    malformed lines are skipped (a member mid-restart must not poison
+    the whole fleet view); non-finite values (``NaN``/``+Inf`` bucket
+    bounds live in label values, not sample values) parse via float()."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        name, labels_str, value_str = m.groups()
+        try:
+            value = float(value_str)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        if labels_str:
+            for lm in _LABEL_RE.finditer(labels_str[1:-1]):
+                labels[lm.group(1)] = _unescape_label_value(lm.group(2))
+        out.append((name, labels, value))
+    return out
+
+
+def format_sample(name: str, labels: Dict[str, str], value: float) -> str:
+    """One exposition line from an (exposed_name, labels, value) triple —
+    the federation re-emit side, escaping-symmetric with parse."""
+    return f"{name}{_labels_str(_labels_key(labels))} {value}"
 
 
 class MetricsRegistry:

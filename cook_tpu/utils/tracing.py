@@ -71,6 +71,46 @@ def collect_phases():
         _phases_var.reset(token)
 
 
+# ------------------------------------------------------- process identity
+# Every span is stamped with the identity of the PROCESS (fleet member)
+# that recorded it — the grouping key the fleet trace collector turns
+# into per-process Perfetto tracks (docs/OBSERVABILITY.md "Debugging the
+# fleet").  The default is a process-global set once by the daemon at
+# boot (node id); a contextvar override scopes a DIFFERENT identity to
+# one request, so an in-process multi-server topology (tests, the
+# simulator) still yields distinct per-member tracks out of one shared
+# ring.
+_proc_default = "cook"
+_identity_var: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("cook_proc_identity", default=None)
+
+
+def set_process_identity(name: str) -> None:
+    """Install the process-global span identity (daemon boot: node id)."""
+    global _proc_default
+    _proc_default = str(name)
+
+
+def process_identity() -> str:
+    """The identity spans record right now (contextvar override wins)."""
+    return _identity_var.get() or _proc_default
+
+
+@contextmanager
+def scoped_identity(name: Optional[str]):
+    """Spans opened inside record under ``name`` instead of the process
+    default — the REST handler scopes each request to its serving node's
+    identity.  ``None`` is a no-op (keeps the ambient identity)."""
+    if name is None:
+        yield
+        return
+    token = _identity_var.set(str(name))
+    try:
+        yield
+    finally:
+        _identity_var.reset(token)
+
+
 # ------------------------------------------------------ W3C trace context
 # Propagated over the `traceparent` HTTP header (W3C Trace Context:
 # 00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>).  Internal span
@@ -117,7 +157,7 @@ def parse_traceparent(header: Optional[str]
 
 class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "tags",
-                 "start_s", "duration_s", "error")
+                 "start_s", "duration_s", "error", "proc")
 
     def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
                  tags: Dict[str, Any]):
@@ -129,6 +169,7 @@ class Span:
         self.start_s = time.time()
         self.duration_s: Optional[float] = None
         self.error: Optional[str] = None
+        self.proc = process_identity()
 
     def set_tag(self, key: str, value: Any) -> None:
         self.tags[key] = value
@@ -136,6 +177,7 @@ class Span:
     def to_doc(self) -> Dict[str, Any]:
         return {"span": self.name, "trace_id": self.trace_id,
                 "span_id": self.span_id, "parent_id": self.parent_id,
+                "proc": self.proc,
                 "start": self.start_s, "duration_ms":
                 round((self.duration_s or 0.0) * 1000.0, 3),
                 "error": self.error, **self.tags}
@@ -263,7 +305,8 @@ class Tracer:
         events: List[Dict[str, Any]] = []
         for d in self.traces(trace_id):
             args = {k: v for k, v in d.items()
-                    if k not in ("span", "trace_id", "start", "duration_ms")
+                    if k not in ("span", "trace_id", "start", "duration_ms",
+                                 "proc")
                     and v is not None}
             events.append({
                 "name": d["span"],
@@ -333,6 +376,87 @@ def job_track_events(uuid: str, timeline: List[Dict[str, Any]],
             "ts": round(ev["ts"] * 1000.0, 3), "pid": 1, "tid": tid,
             "s": "t", "args": args})
     return events
+
+
+def _proc_sort_key(proc: str) -> tuple:
+    """Stable track ordering for the stitched fleet export: the client
+    track first (it owns the root span), the leader next, everyone else
+    alphabetical — so every export of the same topology reads the same
+    way top-to-bottom in Perfetto."""
+    if proc.startswith("client"):
+        rank = 0
+    elif "leader" in proc or proc.startswith("cook"):
+        rank = 1
+    else:
+        rank = 2
+    return (rank, proc)
+
+
+def fleet_trace_events(span_docs: List[Dict[str, Any]],
+                       base_pid: int = 10) -> List[Dict[str, Any]]:
+    """Merged span docs (each carrying its recording process in ``proc``)
+    as Chrome trace events on PER-PROCESS tracks: every distinct proc
+    gets its own ``pid`` with ``process_name`` + ``process_sort_index``
+    metadata, so the gang-launch path shows leader txn, partition fsync,
+    agent exec, and barrier release as separate swimlanes on one
+    timeline (the Dapper stitch, docs/OBSERVABILITY.md).
+
+    Spans are deduplicated by ``(proc, span_id)`` — the fleet collector
+    fans out to every member and a member may return spans another
+    member (or the local ring) already contributed."""
+    procs = sorted({str(d.get("proc") or "?") for d in span_docs},
+                   key=_proc_sort_key)
+    pid_of = {p: base_pid + i for i, p in enumerate(procs)}
+    events: List[Dict[str, Any]] = []
+    for i, p in enumerate(procs):
+        pid = pid_of[p]
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": p}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"sort_index": i}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": "spans"}})
+    seen = set()
+    for d in span_docs:
+        proc = str(d.get("proc") or "?")
+        key = (proc, d.get("span_id"))
+        if key in seen:
+            continue
+        seen.add(key)
+        args = {k: v for k, v in d.items()
+                if k not in ("span", "trace_id", "start", "duration_ms",
+                             "proc")
+                and v is not None}
+        events.append({
+            "name": d.get("span", "?"),
+            "cat": "cook",
+            "ph": "X",
+            "ts": round(float(d.get("start") or 0.0) * 1e6, 3),
+            "dur": max(round((d.get("duration_ms") or 0.0) * 1000.0, 3),
+                       1.0),
+            "pid": pid_of[proc],
+            "tid": 1,
+            "args": args,
+        })
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0)))
+    return events
+
+
+def export_fleet_trace(span_docs: List[Dict[str, Any]], trace_id: str,
+                       members: Optional[List[Dict[str, Any]]] = None
+                       ) -> Dict[str, Any]:
+    """One stitched fleet-wide Perfetto export for ``trace_id``: the
+    per-process tracks of :func:`fleet_trace_events` plus the collection
+    provenance (which members contributed / failed) in ``otherData`` so
+    a partial stitch is never mistaken for the whole fleet."""
+    doc: Dict[str, Any] = {
+        "traceEvents": fleet_trace_events(span_docs),
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": trace_id, "fleet": True},
+    }
+    if members is not None:
+        doc["otherData"]["members"] = members
+    return doc
 
 
 class _NoopSpan:

@@ -1,0 +1,730 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md "debugging the
+fleet"): cross-process trace stitching into one Perfetto export,
+metrics federation over the candidate-registry topology, and the
+normalized saturation-signal layer — plus the two satellite contracts
+(the follower health roll-up's read-view block, request-id continuity
+across the 307 redirect hop).
+"""
+
+import http.server
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config, FleetConfig
+from cook_tpu.policy.rate_limit import RateLimits, TokenBucketRateLimiter
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.sched import Scheduler
+from cook_tpu.sched.election import FileLeaderElector
+from cook_tpu.sched.fleet import (FleetScraper, collect_trace,
+                                  compute_saturation, publish_saturation)
+from cook_tpu.state import Resources, Store
+from cook_tpu.state.replication import known_members
+from cook_tpu.utils.metrics import (MetricsRegistry, format_sample,
+                                    parse_exposition, registry)
+from cook_tpu.utils import tracing
+from cook_tpu.utils.tracing import (export_fleet_trace, make_traceparent,
+                                    scoped_identity, tracer)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    registry.reset()
+    tracer.reset()
+    tracer.enabled = True
+    yield
+    registry.reset()
+    tracer.reset()
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(0.01)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# saturation signals
+# ---------------------------------------------------------------------------
+
+class _FakeGroupCommitStore:
+    """The store surface compute_saturation touches: group-commit stats,
+    journal offset, audit queue."""
+
+    def __init__(self, pending=0, offset=0, audit_pending=0):
+        self._pending = pending
+        self._offset = offset
+        self.audit = type("A", (), {
+            "pending_durable_count": staticmethod(lambda: audit_pending),
+            "stats": staticmethod(lambda: {})})()
+
+    def group_commit_stats(self):
+        return {"pending": self._pending, "batches": 0}
+
+    def commit_offset(self):
+        return self._offset
+
+
+class _FakeReadView:
+    def __init__(self, age_ms=0.0):
+        self._age_ms = age_ms
+
+    def age_ms(self):
+        return self._age_ms
+
+    def stats(self):
+        return {"offset": 10, "mirror_offset": 10, "lag_bytes": 0,
+                "age_ms": self._age_ms, "applied_records": 1,
+                "rebuilds": 1}
+
+
+class TestSaturation:
+    def test_all_keys_present_and_zero_on_empty_process(self):
+        from cook_tpu.utils.flight import recorder
+        recorder.reset()  # cycle_p99 reads the process-global recorder
+        values = compute_saturation(Config())
+        assert set(values) == {"group_commit_queue", "follower_staleness",
+                               "cycle_p99", "audit_queue", "launch_tokens",
+                               "journal_head"}
+        assert all(v == 0.0 for v in values.values())
+
+    def test_group_commit_formula(self):
+        cfg = Config()
+        cfg.serving.group_commit_max_batch = 256
+        store = _FakeGroupCommitStore(pending=128)
+        values = compute_saturation(cfg, store=store)
+        assert values["group_commit_queue"] == pytest.approx(0.5)
+        # over-full queue clamps, never exceeds 1
+        store = _FakeGroupCommitStore(pending=10_000)
+        assert compute_saturation(cfg, store=store)[
+            "group_commit_queue"] == 1.0
+
+    def test_follower_staleness_formula_and_clamp(self):
+        cfg = Config()
+        cfg.fleet.staleness_red_line_seconds = 5.0
+        values = compute_saturation(cfg, read_view=_FakeReadView(2500.0))
+        assert values["follower_staleness"] == pytest.approx(0.5)
+        # past the red line clamps to 1.0 (and flips healthy elsewhere)
+        values = compute_saturation(cfg, read_view=_FakeReadView(60_000.0))
+        assert values["follower_staleness"] == 1.0
+
+    def test_launch_tokens_worst_key(self):
+        limiter = TokenBucketRateLimiter(tokens_per_minute=0.0001,
+                                         bucket_size=10)
+        limiter.spend("pool/alice", 5)
+        limiter.spend("pool/bob", 1)
+        rl = RateLimits(job_launch=limiter)
+        values = compute_saturation(Config(), rate_limits=rl)
+        # worst key (alice, 5/10 spent) defines the signal
+        assert values["launch_tokens"] == pytest.approx(0.5, abs=0.01)
+        limiter.spend("pool/alice", 20)  # deep in debt: clamps
+        assert compute_saturation(
+            Config(), rate_limits=rl)["launch_tokens"] == 1.0
+
+    def test_audit_and_journal_formulas(self):
+        cfg = Config()
+        cfg.fleet.audit_queue_red_line = 100
+        cfg.fleet.journal_head_red_line_bytes = 1000
+        store = _FakeGroupCommitStore(offset=250, audit_pending=25)
+        values = compute_saturation(cfg, store=store)
+        assert values["audit_queue"] == pytest.approx(0.25)
+        assert values["journal_head"] == pytest.approx(0.25)
+
+    def test_publish_pins_gauges_into_unit_interval(self):
+        reg = MetricsRegistry()
+        publish_saturation({"cycle_p99": 3.7, "audit_queue": -2.0,
+                            "launch_tokens": float("nan")}, reg)
+        got = {labels["resource"]: value
+               for labels, value in reg.series("cook_saturation")}
+        assert got == {"cycle_p99": 1.0, "audit_queue": 0.0,
+                       "launch_tokens": 0.0}
+        assert all(0.0 <= v <= 1.0 and not math.isnan(v)
+                   for v in got.values())
+
+
+# ---------------------------------------------------------------------------
+# exposition round trip (the federation wire format)
+# ---------------------------------------------------------------------------
+
+class TestExpositionRoundTrip:
+    def test_parse_inverts_format(self):
+        labels = {"pool": 'we"ird\\pool', "user": "a\nb"}
+        line = format_sample("cook_x", labels, 1.25)
+        [(name, parsed, value)] = parse_exposition(line)
+        assert name == "cook_x" and value == 1.25 and parsed == labels
+
+    def test_parse_real_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter_inc("cook_things", 3, labels={"kind": "a"})
+        reg.gauge_set("cook_level", 0.5)
+        reg.observe("cook_lat_seconds", 0.2, labels={"p": "x"})
+        samples = parse_exposition(reg.expose())
+        names = {n for n, _l, _v in samples}
+        assert "cook_things_total" in names
+        assert "cook_level" in names
+        assert "cook_lat_seconds_bucket" in names  # histograms survive
+        assert all(isinstance(v, float) for _n, _l, v in samples)
+
+
+# ---------------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------------
+
+def _fleet_cfg(**kw):
+    kw.setdefault("scrape_interval_seconds", 0.01)
+    return FleetConfig(**kw)
+
+
+def _fake_fetch(expositions):
+    """url -> exposition text; raising entries simulate dead members."""
+
+    def fetch(url, timeout_s):
+        base = url.split("/metrics")[0].split("/debug")[0]
+        body = expositions[base]
+        if isinstance(body, Exception):
+            raise body
+        return body
+
+    return fetch
+
+
+class TestFederation:
+    def _members(self, *urls, roles=None):
+        return {f"m{i}": {"url": u,
+                          "role": (roles or {}).get(f"m{i}", "member")}
+                for i, u in enumerate(urls)}
+
+    def test_merged_view_relabels_with_instance_and_role(self):
+        reg = MetricsRegistry()
+        scraper = FleetScraper(
+            _fleet_cfg(), lambda: self._members(
+                "http://a", "http://b",
+                roles={"m0": "leader", "m1": "follower"}),
+            fetch=_fake_fetch({
+                "http://a": 'cook_jobs_waiting 3\n',
+                "http://b": 'cook_jobs_waiting 7\n'}),
+            registry=reg)
+        scraper.scrape(now=100.0)
+        samples = parse_exposition(scraper.merged_exposition(now=100.0))
+        waiting = {l["instance"]: (l["role"], v)
+                   for n, l, v in samples if n == "cook_jobs_waiting"}
+        assert waiting == {"m0": ("leader", 3.0), "m1": ("follower", 7.0)}
+
+    def test_label_collision_renames_to_exported(self):
+        reg = MetricsRegistry()
+        scraper = FleetScraper(
+            _fleet_cfg(), lambda: self._members("http://a"),
+            fetch=_fake_fetch({"http://a": format_sample(
+                "cook_remote", {"instance": "z9", "role": "leader"},
+                1.0) + "\n"}),
+            registry=reg)
+        scraper.scrape(now=100.0)
+        [(_, labels, _v)] = [s for s in parse_exposition(
+            scraper.merged_exposition(now=100.0))
+            if s[0] == "cook_remote"]
+        # the member identity wins; the member's own labels survive
+        assert labels["instance"] == "m0"
+        assert labels["exported_instance"] == "z9"
+        assert labels["exported_role"] == "leader"
+
+    def test_unreachable_member_is_data_not_a_gap(self):
+        reg = MetricsRegistry()
+        scraper = FleetScraper(
+            _fleet_cfg(), lambda: self._members("http://up", "http://down"),
+            fetch=_fake_fetch({"http://up": "cook_x 1\n",
+                               "http://down": ConnectionError("refused")}),
+            registry=reg)
+        scraper.scrape(now=100.0)
+        up = {l["instance"]: v for n, l, v in parse_exposition(
+            scraper.merged_exposition(now=100.0))
+            if n == "cook_fleet_member_up"}
+        assert up == {"m0": 1.0, "m1": 0.0}
+        doc = scraper.fleet_doc(now=100.0)
+        down = next(m for m in doc["members"] if m["instance"] == "m1")
+        assert down["up"] is False
+        assert "refused" in down["error"]
+
+    def test_per_member_series_cap_reports_drops(self):
+        reg = MetricsRegistry()
+        body = "".join(f'cook_s{{i="{i}"}} 1\n' for i in range(50))
+        scraper = FleetScraper(
+            _fleet_cfg(max_series_per_member=10),
+            lambda: self._members("http://a"),
+            fetch=_fake_fetch({"http://a": body}), registry=reg)
+        scraper.scrape(now=100.0)
+        member = scraper.fleet_doc(now=100.0)["members"][0]
+        assert member["series"] == 10
+        assert member["dropped_series"] == 40
+        dropped = {l["instance"]: v for l, v in reg.series(
+            "cook_fleet_dropped_series")}
+        assert dropped == {"m0": 40.0}
+
+    def test_instance_cardinality_guard_folds_churning_members(self):
+        # a churning registry minting a new instance name every sweep
+        # must fold past the cap (max_members*2+16) instead of growing
+        # the local registry without bound
+        reg = MetricsRegistry()
+        current = {}
+        scraper = FleetScraper(
+            _fleet_cfg(max_members=1), lambda: dict(current),
+            fetch=_fake_fetch({"http://a": "cook_x 1\n"}), registry=reg)
+        for i in range(40):
+            current.clear()
+            current[f"churn-{i:03d}"] = {"url": "http://a"}
+            scraper.scrape(now=100.0 + i)
+        instances = {l["instance"]
+                     for l, _v in reg.series("cook_fleet_member_up")}
+        assert len(instances) <= 18 + 1  # cap + the "other" fold
+        assert "other" in instances
+        folds = list(reg.series("cook_metrics_dropped_labels"))
+        assert folds  # the folds are themselves observable
+        assert any(l.get("metric") == "cook_fleet_member_up"
+                   for l, _ in folds)
+
+    def test_fleet_burn_is_max_over_members(self):
+        reg = MetricsRegistry()
+        mk = lambda v: format_sample(
+            "cook_slo_burn_rate",
+            {"slo": "queue-latency", "pool": "default"}, v) + "\n"
+        scraper = FleetScraper(
+            _fleet_cfg(), lambda: self._members("http://a", "http://b"),
+            fetch=_fake_fetch({"http://a": mk(0.5), "http://b": mk(2.0)}),
+            registry=reg)
+        scraper.scrape(now=100.0)
+        doc = scraper.fleet_doc(now=100.0)
+        [burn] = doc["fleet_burn"]
+        assert burn["burn"] == 2.0  # the worst member pages, not the mean
+        assert burn["pool"] == "default"
+        [(labels, value)] = reg.series("cook_fleet_slo_burn_rate")
+        assert value == 2.0
+
+    def test_max_members_cap_is_loud(self):
+        reg = MetricsRegistry()
+        members = self._members(*[f"http://h{i}" for i in range(5)])
+        scraper = FleetScraper(
+            _fleet_cfg(max_members=2), lambda: members,
+            fetch=_fake_fetch({f"http://h{i}": "cook_x 1\n"
+                               for i in range(5)}),
+            registry=reg)
+        scraper.scrape(now=100.0)
+        assert len(scraper.fleet_doc(now=100.0)["members"]) == 2
+        assert sum(v for _l, v in reg.series(
+            "cook_fleet_members_skipped")) == 3.0
+
+    def test_maybe_scrape_self_gates(self):
+        reg = MetricsRegistry()
+        calls = []
+        scraper = FleetScraper(
+            FleetConfig(scrape_interval_seconds=100.0),
+            lambda: calls.append(1) or {}, registry=reg)
+        assert scraper.maybe_scrape(now=1000.0) is True
+        assert scraper.maybe_scrape(now=1001.0) is False  # inside window
+        assert scraper.maybe_scrape(now=1101.0) is True
+        assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# config boot validation
+# ---------------------------------------------------------------------------
+
+class TestFleetConfig:
+    def test_unknown_key_fails_boot(self):
+        with pytest.raises(ValueError, match="scrape_intervall"):
+            FleetConfig.from_conf({"scrape_intervall_seconds": 5})
+
+    def test_member_entries_validated(self):
+        with pytest.raises(ValueError, match="url"):
+            FleetConfig(members=[{"instance": "x"}])
+        cfg = FleetConfig.from_conf({"members": [
+            {"instance": "a1", "url": "http://a1:7776", "role": "agent"}]})
+        assert cfg.members[0]["role"] == "agent"
+
+    def test_daemon_section_wires_through(self):
+        from cook_tpu.daemon import build_scheduler_config
+        cfg = build_scheduler_config({"fleet": {
+            "scrape_interval_seconds": 3.5, "max_members": 8}})
+        assert cfg.fleet.scrape_interval_seconds == 3.5
+        assert cfg.fleet.max_members == 8
+        with pytest.raises(ValueError):
+            build_scheduler_config({"fleet": {"bogus_knob": 1}})
+
+
+# ---------------------------------------------------------------------------
+# topology discovery (the ONE source all three layers share)
+# ---------------------------------------------------------------------------
+
+class TestKnownMembers:
+    def test_candidates_plus_self_plus_static(self, tmp_path):
+        elector = FileLeaderElector(tmp_path / "lock", "http://me")
+        elector.publish_candidate("peer-1", {"url": "http://peer-1",
+                                             "ts": time.time()})
+        members = known_members(elector, self_id="me",
+                                self_url="http://me", leader=True,
+                                extra=[{"instance": "agent-a",
+                                        "url": "http://agent-a",
+                                        "role": "agent"}])
+        assert members["me"]["role"] == "leader"
+        assert members["me"]["self"] is True
+        assert members["peer-1"]["role"] == "follower"
+        assert members["agent-a"]["role"] == "agent"
+
+    def test_urlless_candidates_skipped_stale_kept(self, tmp_path):
+        elector = FileLeaderElector(tmp_path / "lock", "http://me")
+        elector.publish_candidate("old", {"url": "http://old", "ts": 1.0})
+        elector.publish_candidate("no-url", {"ts": time.time()})
+        members = known_members(elector)
+        assert "old" in members  # stale = unreachable = data, kept
+        assert "no-url" not in members
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace stitching
+# ---------------------------------------------------------------------------
+
+class TestFleetTraceExport:
+    def test_per_process_tracks_and_dedupe(self):
+        docs = [
+            {"span": "client.submit", "trace_id": "t1", "span_id": "s1",
+             "parent_id": None, "proc": "client-cli", "start": 1.0,
+             "duration_ms": 30.0, "error": None},
+            {"span": "http.request", "trace_id": "t1", "span_id": "s2",
+             "parent_id": "s1", "proc": "leader-1", "start": 1.001,
+             "duration_ms": 20.0, "error": None},
+            # the same span arriving from two members' rings dedupes
+            {"span": "http.request", "trace_id": "t1", "span_id": "s2",
+             "parent_id": "s1", "proc": "leader-1", "start": 1.001,
+             "duration_ms": 20.0, "error": None},
+            {"span": "agent.exec", "trace_id": "t1", "span_id": "s3",
+             "parent_id": "s1", "proc": "agent-h0", "start": 1.01,
+             "duration_ms": 5.0, "error": None},
+        ]
+        trace = export_fleet_trace(docs, "t1")
+        events = trace["traceEvents"]
+        names = {e["args"]["name"]: e["pid"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert set(names) == {"client-cli", "leader-1", "agent-h0"}
+        assert len(set(names.values())) == 3  # one pid track per process
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == 3  # the duplicate leader span folded
+        # client sorts first in the Perfetto track order
+        sort = {e["pid"]: e["args"]["sort_index"] for e in events
+                if e["ph"] == "M" and e["name"] == "process_sort_index"}
+        assert sort[names["client-cli"]] < sort[names["leader-1"]]
+        assert trace["otherData"]["fleet"] is True
+
+    def test_collect_trace_merges_and_records_provenance(self):
+        local = [{"span": "a", "trace_id": "t", "span_id": "l1",
+                  "proc": "leader", "start": 1.0, "duration_ms": 1.0}]
+        remote = {"spans": [{"span": "b", "trace_id": "t", "span_id": "r1",
+                             "proc": "follower", "start": 1.0,
+                             "duration_ms": 1.0}]}
+
+        def fetch(url, timeout_s):
+            if "dead" in url:
+                raise OSError("down")
+            return json.dumps(remote)
+
+        spans, provenance = collect_trace(
+            "t", {"f1": {"url": "http://f1"}, "f2": {"url": "http://dead"}},
+            fetch=fetch, local_spans=local)
+        assert {d["span_id"] for d in spans} == {"l1", "r1"}
+        by_instance = {p["instance"]: p for p in provenance}
+        assert by_instance["f1"]["ok"] and by_instance["f1"]["spans"] == 1
+        assert not by_instance["f2"]["ok"]
+        assert "down" in by_instance["f2"]["error"]
+
+
+class TestStitchedTopology:
+    """The acceptance topology: client -> follower (redirect) -> leader,
+    plus a REAL agent-executor subprocess, all under ONE client-minted
+    trace — one Perfetto export, >=3 distinct process tracks."""
+
+    @pytest.fixture()
+    def topology(self, tmp_path):
+        store = Store()
+        cluster = FakeCluster("c", [FakeHost("h0",
+                                             Resources(cpus=8, mem=8192))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.fleet.scrape_interval_seconds = 0.01
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        leader_api = CookApi(store, scheduler=sched, config=cfg)
+        leader_api.instance = "leader-1"
+        leader_srv = ApiServer(leader_api)
+        leader_srv.start()
+        elector = FileLeaderElector(tmp_path / "lock", leader_srv.url)
+        elector.campaign()
+        wait_until(lambda: elector.is_leader)
+
+        follower_api = CookApi(Store(), scheduler=None, config=cfg,
+                               elector=elector, node_url="http://follower")
+        follower_api.instance = "follower-1"
+        follower_srv = ApiServer(follower_api)
+        follower_srv.start()
+
+        members = {
+            "leader-1": {"url": leader_srv.url, "role": "leader",
+                         "self": True},
+            "follower-1": {"url": follower_srv.url, "role": "follower"},
+        }
+        leader_api.fleet = FleetScraper(cfg.fleet, lambda: dict(members))
+        yield leader_srv, follower_srv, store
+        follower_srv.stop()
+        leader_srv.stop()
+        elector.resign()
+
+    def test_single_export_stitches_three_processes(self, topology,
+                                                    tmp_path):
+        leader_srv, follower_srv, store = topology
+        with scoped_identity("client-cli"):
+            with tracer.span("client.submit") as root:
+                trace_id = root.trace_id
+                client = JobClient(follower_srv.url, user="alice")
+                uuid = client.submit_one("echo hi")  # 307 -> leader
+        assert store.job(uuid) is not None
+        assert client.last_trace_id == trace_id
+
+        # the agent leg: the REAL executor wrapper in its own process,
+        # adopting the propagated traceparent (sched/matcher.py stamps
+        # COOK_TRACEPARENT into the task env; here we play launch path)
+        sandbox = tmp_path / "sandbox"
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update(COOK_SANDBOX=str(sandbox), COOK_TASK_ID="task-1",
+                   COOK_TRACEPARENT=make_traceparent(trace_id),
+                   COOK_HOSTNAME="h0",
+                   PYTHONPATH=os.pathsep.join(
+                       p for p in (repo_root, env.get("PYTHONPATH")) if p))
+        proc = subprocess.run(
+            [sys.executable, "-m", "cook_tpu.agent.executor",
+             "echo", "ran"],
+            env=env, cwd=str(tmp_path), timeout=60,
+            capture_output=True)
+        assert proc.returncode == 0, proc.stderr.decode()
+        agent_docs = [json.loads(line) for line in
+                      (sandbox / "trace_spans.jsonl").read_text()
+                      .splitlines()]
+        assert agent_docs, "executor retained no spans for the trace"
+        exec_doc = next(d for d in agent_docs if d["span"] == "agent.exec")
+        assert exec_doc["trace_id"] == trace_id
+        assert exec_doc["proc"] == "agent-h0"
+        assert exec_doc["exit_code"] == 0
+        # the agent's ring died with its process; its sandbox-retained
+        # spans re-enter the leader's ring the way an agent-side
+        # collector would hand them over
+        tracer.finished.extend(agent_docs)
+
+        # ONE stitched export off the leader, fanned out to the fleet
+        wait_until(lambda: tracer.traces(trace_id))
+        with urllib.request.urlopen(
+                f"{leader_srv.url}/debug/trace?trace_id={trace_id}",
+                timeout=10) as resp:
+            trace = json.loads(resp.read())
+        assert trace["otherData"]["fleet"] is True
+        assert trace["otherData"]["trace_id"] == trace_id
+        events = trace["traceEvents"]
+        tracks = {e["args"]["name"]: e["pid"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+        # >=3 distinct processes on distinct pid tracks: the client,
+        # the leader (adopted via 307), the agent subprocess — plus the
+        # follower's redirect leg recorded under ITS identity
+        assert {"client-cli", "leader-1", "agent-h0"} <= set(tracks)
+        assert "follower-1" in tracks
+        assert len({tracks[n] for n in tracks}) == len(tracks)
+        by_pid = {}
+        for e in events:
+            if e["ph"] == "X":
+                by_pid.setdefault(e["pid"], []).append(e)
+        for name in ("client-cli", "leader-1", "agent-h0"):
+            assert by_pid.get(tracks[name]), f"no spans on {name}'s track"
+        # fan-out provenance names the follower's contribution
+        members = {m["instance"]: m
+                   for m in trace["otherData"]["members"]}
+        assert members["follower-1"]["ok"] is True
+
+    def test_debug_fleet_and_metrics_fleet_serve(self, topology):
+        leader_srv, follower_srv, _store = topology
+        client = JobClient(leader_srv.url, user="alice")
+        doc = client.debug_fleet()
+        assert doc["enabled"] is True
+        by_instance = {m["instance"]: m for m in doc["members"]}
+        assert by_instance["follower-1"]["up"] is True
+        assert by_instance["follower-1"]["role"] == "follower"
+        assert doc["local"]["role"] == "leader"
+        assert set(doc["local"]["saturation"]) >= {"cycle_p99",
+                                                   "launch_tokens"}
+        text = client.metrics_fleet()
+        samples = parse_exposition(text)
+        up = {l["instance"] for n, l, _v in samples
+              if n == "cook_fleet_member_up"}
+        assert up == {"leader-1", "follower-1"}
+        # every federated series carries the member identity
+        assert all("instance" in l for n, l, _v in samples)
+
+
+# ---------------------------------------------------------------------------
+# satellite: request-id continuity across the 307 hop
+# ---------------------------------------------------------------------------
+
+class _RedirectingHandler(http.server.BaseHTTPRequestHandler):
+    """A fake follower that mints an id, 307s, pointing at a fake
+    leader that either adopts the forwarded id or breaks the chain."""
+    leader_url = None
+    adopt = True
+    seen_forwarded = []
+
+    def do_GET(self):
+        if self.server.role == "follower":
+            self.send_response(307)
+            self.send_header("X-Cook-Request-Id", "follower-minted-id")
+            self.send_header("Location", self.leader_url + self.path)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        forwarded = self.headers.get("X-Cook-Request-Id")
+        type(self).seen_forwarded.append(forwarded)
+        echoed = forwarded if self.adopt and forwarded \
+            else "leader-minted-id"
+        body = json.dumps({"jobs": []}).encode()
+        self.send_response(200)
+        self.send_header("X-Cook-Request-Id", echoed)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve(role):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _RedirectingHandler)
+    srv.role = role
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+class TestRequestIdAcrossRedirect:
+    @pytest.fixture(autouse=True)
+    def _servers(self):
+        _RedirectingHandler.seen_forwarded = []
+        leader, leader_url = _serve("leader")
+        follower, follower_url = _serve("follower")
+        _RedirectingHandler.leader_url = leader_url
+        self.follower_url = follower_url
+        yield
+        leader.shutdown()
+        follower.shutdown()
+
+    def test_follower_minted_id_is_forwarded_and_adopted(self):
+        _RedirectingHandler.adopt = True
+        client = JobClient(self.follower_url, user="alice")
+        client.query([])
+        # the redirect hop FORWARDED the follower's id...
+        assert _RedirectingHandler.seen_forwarded == ["follower-minted-id"]
+        # ...and the chain settles on that single id
+        assert client.last_request_id == "follower-minted-id"
+
+    def test_echo_mismatch_fails_loudly(self):
+        _RedirectingHandler.adopt = False  # leader mints its own id
+        client = JobClient(self.follower_url, user="alice")
+        with pytest.raises(JobClientError) as exc:
+            client.query([])
+        assert exc.value.status == 502
+        assert "echo mismatch" in str(exc.value)
+
+    def test_real_servers_keep_one_id_across_redirect(self, tmp_path):
+        store = Store()
+        leader_api = CookApi(store)
+        leader_srv = ApiServer(leader_api)
+        leader_srv.start()
+        elector = FileLeaderElector(tmp_path / "lock", leader_srv.url)
+        elector.campaign()
+        wait_until(lambda: elector.is_leader)
+        follower_srv = ApiServer(CookApi(Store(), elector=elector,
+                                         node_url="http://f"))
+        follower_srv.start()
+        try:
+            client = JobClient(follower_srv.url, user="alice")
+            uuid = client.submit_one("echo hi")
+            assert store.job(uuid) is not None
+            assert client.last_request_id
+        finally:
+            follower_srv.stop()
+            leader_srv.stop()
+            elector.resign()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the follower health roll-up carries its read-view block
+# ---------------------------------------------------------------------------
+
+class TestFollowerHealth:
+    def _api(self, age_ms):
+        cfg = Config()
+        cfg.fleet.staleness_red_line_seconds = 5.0
+        api = CookApi(Store(), config=cfg)
+        api.read_view = _FakeReadView(age_ms)
+        api.follower_reads = 12
+        return api
+
+    def test_fresh_follower_reports_role_and_read_view(self):
+        health = self._api(age_ms=100.0).debug_health()
+        assert health["role"] == "follower"
+        assert health["leader"] is False  # back-compat bool kept
+        assert health["read_view"]["reads_served"] == 12
+        assert health["read_view"]["age_ms"] == 100.0
+        assert health["healthy"] is True
+        assert 0.0 < health["saturation"]["follower_staleness"] < 1.0
+
+    def test_stale_follower_is_unhealthy(self):
+        health = self._api(age_ms=60_000.0).debug_health()
+        assert health["saturation"]["follower_staleness"] == 1.0
+        assert health["healthy"] is False
+        assert "follower_staleness" in health["saturation_hot"]
+
+    def test_leader_health_has_role_and_saturation(self):
+        api = CookApi(Store())
+        health = api.debug_health()
+        assert health["role"] == "standby"  # no scheduler attached here
+        assert set(health["saturation"]) == {
+            "group_commit_queue", "follower_staleness", "cycle_p99",
+            "audit_queue", "launch_tokens", "journal_head"}
+
+
+# ---------------------------------------------------------------------------
+# the endpoint registry lint (docs/OBSERVABILITY.md endpoint table)
+# ---------------------------------------------------------------------------
+
+class TestEndpointRegistry:
+    def test_every_observability_route_is_documented(self):
+        from pathlib import Path
+        from cook_tpu.analysis.registry import (documented_endpoints,
+                                                harvest_endpoints)
+        root = Path(__file__).resolve().parent.parent
+        harvested = harvest_endpoints(root / "cook_tpu")
+        assert harvested  # the extractor actually sees API_ROUTES
+        assert {"/debug/fleet", "/debug/trace/spans",
+                "/metrics/fleet"} <= harvested
+        doc = (root / "docs" / "OBSERVABILITY.md").read_text()
+        missing = harvested - documented_endpoints(doc)
+        assert not missing, (
+            f"/debug endpoints missing from the OBSERVABILITY.md "
+            f"endpoint table: {sorted(missing)}")
