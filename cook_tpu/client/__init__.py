@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -47,6 +48,32 @@ class JobClientError(Exception):
         slow-request ring (GET /debug/requests) and the trace."""
         return self.body.get("request_id")
 
+    @property
+    def reason(self) -> Optional[str]:
+        """Machine-readable shed/throttle reason on an admission 429
+        ("rate-limited", "user-pending-cap", "brownout-shed", ...)."""
+        return self.body.get("reason")
+
+    @property
+    def scope(self) -> Optional[str]:
+        """Which limit rejected the request ("user", "ip", "global")."""
+        return self.body.get("scope")
+
+    @property
+    def retry_after_s(self) -> Optional[float]:
+        """The server's Retry-After advice in seconds, when it sent one
+        (admission 429s and 503s always do)."""
+        v = self.body.get("retry_after_s")
+        return float(v) if v is not None else None
+
+    @property
+    def throttled(self) -> bool:
+        """True for an admission rejection (HTTP 429).  Unlike an
+        indeterminate 504, a 429 means the server REFUSED the request
+        before touching state — the exact same request is safe to retry
+        verbatim after backing off (non-indeterminate by construction)."""
+        return self.status == 429
+
 
 class JobClient:
     def __init__(self, url: str, user: str = "anonymous",
@@ -77,6 +104,16 @@ class JobClient:
         # or hands the read to the leader — this client never reads a
         # state older than its own confirmed writes
         self.read_your_writes = read_your_writes
+        # overload etiquette (docs/ROBUSTNESS.md brownout ladder): how
+        # many times one request waits out a 429/503 Retry-After before
+        # surfacing the error.  0 disables the wait (the error carries
+        # retry_after_s for the caller's own pacing).  The wait is the
+        # server's advice bounded by a full-jitter backoff ladder, so a
+        # fleet of throttled clients desynchronizes instead of returning
+        # in one synchronized retry wave.
+        self.throttle_retries = 2
+        #: hard ceiling on a single honored Retry-After sleep
+        self.throttle_cap_s = 30.0
         self.last_commit_offset: Optional[str] = None
         # partitioned write plane (docs/DEPLOY.md): a partitioned
         # leader's token is a VECTOR of per-partition entries
@@ -259,12 +296,17 @@ class JobClient:
         # connection mid-failover must not surface as an error when a
         # jittered retry (utils/retry.py) would land on the new leader
         transient = None
+        from ..utils.retry import Backoff
         if method == "GET":
-            from ..utils.retry import Backoff
             transient = [2, Backoff(base_s=0.1, cap_s=1.0)]
-        # 6 hops: room for the transient-retry budget on top of the
-        # 307 leader-redirect chain
-        for _hop in range(6):  # follow leader redirects (307) incl. POST,
+        # admission throttling (429) / overload (503): the server's
+        # Retry-After is honored with full jitter — never a tight loop,
+        # never an unbounded sleep (see throttle_retries)
+        throttle = [max(0, int(self.throttle_retries)),
+                    Backoff(base_s=0.5, cap_s=self.throttle_cap_s)]
+        # 8 hops: room for the transient + throttle retry budgets on top
+        # of the 307 leader-redirect chain
+        for _hop in range(8):  # follow leader redirects (307) incl. POST,
             parsed = urllib.parse.urlsplit(url)
             target = (parsed.path or "/") \
                 + ("?" + parsed.query if parsed.query else "")
@@ -328,6 +370,30 @@ class JobClient:
                 except Exception:
                     err_body = {}
                     message = f"HTTP {resp.status}: {resp.reason}"
+                if resp.status in (429, 503):
+                    # surface the server's pacing advice on the error
+                    # even when the retry budget is spent
+                    ra = resp.getheader("Retry-After")
+                    try:
+                        advised = float(ra) if ra is not None else None
+                    except ValueError:
+                        advised = None
+                    if advised is not None:
+                        err_body.setdefault("retry_after_s", advised)
+                    if throttle[0] > 0 and advised is not None:
+                        throttle[0] -= 1
+                        # server advice, jittered and capped: sleep a
+                        # uniform draw over [0, advice] plus the ladder's
+                        # own jitter, bounded by throttle_cap_s and never
+                        # shorter than the ladder's first rung (a 429
+                        # with Retry-After: 0 must not tight-loop)
+                        delay = min(self.throttle_cap_s,
+                                    max(throttle[1].next_delay(),
+                                        random.uniform(0.0, advised)))
+                        time.sleep(delay)
+                        continue
+                if echoed_id:
+                    err_body.setdefault("request_id", echoed_id)
                 raise JobClientError(resp.status, message, body=err_body)
             break
         else:

@@ -655,6 +655,124 @@ class ElasticConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """Layered admission control + saturation-driven brownout (the
+    daemon's ``"admission"`` conf section inside ``"scheduler"``,
+    boot-validated like the sections around it).  The front door
+    (rest/api.py) token-buckets submissions per user and requests per
+    IP; the monitor-driven ``sched.admission.AdmissionController`` maps
+    the six ``cook_saturation`` gauges to a 0-1 admission level with
+    hysteresis (DAGOR-style feedback admission) and walks the brownout
+    ladder — observability detail sheds first, then reads degrade to
+    bounded-stale follower serves, then low-priority writes shed, and
+    committed writes + scheduling decisions never shed.  docs/DEPLOY.md
+    "overload runbook", docs/ROBUSTNESS.md "brownout ladder"."""
+
+    #: master switch: off = no submission buckets, no adaptive level,
+    #: no brownout (the pre-existing launch-rate tokens still apply)
+    enabled: bool = False
+    #: per-user submission token refill (jobs/minute); 0 = unlimited.
+    #: The ADAPTIVE level scales this down under pressure.
+    submissions_per_minute: float = 0.0
+    #: per-user bucket size (burst); 0 = same as submissions_per_minute
+    submission_burst: float = 0.0
+    #: per-IP request refill for the serving plane; 0 = fall back to the
+    #: daemon's top-level ``ip_requests_per_minute`` knob (both feed the
+    #: same exemption list: /metrics, /debug/*, health probes never
+    #: rate-limit so observability survives the incident)
+    ip_requests_per_minute: float = 0.0
+    #: GLOBAL per-user pending-job cap enforced at submission across
+    #: partitions by riding the bounded UserSummaryExchange per-user
+    #: summaries (never job state); 0 = off
+    max_user_pending: int = 0
+    #: adaptive level floor: even fully saturated, this fraction of the
+    #: configured refill survives (never starve to a hard zero — the
+    #: metastable-failure guard: some traffic must drain to recover)
+    level_floor: float = 0.1
+    #: worst-gauge saturation above which the level starts declining
+    engage_saturation: float = 0.8
+    #: saturation below which the level recovers; the [release, engage)
+    #: band is the hysteresis dead zone (no flapping at the threshold)
+    release_saturation: float = 0.6
+    #: per-sweep level decrement at full pressure (scaled by how far the
+    #: worst gauge sits past the engage threshold)
+    decrease_step: float = 0.2
+    #: per-sweep level increment while below the release threshold
+    #: (recovery is gradual so admitted load ramps, not steps)
+    recover_step: float = 0.05
+    #: brownout ladder thresholds on the admission level, strictly
+    #: descending: stage 1 (advisory observability detail sheds: audit
+    #: advisory-flush folds, slow-ring capture off) ...
+    observability_shed_level: float = 0.75
+    #: ... stage 2 (follower reads serve bounded-stale: relaxed
+    #: min-offset gate, honest X-Cook-Replication-Age-Ms) ...
+    stale_reads_level: float = 0.5
+    #: ... stage 3 (low-priority writes shed with 429).  Committed
+    #: writes and scheduling decisions degrade last or never.
+    shed_writes_level: float = 0.25
+    #: recovery dwell: the level must hold ABOVE a stage's threshold
+    #: this long before the stage steps back down (escalation is
+    #: immediate; de-escalation is damped)
+    stage_hold_seconds: float = 10.0
+    #: stage 3 sheds submissions whose every job has priority below this
+    shed_priority_below: int = 50
+    #: stage >= 2: the follower's min-offset wait gate shrinks to this
+    #: fraction of serving.min_offset_wait_seconds (bounded-stale serves
+    #: stop queueing reads behind replication under overload)
+    relaxed_offset_wait_factor: float = 0.1
+
+    def __post_init__(self):
+        for k in ("submissions_per_minute", "submission_burst",
+                  "ip_requests_per_minute", "stage_hold_seconds"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"admission {k} must be >= 0")
+        if not isinstance(self.max_user_pending, int) \
+                or self.max_user_pending < 0:
+            raise ValueError("admission max_user_pending must be an "
+                             f"int >= 0, got {self.max_user_pending!r}")
+        if not isinstance(self.shed_priority_below, int):
+            raise ValueError("admission shed_priority_below must be an "
+                             f"int, got {self.shed_priority_below!r}")
+        if not (0.0 <= self.level_floor < 1.0):
+            raise ValueError("admission level_floor must be in [0, 1)")
+        if not (0.0 < self.release_saturation < self.engage_saturation
+                <= 1.0):
+            raise ValueError(
+                "admission thresholds must satisfy 0 < "
+                "release_saturation < engage_saturation <= 1, got "
+                f"{self.release_saturation!r} / {self.engage_saturation!r}")
+        for k in ("decrease_step", "recover_step"):
+            if not (0.0 < float(getattr(self, k)) <= 1.0):
+                raise ValueError(f"admission {k} must be in (0, 1]")
+        if not (0.0 < self.shed_writes_level < self.stale_reads_level
+                < self.observability_shed_level < 1.0):
+            raise ValueError(
+                "admission brownout levels must be strictly descending "
+                "in (0, 1): observability_shed_level > stale_reads_level "
+                "> shed_writes_level")
+        if not (0.0 <= self.relaxed_offset_wait_factor <= 1.0):
+            raise ValueError(
+                "admission relaxed_offset_wait_factor must be in [0, 1]")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "AdmissionConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown admission key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"admission key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -764,6 +882,10 @@ class Config:
     # elastic-gang resize plane (sched/elastic.py, docs/GANG.md
     # elasticity): grace-shrink protocol + optimizer-set budgets
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    # layered admission control + saturation-driven brownout
+    # (sched/admission.py, policy/rate_limit.py; docs/DEPLOY.md
+    # "overload runbook")
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     # the real optimizer loop (sched/optimizer.py): a
     # ``sched.optimizer.OptimizerConfig`` when the daemon's "optimizer"
     # conf section enables it, else None (loop off).  Held untyped here

@@ -166,6 +166,15 @@ def build_scheduler_config(spec: Dict) -> Config:
         # like the sections above
         from .config import FleetConfig
         cfg.fleet = FleetConfig.from_conf(spec["fleet"])
+    if "admission" in spec:
+        # layered admission + brownout ladder (docs/ROBUSTNESS.md,
+        # docs/DEPLOY.md overload runbook): per-user/per-IP buckets,
+        # the adaptive level's hysteresis band, and the stage
+        # thresholds are ALL validated at boot — a typo'd knob or an
+        # out-of-order ladder must fail here, not during the first
+        # overload it was configured to survive
+        from .config import AdmissionConfig
+        cfg.admission = AdmissionConfig.from_conf(spec["admission"])
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

@@ -16,4 +16,5 @@ from .rate_limit import (  # noqa: F401
     TokenBucketRateLimiter,
     UnlimitedRateLimiter,
     pool_user_key,
+    submission_limiter,
 )

@@ -37,6 +37,29 @@ class TokenBucketRateLimiter:
         self._clock = clock
         self._buckets: Dict[str, _Bucket] = {}
         self._lock = threading.Lock()
+        # adaptive-admission handle (sched/admission.py): the effective
+        # refill rate is tokens_per_minute * refill_scale.  Replenishment
+        # is lazy, so a scale change applies from the NEXT refresh on —
+        # tokens already earned are never clawed back.
+        self._refill_scale = 1.0
+
+    @property
+    def refill_scale(self) -> float:
+        return self._refill_scale
+
+    def set_refill_scale(self, scale: float) -> None:
+        """Scale the refill rate by ``scale`` in [0, 1] (the admission
+        level).  Buckets are refreshed lazily, so tokens accrued under
+        the old scale stay earned; only future replenishment slows."""
+        with self._lock:
+            # settle every bucket at the OLD rate first so the scale
+            # change is not applied retroactively to elapsed time
+            for key in list(self._buckets):
+                self._refresh(key)
+            self._refill_scale = min(max(float(scale), 0.0), 1.0)
+
+    def _effective_rate(self) -> float:
+        return self.tokens_per_minute * self._refill_scale
 
     def _refresh(self, key: str) -> _Bucket:
         now = self._clock()
@@ -45,7 +68,8 @@ class TokenBucketRateLimiter:
             bucket = _Bucket(tokens=self.bucket_size, last_update_s=now)
             self._buckets[key] = bucket
         else:
-            earned = (now - bucket.last_update_s) / 60.0 * self.tokens_per_minute
+            earned = (now - bucket.last_update_s) / 60.0 \
+                * self._effective_rate()
             bucket.tokens = min(self.bucket_size, bucket.tokens + earned)
             bucket.last_update_s = now
         return bucket
@@ -108,9 +132,26 @@ class TokenBucketRateLimiter:
     def time_until_out_of_debt_s(self, key: str) -> float:
         with self._lock:
             tokens = self._refresh(key).tokens
+            rate = self._effective_rate()
         if tokens >= 0:
             return 0.0
-        return -tokens / self.tokens_per_minute * 60.0
+        if rate <= 0:
+            return float("inf")
+        return -tokens / rate * 60.0
+
+    def retry_after_s(self, key: str, n: float = 1.0) -> float:
+        """Seconds until ``key`` can afford ``n`` tokens at the current
+        (scaled) refill rate — the honest ``Retry-After`` value for an
+        admission 429.  Infinite when the scaled rate is zero."""
+        with self._lock:
+            tokens = self._refresh(key).tokens
+            rate = self._effective_rate()
+        short = n - tokens
+        if short <= 0:
+            return 0.0
+        if rate <= 0:
+            return float("inf")
+        return short / rate * 60.0
 
     def flush(self) -> None:
         with self._lock:
@@ -136,6 +177,7 @@ class UnlimitedRateLimiter:
     """The no-op limiter used when a plane is unconfigured."""
 
     enforce = False
+    refill_scale = 1.0
 
     def get_token_count(self, key: str) -> float:
         return float("inf")
@@ -146,7 +188,17 @@ class UnlimitedRateLimiter:
     def within_limit(self, key: str) -> bool:
         return True
 
+    def try_spend(self, key: str, n: float = 1.0,
+                  max_keys: int = 65536) -> bool:
+        return True
+
+    def set_refill_scale(self, scale: float) -> None:
+        pass
+
     def time_until_out_of_debt_s(self, key: str) -> float:
+        return 0.0
+
+    def retry_after_s(self, key: str, n: float = 1.0) -> float:
         return 0.0
 
     def flush(self) -> None:
@@ -158,6 +210,21 @@ class UnlimitedRateLimiter:
 
 def pool_user_key(pool: str, user: str) -> str:
     return f"{pool}/{user}"
+
+
+def submission_limiter(admission_conf, clock=time.monotonic):
+    """Build the submission-side per-user limiter from an
+    ``config.AdmissionConfig`` (rest/api.py front door).  Unconfigured
+    (disabled, or refill 0) -> the no-op limiter, matching the other
+    planes' unconfigured behavior."""
+    if admission_conf is None or not getattr(admission_conf, "enabled",
+                                             False):
+        return UnlimitedRateLimiter()
+    rate = float(getattr(admission_conf, "submissions_per_minute", 0.0))
+    if rate <= 0:
+        return UnlimitedRateLimiter()
+    burst = float(getattr(admission_conf, "submission_burst", 0.0)) or rate
+    return TokenBucketRateLimiter(rate, burst, enforce=True, clock=clock)
 
 
 @dataclass

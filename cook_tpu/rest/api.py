@@ -688,6 +688,29 @@ class CookApi:
             self.ip_limiter = TokenBucketRateLimiter(
                 tokens_per_minute=float(ip_requests_per_minute),
                 bucket_size=float(ip_requests_per_minute))
+        # layered admission front door (config.AdmissionConfig +
+        # sched/admission.py): the admission section can supply both the
+        # per-IP bucket (when the daemon-level knob is absent) and the
+        # per-user submission bucket; the scheduler's AdmissionController
+        # — when one exists — gets handles to BOTH so the adaptive level
+        # scales their refill rates under pressure
+        ac = self.config.admission
+        if ac.enabled:
+            from ..policy.rate_limit import (TokenBucketRateLimiter,
+                                             submission_limiter)
+            if self.ip_limiter is None and ac.ip_requests_per_minute > 0:
+                self.ip_limiter = TokenBucketRateLimiter(
+                    tokens_per_minute=ac.ip_requests_per_minute,
+                    bucket_size=ac.ip_requests_per_minute)
+            if not getattr(self.rate_limits.job_submission, "enforce",
+                           False):
+                self.rate_limits.job_submission = submission_limiter(ac)
+        ctrl = scheduler.admission if scheduler is not None else None
+        if ctrl is not None:
+            ctrl.rate_limits = self.rate_limits
+            if self.ip_limiter is not None:
+                ctrl.ip_limiter = self.ip_limiter
+            ctrl._apply_level()
         self.incremental = IncrementalConfig()
         # HTTP-basic verification (reference: basic_auth.clj). None = "open"
         # mode: the username is taken from Basic/X-Cook-User unverified.
@@ -728,6 +751,38 @@ class CookApi:
             return url
         return None
 
+    # ------------------------------------------------------- admission
+    def admission_controller(self):
+        return self.scheduler.admission if self.scheduler is not None \
+            else None
+
+    def brownout_stage(self) -> int:
+        """The brownout stage this node acts on: the live controller on
+        the leader; on followers, the journaled dynamic-config document
+        replicated into the read view's mirror (stage flips ride
+        ordinary ``"w"`` records, so standbys see them at replication
+        latency)."""
+        ctrl = self.admission_controller()
+        if ctrl is not None:
+            return ctrl.stage
+        from ..sched.admission import stage_from_store
+        rv = self.read_view
+        rv_store = getattr(rv, "store", None) if rv is not None else None
+        if rv_store is not None:
+            return stage_from_store(rv_store)
+        return stage_from_store(self.store)
+
+    def admission_state(self) -> Dict:
+        """The /debug/health "admission" block on ANY role."""
+        ctrl = self.admission_controller()
+        if ctrl is not None:
+            return ctrl.state()
+        from ..sched.admission import STAGE_NAMES
+        stage = self.brownout_stage()
+        return {"enabled": bool(self.config.admission.enabled),
+                "level": None, "stage": stage,
+                "stage_name": STAGE_NAMES[stage]}
+
     # ------------------------------------------------------------------ auth
     def require_admin(self, user: str, message: Optional[str] = None) -> None:
         # an impersonator acting AS an admin may not reach admin endpoints
@@ -753,15 +808,72 @@ class CookApi:
         return auth_user
 
     # ---------------------------------------------------------------- routes
+    def _admit_submission(self, specs: List[Dict], user: str,
+                          idempotent: bool = False) -> None:
+        """The submission front door (ISSUE 17 overload ladder): every
+        rejection is a 429 with a machine-readable ``reason`` +
+        ``scope`` in the body and an honest ``Retry-After`` header, so
+        clients back off instead of stampeding.  Order: brownout write
+        shed (cheapest, and the explicit overload gate) -> per-user
+        token bucket (refill scaled by the admission level) -> GLOBAL
+        per-user pending cap off the bounded summary exchange."""
+        from ..utils.metrics import registry
+
+        def _reject(reason: str, scope: str, message: str,
+                    retry_s: float) -> None:
+            registry.counter_inc("cook_admission_rejections", 1.0,
+                                 {"scope": scope, "reason": reason})
+            retry = max(1, min(int(retry_s) + 1, 3600))
+            raise ApiError(429, message,
+                           extra={"reason": reason, "scope": scope},
+                           headers={"Retry-After": str(retry)})
+
+        ac = self.config.admission
+        if ac.enabled and self.brownout_stage() >= 3:
+            # stage 3 sheds LOW-PRIORITY writes only; a batch with any
+            # at-or-above-threshold job rides the committed-write path,
+            # which never sheds
+            if all(int(s.get("priority", 50)) < ac.shed_priority_below
+                   for s in specs):
+                _reject("brownout-shed", "user",
+                        "the cluster is shedding low-priority writes "
+                        "under overload (brownout stage 3); retry "
+                        "later or raise job priority",
+                        ac.stage_hold_seconds)
+        rl = self.rate_limits.job_submission
+        if rl.enforce and rl.get_token_count(user) < len(specs):
+            _reject("rate-limited", "user",
+                    "job submission rate limit exceeded",
+                    rl.retry_after_s(user, len(specs)))
+        if ac.enabled and ac.max_user_pending > 0 and not idempotent:
+            # idempotent retries are exempt: their jobs may already be
+            # journaled and counted by the summaries — charging them
+            # again would strand a user at cap unable to heal an
+            # ambiguous submission (same principle as the quota gate)
+            # GLOBAL pending cap, partitions included: the bounded
+            # per-user summary exchange (state/partition.py) is the only
+            # cross-partition signal — counts, never job state.  A
+            # single store answers from its own summary.
+            summaries = getattr(self.store, "summaries", None)
+            if summaries is not None:
+                pending = summaries.user_totals(str(user))["pending"]
+            else:
+                u = self.store.user_summary().get(str(user))
+                pending = u["pending"] if u else 0.0
+            if pending + len(specs) > ac.max_user_pending:
+                _reject("user-pending-cap", "global",
+                        f"user {user} has {int(pending)} pending jobs; "
+                        f"admitting {len(specs)} more would exceed the "
+                        f"global cap of {ac.max_user_pending}",
+                        ac.stage_hold_seconds)
+
     def submit_jobs(self, body: Dict, user: str) -> Dict:
         specs = body.get("jobs", [])
         if not specs:
             raise ApiError(400, "no jobs to submit")
         pool_override = body.get("pool")
-        # submission rate limit (per user)
-        rl = self.rate_limits.job_submission
-        if rl.enforce and rl.get_token_count(user) < len(specs):
-            raise ApiError(429, "job submission rate limit exceeded")
+        self._admit_submission(specs, user,
+                               idempotent=bool(body.get("idempotent")))
         jobs = []
         # request trace context (the http.request ingress span, itself
         # parented under a client-sent traceparent): stamped on every job
@@ -976,7 +1088,7 @@ class CookApi:
                 self.store.commit_latch(latch)
             except ReplicationIndeterminate as e:
                 raise _indeterminate(e)
-        rl.spend(user, len(specs))
+        self.rate_limits.job_submission.spend(user, len(specs))
         return {"jobs": all_uuids}
 
     def get_jobs(self, params: Dict) -> List[Dict]:
@@ -1863,6 +1975,10 @@ class CookApi:
             "saturation_hot": sorted(r for r, v in saturation.items()
                                      if v >= red_line),
             "slo_burn_rates": series("cook_slo_burn_rate"),
+            # overload ladder state (sched/admission.py): the adaptive
+            # admission level, the brownout stage + recent flips on a
+            # leader; followers report the journaled stage they act on
+            "admission": self.admission_state(),
             "breakers": breakers.states(),
             "replication": {
                 k: repl.get(k)
@@ -1876,7 +1992,8 @@ class CookApi:
                 None),
             "resident_repacks": series("cook_resident_repack"),
             "audit": {k: v for k, v in self.store.audit.stats().items()
-                      if k in ("jobs", "pending_durable")},
+                      if k in ("jobs", "pending_durable",
+                               "shed_advisory", "shed_count")},
             "http": self.request_obs.snapshot(limit=0)["totals"],
             # lock-order sanitizer (utils/locks.py, docs/ANALYSIS.md):
             # the observed acquisition-graph edge set + violation counts
@@ -2481,20 +2598,54 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
         self._bytes_out = len(data)
 
+    # paths the front door NEVER rate-limits (ISSUE 17 / docs/DEPLOY.md
+    # overload runbook): the observability and health surfaces must
+    # survive the very incident that trips the limiter — an operator
+    # locked out of /metrics and /debug/* mid-overload is flying blind
+    @staticmethod
+    def _admission_exempt(path: str) -> bool:
+        # NOT /info: it has been IP-throttled since the limiter shipped
+        # and is cheap to re-probe; the exemption exists for the surfaces
+        # an operator needs DURING the stampede (/debug/health et al.)
+        return (path in ("/metrics", "/metrics/fleet",
+                         "/failure_reasons", "/settings")
+                or path.startswith("/debug"))
+
     def _check_ip_limit(self) -> bool:
         """Admit or 429 this request per the client-IP bucket (covers
         every verb incl. OPTIONS — the reference's middleware wraps the
         whole handler).  try_spend is atomic: a full token per request,
-        so the fractional refill trickle never admits a burst."""
+        so the fractional refill trickle never admits a burst.
+        Observability/health paths are exempt (_admission_exempt)."""
         limiter = self.api.ip_limiter
-        ip = self.client_address[0]
-        if limiter is None or limiter.try_spend(ip):
+        if limiter is None:
             return True
+        path = urllib.parse.urlparse(self.path).path
+        if self._admission_exempt(path):
+            return True
+        ip = self.client_address[0]
+        if limiter.try_spend(ip):
+            return True
+        from ..utils.metrics import registry
+        registry.counter_inc("cook_admission_rejections", 1.0,
+                             {"scope": "ip", "reason": "rate-limited"})
         # one token's worth of refill is when the next request can pass
-        retry_s = max(1, int(60.0 / max(limiter.tokens_per_minute, 1e-9))
-                      + int(limiter.time_until_out_of_debt_s(ip)))
+        rate = limiter.tokens_per_minute * getattr(limiter, "refill_scale",
+                                                   1.0)
+        retry_s = max(1, int(60.0 / max(rate, 1e-9))
+                      + int(min(limiter.time_until_out_of_debt_s(ip),
+                                3600.0)))
+        # minted lazily: verbs that gate on the IP bucket before _route
+        # (OPTIONS) reject before the request id would normally be set
+        rid = getattr(self, "_request_id", None) \
+            or self.headers.get("X-Cook-Request-Id") \
+            or uuidlib.uuid4().hex[:16]
+        self._request_id = rid
         self._respond(429, {"error": "too many requests from this "
-                                     "address"},
+                                     "address",
+                            "reason": "rate-limited",
+                            "scope": "ip",
+                            "request_id": rid},
                       extra_headers={"Retry-After": str(retry_s)})
         return False
 
@@ -2568,11 +2719,48 @@ class _Handler(BaseHTTPRequestHandler):
                 objective_s=self.api.config.slo
                 .endpoint_latency_objective_s)
 
+    def _drained_bucket_reject(self) -> bool:
+        """Ingress fast path for the stampede case (DAGOR: reject at
+        the cheapest possible layer): a user whose submission bucket is
+        fully drained cannot admit ANY batch — every batch needs at
+        least one token — so answer the 429 before the body is parsed.
+        A stampeding client then costs the server one header parse and
+        a raw body drain, not a JSON decode + validation pass; the
+        saved CPU is exactly the goodput retained under overload
+        (bench.py ``overload`` leg).  Behavior-equivalent to the
+        ``_admit_submission`` bucket check, just earlier and cheaper:
+        a non-empty bucket falls through to the full front door."""
+        rl = self.api.rate_limits.job_submission
+        if not getattr(rl, "enforce", False):
+            return False
+        user = str(self._auth_user or "")
+        if rl.get_token_count(user) > 0:
+            return False
+        from ..utils.metrics import registry
+        registry.counter_inc("cook_admission_rejections", 1.0,
+                             {"scope": "user", "reason": "rate-limited"})
+        try:
+            leftover = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            leftover = 0
+        if leftover:
+            self.rfile.read(leftover)  # keep the keep-alive conn sound
+        retry = max(1, min(int(rl.retry_after_s(user, 1)) + 1, 3600))
+        self._respond(429, {"error": "job submission rate limit "
+                                     "exceeded",
+                            "reason": "rate-limited", "scope": "user",
+                            "request_id": self._request_id},
+                      extra_headers={"Retry-After": str(retry)})
+        return True
+
     def _handle(self, method: str, parsed) -> None:
         try:
             if not self._check_ip_limit():
                 return
             self._auth_user = self._authenticate()
+            if method == "POST" and parsed.path == "/jobs" \
+                    and self._drained_bucket_reject():
+                return
             params = urllib.parse.parse_qs(parsed.query)
             payload = self._dispatch(method, parsed.path, params)
             if method in ("POST", "PUT", "DELETE") \
@@ -2671,6 +2859,18 @@ class _Handler(BaseHTTPRequestHandler):
         leader) and attach the staleness contract headers."""
         api = self.api
         rv = api.read_view
+        # brownout stage >= 2 (sched/admission.py, journaled by the
+        # leader and replicated into this mirror): the min-offset wait
+        # gate RELAXES — reads stop queueing behind replication under
+        # overload and serve bounded-stale instead.  The staleness
+        # contract stays honest: the real age rides the response
+        # headers, an unsatisfiable token still redirects (read-your-
+        # writes is never faked), and the degrade is visible via
+        # X-Cook-Brownout.
+        brownout = api.brownout_stage() >= 2
+        wait_s = api.config.serving.min_offset_wait_seconds
+        if brownout:
+            wait_s *= api.config.admission.relaxed_offset_wait_factor
         want = self.headers.get("X-Cook-Min-Offset")
         if want is not None:
             # vector-aware gate (the partitioned plane's token form —
@@ -2679,13 +2879,10 @@ class _Handler(BaseHTTPRequestHandler):
             gate = getattr(rv, "wait_commit_token", None)
             try:
                 if gate is not None:
-                    ok = gate(want,
-                              api.config.serving.min_offset_wait_seconds)
+                    ok = gate(want, wait_s)
                 else:
                     ep, off = self._parse_min_offset(want)
-                    ok = rv.wait_token(
-                        ep, off,
-                        api.config.serving.min_offset_wait_seconds)
+                    ok = rv.wait_token(ep, off, wait_s)
             except ValueError:
                 raise ApiError(400, "malformed X-Cook-Min-Offset")
             if not ok:
@@ -2697,6 +2894,8 @@ class _Handler(BaseHTTPRequestHandler):
         api.follower_reads += 1
         from ..utils.metrics import registry
         registry.counter_inc("cook_follower_reads")
+        if brownout:
+            self._resp_headers["X-Cook-Brownout"] = "stale-reads"
         self._resp_headers["X-Cook-Replication-Offset"] = str(rv.offset)
         self._resp_headers["X-Cook-Replication-Age-Ms"] = \
             str(round(rv.age_ms(), 1))

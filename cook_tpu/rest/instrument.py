@@ -147,6 +147,11 @@ class RequestObserver:
                  slow_ms: float = 500.0):
         self._lock = threading.Lock()
         self.enabled = True
+        #: brownout stage >= 1 (sched/admission.py) turns the capture
+        #: rings off — RED metrics and SLO windows keep flowing (the
+        #: signal the incident is diagnosed with must survive the
+        #: incident); only the per-request detail records shed
+        self.capture = True
         self.slow_ms = float(slow_ms)
         self._recent: deque = deque(maxlen=recent)
         self._slow: deque = deque(maxlen=slow)
@@ -222,9 +227,10 @@ class RequestObserver:
         with self._lock:
             self._inflight -= 1
             n = self._inflight
-            self._recent.append(record)
-            if record["duration_ms"] >= self.slow_ms:
-                self._slow.append(record)
+            if self.capture:
+                self._recent.append(record)
+                if record["duration_ms"] >= self.slow_ms:
+                    self._slow.append(record)
             win = self._slo_window.setdefault(endpoint, [0, 0])
             win[0] += 1
             if objective_s is not None and duration_s > objective_s:
@@ -254,8 +260,8 @@ class RequestObserver:
                       "phases_s": {k: round(v, 6) for k, v
                                    in self._phase_totals.items()},
                       "inflight": self._inflight}
-        return {"slow_threshold_ms": self.slow_ms, "recent": recent,
-                "slow": slow, "totals": totals}
+        return {"slow_threshold_ms": self.slow_ms, "capture": self.capture,
+                "recent": recent, "slow": slow, "totals": totals}
 
     def drain_slo_window(self) -> Dict[str, Tuple[int, int]]:
         """Per-endpoint (requests, over-objective) since the last drain —

@@ -99,6 +99,33 @@ class Matcher:
         # scheduler): meters grow admissions of satisfied elastic gangs
         # by the optimizer's per-pool budget.  None = unmetered growth.
         self.elastic = None
+        # adaptive-admission controller (sched/admission.py, set by the
+        # scheduler): its 0-1 level also scales the considerable window,
+        # so a browned-out cell stops paying full-queue match work.
+        # None = no admission throttle.
+        self.admission = None
+
+    def admission_limit(self, pool_name: str, ranked: List[Job],
+                        limit: int) -> int:
+        """Scale the considerable window by the admission level and
+        attribute the cut (bounded by the window, never [T]-sized) as
+        ``admission-throttled`` skips — `cs why` answers "why is my job
+        waiting" during brownout from the same audit lane as every
+        other throttle."""
+        ctrl = self.admission
+        if ctrl is None or ctrl.level >= 1.0 or limit <= 1:
+            return limit
+        scaled = max(1, int(limit * ctrl.level))
+        if scaled < limit:
+            cut = ranked[scaled:limit]
+            if cut:
+                from ..utils import audit as _audit
+                _audit.note_skips(self.store.audit, {
+                    "admission-throttled": [
+                        (j.uuid, {"level": round(ctrl.level, 3),
+                                  "stage": ctrl.stage})
+                        for j in cut]}, pool=pool_name)
+        return scaled
 
     # ------------------------------------------------------------ selection
     def considerable_jobs(self, pool_name: str, ranked: List[Job],
@@ -461,9 +488,10 @@ class Matcher:
         backoff = self._backoff.setdefault(
             pool_name, _BackoffState(mc.max_jobs_considered))
         result = MatchCycleResult()
-        considerable = self.considerable_jobs(
+        limit = self.admission_limit(
             pool_name, ranked, min(backoff.num_considerable,
                                    mc.max_jobs_considered))
+        considerable = self.considerable_jobs(pool_name, ranked, limit)
         result.considered = len(considerable)
         # per-job rank attribution for the admitted candidates (bounded
         # by the considerable cap): queue position this cycle + the

@@ -120,6 +120,11 @@ class Monitor:
         self.rate_limits = None
         self.read_view = None
         self.fleet = None
+        # adaptive-admission control loop (sched/admission.py): the
+        # scheduler wires its AdmissionController in when the admission
+        # section enables it; each sweep's saturation gauges feed ONE
+        # decide() step.  None = no adaptive admission (default).
+        self.admission = None
         # (pool, state) -> {user -> stats} from the previous sweep, so
         # series for vanished users can be zeroed
         self._previous: Dict[Tuple[str, str], Dict[str, Dict]] = {}
@@ -166,7 +171,14 @@ class Monitor:
         self._sweep_cycle_slo()
         self._sweep_http_slo()
         self._sweep_serving()
-        self._sweep_saturation()
+        saturation = self._sweep_saturation()
+        admission = self.admission
+        if admission is not None:
+            # the adaptive-admission control loop runs at the sweep
+            # cadence off the SAME saturation computation the gauges
+            # publish — the operator's dashboard and the controller can
+            # never disagree about the input signal
+            admission.decide(saturation)
         fleet = self.fleet
         if fleet is not None:
             # monitor-driven federation (sched/fleet.py): the scraper
@@ -175,17 +187,18 @@ class Monitor:
             fleet.maybe_scrape()
         return out
 
-    def _sweep_saturation(self) -> None:
+    def _sweep_saturation(self) -> Dict[str, float]:
         """The derived 0-1 saturation layer (sched/fleet.py formulas):
         recomputed from live counters each sweep and published as
         ``cook_saturation{resource=}`` — the admission-control input
-        contract, also surfaced on /debug/health + /debug/fleet."""
+        contract (sched/admission.py consumes the returned dict), also
+        surfaced on /debug/health + /debug/fleet."""
         from .fleet import compute_saturation, publish_saturation
-        publish_saturation(
-            compute_saturation(self.config, store=self.store,
-                               read_view=self.read_view,
-                               rate_limits=self.rate_limits),
-            self.registry)
+        saturation = compute_saturation(self.config, store=self.store,
+                                        read_view=self.read_view,
+                                        rate_limits=self.rate_limits)
+        publish_saturation(saturation, self.registry)
+        return saturation
 
     def _sweep_serving(self) -> None:
         """Leader serving-plane gauges: the journal commit position (the
@@ -235,8 +248,13 @@ class Monitor:
     def _sweep_pool(self, pool) -> Dict[str, int]:
         from ..state.schema import DruMode
         pool_name = pool.name
-        pending = self.store.pending_jobs(pool_name)
-        running = self.store.running_instances(pool_name)
+        # clone=False: the sweep only READS (user, resources, wait
+        # ages) to fold into gauges — cloning 20k+ jobs per sweep was
+        # most of the sweep's cost, and a monitor that burns half a
+        # core under queue pressure is feeding the very saturation it
+        # reports (store.jobs_where contract)
+        pending = self.store.pending_jobs(pool_name, clone=False)
+        running = self.store.running_instances(pool_name, clone=False)
         running_stats = _job_stats([
             (job.user, job.resources.cpus, job.resources.mem)
             for job, _inst in running])

@@ -40,7 +40,7 @@ from ..state.schema import (
 from ..state.store import AbortTransaction, Store
 from ..utils import tracing
 from ..utils.flight import recorder as flight_recorder
-from .matcher import MatchCycleResult, Matcher
+from .matcher import MatchCycleResult, Matcher, _BackoffState
 from .ranker import Ranker
 from .rebalancer import Rebalancer
 
@@ -92,6 +92,19 @@ class Scheduler:
         # launch-token saturation input (sched/fleet.py): the sweep
         # reads the same buckets the matcher admits against
         self.monitor.rate_limits = self.rate_limits
+        # adaptive admission + brownout ladder (sched/admission.py):
+        # leader-only — the controller recovers any journaled brownout
+        # stage at construction, and each monitor sweep feeds it the
+        # saturation gauges.  None when the section is disabled.
+        self.admission = None
+        if self.config.admission.enabled:
+            from .admission import AdmissionController
+            self.admission = AdmissionController(
+                store, self.config, rate_limits=self.rate_limits)
+            self.monitor.admission = self.admission
+            # head-of-queue scaleback: the matcher shrinks its
+            # considerable window by the admission level under pressure
+            self.matcher.admission = self.admission
         from .heartbeat import HeartbeatTracker
         self.heartbeats = HeartbeatTracker(self.config.heartbeat_timeout_ms)
         # Heartbeat stamps and reaper sweeps follow the store's injectable
@@ -918,16 +931,27 @@ class Scheduler:
         capacity and let the backend place (scheduler.clj:1728-1771)."""
         result = MatchCycleResult()
         clusters = self.launchable_clusters(pool_name)
-        mc_cap = self.config.matcher_for_pool(pool_name).max_jobs_considered
+        mc = self.config.matcher_for_pool(pool_name)
+        # the fused path's head-of-queue scaleback + admission scaling
+        # apply here too: an unmatchable head job shrinks the window,
+        # and a brownout shrinks it further (scheduler.clj:1613-1651)
+        backoff = self.matcher._backoff.setdefault(
+            pool_name, _BackoffState(mc.max_jobs_considered))
+        window = self.matcher.admission_limit(
+            pool_name, ranked,
+            min(backoff.num_considerable, mc.max_jobs_considered))
         if not clusters:
             # no launchable backend (none configured, or every breaker
             # open): the real demand must still be visible — a
             # capacity-of-zero truncation would report considered=0 /
             # unmatched=0 and hide the whole backlog for the outage
             considerable = self.matcher.considerable_jobs(
-                pool_name, ranked, mc_cap)
+                pool_name, ranked, window)
             result.considered = len(considerable)
             result.unmatched = considerable
+            # backend outage, not a head-of-queue problem: like the
+            # fused path's no-offers cycle, backoff state is untouched
+            result.head_matched = False
             from ..utils import audit as _audit
             _audit.note_skips(self.store.audit, {
                 "unmatched": [j.uuid for j in result.unmatched]},
@@ -935,7 +959,7 @@ class Scheduler:
             return result
         capacity = sum(c.max_launchable(pool_name) for c in clusters)
         considerable = self.matcher.considerable_jobs(
-            pool_name, ranked, min(capacity, mc_cap))
+            pool_name, ranked, min(capacity, window))
         result.considered = len(considerable)
         from ..policy import pool_user_key
         launch_rl = self.rate_limits.job_launch
@@ -980,6 +1004,11 @@ class Scheduler:
         # one batched intent-confirm for the cycle's direct launches (a
         # per-task clear would journal one transaction per job)
         self.store.clear_launch_intents(result.launched_task_ids)
+        launched = set(result.launched_job_uuids)
+        result.head_matched = bool(
+            considerable and considerable[0].uuid in launched)
+        if considerable:
+            backoff.update(mc, result.head_matched)
         from ..utils import audit as _audit
         _audit.note_skips(self.store.audit, {
             "unmatched": [j.uuid for j in result.unmatched],

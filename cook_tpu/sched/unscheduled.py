@@ -142,6 +142,21 @@ def job_reasons(store: Store, job: Job,
                             "data": {"detail": msg}})
 
     if scheduler is not None:
+        # admission brownout (sched/admission.py): under saturation the
+        # matcher's considerable window is scaled down by the admission
+        # level, so a job can be at the FRONT of its share and still wait
+        # — "cs why" must say so instead of "just waiting for its turn"
+        ctrl = getattr(scheduler, "admission", None)
+        if ctrl is not None and ctrl.level < 1.0:
+            reasons.append({
+                "reason": "The scheduler is throttling admissions while "
+                          "the cluster recovers from overload; fewer "
+                          "jobs are considered each cycle.",
+                "data": {"kind": "admission-throttled",
+                         "level": round(ctrl.level, 3),
+                         "stage": ctrl.stage,
+                         "stage_name": ctrl.state().get("stage_name"),
+                         "worst_resource": ctrl.worst_resource}})
         # launch rate limit
         rl = scheduler.rate_limits.job_launch
         if rl.enforce:

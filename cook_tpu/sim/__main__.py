@@ -93,6 +93,18 @@ def main(argv=None) -> int:
                         "fsync + replication ack round, and an ack "
                         "lost mid-batch must demux indeterminate to "
                         "every waiter — never a silent drop)")
+    p.add_argument("--overload", action="store_true",
+                   help="overload replay (sim/overload.py): heavy-tailed "
+                        "users at --overload-multiple x sustainable "
+                        "offered load with the admission controller in "
+                        "the loop; asserts the brownout ladder engages "
+                        "in shed order, recovers, and loses zero "
+                        "committed writes.  Combine with --chaos for a "
+                        "leader kill MID-BROWNOUT (the promoted "
+                        "controller must restore the journaled stage)")
+    p.add_argument("--overload-multiple", type=float, default=None,
+                   help="overload: offered load as a multiple of "
+                        "sustainable capacity (default 10)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -124,9 +136,19 @@ def main(argv=None) -> int:
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1
 
+    if args.overload and not args.chaos:
+        from .overload import run_overload
+        summary = run_overload(
+            offered_multiple=args.overload_multiple or 10.0,
+            seed=args.seed if args.seed is not None else 17)
+        print(json.dumps(summary, indent=2))
+        return 0 if summary["ok"] else 1
+
     if args.chaos:
         from .chaos import ChaosConfig, run_chaos
         cc = ChaosConfig(seed=args.seed or 0)
+        if args.overload:
+            cc.overload = True
         if args.jobs is not None:
             cc.n_jobs = args.jobs
         if args.n_hosts is not None:

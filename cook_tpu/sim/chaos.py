@@ -122,6 +122,17 @@ class ChaosConfig:
     # rebuild the resident pack from scratch on the successor's driver
     resident: bool = False
     delta_fault_probability: float = 0.0
+    # overload chaos (ISSUE 17, docs/ROBUSTNESS.md): run the admission
+    # controller in the loop — a small launch-token bucket on the
+    # virtual clock drives saturation genuinely, monitor sweeps run
+    # every tick, and the brownout ladder engages BEFORE the leader
+    # kill.  The invariant under test: the promoted leader's controller
+    # restores the journaled brownout stage (the flip rode the
+    # dynamic-config journal record), so a failover mid-brownout never
+    # resets the ladder to "everything open" under standing overload
+    overload: bool = False
+    overload_launch_rate_per_min: float = 30.0
+    overload_launch_burst: float = 2.0
 
 
 @dataclass
@@ -150,6 +161,10 @@ class ChaosResult:
     user_retries_charged: int = 0
     makespan_ms: int = 0
     flight: Dict = field(default_factory=dict)
+    # overload chaos: the ladder's state across the failover
+    brownout_stage_at_kill: int = -1
+    brownout_stage_recovered: int = -1
+    min_admission_level: float = 1.0
 
     @property
     def ok(self) -> bool:
@@ -176,6 +191,9 @@ class ChaosResult:
             "breaker_trips": self.breaker_trips,
             "user_retries_charged": self.user_retries_charged,
             "makespan_virtual_s": self.makespan_ms / 1000.0,
+            "brownout_stage_at_kill": self.brownout_stage_at_kill,
+            "brownout_stage_recovered": self.brownout_stage_recovered,
+            "min_admission_level": round(self.min_admission_level, 4),
             "flight": self.flight,
         }
 
@@ -207,6 +225,11 @@ def _scheduler_config(cc: ChaosConfig) -> Config:
     cfg.default_matcher.backend = "cpu"
     cfg.circuit_breaker.failure_threshold = cc.breaker_failure_threshold
     cfg.circuit_breaker.reset_timeout_s = cc.breaker_reset_timeout_s
+    if cc.overload:
+        # admission ladder in the loop (sched/admission.py), tuned so
+        # the stage flips land well before the leader kill
+        cfg.admission.enabled = True
+        cfg.admission.stage_hold_seconds = 4.0
     return cfg
 
 
@@ -295,7 +318,18 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     cluster.job_durations_ms = {
         j.uuid: int(j.labels["sim/duration_ms"])
         for j in list(trace) + gang_jobs}
-    scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+    # overload mode: a small launch-token bucket on the virtual clock is
+    # the genuine saturation driver the monitor sweep reads (the same
+    # RateLimits object survives the failover — token debt is leader
+    # memory, the journaled brownout STAGE is the durable part)
+    rate_limits = None
+    if cc.overload:
+        from ..policy import RateLimits, TokenBucketRateLimiter
+        rate_limits = RateLimits(job_launch=TokenBucketRateLimiter(
+            cc.overload_launch_rate_per_min, cc.overload_launch_burst,
+            enforce=True, clock=lambda: now_box[0] / 1000.0))
+    scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                          rate_limits=rate_limits)
 
     def check_single_live(when: str) -> None:
         live_by_job: Dict[str, int] = {}
@@ -388,6 +422,8 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
     def kill_leader_and_promote() -> None:
         nonlocal store, scheduler
         result.leader_kills += 1
+        stage_at_kill = (scheduler.admission.stage
+                         if scheduler.admission is not None else -1)
         # elastic: open a grace shrink RIGHT before the crash so the
         # kill window races the resize ledger (docs/GANG.md elasticity:
         # a shrink may be DELAYED by failover — the in-memory deadline
@@ -453,7 +489,14 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             # replay (the old process's in-memory trail died with it):
             # `cs why` on a pre-kill job must still show the lifecycle
             kinds = {e["kind"] for e in store.audit.timeline(probe_uuid)}
-            missing = {"submitted", "ranked", "launched"} - kinds
+            expect = {"submitted", "ranked", "launched"}
+            if cc.overload and stage_at_kill >= 1:
+                # brownout stage >= 1 sheds ADVISORY observability: the
+                # ranked lane's advisory flushes fold by design
+                # (utils/audit.py shed_advisory) — only the journal-
+                # transaction-backed kinds must survive the failover
+                expect = {"submitted", "launched"}
+            missing = expect - kinds
             if missing:
                 result.audit_timeline_ok = False
                 result.violations.append(
@@ -463,7 +506,21 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         store.clock = clock
         # the new leader adopts the (still-running) cluster and sweeps
         # the open launch intents in its constructor
-        scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        scheduler = Scheduler(store, cfg, [cluster], rank_backend="cpu",
+                              rate_limits=rate_limits)
+        if cc.overload:
+            # the promoted controller must RESTORE the journaled
+            # brownout stage (sched/admission.py restore()): a failover
+            # mid-brownout that reset the ladder would reopen every
+            # shed path under standing overload — the metastable trap
+            recovered = (scheduler.admission.stage
+                         if scheduler.admission is not None else -1)
+            result.brownout_stage_at_kill = stage_at_kill
+            result.brownout_stage_recovered = recovered
+            if recovered != stage_at_kill:
+                result.violations.append(
+                    f"promotion lost the brownout stage: was "
+                    f"{stage_at_kill} at kill, restored {recovered}")
         if racing_shrink is not None:
             # never half-applied: after promotion the victim is either
             # UNTOUCHED (ledger + deadline died with the leader — the
@@ -526,6 +583,14 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             scheduler.step_rank()
             scheduler.step_match()
         scheduler.step_reapers(current_ms=now)
+        if cc.overload:
+            # the production control loop: each sweep recomputes the
+            # saturation layer and steps the admission controller
+            scheduler.monitor.sweep()
+            if scheduler.admission is not None:
+                result.min_admission_level = min(
+                    result.min_admission_level,
+                    scheduler.admission.level)
         if cc.elastic:
             # a mid-run grace shrink well before the kill: the grace
             # deadline expires through step_resize ticks on the virtual
@@ -636,6 +701,11 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
             "final journal replay diverges from the live store")
 
     result.flight = flight_recorder.summary(since_seq=flight_seq0)
+    if cc.overload:
+        # the controller flips process-global planes (request-capture
+        # ring); a run ending mid-brownout must not leak the shed
+        from ..rest.instrument import request_log
+        request_log.capture = True
     store.close()
     injector.clear()
     breakers.reset()

@@ -115,7 +115,8 @@ class Simulator:
                  rank_interval_ms: int = 5000, match_interval_ms: int = 1000,
                  rebalance_interval_ms: int = 30000,
                  cycle_mode: Optional[str] = None,
-                 groups: Optional[Dict[str, object]] = None):
+                 groups: Optional[Dict[str, object]] = None,
+                 rate_limits=None):
         self.trace = trace
         # gang groups keyed by uuid (docs/GANG.md): members referencing
         # a group here are CO-SUBMITTED as one batch with the Group at
@@ -127,7 +128,17 @@ class Simulator:
         self.store = Store()
         self.cluster = FakeCluster("sim", hosts)
         self.scheduler = Scheduler(self.store, self.config, [self.cluster],
-                                   rank_backend=backend)
+                                   rank_backend=backend,
+                                   rate_limits=rate_limits)
+        # overload-replay hooks (sim/overload.py): ``admit`` gates each
+        # trace submission like the REST front door would (return False
+        # = shed, the uuid lands in ``shed_job_uuids`` instead of the
+        # store); ``on_tick`` runs once per loop iteration on the
+        # virtual clock (the overload harness drives monitor sweeps —
+        # and thus the admission controller — through it)
+        self.admit = None
+        self.on_tick = None
+        self.shed_job_uuids: List[str] = []
         self.rank_interval_ms = rank_interval_ms
         self.match_interval_ms = match_interval_ms
         self.rebalance_interval_ms = rebalance_interval_ms
@@ -170,6 +181,9 @@ class Simulator:
             # deliver submissions due now
             while pending and pending[0].submit_time_ms <= now:
                 job = pending.pop(0)
+                if self.admit is not None and not self.admit(job, now):
+                    self.shed_job_uuids.append(job.uuid)
+                    continue
                 self._job_durations[job.uuid] = int(
                     job.labels["sim/duration_ms"])
                 if job.group and job.group in self.groups:
@@ -214,6 +228,8 @@ class Simulator:
                         result.preemptions += len(d.victim_task_ids)
                 next_rebalance = now + self.rebalance_interval_ms
             self.scheduler.step_reapers(current_ms=now)
+            if self.on_tick is not None:
+                self.on_tick(now)
             if elastic_on:
                 # elastic resize plane (docs/GANG.md elasticity): execute
                 # grace-expired shrinks and the optimizer's standing

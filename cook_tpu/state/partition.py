@@ -309,11 +309,14 @@ class _PartitionedAudit:
 
     def stats(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"jobs": 0, "pending_durable": 0,
+                               "shed_advisory": False, "shed_count": 0,
                                "by_kind": {}}
         for store in self._ps.partitions:
             s = store.audit.stats()
             out["jobs"] += s.get("jobs", 0)
             out["pending_durable"] += s.get("pending_durable", 0)
+            out["shed_advisory"] |= bool(s.get("shed_advisory"))
+            out["shed_count"] += s.get("shed_count", 0)
             for k, v in (s.get("by_kind") or {}).items():
                 out["by_kind"][k] = out["by_kind"].get(k, 0) + v
         return out
@@ -723,19 +726,21 @@ class PartitionedStore:
             out.extend(store.elastic_gang_groups())
         return out
 
-    def jobs_where(self, pred: Callable[[Job], bool]) -> List[Job]:
+    def jobs_where(self, pred: Callable[[Job], bool],
+                   clone: bool = True) -> List[Job]:
         out: List[Job] = []
         for store in self.partitions:
-            out.extend(store.jobs_where(pred))
+            out.extend(store.jobs_where(pred, clone=clone))
         return out
 
-    def pending_jobs(self, pool: Optional[str] = None) -> List[Job]:
+    def pending_jobs(self, pool: Optional[str] = None,
+                     clone: bool = True) -> List[Job]:
         if pool is not None:
             # single-pool fast path: one partition owns the pool
-            return self._for_pool(pool).pending_jobs(pool)
+            return self._for_pool(pool).pending_jobs(pool, clone=clone)
         out: List[Job] = []
         for store in self.partitions:
-            out.extend(store.pending_jobs())
+            out.extend(store.pending_jobs(clone=clone))
         return out
 
     def running_jobs(self, pool: Optional[str] = None) -> List[Job]:
@@ -746,13 +751,14 @@ class PartitionedStore:
             out.extend(store.running_jobs())
         return out
 
-    def running_instances(self, pool: Optional[str] = None
+    def running_instances(self, pool: Optional[str] = None,
+                          clone: bool = True
                           ) -> List[Tuple[Job, Instance]]:
         if pool is not None:
-            return self._for_pool(pool).running_instances(pool)
+            return self._for_pool(pool).running_instances(pool, clone=clone)
         out: List[Tuple[Job, Instance]] = []
         for store in self.partitions:
-            out.extend(store.running_instances())
+            out.extend(store.running_instances(clone=clone))
         return out
 
     def user_usage(self, pool: Optional[str] = None

@@ -69,7 +69,7 @@ _COALESCE_BY_KIND = frozenset({"ranked"})
 # `cs why` read this split
 FAIRNESS_REASONS = frozenset({
     "over-quota", "rate-limited", "cap-reserved", "gang-deferred",
-    "offensive", "launch-filtered",
+    "offensive", "launch-filtered", "admission-throttled",
 })
 CONSTRAINT_REASONS = frozenset({"gang-partial"})
 
@@ -128,6 +128,16 @@ class AuditTrail:
         #: journal durable events (the store consults this before
         #: embedding/appending audit records)
         self.journal = True
+        #: brownout stage >= 1 (sched/admission.py): fold the advisory
+        #: flush — pending advisory events stop being serialized to the
+        #: journal (the in-memory lanes keep everything, so `cs why`
+        #: still answers; only pre-failover durability of ADVISORY
+        #: detail is shed).  Lifecycle events ride their own txn
+        #: records and are untouched.
+        self.shed_advisory = False
+        #: advisory events folded (not journaled) while shedding —
+        #: surfaced via stats() so the brownout's cost is visible
+        self.shed_count = 0
         self.max_jobs = max_jobs
         self.per_job = per_job
         # durable events awaiting a journal flush (Store.flush_audit)
@@ -372,7 +382,12 @@ class AuditTrail:
         """Wire docs for durable events not yet journaled (Store.
         flush_audit calls this once per cycle).  Coalesced events are
         journaled at their first flush only; later count bumps stay
-        in-memory (bounded journal growth)."""
+        in-memory (bounded journal growth).  Under brownout stage >= 1
+        (``shed_advisory``, sched/admission.py) the flush FOLDS:
+        pending events are marked flushed without serializing — zero
+        journal bytes, in-memory lanes intact, `cs why` keeps
+        answering; only pre-failover durability of advisory detail is
+        shed."""
         with self._lock:
             pending, self._pending = self._pending, []
             out = []
@@ -380,6 +395,9 @@ class AuditTrail:
                 if ev.flushed:
                     continue
                 ev.flushed = True
+                if self.shed_advisory:
+                    self.shed_count += 1
+                    continue
                 out.append(ev.to_wire(uuid))
             return out
 
@@ -462,7 +480,9 @@ class AuditTrail:
                 for ev in lane.events:
                     by_kind[ev.kind] = by_kind.get(ev.kind, 0) + ev.count
             return {"jobs": len(self._lanes), "by_kind": by_kind,
-                    "pending_durable": len(self._pending)}
+                    "pending_durable": len(self._pending),
+                    "shed_advisory": self.shed_advisory,
+                    "shed_count": self.shed_count}
 
     def skip_counts(self) -> Dict[str, int]:
         """Per-reason sums over every job's skip events — the attribution

@@ -1,0 +1,818 @@
+"""Overload-proof serving (ISSUE 17): layered admission control +
+saturation-driven brownout.
+
+Covers the whole ladder, cheapest layer first:
+
+- token-bucket mechanics under a fake clock (refill, borrow/debt,
+  adaptive refill scaling is never retroactive);
+- the GLOBAL per-user pending cap across a P=2 partitioned store (the
+  bounded summary exchange is the only cross-partition signal);
+- the adaptive level's hysteresis dead zone (no flapping at the
+  threshold) and the brownout stage ladder's provably monotone shed
+  order — escalation immediate, de-escalation one stage per dwell,
+  every flip journaled through the dynamic-config plane;
+- the front door over real HTTP: machine-readable 429s with honest
+  Retry-After, the observability/health exemption list, the stage-3
+  low-priority write shed, /debug/health visibility;
+- JobClient overload etiquette (Retry-After honored with jitter, 429
+  non-indeterminate, request_id + reason surfaced);
+- follower bounded-stale serves under stage >= 2, and recovery;
+- the faster-than-real-time overload replay (sim/overload.py) and the
+  chaos leg (leader killed MID-BROWNOUT restores the journaled stage).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.config import Config
+from cook_tpu.policy.rate_limit import (
+    TokenBucketRateLimiter,
+    UnlimitedRateLimiter,
+    submission_limiter,
+)
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.rest.api import ApiError
+from cook_tpu.sched.admission import (
+    CONFIG_KEY,
+    STAGE_NAMES,
+    AdmissionController,
+    stage_from_store,
+)
+from cook_tpu.state import Resources, Store
+from cook_tpu.state.partition import PartitionedStore, PartitionMap
+from cook_tpu.state.schema import Job, Pool
+
+pytestmark = pytest.mark.overload
+
+
+def make_job(i, user="alice", **kw):
+    return Job(uuid=f"00000000-0000-0000-0000-{i:012d}", user=user,
+               command=f"echo {i}", resources=Resources(cpus=1, mem=64),
+               **kw)
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return bool(pred())
+
+
+# ---------------------------------------------------------------------------
+# token buckets under a fake clock
+# ---------------------------------------------------------------------------
+class TestTokenBucket:
+    def bucket(self, per_min=60.0, size=10.0):
+        clk = [100.0]
+        rl = TokenBucketRateLimiter(per_min, size,
+                                    clock=lambda: clk[0])
+        return rl, clk
+
+    def test_refill_and_borrow(self):
+        rl, clk = self.bucket()  # 1 token/s, size 10
+        assert rl.get_token_count("u") == 10.0  # first touch: full
+        rl.spend("u", 12.0)  # borrow into debt
+        assert rl.get_token_count("u") == -2.0
+        assert not rl.within_limit("u")
+        assert rl.time_until_out_of_debt_s("u") == pytest.approx(2.0)
+        clk[0] += 2.0  # earns back to exactly zero — still no tokens
+        assert rl.get_token_count("u") == pytest.approx(0.0)
+        assert not rl.within_limit("u")
+        clk[0] += 1.0
+        assert rl.within_limit("u")
+        # Retry-After is the honest shortfall at the current rate
+        assert rl.retry_after_s("u", 5.0) == pytest.approx(4.0)
+        # refill never overfills past the bucket size
+        clk[0] += 3600.0
+        assert rl.get_token_count("u") == 10.0
+
+    def test_frozen_clock_mints_nothing(self):
+        rl, _clk = self.bucket()
+        rl.spend("u", 4.0)
+        # same-instant reads are pure: no elapsed time, no new tokens
+        assert all(rl.get_token_count("u") == 6.0 for _ in range(5))
+
+    def test_try_spend_refuses_partial_tokens(self):
+        rl, clk = self.bucket(per_min=60.0, size=1.0)
+        assert rl.try_spend("u")
+        assert not rl.try_spend("u")  # fractional refill never admits
+        clk[0] += 0.5
+        assert not rl.try_spend("u")  # 0.5 tokens < 1 full token
+        clk[0] += 0.5
+        assert rl.try_spend("u")
+
+    def test_refill_scale_is_never_retroactive(self):
+        rl, clk = self.bucket(per_min=60.0, size=100.0)
+        rl.spend("u", 100.0)  # drain to zero
+        clk[0] += 30.0  # 30 tokens earned at full rate...
+        rl.set_refill_scale(0.5)  # ...settled BEFORE the scale applies
+        clk[0] += 30.0  # 15 more at half rate
+        assert rl.get_token_count("u") == pytest.approx(45.0)
+        # recovery restores the configured rate, earned tokens kept
+        rl.set_refill_scale(1.0)
+        clk[0] += 10.0
+        assert rl.get_token_count("u") == pytest.approx(55.0)
+
+    def test_enforce_off_admits_everything(self):
+        rl = TokenBucketRateLimiter(1.0, 1.0, enforce=False)
+        rl.spend("u", 99.0)
+        assert rl.within_limit("u") and rl.try_spend("u", 50.0)
+
+    def test_submission_limiter_construction(self):
+        assert isinstance(submission_limiter(None), UnlimitedRateLimiter)
+        cfg = Config()
+        assert isinstance(submission_limiter(cfg.admission),
+                          UnlimitedRateLimiter)  # disabled section
+        cfg.admission.enabled = True
+        assert isinstance(submission_limiter(cfg.admission),
+                          UnlimitedRateLimiter)  # refill 0 = unlimited
+        cfg.admission.submissions_per_minute = 60.0
+        rl = submission_limiter(cfg.admission)
+        assert isinstance(rl, TokenBucketRateLimiter) and rl.enforce
+        assert rl.bucket_size == 60.0  # burst defaults to the refill
+
+
+# ---------------------------------------------------------------------------
+# GLOBAL per-user pending cap across partitions
+# ---------------------------------------------------------------------------
+class TestGlobalPendingCap:
+    def api(self, max_pending=3):
+        pmap = PartitionMap(count=2, pools={"alpha": 0, "beta": 1})
+        ps = PartitionedStore([Store(partition=0), Store(partition=1)],
+                              pmap, summary_max_age_s=0.0)
+        ps.put_pool(Pool(name="alpha"))
+        ps.put_pool(Pool(name="beta"))
+        cfg = Config()
+        cfg.admission.enabled = True
+        cfg.admission.max_user_pending = max_pending
+        return CookApi(ps, config=cfg)
+
+    def test_cap_counts_every_partition(self):
+        api = self.api(max_pending=3)
+        api.submit_jobs({"jobs": [{"command": "a", "pool": "alpha"},
+                                  {"command": "b", "pool": "alpha"}]},
+                        "alice")
+        api.submit_jobs({"jobs": [{"command": "c", "pool": "beta"}]},
+                        "alice")
+        # 2 pending in p0 + 1 in p1: the NEXT job busts the global cap
+        # even though each partition is individually under it
+        with pytest.raises(ApiError) as e:
+            api.submit_jobs({"jobs": [{"command": "d", "pool": "beta"}]},
+                            "alice")
+        assert e.value.status == 429
+        assert e.value.extra["reason"] == "user-pending-cap"
+        assert e.value.extra["scope"] == "global"
+        assert "Retry-After" in e.value.headers
+        # per-user isolation: bob is not charged for alice's queue
+        api.submit_jobs({"jobs": [{"command": "e", "pool": "beta"}]},
+                        "bob")
+
+    def test_idempotent_retries_are_exempt(self):
+        api = self.api(max_pending=1)
+        api.submit_jobs({"jobs": [{"command": "a", "pool": "alpha"}]},
+                        "alice")
+        # an idempotent resubmission may already be journaled and
+        # counted by the summaries — charging it again would strand the
+        # user at cap unable to heal an ambiguous submission
+        api._admit_submission([{"command": "a", "pool": "alpha"}],
+                              "alice", idempotent=True)
+        with pytest.raises(ApiError):
+            api._admit_submission([{"command": "b", "pool": "alpha"}],
+                                  "alice")
+
+
+# ---------------------------------------------------------------------------
+# adaptive level: hysteresis + the brownout stage ladder
+# ---------------------------------------------------------------------------
+class _Obs:
+    capture = True
+
+
+def make_controller(**admission_kw):
+    store = Store()
+    clk = [1_000_000]
+    store.clock = lambda: clk[0]
+    cfg = Config()
+    cfg.admission.enabled = True
+    for k, v in admission_kw.items():
+        setattr(cfg.admission, k, v)
+    ctrl = AdmissionController(store, cfg, request_obs=_Obs())
+    return ctrl, store, clk
+
+
+class TestAdmissionHysteresis:
+    def test_dead_zone_holds_the_level(self):
+        ctrl, _store, _clk = make_controller()
+        # [release 0.6, engage 0.8) is the dead zone: no movement, no
+        # flapping no matter how the gauge oscillates inside it
+        for sat in (0.7, 0.79, 0.61, 0.75, 0.79, 0.61):
+            ctrl.decide({"cpu": sat})
+        assert ctrl.level == 1.0
+        assert ctrl.stage == 0 and ctrl.transitions == []
+
+    def test_exactly_at_engage_is_not_a_stable_noop(self):
+        ctrl, _store, _clk = make_controller()
+        ctrl.decide({"cpu": 0.8})  # severity 0 -> quarter-step floor
+        assert ctrl.level == pytest.approx(0.95)
+
+    def test_deeper_overload_sheds_faster(self):
+        ctrl, _store, _clk = make_controller()
+        ctrl.decide({"cpu": 1.0})  # severity 1 -> full decrease_step
+        assert ctrl.level == pytest.approx(0.8)
+
+    def test_level_floor_never_starves_to_zero(self):
+        ctrl, _store, _clk = make_controller()
+        for _ in range(50):
+            ctrl.decide({"cpu": 1.0})
+        assert ctrl.level == pytest.approx(
+            ctrl.ac.level_floor)  # the metastable-failure guard
+
+    def test_recovery_is_gradual(self):
+        ctrl, _store, _clk = make_controller()
+        for _ in range(10):
+            ctrl.decide({"mem": 1.0})
+        for _ in range(100):
+            ctrl.decide({"mem": 0.0})
+        assert ctrl.level == 1.0  # ramps by recover_step, capped
+
+    def test_level_scales_bucket_refill(self):
+        ctrl, _store, _clk = make_controller()
+        rl = TokenBucketRateLimiter(60.0, 60.0)
+
+        class Limits:
+            job_submission = rl
+
+        ctrl.rate_limits = Limits()
+        ctrl.decide({"cpu": 1.0})
+        assert rl.refill_scale == pytest.approx(ctrl.level)
+        for _ in range(100):
+            ctrl.decide({"cpu": 0.0})
+        assert rl.refill_scale == 1.0
+
+
+class TestBrownoutLadder:
+    def test_stage_order_golden(self):
+        """The shed order is monotone and exactly: observability ->
+        stale reads -> writes (never reordered, never skipped on the
+        way down the level ramp)."""
+        ctrl, store, _clk = make_controller()
+        for _ in range(6):
+            ctrl.decide({"queue": 1.0})
+        golden = [("none", "shed-observability"),
+                  ("shed-observability", "stale-reads"),
+                  ("stale-reads", "shed-writes")]
+        assert [(t["from_name"], t["to_name"])
+                for t in ctrl.transitions] == golden
+        assert ctrl.stage == 3
+        # every flip is journaled through the dynamic-config plane
+        doc = store.dynamic_config(CONFIG_KEY)
+        assert doc["stage"] == 3
+        assert doc["stage_name"] == "shed-writes"
+        assert stage_from_store(store) == 3
+        # stage >= 1 side effects: advisory observability is shed
+        assert store.audit.shed_advisory is True
+        assert ctrl.request_obs.capture is False
+
+    def test_multi_threshold_jump_engages_every_stage_below(self):
+        # a level collapse past several thresholds in ONE sweep: stage
+        # actions are nested >= k checks, so the jump engages stages
+        # 1..3 together and the order stays monotone by construction
+        ctrl, store, _clk = make_controller(decrease_step=1.0)
+        ctrl.decide({"cpu": 1.0})
+        assert ctrl.stage == 3 and len(ctrl.transitions) == 1
+        assert ctrl.transitions[0]["from"] == 0
+        assert ctrl.transitions[0]["to"] == 3
+        assert store.audit.shed_advisory is True
+
+    def test_deescalation_one_stage_per_dwell(self):
+        ctrl, store, clk = make_controller(recover_step=1.0,
+                                           stage_hold_seconds=10.0)
+        for _ in range(6):
+            ctrl.decide({"cpu": 1.0})
+        assert ctrl.stage == 3
+        # recovery: the level snaps back above every threshold, but the
+        # ladder steps down ONE stage per dwell of SUSTAINED recovery —
+        # a brief dip must not whipsaw the shed surface back on
+        stages = []
+        for _ in range(8):
+            clk[0] += 10_001
+            ctrl.decide({"cpu": 0.0})
+            stages.append(ctrl.stage)
+        assert stages[:3] == [3, 2, 1]  # first sweep only starts dwell
+        assert 0 in stages
+        down = [t for t in ctrl.transitions if t["to"] < t["from"]]
+        assert [(t["from"], t["to"]) for t in down] == \
+            [(3, 2), (2, 1), (1, 0)]
+        # fully recovered: shed side effects rolled back, journal says 0
+        assert store.audit.shed_advisory is False
+        assert ctrl.request_obs.capture is True
+        assert stage_from_store(store) == 0
+
+    def test_brief_dip_does_not_deescalate(self):
+        ctrl, _store, clk = make_controller(recover_step=1.0,
+                                            stage_hold_seconds=10.0)
+        for _ in range(6):
+            ctrl.decide({"cpu": 1.0})
+        clk[0] += 3_000
+        ctrl.decide({"cpu": 0.0})  # starts the dwell
+        clk[0] += 3_000
+        ctrl.decide({"cpu": 0.0})  # 3s < 10s hold: still stage 3... but
+        # the level recovered, so re-engagement needs real saturation
+        assert ctrl.stage == 3
+
+    def test_restore_recovers_journaled_stage(self):
+        """A promoted leader (or restarted process) resumes shedding at
+        its journaled stage instead of re-admitting the overload."""
+        ctrl, store, _clk = make_controller()
+        for _ in range(4):
+            ctrl.decide({"cpu": 1.0})
+        assert ctrl.stage >= 2
+        ctrl2 = AdmissionController(store, ctrl.config, request_obs=_Obs())
+        assert ctrl2.stage == ctrl.stage
+        assert ctrl2.level == pytest.approx(
+            store.dynamic_config(CONFIG_KEY)["level"])
+        assert ctrl2.request_obs.capture is False  # side effects re-applied
+
+
+# ---------------------------------------------------------------------------
+# the front door over real HTTP
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def front_door():
+    store = Store()
+    cfg = Config()
+    cfg.admission.enabled = True
+    cfg.admission.submissions_per_minute = 60.0
+    cfg.admission.submission_burst = 2.0
+    api = CookApi(store, config=cfg)
+    server = ApiServer(api)
+    server.start()
+    yield store, api, server
+    server.stop()
+
+
+class TestFrontDoorHttp:
+    def test_user_bucket_429_contract(self, front_door):
+        _store, _api, server = front_door
+        client = JobClient(server.url, user="alice")
+        client.throttle_retries = 0
+        client.submit([{"command": "a"}])  # burst 2: one token left
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "b"}, {"command": "c"}])
+        err = e.value
+        assert err.status == 429 and err.throttled
+        assert not err.indeterminate  # refused BEFORE touching state
+        assert err.reason == "rate-limited" and err.scope == "user"
+        assert err.retry_after_s is not None and err.retry_after_s >= 1
+        assert err.request_id  # joinable to the server's slow ring
+        # a different user holds their own bucket
+        JobClient(server.url, user="bob").submit([{"command": "d"}])
+
+    def test_drained_bucket_fast_path_keeps_contract(self, front_door):
+        _store, api, server = front_door
+        client = JobClient(server.url, user="carol")
+        client.throttle_retries = 0
+        client.submit([{"command": "a"}])
+        # drain the bucket INTO DEBT (the sustained-stampede steady
+        # state): the ingress fast path triggers only when no batch
+        # could possibly admit
+        api.rate_limits.job_submission.spend("carol", 10.0)
+        assert api.rate_limits.job_submission.get_token_count(
+            "carol") <= 0
+        # the ingress fast path answers before parsing the body — the
+        # client-visible contract is identical to the full-path 429
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "c"}])
+        assert e.value.status == 429
+        assert e.value.reason == "rate-limited"
+        assert e.value.scope == "user"
+        assert e.value.retry_after_s is not None
+        assert e.value.request_id
+        # the keep-alive connection stays sound: a later in-budget user
+        # request on a fresh client still round-trips
+        JobClient(server.url, user="dave").submit([{"command": "d"}])
+
+    def test_stage3_sheds_low_priority_writes_only(self, front_door):
+        store, _api, server = front_door
+        # follower-style stage source: the journaled dynamic-config doc
+        store.update_dynamic_config(CONFIG_KEY, {
+            "stage": 3, "stage_name": "shed-writes", "level": 0.1})
+        client = JobClient(server.url, user="erin")
+        client.throttle_retries = 0
+        with pytest.raises(JobClientError) as e:
+            client.submit([{"command": "a", "priority": 10}])
+        assert e.value.status == 429
+        assert e.value.reason == "brownout-shed"
+        # committed-write path: at-or-above-threshold priority rides
+        # through — scheduling-relevant writes degrade last or never
+        client.submit([{"command": "b", "priority": 80}])
+        # the stage is visible on /debug/health on any role
+        req = urllib.request.Request(server.url + "/debug/health",
+                                     headers={"X-Cook-User": "erin"})
+        health = json.load(urllib.request.urlopen(req, timeout=10))
+        assert health["admission"]["stage"] == 3
+        assert health["admission"]["stage_name"] == "shed-writes"
+
+
+class TestExemptEndpoints:
+    @pytest.fixture()
+    def limited(self):
+        cfg = Config()
+        cfg.admission.enabled = True
+        cfg.admission.ip_requests_per_minute = 2.0
+        api = CookApi(Store(), config=cfg)
+        server = ApiServer(api)
+        server.start()
+        yield server
+        server.stop()
+
+    def _get(self, url):
+        req = urllib.request.Request(
+            url, headers={"X-Cook-User": "alice"})
+        try:
+            resp = urllib.request.urlopen(req, timeout=10)
+            return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    def test_observability_survives_the_incident(self, limited):
+        server = limited
+        # hammer the exempt surfaces far past the 2/min budget: the
+        # operator debugging the overload is never locked out
+        for path in ("/metrics", "/debug/health", "/metrics/fleet"):
+            for _ in range(5):
+                status, _h = self._get(server.url + path)
+                assert status == 200, path
+        # a non-exempt surface drains the 2-token bucket then 429s
+        # with an honest Retry-After
+        statuses = []
+        for _ in range(4):
+            status, headers = self._get(server.url + "/jobs?user=alice")
+            statuses.append((status, headers.get("Retry-After")))
+        assert statuses[0][0] == 200 and statuses[1][0] == 200
+        assert statuses[-1][0] == 429
+        assert int(statuses[-1][1]) >= 1
+        # even rate-limited, observability still answers
+        assert self._get(server.url + "/metrics")[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# JobClient overload etiquette
+# ---------------------------------------------------------------------------
+class TestClientRetryAfter:
+    def _stub_server(self, responses):
+        """Tiny HTTP server answering scripted (status, headers, body)
+        tuples in order, recording request paths."""
+        import http.server
+        seen = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(n)
+                seen.append(self.path)
+                status, headers, body = responses[
+                    min(len(seen) - 1, len(responses) - 1)]
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Cook-Request-Id", "req-stub")
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, seen
+
+    def test_retry_after_honored_then_succeeds(self):
+        srv, seen = self._stub_server([
+            (429, {"Retry-After": "0"},
+             {"error": "rate limit", "reason": "rate-limited",
+              "scope": "user"}),
+            (200, {}, {"jobs": ["u-1"]}),
+        ])
+        try:
+            client = JobClient(f"http://127.0.0.1:{srv.server_port}",
+                               user="alice")
+            client.throttle_cap_s = 0.6  # bound the jittered wait
+            t0 = time.perf_counter()
+            uuids = client.submit([{"command": "a"}])
+            assert uuids == ["u-1"]
+            assert len(seen) == 2  # one honored 429, then the accept
+            assert time.perf_counter() - t0 < 10.0
+        finally:
+            srv.shutdown()
+
+    def test_retries_disabled_surfaces_the_throttle(self):
+        srv, seen = self._stub_server([
+            (429, {"Retry-After": "7"},
+             {"error": "rate limit", "reason": "rate-limited",
+              "scope": "user"}),
+        ])
+        try:
+            client = JobClient(f"http://127.0.0.1:{srv.server_port}",
+                               user="alice")
+            client.throttle_retries = 0
+            with pytest.raises(JobClientError) as e:
+                client.submit([{"command": "a"}])
+            err = e.value
+            assert err.throttled and not err.indeterminate
+            assert err.reason == "rate-limited"
+            # the advice survives on the error for the caller's pacing
+            assert err.retry_after_s == pytest.approx(7.0)
+            assert err.request_id == "req-stub"
+            assert len(seen) == 1  # no tight-loop hammering
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# head-of-queue scaleback + the unscheduled explainer
+# ---------------------------------------------------------------------------
+class TestExplainer:
+    def test_admission_throttled_reason(self):
+        from cook_tpu.cluster import FakeCluster, FakeHost
+        from cook_tpu.sched import Scheduler
+        from cook_tpu.sched.unscheduled import job_reasons
+
+        store = Store()
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.admission.enabled = True
+        cluster = FakeCluster(
+            "fake-1", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        job = make_job(1)
+        store.create_jobs([job])
+        assert sched.admission is not None
+        reasons = job_reasons(store, store.job(job.uuid), scheduler=sched)
+        kinds = [r["data"].get("kind") for r in reasons]
+        assert "admission-throttled" not in kinds  # level 1.0: silent
+        sched.admission.level = 0.4
+        sched.admission.stage = 2
+        reasons = job_reasons(store, store.job(job.uuid), scheduler=sched)
+        throttled = [r for r in reasons
+                     if r["data"].get("kind") == "admission-throttled"]
+        assert len(throttled) == 1
+        assert throttled[0]["data"]["level"] == pytest.approx(0.4)
+        assert throttled[0]["data"]["stage_name"] == STAGE_NAMES[2]
+
+    def test_considerable_window_scales_with_level(self):
+        from cook_tpu.cluster import FakeCluster, FakeHost
+        from cook_tpu.sched import Scheduler
+
+        store = Store()
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.default_matcher.max_jobs_considered = 100
+        cfg.admission.enabled = True
+        cluster = FakeCluster(
+            "fake-1", [FakeHost("h0", Resources(cpus=64, mem=65536))])
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        store.create_jobs([make_job(i) for i in range(40)])
+        sched.admission.level = 0.25
+        sched.step_rank()
+        results = sched.step_match()
+        # fenzo-scaleback through the admission level: the head-of-queue
+        # window shrinks to level * cap — both the fused and the direct
+        # match path see the SAME scaled window
+        assert sum(r.considered for r in results.values()) <= 25
+
+    def test_direct_match_path_gets_the_same_scaleback(self):
+        from cook_tpu.sched.matcher import Matcher
+
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.default_matcher.max_jobs_considered = 100
+        cfg.admission.enabled = True
+        store = Store()
+        m = Matcher(store, cfg)
+
+        class Ctrl:
+            level = 0.1
+            stage = 2
+
+        m.admission = Ctrl()
+        jobs = [make_job(i) for i in range(50)]
+        # admission_limit is the shared gate both match paths call:
+        # the window shrinks to floor(level * cap), floored at 1, and
+        # the cut jobs get attributable admission-throttled skips
+        assert m.admission_limit("default", jobs, 100) == 10
+        assert m.admission_limit("default", jobs, 1) == 1
+        considered = m.considerable_jobs(
+            "default", jobs, m.admission_limit("default", jobs, 100))
+        assert len(considered) == 10
+
+
+# ---------------------------------------------------------------------------
+# live-reference aggregate reads (the monitor sweep's fast path)
+# ---------------------------------------------------------------------------
+class TestAggregateReads:
+    def test_clone_false_returns_live_entities(self):
+        store = Store()
+        store.create_jobs([make_job(1)])
+        a = store.pending_jobs(clone=False)
+        b = store.pending_jobs(clone=False)
+        assert a[0] is b[0]  # the live entity, not a per-call clone
+        c = store.pending_jobs()
+        assert c[0] is not a[0] and c[0].uuid == a[0].uuid
+        # the list itself is fresh (collected under the lock): callers
+        # can iterate without holding the store's lock
+        assert a is not b
+
+
+# ---------------------------------------------------------------------------
+# follower bounded-stale serves under brownout stage >= 2
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def follower_rest(tmp_path):
+    from cook_tpu.state.read_replica import FollowerReadView
+
+    d = str(tmp_path / "m")
+    leader_store = Store.open(d)
+    leader_api = CookApi(leader_store)
+    leader = ApiServer(leader_api)
+    leader.start()
+    view = FollowerReadView(d, interval_s=0.005)
+
+    class StubElector:
+        def leader_url(self):
+            return leader.url
+
+    cfg = Config()
+    cfg.admission.enabled = True
+    api = CookApi(view.store, config=cfg, elector=StubElector(),
+                  node_url="http://follower-node")
+    api.read_view = view
+    view.on_swap(lambda s: setattr(api, "store", s))
+    server = ApiServer(api)
+    server.start()
+    yield leader_store, view, api, server
+    server.stop()
+    leader.stop()
+    view.stop()
+    leader_store.close()
+
+
+class TestFollowerDegrade:
+    def _get(self, url, headers=None):
+        req = urllib.request.Request(
+            url, headers={"X-Cook-User": "alice", **(headers or {})})
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_stage2_degrade_is_visible_and_recovers(self, follower_rest):
+        leader_store, view, api, server = follower_rest
+        job = make_job(1)
+        leader_store.create_jobs([job])
+        # the leader's stage-2 flip rides an ordinary journal record
+        leader_store.update_dynamic_config(CONFIG_KEY, {
+            "stage": 2, "stage_name": "stale-reads", "level": 0.45})
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        assert api.brownout_stage() == 2  # replicated, not pushed
+        resp = self._get(server.url + f"/jobs/{job.uuid}")
+        assert resp.status == 200
+        # the degrade is honest: flagged, and the staleness contract
+        # headers still ride the response
+        assert resp.headers["X-Cook-Brownout"] == "stale-reads"
+        assert float(resp.headers["X-Cook-Replication-Age-Ms"]) >= 0
+        # recovery: the leader journals stage 0 and the flag drops
+        leader_store.update_dynamic_config(CONFIG_KEY, {
+            "stage": 0, "stage_name": "none", "level": 1.0})
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        assert api.brownout_stage() == 0
+        resp = self._get(server.url + f"/jobs/{job.uuid}")
+        assert resp.status == 200
+        assert "X-Cook-Brownout" not in resp.headers
+
+    def test_read_your_writes_is_never_faked(self, follower_rest):
+        leader_store, view, _api, server = follower_rest
+        job = make_job(2)
+        leader_store.create_jobs([job])
+        leader_store.update_dynamic_config(CONFIG_KEY, {
+            "stage": 2, "stage_name": "stale-reads", "level": 0.45})
+        assert wait_for(
+            lambda: view.offset >= leader_store.commit_offset())
+        # a token beyond the mirror redirects to the leader even under
+        # brownout — bounded-stale is a degrade, not a lie
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        req = urllib.request.Request(
+            server.url + f"/jobs/{job.uuid}",
+            headers={"X-Cook-User": "alice",
+                     "X-Cook-Min-Offset":
+                         str(leader_store.commit_offset() + 10_000)})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            opener.open(req, timeout=10)
+        assert e.value.code == 307
+
+
+# ---------------------------------------------------------------------------
+# trace-scale proofs: overload replay + chaos mid-brownout
+# ---------------------------------------------------------------------------
+class TestOverloadReplay:
+    def test_ladder_engages_and_loses_nothing_at_10x(self):
+        from cook_tpu.sim.overload import run_overload
+
+        s = run_overload(offered_multiple=10.0, horizon_ms=30_000)
+        assert s["ok"], s
+        adm = s["admission"]
+        # the ladder engaged in shed order and the level responded
+        assert adm["stages_engaged"] == [1, 2, 3]
+        assert adm["stage_order_ok"]
+        assert adm["min_level"] < 1.0
+        # the front door did the shedding: most of the 10x excess was
+        # refused up front with an attributable reason...
+        assert s["shed"].get("rate-limited", 0) > 0
+        assert s["shed_total"] > 0
+        # ...and NOTHING admitted was lost or left dangling
+        assert s["committed_writes_lost"] == 0
+        assert s["completion_rate_of_admitted"] > 0.95
+
+    def test_replay_is_deterministic(self):
+        from cook_tpu.sim.overload import run_overload
+
+        a = run_overload(offered_multiple=6.0, horizon_ms=15_000, seed=5)
+        b = run_overload(offered_multiple=6.0, horizon_ms=15_000, seed=5)
+        assert (a["admitted"], a["shed"], a["completed"],
+                a["admission"]["stages_engaged"]) == \
+            (b["admitted"], b["shed"], b["completed"],
+             b["admission"]["stages_engaged"])
+
+
+@pytest.mark.chaos
+class TestChaosOverload:
+    def test_leader_killed_mid_brownout_restores_stage(self, tmp_path):
+        """``sim --chaos --overload``: the ladder engages BEFORE the
+        leader kill, and the promoted controller restores the journaled
+        stage — a failover mid-brownout never resets the shed surface
+        under standing overload (the metastable trap)."""
+        from cook_tpu.sim.chaos import ChaosConfig, run_chaos
+
+        cc = ChaosConfig(seed=7, overload=True,
+                         data_dir=str(tmp_path / "chaos"))
+        result = run_chaos(cc)
+        assert result.ok, result.violations
+        assert result.min_admission_level < 1.0
+        assert result.brownout_stage_at_kill >= 1
+        assert result.brownout_stage_recovered == \
+            result.brownout_stage_at_kill
+
+
+# ---------------------------------------------------------------------------
+# boot validation
+# ---------------------------------------------------------------------------
+class TestBootValidation:
+    def test_daemon_admission_section(self):
+        from cook_tpu.daemon import build_scheduler_config
+
+        cfg = build_scheduler_config({"admission": {
+            "enabled": True, "submissions_per_minute": 600,
+            "max_user_pending": 5000}})
+        assert cfg.admission.enabled
+        assert cfg.admission.submissions_per_minute == 600.0
+
+    def test_typod_knob_fails_the_boot(self):
+        from cook_tpu.daemon import build_scheduler_config
+
+        with pytest.raises(ValueError, match="unknown admission key"):
+            build_scheduler_config(
+                {"admission": {"submisions_per_minute": 600}})
+
+    def test_out_of_order_ladder_fails_the_boot(self):
+        from cook_tpu.daemon import build_scheduler_config
+
+        with pytest.raises(ValueError, match="strictly descending"):
+            build_scheduler_config({"admission": {
+                "enabled": True, "observability_shed_level": 0.3,
+                "stale_reads_level": 0.5, "shed_writes_level": 0.25}})
+
+    def test_example_production_conf_boots(self):
+        import os
+
+        from cook_tpu.daemon import build_scheduler_config
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "examples", "cook-production.json")
+        spec = json.load(open(path))["scheduler"]
+        cfg = build_scheduler_config(spec)
+        assert cfg.admission.enabled
+        assert cfg.admission.max_user_pending > 0
