@@ -41,14 +41,19 @@ On top of the summaries, four passes:
     to the coverage diff but never violations.
 ``lock-contract-unverified`` / ``lock-contract-unnamed``
     the requires_lock verifier.
-``journal-record-*``
+``journal-record-*`` / ``journal-raw-write``
     protocol completeness for the journal record kinds: every kind
-    written at a ``*journal_file*.write(json.dumps(...))`` site must
-    have a replay handler (``_apply_journal_record`` /
-    ``_replay_records``), be declared in the ``JOURNAL_RECORD_KINDS``
-    registry, and the read-replica tail must route whole records
-    through ``_replay_records`` — so a new record kind can never
-    silently vanish on a follower again.
+    written at a ``*journal_file*.write(seal_record(...))`` site (the
+    checksummed appender, state/integrity.py; legacy
+    ``json.dumps(...)`` payloads still harvest) must have a replay
+    handler (``_apply_journal_record`` / ``_replay_records``), be
+    declared in the ``JOURNAL_RECORD_KINDS`` registry, and the
+    read-replica tail must route whole records through
+    ``_replay_records`` — so a new record kind can never silently
+    vanish on a follower again.  ``journal-raw-write`` flags any
+    journal write whose payload bypasses ``seal_record`` — an
+    un-enveloped line is invisible to the torn-vs-corrupt verdict
+    (docs/ROBUSTNESS.md WAL v2).
 
 The static edge set is exported (family-normalized) for the
 static-vs-dynamic coverage diff on ``cs lint --lock-coverage`` and
@@ -465,25 +470,50 @@ def _dotted_parts(node: ast.AST) -> str:
     return ".".join(parts)
 
 
-def _dumps_payload(arg: ast.AST,
-                   local_assigns: Dict[str, ast.AST]
-                   ) -> Optional[ast.AST]:
-    """The dict/name inside a ``json.dumps(...)`` payload expression,
-    following one level of local alias and stripping ``+ "\\n"``."""
+def _call_fname(call: ast.Call) -> str:
+    return call.func.attr if isinstance(call.func, ast.Attribute) else (
+        call.func.id if isinstance(call.func, ast.Name) else "")
+
+
+def _payload_call(arg: ast.AST,
+                  local_assigns: Dict[str, ast.AST]
+                  ) -> Optional[ast.Call]:
+    """The outermost call feeding a journal ``write(...)`` payload
+    (``seal_record(rec)`` / ``json.dumps(rec)``), following one level
+    of local alias and stripping ``+ "\\n"``."""
     for _ in range(2):
         while isinstance(arg, ast.BinOp):
             arg = arg.left
         if isinstance(arg, ast.Call):
-            fname = arg.func.attr if isinstance(
-                arg.func, ast.Attribute) else (
-                arg.func.id if isinstance(arg.func, ast.Name) else "")
-            if "dumps" in fname and arg.args:
-                return arg.args[0]
-            return None
+            return arg
         if isinstance(arg, ast.Name) and arg.id in local_assigns:
             arg = local_assigns[arg.id]
             continue
         return None
+    return None
+
+
+def _dumps_payload(arg: ast.AST,
+                   local_assigns: Dict[str, ast.AST]
+                   ) -> Optional[ast.AST]:
+    """The dict/name inside a journal payload expression — the sealed
+    form ``seal_record(rec)`` (state/integrity.py — the record rides a
+    CRC32C envelope) or the legacy ``json.dumps(rec) + "\\n"`` —
+    following one level of local alias."""
+    call = _payload_call(arg, local_assigns)
+    if call is None or not call.args:
+        return None
+    fname = _call_fname(call)
+    if "seal" in fname:
+        inner = call.args[0]
+        # seal_record(json.dumps(...)) never occurs, but a sealed
+        # payload may itself be aliased one level
+        if isinstance(inner, ast.Call) and "dumps" in _call_fname(inner) \
+                and inner.args:
+            return inner.args[0]
+        return inner
+    if "dumps" in fname:
+        return call.args[0]
     return None
 
 
@@ -517,9 +547,10 @@ def journal_record_findings(trees: Dict[str, ast.Module]
     Harvests, purely statically:
 
     - **written** kinds — at every ``<...journal_file...>.write(
-      json.dumps(rec) ...)`` site, the constant keys of ``rec``
-      (dict-literal init + ``rec["k"] = ...`` assignments in the same
-      function, or an inline dict literal);
+      seal_record(rec))`` site (or the legacy ``json.dumps(rec)``
+      form), the constant keys of ``rec`` (dict-literal init +
+      ``rec["k"] = ...`` assignments in the same function, or an
+      inline dict literal);
     - **handled** kinds — constant ``rec.get("k")`` / ``rec["k"]`` keys
       inside the replay handlers (``_apply_journal_record`` /
       ``_replay_records``);
@@ -535,6 +566,7 @@ def journal_record_findings(trees: Dict[str, ast.Module]
     written: Dict[str, Tuple[str, int]] = {}
     handled: Set[str] = set()
     declared: Dict[str, Tuple[str, int]] = {}
+    raw_writes: List[Tuple[str, int]] = []
     writer_seen = False
     replica_files: List[str] = []
     replica_calls_replay = False
@@ -567,7 +599,7 @@ def journal_record_findings(trees: Dict[str, ast.Module]
                 # writer sites in this function.  The repo idiom
                 # aliases the handle and the line:
                 #     f = self._journal_file
-                #     line = json.dumps(rec) + "\n"
+                #     line = seal_record(rec)
                 #     f.write(line)
                 # so both the write target and the payload resolve
                 # through one level of local assignment.
@@ -596,6 +628,14 @@ def journal_record_findings(trees: Dict[str, ast.Module]
                         and base.id in aliases)
                     if not is_journal:
                         continue
+                    # every journal write must route through the
+                    # checksummed appender (state/integrity.seal_record)
+                    # — a bare json.dumps line has no CRC envelope, so
+                    # replay can't tell a torn tail from mid-file
+                    # corruption for it
+                    call = _payload_call(sub.args[0], local_assigns)
+                    if call is None or "seal" not in _call_fname(call):
+                        raw_writes.append((relpath, sub.lineno))
                     payload = _dumps_payload(sub.args[0], local_assigns)
                     if payload is None:
                         continue
@@ -618,6 +658,14 @@ def journal_record_findings(trees: Dict[str, ast.Module]
                             replica_calls_replay = True
 
     findings: List[Finding] = []
+    for relpath, line in raw_writes:
+        findings.append(Finding(
+            check="journal-raw-write", path=relpath, line=line,
+            scope="journal", detail="write",
+            message=("journal write bypasses the checksummed appender — "
+                     "route the record through state/integrity."
+                     "seal_record so replay can tell a torn tail from "
+                     "mid-file corruption (docs/ROBUSTNESS.md WAL v2)")))
     if not writer_seen:
         return findings
     for kind, (relpath, line) in sorted(written.items()):

@@ -655,7 +655,11 @@ def cmd_debug(args) -> int:
     fleet panel — every known member's health, role, last-scrape age,
     staleness, SLO burn, and saturation hot-spots, with unreachable
     members surfaced as rows (up=false), not gaps
-    (docs/OBSERVABILITY.md debugging the fleet)."""
+    (docs/OBSERVABILITY.md debugging the fleet); ``cs debug storage``
+    dumps the persistence-integrity panel — per-partition scrub
+    progress, last verified offset, corruption/repair counters,
+    checkpoint manifest status, and a follower's mirror poison state
+    (docs/DEPLOY.md corrupted-journal runbook)."""
     client = clients(args)[0]
     if args.debug_cmd == "cycles":
         out(client.debug_cycles(limit=args.limit))
@@ -677,6 +681,9 @@ def cmd_debug(args) -> int:
         return 0
     if args.debug_cmd == "fleet":
         out(client.debug_fleet())
+        return 0
+    if args.debug_cmd == "storage":
+        out(client.debug_storage())
         return 0
     trace_id = args.trace_id
     if not trace_id:
@@ -1044,7 +1051,8 @@ def build_parser() -> argparse.ArgumentParser:
                                       "failover panel")
     sp.add_argument("debug_cmd",
                     choices=["cycles", "trace", "faults", "replication",
-                             "health", "requests", "optimizer", "fleet"])
+                             "health", "requests", "optimizer", "fleet",
+                             "storage"])
     sp.add_argument("trace_id", nargs="?",
                     help="trace to export (trace subcommand); default: "
                          "the newest cycle record's trace")

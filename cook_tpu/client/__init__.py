@@ -678,6 +678,13 @@ class JobClient:
         hot-spots, and last-scrape age (docs/OBSERVABILITY.md)."""
         return self._request("GET", "/debug/fleet")
 
+    def debug_storage(self) -> Dict:
+        """GET /debug/storage — the persistence-integrity panel behind
+        ``cs debug storage``: per-partition scrub progress, corruption/
+        repair counters, checkpoint manifest status, mirror poison
+        state (docs/DEPLOY.md corrupted-journal runbook)."""
+        return self._request("GET", "/debug/storage")
+
     def debug_trace_spans(self, trace_id: str) -> Dict:
         """GET /debug/trace/spans — ONE member's raw span-ring docs for
         a trace id; the fleet trace collector's per-member stitch

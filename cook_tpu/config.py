@@ -773,6 +773,66 @@ class AdmissionConfig:
 
 
 @dataclass
+class StorageConfig:
+    """Storage-integrity plane (the daemon's ``"storage"`` conf section;
+    docs/ROBUSTNESS.md "WAL v2"): the monitor's background scrub
+    incrementally re-verifies journal CRC32C frames
+    (:meth:`~cook_tpu.state.store.Store.scrub`), a leader self-heals
+    scrub-detected corruption by checkpointing (its memory is
+    authoritative), and the boot hygiene sweep's minimum orphan age is
+    tunable for shared-dir topologies."""
+
+    #: master switch for the monitor-driven background scrub sweep
+    scrub_enabled: bool = True
+    #: seconds between scrub steps (each step verifies one chunk; the
+    #: monitor sweep itself runs on monitor_interval_seconds, so the
+    #: effective cadence is the max of the two)
+    scrub_interval_seconds: float = 30.0
+    #: journal bytes verified per scrub step — bounds the read burst a
+    #: step may impose on the journal disk
+    scrub_chunk_bytes: int = 1 << 20
+    #: leader self-heal: checkpoint (fresh verified snapshot, damaged
+    #: journal rotated aside) when the scrub finds corruption.  Off =
+    #: detect-and-report only (the operator repairs per docs/DEPLOY.md).
+    checkpoint_on_corruption: bool = True
+    #: minimum age before the boot hygiene sweep unlinks an orphaned
+    #: ``.tmp.`` atomic-write leftover or stale poison marker — a LIVE
+    #: writer's in-flight temp in a shared dir must survive
+    hygiene_min_age_seconds: float = 60.0
+    #: per-peer timeout for the quarantine-and-pull repair path
+    #: (state/repair.py)
+    repair_timeout_seconds: float = 30.0
+
+    def __post_init__(self):
+        for k in ("scrub_interval_seconds", "hygiene_min_age_seconds"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"storage {k} must be >= 0")
+        if not isinstance(self.scrub_chunk_bytes, int) \
+                or self.scrub_chunk_bytes <= 0:
+            raise ValueError("storage scrub_chunk_bytes must be an "
+                             f"int > 0, got {self.scrub_chunk_bytes!r}")
+        if float(self.repair_timeout_seconds) <= 0:
+            raise ValueError("storage repair_timeout_seconds must be > 0")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "StorageConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown storage key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"storage key {k!r} must be a JSON "
+                                     f"boolean, got {v!r}")
+                setattr(cfg, k, v)
+            else:
+                setattr(cfg, k, type(default)(v))
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker
@@ -886,6 +946,10 @@ class Config:
     # (sched/admission.py, policy/rate_limit.py; docs/DEPLOY.md
     # "overload runbook")
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # storage-integrity plane: background CRC scrub + corruption
+    # self-heal + hygiene-sweep tuning (state/integrity.py,
+    # state/repair.py; docs/ROBUSTNESS.md "WAL v2")
+    storage: StorageConfig = field(default_factory=StorageConfig)
     # the real optimizer loop (sched/optimizer.py): a
     # ``sched.optimizer.OptimizerConfig`` when the daemon's "optimizer"
     # conf section enables it, else None (loop off).  Held untyped here

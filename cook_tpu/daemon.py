@@ -175,6 +175,17 @@ def build_scheduler_config(spec: Dict) -> Config:
         # overload it was configured to survive
         from .config import AdmissionConfig
         cfg.admission = AdmissionConfig.from_conf(spec["admission"])
+    if "storage" in spec:
+        # storage-integrity plane (docs/ROBUSTNESS.md "WAL v2"): scrub
+        # cadence/chunk, corruption self-heal, hygiene-sweep age; a
+        # typo'd knob fails the boot like the sections above
+        from .config import StorageConfig
+        cfg.storage = StorageConfig.from_conf(spec["storage"])
+        from .state import integrity as _integrity
+        # Store.open's hygiene sweep runs before any config object is
+        # reachable from the store, so the knob lands module-level
+        _integrity.HYGIENE_MIN_AGE_S = \
+            float(cfg.storage.hygiene_min_age_seconds)
     k8s = spec.get("kubernetes") or {}
     cfg.kubernetes_disallowed_container_paths = list(
         k8s.get("disallowed_container_paths", []))

@@ -69,7 +69,7 @@ from ..state.schema import (
     to_json,
 )
 from ..state.store import (AbortTransaction, ReplicationIndeterminate,
-                           Store)
+                           StorageFullError, Store)
 from . import task_stats
 
 
@@ -124,6 +124,10 @@ API_ROUTES = [
     ("GET", "/debug/health",
      "one-shot health roll-up: SLO burn rates, breakers, replication "
      "lag, pipeline depth, repack counters, audit queue depth", False),
+    ("GET", "/debug/storage",
+     "persistence-integrity panel: per-partition scrub progress, "
+     "corruption/repair counters, checkpoint manifest status, mirror "
+     "poison state", False),
     ("GET", "/debug/optimizer",
      "goodput optimizer panel: last per-pool decisions, cycle "
      "counts/errors, elastic resize plane state", False),
@@ -2035,6 +2039,15 @@ class CookApi:
             # applied offset, no reads-served count
             health["read_view"] = {**rv.stats(),
                                    "reads_served": self.follower_reads}
+        # persistence-integrity roll-up (full detail: /debug/storage) —
+        # a poisoned journal or a corrupt mirror is NOT healthy even
+        # while the process keeps serving its verified prefix
+        storage = self.debug_storage()
+        health["storage"] = {
+            k: storage.get(k)
+            for k in ("poisoned", "corruptions", "repairs",
+                      "enospc_aborts", "mirror_corrupt")
+            if storage.get(k) is not None}
         # burning past budget, a fenced store, or a potential-deadlock
         # lock graph is not healthy
         if any(s["value"] > 1.0 for s in health["slo_burn_rates"]) \
@@ -2042,12 +2055,60 @@ class CookApi:
                 or health["locks"]["violations"] \
                 or health["locks"]["blocking_events"]:
             health["healthy"] = False
+        if storage.get("poisoned") or storage.get("mirror_corrupt"):
+            health["healthy"] = False
         if rv is not None and saturation["follower_staleness"] >= 1.0:
             # a follower serving reads staler than the red line
             # (fleet.staleness_red_line_seconds) is NOT healthy — the
             # exact "looks healthier than it is" gap this block closes
             health["healthy"] = False
         return health
+
+    def debug_storage(self) -> Dict:
+        """GET /debug/storage — the persistence-integrity panel `cs
+        debug storage` renders: per-partition scrub progress (last
+        verified offset vs journal size), corruption/repair counters,
+        checkpoint manifest status, ENOSPC aborts, boot hygiene, and —
+        on a follower — the read view's poison state
+        (docs/DEPLOY.md corrupted-journal runbook)."""
+        from ..state.partition import substores
+        shards: List[Dict[str, Any]] = []
+        for shard in substores(self.store):
+            try:
+                shards.append(shard.storage_stats())
+            except Exception as e:  # pragma: no cover — defensive
+                shards.append({"error": str(e)})
+        doc: Dict[str, Any] = {
+            "shards": shards,
+            "poisoned": any(s.get("journal_poisoned") for s in shards),
+            "corruptions": sum(int(s.get("scrub_corruptions", 0) or 0)
+                               for s in shards),
+            "repairs": sum(int(s.get("scrub_repairs", 0) or 0)
+                           for s in shards),
+            "enospc_aborts": sum(int(s.get("enospc_aborts", 0) or 0)
+                                 for s in shards),
+            "hygiene_removed": sum(int(s.get("hygiene_removed", 0) or 0)
+                                   for s in shards),
+        }
+        sc = getattr(self.config, "storage", None)
+        if sc is not None:
+            doc["scrub"] = {
+                "enabled": bool(sc.scrub_enabled),
+                "interval_seconds": sc.scrub_interval_seconds,
+                "chunk_bytes": sc.scrub_chunk_bytes,
+                "checkpoint_on_corruption":
+                    bool(sc.checkpoint_on_corruption),
+            }
+        rv = self.read_view
+        if rv is not None:
+            st = rv.stats()
+            doc["read_view"] = {
+                k: st.get(k)
+                for k in ("offset", "epoch", "jobs", "corrupt")
+                if st.get(k) is not None}
+            doc["mirror_corrupt"] = \
+                getattr(rv, "corrupt", None) is not None
+        return doc
 
     def debug_job_timeline(self, uuid: str) -> Dict:
         """GET /debug/job/<uuid>/timeline — the job's full decision
@@ -2805,6 +2866,22 @@ class _Handler(BaseHTTPRequestHandler):
             # is applied locally but unconfirmed on the mirror
             self._respond(504, {"error": str(e), "indeterminate": True,
                                 "request_id": self._request_id})
+        except StorageFullError as e:
+            # ENOSPC clean abort (state/store.py): the journal excised
+            # the torn append, in-memory state matches disk, nothing was
+            # committed.  Escalation happens HERE rather than inside the
+            # store because force_shed_writes journals its stage flip —
+            # doing that under the store lock on a full disk would
+            # recurse into the same failing append.
+            try:
+                ctrl = self.api.admission_controller()
+                if ctrl is not None:
+                    ctrl.force_shed_writes("storage:enospc")
+            except Exception:
+                pass
+            self._respond(503, {"error": str(e), "storage_full": True,
+                                "request_id": self._request_id},
+                          extra_headers={"Retry-After": "30"})
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": f"internal error: {e}",
                                 "request_id": self._request_id})
@@ -2813,7 +2890,8 @@ class _Handler(BaseHTTPRequestHandler):
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
                     "/debug/trace/spans", "/debug/fleet",
                     "/debug/faults", "/debug/replication",
-                    "/debug/requests", "/debug/health", "/metrics",
+                    "/debug/requests", "/debug/health", "/debug/storage",
+                    "/metrics",
                     "/metrics/fleet",
                     "/failure_reasons", "/settings", "/swagger-docs",
                     "/swagger-ui"}
@@ -2997,6 +3075,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_requests(params)
             if path == "/debug/health":
                 return api.debug_health()
+            if path == "/debug/storage":
+                return api.debug_storage()
             if path == "/debug/optimizer":
                 return api.debug_optimizer()
             if path == "/debug/trace/spans":

@@ -210,6 +210,33 @@ class AdmissionController:
             self.stage -= 1
             self._above_since_ms = now
 
+    # ------------------------------------------------- storage escalation
+    def force_shed_writes(self, reason: str) -> None:
+        """Jump straight to stage 3 (shed-writes) outside the saturation
+        loop — the storage plane's ENOSPC clean-abort path
+        (state/store.py ``StorageFullError``).  A full disk is not a
+        load problem the level feedback can see, but the remedy is the
+        same shed surface: stop admitting low-priority writes before
+        retries hammer a journal that cannot append.  The level is
+        pinned below ``shed_writes_level`` so :meth:`_step_stage` holds
+        the stage; normal dwell-gated recovery applies once appends
+        succeed again (and a still-full disk re-forces on the next
+        failed write).  The journaled flip is best-effort by
+        construction — the disk that triggered this is the same disk
+        the flip record would land on."""
+        if self.stage >= 3:
+            return
+        prev_stage = self.stage
+        self.stage = 3
+        self.level = min(self.level,
+                         max(self.ac.level_floor,
+                             self.ac.shed_writes_level - 1e-6))
+        self._above_since_ms = None
+        self.worst_resource = reason
+        self._apply_level()
+        self._flip(prev_stage)
+        self._publish()
+
     # -------------------------------------------------------- stage flip
     def _flip(self, prev_stage: int) -> None:
         now = self._now_ms()

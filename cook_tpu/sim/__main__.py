@@ -1,7 +1,10 @@
 """CLI: python -m cook_tpu.sim --trace trace.json --hosts hosts.json
      or: python -m cook_tpu.sim --workload spec.json [--emit-trace t.json]
      or: python -m cook_tpu.sim --chaos [--seed N]  (fault-schedule run
-         with invariant checks, sim/chaos.py; exit 1 on violations)"""
+         with invariant checks, sim/chaos.py; exit 1 on violations)
+     or: python -m cook_tpu.sim --crashpoints  (exhaustive disk-fault /
+         crash-point recovery matrix, sim/crashpoint.py; exit 1 on any
+         storage-contract violation)"""
 
 import argparse
 import json
@@ -105,12 +108,37 @@ def main(argv=None) -> int:
     p.add_argument("--overload-multiple", type=float, default=None,
                    help="overload: offered load as a multiple of "
                         "sustainable capacity (default 10)")
+    p.add_argument("--crashpoints", action="store_true",
+                   help="run the exhaustive crash-point recovery matrix "
+                        "(sim/crashpoint.py): every disk-fault site at "
+                        "every append index, every record byte boundary "
+                        "truncation, per-record bit flips with peer "
+                        "repair, checkpoint crash windows; exit 1 on "
+                        "any committed-write loss, phantom, refused "
+                        "torn tail, or non-byte-identical repair")
+    p.add_argument("--crashpoint-stride", type=int, default=None,
+                   help="crashpoints: subsample the fault-site append "
+                        "indices (default 1 = every index)")
+    p.add_argument("--disk-faults", type=float, default=None,
+                   help="chaos: per-append fire probability for the "
+                        "store.journal.bitflip point on the leader's "
+                        "journal during the failover legs — recovery "
+                        "must detect the damage and still converge "
+                        "(docs/ROBUSTNESS.md WAL v2)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
                         "launched job set, no duplicate live instances; "
                         "exit 1 on divergence")
     args = p.parse_args(argv)
+
+    if args.crashpoints:
+        from .crashpoint import run_crashpoints
+        cres = run_crashpoints(
+            n_jobs=args.jobs or 4,
+            stride=args.crashpoint_stride or 1)
+        print(json.dumps(cres.summary(), indent=2))
+        return 0 if cres.ok else 1
 
     if args.parity_pipeline:
         from .simulator import run_pipeline_parity
@@ -170,6 +198,8 @@ def main(argv=None) -> int:
             cc.resident = True
         if args.delta_faults is not None:
             cc.delta_fault_probability = args.delta_faults
+        if args.disk_faults is not None:
+            cc.disk_fault_probability = args.disk_faults
         result = run_chaos(cc)
         print(json.dumps(result.summary(), indent=2))
         return 0 if result.ok else 1

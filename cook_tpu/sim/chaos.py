@@ -37,6 +37,7 @@ Run it:  ``python -m cook_tpu.sim --chaos [--seed N]`` or
 from __future__ import annotations
 
 import json
+import os
 import random
 import tempfile
 from dataclasses import dataclass, field
@@ -45,6 +46,7 @@ from typing import Dict, List, Optional
 from ..cluster.fake import FakeCluster
 from ..config import Config
 from ..sched.scheduler import Scheduler
+from ..state.integrity import JournalCorruptionError
 from ..state.schema import InstanceStatus, JobState, Reasons
 from ..state.store import Store
 from ..utils.faults import injector
@@ -133,6 +135,14 @@ class ChaosConfig:
     overload: bool = False
     overload_launch_rate_per_min: float = 30.0
     overload_launch_burst: float = 2.0
+    # disk-fault chaos (docs/ROBUSTNESS.md WAL v2): silent bit flips on
+    # the leader's journal stream at this per-append probability
+    # (``store.journal.bitflip``).  The leader-kill leg then asserts
+    # the storage-integrity contract end to end: the scrub self-heal
+    # detects and repairs every flip (checkpoint from the in-memory
+    # authority), and promotion replays with zero committed-txn loss —
+    # a flip the scrub missed would REFUSE the successor's open
+    disk_fault_probability: float = 0.0
 
 
 @dataclass
@@ -165,6 +175,10 @@ class ChaosResult:
     brownout_stage_at_kill: int = -1
     brownout_stage_recovered: int = -1
     min_admission_level: float = 1.0
+    # disk-fault chaos: journal corruptions the pre-promotion scrub
+    # detected and healed (each one was a silent bit flip the CRC
+    # envelope caught)
+    disk_corruptions_healed: int = 0
 
     @property
     def ok(self) -> bool:
@@ -194,8 +208,30 @@ class ChaosResult:
             "brownout_stage_at_kill": self.brownout_stage_at_kill,
             "brownout_stage_recovered": self.brownout_stage_recovered,
             "min_admission_level": round(self.min_admission_level, 4),
+            "disk_corruptions_healed": self.disk_corruptions_healed,
             "flight": self.flight,
         }
+
+
+def _scrub_heal(store: Store, result: "ChaosResult") -> None:
+    """Drain the background-scrub contract over the whole journal in
+    one call: disarm the flip point, then scrub windows until the file
+    verifies end to end, healing every CRC hit via the checkpoint
+    self-repair (state/store.py Store.scrub)."""
+    injector.disarm("store.journal.bitflip")
+    while True:
+        doc = store.scrub(max_bytes=1 << 20, repair=True)
+        if doc.get("corrupt"):
+            result.disk_corruptions_healed += 1
+            if not doc.get("repaired"):
+                result.violations.append(
+                    "disk-fault scrub detected corruption but failed "
+                    f"to self-heal: {doc}")
+                return
+            continue
+        if not doc.get("enabled") or doc.get("verified_offset", 0) \
+                >= doc.get("journal_bytes", 0):
+            return
 
 
 class _LeaderCrash(BaseException):
@@ -309,6 +345,12 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                      probability=cc.delta_fault_probability)
         injector.arm("delta.apply",
                      probability=cc.delta_fault_probability)
+    if cc.disk_fault_probability > 0:
+        # silent media rot under the live appender: no error surfaces
+        # at flip time by design — the CRC envelope must catch it at
+        # scrub/replay (state/integrity.py)
+        injector.arm("store.journal.bitflip",
+                     probability=cc.disk_fault_probability)
     flight_seq0 = flight_recorder.last_seq()
 
     cfg = _scheduler_config(cc)
@@ -469,11 +511,34 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
         probe_uuid = next(
             (j.uuid for j, _i in store.running_instances()
              if j.uuid not in crashed_jobs), None)
+        if cc.disk_fault_probability > 0:
+            # drain the background-scrub contract before the crash: the
+            # injected flips are SILENT, so promotion only survives if
+            # the CRC scrub detects every one and self-heals (checkpoint
+            # from the in-memory authority).  A missed flip refuses the
+            # successor's open below — that's the violation under test.
+            _scrub_heal(store, result)
         pre = json.loads(store.snapshot())
         store.close()  # crash-equivalent: no checkpoint, journal as-is
         # promotion: the successor re-reads everything the dead leader
         # committed (snapshot + journal replay)
-        store = Store.open(data_dir)
+        try:
+            store = Store.open(data_dir)
+        except JournalCorruptionError as e:
+            # a flip the scrub heal missed: committed history is
+            # unreadable — record the contract violation, then restore
+            # the pre-crash snapshot so the rest of the run still
+            # reports its other invariants
+            result.violations.append(
+                "promotion refused the journal after the scrub heal: "
+                f"{e}")
+            from ..state.repair import quarantine
+            from ..utils.fsatomic import write_atomic_text
+            quarantine(data_dir)
+            write_atomic_text(
+                os.path.join(data_dir, "snapshot.json"),
+                json.dumps(pre))
+            store = Store.open(data_dir)
         post = json.loads(store.snapshot())
         # tx_id counts every transaction including write-free ones (an
         # all-deny launch guard journals nothing); entity state is the
@@ -691,14 +756,25 @@ def run_chaos(cc: Optional[ChaosConfig] = None) -> ChaosResult:
                 "injected (mea-culpa) failures")
 
     # the journal IS the state: a fresh replay must reproduce the final
-    # store exactly (what the NEXT promotion would read)
+    # store exactly (what the NEXT promotion would read).  Under disk
+    # faults the scrub heal runs first — flips injected since the last
+    # sweep would otherwise (correctly) refuse this replay.
+    if cc.disk_fault_probability > 0:
+        _scrub_heal(store, result)
     final_live = json.loads(store.snapshot())
-    final_replayed = json.loads(Store.replay_only(data_dir).snapshot())
-    final_live.pop("tx_id", None)
-    final_replayed.pop("tx_id", None)
-    if final_live != final_replayed:
+    try:
+        final_replayed = json.loads(
+            Store.replay_only(data_dir).snapshot())
+    except JournalCorruptionError as e:
+        final_replayed = None
         result.violations.append(
-            "final journal replay diverges from the live store")
+            f"final journal replay refused after scrub heal: {e}")
+    if final_replayed is not None:
+        final_live.pop("tx_id", None)
+        final_replayed.pop("tx_id", None)
+        if final_live != final_replayed:
+            result.violations.append(
+                "final journal replay diverges from the live store")
 
     result.flight = flight_recorder.summary(since_seq=flight_seq0)
     if cc.overload:

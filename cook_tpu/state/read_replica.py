@@ -31,13 +31,14 @@ atomically and notifying ``on_swap`` subscribers (the REST layer points
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.locks import named_lock
+from ..utils.metrics import registry
+from .integrity import FrameError, parse_journal_line
 from .store import Store, _scan_journal
 
 
@@ -87,6 +88,12 @@ class FollowerReadView:
         self._offset = 0
         self._max_ep = 0
         self._base_sig: Any = None
+        #: non-None when the mirror bytes failed frame verification
+        #: (``{"offset", "reason"}``): the view STOPS advancing and
+        #: serves only the verified prefix until repair_from_peer (or a
+        #: clean re-base) heals the mirror — poisoned state is never
+        #: served as fresh
+        self.corrupt: Optional[Dict[str, Any]] = None
         self._rebuild()
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -128,6 +135,7 @@ class FollowerReadView:
                 "age_ms": round(self.age_ms(), 1),
                 "applied_records": self.applied_records,
                 "rebuilds": self.rebuilds,
+                **({"corrupt": self.corrupt} if self.corrupt else {}),
                 **({"partition": f"p{self.partition_id}"}
                    if self.partition_id is not None else {})}
 
@@ -221,10 +229,39 @@ class FollowerReadView:
             snap_sig = None
         return (token, snap_sig)
 
+    def _mark_corrupt(self, offset: int, reason: str) -> None:
+        """First sighting of mirror damage: remember it (the view stops
+        advancing and keeps serving the verified prefix), count it, and
+        drop a ``mirror_poisoned`` marker so the daemon's health surface
+        and the boot hygiene sweep can see it across restarts."""
+        if self.corrupt is not None:
+            return
+        self.corrupt = {"offset": offset, "reason": reason}
+        registry.counter_inc("cook_journal_corruption",
+                             labels={"source": "mirror"})
+        try:
+            with open(os.path.join(self.directory, "mirror_poisoned"),
+                      "w", encoding="utf-8") as f:
+                f.write(f"{offset} {reason}\n")
+        except OSError:
+            pass
+
+    def _clear_corrupt(self) -> None:
+        if self.corrupt is None:
+            return
+        self.corrupt = None
+        try:
+            os.unlink(os.path.join(self.directory, "mirror_poisoned"))
+        except OSError:
+            pass
+
     def _rebuild(self) -> None:
         """Full rebuild from snapshot + journal (the Store.replay_only
         shape, with the epoch high-water mark kept for later incremental
-        applies)."""
+        applies).  A mirror whose journal fails frame verification
+        rebuilds to the verified PREFIX and marks itself corrupt — the
+        re-base path is also how a repaired mirror (new repl_token)
+        comes back clean."""
         with self._mu:
             self._base_sig = self._base_signature()
             snap = os.path.join(self.directory, "snapshot.json")
@@ -232,7 +269,8 @@ class FollowerReadView:
                                    partition=self.partition_id)
                      if os.path.exists(snap)
                      else Store(partition=self.partition_id))
-            records, good, _size = _scan_journal(self._journal)
+            scan = _scan_journal(self._journal)
+            records, good = scan.records, scan.good
             max_ep = store._replay_records(records)
             swapped = store is not self.store
             self.store = store
@@ -242,6 +280,11 @@ class FollowerReadView:
                 self._offset_cv.notify_all()
             self.rebuilds += 1
             self._caught_up_ts = time.time()
+            if scan.corrupt:
+                self._mark_corrupt(scan.corrupt_offset or good,
+                                   scan.reason)
+            else:
+                self._clear_corrupt()
         if swapped:
             for fn in self._on_swap:
                 fn(store)
@@ -254,6 +297,13 @@ class FollowerReadView:
         size = self.mirror_offset()
         if sig != self._base_sig or size < self._offset:
             self._rebuild()
+            return 0
+        if self.corrupt is not None:
+            # poisoned mirror: hold the verified prefix and wait for a
+            # re-base (repair_from_peer writes a new repl_token, which
+            # the sig check above turns into a clean rebuild) — applying
+            # past the damage would serve records whose provenance the
+            # CRC just disproved
             return 0
         if size <= self._offset:
             self._caught_up_ts = time.time()
@@ -273,11 +323,14 @@ class FollowerReadView:
             text = line.strip()
             if text:
                 try:
-                    recs.append(json.loads(text))
-                except json.JSONDecodeError:
-                    # a torn/garbled line at the head of this window —
-                    # re-scan next tick (the native follower only ever
-                    # appends whole frames, so this resolves)
+                    recs.append(parse_journal_line(text))
+                except FrameError as e:
+                    # a COMPLETE line that fails frame verification is
+                    # mirror corruption, not a mid-append race: the
+                    # native follower only splits lines mid-frame
+                    # (before the newline), and those park on the
+                    # endswith check above
+                    self._mark_corrupt(good, str(e))
                     break
             good += len(line)
         store = self.store
@@ -301,6 +354,39 @@ class FollowerReadView:
             # one-tick lag.
             self._caught_up_ts = time.time()
         return applied
+
+    def repair_from_peer(self, host: str, port: int,
+                         timeout_s: float = 30.0) -> bool:
+        """Heal a corrupt mirror by pulling a fresh full resync from a
+        synced peer over the PR 3 framed-TCP catch-up carrier
+        (:func:`cook_tpu.state.replication.catch_up_from_peer`).  The
+        damaged journal is quarantined as ``journal.jsonl.corrupt``
+        (forensics; docs/DEPLOY.md runbook) and the resync markers are
+        cleared so the transfer starts from the peer's snapshot.  The
+        resync mints a NEW ``repl_token`` — the next poll sees the base
+        change and rebuilds the view from the healed bytes, clearing the
+        poisoned state.  The caller must ensure the native follower that
+        normally feeds this mirror is detached for the duration: two
+        writers on one mirror directory is never safe."""
+        from .replication import catch_up_from_peer
+        d = self.directory
+        try:
+            os.replace(os.path.join(d, "journal.jsonl"),
+                       os.path.join(d, "journal.jsonl.corrupt"))
+        except OSError:
+            pass
+        for marker in ("repl_token", "repl_synced", "repl_following"):
+            try:
+                os.unlink(os.path.join(d, marker))
+            except OSError:
+                pass
+        ok = catch_up_from_peer(host, int(port), d, 0,
+                                timeout_s=timeout_s)
+        if ok:
+            registry.counter_inc("cook_storage_repair",
+                                 labels={"kind": "peer"})
+            self._rebuild()
+        return ok
 
     def _apply_loop(self) -> None:
         while not self._stop.is_set():

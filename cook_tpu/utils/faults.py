@@ -33,6 +33,23 @@ Registered point names (the sites that consult this module):
 ``fused.dispatch``          `sched/fused.py` — whole fused cycle dispatch raises
 ``leader.lease``            `sched/election.py` — lease acquire/renew fails
 ``cluster.launch``          `cluster/fake.py` — backend rejects a launch
+``store.journal.torn_write``  `state/store.py` — a PREFIX of the frame lands
+                            then the write fails (``arg`` = cut byte offset);
+                            exercises the torn-tail excision discipline
+``store.journal.bitflip``   `state/store.py` — one bit flips in the
+                            just-written frame (``arg`` = byte offset), with
+                            NO error surfaced: silent media corruption for
+                            the CRC scrub/replay to catch
+``store.journal.fsync_lie`` `state/store.py` — fsync reports EIO while the
+                            page cache silently drops the dirty frame and
+                            the next fsync succeeds (the ATC'20
+                            "succeeds-after-failure" lie)
+``store.journal.enospc``    `state/store.py` — ENOSPC on append: a clean
+                            abort surfaced as StorageFullError (503 +
+                            admission write-shed, never a dead daemon)
+``fsatomic.fsync``          `utils/fsatomic.py` — fsync of an atomic-write
+                            temp fails (checkpoint/fence publish aborts;
+                            the orphaned temp is the hygiene sweep's prey)
 ==========================  ====================================================
 """
 
@@ -55,11 +72,12 @@ class FaultInjected(RuntimeError):
 
 class _Point:
     __slots__ = ("name", "probability", "schedule", "max_fires",
-                 "calls", "fires")
+                 "calls", "fires", "arg")
 
     def __init__(self, name: str, probability: float = 0.0,
                  schedule: Optional[List[int]] = None,
-                 max_fires: Optional[int] = None):
+                 max_fires: Optional[int] = None,
+                 arg: Optional[Any] = None):
         self.name = name
         self.probability = float(probability)
         # explicit call indices (0-based) that fire, e.g. [2] = third call
@@ -67,12 +85,17 @@ class _Point:
         self.max_fires = max_fires
         self.calls = 0
         self.fires = 0
+        # site-interpreted parameter (e.g. the byte offset a torn write
+        # cuts at, or the byte a bitflip targets) — what lets the
+        # crash-point harness sweep every record byte boundary
+        self.arg = arg
 
     def to_doc(self) -> Dict[str, Any]:
         return {"probability": self.probability,
                 "schedule": sorted(self.schedule),
                 "max_fires": self.max_fires,
-                "calls": self.calls, "fires": self.fires}
+                "calls": self.calls, "fires": self.fires,
+                **({"arg": self.arg} if self.arg is not None else {})}
 
 
 class FaultInjector:
@@ -94,10 +117,11 @@ class FaultInjector:
 
     def arm(self, point: str, probability: float = 0.0,
             schedule: Optional[List[int]] = None,
-            max_fires: Optional[int] = None) -> None:
+            max_fires: Optional[int] = None,
+            arg: Optional[Any] = None) -> None:
         with self._lock:
             self._points[point] = _Point(point, probability, schedule,
-                                         max_fires)
+                                         max_fires, arg)
 
     def disarm(self, point: str) -> None:
         with self._lock:
@@ -119,7 +143,8 @@ class FaultInjector:
             self.arm(name,
                      probability=float(knobs.get("probability", 0.0)),
                      schedule=list(knobs.get("schedule", [])),
-                     max_fires=knobs.get("max_fires"))
+                     max_fires=knobs.get("max_fires"),
+                     arg=knobs.get("arg"))
 
     # ------------------------------------------------------------- firing
     def should_fire(self, point: str) -> bool:
@@ -152,6 +177,13 @@ class FaultInjector:
         if self.should_fire(point):
             raise (exc_factory() if exc_factory is not None
                    else FaultInjected(point))
+
+    def point_arg(self, point: str) -> Optional[Any]:
+        """The armed point's site-interpreted parameter (byte offsets
+        for the disk-fault sites), or None when unarmed/unset."""
+        with self._lock:
+            p = self._points.get(point)
+            return p.arg if p is not None else None
 
     # -------------------------------------------------------------- query
     def active(self) -> Dict[str, Dict[str, Any]]:

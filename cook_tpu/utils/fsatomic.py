@@ -52,10 +52,17 @@ def write_atomic_text(path: str, text: str) -> None:
     head, tail = os.path.split(path)
     tmp = os.path.join(
         head, f".{tail}.tmp.{os.getpid()}.{threading.get_ident()}")
+    # lazy import: fsatomic must stay import-light (the fencing paths
+    # pull it in before most of the package exists)
+    from .faults import injector as _faults
     try:
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(text)
             f.flush()
+            _faults.fire(
+                "fsatomic.fsync",
+                lambda: OSError(5, "injected fsync failure on "
+                                   "atomic-write temp"))
             os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:

@@ -46,6 +46,21 @@ def test_chaos_is_deterministic(tmp_path):
          b.intents_open_at_kill, b.makespan_ms)
 
 
+def test_chaos_disk_faults_heal_before_promotion(tmp_path):
+    """The leader-kill leg under silent bit rot (docs/ROBUSTNESS.md
+    "WAL v2"): ``store.journal.bitflip`` armed on every append, the
+    pre-promotion scrub must detect and self-heal every flip, and the
+    promoted store still replays to the exact pre-crash state.  Seed and
+    probability are pinned so at least one flip actually lands."""
+    cc = ChaosConfig(seed=7, data_dir=str(tmp_path / "df"),
+                     disk_fault_probability=0.25)
+    result = run_chaos(cc)
+    assert result.ok, result.violations
+    assert result.completed == result.total
+    assert result.leader_kills == 1
+    assert result.disk_corruptions_healed > 0
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
 def test_chaos_soak(tmp_path, seed):

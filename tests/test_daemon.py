@@ -303,3 +303,35 @@ def test_build_scheduler_config_validates_matcher_knobs():
     with pytest.raises(ValueError, match="auto_paking"):
         build_scheduler_config({"default_matcher": {
             "auto_paking": "tight"}})
+
+
+def test_build_scheduler_config_validates_storage_section():
+    """The storage-integrity plane's conf section (docs/ROBUSTNESS.md
+    "WAL v2") is boot-validated like the sections above: typo'd keys,
+    non-boolean switches, and nonsense numerics fail the boot, and the
+    hygiene-age knob lands on the module-level sweep default."""
+    import pytest
+    from cook_tpu.daemon import build_scheduler_config
+    from cook_tpu.state import integrity
+
+    before = integrity.HYGIENE_MIN_AGE_S
+    try:
+        cfg = build_scheduler_config({"storage": {
+            "scrub_interval_seconds": 5,
+            "scrub_chunk_bytes": 65536,
+            "hygiene_min_age_seconds": 120}})
+        assert cfg.storage.scrub_interval_seconds == 5.0
+        assert cfg.storage.scrub_chunk_bytes == 65536
+        assert integrity.HYGIENE_MIN_AGE_S == 120.0
+        with pytest.raises(ValueError, match="scrub_chnk_bytes"):
+            build_scheduler_config({"storage": {"scrub_chnk_bytes": 1}})
+        with pytest.raises(ValueError, match="boolean"):
+            build_scheduler_config({"storage": {
+                "scrub_enabled": "false"}})
+        with pytest.raises(ValueError, match="scrub_chunk_bytes"):
+            build_scheduler_config({"storage": {"scrub_chunk_bytes": 0}})
+        with pytest.raises(ValueError, match="repair_timeout_seconds"):
+            build_scheduler_config({"storage": {
+                "repair_timeout_seconds": 0}})
+    finally:
+        integrity.HYGIENE_MIN_AGE_S = before

@@ -408,12 +408,11 @@ class TestJournalEpochFencing:
         assert c2.job(j2.uuid) is not None
 
     def test_takeover_writes_epoch_barrier(self, tmp_path):
-        import json
         from cook_tpu.state import Store
+        from cook_tpu.state.integrity import scan_journal
         d = str(tmp_path / "shared")
         Store.open(d, epoch="auto")
         Store.open(d, epoch="auto")
-        recs = [json.loads(x) for x in
-                open(d + "/journal.jsonl", encoding="utf-8")]
+        recs, _good, _size = scan_journal(d + "/journal.jsonl")
         barriers = [r for r in recs if r.get("barrier")]
         assert [b["ep"] for b in barriers] == [1, 2]

@@ -326,7 +326,13 @@ class TestDebugRequests:
         client = JobClient(srv.url, user="alice")
         _http(srv.url + "/share?user=alice&token=hunter2",
               headers={"X-Cook-User": "alice"})
-        doc = client.debug_requests(limit=10)
+        # same race as the request-id join above: the client can see the
+        # /share response a hair before the finally-block records it
+        deadline = time.time() + 2.0
+        doc: dict = {}
+        while not doc.get("slow") and time.time() < deadline:
+            doc = client.debug_requests(limit=10)
+            time.sleep(0.01)
         assert doc["slow"], "slow ring empty with threshold 0"
         rec = [r for r in doc["slow"]
                if r["endpoint"] == "/share"][-1]

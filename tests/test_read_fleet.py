@@ -240,13 +240,12 @@ class TestFollowerReadView:
         scratch.create_jobs([make_job(1)])
         scratch.create_jobs([make_job(2)])
         scratch.close()
-        lines = (tmp_path / "scratch" /
-                 "journal.jsonl").read_text().splitlines()
-        rec_a, rec_b = json.loads(lines[0]), json.loads(lines[1])
+        from cook_tpu.state.integrity import scan_journal, seal_record
+        (rec_a, rec_b), _good, _size = scan_journal(
+            str(tmp_path / "scratch" / "journal.jsonl"))
         rec_a["ep"] = 2
         rec_b["ep"] = 1  # deposed leader's late append
-        journal.write_text(json.dumps(rec_a) + "\n"
-                           + json.dumps(rec_b) + "\n")
+        journal.write_text(seal_record(rec_a) + seal_record(rec_b))
         view = FollowerReadView(str(d), start=False)
         assert view.store.job(make_job(1).uuid) is not None
         assert view.store.job(make_job(2).uuid) is None

@@ -1,0 +1,516 @@
+"""Storage-integrity plane contracts (docs/ROBUSTNESS.md "WAL v2"):
+the CRC32C journal envelope, torn-tail vs mid-file-corruption verdicts,
+mixed v1/v2 replay, checkpoint manifest fallback, the boot hygiene
+sweep, ENOSPC clean aborts (unit + REST 503 + forced write-shed), the
+background scrub's self-heal, and peer repair of a poisoned mirror.
+
+Layered like test_robustness.py: pure integrity units first, store-level
+recovery contracts, then the serving-plane and replication layers."""
+
+import json
+import os
+import time
+
+import pytest
+
+from cook_tpu.client import JobClient, JobClientError
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config
+from cook_tpu.policy import QueueLimits
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.sched import Scheduler
+from cook_tpu.state.integrity import (
+    FrameError,
+    JournalCorruptionError,
+    crc32c,
+    hygiene_sweep,
+    parse_journal_line,
+    scan_journal,
+    seal_record,
+    verify_snapshot,
+    verify_window,
+)
+from cook_tpu.state.partition import PartitionedStore, PartitionMap
+from cook_tpu.state.read_replica import FollowerReadView
+from cook_tpu.state.repair import open_with_repair, quarantine
+from cook_tpu.state.schema import InstanceStatus, Job, Resources
+from cook_tpu.state.store import StorageFullError, Store
+from cook_tpu.utils.faults import injector
+
+
+def make_job(i, user="alice", pool="default"):
+    return Job(uuid=f"00000000-0000-0000-0000-{i:012d}", user=user,
+               pool=pool, command=f"echo {i}",
+               resources=Resources(cpus=1, mem=64))
+
+
+def run_workload(store, n=4):
+    """Create / launch / transition enough jobs to exercise every
+    journal record shape."""
+    for i in range(n):
+        store.create_jobs([make_job(i)])
+        store.launch_instance(make_job(i).uuid, f"t-{i}", f"h-{i % 2}")
+        store.update_instance_status(f"t-{i}", InstanceStatus.RUNNING)
+        if i % 2 == 0:
+            store.update_instance_status(f"t-{i}", InstanceStatus.SUCCESS)
+
+
+def digest(store):
+    return sorted(
+        (j.uuid, j.state.name,
+         tuple(sorted((t, store.instance(t).status.name)
+                      for t in j.instances)))
+        for j in store.jobs_where(lambda j: True))
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    injector.clear()
+    yield
+    injector.clear()
+
+
+# ---------------------------------------------------------------------------
+# integrity units: frames, scans, windows
+# ---------------------------------------------------------------------------
+
+class TestFrame:
+    def test_seal_parse_roundtrip(self):
+        rec = {"tx": 7, "w": [["jobs", {"uuid": "x"}]], "unicode": "λ"}
+        line = seal_record(rec)
+        assert line.startswith("v2 ") and line.endswith("\n")
+        assert parse_journal_line(line.strip().encode()) == rec
+
+    def test_crc_catches_single_bit_flip(self):
+        line = seal_record({"tx": 1, "payload": "abcdef"}).strip().encode()
+        flipped = bytearray(line)
+        flipped[-3] ^= 0x01
+        with pytest.raises(FrameError) as ei:
+            parse_journal_line(bytes(flipped))
+        # a complete frame failing its CRC can only be corruption
+        assert ei.value.complete
+
+    def test_short_payload_is_incomplete(self):
+        line = seal_record({"tx": 1, "k": "vvvv"}).strip().encode()
+        with pytest.raises(FrameError) as ei:
+            parse_journal_line(line[:-4])
+        assert not ei.value.complete
+
+    def test_v1_bare_json_still_parses(self):
+        assert parse_journal_line(b'{"tx": 3}') == {"tx": 3}
+
+    def test_crc32c_known_vector(self):
+        # iSCSI/ext4 Castagnoli check value for "123456789"
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_crc32c_fallback_agrees_with_active_impl(self):
+        # whichever implementation is active (native wheel or the pure-
+        # Python table), the fallback must produce identical checksums —
+        # a journal sealed on one box must verify on another
+        from cook_tpu.state.integrity import _crc32c_py
+        assert _crc32c_py(b"123456789") == 0xE3069283
+        rng = __import__("random").Random(42)
+        for n in (0, 1, 63, 64, 65, 300):
+            blob = bytes(rng.randrange(256) for _ in range(n))
+            assert crc32c(blob) == _crc32c_py(blob)
+            half = n // 2
+            assert crc32c(blob[half:], crc32c(blob[:half])) == crc32c(blob)
+
+
+class TestScan:
+    def _write(self, tmp_path, chunks):
+        p = os.path.join(str(tmp_path), "journal.jsonl")
+        with open(p, "wb") as f:
+            for c in chunks:
+                f.write(c)
+        return p
+
+    def test_torn_tail_is_excised_not_corrupt(self, tmp_path):
+        whole = seal_record({"tx": 1}).encode()
+        torn = seal_record({"tx": 2}).encode()[:-7]
+        p = self._write(tmp_path, [whole, torn])
+        scan = scan_journal(p)
+        assert not scan.corrupt
+        assert [r["tx"] for r in scan.records] == [1]
+        assert scan.good == len(whole)
+
+    def test_midfile_garbage_with_records_after_is_corruption(
+            self, tmp_path):
+        p = self._write(tmp_path, [seal_record({"tx": 1}).encode(),
+                                   b"#### garbage ####\n",
+                                   seal_record({"tx": 2}).encode()])
+        scan = scan_journal(p)
+        assert scan.corrupt
+        assert scan.corrupt_offset == len(seal_record({"tx": 1}))
+
+    def test_complete_frame_crc_fail_at_tail_is_corruption(
+            self, tmp_path):
+        bad = bytearray(seal_record({"tx": 2}).encode())
+        bad[-3] ^= 0x10  # inside the payload, newline intact
+        p = self._write(tmp_path, [seal_record({"tx": 1}).encode(),
+                                   bytes(bad)])
+        assert scan_journal(p).corrupt
+
+    def test_legacy_triple_unpack(self, tmp_path):
+        p = self._write(tmp_path, [seal_record({"tx": 1}).encode()])
+        records, good, size = scan_journal(p)
+        assert [r["tx"] for r in records] == [1] and good == size
+
+    def test_verify_window_walks_the_file(self, tmp_path):
+        lines = [seal_record({"tx": i}).encode() for i in range(20)]
+        p = self._write(tmp_path, lines)
+        off, size = 0, os.path.getsize(p)
+        while off < size:
+            res = verify_window(p, off, 64)
+            assert not res.corrupt
+            assert res.good > off  # progress every pass
+            off = res.good
+        assert off == size
+
+    def test_verify_window_finds_midfile_damage(self, tmp_path):
+        lines = [seal_record({"tx": i}).encode() for i in range(5)]
+        p = self._write(tmp_path, lines)
+        with open(p, "r+b") as f:
+            f.seek(len(lines[0]) + len(lines[1]) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0x20]))
+        res = verify_window(p, 0, 1 << 20)
+        assert res.corrupt and res.corrupt_offset == len(lines[0])
+
+
+# ---------------------------------------------------------------------------
+# store-level recovery: mixed v1/v2 replay, manifest fallback, hygiene
+# ---------------------------------------------------------------------------
+
+def _downgrade_alternate_lines(journal):
+    """Rewrite every other v2 frame as its bare-JSON v1 form — the
+    mixed-version journal an in-place upgrade produces."""
+    out = []
+    with open(journal, "rb") as f:
+        for i, line in enumerate(f.read().splitlines()):
+            rec = parse_journal_line(line.strip())
+            out.append(json.dumps(rec) + "\n" if i % 2
+                       else seal_record(rec))
+    with open(journal, "w", encoding="utf-8") as f:
+        f.writelines(out)
+
+
+class TestMixedReplay:
+    def test_store_replays_v1_and_v2_interleaved(self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        run_workload(store)
+        expected = digest(store)
+        store.close()
+        _downgrade_alternate_lines(os.path.join(d, "journal.jsonl"))
+        assert not scan_journal(os.path.join(d, "journal.jsonl")).corrupt
+        reopened = Store.open(d)
+        assert digest(reopened) == expected
+        reopened.close()
+
+    def test_partitioned_store_replays_mixed_shards(self, tmp_path):
+        pmap = PartitionMap(count=2, pools={"alpha": 0, "beta": 1})
+        d = str(tmp_path / "ps")
+        ps = PartitionedStore.open(d, pmap)
+        for i, pool in enumerate(["alpha", "beta", "alpha", "beta"]):
+            ps.create_jobs([make_job(i, pool=pool)])
+            ps.launch_instance(make_job(i).uuid, f"t-{i}", "h-0")
+        expected = digest(ps)
+        ps.close()
+        for sub in os.listdir(d):
+            j = os.path.join(d, sub, "journal.jsonl")
+            if os.path.exists(j):
+                _downgrade_alternate_lines(j)
+        reopened = PartitionedStore.open(d, pmap)
+        assert digest(reopened) == expected
+        reopened.close()
+
+    def test_read_view_replays_mixed_journal(self, tmp_path):
+        d = str(tmp_path / "rv")
+        store = Store.open(d)
+        run_workload(store)
+        store.checkpoint()  # the view's base snapshot
+        for i in range(4, 7):
+            store.create_jobs([make_job(i)])
+        expected = digest(store)
+        store.close()
+        _downgrade_alternate_lines(os.path.join(d, "journal.jsonl"))
+        view = FollowerReadView(d, start=False)
+        try:
+            view.poll()
+            assert view.corrupt is None
+            assert digest(view.store) == expected
+        finally:
+            view.stop()
+
+
+class TestManifestFallback:
+    def test_damaged_snapshot_falls_back_to_prev_generation(
+            self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        run_workload(store, n=2)
+        store.checkpoint()
+        store.create_jobs([make_job(7)])
+        store.checkpoint()  # rotation keeps gen N-1 aside
+        store.create_jobs([make_job(8)])
+        expected = digest(store)
+        store.close()
+        snap = os.path.join(d, "snapshot.json")
+        assert verify_snapshot(snap) is True
+        with open(snap, "r+b") as f:
+            f.seek(os.path.getsize(snap) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0x40]))
+        assert verify_snapshot(snap) is False
+        reopened = Store.open(d)
+        assert digest(reopened) == expected
+        reopened.close()
+
+    def test_sole_damaged_generation_refuses(self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        run_workload(store, n=2)
+        store.checkpoint()
+        store.close()
+        snap = os.path.join(d, "snapshot.json")
+        with open(snap, "r+b") as f:
+            f.write(b"X")
+        with pytest.raises(JournalCorruptionError):
+            Store.open(d)
+
+
+class TestHygiene:
+    def test_sweep_removes_old_orphans_keeps_young(self, tmp_path):
+        d = str(tmp_path)
+        old_tmp = os.path.join(d, ".snapshot.json.tmp.123.456")
+        young_tmp = os.path.join(d, ".snapshot.json.tmp.789.012")
+        marker = os.path.join(d, "mirror_poisoned")
+        normal = os.path.join(d, "journal.jsonl")
+        for p in (old_tmp, young_tmp, marker, normal):
+            with open(p, "w") as f:
+                f.write("x")
+        past = time.time() - 3600
+        os.utime(old_tmp, (past, past))
+        os.utime(marker, (past, past))
+        assert hygiene_sweep(d, min_age_s=60) == 2
+        assert not os.path.exists(old_tmp)
+        assert not os.path.exists(marker)
+        assert os.path.exists(young_tmp)  # a live writer's in-flight temp
+        assert os.path.exists(normal)
+
+    def test_store_open_runs_the_sweep_and_counts_it(self, tmp_path):
+        d = str(tmp_path / "s")
+        os.makedirs(d)
+        orphan = os.path.join(d, ".config.json.tmp.1.2")
+        with open(orphan, "w") as f:
+            f.write("{}")
+        past = time.time() - 3600
+        os.utime(orphan, (past, past))
+        store = Store.open(d)
+        assert not os.path.exists(orphan)
+        assert store.storage_stats()["hygiene_removed"] == 1
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC: clean abort at the store, 503 + write-shed at the front door
+# ---------------------------------------------------------------------------
+
+class TestEnospc:
+    def test_full_disk_aborts_cleanly(self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        store.create_jobs([make_job(0)])
+        injector.arm("store.journal.enospc", probability=1.0)
+        with pytest.raises(StorageFullError):
+            store.create_jobs([make_job(1)])
+        injector.clear()
+        # nothing installed in memory, nothing torn on disk: the journal
+        # replays to exactly the pre-abort state
+        assert store.job(make_job(1).uuid) is None
+        assert store.storage_stats()["enospc_aborts"] == 1
+        expected = digest(store)
+        store.close()
+        reopened = Store.open(d)
+        assert digest(reopened) == expected
+        reopened.close()
+
+    def test_rest_503_sheds_writes_keeps_reads(self, tmp_path):
+        store = Store.open(str(tmp_path / "s"))
+        cluster = FakeCluster(
+            "fake-1", [FakeHost("h0", Resources(cpus=8, mem=8192))])
+        cfg = Config()
+        cfg.default_matcher.backend = "cpu"
+        cfg.admission.enabled = True
+        sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+        api = CookApi(store, scheduler=sched, config=cfg,
+                      queue_limits=QueueLimits(store, per_user_limit=100))
+        server = ApiServer(api)
+        server.start()
+        try:
+            client = JobClient(server.url, user="alice")
+            client.throttle_retries = 0  # surface the 503, don't pace
+            ok_uuid = client.submit_one("echo hi", cpus=1, mem=64)
+            injector.arm("store.journal.enospc", probability=1.0)
+            with pytest.raises(JobClientError) as ei:
+                client.submit_one("echo blocked", cpus=1, mem=64)
+            assert ei.value.status == 503
+            assert ei.value.body.get("storage_full") is True
+            assert ei.value.retry_after_s is not None
+            # the failed append escalated the brownout ladder to
+            # shed-writes (stage 3) so retry storms die at the front
+            # door instead of hammering a full disk
+            assert sched.admission is not None
+            assert sched.admission.stage == 3
+            # reads keep serving through the whole episode
+            assert client.job(ok_uuid)["state"] == "waiting"
+            assert api.debug_storage()["enospc_aborts"] >= 1
+        finally:
+            injector.clear()
+            server.stop()
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# the /debug/storage surface (REST + client + cs CLI)
+# ---------------------------------------------------------------------------
+
+class TestDebugStorageSurface:
+    def test_panel_serves_over_http_client_and_cli(
+            self, tmp_path, capsys, monkeypatch):
+        import urllib.request
+        from cook_tpu.cli.main import main as cli_main
+        store = Store.open(str(tmp_path / "s"))
+        run_workload(store, n=2)
+        api = CookApi(store, config=Config())
+        server = ApiServer(api)
+        server.start()
+        try:
+            # raw HTTP: the panel is a plain GET, no auth gymnastics
+            resp = urllib.request.urlopen(server.url + "/debug/storage")
+            assert resp.status == 200
+            doc = json.load(resp)
+            assert doc["poisoned"] is False
+            assert doc["corruptions"] == 0
+            (shard,) = doc["shards"]
+            assert shard["journal_bytes"] > 0
+            assert shard["journal_poisoned"] is False
+            # Config() wires the scrub block from config.storage
+            assert doc["scrub"]["enabled"] is True
+            assert doc["scrub"]["chunk_bytes"] > 0
+            # client wrapper returns the same panel
+            assert JobClient(server.url).debug_storage() == doc
+            # and `cs debug storage` renders it as JSON on stdout
+            monkeypatch.setenv("COOK_URL", server.url)
+            rc = cli_main(["debug", "storage"])
+            assert rc == 0
+            printed = json.loads(capsys.readouterr().out)
+            assert printed["shards"] == doc["shards"]
+        finally:
+            server.stop()
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# scrub self-heal + peer repair
+# ---------------------------------------------------------------------------
+
+class TestScrubAndRepair:
+    def _flip_journal_byte(self, d, frac=0.5):
+        j = os.path.join(d, "journal.jsonl")
+        size = os.path.getsize(j)
+        with open(j, "r+b") as f:
+            f.seek(int(size * frac))
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0x08]))
+
+    def test_scrub_detects_and_self_heals_live_store(self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        run_workload(store)
+        expected = digest(store)
+        self._flip_journal_byte(d)
+        hit = {}
+        while True:
+            doc = store.scrub(max_bytes=256, repair=True)
+            if doc.get("corrupt"):
+                hit = doc
+                break
+            assert doc.get("enabled")
+            if doc.get("verified_offset", 0) >= doc.get(
+                    "journal_bytes", 0):
+                break
+        assert hit and hit["repaired"]
+        stats = store.storage_stats()
+        assert stats["scrub_corruptions"] == 1
+        assert stats["scrub_repairs"] == 1
+        store.close()
+        # the self-heal checkpointed from the in-memory authority: a
+        # cold replay now verifies clean and reproduces the state
+        reopened = Store.open(d)
+        assert digest(reopened) == expected
+        reopened.close()
+
+    def test_cold_open_refuses_then_quarantine_recovers_checkpoint(
+            self, tmp_path):
+        d = str(tmp_path / "s")
+        store = Store.open(d)
+        run_workload(store, n=2)
+        store.checkpoint()
+        store.create_jobs([make_job(9)])
+        store.close()
+        self._flip_journal_byte(d)
+        with pytest.raises(JournalCorruptionError):
+            Store.open(d)
+        with pytest.raises(JournalCorruptionError):
+            open_with_repair(d)  # no peers: refusal must propagate
+        quarantine(d)
+        # the damaged generation is out of replay's way but kept for
+        # forensics; the directory is a blank slate a peer resync (or a
+        # fresh leader) can safely fill — never a silently-truncated
+        # half-state
+        assert os.path.exists(os.path.join(d, "journal.jsonl.corrupt"))
+        assert os.path.exists(os.path.join(d, "snapshot.json.corrupt"))
+        reopened = Store.open(d)
+        assert digest(reopened) == []
+        reopened.close()
+        # every committed frame BEFORE the damage point is still
+        # recoverable from the quarantined bytes
+        scan = scan_journal(os.path.join(d, "journal.jsonl.corrupt"))
+        assert scan.corrupt and scan.records
+
+    def test_open_with_repair_pulls_from_peer(self, tmp_path):
+        from cook_tpu.state.replication import (ReplicationServer,
+                                                replication_available)
+        if not replication_available():
+            pytest.skip("native replication carrier unavailable")
+        pristine = str(tmp_path / "leader")
+        store = Store.open(pristine, fsync=True)
+        run_workload(store)
+        expected = digest(store)
+        server = ReplicationServer(pristine, port=0)
+        try:
+            damaged = str(tmp_path / "damaged")
+            import shutil
+            shutil.copytree(pristine, damaged)
+            self._flip_journal_byte(damaged)
+            with pytest.raises(JournalCorruptionError):
+                Store.open(damaged)
+            healed = open_with_repair(
+                damaged, peers=[("127.0.0.1", server.port)])
+            assert digest(healed) == expected
+            healed.close()
+            with open(os.path.join(pristine, "journal.jsonl"),
+                      "rb") as f:
+                want = f.read()
+            with open(os.path.join(damaged, "journal.jsonl"),
+                      "rb") as f:
+                got = f.read()
+            assert got == want  # byte-identical convergence
+        finally:
+            server.stop()
+            store.close()
