@@ -3086,6 +3086,94 @@ def bench_fleet_obs(submit_total=14_000, batch=20, n_writers=4,
     return out
 
 
+def bench_sharded_cycle(n_jobs=4000, n_users=50, n_pools=8,
+                        hosts_per_pool=25, rounds=8):
+    """Multi-controller scale-out (sched/shard.py): the same
+    deterministic world driven through 1-, 2- and 4-process scheduler
+    topologies — each shard process owns a contiguous pool block
+    end-to-end (own Store, own fused cycle) and sees siblings only
+    through the bounded summary exchange.
+
+    Reported per topology: per-shard cycle p50/p99 (worker-side
+    perf_counter), GLOBAL cycle p50/p99 (wall time for every shard to
+    finish cycle k — the fleet's effective cycle time), and aggregate
+    shard-cycle / pool-cycle throughput.  A parity leg asserts the
+    N-process launched set is bit-identical to single-process.  The
+    canonical shape is 10M pending x 500k hosts across a pod's
+    controllers; this section runs the BENCH_SCALE-scaled shape and
+    reports the measured core count — on a 1-core box the N>1
+    topologies time-slice one core and aggregate throughput CANNOT
+    exceed N=1 (the honest machine-bound note in the artifact)."""
+    from cook_tpu.sched.shard import sched_topology
+
+    world = {"n_jobs": n_jobs, "n_users": n_users,
+             "hosts_per_pool": hosts_per_pool, "seed": 3}
+    pools = [f"pool{i}" for i in range(n_pools)]
+    out = {"shape": {"n_jobs": n_jobs, "n_users": n_users,
+                     "n_pools": n_pools, "hosts_per_pool": hosts_per_pool,
+                     "rounds": rounds,
+                     "canonical": "10M pending x 500k hosts, one "
+                                  "controller process per mesh shard"},
+           "cores": os.cpu_count(), "topologies": {}}
+    decision_sets = {}
+    for n in (1, 2, 4):
+        sup = sched_topology(n, pools, world)
+        shard_ms = {i: [] for i in range(n)}
+        round_wall = []
+        try:
+            # warm: compile the fused cycle in every worker
+            sup.broadcast({"cmd": "cycle", "n": 2}, timeout_s=600)
+            t_all0 = time.perf_counter()
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                resps = sup.broadcast({"cmd": "cycle", "n": 1},
+                                      timeout_s=600)
+                round_wall.append((time.perf_counter() - t0) * 1000.0)
+                for i, resp in enumerate(resps):
+                    shard_ms[i].extend(resp["durations_ms"])
+            wall_s = time.perf_counter() - t_all0
+            decisions = sup.collect_decisions()
+            flight = sup.collect_flight()
+        finally:
+            sup.stop()
+        decision_sets[n] = decisions
+        out["topologies"][str(n)] = {
+            "per_shard": {
+                str(i): {"cycles": len(ms),
+                         "cycle_ms_p50": round(pctl(ms, 50), 3),
+                         "cycle_ms_p99": round(pctl(ms, 99), 3)}
+                for i, ms in shard_ms.items()},
+            "global_cycle_ms_p50": round(pctl(round_wall, 50), 3),
+            "global_cycle_ms_p99": round(pctl(round_wall, 99), 3),
+            "aggregate_shard_cycles_per_s": round(n * rounds / wall_s, 2),
+            "aggregate_pool_cycles_per_s": round(n_pools * rounds / wall_s,
+                                                 2),
+            "jobs_placed": sum(1 for _s, h in decisions.values() if h),
+            "flight_by_shard": sorted(
+                k for f in flight.values()
+                for k in (f.get("by_shard") or {}))}
+        print(f"sharded_cycle n={n}: global p50="
+              f"{out['topologies'][str(n)]['global_cycle_ms_p50']}ms "
+              f"agg={out['topologies'][str(n)]['aggregate_shard_cycles_per_s']}"
+              " shard-cycles/s", file=sys.stderr)
+    out["parity"] = {
+        "n2_vs_n1": decision_sets[2] == decision_sets[1],
+        "n4_vs_n1": decision_sets[4] == decision_sets[1]}
+    agg = {n: out["topologies"][str(n)]["aggregate_shard_cycles_per_s"]
+           for n in (1, 2, 4)}
+    out["speedup"] = {"n2_vs_n1": round(agg[2] / agg[1], 3),
+                      "n4_vs_n1": round(agg[4] / agg[1], 3)}
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        out["machine_bound_note"] = (
+            f"measured on {cores} core(s): the N-shard workers time-slice "
+            "one CPU, so aggregate throughput is bounded at ~1x "
+            "single-process regardless of N — the scale-out claim needs "
+            ">=N cores (or a real mesh); what this box CAN prove is "
+            "decision parity and the per-shard/global latency split")
+    return out
+
+
 # ---------------------------------------------------------------- sections
 # Each section runs in its OWN subprocess with a timeout (round 2 lost its
 # number to a backend-init hang; round 3 then saw a device read wedge
@@ -3186,6 +3274,10 @@ def run_section(name: str) -> None:
         data = bench_fleet_obs(submit_total=scaled(14_000, lo=2800),
                                span_total=scaled(30_000, lo=2000),
                                cycle_jobs=scaled(5000, lo=500))
+    elif name == "sharded_cycle":
+        data = bench_sharded_cycle(n_jobs=scaled(4000, lo=200),
+                                   hosts_per_pool=max(
+                                       4, scaled(25, lo=4)))
     elif name == "pipeline":
         data = bench_pipeline(T=scaled(100_000), n_users=scaled(200, lo=8),
                               H=scaled(5000))
@@ -3421,7 +3513,8 @@ def main():
                 "gang_cycle", "elastic_cycle", "rest_plane", "fused_cycle",
                 "store_cycle", "store_scale", "match_large", "rebalance",
                 "end2end", "pallas_scale", "pipeline",
-                "placement_quality", "fleet_obs", "overload"]
+                "placement_quality", "fleet_obs", "overload",
+                "sharded_cycle"]
     if os.environ.get("BENCH_SECTIONS"):
         # comma-separated subset, e.g. BENCH_SECTIONS=sync_floor,rank,match
         # to re-run just the headline after a transient tunnel failure

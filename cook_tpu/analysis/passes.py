@@ -479,9 +479,92 @@ def pallas_module_constants(path: Path, relpath: str, tree: ast.Module,
     return findings
 
 
+# --------------------------------------------------------------------------
+# pass: partition isolation (the multi-controller ownership boundary)
+# --------------------------------------------------------------------------
+
+#: modules allowed to index/iterate sibling partition stores: the
+#: PartitionedStore / UserSummaryExchange facade itself
+PARTITION_FACADE_FILES = ("state/partition.py",)
+
+
+class _PartitionIsolation(_ScopeWalker):
+    def __init__(self, relpath: str):
+        super().__init__()
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+
+    def _is_partitions_attr(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and node.attr == "partitions")
+
+    def _iter_target(self, it: ast.AST) -> Optional[ast.AST]:
+        """The `.partitions` attribute an iteration walks, unwrapping
+        enumerate()/reversed()/list()."""
+        if self._is_partitions_attr(it):
+            return it
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("enumerate", "reversed", "list",
+                                   "tuple", "sorted"):
+            for arg in it.args:
+                if self._is_partitions_attr(arg):
+                    return arg
+        return None
+
+    def _flag(self, node: ast.AST, attr: ast.AST, how: str) -> None:
+        owner = _dotted(attr.value) or "<expr>"  # type: ignore[attr-defined]
+        self.findings.append(Finding(
+            check="partition-isolation", path=self.relpath,
+            line=node.lineno, scope=self.qualname(),
+            detail=f"{owner}.partitions",
+            message=(f"direct cross-partition store access "
+                     f"(`{owner}.partitions` {how}): one shard process "
+                     "owns one partition's write plane — sibling state "
+                     "crosses only via UserSummaryExchange / the "
+                     "PartitionedStore facade (state/partition.py)")))
+
+    def visit_Subscript(self, node):  # noqa: N802
+        if self._is_partitions_attr(node.value):
+            self._flag(node, node.value, "subscript")
+        self.generic_visit(node)
+
+    def visit_For(self, node):  # noqa: N802
+        attr = self._iter_target(node.iter)
+        if attr is not None:
+            self._flag(node, attr, "iteration")
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_comprehension(self, node):  # noqa: N802
+        attr = self._iter_target(node.iter)
+        if attr is not None:
+            self._flag(node.iter, attr, "iteration")
+        self.generic_visit(node)
+
+
+def partition_isolation(path: Path, relpath: str, tree: ast.Module,
+                        src_lines: Sequence[str]) -> List[Finding]:
+    """Forbid reaching THROUGH the partition boundary: subscripting or
+    iterating a ``.partitions`` store list anywhere outside the
+    state/partition.py facade.  In the multi-controller deployment each
+    partition's Store lives in a different PROCESS — code that indexes a
+    sibling partition's store only works single-process and silently
+    breaks the scale-out contract (cross-pool reads must ride the
+    bounded UserSummaryExchange; routed writes go through
+    PartitionedStore).  Reading a ``PartitionConfig.partitions`` field
+    is fine — only indexing/iterating the store list is flagged."""
+    if relpath in PARTITION_FACADE_FILES:
+        return []
+    walker = _PartitionIsolation(relpath)
+    walker.visit(tree)
+    return walker.findings
+
+
 #: the per-file passes, in run order
 PASSES = (
     ("lock-discipline", lock_discipline),
     ("jit-hygiene", jit_hygiene),
     ("pallas-module-constant", pallas_module_constants),
+    ("partition-isolation", partition_isolation),
 )

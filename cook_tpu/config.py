@@ -418,6 +418,19 @@ class PartitionConfig:
     #: staleness bound of the cross-partition per-user summary exchange
     #: (quota enforcement / global DRU view read through it)
     summary_max_age_seconds: float = 1.0
+    #: controller shard processes (ISSUE 19: one partition block = one
+    #: process = one mesh shard).  0 = unsharded (this daemon owns every
+    #: partition in-process, the classic plane); N > 0 declares an
+    #: N-process topology and must divide ``count`` evenly.  Validated
+    #: against the mesh pool layout at boot
+    #: (parallel.mesh.validate_shard_alignment).
+    shards: int = 0
+    #: operator-declared pool -> mesh shard table, cross-checked at boot
+    #: against the PartitionMap routing — a pool declared on a shard
+    #: other than the one its write-plane partition belongs to is a
+    #: config error (double-owned / orphaned resident buffers), refused
+    #: at daemon start.
+    shard_pools: Dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self):
         if not isinstance(self.count, int) or isinstance(self.count, bool) \
@@ -433,6 +446,26 @@ class PartitionConfig:
         if float(self.summary_max_age_seconds) < 0:
             raise ValueError(
                 "partitions summary_max_age_seconds must be >= 0")
+        if not isinstance(self.shards, int) or isinstance(self.shards, bool) \
+                or self.shards < 0:
+            raise ValueError(
+                f"partitions shards must be an int >= 0, got {self.shards!r}")
+        if self.shards:
+            if self.count % self.shards != 0:
+                raise ValueError(
+                    f"partitions.count ({self.count}) must divide evenly "
+                    f"over partitions.shards ({self.shards}): every "
+                    "controller shard owns an equal contiguous partition "
+                    "block")
+        for pool, idx in (self.shard_pools or {}).items():
+            if not isinstance(idx, int) or isinstance(idx, bool) \
+                    or idx < 0 or (self.shards and idx >= self.shards):
+                raise ValueError(
+                    f"partitions.shard_pools[{pool!r}] must be an int in "
+                    f"[0, {self.shards or '#shards'}), got {idx!r}")
+        if self.shard_pools and not self.shards:
+            raise ValueError(
+                "partitions.shard_pools declared without partitions.shards")
 
     @classmethod
     def from_conf(cls, conf: Dict) -> "PartitionConfig":
@@ -445,6 +478,11 @@ class PartitionConfig:
                     raise ValueError("partitions.pools must be a map of "
                                      "pool name to partition index")
                 cfg.pools = {str(p): i for p, i in v.items()}
+            elif k == "shard_pools":
+                if not isinstance(v, dict):
+                    raise ValueError("partitions.shard_pools must be a map "
+                                     "of pool name to mesh shard index")
+                cfg.shard_pools = {str(p): i for p, i in v.items()}
             else:
                 default = getattr(cfg, k)
                 setattr(cfg, k, type(default)(v))

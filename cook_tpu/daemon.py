@@ -405,6 +405,18 @@ class CookDaemon:
                 self.sched_config.resident_pack = False
             from .state.partition import PartitionedStore, PartitionMap
             pmap = PartitionMap(count=pc.count, pools=pc.pools)
+            if pc.shards or pc.shard_pools:
+                # boot-time cross-check (ISSUE 19 satellite): the
+                # PartitionMap pool groups and the mesh pool_sharding
+                # layout must be the SAME partition — a mismatched
+                # declaration silently double-owns or orphans a pool's
+                # resident buffers, so it fails the boot here with the
+                # offending pool named (ShardAlignmentError is a
+                # ValueError: same config-error surface as the sections
+                # around it)
+                from .parallel.mesh import validate_shard_alignment
+                validate_shard_alignment(pmap, pc.shards or 1,
+                                         pc.shard_pools)
             if not self.data_dir:
                 self.store = PartitionedStore(
                     [Store(partition=i) for i in range(pc.count)], pmap,

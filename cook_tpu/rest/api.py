@@ -1768,13 +1768,20 @@ class CookApi:
 
     def debug_cycles(self, params: Dict) -> Dict:
         """GET /debug/cycles?limit= — the flight recorder's newest-last
-        CycleRecords (docs/OBSERVABILITY.md documents every field)."""
+        CycleRecords (docs/OBSERVABILITY.md documents every field).
+        When sharded cycles are in the ring (ISSUE 19: records carry a
+        ``shard`` id) the response adds the per-shard summary roll-up
+        (cycle count + p50/p99 per shard) under ``by_shard``."""
         from ..utils.flight import recorder
         try:
             limit = int(params.get("limit", ["50"])[0])
         except ValueError:
             raise ApiError(400, "limit must be an integer")
-        return {"cycles": recorder.recent(limit=limit)}
+        out: Dict = {"cycles": recorder.recent(limit=limit)}
+        by_shard = recorder.summary().get("by_shard")
+        if by_shard:
+            out["by_shard"] = by_shard
+        return out
 
     def debug_trace(self, params: Dict) -> Dict:
         """GET /debug/trace?trace_id=&job= — spans as Chrome trace-event

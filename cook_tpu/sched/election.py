@@ -234,6 +234,30 @@ def partition_lock_path(election_dir: str, partition: int) -> str:
     return str(Path(election_dir) / f"cook-leader-p{int(partition)}.lock")
 
 
+def acquire_shard_lease(election_dir: str, partition: int, node_url: str,
+                        timeout_s: float = 10.0) -> "FileLeaderElector":
+    """Synchronous acquire-or-die for a controller shard boot (ISSUE
+    19: one partition = one process).  A shard worker cannot serve a
+    single cycle without its partition's lease — unlike the daemon's
+    background campaign there is nothing useful to do while waiting —
+    so this blocks until the flock is held and the fencing epoch is
+    minted, or raises.  The returned elector holds the lease; process
+    death releases it, which is exactly what the failover path (PR 3
+    candidate ranking over the same lock's sidecar files) keys on."""
+    elector = FileLeaderElector(
+        partition_lock_path(election_dir, partition), node_url)
+    deadline = time.monotonic() + max(float(timeout_s), 0.0)
+    while True:
+        if elector._try_acquire():
+            elector._leader = True
+            return elector
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"shard worker could not acquire the partition {partition} "
+                f"lease within {timeout_s}s ({elector.lock_path} is held)")
+        time.sleep(0.05)
+
+
 class PartitionLeaseSet:
     """N independent leader leases over P partitions: one
     :class:`FileLeaderElector` per partition lock, campaigned and

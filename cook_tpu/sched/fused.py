@@ -199,13 +199,20 @@ class _ResidentPack:
 
 class FusedCycleDriver:
     def __init__(self, store: Store, config: Config, matcher: Matcher,
-                 plugins, rate_limits, mesh=None):
+                 plugins, rate_limits, mesh=None, shard_id=None):
         self.store = store
         self.config = config
         self.matcher = matcher
         self.plugins = plugins
         self.rate_limits = rate_limits
         self._mesh = mesh
+        # sharded-controller mode (ISSUE 19): this process owns ONE mesh
+        # shard, so the [P, ...] pool-stacked arrays it builds cover only
+        # its partition's pools and its resident buffers are committed
+        # per-PROCESS — the mesh it runs on must be this shard's local
+        # device slice, never a pool mesh spanning other shards' pools
+        # (mesh() enforces this)
+        self.shard_id = shard_id
         self._cycles: Dict[Tuple, object] = {}
         # device-resident mirror of the columnar index's immutable res/disk
         # base columns: rows append-only while the compaction epoch is
@@ -239,6 +246,19 @@ class FusedCycleDriver:
 
             from ..parallel.mesh import POOL_AXIS
             self._mesh = Mesh(np.array(jax.devices()[:1]), (POOL_AXIS,))
+        if self.shard_id is not None and self._mesh.size > 1:
+            # one partition = one process = one mesh shard: a shard
+            # worker driving a multi-device pool mesh would commit
+            # resident buffers for pools OTHER processes own —
+            # double-owned device state, the exact split-brain the boot
+            # alignment check (parallel.mesh.validate_shard_alignment)
+            # exists to refuse
+            from ..parallel.mesh import ShardAlignmentError
+            raise ShardAlignmentError(
+                f"controller shard {self.shard_id} was given a "
+                f"{self._mesh.size}-device pool mesh: a shard process "
+                "commits resident buffers for ITS pools only; give each "
+                "shard its local device slice")
         return self._mesh
 
     def _cycle_fn(self, gpu_mode: bool, considerable_cap: int,

@@ -49,10 +49,21 @@ class Scheduler:
     def __init__(self, store: Store, config: Optional[Config] = None,
                  clusters: Optional[List[ComputeCluster]] = None,
                  rank_backend: str = "tpu", plugins=None, rate_limits=None,
-                 status_queue_shards: Optional[int] = None):
+                 status_queue_shards: Optional[int] = None,
+                 shard_id: Optional[int] = None):
         from ..policy import PluginRegistry, RateLimits
         self.store = store
         self.config = config or Config()
+        # sharded-controller identity (ISSUE 19: one partition = one
+        # process = one mesh shard).  Process-wide, not per-scheduler:
+        # a shard worker runs exactly one scheduler, and everything the
+        # shard emits — CycleRecords, spans, the Perfetto process track
+        # — must carry the same id whether or not it passed through
+        # this object.
+        self.shard_id = shard_id
+        if shard_id is not None:
+            from ..utils import flight
+            flight.set_shard(shard_id)
         # fault-injection + breaker policy are config planes the scheduler
         # owns applying (docs/ROBUSTNESS.md): arming is explicit opt-in
         from ..utils.faults import injector as _faults
@@ -646,7 +657,7 @@ class Scheduler:
             from .fused import FusedCycleDriver
             self._fused = FusedCycleDriver(
                 self.store, self.config, self.matcher, self.plugins,
-                self.rate_limits)
+                self.rate_limits, shard_id=self.shard_id)
             if self.config.pipeline.depth > 0:
                 from .pipeline import PipelinedCycleDriver
                 self._pipeline = PipelinedCycleDriver(

@@ -125,6 +125,12 @@ def main(argv=None) -> int:
                         "journal during the failover legs — recovery "
                         "must detect the damage and still converge "
                         "(docs/ROBUSTNESS.md WAL v2)")
+    p.add_argument("--in-process", action="store_true",
+                   help="partitioned chaos-failover: keep every partition "
+                        "leader a thread inside THIS process (the pre-"
+                        "scale-out variant).  Default since the multi-"
+                        "controller scale-out: one real shard worker "
+                        "process per partition, the victim is SIGKILLed")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -151,10 +157,15 @@ def main(argv=None) -> int:
 
     if args.chaos_failover:
         if args.partitions and args.partitions > 1:
-            from .chaos import PartitionChaosConfig, run_partition_chaos
-            presult = run_partition_chaos(PartitionChaosConfig(
+            from .chaos import (PartitionChaosConfig, run_partition_chaos,
+                                run_partition_chaos_procs)
+            pcc = PartitionChaosConfig(
                 seed=args.seed or 0, partitions=args.partitions,
-                group_commit=not args.no_group_commit))
+                group_commit=not args.no_group_commit,
+                process_kill=not args.in_process)
+            runner = (run_partition_chaos if args.in_process
+                      else run_partition_chaos_procs)
+            presult = runner(pcc)
             print(json.dumps(presult.summary(), indent=2))
             return 0 if presult.ok else 1
         from .chaos import FailoverChaosConfig, run_failover_chaos

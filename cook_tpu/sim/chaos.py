@@ -917,6 +917,11 @@ class PartitionChaosConfig:
     ack_timeout_s: float = 5.0
     data_root: Optional[str] = None
     group_commit: bool = True
+    #: True (the default since ISSUE 19): each partition leader is a
+    #: REAL shard worker process (sched/shard.py) and the victim is
+    #: SIGKILLed — journal stops mid-write exactly as a host loss.
+    #: False keeps the original thread-based in-process variant.
+    process_kill: bool = True
 
 
 @dataclass
@@ -931,6 +936,8 @@ class PartitionChaosResult:
     promotion_window_s: float = 0.0
     promoted_epoch: int = 0
     unresolved_writers: int = 0
+    #: whether the victim loss was a real SIGKILL of a worker process
+    process_kill: bool = False
 
     @property
     def ok(self) -> bool:
@@ -949,6 +956,7 @@ class PartitionChaosResult:
             "promotion_window_s": round(self.promotion_window_s, 3),
             "promoted_epoch": self.promoted_epoch,
             "unresolved_writers": self.unresolved_writers,
+            "process_kill": self.process_kill,
         }
 
 
@@ -1221,6 +1229,250 @@ def run_partition_chaos(cc: Optional[PartitionChaosConfig] = None
             except Exception:
                 pass
         injector.disarm("repl.ack")
+    return result
+
+
+def run_partition_chaos_procs(cc: Optional[PartitionChaosConfig] = None
+                              ) -> PartitionChaosResult:
+    """The multi-CONTROLLER form of :func:`run_partition_chaos` (ISSUE
+    19): each partition's leader is a real shard worker PROCESS
+    (sched/shard.py store role — own journal, fence authority, group
+    commit, sync socket replication), the parent mirrors each journal
+    with a synced standby follower, and the victim partition's worker
+    is lost to a real ``SIGKILL`` mid-batch.  The same invariants as
+    the thread-based variant, now across process boundaries:
+
+    - the fault-lost replication ack (armed INSIDE the victim process)
+      demuxes every concurrent writer to committed or indeterminate —
+      never a hang, never a silent drop;
+    - sibling shard processes keep committing THROUGH the kill and the
+      whole promotion window (zero errors, nonzero in-window commits);
+    - the victim's standby promotes via the PR 3 candidate ranking
+      (candidate position, promotion gate, epoch-2 fencing) and holds
+      every committed-or-indeterminate transaction — zero loss;
+    - every sibling partition still serves every commit it acked.
+    """
+    import os
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+
+    from ..sched.election import partition_lock_path
+    from ..sched.shard import ShardSupervisor, rpc
+    from ..state import replication as repl
+    from ..state.schema import Job, Resources
+    from ..state.schema import to_json as _to_json
+    from ..state.store import Store
+    from ..utils.fsatomic import write_atomic_int
+
+    cc = cc or PartitionChaosConfig()
+    result = PartitionChaosResult(partitions=cc.partitions,
+                                  process_kill=True)
+    if cc.partitions < 2:
+        result.violations.append("partition chaos needs >= 2 partitions")
+        return result
+    if not 0 <= cc.victim < cc.partitions:
+        result.violations.append(f"victim {cc.victim} out of range")
+        return result
+    if not repl.replication_available():
+        result.violations.append("native replication library unavailable")
+        return result
+    root = cc.data_root or tempfile.mkdtemp(prefix="cook-partchaos-")
+    election = os.path.join(root, "election")
+    os.makedirs(election, exist_ok=True)
+    committed: Dict[int, List[str]] = {p: [] for p in range(cc.partitions)}
+
+    def _job(p: int, i: int) -> Dict:
+        return _to_json(Job(
+            uuid=f"0000000{p}-0000-4000-8000-{i:012d}",
+            user=f"chaos{p}", command=f"echo {i}", pool=f"pool-p{p}",
+            resources=Resources(cpus=1, mem=64)))
+
+    per_shard = []
+    for p in range(cc.partitions):
+        authority = partition_lock_path(election, p) + ".epoch"
+        write_atomic_int(authority, 1)
+        per_shard.append({
+            "role": "store", "data_dir": os.path.join(root, f"p{p}",
+                                                      "leader"),
+            "authority": authority, "epoch": 1, "replicate": True,
+            "group_commit": cc.group_commit,
+            "ack_timeout_s": cc.ack_timeout_s})
+    sup = ShardSupervisor(cc.partitions, {"role": "store"},
+                          root=os.path.join(root, "run"),
+                          per_shard=per_shard)
+    followers = []
+    promoted = None
+    try:
+        sup.start()
+        # ---- parent-side synced standby per partition worker ---------
+        for p in range(cc.partitions):
+            d_standby = os.path.join(root, f"p{p}", "standby")
+            f = repl.ReplicationFollower(
+                "127.0.0.1", int(sup.procs[p].addr["repl_port"]), d_standby)
+            repl.record_followed_epoch(d_standby, 1)
+            followers.append(f)
+        for p in range(cc.partitions):
+            if not _wait(lambda p=p: sup.rpc(
+                    p, {"cmd": "repl_status"})["synced_followers"] >= 1):
+                result.violations.append(
+                    f"partition {p} standby never synced")
+                return result
+            sup.rpc(p, {"cmd": "put_pool", "name": f"pool-p{p}"})
+            for i in range(cc.jobs_before):
+                doc = _job(p, i)
+                sup.rpc(p, {"cmd": "submit", "jobs": [doc]})
+                committed[p].append(doc["uuid"])
+
+        # ---- victim batch with the ack fault armed IN the worker -----
+        sup.rpc(cc.victim, {"cmd": "arm_fault", "point": "repl.ack",
+                            "probability": 1.0, "max_fires": 1})
+        outcomes: List[tuple] = []
+
+        def victim_writer(i: int):
+            doc = _job(cc.victim, 10_000 + i)
+            try:
+                resp = sup.rpc(cc.victim, {"cmd": "submit", "jobs": [doc]},
+                               timeout_s=cc.ack_timeout_s + 25.0)
+                outcomes.append((resp["outcome"], doc["uuid"]))
+            except Exception as e:
+                outcomes.append((f"unexpected:{type(e).__name__}",
+                                 doc["uuid"]))
+
+        threads = [threading.Thread(target=victim_writer, args=(i,))
+                   for i in range(cc.writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        result.unresolved_writers += sum(1 for t in threads if t.is_alive())
+        for outcome, uuid in outcomes:
+            if outcome in ("indeterminate", "committed"):
+                if outcome == "indeterminate":
+                    result.victim_indeterminate += 1
+                committed[cc.victim].append(uuid)  # on the synced mirror
+            else:
+                result.violations.append(f"victim-batch writer got {outcome}")
+        if not result.victim_indeterminate:
+            result.violations.append(
+                "injected ack loss demuxed no indeterminate outcome on "
+                "the victim partition")
+
+        # ---- sibling streams through the kill + promotion ------------
+        stop_siblings = threading.Event()
+        sibling_log: List[tuple] = []
+        sibling_errors = [0]
+
+        def sibling_writer(p: int):
+            i = 20_000
+            port = sup.procs[p].port
+            while not stop_siblings.is_set():
+                doc = _job(p, i)
+                i += 1
+                try:
+                    rpc(port, {"cmd": "submit", "jobs": [doc]},
+                        timeout_s=30.0)
+                    sibling_log.append((_time.monotonic(), p, doc["uuid"]))
+                except Exception as e:
+                    sibling_errors[0] += 1
+                    sibling_log.append((_time.monotonic(), p,
+                                        f"error:{type(e).__name__}"))
+                    return
+
+        sibling_threads = [threading.Thread(target=sibling_writer, args=(p,))
+                           for p in range(cc.partitions) if p != cc.victim]
+        for t in sibling_threads:
+            t.start()
+        _time.sleep(0.1)  # streams flowing before the kill
+
+        # ---- REAL process kill of the victim's worker ----------------
+        if not _wait(lambda: followers[cc.victim].offset
+                     >= sup.rpc(cc.victim,
+                                {"cmd": "repl_status"})["journal_bytes"]):
+            result.violations.append(
+                "victim standby never reached the head pre-kill")
+        kill_ts = _time.monotonic()
+        sup.kill(cc.victim, _signal.SIGKILL)
+        followers[cc.victim].stop()
+
+        # ---- promote the standby (PR 3 machinery, parent side) -------
+        d_standby = os.path.join(root, f"p{cc.victim}", "standby")
+        pos = repl.candidate_position(d_standby)
+        if not pos.get("synced"):
+            result.violations.append(
+                f"victim standby position not synced: {pos}")
+        authority = partition_lock_path(election, cc.victim) + ".epoch"
+        write_atomic_int(authority, 2)
+        try:
+            repl.assert_promotable(d_standby)
+        except RuntimeError as e:
+            result.violations.append(f"promotion gate refused: {e}")
+            return result
+        promoted = Store.open(d_standby, epoch=2, shared=False,
+                              partition=cc.victim)
+        promoted.attach_fence_authority(authority)
+        result.promoted_epoch = 2
+        promote_ts = _time.monotonic()
+        result.promotion_window_s = promote_ts - kill_ts
+
+        # siblings stream a little past the promotion, then stop
+        deadline = _time.monotonic() + max(
+            0.0, cc.sibling_stream_s - (promote_ts - kill_ts))
+        while _time.monotonic() < deadline and not sibling_errors[0]:
+            _time.sleep(0.01)
+        stop_siblings.set()
+        for t in sibling_threads:
+            t.join(timeout=60.0)
+        result.unresolved_writers += sum(1 for t in sibling_threads
+                                         if t.is_alive())
+        result.sibling_errors = sibling_errors[0]
+        in_window = [e for e in sibling_log
+                     if kill_ts <= e[0] <= promote_ts
+                     and not str(e[2]).startswith("error:")]
+        result.sibling_commits_during_promotion = len(in_window)
+        if sibling_errors[0]:
+            result.violations.append(
+                f"{sibling_errors[0]} sibling writer(s) errored during "
+                "the victim's failover — sibling shard processes must "
+                "keep committing uninterrupted")
+        if not in_window:
+            result.violations.append(
+                "no sibling commit landed inside the victim's promotion "
+                "window — the sibling commit stream stalled")
+        for _ts, p, uuid in sibling_log:
+            if not str(uuid).startswith("error:"):
+                committed[p].append(uuid)
+
+        # ---- zero loss: promoted store + live sibling workers --------
+        for uuid in committed[cc.victim]:
+            if promoted.job(uuid) is None:
+                result.violations.append(
+                    f"victim-partition commit {uuid} lost by the "
+                    "promotion")
+        for p, uuids in committed.items():
+            result.committed_by_partition[f"p{p}"] = len(uuids)
+            result.committed += len(uuids)
+            if p == cc.victim:
+                continue
+            for uuid in uuids:
+                if not sup.rpc(p, {"cmd": "job", "uuid": uuid})["found"]:
+                    result.violations.append(
+                        f"committed job {uuid} (partition {p}) missing "
+                        "from its shard worker after the failover")
+                    break
+    finally:
+        for f in followers:
+            try:
+                f.stop()
+            except Exception:
+                pass
+        if promoted is not None:
+            try:
+                promoted.close()
+            except Exception:
+                pass
+        sup.stop()
     return result
 
 

@@ -74,6 +74,13 @@ class PartitionRoutingError(ValueError):
     or a persisted routing map that disagrees with the configured one."""
 
 
+class SummaryStalenessError(RuntimeError):
+    """The cross-shard user-summary table could not be brought under
+    its staleness bound (a peer shard's table is too old): global
+    enforcement reads must fail loudly rather than consume a view
+    whose window the quota refusal would then misquote (ISSUE 19)."""
+
+
 class PartitionMap:
     """Deterministic ``pool → partition`` routing.
 
@@ -150,10 +157,29 @@ class UserSummaryExchange:
     counts and running resource sums (:meth:`Store.user_summary`) —
     refreshed lazily with an explicit staleness bound.  Consumers that
     enforce (the global per-user quota refusal) assert the window; the
-    monitor's global DRU view reads the same merged table."""
+    monitor's global DRU view reads the same merged table.
 
-    def __init__(self, partitions: List[Store], max_age_s: float = 1.0):
+    ``peer_fetch`` (ISSUE 19 sharded controllers) feeds the tables of
+    REMOTE shard processes into the same merge: a zero-arg callable
+    returning ``[(users_table, age_s), ...]`` — one entry per peer
+    shard, each table stamped with how old it already was when fetched
+    (socket carrier locally, ICI/DCN collectives on a real mesh).  The
+    merged table's staleness then includes the OLDEST peer age, so the
+    bound consumers quote covers the whole fleet, not just the local
+    sweep.  With ``assert_bound`` a sweep that cannot get the table
+    under ``max_age_s`` raises :class:`SummaryStalenessError` instead
+    of serving silently-stale enforcement state."""
+
+    def __init__(self, partitions: List[Store], max_age_s: float = 1.0,
+                 peer_fetch: Optional[Callable[
+                     [], List[Tuple[Dict[str, Dict[str, float]], float]]]]
+                 = None,
+                 assert_bound: bool = False):
         self._partitions = partitions
+        self._peer_fetch = peer_fetch
+        self.assert_bound = bool(assert_bound)
+        self.peer_tables = 0       # peers merged into the last sweep
+        self.peer_age_s = 0.0      # oldest peer table age at last sweep
         self.max_age_s = max(float(max_age_s), 0.0)
         self._mu = named_lock("partition.summaries")
         # serializes whole sweeps (sweep → install under _mu): two
@@ -171,11 +197,17 @@ class UserSummaryExchange:
         return time.monotonic() - self._refreshed_at
 
     def _sweep_locked(self) -> None:
-        """Merge every partition's user summary (caller holds
-        _refresh_mu)."""
+        """Merge every partition's user summary, plus peer shard tables
+        when a carrier is attached (caller holds _refresh_mu)."""
         summaries = [p.user_summary() for p in self._partitions]
+        peer_age = 0.0
+        peers: List[Dict[str, Dict[str, float]]] = []
+        if self._peer_fetch is not None:
+            for table, age_s in self._peer_fetch():
+                peers.append(table)
+                peer_age = max(peer_age, max(float(age_s), 0.0))
         merged: Dict[str, Dict[str, float]] = {}
-        for summary in summaries:
+        for summary in summaries + peers:
             for user, u in summary.items():
                 m = merged.setdefault(user, {
                     "pending": 0.0, "running": 0.0,
@@ -184,7 +216,12 @@ class UserSummaryExchange:
                     m[k] += v
         with self._mu:
             self._merged = merged
-            self._refreshed_at = time.monotonic()
+            # a peer table that was already age_s old when it crossed
+            # the wire backdates the whole merge: staleness_s() is the
+            # fleet-wide bound, never just the local sweep's
+            self._refreshed_at = time.monotonic() - peer_age
+            self.peer_tables = len(peers)
+            self.peer_age_s = peer_age
             self.refreshes += 1
 
     def refresh(self) -> None:
@@ -204,6 +241,16 @@ class UserSummaryExchange:
             with self._refresh_mu:
                 if self.staleness_s() > self.max_age_s:
                     self._sweep_locked()
+            if self.assert_bound and self.staleness_s() > self.max_age_s:
+                # even a fresh sweep could not get under the window
+                # (a peer shard's table is too old — dead peer, wedged
+                # carrier): enforcement must not pretend it has a
+                # current global view
+                raise SummaryStalenessError(
+                    f"cross-shard user summary is {self.staleness_s():.3f}s "
+                    f"stale (bound {self.max_age_s}s; oldest peer table "
+                    f"{self.peer_age_s:.3f}s, {self.peer_tables} peers "
+                    "merged)")
 
     def merged(self) -> Dict[str, Dict[str, float]]:
         """The cross-partition per-user table, refreshed when older
@@ -228,6 +275,8 @@ class UserSummaryExchange:
             return {"users": len(self._merged),
                     "refreshes": self.refreshes,
                     "max_age_s": self.max_age_s,
+                    "peer_tables": self.peer_tables,
+                    "peer_age_s": round(self.peer_age_s, 4),
                     "staleness_s": round(min(self.staleness_s(), 1e12),
                                          4)}
 

@@ -54,6 +54,25 @@ PHASE_BY_SPAN = {
 _current_record: "contextvars.ContextVar[Optional[CycleRecord]]" = \
     contextvars.ContextVar("cook_cycle_record", default=None)
 
+# process-wide shard identity (ISSUE 19): a sharded-controller process
+# owns exactly ONE partition shard, so the id is process state, not
+# per-record plumbing — set once at shard boot (sched/shard.py), stamped
+# onto every CycleRecord minted after.  None = unsharded (classic
+# single-controller daemon): records export shard=null and the summary
+# roll-up stays flat.
+_shard_id: Optional[int] = None
+
+
+def set_shard(shard: Optional[int]) -> None:
+    """Declare this process's shard id (one partition = one process);
+    every CycleRecord minted after carries it."""
+    global _shard_id
+    _shard_id = None if shard is None else int(shard)
+
+
+def current_shard() -> Optional[int]:
+    return _shard_id
+
 
 class CycleRecord:
     """One scheduler cycle's instrument-panel readings."""
@@ -64,11 +83,15 @@ class CycleRecord:
                  "h2d_bytes", "d2h_bytes", "sync_wait_ms", "faults",
                  "error", "pipeline_depth", "pipeline_inflight",
                  "pipeline_conflicts", "delta_rows", "full_repacks",
-                 "audit_events", "kernel_launches", "path", "_t0")
+                 "audit_events", "kernel_launches", "path", "shard", "_t0")
 
     def __init__(self, seq: int, kind: str):
         self.seq = seq
         self.kind = kind
+        # which controller shard ran this cycle (ISSUE 19 sharded
+        # controllers; None on the classic single process) — the key the
+        # stitched /debug/cycles roll-up and fleet trace group by
+        self.shard: Optional[int] = _shard_id
         self.trace_id: Optional[str] = None
         self.start_s = time.time()
         self.duration_ms = 0.0
@@ -143,6 +166,7 @@ class CycleRecord:
             "audit_events": self.audit_events,
             "kernel_launches": self.kernel_launches,
             "path": self.path,
+            "shard": self.shard,
             "error": self.error,
         }
 
@@ -366,6 +390,21 @@ class FlightRecorder:
             idx = min(len(durs) - 1, int(round(q / 100.0 * (len(durs) - 1))))
             return round(durs[idx], 3)
 
+        by_shard: Dict[int, List[float]] = {}
+        for r in records:
+            if r.shard is not None:
+                by_shard.setdefault(r.shard, []).append(r.duration_ms)
+
+        def _shard_agg(durations: List[float]) -> Dict[str, Any]:
+            ds = sorted(durations)
+
+            def sp(q: float) -> float:
+                i = min(len(ds) - 1, int(round(q / 100.0 * (len(ds) - 1))))
+                return round(ds[i], 3)
+
+            return {"cycles": len(ds), "cycle_ms_p50": sp(50),
+                    "cycle_ms_p99": sp(99)}
+
         by_kind: Dict[str, int] = {}
         recompiles: Dict[str, int] = {}
         skips: Dict[str, int] = {}
@@ -388,6 +427,12 @@ class FlightRecorder:
             "by_kind": by_kind,
             "cycle_ms_p50": pctl(50),
             "cycle_ms_p99": pctl(99),
+            # per-shard roll-up (ISSUE 19): keyed by CycleRecord.shard,
+            # present only when sharded cycles are in the window so the
+            # classic single-process summary shape is unchanged
+            **({"by_shard": {str(s): _shard_agg(d)
+                             for s, d in sorted(by_shard.items())}}
+               if by_shard else {}),
             "jobs_considered": sum(r.jobs_considered for r in records),
             "jobs_placed": sum(r.jobs_placed for r in records),
             "preemptions": sum(r.preemptions for r in records),

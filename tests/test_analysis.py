@@ -274,6 +274,49 @@ class TestJitHygienePass:
             ("jit-uninstrumented", "S")]
 
 
+class TestPartitionIsolationPass:
+    def test_subscript_fires(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            def peek(ps, p):
+                return ps.partitions[p].pending_jobs("pool-x")
+        """, name="sched/bad.py")
+        assert checks(r) == {"partition-isolation"}
+        f = r.findings[0]
+        assert f.detail == "ps.partitions"
+        assert f.scope == "peek"
+        assert "UserSummaryExchange" in f.message
+
+    def test_iteration_and_enumerate_fire(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            def sweep(store):
+                for s in store.partitions:
+                    s.user_summary()
+                for i, s in enumerate(store.partitions):
+                    s.ensure_index()
+                return [s.clock() for s in store.partitions]
+        """, name="rest/bad.py")
+        assert [f.check for f in r.findings] == ["partition-isolation"] * 3
+
+    def test_facade_module_exempt(self, tmp_path):
+        r = lint_snippet(tmp_path, """
+            class PartitionedStore:
+                def jobs(self):
+                    for s in self.partitions:
+                        yield from s.jobs()
+                    return self.partitions[0].clock
+        """, name="state/partition.py")
+        assert r.findings == []
+
+    def test_config_field_read_clean(self, tmp_path):
+        # reading a PartitionConfig.partitions field is not store access
+        r = lint_snippet(tmp_path, """
+            def boot(cfg):
+                pc = cfg.partitions
+                return pc.count > 1
+        """, name="daemon2.py")
+        assert r.findings == []
+
+
 class TestEngineMechanics:
     def test_pragma_suppression(self, tmp_path):
         r = lint_snippet(tmp_path, """

@@ -111,6 +111,9 @@ class TestJobsEndpoint:
         api_rl.job_submission = TokenBucketRateLimiter(
             tokens_per_minute=0.001, bucket_size=2)
         client = client_for(server)
+        # surface the 429 instead of pacing Retry-After for a bucket
+        # that refills at 0.001 tokens/min
+        client.throttle_retries = 0
         client.submit_one("a")
         client.submit_one("b")
         with pytest.raises(JobClientError) as e:
