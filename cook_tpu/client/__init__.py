@@ -224,12 +224,26 @@ class JobClient:
     def _merge_commit_token(self, token: str) -> None:
         """Fold one X-Cook-Commit-Offset into the session token: plain
         tokens replace wholesale (latest wins); partition-qualified
-        vectors replace per partition and the session token is the
-        sorted joined vector (string-level — the entries stay opaque)."""
+        vectors replace per partition; CELL-qualified entries (a
+        federation front door's ``cell/p0:3:128`` — docs/DEPLOY.md
+        multi-cell federation) replace per (cell, partition), so one
+        session token carries read-your-writes across every cell the
+        session touched.  All string-level — the entries stay opaque."""
         entries = [e.strip() for e in token.split(",") if e.strip()]
-        qualified = [e for e in entries if e.startswith("p")
-                     and ":" in e]
-        if not qualified or len(qualified) != len(entries):
+
+        def _key(e: str) -> Optional[str]:
+            # merge key per entry: "p<part>" intra-cell, "<cell>/" or
+            # "<cell>/p<part>" when a front door qualified it
+            cell, sep, rest = e.partition("/")
+            if sep and cell and "/" not in rest:
+                if rest.startswith("p") and ":" in rest:
+                    return cell + "/" + rest.partition(":")[0]
+                return cell + "/"
+            return e.partition(":")[0] \
+                if e.startswith("p") and ":" in e else None
+
+        keys = [_key(e) for e in entries]
+        if not entries or any(k is None for k in keys):
             # legacy single token (or something unrecognized: treat as
             # the opaque session token it is).  Wholesale replacement
             # retires any per-partition vector too — the server that
@@ -239,8 +253,8 @@ class JobClient:
             self._commit_tokens.clear()
             self.last_commit_offset = token
             return
-        for e in qualified:
-            self._commit_tokens[e.partition(":")[0]] = e
+        for k, e in zip(keys, entries):
+            self._commit_tokens[k] = e
         self.last_commit_offset = ",".join(
             self._commit_tokens[k]
             for k in sorted(self._commit_tokens))

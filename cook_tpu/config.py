@@ -871,6 +871,161 @@ class StorageConfig:
 
 
 @dataclass
+class FederationConfig:
+    """Multi-cell front-door tier (the daemon's top-level
+    ``"federation"`` conf section; presence of the section makes the
+    process a stateless ROUTER node — no store, no journal, no
+    election, no scheduler).  Boot-validated like every other section:
+    a typo'd knob or malformed cell entry fails the boot, never routes
+    half-configured.  docs/DEPLOY.md "multi-cell federation"."""
+
+    #: the cells this router fronts: objects with ``id`` + ``url``
+    #: (required) and optional ``tier`` (``standard``/``spot``),
+    #: ``attributes`` (data-locality string pairs) and ``weight``
+    #: (relative capacity for load scoring).  At least one.
+    cells: List[Dict] = field(default_factory=list)
+    #: job label key carrying a data-locality demand: a job labeled
+    #: ``{"cell-attribute/region": "us-east"}`` (for the default
+    #: ``"cell-attribute/"`` prefix) routes only to cells whose
+    #: attributes match every such pair; a label naming the reserved
+    #: key ``cell-attribute/cell`` pins the batch to that cell id
+    locality_label_prefix: str = "cell-attribute/"
+    #: staleness bound on the federated per-user summary merge — the
+    #: window every global-enforcement refusal quotes (asserted: an
+    #: unmeetable bound raises, never silently serves)
+    summary_max_age_seconds: float = 5.0
+    #: GLOBAL per-user pending-job cap across every cell (0 = off);
+    #: enforced at the front door off the federated summaries
+    max_user_pending: int = 0
+    #: GLOBAL per-user dominant-share ceiling in [0, 1] (0 = off): a
+    #: user whose dominant resource share of the federation's running
+    #: total exceeds this sheds NEW submissions with 429 until usage
+    #: drains — the DRU fair-share floor, lifted to the federation
+    max_user_dominant_share: float = 0.0
+    #: routing mode: ``"load"`` scores cells by weight, in-flight
+    #: demand and saturation; ``"goodput"`` additionally replays each
+    #: candidate cell's recent routed traffic through ``sim/`` and
+    #: routes to argmax predicted goodput (costlier per decision)
+    route_mode: str = "load"
+    #: consecutive transport failures that open a cell's breaker (the
+    #: whole cell's traffic then reroutes until a half-open probe heals)
+    breaker_failures: int = 3
+    #: seconds an open cell breaker waits before the half-open probe
+    breaker_reset_seconds: float = 5.0
+    #: per-proxied-request timeout against a cell
+    request_timeout_seconds: float = 5.0
+    #: score multiplier applied to ``spot``-tier cells so standard
+    #: capacity absorbs steady demand first, in (0, 1]
+    spot_penalty: float = 0.5
+    #: bounded commit ledger: most recent ACCEPTED submission batches
+    #: remembered per router for outage re-route and uuid->cell read
+    #: routing (oldest evicted first; eviction is counted, never silent)
+    ledger_max_batches: int = 10000
+    #: recent routed batches replayed per candidate cell in goodput
+    #: route mode
+    goodput_window: int = 32
+
+    def __post_init__(self):
+        if not isinstance(self.cells, list):
+            raise ValueError("federation cells must be a list of "
+                             "{id, url, ...} objects")
+        seen = set()
+        for entry in self.cells:
+            if not isinstance(entry, dict):
+                raise ValueError(
+                    f"federation cell entry must be an object, got "
+                    f"{entry!r}")
+            unknown = set(entry) - {"id", "url", "tier", "attributes",
+                                    "weight"}
+            if unknown:
+                raise ValueError(
+                    f"unknown federation cell key(s) "
+                    f"{sorted(unknown)!r}")
+            if not entry.get("id") or not entry.get("url"):
+                raise ValueError(
+                    "federation cell entries require id and url, got "
+                    f"{entry!r}")
+            cid = str(entry["id"])
+            if "/" in cid or "," in cid:
+                # "/" qualifies token entries and "," joins the vector:
+                # either in a cell id would make session tokens
+                # ambiguous (federation/tokens.py)
+                raise ValueError(f"federation cell id {cid!r} must not "
+                                 "contain '/' or ','")
+            if not str(entry["url"]).startswith(("http://", "https://")):
+                raise ValueError(f"federation cell {cid!r} url must be "
+                                 f"http(s), got {entry['url']!r}")
+            if entry.get("tier", "standard") not in ("standard", "spot"):
+                raise ValueError(
+                    f"federation cell {cid!r} tier must be 'standard' "
+                    f"or 'spot', got {entry['tier']!r}")
+            if not isinstance(entry.get("attributes", {}), dict):
+                raise ValueError(f"federation cell {cid!r} attributes "
+                                 "must be an object")
+            if float(entry.get("weight", 1.0)) <= 0:
+                raise ValueError(
+                    f"federation cell {cid!r} weight must be > 0")
+            if cid in seen:
+                raise ValueError(
+                    f"duplicate federation cell id {cid!r}")
+            seen.add(cid)
+        if self.route_mode not in ("load", "goodput"):
+            raise ValueError("federation route_mode must be 'load' or "
+                             f"'goodput', got {self.route_mode!r}")
+        if not self.locality_label_prefix:
+            raise ValueError(
+                "federation locality_label_prefix must be non-empty")
+        for k in ("summary_max_age_seconds", "breaker_reset_seconds"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"federation {k} must be >= 0")
+        if float(self.request_timeout_seconds) <= 0:
+            raise ValueError(
+                "federation request_timeout_seconds must be > 0")
+        if not isinstance(self.max_user_pending, int) \
+                or self.max_user_pending < 0:
+            raise ValueError("federation max_user_pending must be an "
+                             f"int >= 0, got {self.max_user_pending!r}")
+        if not (0.0 <= float(self.max_user_dominant_share) <= 1.0):
+            raise ValueError("federation max_user_dominant_share must "
+                             "be in [0, 1]")
+        if not (0.0 < float(self.spot_penalty) <= 1.0):
+            raise ValueError("federation spot_penalty must be in (0, 1]")
+        for k in ("breaker_failures", "ledger_max_batches",
+                  "goodput_window"):
+            if not isinstance(getattr(self, k), int) \
+                    or getattr(self, k) < 1:
+                raise ValueError(f"federation {k} must be an int >= 1, "
+                                 f"got {getattr(self, k)!r}")
+
+    @classmethod
+    def from_conf(cls, conf: Dict) -> "FederationConfig":
+        cfg = cls()
+        for k, v in conf.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown federation key {k!r}")
+            default = getattr(cfg, k)
+            if isinstance(default, bool):
+                if not isinstance(v, bool):
+                    raise ValueError(f"federation key {k!r} must be a "
+                                     f"JSON boolean, got {v!r}")
+                setattr(cfg, k, v)
+            elif isinstance(default, list):
+                if not isinstance(v, list):
+                    raise ValueError(f"federation key {k!r} must be a "
+                                     f"JSON array, got {v!r}")
+                setattr(cfg, k, list(v))
+            else:
+                setattr(cfg, k, type(default)(v))
+        if not cfg.cells:
+            # a router fronting zero cells would accept nothing and
+            # route nowhere — a config mistake, not a deployment
+            raise ValueError("federation requires at least one cell "
+                             "({id, url} entries under federation.cells)")
+        cfg.__post_init__()
+        return cfg
+
+
+@dataclass
 class CircuitBreakerConfig:
     """Per-compute-cluster launch circuit breaker (utils/retry.py):
     ``failure_threshold`` consecutive backend failures open the breaker

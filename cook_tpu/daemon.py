@@ -332,10 +332,44 @@ class CookDaemon:
         # fleet observability plane (sched/fleet.py): federation scraper
         # + trace fan-out over the candidate registry's topology
         self.fleet = None
+        # multi-cell federation front door (federation/): a "federation"
+        # conf section makes this process a stateless router over N
+        # cells — no store, no journal, no election
+        self.federation = None
 
     # -------------------------------------------------------------- assembly
     def start(self) -> None:
         conf = self.conf
+        # ------------------------------------------------ federation role
+        fed = conf.get("federation")
+        if fed is not None:
+            # the front door is sovereign-cell-agnostic by construction:
+            # combining it with cell state in one process would couple
+            # the router's availability to one cell's journal — exactly
+            # the blast-radius federation exists to remove.  Refuse the
+            # combination at boot, like every other conf contradiction.
+            clashing = [k for k in ("scheduler", "clusters", "replication",
+                                    "shared_data_dir", "data_dir",
+                                    "election_dir", "election")
+                        if conf.get(k)]
+            if clashing:
+                raise ValueError(
+                    "a \"federation\" section makes this process a "
+                    "stateless front-door router; it cannot also carry "
+                    f"cell state (drop {', '.join(sorted(clashing))} or "
+                    "run them as separate cell daemons — docs/DEPLOY.md "
+                    "multi-cell federation)")
+            from .federation.rest import build_federation_node
+            # boot-validates the section (FederationConfig.from_conf):
+            # unknown keys, malformed cells, bad tiers all fail HERE
+            self.federation = build_federation_node(
+                fed, host=self.host, port=self.port)
+            self.federation.start()
+            self.node_url = self.federation.url
+            self._node_id = f"{self.host}-{self.federation.port}"
+            from .utils import tracing
+            tracing.set_process_identity(self._node_id)
+            return
         # shared_data_dir: the data dir is on shared storage reachable from
         # every scheduler host (the Datomic-transactor slot).  Followers
         # load a replay-only view (no journal attach — their appends would
@@ -489,7 +523,17 @@ class CookDaemon:
                 duration_s=float(election.get("duration_seconds", 15.0)),
                 on_leadership=self._on_leadership, on_loss=self._on_loss)
         else:
-            election_dir = conf.get("election_dir") or self.data_dir or "."
+            election_dir = conf.get("election_dir") or self.data_dir
+            if not election_dir:
+                # no explicit election_dir and no data_dir: a
+                # single-process election with nothing to share.  The
+                # old fallback was the cwd, which littered
+                # cook-leader.lock{,.epoch,.leader} into whatever
+                # directory the process (or a test) started from; a
+                # per-process tempdir keeps the same semantics with no
+                # droppings
+                import tempfile
+                election_dir = tempfile.mkdtemp(prefix="cook-election-")
             self.elector = FileLeaderElector(
                 str(Path(election_dir) / "cook-leader.lock"), self.node_url,
                 on_leadership=self._on_leadership, on_loss=self._on_loss)
@@ -908,9 +952,9 @@ class CookDaemon:
         self.start()
         signal.signal(signal.SIGTERM, self._sigterm)
         signal.signal(signal.SIGINT, self._sigterm)
-        print(f"cook_tpu: serving {self.node_url}"
-              + (" (api-only)" if self.api_only else " (campaigning)"),
-              flush=True)
+        role = " (federation router)" if self.federation is not None \
+            else (" (api-only)" if self.api_only else " (campaigning)")
+        print(f"cook_tpu: serving {self.node_url}" + role, flush=True)
         self._done.wait()
         self.shutdown()
         return self.exit_code
@@ -920,6 +964,10 @@ class CookDaemon:
         self._done.set()
 
     def shutdown(self) -> None:
+        if self.federation is not None:
+            self.federation.stop()
+            self.federation = None
+            return
         with self._lock:
             if self.scheduler is not None:
                 self.scheduler.shutdown()

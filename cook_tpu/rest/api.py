@@ -37,6 +37,7 @@ import copy
 import hmac
 import json
 import re
+import socket
 import threading
 import time
 import urllib.parse
@@ -137,6 +138,10 @@ API_ROUTES = [
     ("GET", "/debug/fleet",
      "federated fleet panel: per-member health, staleness, burn, "
      "saturation hot-spots, last-scrape age", False),
+    ("GET", "/debug/federation/summary",
+     "this cell's bounded per-user summary table + host inventory for "
+     "a federation front door's global fair-share merge and goodput "
+     "routing (never job state)", False),
     ("GET", "/metrics", "Prometheus metrics", False),
     ("GET", "/metrics/fleet",
      "merged fleet exposition: every member's /metrics re-labeled "
@@ -1928,6 +1933,48 @@ class CookApi:
         doc["local"] = local
         return doc
 
+    def debug_federation_summary(self) -> Dict:
+        """GET /debug/federation/summary — what this cell contributes
+        to a federation front door (federation/summary.py): the SAME
+        bounded per-user table partitions exchange intra-cell
+        (state/store.py user_summary: a few floats per distinct user,
+        never job state), a freshness age, and a bounded host inventory
+        for goodput-mode cross-cell placement scoring.  Cheap enough to
+        poll every summary sweep."""
+        store = self.store if self.store is not None else (
+            self.read_view.store if self.read_view is not None else None)
+        users = store.user_summary() if store is not None else {}
+        hosts: List[Dict[str, Any]] = []
+        if self.scheduler is not None:
+            seen = set()
+            pools = [p.name for p in (store.pools() if store else [])] \
+                or ["default"]
+            for cluster in self.scheduler.clusters.values():
+                for pool in pools:
+                    try:
+                        offers = cluster.hosts(pool)
+                    except Exception:
+                        continue
+                    for o in offers:
+                        if o.hostname in seen:
+                            continue
+                        seen.add(o.hostname)
+                        hosts.append({
+                            "hostname": o.hostname,
+                            "cpus": o.capacity.cpus,
+                            "mem": o.capacity.mem,
+                            "gpus": o.capacity.gpus,
+                            "pool": o.pool,
+                            "attributes": dict(o.attributes),
+                            "gpu_model": o.gpu_model})
+                        if len(hosts) >= 256:
+                            break
+                    if len(hosts) >= 256:
+                        break
+                if len(hosts) >= 256:
+                    break
+        return {"users": users, "age_s": 0.0, "hosts": hosts}
+
     def metrics_fleet(self) -> str:
         """GET /metrics/fleet — the merged fleet exposition: every
         member's /metrics re-labeled with {instance, role}
@@ -2896,6 +2943,7 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------- dispatch
     _LOCAL_PATHS = {"/info", "/debug", "/debug/cycles", "/debug/trace",
                     "/debug/trace/spans", "/debug/fleet",
+                    "/debug/federation/summary",
                     "/debug/faults", "/debug/replication",
                     "/debug/requests", "/debug/health", "/debug/storage",
                     "/metrics",
@@ -3090,6 +3138,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return api.debug_trace_spans(params)
             if path == "/debug/fleet":
                 return api.debug_fleet()
+            if path == "/debug/federation/summary":
+                return api.debug_federation_summary()
             if len(parts) == 4 and parts[0] == "debug" \
                     and parts[1] == "job" and parts[3] == "timeline":
                 return api.debug_job_timeline(parts[2])
@@ -3184,6 +3234,39 @@ class _CookHTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
     daemon_threads = True
 
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # live client sockets, so kill() can sever established
+        # keep-alive connections the way a process death would —
+        # shutdown() alone only stops the LISTENER, leaving pooled
+        # connections served by their handler threads indefinitely
+        self._live: set = set()
+        self._live_mu = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._live_mu:
+            self._live.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_mu:
+            self._live.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._live_mu:
+            live = list(self._live)
+            self._live.clear()
+        for sock in live:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
 
 class ApiServer:
     """Threaded HTTP server wrapper."""
@@ -3204,6 +3287,18 @@ class ApiServer:
     def stop(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Hard-stop: close the listener AND sever every established
+        client connection, like a process death would.  The graceful
+        stop() leaves keep-alive connections draining — correct for
+        shutdown, wrong for an outage drill (sim/federation.py's
+        full-cell kill needs remote sockets to actually die)."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.server.close_all_connections()
         if self._thread:
             self._thread.join(timeout=5)
 

@@ -131,6 +131,19 @@ def main(argv=None) -> int:
                         "scale-out variant).  Default since the multi-"
                         "controller scale-out: one real shard worker "
                         "process per partition, the victim is SIGKILLed")
+    p.add_argument("--cell-outage", action="store_true",
+                   help="chaos: multi-cell federation outage "
+                        "(sim/federation.py): N real cells behind the "
+                        "front-door router, one cell hard-killed "
+                        "mid-traffic then reclaimed; exit 1 on any lost "
+                        "acked submission, split gang, faked-fresh "
+                        "read, breaker cascade, or stalled survivors")
+    p.add_argument("--cells", type=int, default=None,
+                   help="cell-outage: number of federated cells "
+                        "(default 2; soak raises to 3)")
+    p.add_argument("--soak", action="store_true",
+                   help="cell-outage: the slow-tier soak shape (more "
+                        "cells, ~5x the traffic)")
     p.add_argument("--parity-pipeline", action="store_true",
                    help="run the pipelined-vs-sync parity harness "
                         "(sim/simulator.py run_pipeline_parity): same "
@@ -154,6 +167,18 @@ def main(argv=None) -> int:
             depth=args.pipeline_depth or 2, backend=args.backend)
         print(json.dumps(result, indent=2))
         return 0 if result["ok"] else 1
+
+    if args.chaos and args.cell_outage:
+        from .federation import CellOutageConfig, run_cell_outage
+        occ = CellOutageConfig(seed=args.seed or 0, soak=args.soak)
+        if args.cells is not None:
+            occ.n_cells = args.cells
+            occ.__post_init__()
+        if args.jobs is not None:
+            occ.n_batches = max(args.jobs // 2, 4)
+        oresult = run_cell_outage(occ)
+        print(json.dumps(oresult.summary(), indent=2))
+        return 0 if oresult.ok else 1
 
     if args.chaos_failover:
         if args.partitions and args.partitions > 1:

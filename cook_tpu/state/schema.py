@@ -110,6 +110,13 @@ class Reasons:
     # and the gang policy never reacts to it (the gang stays whole at
     # its post-shrink size).
     GANG_RESIZED = Reason(18, "gang-resized", mea_culpa=True)
+    # a whole CELL's capacity was reclaimed (spot/preemptible tier) or
+    # lost outright and the federation router re-routed this job's
+    # demand to a surviving cell (cook_tpu/federation): the platform
+    # took the capacity back, the job did nothing wrong — mea-culpa,
+    # free retries.  The refund is the spot tier's contract: capacity
+    # is cheap BECAUSE reclaim costs the user nothing.
+    CELL_RECLAIMED = Reason(19, "cell-reclaimed", mea_culpa=True)
 
     _by_code: Dict[int, Reason] = {}
     _by_name: Dict[str, Reason] = {}

@@ -97,9 +97,20 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 #:     as their inner os.fsync (already allowed); this entry is the
 #:     static pass's name for the same sites.
 #:   - ("store", "time.sleep"): none expected; not allowlisted.
+#:   - ("partition.summaries.refresh", "socket.connect"/"socket.sendall"):
+#:     the UserSummaryExchange peer fetch (shard control socket,
+#:     sched/shard.py PeerSummaryFeed; federation cell HTTP,
+#:     federation/summary.py) runs INSIDE the serialized sweep by
+#:     design — the refresh lock is what guarantees a stalled sweep can
+#:     never install an older peer table over a newer one while
+#:     stamping it fresh (state/partition.py).  The fetch is bounded by
+#:     the carrier's own request timeout, and no other lock family
+#:     ranks under this one.
 ALLOWED_BLOCKING: Set[Tuple[str, str]] = {
     ("store", "os.fsync"),
     ("store", "fsatomic.fsync"),
+    ("partition.summaries.refresh", "socket.connect"),
+    ("partition.summaries.refresh", "socket.sendall"),
 }
 
 _MAX_VIOLATIONS = 256

@@ -1,0 +1,466 @@
+"""Multi-cell federation tests: cell-qualified commit tokens, the
+front-door router, the federated user-summary merge and its honesty at
+the staleness bound, single-cell wire parity, the boot surface, and the
+full-cell-outage chaos invariants (cook_tpu/federation/;
+docs/DEPLOY.md multi-cell federation)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cook_tpu.client import JobClient
+from cook_tpu.cluster import FakeCluster, FakeHost
+from cook_tpu.config import Config, FederationConfig
+from cook_tpu.federation import (CellHandle, CellSpec,
+                                 FederatedUserSummaries, RouteRejected,
+                                 cells_in_token, qualify_token,
+                                 split_entry, strip_for_cell)
+from cook_tpu.federation.rest import build_federation_node
+from cook_tpu.rest import ApiServer, CookApi
+from cook_tpu.sched import Scheduler
+from cook_tpu.state import Resources, Store
+from cook_tpu.state.partition import SummaryStalenessError
+
+pytestmark = pytest.mark.federation
+
+
+def make_cell(data_dir=None, n_hosts=2, prefix="h"):
+    store = Store.open(str(data_dir)) if data_dir else Store()
+    cluster = FakeCluster(
+        f"{prefix}-cluster",
+        [FakeHost(f"{prefix}{i}", Resources(cpus=8, mem=8192))
+         for i in range(n_hosts)])
+    cfg = Config()
+    cfg.default_matcher.backend = "cpu"
+    sched = Scheduler(store, cfg, [cluster], rank_backend="cpu")
+    api = CookApi(store, scheduler=sched, config=cfg)
+    server = ApiServer(api)
+    server.start()
+    return store, cluster, sched, server
+
+
+def fed_over(cells, **conf):
+    section = {"cells": [{"id": cid, "url": srv.url, **extra}
+                         for cid, srv, extra in cells]}
+    section.update(conf)
+    node = build_federation_node(section)
+    node.start()
+    return node
+
+
+# ---------------------------------------------------------------- tokens
+class TestTokens:
+    def test_qualify_prefixes_every_entry(self):
+        assert qualify_token("cellA", "p0:3:128,p1:3:64") == \
+            "cellA/p0:3:128,cellA/p1:3:64"
+        assert qualify_token("cellA", "2372") == "cellA/2372"
+
+    def test_qualify_is_idempotent_per_cell(self):
+        t = qualify_token("cellA", "p0:3:128")
+        assert qualify_token("cellA", t) == t
+
+    def test_split_entry(self):
+        assert split_entry("cellA/p0:3:128") == ("cellA", "p0:3:128")
+        assert split_entry("p0:3:128") == (None, "p0:3:128")
+
+    def test_cells_in_token(self):
+        assert cells_in_token("cellA/p0:1:2,cellB/9,p1:0:4") == \
+            {"cellA", "cellB"}
+
+    def test_strip_for_cell_reduces_and_reports(self):
+        cell_token, others = strip_for_cell(
+            "cellA/p0:3:128,cellB/2372,p1:0:9", "cellA")
+        # target cell's entries lose the prefix; unqualified entries
+        # pass through verbatim; every OTHER cell is reported so the
+        # read can be honestly labeled stale with respect to it
+        assert set(cell_token.split(",")) == {"p0:3:128", "p1:0:9"}
+        assert others == {"cellB"}
+
+    def test_strip_for_cell_none_when_absent(self):
+        cell_token, others = strip_for_cell("cellB/2372", "cellA")
+        assert cell_token is None
+        assert others == {"cellB"}
+
+
+class TestClientTokenMerge:
+    def c(self):
+        return JobClient("http://127.0.0.1:1", user="u")
+
+    def test_cell_qualified_merges_per_cell_partition(self):
+        c = self.c()
+        c._merge_commit_token("cellA/p0:1:10")
+        c._merge_commit_token("cellB/p0:1:20")
+        c._merge_commit_token("cellA/p0:2:30")  # same (cell, partition)
+        assert c.last_commit_offset == "cellA/p0:2:30,cellB/p0:1:20"
+
+    def test_cell_qualified_simple_tokens_merge_per_cell(self):
+        c = self.c()
+        c._merge_commit_token("cellA/100")
+        c._merge_commit_token("cellB/200")
+        c._merge_commit_token("cellA/300")
+        assert c.last_commit_offset == "cellA/300,cellB/200"
+
+    def test_unqualified_replaces_wholesale(self):
+        c = self.c()
+        c._merge_commit_token("cellA/p0:1:10")
+        c._merge_commit_token("4594")  # a non-federated server's token
+        assert c.last_commit_offset == "4594"
+
+    def test_partition_vector_still_merges(self):
+        c = self.c()
+        c._merge_commit_token("p0:1:10,p1:1:20")
+        c._merge_commit_token("p0:1:30")
+        assert c.last_commit_offset == "p0:1:30,p1:1:20"
+
+
+# ---------------------------------------------------------------- config
+class TestFederationConfig:
+    def test_unknown_key_fails_boot(self):
+        with pytest.raises(ValueError, match="unknown federation key"):
+            FederationConfig.from_conf(
+                {"cells": [{"id": "a", "url": "http://x:1"}],
+                 "tpyo": True})
+
+    def test_empty_cells_fails_boot(self):
+        with pytest.raises(ValueError, match="at least one cell"):
+            FederationConfig.from_conf({"cells": []})
+
+    def test_bad_cell_entries_fail_boot(self):
+        for cells in ([{"id": "a/b", "url": "http://x:1"}],
+                      [{"id": "a", "url": "ftp://x:1"}],
+                      [{"id": "a", "url": "http://x:1", "tier": "weird"}],
+                      [{"id": "a", "url": "http://x:1"},
+                       {"id": "a", "url": "http://y:1"}]):
+            with pytest.raises(ValueError):
+                FederationConfig.from_conf({"cells": cells})
+
+    def test_example_federation_conf_boots(self):
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "examples", "cook-federation.json")
+        conf = json.load(open(path))
+        node = build_federation_node(conf["federation"])
+        # never start()ed: boot validation is the point
+        assert not node.router.single_cell
+        assert set(node.router.cells) == {"cellA", "cellB"}
+        assert node.router.cells["cellB"].spec.tier == "spot"
+        cfg = FederationConfig.from_conf(conf["federation"])
+        assert cfg.max_user_pending == 5000
+
+    def test_daemon_refuses_federation_plus_cell_state(self):
+        from cook_tpu.daemon import CookDaemon
+        d = CookDaemon({"federation": {"cells": [
+            {"id": "a", "url": "http://127.0.0.1:1"}]},
+            "scheduler": {"rank_backend": "cpu"}})
+        with pytest.raises(ValueError, match="stateless front-door"):
+            d.start()
+
+    def test_daemon_federation_role_boots_and_stops(self):
+        from cook_tpu.daemon import CookDaemon
+        d = CookDaemon({"federation": {"cells": [
+            {"id": "a", "url": "http://127.0.0.1:1"}]}})
+        d.start()
+        try:
+            assert d.federation is not None
+            assert d.store is None and d.elector is None
+            doc = json.load(urllib.request.urlopen(
+                d.node_url + "/debug/federation"))
+            assert doc["single_cell"] is True
+            assert [c["id"] for c in doc["cells"]] == ["a"]
+        finally:
+            d.shutdown()
+
+    def test_cellspec_validation(self):
+        with pytest.raises(ValueError):
+            CellSpec(id="a,b", url="http://x:1")
+        with pytest.raises(ValueError):
+            CellSpec(id="a", url="http://x:1", weight=0.0)
+
+
+# ------------------------------------------------------------ wire parity
+class TestSingleCellParity:
+    """One configured cell ⇒ the front door is decision- and
+    wire-identical to the cell: PR 19 deployments keep their exact
+    behavior when a router is slotted in front."""
+
+    def test_submit_token_and_reads_are_wire_identical(self, tmp_path):
+        store, _c, sched, server = make_cell(tmp_path / "cell")
+        fed = fed_over([("solo", server, {})],
+                       max_user_pending=1)  # caps must NOT engage
+        try:
+            direct = JobClient(server.url, user="alice")
+            routed = JobClient(fed.url, user="alice")
+            u1 = direct.submit_one("echo a", cpus=1, mem=64)
+            u2 = routed.submit_one("echo b", cpus=1, mem=64)
+            # same token grammar: UNqualified (no cell prefix) — the
+            # single-cell front door never rewrites the wire
+            assert "/" not in direct.last_commit_offset
+            assert "/" not in routed.last_commit_offset
+            # a third submit would trip max_user_pending=1 were the
+            # router enforcing globally; single-cell must pass through
+            routed.submit_one("echo c", cpus=1, mem=64)
+            # reads answer identically through either path
+            assert routed.job(u1)["uuid"] == u1
+            d1, d2 = direct.job(u2), routed.job(u2)
+            assert d1 == d2
+        finally:
+            fed.stop()
+            server.stop()
+
+    def test_single_cell_proxies_every_path(self, tmp_path):
+        _store, _c, _s, server = make_cell(tmp_path / "cell")
+        fed = fed_over([("solo", server, {})])
+        try:
+            for path in ("/pools", "/list?user=alice&state=waiting",
+                         "/failure_reasons", "/info"):
+                a = urllib.request.urlopen(server.url + path).read()
+                b = urllib.request.urlopen(fed.url + path).read()
+                assert a == b, path
+        finally:
+            fed.stop()
+            server.stop()
+
+
+# --------------------------------------------------------- two-cell router
+class TestTwoCellRouting:
+    @pytest.fixture()
+    def duo(self, tmp_path):
+        sa = make_cell(tmp_path / "a", prefix="a")
+        sb = make_cell(tmp_path / "b", prefix="b")
+        yield sa, sb
+        for s in (sa, sb):
+            try:
+                s[3].stop()
+            except Exception:
+                pass
+
+    def test_locality_pin_routes_to_named_cell(self, duo):
+        sa, sb = duo
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})])
+        try:
+            cli = JobClient(fed.url, user="alice")
+            uuids = cli.submit(
+                [{"command": "x", "cpus": 1, "mem": 64,
+                  "labels": {"cell-attribute/cell": "cellB"}}])
+            assert fed.router.cell_of_uuid(uuids[0]) == "cellB"
+            assert cli.last_commit_offset.startswith("cellB/")
+        finally:
+            fed.stop()
+
+    def test_attribute_demand_matches_cells(self, duo):
+        sa, sb = duo
+        fed = fed_over([
+            ("cellA", sa[3], {"attributes": {"region": "east"}}),
+            ("cellB", sb[3], {"attributes": {"region": "west"}})])
+        try:
+            cli = JobClient(fed.url, user="alice")
+            uuids = cli.submit(
+                [{"command": "x", "cpus": 1, "mem": 64,
+                  "labels": {"cell-attribute/region": "west"}}])
+            assert fed.router.cell_of_uuid(uuids[0]) == "cellB"
+            # an unsatisfiable demand refuses loudly, routing nowhere
+            with pytest.raises(Exception) as ei:
+                cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                             "labels": {"cell-attribute/region": "mars"}}])
+            assert "503" in str(ei.value) or "no eligible" in str(ei.value)
+        finally:
+            fed.stop()
+
+    def test_global_pending_cap_spans_cells(self, duo):
+        sa, sb = duo
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})],
+                       max_user_pending=3,
+                       summary_max_age_seconds=0.05)
+        try:
+            cli = JobClient(fed.url, user="alice")
+            # 2 jobs pinned to each cell: per-cell pending never
+            # exceeds 2, so only a GLOBAL merge can see 4
+            cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                         "labels": {"cell-attribute/cell": "cellA"}}
+                        for _ in range(2)])
+            time.sleep(0.06)
+            with pytest.raises(Exception) as ei:
+                cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                             "labels": {"cell-attribute/cell": "cellB"}}
+                            for _ in range(2)])
+            msg = str(ei.value)
+            assert "pending" in msg
+            # the refusal quotes the staleness window it enforced under
+            assert "stale" in msg and "bound" in msg
+            # a different user is not capped (per-user, not global-total)
+            other = JobClient(fed.url, user="bob")
+            other.submit([{"command": "x", "cpus": 1, "mem": 64}])
+        finally:
+            fed.stop()
+
+    def test_gang_routes_whole_to_one_cell(self, duo):
+        import uuid as _uuid
+        sa, sb = duo
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})])
+        try:
+            cli = JobClient(fed.url, user="alice")
+            g = str(_uuid.uuid4())
+            uuids = cli.submit(
+                [{"command": "x", "cpus": 1, "mem": 64, "group": g}
+                 for _ in range(3)],
+                groups=[{"uuid": g, "gang": {"size": 3}}])
+            owners = {fed.router.cell_of_uuid(u) for u in uuids}
+            assert len(owners) == 1
+        finally:
+            fed.stop()
+
+    def test_cross_cell_query_merges_with_honest_staleness(self, duo):
+        sa, sb = duo
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})])
+        try:
+            cli = JobClient(fed.url, user="alice")
+            ua = cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                              "labels": {"cell-attribute/cell": "cellA"}}])
+            ub = cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                              "labels": {"cell-attribute/cell": "cellB"}}])
+            docs = cli.query(ua + ub)
+            assert {d["uuid"] for d in docs} == set(ua + ub)
+            # a single-cell read carrying a 2-cell token declares the
+            # OTHER cell stale instead of faking freshness
+            req = urllib.request.Request(
+                f"{fed.url}/jobs/{ua[0]}",
+                headers={"X-Cook-Min-Offset": cli.last_commit_offset})
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["X-Cook-Federation-Stale-Cells"] == \
+                    "cellB"
+        finally:
+            fed.stop()
+
+
+# --------------------------------------- federated summary edge semantics
+class TestFederatedSummaryEdges:
+    """Satellite: the federated UserSummaryExchange at its edges — an
+    unreachable peer must surface SummaryStalenessError at the bound
+    (never a silently-served stale view), and a drained/rejoined cell
+    must re-converge."""
+
+    def test_unreachable_cell_raises_at_bound(self, tmp_path):
+        sa = make_cell(tmp_path / "a", prefix="a")
+        sb = make_cell(tmp_path / "b", prefix="b")
+        JobClient(sb[3].url, user="alice").submit_one(
+            "x", cpus=1, mem=64)
+        cells = {
+            "cellA": CellHandle(CellSpec(id="cellA", url=sa[3].url)),
+            "cellB": CellHandle(CellSpec(id="cellB", url=sb[3].url))}
+        fs = FederatedUserSummaries(cells, max_age_s=1.5)
+        try:
+            fs.refresh()
+            assert fs.user_totals("alice")["pending"] == 1.0
+            sb[3].kill()  # full outage: listener + live sockets die
+            # inside the bound the CACHED table still serves (honestly
+            # within the window)
+            assert fs.user_totals("alice")["pending"] == 1.0
+            time.sleep(1.6)
+            # past the bound: loud failure, never a silent stale serve
+            with pytest.raises(SummaryStalenessError) as ei:
+                fs.user_totals("alice")
+            assert "stale" in str(ei.value)
+        finally:
+            sa[3].stop()
+
+    def test_never_fetched_cell_is_infinitely_stale(self, tmp_path):
+        sa = make_cell(tmp_path / "a", prefix="a")
+        cells = {
+            "cellA": CellHandle(CellSpec(id="cellA", url=sa[3].url)),
+            "dead": CellHandle(CellSpec(
+                id="dead", url="http://127.0.0.1:1"))}
+        fs = FederatedUserSummaries(cells, max_age_s=0.5)
+        try:
+            # the unreachable cell's users are invisible; enforcement
+            # must refuse rather than enforce around them
+            with pytest.raises(SummaryStalenessError):
+                fs.user_totals("alice")
+        finally:
+            sa[3].stop()
+
+    def test_drain_excludes_and_rejoin_reconverges(self, tmp_path):
+        sa = make_cell(tmp_path / "a", prefix="a")
+        sb = make_cell(tmp_path / "b", prefix="b")
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})],
+                       summary_max_age_seconds=0.05)
+        try:
+            cli = JobClient(fed.url, user="alice")
+            cli.submit([{"command": "x", "cpus": 1, "mem": 64,
+                         "labels": {"cell-attribute/cell": "cellB"}}])
+            router = fed.router
+            router.summaries.refresh()
+            assert router.summaries.user_totals("alice")["pending"] == 1.0
+            router.drain_cell("cellB")
+            time.sleep(0.06)
+            # drained: cellB's demand leaves the merge (operator
+            # intent — a re-routed user must not double-count)
+            assert router.summaries.user_totals("alice")["pending"] == 0.0
+            # drained cells take no new demand
+            with pytest.raises(RouteRejected):
+                router.pick_cell({"jobs": [{
+                    "labels": {"cell-attribute/cell": "cellB"}}]})
+            router.rejoin_cell("cellB")
+            time.sleep(0.06)
+            assert router.summaries.user_totals("alice")["pending"] == 1.0
+        finally:
+            fed.stop()
+            for s in (sa, sb):
+                try:
+                    s[3].stop()
+                except Exception:
+                    pass
+
+    def test_stale_enforcement_answers_503_not_silence(self, tmp_path):
+        sa = make_cell(tmp_path / "a", prefix="a")
+        sb = make_cell(tmp_path / "b", prefix="b")
+        fed = fed_over([("cellA", sa[3], {}), ("cellB", sb[3], {})],
+                       max_user_pending=100,
+                       summary_max_age_seconds=0.2)
+        try:
+            cli = JobClient(fed.url, user="alice")
+            cli.submit_one("x", cpus=1, mem=64)
+            sb[3].kill()
+            fed.router.cells["cellB"].breaker.trip()
+            time.sleep(0.25)
+            body = json.dumps({"jobs": [{
+                "uuid": "00000000-0000-4000-8000-000000000001",
+                "command": "x", "cpus": 1, "mem": 64}]}).encode()
+            req = urllib.request.Request(
+                fed.url + "/jobs", data=body, method="POST",
+                headers={"Content-Type": "application/json",
+                         "X-Cook-User": "alice"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 503
+            doc = json.loads(ei.value.read())
+            assert doc.get("reason") == "summary-stale"
+            assert ei.value.headers.get("Retry-After")
+        finally:
+            fed.stop()
+            sa[3].stop()
+
+
+# ------------------------------------------------------------ cell outage
+class TestCellOutage:
+    def test_outage_smoke(self):
+        from cook_tpu.sim.federation import (CellOutageConfig,
+                                             run_cell_outage)
+        res = run_cell_outage(CellOutageConfig(n_batches=8))
+        assert res.ok, res.violations
+        assert res.lost_jobs == 0
+        assert res.split_gangs == 0
+        assert res.rerouted_batches > 0
+        assert res.breaker_states[res.victim] in ("open", "half-open")
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_outage_soak(self):
+        from cook_tpu.sim.federation import (CellOutageConfig,
+                                             run_cell_outage)
+        res = run_cell_outage(CellOutageConfig(soak=True))
+        assert res.ok, res.violations
+        assert res.jobs_acked >= 150
+        assert res.lost_jobs == 0 and res.split_gangs == 0

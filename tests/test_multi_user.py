@@ -260,6 +260,7 @@ class TestRealProcessesMultiUser:
             agent.stop()
 
 
+@pytest.mark.slow
 class TestStatisticalWorkloadAtScale:
     def test_50k_jobs_wait_time_metrics(self):
         """Statistical workload (Poisson arrivals, lognormal durations) at
@@ -318,6 +319,7 @@ class TestStatisticalWorkloadAtScale:
         assert p50["interactive"] <= p50["batch"] + 1e-9, p50
 
 
+@pytest.mark.slow
 class TestRebalancerChurn:
     def test_preemption_churn_at_thousands_of_jobs(self):
         """Tight capacity + an over-share user + periodic rebalancing at

@@ -1,6 +1,7 @@
 """Statistical workload generator (reference: simulator/ system simulator)."""
 
 import numpy as np
+import pytest
 
 from cook_tpu.sim.simulator import Simulator, load_hosts, load_trace
 from cook_tpu.sim.workload import (
@@ -68,6 +69,7 @@ class TestGenerator:
         assert all(h["cpus"] == 8.0 for h in hosts)
 
 
+@pytest.mark.slow
 class TestScale:
     def test_50k_job_statistical_run_wait_metrics(self):
         """The reference's system-simulator tier at scale (reference:
